@@ -1,0 +1,14 @@
+"""simgrid_tpu — a TPU-native distributed-systems simulation framework.
+
+Brand-new implementation with the capabilities of SimGrid 3.23.3
+(reference at /root/reference): deterministic actor/maestro discrete-event
+kernel, fluid resource models backed by a linear max-min fairness solver
+(solved as a jit'd fixpoint on TPU), hierarchical platform topologies, an
+MPI layer able to run and replay MPI workloads in simulation, tracing,
+fault injection and a model checker.  See SURVEY.md for the structural
+map to the reference.
+"""
+
+__version__ = "0.1.0"
+
+from .utils.config import config  # noqa: F401

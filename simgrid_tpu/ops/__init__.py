@@ -1,0 +1,19 @@
+"""Device-side numerical kernels (JAX/XLA/Pallas) + their exact host oracles.
+
+f64 is enabled globally: the solver's epsilon semantics (maxmin/precision,
+reference maxmin.cpp:12-14) are defined on doubles.  TPU executions opt
+into f32 explicitly via the ``lmm/dtype`` flag.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from .lmm_host import (System, Constraint, Variable, Element, SharingPolicy,  # noqa: E402
+                       make_new_maxmin_system, double_update, double_positive,
+                       double_equals)
+from . import lmm_jax  # noqa: E402
+
+__all__ = ["System", "Constraint", "Variable", "Element", "SharingPolicy",
+           "make_new_maxmin_system", "double_update", "double_positive",
+           "double_equals", "lmm_jax"]

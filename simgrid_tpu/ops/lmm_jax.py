@@ -1,0 +1,301 @@
+"""Vectorized max-min fairness solver on JAX (TPU-native hot path).
+
+This is the north-star component: SimGrid's saturate-bottleneck fixpoint
+(reference semantics: /root/reference/src/kernel/lmm/maxmin.cpp:502-693)
+re-designed for the TPU/XLA execution model instead of intrusive linked
+lists:
+
+* the constraint/variable graph is flattened into COO-style element arrays
+  ``(e_var, e_cnst, e_w)`` padded to bucketed static shapes (XLA wants
+  static shapes; buckets bound recompiles);
+* one *saturation round* = a handful of segment-sum / segment-max scatters
+  plus two min-reductions over dense vectors — bandwidth-bound vector work
+  XLA maps directly onto the TPU's VPU, with the whole fixpoint inside one
+  ``lax.while_loop`` so there is a single device dispatch per solve;
+* the epsilon semantics (``double_update`` clamping, saturation tests
+  against ``bound*eps``) are applied batched, and ties in the min-reduce
+  are detected by exact equality like the reference, so the returned rate
+  vector matches the exact list solver bit-for-bit in f64 on identical
+  round structures.
+
+The same function runs unchanged on CPU (f64, used for validation and as
+the oracle cross-check) and on TPU (f32 by default, f64 unsupported by the
+hardware).  For multi-simulation batching it is ``vmap``-able, and the
+segment ops shard over a device mesh for very large systems (see
+simgrid_tpu.parallel.sharded_solve).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils.config import config
+from .lmm_host import SharingPolicy, System, Constraint, Variable
+
+_MAX_ROUNDS = 100_000
+
+
+class LmmArrays(NamedTuple):
+    """Flattened (padded) view of an LMM system."""
+    e_var: np.ndarray    # [E] int32 — variable slot per element
+    e_cnst: np.ndarray   # [E] int32 — constraint slot per element
+    e_w: np.ndarray      # [E] float — consumption weight (0 padding)
+    c_bound: np.ndarray  # [C] float — constraint capacity (0 padding)
+    c_fatpipe: np.ndarray  # [C] bool — max-sharing (FATPIPE) constraint
+    v_penalty: np.ndarray  # [V] float — sharing penalty (0 = disabled/pad)
+    v_bound: np.ndarray    # [V] float — variable rate bound (-1 = none)
+    n_elem: int
+    n_cnst: int
+    n_var: int
+
+
+def _bucket(n: int) -> int:
+    """Round up to a bucketed size to bound XLA recompiles."""
+    if n <= 16:
+        return 16
+    return 1 << (n - 1).bit_length()
+
+
+@functools.partial(jax.jit, static_argnames=("n_c", "n_v"))
+def _solve_kernel(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
+                  eps, n_c: int, n_v: int):
+    """The saturate-bottleneck fixpoint over padded arrays."""
+    dtype = e_w.dtype
+    inf = jnp.array(jnp.inf, dtype)
+
+    v_enabled = v_penalty > 0
+    e_valid = (e_w > 0) & jnp.take(v_enabled, e_var, fill_value=False)
+    safe_pen = jnp.where(v_enabled, v_penalty, 1.0)
+    e_upen = jnp.where(e_valid, e_w / jnp.take(safe_pen, e_var), 0.0)
+
+    # Initial usage per constraint: sum for SHARED, max for FATPIPE.
+    usage_sum = jnp.zeros(n_c, dtype).at[e_cnst].add(e_upen)
+    usage_max = jnp.zeros(n_c, dtype).at[e_cnst].max(e_upen)
+    usage0 = jnp.where(c_fatpipe, usage_max, usage_sum)
+
+    remaining0 = c_bound
+    # Initial light set: usage strictly positive (exact, maxmin.cpp:545) and
+    # remaining above the relative epsilon (maxmin.cpp:524).
+    light0 = (remaining0 > c_bound * eps) & (usage0 > 0)
+
+    v_value0 = jnp.zeros(n_v, dtype)
+    v_fixed0 = jnp.zeros(n_v, dtype=bool)
+
+    def cond(state):
+        _, _, _, _, light, it = state
+        return jnp.any(light) & (it < _MAX_ROUNDS)
+
+    def body(state):
+        v_value, v_fixed, remaining, usage, light, it = state
+
+        rou = jnp.where(light, remaining / jnp.where(light, usage, 1.0), inf)
+        min_usage = jnp.min(rou)
+        saturated_c = light & (rou == min_usage)
+
+        # Saturated variables: any live element inside a saturated constraint.
+        e_live = e_valid & ~jnp.take(v_fixed, e_var)
+        e_sat = e_live & jnp.take(saturated_c, e_cnst)
+        v_sat = jnp.zeros(n_v, dtype=bool).at[e_var].max(e_sat)
+
+        # Bound-first rule (maxmin.cpp:566-596): if any saturated variable's
+        # bound*penalty sits below min_usage, fix (only) the variables whose
+        # bound*penalty equals the smallest such value this round.
+        bp = v_bound * v_penalty
+        has_low_bound = v_sat & (v_bound > 0) & (bp < min_usage)
+        min_bound = jnp.min(jnp.where(has_low_bound, bp, inf))
+        use_bounds = jnp.isfinite(min_bound)
+
+        fix_now = jnp.where(use_bounds,
+                            v_sat & (jnp.abs(bp - min_bound) < eps),
+                            v_sat)
+        new_value = jnp.where(use_bounds, v_bound,
+                              min_usage / jnp.where(v_enabled, v_penalty, 1.0))
+        v_value = jnp.where(fix_now, new_value, v_value)
+        v_fixed = v_fixed | fix_now
+
+        # Batched double_update on every constraint touched by fixed vars.
+        e_fix = e_valid & jnp.take(fix_now, e_var)
+        d_rem = jnp.zeros(n_c, dtype).at[e_cnst].add(
+            jnp.where(e_fix, e_w * jnp.take(v_value, e_var), 0.0))
+        d_use = jnp.zeros(n_c, dtype).at[e_cnst].add(
+            jnp.where(e_fix, e_upen, 0.0))
+
+        new_remaining = remaining - d_rem
+        new_remaining = jnp.where(new_remaining < c_bound * eps, 0.0, new_remaining)
+        new_usage_sum = usage - d_use
+        new_usage_sum = jnp.where(new_usage_sum < eps, 0.0, new_usage_sum)
+
+        # FATPIPE: usage is re-derived as the max over still-unset variables.
+        e_live2 = e_valid & ~jnp.take(v_fixed, e_var)
+        new_usage_max = jnp.zeros(n_c, dtype).at[e_cnst].max(
+            jnp.where(e_live2, e_upen, 0.0))
+
+        touched = jnp.zeros(n_c, dtype=bool).at[e_cnst].max(e_fix)
+        new_usage = jnp.where(c_fatpipe, new_usage_max, new_usage_sum)
+        usage = jnp.where(touched, new_usage, usage)
+        remaining = jnp.where(touched & ~c_fatpipe, new_remaining, remaining)
+
+        # A constraint leaves the light set only when *touched* by a fixed
+        # variable and failing the epsilon tests (maxmin.cpp:607-609);
+        # untouched constraints with tiny-but-positive usage stay in.
+        drop = touched & (~(usage > eps) | ~(remaining > c_bound * eps))
+        light = light & ~drop
+        return v_value, v_fixed, remaining, usage, light, it + 1
+
+    v_value, v_fixed, remaining, usage, light, rounds = lax.while_loop(
+        cond, body, (v_value0, v_fixed0, remaining0, usage0, light0,
+                     jnp.array(0, jnp.int32)))
+    return v_value, remaining, usage, rounds
+
+
+def flatten(cnst_list: List[Constraint], dtype=np.float64
+            ) -> Optional[Tuple[LmmArrays, List["Variable"]]]:
+    """Flatten the live portion of a host System into padded COO arrays.
+
+    Slot numbering follows the constraint-list iteration order and, within
+    each constraint, the enabled-element list order, giving the same
+    deterministic structure the reference's intrusive lists provide.
+    """
+    var_slots = {}
+    v_penalty: List[float] = []
+    v_bound: List[float] = []
+    vars_in_order = []
+    e_var: List[int] = []
+    e_cnst: List[int] = []
+    e_w: List[float] = []
+    c_bound: List[float] = []
+    c_fat: List[bool] = []
+
+    for ci, cnst in enumerate(cnst_list):
+        c_bound.append(cnst.bound)
+        c_fat.append(cnst.sharing_policy == SharingPolicy.FATPIPE)
+        for elem in cnst.enabled_element_set:
+            var = elem.variable
+            slot = var_slots.get(id(var))
+            if slot is None:
+                slot = len(v_penalty)
+                var_slots[id(var)] = slot
+                v_penalty.append(var.sharing_penalty)
+                v_bound.append(var.bound)
+                vars_in_order.append(var)
+            e_var.append(slot)
+            e_cnst.append(ci)
+            e_w.append(elem.consumption_weight)
+
+    n_e, n_c, n_v = len(e_var), len(c_bound), len(v_penalty)
+    if n_c == 0:
+        return None
+    E, C, V = _bucket(max(n_e, 1)), _bucket(n_c), _bucket(max(n_v, 1))
+
+    arrays = LmmArrays(
+        e_var=np.zeros(E, np.int32), e_cnst=np.zeros(E, np.int32),
+        e_w=np.zeros(E, dtype), c_bound=np.zeros(C, dtype),
+        c_fatpipe=np.zeros(C, bool), v_penalty=np.zeros(V, dtype),
+        v_bound=np.full(V, -1.0, dtype), n_elem=n_e, n_cnst=n_c, n_var=n_v)
+    arrays.e_var[:n_e] = e_var
+    # Padding elements point at constraint slot 0 with weight 0: harmless.
+    arrays.e_cnst[:n_e] = e_cnst
+    arrays.e_w[:n_e] = e_w
+    arrays.c_bound[:n_c] = c_bound
+    arrays.c_fatpipe[:n_c] = c_fat
+    arrays.v_penalty[:n_v] = v_penalty
+    arrays.v_bound[:n_v] = v_bound
+    return arrays, vars_in_order
+
+
+def solve_arrays(arrays: LmmArrays, eps: float, device=None):
+    """Run the jit'd fixpoint; returns (values ndarray, rounds)."""
+    kw = {}
+    args = [arrays.e_var, arrays.e_cnst, arrays.e_w, arrays.c_bound,
+            arrays.c_fatpipe, arrays.v_penalty, arrays.v_bound,
+            np.asarray(eps, arrays.e_w.dtype)]
+    if device is not None:
+        args = [jax.device_put(a, device) for a in args]
+    values, remaining, usage, rounds = _solve_kernel(
+        *args, n_c=len(arrays.c_bound), n_v=len(arrays.v_penalty), **kw)
+    return np.asarray(values), np.asarray(remaining), np.asarray(usage), int(rounds)
+
+
+def solve_jax(system: System) -> None:
+    """Backend entry: flatten host graph, solve on device, scatter back.
+
+    Mirrors the side effects of System::lmm_solve (maxmin.cpp:487-500):
+    values written to variables, modified-action collection for lazy model
+    updates, constraint usage left consistent, modified flags cleared.
+    """
+    if system.selective_update_active:
+        cnst_list = list(system.modified_constraint_set)
+    else:
+        cnst_list = list(system.active_constraint_set)
+
+    eps = config["maxmin/precision"]
+    dtype = np.float32 if config["lmm/dtype"] == "float32" else np.float64
+
+    # Reset + collect modified actions exactly like the init pass of the
+    # list solver (maxmin.cpp:509-539).
+    for cnst in cnst_list:
+        for elem in cnst.enabled_element_set:
+            elem.variable.value = 0.0
+    if system.modified_actions is not None:
+        for cnst in cnst_list:
+            if not (cnst.bound > cnst.bound * eps):
+                continue
+            for elem in cnst.enabled_element_set:
+                if elem.consumption_weight > 0:
+                    action = elem.variable.id
+                    if action is not None and not getattr(action, "in_modified_set", False):
+                        action.in_modified_set = True
+                        system.modified_actions.append(action)
+
+    flat = flatten(cnst_list, dtype)
+    if flat is not None:
+        arrays, vars_in_order = flat
+        values, remaining, usage, _ = solve_arrays(arrays, eps)
+        for slot, var in enumerate(vars_in_order):
+            var.value = float(values[slot])
+        # Scatter back the kernel's end-state remaining/usage so constraint
+        # introspection matches the list solver's post-solve state.
+        for ci, cnst in enumerate(cnst_list):
+            cnst.remaining = float(remaining[ci])
+            cnst.usage = float(usage[ci])
+
+    system.modified = False
+    if system.selective_update_active:
+        system.remove_all_modified_set()
+
+
+def _count_live_vars(system: System) -> int:
+    n = 0
+    for var in system.variable_set:
+        if var.sharing_penalty <= 0:
+            break  # enabled vars are kept at the list head
+        n += 1
+    return n
+
+
+def dispatching_solve(system: System) -> None:
+    """'auto' backend: exact list solver for small live sets, JAX above
+    the lmm/jax-threshold crossover (SURVEY.md hard part (e))."""
+    if _count_live_vars(system) >= config["lmm/jax-threshold"]:
+        solve_jax(system)
+    else:
+        system.solve_exact()
+
+
+def install(system: System, backend: Optional[str] = None) -> System:
+    """Attach the configured solver backend to a System."""
+    backend = backend or config["lmm/backend"]
+    if backend == "jax":
+        system.solve_fn = solve_jax
+    elif backend == "auto":
+        system.solve_fn = dispatching_solve
+    else:
+        system.solve_fn = None
+    return system

@@ -1,0 +1,8 @@
+"""Utility base layer (the XBT equivalent): config, logging, signals."""
+
+from .config import config, declare_flag, ConfigError
+from .log import get_category, new_category, apply_control
+from .signal import Signal
+
+__all__ = ["config", "declare_flag", "ConfigError", "get_category",
+           "new_category", "apply_control", "Signal"]

@@ -1,0 +1,229 @@
+"""Typed configuration/flag registry.
+
+TPU-native re-design of SimGrid's xbt config system
+(reference: /root/reference/src/xbt/config.cpp, flag declarations in
+/root/reference/src/simgrid/sg_config.cpp:258-437).  Same capabilities:
+typed flags with defaults, aliases, on-set callbacks, ``--cfg=key:value``
+command-line parsing and ``help-cfg`` dump — implemented as a plain Python
+registry (no C++ needed host-side).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Callable, Dict, List, Optional
+
+
+class ConfigError(Exception):
+    pass
+
+
+class _Flag:
+    __slots__ = ("name", "description", "default", "value", "type", "callback", "aliases")
+
+    def __init__(self, name: str, description: str, default: Any,
+                 callback: Optional[Callable[[Any], None]] = None,
+                 aliases: Optional[List[str]] = None):
+        self.name = name
+        self.description = description
+        self.default = default
+        self.value = default
+        self.type = type(default)
+        self.callback = callback
+        self.aliases = aliases or []
+
+
+_TRUTHY = {"yes", "on", "true", "1"}
+_FALSY = {"no", "off", "false", "0"}
+
+
+class Config:
+    """A registry of typed flags (the equivalent of simgrid's sg_cfg_*)."""
+
+    def __init__(self) -> None:
+        self._flags: Dict[str, _Flag] = {}
+        self._alias: Dict[str, str] = {}
+
+    # -- declaration ------------------------------------------------------
+    def declare(self, name: str, description: str, default: Any,
+                callback: Optional[Callable[[Any], None]] = None,
+                aliases: Optional[List[str]] = None) -> None:
+        if name in self._flags:
+            # Re-declaration keeps the already-set value (mirrors the
+            # reference's idempotent module registration).
+            return
+        flag = _Flag(name, description, default, callback, aliases)
+        self._flags[name] = flag
+        for a in flag.aliases:
+            self._alias[a] = name
+
+    # -- access -----------------------------------------------------------
+    def _resolve(self, name: str) -> _Flag:
+        name = self._alias.get(name, name)
+        try:
+            return self._flags[name]
+        except KeyError:
+            raise ConfigError(f"Unknown configuration key '{name}' "
+                              f"(try help-cfg for the list)") from None
+
+    def get(self, name: str) -> Any:
+        return self._resolve(name).value
+
+    def __getitem__(self, name: str) -> Any:
+        return self.get(name)
+
+    def set(self, name: str, value: Any) -> None:
+        flag = self._resolve(name)
+        if isinstance(value, str) and flag.type is not str:
+            value = self._parse(flag, value)
+        elif flag.type is float and isinstance(value, int):
+            value = float(value)
+        if not isinstance(value, flag.type) and not (flag.type is float and isinstance(value, int)):
+            raise ConfigError(f"Invalid value {value!r} for flag '{flag.name}' "
+                              f"of type {flag.type.__name__}")
+        flag.value = value
+        if flag.callback is not None:
+            flag.callback(value)
+
+    def __setitem__(self, name: str, value: Any) -> None:
+        self.set(name, value)
+
+    def is_default(self, name: str) -> bool:
+        flag = self._resolve(name)
+        return flag.value == flag.default
+
+    @staticmethod
+    def _parse(flag: _Flag, text: str) -> Any:
+        if flag.type is bool:
+            low = text.lower()
+            if low in _TRUTHY:
+                return True
+            if low in _FALSY:
+                return False
+            raise ConfigError(f"Invalid boolean '{text}' for flag '{flag.name}'")
+        if flag.type is int:
+            return int(text)
+        if flag.type is float:
+            return float(text)
+        return text
+
+    # -- command line -----------------------------------------------------
+    def set_from_string(self, opt: str) -> None:
+        """Parse one ``key:value`` option (the --cfg= payload)."""
+        if ":" not in opt:
+            raise ConfigError(f"Invalid --cfg option '{opt}', expected key:value")
+        key, value = opt.split(":", 1)
+        self.set(key.strip(), value.strip())
+
+    def parse_argv(self, argv: List[str]) -> List[str]:
+        """Consume --cfg=... / --log=... / --help-cfg from argv, returning the rest."""
+        from . import log as _log
+        remaining: List[str] = []
+        for arg in argv:
+            if arg.startswith("--cfg="):
+                self.set_from_string(arg[len("--cfg="):])
+            elif arg.startswith("--log="):
+                _log.apply_control(arg[len("--log="):])
+            elif arg == "--help-cfg":
+                self.dump(sys.stdout)
+            else:
+                remaining.append(arg)
+        return remaining
+
+    def dump(self, out) -> None:
+        for name in sorted(self._flags):
+            f = self._flags[name]
+            out.write(f"   {name}: {f.description} (default: {f.default!r})\n")
+
+
+#: Process-wide configuration registry (mirrors simgrid_config).
+config = Config()
+
+
+def declare_flag(name: str, description: str, default: Any,
+                 callback: Optional[Callable[[Any], None]] = None,
+                 aliases: Optional[List[str]] = None) -> None:
+    config.declare(name, description, default, callback, aliases)
+
+
+# ---------------------------------------------------------------------------
+# Core solver / kernel flags, same key names as the reference
+# (sg_config.cpp:258-437, maxmin.cpp:12-14).
+# ---------------------------------------------------------------------------
+declare_flag("maxmin/precision",
+             "Numerical precision used when updating simulation variables",
+             1e-5, aliases=["maxmin/epsilon"])
+declare_flag("surf/precision",
+             "Numerical precision used when comparing simulated times",
+             1e-5)
+declare_flag("maxmin/concurrency-limit",
+             "Maximum number of concurrent variables per resource (-1: none)",
+             -1)
+declare_flag("host/model", "Host model to use", "default")
+declare_flag("cpu/model", "CPU model to use", "Cas01")
+declare_flag("network/model", "Network model to use", "LV08")
+declare_flag("storage/model", "Storage model to use", "default")
+declare_flag("cpu/optim", "CPU optimization mode (Lazy/TI/Full)", "Lazy")
+declare_flag("network/optim", "Network optimization mode (Lazy/Full)", "Lazy")
+declare_flag("cpu/maxmin-selective-update",
+             "Update the constraint set selectively for CPU", False)
+declare_flag("network/maxmin-selective-update",
+             "Update the constraint set selectively for network", False)
+declare_flag("network/crosstraffic",
+             "Model cross-traffic (bidirectional flows interfere)", True)
+declare_flag("network/TCP-gamma",
+             "Maximum TCP window size (bytes)", 4194304.0)
+declare_flag("network/latency-factor",
+             "Multiplier for link latencies", 1.0)
+declare_flag("network/bandwidth-factor",
+             "Multiplier for link bandwidths", 1.0)
+declare_flag("network/weight-S",
+             "RTT cost correction added per link (LV08: 20537)", 0.0)
+declare_flag("network/loopback-bw", "Default loopback bandwidth", 498000000.0)
+declare_flag("network/loopback-lat", "Default loopback latency", 0.000015)
+declare_flag("lmm/backend",
+             "Max-min solver backend: list (exact host), jax (vectorized, "
+             "TPU/CPU), auto (jax above lmm/jax-threshold variables)", "auto")
+declare_flag("lmm/jax-threshold",
+             "Minimum live variable count before 'auto' switches the solve "
+             "to the JAX backend", 512)
+declare_flag("lmm/dtype", "JAX solver dtype: float64 or float32", "float64")
+declare_flag("contexts/stack-size", "Actor stack size (bytes)", 131072)
+declare_flag("contexts/factory", "Actor context factory (thread)", "thread")
+declare_flag("tracing", "Enable tracing", False)
+declare_flag("tracing/filename", "Trace output file", "simgrid.trace")
+declare_flag("tracing/format", "Trace format (Paje|TI)", "Paje")
+declare_flag("tracing/platform", "Trace platform resources", False)
+declare_flag("tracing/actor", "Trace actor behavior", False)
+declare_flag("tracing/uncategorized",
+             "Trace uncategorized resource usage", False)
+declare_flag("tracing/smpi", "Trace SMPI ranks", False)
+declare_flag("tracing/smpi/computing", "Trace SMPI computing states", False)
+declare_flag("smpi/async-small-thresh",
+             "Maximum size of messages sent over the eager (async) protocol",
+             0)
+declare_flag("smpi/send-is-detached-thresh",
+             "Threshold under which MPI_Send is done in a detached manner",
+             65536)
+declare_flag("smpi/host-speed",
+             "Speed of the host running the simulation (flop/s)", 20000.0)
+declare_flag("smpi/os", "Overhead of a send (size-dependent segments)", "0:0:0:0:0")
+declare_flag("smpi/or", "Overhead of a receive", "0:0:0:0:0")
+declare_flag("smpi/ois", "Overhead of an isend", "0:0:0:0:0")
+declare_flag("smpi/bw-factor", "Piecewise bandwidth factors size:factor;...",
+             "65472:0.940694;15424:0.697866;9376:0.58729;5776:1.08739;3484:0.77493;"
+             "1426:0.608902;732:0.341987;257:0.338112;0:0.812084")
+declare_flag("smpi/lat-factor", "Piecewise latency factors size:factor;...",
+             "65472:11.6436;15424:3.48845;9376:2.59299;5776:2.18796;3484:1.88101;"
+             "1426:1.61075;732:1.9503;257:1.95341;0:2.01467")
+declare_flag("smpi/IB-penalty-factors",
+             "InfiniBand penalty factors beta_s;beta_e;gamma", "0.965;0.925;1.35")
+declare_flag("smpi/simulate-computation",
+             "Simulate the computation of the application", True)
+declare_flag("smpi/cpu-threshold",
+             "Minimal computation time (s) not discarded", 1e-6)
+declare_flag("smpi/coll-selector", "Collective algorithm selector", "default")
+declare_flag("model-check/reduction", "DPOR reduction (none|dpor)", "dpor")
+declare_flag("model-check/max-depth", "Maximal exploration depth", 1000)
+declare_flag("precision-tracking/jax",
+             "Tolerance used when cross-checking JAX solver results", 1e-9)

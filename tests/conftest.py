@@ -1,0 +1,25 @@
+"""Test harness config: run JAX on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; sharding tests run over
+virtual CPU devices instead (the driver separately dry-run-compiles the
+multi-chip path via __graft_entry__.dryrun_multichip).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_config():
+    """Snapshot/restore the global flag registry around each test."""
+    from simgrid_tpu.utils.config import config
+    saved = {name: f.value for name, f in config._flags.items()}
+    yield
+    for name, value in saved.items():
+        config._flags[name].value = value
