@@ -1,0 +1,1 @@
+"""Kernel layer: resource models base, profiles, actors, activities, engine."""

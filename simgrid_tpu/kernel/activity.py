@@ -1,0 +1,816 @@
+"""Kernel activities: the blocking operations actors wait on.
+
+Semantics from the reference's src/kernel/activity/: CommImpl (rendezvous
+matching via mailboxes, eager permanent-receiver queue, detached sends,
+timeout sleep actions, data copy), ExecImpl, SleepImpl, IoImpl and the
+synchronization primitives (Mutex/Semaphore/ConditionVariable), plus
+RawImpl used as timeout detector for synchro waits.  Each activity owns the
+surf action(s) driving it; when an action completes/fails the engine calls
+``post()``, which computes the activity state and answers the registered
+simcalls in FIFO order.
+"""
+
+from __future__ import annotations
+
+import sys
+from collections import deque
+from enum import Enum
+from typing import Callable, List, Optional
+
+from ..exceptions import (CancelException, HostFailureException,
+                          NetworkFailureException, StorageFailureException,
+                          TimeoutException)
+from ..utils.signal import Signal
+from .resource import Action, ActionState
+
+
+class State(Enum):
+    WAITING = 0       # not matched yet / not started
+    READY = 1         # comm matched, not yet started
+    RUNNING = 2
+    DONE = 3
+    CANCELED = 4
+    FAILED = 5
+    SRC_TIMEOUT = 6
+    DST_TIMEOUT = 7
+    SRC_HOST_FAILURE = 8
+    DST_HOST_FAILURE = 9
+    LINK_FAILURE = 10
+    TIMEOUT = 11
+    SLEEPING = 12
+
+
+class ActivityImpl:
+    """Base kernel activity (reference ActivityImpl.hpp)."""
+
+    def __init__(self, engine, name: str = ""):
+        self.engine = engine
+        self.name = name
+        self.state = State.WAITING
+        self.simcalls: deque = deque()
+        self.surf_action: Optional[Action] = None
+        self.category: Optional[str] = None
+
+    def register_simcall(self, simcall) -> None:
+        self.simcalls.append(simcall)
+        simcall.issuer.waiting_synchro = self
+
+    def is_pending(self) -> bool:
+        return self.state in (State.WAITING, State.RUNNING, State.READY)
+
+    def clean_action(self) -> None:
+        if self.surf_action is not None:
+            self.surf_action.activity = None
+            self.surf_action.unref()
+            self.surf_action = None
+
+    def suspend(self) -> None:
+        if self.surf_action is not None:
+            self.surf_action.suspend()
+
+    def resume(self) -> None:
+        if self.surf_action is not None:
+            self.surf_action.resume()
+
+    def cancel(self) -> None:
+        if self.surf_action is not None:
+            self.surf_action.cancel()
+
+    def get_remaining(self) -> float:
+        return self.surf_action.get_remains() if self.surf_action else 0.0
+
+    def post(self) -> None:
+        raise NotImplementedError
+
+    def finish(self) -> None:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Communications
+# ---------------------------------------------------------------------------
+
+class CommType(Enum):
+    SEND = 0
+    RECEIVE = 1
+    READY = 2
+    DONE = 3
+
+
+class CommImpl(ActivityImpl):
+    """A point-to-point communication (reference CommImpl.cpp)."""
+
+    def __init__(self, engine):
+        super().__init__(engine)
+        self.type = CommType.SEND
+        self.src_actor = None
+        self.dst_actor = None
+        self.src_data = None       # payload handed by the sender
+        self.dst_data = None
+        self.src_buff = None       # payload container [value]
+        self.dst_buff = None       # receiver's container: list to fill
+        self.size = 0.0
+        self.rate = -1.0
+        self.detached = False
+        self.mailbox: Optional["MailboxImpl"] = None
+        self.match_fun: Optional[Callable] = None
+        self.copy_data_fun: Optional[Callable] = None
+        self.clean_fun: Optional[Callable] = None
+        self.src_timeout: Optional[Action] = None
+        self.dst_timeout: Optional[Action] = None
+        self.copied = False
+
+    def start(self) -> "CommImpl":
+        # reference CommImpl::start (CommImpl.cpp:425-465)
+        if self.state == State.READY:
+            sender = self.src_actor.host
+            receiver = self.dst_actor.host
+            self.surf_action = self.engine.network_model.communicate(
+                sender, receiver, self.size, self.rate)
+            self.surf_action.activity = self
+            self.surf_action.category = self.category
+            self.state = State.RUNNING
+            if self.surf_action.get_state() == ActionState.FAILED:
+                self.state = State.LINK_FAILURE
+                self.post()
+            elif self.src_actor.suspended or self.dst_actor.suspended:
+                self.surf_action.suspend()
+        return self
+
+    def copy_data(self) -> None:
+        if self.src_buff is None or self.dst_buff is None or self.copied:
+            return
+        if self.copy_data_fun is not None:
+            self.copy_data_fun(self, self.src_buff)
+        else:
+            self.dst_buff[0] = self.src_buff[0]
+        self.copied = True
+
+    def cancel(self) -> None:
+        if self.state == State.WAITING:
+            if not self.detached:
+                if self.mailbox is not None:
+                    self.mailbox.remove(self)
+                self.state = State.CANCELED
+        elif self.state in (State.READY, State.RUNNING):
+            if self.surf_action is not None:
+                self.surf_action.cancel()
+
+    def cleanup_surf(self) -> None:
+        self.clean_action()
+        if self.src_timeout is not None:
+            self.src_timeout.unref()
+            self.src_timeout = None
+        if self.dst_timeout is not None:
+            self.dst_timeout.unref()
+            self.dst_timeout = None
+
+    def post(self) -> None:
+        # reference CommImpl::post (CommImpl.cpp:545-569)
+        if (self.src_timeout is not None
+                and self.src_timeout.get_state() == ActionState.FINISHED):
+            self.state = State.SRC_TIMEOUT
+        elif (self.dst_timeout is not None
+                and self.dst_timeout.get_state() == ActionState.FINISHED):
+            self.state = State.DST_TIMEOUT
+        elif (self.src_timeout is not None
+                and self.src_timeout.get_state() == ActionState.FAILED):
+            self.state = State.SRC_HOST_FAILURE
+        elif (self.dst_timeout is not None
+                and self.dst_timeout.get_state() == ActionState.FAILED):
+            self.state = State.DST_HOST_FAILURE
+        elif (self.surf_action is not None
+                and self.surf_action.get_state() == ActionState.FAILED):
+            self.state = State.LINK_FAILURE
+        else:
+            self.state = State.DONE
+        self.cleanup_surf()
+        self.finish()
+
+    def finish(self) -> None:
+        # reference CommImpl::finish (CommImpl.cpp:571-713)
+        while self.simcalls:
+            simcall = self.simcalls.popleft()
+            if simcall.call is None:
+                continue  # issuer got killed
+            if simcall.call == "comm_waitany":
+                comms = simcall.payload["comms"]
+                for comm in comms:
+                    try:
+                        comm.simcalls.remove(simcall)
+                    except ValueError:
+                        pass
+                if simcall.timeout_cb is not None:
+                    simcall.timeout_cb.remove()
+                    simcall.timeout_cb = None
+                simcall.result = comms.index(self) if self in comms else -1
+
+            if self.mailbox is not None:
+                self.mailbox.remove(self)
+
+            issuer = simcall.issuer
+            if not issuer.host.is_on():
+                issuer.context.iwannadie = True
+            else:
+                if self.state == State.DONE:
+                    self.copy_data()
+                elif self.state == State.SRC_TIMEOUT:
+                    issuer.exception = TimeoutException(
+                        "Communication timeouted because of the sender")
+                elif self.state == State.DST_TIMEOUT:
+                    issuer.exception = TimeoutException(
+                        "Communication timeouted because of the receiver")
+                elif self.state == State.SRC_HOST_FAILURE:
+                    if issuer is self.src_actor:
+                        issuer.context.iwannadie = True
+                    else:
+                        issuer.exception = NetworkFailureException("Remote peer failed")
+                elif self.state == State.DST_HOST_FAILURE:
+                    if issuer is self.dst_actor:
+                        issuer.context.iwannadie = True
+                    else:
+                        issuer.exception = NetworkFailureException("Remote peer failed")
+                elif self.state == State.LINK_FAILURE:
+                    issuer.exception = NetworkFailureException("Link failure")
+                elif self.state == State.CANCELED:
+                    if issuer is self.dst_actor:
+                        issuer.exception = CancelException(
+                            "Communication canceled by the sender")
+                    else:
+                        issuer.exception = CancelException(
+                            "Communication canceled by the receiver")
+                else:
+                    raise AssertionError(
+                        f"Unexpected comm state in finish: {self.state}")
+                issuer.simcall_answer()
+
+            if (issuer.exception is not None
+                    and simcall.call in ("comm_waitany", "comm_testany")):
+                comms = simcall.payload["comms"]
+                issuer.exception.value = comms.index(self) if self in comms else -1
+
+            issuer.waiting_synchro = None
+            if self in issuer.comms:
+                issuer.comms.remove(self)
+            if self.detached:
+                for side in (self.src_actor, self.dst_actor):
+                    if side is not None and side is not issuer and self in side.comms:
+                        side.comms.remove(self)
+
+
+class MailboxImpl:
+    """Named rendezvous point (reference MailboxImpl.cpp)."""
+
+    def __init__(self, engine, name: str):
+        self.engine = engine
+        self.name = name
+        self.comm_queue: List[CommImpl] = []
+        self.done_comm_queue: List[CommImpl] = []  # permanent-receiver mode
+        self.permanent_receiver = None
+
+    def __repr__(self):
+        return f"<Mailbox {self.name}>"
+
+    def set_receiver(self, actor) -> None:
+        self.permanent_receiver = actor
+
+    def push(self, comm: CommImpl) -> None:
+        comm.mailbox = self
+        self.comm_queue.append(comm)
+
+    def remove(self, comm: CommImpl) -> None:
+        comm.mailbox = None
+        try:
+            self.comm_queue.remove(comm)
+        except ValueError:
+            pass
+
+    def find_matching_comm(self, type_: CommType, match_fun, this_user_data,
+                           my_synchro: CommImpl, done: bool,
+                           remove_matching: bool) -> Optional[CommImpl]:
+        # reference MailboxImpl.cpp:125-160
+        queue = self.done_comm_queue if done else self.comm_queue
+        for comm in queue:
+            if comm.type == CommType.SEND:
+                other_user_data = comm.src_data
+            elif comm.type == CommType.RECEIVE:
+                other_user_data = comm.dst_data
+            else:
+                other_user_data = None
+            if (comm.type == type_
+                    and (match_fun is None
+                         or match_fun(this_user_data, other_user_data, comm))
+                    and (comm.match_fun is None
+                         or comm.match_fun(other_user_data, this_user_data,
+                                           my_synchro))):
+                comm.mailbox = None
+                if remove_matching:
+                    queue.remove(comm)
+                return comm
+        return None
+
+    def iprobe(self, sender_side: bool, match_fun, data) -> Optional[CommImpl]:
+        this_comm = CommImpl(self.engine)
+        if sender_side:
+            this_comm.type = CommType.SEND
+            look_for = CommType.RECEIVE
+        else:
+            this_comm.type = CommType.RECEIVE
+            look_for = CommType.SEND
+        other = None
+        if self.permanent_receiver is not None and self.done_comm_queue:
+            other = self.find_matching_comm(look_for, match_fun, data,
+                                            this_comm, True, False)
+        if other is None:
+            other = self.find_matching_comm(look_for, match_fun, data,
+                                            this_comm, False, False)
+        return other
+
+
+# ---------------------------------------------------------------------------
+# Executions / sleeps / IO
+# ---------------------------------------------------------------------------
+
+class ExecImpl(ActivityImpl):
+    """A computation on one (or several) host CPUs (reference ExecImpl.cpp)."""
+
+    on_creation = Signal()
+    on_completion = Signal()
+
+    def __init__(self, engine, name: str = ""):
+        super().__init__(engine, name)
+        self.hosts = []
+        self.flops_amounts: List[float] = []
+        self.bytes_amounts: List[float] = []
+        self.bound = 0.0
+        self.sharing_penalty = 1.0
+        self.timeout_detector: Optional[Action] = None
+
+    def set_timeout(self, timeout: float) -> None:
+        if timeout > 0:
+            self.timeout_detector = self.hosts[0].cpu.sleep(timeout)
+            self.timeout_detector.activity = self
+
+    def start(self) -> "ExecImpl":
+        self.state = State.RUNNING
+        if len(self.hosts) == 1:
+            self.surf_action = self.hosts[0].cpu.execution_start(
+                self.flops_amounts[0])
+            self.surf_action.set_sharing_penalty(self.sharing_penalty)
+            self.surf_action.category = self.category
+            if self.bound > 0:
+                self.surf_action.set_bound(self.bound)
+        else:
+            self.surf_action = self.engine.host_model.execute_parallel(
+                self.hosts, self.flops_amounts, self.bytes_amounts, -1)
+        self.surf_action.activity = self
+        ExecImpl.on_creation(self)
+        return self
+
+    def post(self) -> None:
+        if len(self.hosts) == 1 and not self.hosts[0].is_on():
+            self.state = State.FAILED
+        elif (self.surf_action is not None
+                and self.surf_action.get_state() == ActionState.FAILED):
+            self.state = State.CANCELED
+        elif (self.timeout_detector is not None
+                and self.timeout_detector.get_state() == ActionState.FINISHED):
+            self.state = State.TIMEOUT
+        else:
+            self.state = State.DONE
+        ExecImpl.on_completion(self)
+        self.clean_action()
+        if self.timeout_detector is not None:
+            self.timeout_detector.unref()
+            self.timeout_detector = None
+        self.finish()
+
+    def finish(self) -> None:
+        while self.simcalls:
+            simcall = self.simcalls.popleft()
+            if simcall.call is None:
+                continue
+            if simcall.call == "execution_waitany":
+                execs = simcall.payload["execs"]
+                for ex in execs:
+                    try:
+                        ex.simcalls.remove(simcall)
+                    except ValueError:
+                        pass
+                if simcall.timeout_cb is not None:
+                    simcall.timeout_cb.remove()
+                    simcall.timeout_cb = None
+                simcall.result = execs.index(self) if self in execs else -1
+            issuer = simcall.issuer
+            if issuer.context.iwannadie:
+                continue
+            if self.state == State.DONE:
+                pass
+            elif self.state == State.FAILED:
+                issuer.context.iwannadie = True
+                if issuer.host.is_on():
+                    # host came back: deliver as exception instead
+                    issuer.context.iwannadie = False
+                    issuer.exception = HostFailureException("Host failed")
+            elif self.state == State.CANCELED:
+                issuer.exception = CancelException("Execution Canceled")
+            elif self.state == State.TIMEOUT:
+                issuer.exception = TimeoutException("Timeouted")
+            else:
+                raise AssertionError(f"Unexpected exec state {self.state}")
+            issuer.waiting_synchro = None
+            issuer.simcall_answer()
+
+
+class SleepImpl(ActivityImpl):
+    """An actor sleeping for a duration (reference SleepImpl.cpp)."""
+
+    def __init__(self, engine, name: str = ""):
+        super().__init__(engine, name)
+        self.host = None
+        self.duration = 0.0
+
+    def start(self) -> "SleepImpl":
+        self.state = State.RUNNING
+        self.surf_action = self.host.cpu.sleep(self.duration)
+        self.surf_action.activity = self
+        return self
+
+    def post(self) -> None:
+        if self.surf_action.get_state() == ActionState.FAILED:
+            self.state = State.FAILED
+        elif self.surf_action.get_state() == ActionState.FINISHED:
+            self.state = State.DONE
+        self.clean_action()
+        self.finish()
+
+    def finish(self) -> None:
+        while self.simcalls:
+            simcall = self.simcalls.popleft()
+            if simcall.call is None:
+                continue
+            issuer = simcall.issuer
+            if self.state == State.FAILED or not issuer.host.is_on():
+                issuer.context.iwannadie = True
+                issuer.exception = HostFailureException("Host failed")
+            issuer.waiting_synchro = None
+            issuer.simcall_answer()
+
+
+class IoImpl(ActivityImpl):
+    """A disk read/write (reference IoImpl.cpp)."""
+
+    def __init__(self, engine, name: str = ""):
+        super().__init__(engine, name)
+        self.storage = None
+        self.size = 0.0
+        self.io_type = "read"
+        self.performed_ioops = 0.0
+
+    def start(self) -> "IoImpl":
+        self.state = State.RUNNING
+        self.surf_action = self.storage.io_start(self.size, self.io_type)
+        self.surf_action.activity = self
+        return self
+
+    def post(self) -> None:
+        self.performed_ioops = self.surf_action.cost - self.surf_action.remains
+        if self.surf_action.get_state() == ActionState.FAILED:
+            self.state = State.FAILED
+        elif self.surf_action.get_state() == ActionState.FINISHED:
+            self.state = State.DONE
+        self.clean_action()
+        self.finish()
+
+    def finish(self) -> None:
+        while self.simcalls:
+            simcall = self.simcalls.popleft()
+            if simcall.call is None:
+                continue
+            issuer = simcall.issuer
+            if self.state == State.FAILED:
+                issuer.exception = StorageFailureException("Storage failed")
+            issuer.waiting_synchro = None
+            issuer.simcall_answer()
+
+
+# ---------------------------------------------------------------------------
+# Synchronization: raw timeout detector, mutex, condvar, semaphore
+# ---------------------------------------------------------------------------
+
+class RawImpl(ActivityImpl):
+    """Host-clocked timeout detector for synchro waits (reference
+    RawImpl.cpp): a sleep action whose completion means 'the wait timed
+    out'."""
+
+    def __init__(self, engine):
+        super().__init__(engine)
+        self.host = None
+        self.timeout = -1.0
+
+    def start(self, host, timeout: float) -> "RawImpl":
+        self.host = host
+        self.timeout = timeout
+        self.surf_action = host.cpu.sleep(timeout)
+        self.surf_action.activity = self
+        return self
+
+    def post(self) -> None:
+        if self.surf_action.get_state() == ActionState.FAILED:
+            self.state = State.FAILED
+        elif self.surf_action.get_state() == ActionState.FINISHED:
+            self.state = State.SRC_TIMEOUT
+        self.clean_action()
+        self.finish()
+
+    def finish(self) -> None:
+        simcall = self.simcalls.popleft()
+        issuer = simcall.issuer
+        if self.state == State.SRC_TIMEOUT:
+            issuer.exception = TimeoutException("Synchro's wait timeout")
+        elif self.state == State.FAILED:
+            issuer.context.iwannadie = True
+        else:
+            raise AssertionError(f"Unexpected raw state {self.state}")
+        # Remove the issuer from the object it was waiting for
+        owner = simcall.payload.get("synchro_owner")
+        if owner is not None:
+            owner.remove_sleeping(simcall)
+        issuer.waiting_synchro = None
+        issuer.simcall_answer()
+
+
+# ---------------------------------------------------------------------------
+# Maestro-side comm simcall handlers (reference CommImpl.cpp:21-330)
+# ---------------------------------------------------------------------------
+
+def comm_isend(engine, src_actor, mbox: "MailboxImpl", task_size: float,
+               rate: float, src_buff, match_fun, clean_fun, copy_data_fun,
+               data, detached: bool) -> Optional[CommImpl]:
+    this_comm = CommImpl(engine)
+    this_comm.type = CommType.SEND
+    other_comm = mbox.find_matching_comm(CommType.RECEIVE, match_fun, data,
+                                         this_comm, False, True)
+    if other_comm is None:
+        other_comm = this_comm
+        if mbox.permanent_receiver is not None:
+            # eager: this mailbox delivers to a permanent receiver right away
+            other_comm.state = State.READY
+            other_comm.dst_actor = mbox.permanent_receiver
+            mbox.done_comm_queue.append(other_comm)
+        else:
+            mbox.push(other_comm)
+    else:
+        other_comm.state = State.READY
+        other_comm.type = CommType.READY
+
+    if detached:
+        other_comm.detached = True
+        other_comm.clean_fun = clean_fun
+    else:
+        other_comm.clean_fun = None
+        src_actor.comms.append(other_comm)
+
+    other_comm.src_actor = src_actor
+    other_comm.src_data = data
+    other_comm.src_buff = src_buff
+    other_comm.size = task_size
+    other_comm.rate = rate
+    other_comm.match_fun = match_fun
+    other_comm.copy_data_fun = copy_data_fun
+    other_comm.start()
+    return None if detached else other_comm
+
+
+def comm_irecv(engine, receiver, mbox: "MailboxImpl", dst_buff, match_fun,
+               copy_data_fun, data, rate: float) -> CommImpl:
+    this_synchro = CommImpl(engine)
+    this_synchro.type = CommType.RECEIVE
+
+    if mbox.permanent_receiver is not None and mbox.done_comm_queue:
+        other_comm = mbox.find_matching_comm(CommType.SEND, match_fun, data,
+                                             this_synchro, True, True)
+        if other_comm is None:
+            other_comm = this_synchro
+            mbox.push(other_comm)
+        else:
+            if (other_comm.surf_action is not None
+                    and other_comm.get_remaining() < 1e-12):
+                other_comm.state = State.DONE
+                other_comm.type = CommType.DONE
+                other_comm.mailbox = None
+    else:
+        other_comm = mbox.find_matching_comm(CommType.SEND, match_fun, data,
+                                             this_synchro, False, True)
+        if other_comm is None:
+            other_comm = this_synchro
+            mbox.push(other_comm)
+        else:
+            other_comm.state = State.READY
+            other_comm.type = CommType.READY
+        receiver.comms.append(other_comm)
+
+    other_comm.dst_actor = receiver
+    other_comm.dst_data = data
+    other_comm.dst_buff = dst_buff
+    if rate > -1.0 and (other_comm.rate < 0.0 or rate < other_comm.rate):
+        other_comm.rate = rate
+    other_comm.match_fun = match_fun
+    other_comm.copy_data_fun = copy_data_fun
+    other_comm.start()
+    return other_comm
+
+
+def comm_wait(simcall, comm: CommImpl, timeout: float) -> None:
+    comm.register_simcall(simcall)
+    if comm.state not in (State.WAITING, State.RUNNING):
+        comm.finish()
+    else:
+        # a sleep action (even with no timeout) to notice host failures
+        sleep = simcall.issuer.host.cpu.sleep(timeout)
+        sleep.activity = comm
+        if simcall.issuer is comm.src_actor:
+            comm.src_timeout = sleep
+        else:
+            comm.dst_timeout = sleep
+
+
+def comm_test(simcall, comm: CommImpl) -> None:
+    res = comm.state not in (State.WAITING, State.RUNNING)
+    simcall.result = res
+    if res:
+        comm.simcalls.append(simcall)
+        comm.finish()
+    else:
+        simcall.issuer.simcall_answer()
+
+
+def comm_testany(simcall, comms: List[CommImpl]) -> None:
+    simcall.result = -1
+    simcall.payload["comms"] = comms
+    for idx, comm in enumerate(comms):
+        if comm.state not in (State.WAITING, State.RUNNING):
+            simcall.result = idx
+            comm.simcalls.append(simcall)
+            comm.finish()
+            return
+    simcall.issuer.simcall_answer()
+
+
+def comm_waitany(simcall, comms: List[CommImpl], timeout: float) -> None:
+    simcall.payload["comms"] = comms
+    if timeout < 0.0:
+        simcall.timeout_cb = None
+    else:
+        def on_timeout():
+            for comm in comms:
+                try:
+                    comm.simcalls.remove(simcall)
+                except ValueError:
+                    pass
+            simcall.result = -1
+            simcall.issuer.simcall_answer()
+        simcall.timeout_cb = simcall.issuer.engine.timer_set(
+            simcall.issuer.engine.now + timeout, on_timeout)
+    for comm in comms:
+        comm.simcalls.append(simcall)
+        if comm.state not in (State.WAITING, State.RUNNING):
+            comm.finish()
+            break
+
+
+class MutexImpl:
+    """Kernel mutex (reference MutexImpl.cpp): FIFO sleeping queue of
+    simcalls."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.locked = False
+        self.owner = None
+        self.sleeping: deque = deque()
+
+    def lock(self, simcall) -> None:
+        issuer = simcall.issuer
+        if self.locked:
+            synchro = RawImpl(self.engine).start(issuer.host,
+                                                 simcall.payload.get("timeout", -1))
+            synchro.register_simcall(simcall)
+            simcall.payload["synchro_owner"] = self
+            self.sleeping.append(simcall)
+        else:
+            self.locked = True
+            self.owner = issuer
+            issuer.simcall_answer()
+
+    def try_lock(self, issuer) -> bool:
+        if self.locked:
+            return False
+        self.locked = True
+        self.owner = issuer
+        return True
+
+    def unlock(self, issuer) -> None:
+        assert self.locked, "Cannot release that mutex: it was not locked."
+        assert self.owner is issuer, (
+            f"Cannot release that mutex: it was locked by "
+            f"{self.owner.name if self.owner else '?'}, not by {issuer.name}.")
+        if self.sleeping:
+            simcall = self.sleeping.popleft()
+            if simcall.issuer.waiting_synchro is not None:
+                simcall.issuer.waiting_synchro.surf_action.cancel()
+                simcall.issuer.waiting_synchro.clean_action()
+            simcall.issuer.waiting_synchro = None
+            self.owner = simcall.issuer
+            simcall.issuer.simcall_answer()
+        else:
+            self.locked = False
+            self.owner = None
+
+    def remove_sleeping(self, simcall) -> None:
+        try:
+            self.sleeping.remove(simcall)
+        except ValueError:
+            pass
+
+
+class CondVarImpl:
+    """Kernel condition variable (reference ConditionVariableImpl.cpp)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.sleeping: deque = deque()
+
+    def wait(self, mutex: Optional[MutexImpl], timeout: float, simcall) -> None:
+        issuer = simcall.issuer
+        if mutex is not None:
+            simcall.payload["mutex"] = mutex
+            mutex.unlock(issuer)
+        synchro = RawImpl(self.engine).start(issuer.host, timeout)
+        synchro.register_simcall(simcall)
+        simcall.payload["synchro_owner"] = self
+        self.sleeping.append(simcall)
+
+    def signal(self) -> None:
+        # reference: wake one process, transform its wait into an acquire
+        # of the mutex
+        if self.sleeping:
+            simcall = self.sleeping.popleft()
+            if simcall.issuer.waiting_synchro is not None:
+                simcall.issuer.waiting_synchro.surf_action.cancel()
+                simcall.issuer.waiting_synchro.clean_action()
+            simcall.issuer.waiting_synchro = None
+            mutex = simcall.payload.get("mutex")
+            if mutex is not None:
+                mutex.lock(simcall)
+            else:
+                simcall.issuer.simcall_answer()
+
+    def broadcast(self) -> None:
+        while self.sleeping:
+            self.signal()
+
+    def remove_sleeping(self, simcall) -> None:
+        try:
+            self.sleeping.remove(simcall)
+        except ValueError:
+            pass
+
+
+class SemImpl:
+    """Kernel semaphore (reference SemaphoreImpl.cpp)."""
+
+    def __init__(self, engine, value: int):
+        self.engine = engine
+        self.value = value
+        self.sleeping: deque = deque()
+
+    def acquire(self, simcall, timeout: float) -> None:
+        issuer = simcall.issuer
+        if self.value <= 0:
+            synchro = RawImpl(self.engine).start(issuer.host, timeout)
+            synchro.register_simcall(simcall)
+            simcall.payload["synchro_owner"] = self
+            self.sleeping.append(simcall)
+        else:
+            self.value -= 1
+            issuer.simcall_answer()
+
+    def release(self) -> None:
+        if self.sleeping:
+            simcall = self.sleeping.popleft()
+            if simcall.issuer.waiting_synchro is not None:
+                simcall.issuer.waiting_synchro.surf_action.cancel()
+                simcall.issuer.waiting_synchro.clean_action()
+            simcall.issuer.waiting_synchro = None
+            simcall.issuer.simcall_answer()
+        else:
+            self.value += 1
+
+    def would_block(self) -> bool:
+        return self.value <= 0
+
+    def remove_sleeping(self, simcall) -> None:
+        try:
+            self.sleeping.remove(simcall)
+        except ValueError:
+            pass

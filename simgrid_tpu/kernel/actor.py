@@ -1,0 +1,223 @@
+"""Kernel-side actors and the simcall boundary.
+
+Semantics from the reference's src/kernel/actor/ActorImpl.cpp and the
+simcall marshalling layer (src/simix/popping_*.cpp, libsmx.cpp): an actor
+runs user code in its own context; every interaction with the simulated
+world is a *simcall* handled by maestro between scheduling sub-rounds, and
+blocking simcalls are answered later by the activity they wait on.  Instead
+of code-generated argument marshalling, a simcall here carries a handler
+closure executed on the maestro side — same boundary, Python-idiomatic.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from ..exceptions import HostFailureException
+from ..utils.signal import Signal
+
+SIMCALL_NONE = None
+
+
+class Simcall:
+    __slots__ = ("call", "issuer", "handler", "result", "mc_value",
+                 "timeout_cb", "payload")
+
+    def __init__(self, issuer: "ActorImpl"):
+        self.call: Optional[str] = SIMCALL_NONE
+        self.issuer = issuer
+        self.handler: Optional[Callable[["Simcall"], None]] = None
+        self.result: Any = None
+        self.mc_value = 0
+        self.timeout_cb = None   # Timer for waitany timeouts
+        self.payload: Dict[str, Any] = {}
+
+
+class ActorImpl:
+    """A simulated actor (reference ActorImpl.cpp)."""
+
+    on_creation = Signal()
+    on_termination = Signal()
+    on_destruction = Signal()
+
+    def __init__(self, engine, name: str, host, code: Optional[Callable] = None):
+        self.engine = engine
+        self.name = name
+        self.host = host
+        self.pid = engine.next_pid()
+        self.ppid = -1
+        self.code = code
+        self.context = None          # set by engine when starting
+        self.simcall_ = Simcall(self)
+        self.exception: Optional[BaseException] = None
+        self.waiting_synchro = None  # activity this actor is blocked on
+        self.comms: List = []        # ongoing comms (for cleanup on kill)
+        self.suspended = False
+        self.daemonized = False
+        self.auto_restart = False
+        self.finished = False
+        self.properties: Dict[str, str] = {}
+        self.on_exit_callbacks: List[Callable[[bool], None]] = []
+        self.data = None
+        if host is not None:
+            host.actor_list.append(self)
+
+    def __repr__(self):
+        return f"<Actor {self.name}({self.pid})>"
+
+    def is_maestro(self) -> bool:
+        return self is self.engine.maestro
+
+    # ------------------------------------------------------------------
+    # Actor-side API (runs in the actor's context)
+    # ------------------------------------------------------------------
+    def simcall(self, name: str, handler: Callable[[Simcall], None]) -> Any:
+        """Issue a simcall: record it, yield to maestro, return the answer.
+
+        The handler runs maestro-side; it must either call
+        ``simcall_answer()`` on the issuer (immediate answer) or register
+        the simcall on an activity that will answer it later ([[block]]
+        semantics of simcalls.in:38-66)."""
+        sc = self.simcall_
+        sc.call = name
+        sc.handler = handler
+        sc.result = None
+        if self.is_maestro():
+            # Maestro (or the main thread before run()) executes simcalls
+            # inline (reference: maestro handles its own simcalls directly).
+            sc.call = SIMCALL_NONE
+            handler(sc)
+            return sc.result
+        self.yield_()
+        if self.exception is not None:
+            exc = self.exception
+            self.exception = None
+            raise exc
+        return sc.result
+
+    def yield_(self) -> None:
+        """Suspend this actor's context until maestro reschedules us
+        (reference ActorImpl::yield, ActorImpl.cpp:277-308)."""
+        self.context.suspend()
+        # Back to life...
+        if self.suspended:
+            # go immediately to sleep again after handling the wakeup
+            self.suspended = False
+            self._suspend_self()
+        if self.exception is not None and self.simcall_.call is SIMCALL_NONE:
+            exc = self.exception
+            self.exception = None
+            raise exc
+
+    def _suspend_self(self):
+        from . import activity
+        # Block on a signal-less exec (reference suspends via a 0-flop exec)
+        self.simcall("actor_suspend", lambda sc: None)
+
+    # ------------------------------------------------------------------
+    # Maestro-side operations
+    # ------------------------------------------------------------------
+    def simcall_handle(self) -> None:
+        """Called by maestro after a scheduling sub-round for each actor
+        that issued a simcall (popping_generated.cpp equivalent)."""
+        sc = self.simcall_
+        if sc.call is SIMCALL_NONE:
+            return
+        handler = sc.handler
+        sc.handler = None
+        handler(sc)
+
+    def simcall_answer(self) -> None:
+        """Answer the pending simcall: make the actor runnable again
+        (reference ActorImpl.cpp:440-451)."""
+        if not self.is_maestro():
+            self.simcall_.call = SIMCALL_NONE
+            self.engine.actors_to_run.append(self)
+
+    def kill(self, victim: "ActorImpl") -> None:
+        """Maestro-side kill (reference ActorImpl::kill, ActorImpl.cpp:189+)."""
+        if victim.finished:
+            return
+        victim.context.iwannadie = True
+        victim.exception = None
+        # Detach from whatever it waits on
+        if victim.waiting_synchro is not None:
+            victim.waiting_synchro.cancel()
+            try:
+                victim.waiting_synchro.simcalls.remove(victim.simcall_)
+            except ValueError:
+                pass
+            victim.waiting_synchro = None
+        victim.simcall_.call = SIMCALL_NONE
+        if victim not in self.engine.actors_to_run:
+            self.engine.actors_to_run.append(victim)
+
+    def throw_exception(self, exc: BaseException) -> None:
+        """Inject an exception into this actor (resumes it)."""
+        self.exception = exc
+        if self.suspended:
+            self._resume_internal()
+        if self.waiting_synchro is not None:
+            synchro = self.waiting_synchro
+            self.waiting_synchro = None
+            synchro.cancel()
+            try:
+                synchro.simcalls.remove(self.simcall_)
+            except ValueError:
+                pass
+            self.simcall_answer()
+
+    def suspend_actor(self) -> None:
+        """Maestro-side suspend."""
+        if self.suspended:
+            return
+        self.suspended = True
+        if self.waiting_synchro is not None:
+            self.waiting_synchro.suspend()
+
+    def resume_actor(self) -> None:
+        if self.context.iwannadie:
+            return
+        if not self.suspended:
+            return
+        self.suspended = False
+        self._resume_internal()
+
+    def _resume_internal(self) -> None:
+        if self.waiting_synchro is not None:
+            self.waiting_synchro.resume()
+        elif self.simcall_.call == "actor_suspend":
+            # wake from the pure-suspend parking simcall
+            self.simcall_answer()
+
+    def daemonize(self) -> None:
+        if not self.daemonized:
+            self.daemonized = True
+            self.engine.daemons.append(self)
+
+    # ------------------------------------------------------------------
+    # Termination (runs on the actor's thread, just before stop())
+    # ------------------------------------------------------------------
+    def _terminate(self, failed: bool, crash: Optional[BaseException] = None):
+        self.finished = True
+        if crash is not None:
+            import traceback
+            traceback.print_exc()
+            self.engine.actor_crashed(self, crash)
+        for cb in self.on_exit_callbacks:
+            try:
+                cb(failed)
+            except Exception:
+                import traceback
+                traceback.print_exc()
+        self.on_exit_callbacks.clear()
+        # Answer any join() simcalls parked on us
+        for sc in getattr(self, "_join_simcalls", []):
+            if sc.timeout_cb is not None:
+                sc.timeout_cb.remove()
+                sc.timeout_cb = None
+            sc.issuer.simcall_answer()
+        if hasattr(self, "_join_simcalls"):
+            self._join_simcalls.clear()
+        ActorImpl.on_termination(self)
+        self.engine.actor_terminated(self)

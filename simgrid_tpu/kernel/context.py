@@ -1,0 +1,109 @@
+"""Actor execution contexts: deterministic host-side green-threading.
+
+The reference implements actor contexts with raw x86 assembly stack
+switching (src/kernel/context/ContextRaw.cpp), Boost.Context, ucontext or
+std::thread, all behind one Context interface with strict maestro<->actor
+handoff.  On the TPU-native rebuild the host side doesn't need asm: we use
+OS threads with semaphore handoff — exactly one runnable thread at any
+instant, so scheduling stays as deterministic as the reference's serial
+context factory (ContextSwapped.cpp:152-170).  The factory abstraction is
+kept so a C fiber extension can slot in later without touching the kernel.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from ..exceptions import ForcefulKillException
+from ..utils.config import config
+
+
+class Context:
+    """One actor's execution context."""
+
+    def __init__(self, code: Optional[Callable], actor, factory: "ContextFactory"):
+        self.code = code
+        self.actor = actor
+        self.factory = factory
+        self.iwannadie = False
+        self._sem = threading.Semaphore(0)
+        self._thread: Optional[threading.Thread] = None
+        self._finished = False
+
+    # -- maestro side -----------------------------------------------------
+    def resume(self) -> None:
+        """Schedule the actor and block until it yields back (maestro)."""
+        if self._thread is None:
+            self._spawn()
+        self.factory.current_actor = self.actor
+        self._sem.release()
+        self.factory.maestro_sem.acquire()
+        self.factory.current_actor = None
+
+    def _spawn(self) -> None:
+        self._thread = threading.Thread(
+            target=self._wrapper, name=f"actor-{self.actor.name}-{self.actor.pid}",
+            daemon=True)
+        self._thread.start()
+
+    # -- actor side -------------------------------------------------------
+    def suspend(self) -> None:
+        """Yield back to maestro and wait to be scheduled again (actor)."""
+        self.factory.maestro_sem.release()
+        self._sem.acquire()
+        if self.iwannadie:
+            raise ForcefulKillException()
+
+    def stop(self) -> None:
+        """Final yield: the actor is done; does not return."""
+        self._finished = True
+        self.factory.maestro_sem.release()
+
+    def _wrapper(self) -> None:
+        self._sem.acquire()
+        try:
+            if self.iwannadie:
+                raise ForcefulKillException()
+            self.code()
+            self.actor._terminate(failed=False)
+        except ForcefulKillException:
+            self.actor._terminate(failed=self.iwannadie)
+        except Exception as exc:  # actor code crashed
+            self.actor._terminate(failed=True, crash=exc)
+        finally:
+            self.stop()
+
+
+class MaestroContext(Context):
+    """The maestro's own context is the main thread: no handoff needed."""
+
+    def __init__(self, factory):
+        super().__init__(None, None, factory)
+
+
+class ContextFactory:
+    """Serial scheduling-round runner (the 'thread' factory; see
+    contexts/factory flag)."""
+
+    def __init__(self):
+        self.maestro_sem = threading.Semaphore(0)
+        #: the actor currently holding the execution token (strict handoff:
+        #: at most one actor runs at any instant, so a plain slot suffices)
+        self.current_actor = None
+        stack_size = int(config["contexts/stack-size"])
+        if stack_size >= 32768:
+            try:
+                threading.stack_size(stack_size)
+            except (ValueError, RuntimeError):
+                pass
+
+    def create_context(self, code: Callable, actor) -> Context:
+        return Context(code, actor, self)
+
+    def run_all(self, actors) -> None:
+        """Run every actor of the scheduling round in turn; strictly serial
+        so simcall issue order is the actors_to_run order (the determinism
+        contract of smx_global.cpp:401-473)."""
+        for actor in actors:
+            actor.context.resume()

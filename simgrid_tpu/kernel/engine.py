@@ -1,0 +1,313 @@
+"""The simulation engine: maestro event loop + time advance.
+
+Re-implements the reference's deterministic scheduling loop
+(SIMIX_run, src/simix/smx_global.cpp:377-529) and time-advance
+(surf_solve, src/surf/surf_c_bindings.cpp:45-151): run scheduling
+sub-rounds until no actor is runnable, handle simcalls in FIFO order, jump
+simulated time to the next action completion (the min-reduction over
+models, solved by the LMM backend), apply profile events, update action
+states and wake finished/failed activities.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Callable, Dict, List, Optional
+
+from ..exceptions import SimgridException
+from ..utils import log as _log
+from ..utils.config import config
+from ..utils.signal import Signal
+from .actor import ActorImpl
+from .context import ContextFactory
+from .profile import FutureEvtSet
+from .activity import MailboxImpl
+
+_logger = _log.get_category("kernel")
+
+
+class Timer:
+    """A host-side timer fired at an absolute simulated date
+    (reference simix::Timer, smx_global.cpp:120-146)."""
+
+    _cancelled = False
+
+    def __init__(self, date: float, callback: Callable[[], None]):
+        self.date = date
+        self.callback = callback
+
+    def remove(self) -> None:
+        self._cancelled = True
+
+
+class EngineImpl:
+    """Kernel singleton: owns models, actors, mailboxes, timers, clock."""
+
+    instance: Optional["EngineImpl"] = None
+
+    on_time_advance = Signal()
+    on_platform_created = Signal()
+    on_simulation_end = Signal()
+    on_deadlock = Signal()
+
+    def __init__(self):
+        EngineImpl.instance = self
+        self.now = 0.0
+        self.models: List = []            # all_existing_models
+        self.host_model = None
+        self.cpu_model = None
+        self.network_model = None
+        self.storage_model = None
+        self.vm_model = None
+        self.future_evt_set = FutureEvtSet()
+        self.watched_hosts: set = set()
+
+        self.context_factory = ContextFactory()
+        self._pid = 1
+        self.maestro = ActorImpl(self, "maestro", None)
+        self.maestro.pid = 0
+        self.actors_to_run: List[ActorImpl] = []
+        self.actors_that_ran: List[ActorImpl] = []
+        self.process_list: Dict[int, ActorImpl] = {}
+        self.actors_to_destroy: List[ActorImpl] = []
+        self.daemons: List[ActorImpl] = []
+        self.tasks: List[Callable[[], None]] = []
+        self._timers: List = []  # heap of (date, seq, Timer)
+        self._timer_seq = 0
+        self.mailboxes: Dict[str, MailboxImpl] = {}
+        self.netpoints: Dict[str, object] = {}
+        self.hosts: Dict[str, object] = {}
+        self.links: Dict[str, object] = {}
+        self.storages: Dict[str, object] = {}
+        self.netzone_root = None
+        self._breakpoint = -1.0
+        _log.clock_getter = lambda: self.now
+
+    # ------------------------------------------------------------------
+    def next_pid(self) -> int:
+        pid = self._pid
+        self._pid += 1
+        return pid
+
+    def add_model(self, model) -> None:
+        self.models.append(model)
+
+    def mailbox_by_name_or_create(self, name: str) -> MailboxImpl:
+        mbox = self.mailboxes.get(name)
+        if mbox is None:
+            mbox = MailboxImpl(self, name)
+            self.mailboxes[name] = mbox
+        return mbox
+
+    # -- actor management ------------------------------------------------
+    def create_actor(self, name: str, host, code: Callable,
+                     daemonize: bool = False) -> ActorImpl:
+        if not host.is_on():
+            raise SimgridException(
+                f"Cannot create actor '{name}' on failed host '{host.name}'")
+        actor = ActorImpl(self, name, host, code)
+        actor.context = self.context_factory.create_context(code, actor)
+        self.process_list[actor.pid] = actor
+        self.actors_to_run.append(actor)
+        if daemonize:
+            actor.daemonize()
+        ActorImpl.on_creation(actor)
+        return actor
+
+    def actor_terminated(self, actor: ActorImpl) -> None:
+        """Called from the actor's context just before its final yield."""
+        self.process_list.pop(actor.pid, None)
+        if actor in self.daemons:
+            self.daemons.remove(actor)
+        if actor.host is not None and actor in actor.host.actor_list:
+            actor.host.actor_list.remove(actor)
+        # Cancel any remaining comms of this actor (kill cleanup).
+        for comm in list(actor.comms):
+            comm.cancel()
+        actor.comms.clear()
+        self.actors_to_destroy.append(actor)
+
+    def actor_crashed(self, actor: ActorImpl, exc: BaseException) -> None:
+        _logger.error("Actor %s@%s died of an uncaught exception: %s",
+                      actor.name,
+                      actor.host.name if actor.host else "?", exc)
+
+    # -- timers ----------------------------------------------------------
+    def timer_set(self, date: float, callback: Callable[[], None]) -> Timer:
+        timer = Timer(date, callback)
+        heapq.heappush(self._timers, (date, self._timer_seq, timer))
+        self._timer_seq += 1
+        return timer
+
+    def next_timer_date(self) -> float:
+        while self._timers and self._timers[0][2]._cancelled:
+            heapq.heappop(self._timers)
+        return self._timers[0][0] if self._timers else -1.0
+
+    def _execute_timers(self) -> bool:
+        result = False
+        while self._timers and self.now >= self._timers[0][0]:
+            _, _, timer = heapq.heappop(self._timers)
+            if timer._cancelled:
+                continue
+            result = True
+            timer.callback()
+        return result
+
+    # -- task queue (futures' .then callbacks) ---------------------------
+    def add_task(self, task: Callable[[], None]) -> None:
+        self.tasks.append(task)
+
+    def _execute_tasks(self) -> bool:
+        if not self.tasks:
+            return False
+        while self.tasks:
+            batch, self.tasks = self.tasks, []
+            for task in batch:
+                task()
+        return True
+
+    # ------------------------------------------------------------------
+    # surf_solve: the time-advance (surf_c_bindings.cpp:45-151)
+    # ------------------------------------------------------------------
+    def surf_solve(self, max_date: float) -> float:
+        time_delta = -1.0
+        if max_date > 0.0:
+            assert max_date >= self.now, \
+                f"You asked to simulate up to {max_date} but that's in the past"
+            time_delta = max_date - self.now
+
+        # Physical models first: host composes cpu+network+storage.
+        next_event_phy = self.host_model.next_occurring_event(self.now)
+        if (time_delta < 0.0 or next_event_phy < time_delta) and next_event_phy >= 0.0:
+            time_delta = next_event_phy
+        if self.vm_model is not None:
+            next_event_virt = self.vm_model.next_occurring_event(self.now)
+            if (time_delta < 0.0 or next_event_virt < time_delta) and next_event_virt >= 0.0:
+                time_delta = next_event_virt
+        for model in self.models:
+            if model in (self.host_model, self.vm_model, self.network_model,
+                         self.storage_model, self.cpu_model):
+                continue
+            next_event_model = model.next_occurring_event(self.now)
+            if (time_delta < 0.0 or next_event_model < time_delta) and next_event_model >= 0.0:
+                time_delta = next_event_model
+
+        # Consume profile events up to the chosen horizon.
+        while True:
+            next_event_date = self.future_evt_set.next_date()
+            if not self.network_model.next_occurring_event_is_idempotent():
+                # ns-3-style co-simulation backend hook
+                if next_event_date != -1.0:
+                    time_delta = min(next_event_date - self.now, time_delta)
+                else:
+                    time_delta = max(next_event_date - self.now, time_delta)
+                model_next_action_end = self.network_model.next_occurring_event(time_delta)
+                if model_next_action_end >= 0.0:
+                    time_delta = model_next_action_end
+            if next_event_date < 0.0 or next_event_date > self.now + time_delta:
+                break
+            while True:
+                popped = self.future_evt_set.pop_leq(next_event_date)
+                if popped is None:
+                    break
+                event, value, resource = popped
+                if (resource.is_used()
+                        or resource.name in self.watched_hosts):
+                    time_delta = next_event_date - self.now
+                round_start = self.now
+                self.now = next_event_date
+                resource.apply_event(event, value)
+                self.now = round_start
+
+        if time_delta < 0:
+            return -1.0
+
+        self.now += time_delta
+        for model in self.models:
+            model.update_actions_state(self.now, time_delta)
+        EngineImpl.on_time_advance(time_delta)
+        return time_delta
+
+    def _wake_processes(self) -> None:
+        # reference SIMIX_wake_processes (smx_global.cpp:336-356)
+        for model in self.models:
+            action = model.extract_failed_action()
+            while action is not None:
+                if action.activity is not None:
+                    action.activity.post()
+                action = model.extract_failed_action()
+            action = model.extract_done_action()
+            while action is not None:
+                if action.activity is not None:
+                    action.activity.post()
+                action = model.extract_done_action()
+
+    def _empty_trash(self) -> None:
+        self.actors_to_destroy.clear()
+
+    # ------------------------------------------------------------------
+    # The main loop (SIMIX_run, smx_global.cpp:377-529)
+    # ------------------------------------------------------------------
+    def run(self) -> None:
+        time = 0.0
+        while True:
+            self._execute_tasks()
+
+            while self.actors_to_run:
+                # Run all ready actors (serial, deterministic order).
+                self.context_factory.run_all(self.actors_to_run)
+                self.actors_to_run, self.actors_that_ran = \
+                    [], self.actors_to_run
+                # Answer the simcalls issued during this sub-round, FIFO.
+                for actor in self.actors_that_ran:
+                    if actor.simcall_.call is not None:
+                        actor.simcall_handle()
+                self._execute_tasks()
+                while True:
+                    self._wake_processes()
+                    if not self._execute_tasks():
+                        break
+                # Only daemons left: kill them and wrap up.
+                if len(self.process_list) == len(self.daemons) and self.daemons:
+                    for dmon in list(self.daemons):
+                        self.maestro.kill(dmon)
+
+            time = self.next_timer_date()
+            if time > -1.0 or self.process_list:
+                time = self.surf_solve(time)
+
+            again = True
+            while again:
+                again = self._execute_timers()
+                if self._execute_tasks():
+                    again = True
+                self._wake_processes()
+
+            self._empty_trash()
+
+            if not (time > -1.0 or self.actors_to_run):
+                break
+
+        if self.process_list:
+            if len(self.process_list) <= len(self.daemons):
+                _logger.critical(
+                    "Daemon actors cannot do any blocking activity once the "
+                    "simulation is over.")
+            else:
+                _logger.critical("Oops! Deadlock or code not perfectly clean.")
+            self.display_process_status()
+            EngineImpl.on_deadlock()
+            raise SimgridException("Deadlock detected: actors are still "
+                                   "blocked but no event remains")
+        EngineImpl.on_simulation_end()
+
+    def display_process_status(self) -> None:
+        _logger.info("%d actors are still active, awaiting something. "
+                     "Here is their status:", len(self.process_list))
+        for actor in self.process_list.values():
+            synchro = actor.waiting_synchro
+            what = type(synchro).__name__ if synchro is not None else "nothing"
+            _logger.info("Actor %d (%s@%s): waiting for %s", actor.pid,
+                         actor.name,
+                         actor.host.name if actor.host else "?", what)

@@ -1,0 +1,1 @@
+"""Resource models (the SURF equivalent): cpu, network, host, storage."""

@@ -1,0 +1,112 @@
+"""Hosts and the composed host model.
+
+Host = CPU + network endpoint + actor list (reference src/surf/HostImpl.cpp
+and s4u_Host.cpp); HostCLM03Model composes the CPU/network/storage models'
+next-event minima (reference src/surf/host_clm03.cpp).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..kernel.resource import Model, UpdateAlgo
+from ..utils.signal import Signal
+
+
+class Host:
+    """A simulated machine."""
+
+    on_creation = Signal()
+    on_destruction = Signal()
+    on_state_change = Signal()
+    on_speed_change_sig = Signal()
+
+    def __init__(self, engine, name: str):
+        self.engine = engine
+        self.name = name
+        self.cpu = None                   # set by the CPU model factory
+        self.netpoint = None              # routing endpoint
+        self.actor_list: List = []
+        self.properties: Dict[str, str] = {}
+        self.storages: Dict[str, object] = {}
+        self.data = None
+        engine.hosts[name] = self
+
+    def __repr__(self):
+        return f"<Host {self.name}>"
+
+    # -- state ------------------------------------------------------------
+    def is_on(self) -> bool:
+        return self.cpu.is_on() if self.cpu is not None else True
+
+    def turn_on(self) -> None:
+        if not self.is_on():
+            self.cpu.turn_on()
+            Host.on_state_change(self)
+            # autorestart actors are relaunched by the engine hook
+            self.engine_on_host_restart()
+
+    def turn_off(self) -> None:
+        # reference s4u::Host::turn_off: kill every actor of the host
+        if self.is_on():
+            self.cpu.turn_off()
+            for actor in list(self.actor_list):
+                self.engine.maestro.kill(actor)
+            Host.on_state_change(self)
+
+    def engine_on_host_restart(self) -> None:
+        restart = getattr(self.engine, "on_host_restart", None)
+        if restart is not None:
+            restart(self)
+
+    def on_speed_change(self) -> None:
+        Host.on_speed_change_sig(self)
+
+    # -- perf -------------------------------------------------------------
+    def get_speed(self) -> float:
+        return self.cpu.get_speed()
+
+    def get_core_count(self) -> int:
+        return self.cpu.core_count
+
+    def get_load(self) -> float:
+        return self.cpu.get_load()
+
+    # -- routing ----------------------------------------------------------
+    def route_to(self, dst: "Host", links: List) -> float:
+        """Fill `links` with the route to dst; returns the summed latency
+        (reference s4u::Host::route_to → NetZoneImpl::get_global_route)."""
+        from ..routing.zone import get_global_route
+        return get_global_route(self.netpoint, dst.netpoint, links)
+
+
+class HostCLM03Model(Model):
+    """Composes CPU + network + storage minima (host_clm03.cpp)."""
+
+    def __init__(self, engine):
+        super().__init__(engine, UpdateAlgo.FULL)
+        engine.host_model = self
+
+    def next_occurring_event(self, now: float) -> float:
+        e = self.engine
+        min_by_cpu = e.cpu_model.next_occurring_event(now)
+        if e.network_model.next_occurring_event_is_idempotent():
+            min_by_net = e.network_model.next_occurring_event(now)
+        else:
+            min_by_net = -1.0
+        min_by_sto = (e.storage_model.next_occurring_event(now)
+                      if e.storage_model is not None else -1.0)
+        res = min_by_cpu
+        if res < 0 or (0.0 <= min_by_net < res):
+            res = min_by_net
+        if res < 0 or (0.0 <= min_by_sto < res):
+            res = min_by_sto
+        return res
+
+    def update_actions_state(self, now: float, delta: float) -> None:
+        pass  # host model has no action of its own
+
+    def execute_parallel(self, hosts, flops_amounts, bytes_amounts, rate):
+        raise NotImplementedError(
+            "parallel tasks need the ptask_L07 model "
+            "(--cfg=host/model:ptask_L07)")

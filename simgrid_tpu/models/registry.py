@@ -1,0 +1,83 @@
+"""Model registry: named model tables + --cfg selection.
+
+Equivalent of the reference's model description tables
+(src/surf/surf_interface.cpp:56-116) and the surf_*_model_init_* functions:
+models are picked by the host/model, cpu/model, network/model,
+storage/model flags.  New backends (e.g. a fully device-resident solver)
+register here the same way the reference registered LMM_TPU candidates.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from ..kernel.resource import UpdateAlgo
+from ..utils.config import config
+from .cpu import CpuCas01Model
+from .host import HostCLM03Model
+from .network import (NetworkCm02Model, NetworkConstantModel)
+from .storage import StorageN11Model
+
+network_models: Dict[str, Callable] = {}
+cpu_models: Dict[str, Callable] = {}
+host_models: Dict[str, Callable] = {}
+storage_models: Dict[str, Callable] = {}
+
+
+def _register_defaults() -> None:
+    def init_lv08(engine):
+        config.set_default("network/latency-factor", 13.01)
+        config.set_default("network/bandwidth-factor", 0.97)
+        config.set_default("network/weight-S", 20537.0)
+        return NetworkCm02Model(engine)
+
+    def init_cm02(engine):
+        config.set_default("network/latency-factor", 1.0)
+        config.set_default("network/bandwidth-factor", 1.0)
+        config.set_default("network/weight-S", 0.0)
+        return NetworkCm02Model(engine)
+
+    def init_smpi(engine):
+        from .network_smpi import NetworkSmpiModel
+        return NetworkSmpiModel(engine)
+
+    def init_ib(engine):
+        from .network_ib import NetworkIBModel
+        return NetworkIBModel(engine)
+
+    network_models.update({
+        "LV08": init_lv08,
+        "CM02": init_cm02,
+        "SMPI": init_smpi,
+        "IB": init_ib,
+        "Constant": NetworkConstantModel,
+    })
+
+    def init_cas01(engine):
+        algo = (UpdateAlgo.LAZY if config["cpu/optim"] == "Lazy"
+                else UpdateAlgo.FULL)
+        if config["cpu/optim"] == "TI":
+            from .cpu_ti import CpuTiModel
+            return CpuTiModel(engine)
+        return CpuCas01Model(engine, algo)
+
+    cpu_models["Cas01"] = init_cas01
+    host_models["default"] = HostCLM03Model
+    storage_models["default"] = StorageN11Model
+
+
+_register_defaults()
+
+
+def setup_models(engine) -> None:
+    """Instantiate the configured models in the reference's creation order
+    (host first so its wake-up sweep runs first, then cpu, then network)."""
+    host_model_name = config["host/model"]
+    if host_model_name == "ptask_L07":
+        from .ptask_l07 import HostL07Model
+        HostL07Model(engine)
+        return
+    host_models[host_model_name](engine)
+    engine.cpu_model = cpu_models[config["cpu/model"]](engine)
+    network_models[config["network/model"]](engine)  # sets engine.network_model
+    engine.storage_model = storage_models[config["storage/model"]](engine)

@@ -1,0 +1,145 @@
+"""Storage model N11: disk read/write constraints via LMM.
+
+Semantics from the reference's src/surf/storage_n11.cpp and
+StorageImpl.cpp: each storage has read/write bandwidth constraints plus a
+global connection constraint; IO actions are variables expanded on the
+matching constraint.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..kernel.resource import (Action, ActionState, Model, Resource,
+                               NO_MAX_DURATION, UpdateAlgo)
+from ..ops.lmm_host import System
+from ..utils.config import config
+from ..utils.signal import Signal
+
+
+class StorageAction(Action):
+    on_state_change = Signal()
+
+    def __init__(self, model, cost, failed, variable, storage, io_type):
+        super().__init__(model, cost, failed, variable)
+        self.storage = storage
+        self.io_type = io_type
+
+    def set_state(self, state: ActionState) -> None:
+        super().set_state(state)
+        StorageAction.on_state_change(self)
+
+    def update_remains_lazy(self, now: float) -> None:
+        raise NotImplementedError("storage model is FULL-update only")
+
+
+class StorageN11Model(Model):
+    def __init__(self, engine):
+        super().__init__(engine, UpdateAlgo.FULL)
+        self.set_maxmin_system(System(False))
+        engine.storage_model = self
+
+    def create_storage(self, id_: str, type_id: str, content_name: str,
+                       attach: str, read_bw: float, write_bw: float,
+                       size: float) -> "StorageN11":
+        return StorageN11(self, id_, type_id, content_name, attach,
+                          read_bw, write_bw, size)
+
+    def update_actions_state_full(self, now: float, delta: float) -> None:
+        for action in list(self.started_action_set):
+            action.update_remains(action.variable.value * delta)
+            action.update_max_duration(delta)
+            if ((action.get_remains_no_update() <= 0
+                 and action.variable.sharing_penalty > 0)
+                    or (action.max_duration != NO_MAX_DURATION
+                        and action.max_duration <= 0)):
+                action.finish(ActionState.FINISHED)
+
+
+class StorageN11(Resource):
+    """One disk: read/write constraints (storage_n11.cpp)."""
+
+    def __init__(self, model: StorageN11Model, name: str, type_id: str,
+                 content_name: str, attach: str, read_bw: float,
+                 write_bw: float, size: float):
+        super().__init__(model, name, model.system.constraint_new(
+            None, max(read_bw, write_bw)))
+        self.constraint.id = self
+        self.constraint_read = model.system.constraint_new(None, read_bw)
+        self.constraint_write = model.system.constraint_new(None, write_bw)
+        self.type_id = type_id
+        self.content_name = content_name
+        self.attach = attach  # host name this disk is attached to
+        self.read_bw = read_bw
+        self.write_bw = write_bw
+        self.size = size
+        self.used_size = 0.0
+        model.engine.storages[name] = self
+
+    def is_used(self) -> bool:
+        return self.constraint._acs_hook is not None
+
+    def apply_event(self, event, value: float) -> None:
+        if value > 0:
+            self.turn_on()
+        else:
+            self.turn_off()
+
+    def io_start(self, size: float, io_type: str) -> StorageAction:
+        var = self.model.system.variable_new(None, 1.0, -1.0, 3)
+        action = StorageAction(self.model, size, not self.is_on(), var,
+                               self, io_type)
+        var.id = action
+        self.model.system.expand(self.constraint, var, 1.0)
+        if io_type == "read":
+            self.model.system.expand(self.constraint_read, var, 1.0)
+        else:
+            self.model.system.expand(self.constraint_write, var, 1.0)
+        return action
+
+    def read(self, size: float) -> StorageAction:
+        return self.io_start(size, "read")
+
+    def write(self, size: float) -> StorageAction:
+        return self.io_start(size, "write")
+
+
+#: registered <storage_type> declarations
+_storage_types: Dict[str, dict] = {}
+
+
+def parse_storage_tag(loader, elem, zone) -> None:
+    """Handle <storage_type>, <storage>, <mount> platform tags
+    (sg_platf.cpp storage callbacks)."""
+    from ..platform.units import parse_bandwidth, parse_size
+
+    engine = loader.engine
+    if elem.tag == "storage_type":
+        props = {}
+        model_props = {}
+        for child in elem:
+            if child.tag == "prop":
+                props[child.get("id")] = child.get("value")
+            elif child.tag == "model_prop":
+                model_props[child.get("id")] = child.get("value")
+        _storage_types[elem.get("id")] = {
+            "size": parse_size(elem.get("size", "0")),
+            "props": props,
+            "model_props": model_props,
+        }
+    elif elem.tag == "storage":
+        type_id = elem.get("typeId")
+        st = _storage_types.get(type_id)
+        if st is None:
+            raise ValueError(f"Unknown storage type {type_id}")
+        read_bw = parse_bandwidth(st["model_props"].get("Bread", "0"))
+        write_bw = parse_bandwidth(st["model_props"].get("Bwrite", "0"))
+        if engine.storage_model is None:
+            StorageN11Model(engine)
+        engine.storage_model.create_storage(
+            elem.get("id"), type_id, elem.get("content", ""),
+            elem.get("attach", ""), read_bw, write_bw, st["size"])
+    elif elem.tag == "mount":
+        storage = engine.storages.get(elem.get("storageId"))
+        if storage is not None:
+            storage.mount_point = elem.get("name")

@@ -52,81 +52,11 @@ def double_equals(a: float, b: float, precision: float) -> bool:
     return abs(a - b) < precision
 
 
-# -- intrusive doubly-linked lists ------------------------------------------
 # The reference keeps elements/variables/constraints in boost::intrusive
 # lists whose push_front/push_back ordering defines the deterministic
-# iteration (and hence floating-point accumulation) order.  We reproduce
-# that with O(1) linked lists keyed by a per-list hook attribute.
-
-class IntrusiveList:
-    __slots__ = ("hook", "head", "tail", "size")
-
-    def __init__(self, hook: str):
-        self.hook = hook
-        self.head: Any = None
-        self.tail: Any = None
-        self.size = 0
-
-    def is_linked(self, obj) -> bool:
-        return getattr(obj, self.hook, None) is not None
-
-    def push_front(self, obj) -> None:
-        assert getattr(obj, self.hook, None) is None
-        setattr(obj, self.hook, [None, self.head])
-        if self.head is not None:
-            getattr(self.head, self.hook)[0] = obj
-        else:
-            self.tail = obj
-        self.head = obj
-        self.size += 1
-
-    def push_back(self, obj) -> None:
-        assert getattr(obj, self.hook, None) is None
-        setattr(obj, self.hook, [self.tail, None])
-        if self.tail is not None:
-            getattr(self.tail, self.hook)[1] = obj
-        else:
-            self.head = obj
-        self.tail = obj
-        self.size += 1
-
-    def remove(self, obj) -> None:
-        prev, nxt = getattr(obj, self.hook)
-        if prev is not None:
-            getattr(prev, self.hook)[1] = nxt
-        else:
-            self.head = nxt
-        if nxt is not None:
-            getattr(nxt, self.hook)[0] = prev
-        else:
-            self.tail = prev
-        setattr(obj, self.hook, None)
-        self.size -= 1
-
-    def front(self):
-        return self.head
-
-    def empty(self) -> bool:
-        return self.head is None
-
-    def __len__(self) -> int:
-        return self.size
-
-    def __iter__(self):
-        node = self.head
-        while node is not None:
-            nxt = getattr(node, self.hook)[1]
-            yield node
-            node = nxt
-
-    def clear(self) -> None:
-        node = self.head
-        while node is not None:
-            nxt = getattr(node, self.hook)[1]
-            setattr(node, self.hook, None)
-            node = nxt
-        self.head = self.tail = None
-        self.size = 0
+# iteration (and hence floating-point accumulation) order; see
+# utils/intrusive.py for the Python equivalent.
+from ..utils.intrusive import IntrusiveList
 
 
 class Element:

@@ -212,15 +212,20 @@ def flatten(cnst_list: List[Constraint], dtype=np.float64
 
 def solve_arrays(arrays: LmmArrays, eps: float, device=None):
     """Run the jit'd fixpoint; returns (values ndarray, rounds)."""
-    kw = {}
     args = [arrays.e_var, arrays.e_cnst, arrays.e_w, arrays.c_bound,
             arrays.c_fatpipe, arrays.v_penalty, arrays.v_bound,
             np.asarray(eps, arrays.e_w.dtype)]
     if device is not None:
         args = [jax.device_put(a, device) for a in args]
     values, remaining, usage, rounds = _solve_kernel(
-        *args, n_c=len(arrays.c_bound), n_v=len(arrays.v_penalty), **kw)
-    return np.asarray(values), np.asarray(remaining), np.asarray(usage), int(rounds)
+        *args, n_c=len(arrays.c_bound), n_v=len(arrays.v_penalty))
+    rounds = int(rounds)
+    if rounds >= _MAX_ROUNDS:
+        raise RuntimeError(
+            f"LMM JAX solve did not converge within {_MAX_ROUNDS} saturation "
+            f"rounds ({arrays.n_cnst} constraints, {arrays.n_var} variables); "
+            f"check maxmin/precision vs the system's magnitudes")
+    return np.asarray(values), np.asarray(remaining), np.asarray(usage), rounds
 
 
 def solve_jax(system: System) -> None:
@@ -296,6 +301,9 @@ def install(system: System, backend: Optional[str] = None) -> System:
         system.solve_fn = solve_jax
     elif backend == "auto":
         system.solve_fn = dispatching_solve
-    else:
+    elif backend == "list":
         system.solve_fn = None
+    else:
+        raise ValueError(f"Unknown lmm/backend {backend!r} "
+                         "(expected list, jax or auto)")
     return system

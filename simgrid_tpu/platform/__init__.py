@@ -1,0 +1,1 @@
+"""Platform descriptions: XML loader (simgrid.dtd compatible) + units."""

@@ -1,0 +1,10 @@
+"""Platform routing: netzone tree, route resolution, topology zones."""
+
+from .zone import (NetPoint, NetPointType, NetZoneImpl, Route,
+                   get_global_route)
+from .routed import (RoutedZone, FullZone, FloydZone, DijkstraZone,
+                     EmptyZone, VivaldiZone)
+
+__all__ = ["NetPoint", "NetPointType", "NetZoneImpl", "Route",
+           "get_global_route", "RoutedZone", "FullZone", "FloydZone",
+           "DijkstraZone", "EmptyZone", "VivaldiZone"]

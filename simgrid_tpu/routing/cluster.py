@@ -1,0 +1,212 @@
+"""Cluster zones: flat crossbar/backbone clusters + the <cluster> tag.
+
+Semantics from the reference's src/kernel/routing/ClusterZone.cpp (route =
+src private up-link, optional limiter, optional backbone, dst private
+down-link; loopback for self-routes) and sg_platf_new_cluster
+(src/surf/sg_platf.cpp): one host + private link per radical entry, an
+optional backbone, a cluster router for inter-zone traffic.  The fat-tree
+/ torus / dragonfly variants subclass this and add their own interconnect
+(their dedicated modules register themselves in the topology table).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ..exceptions import ParseError
+from ..ops.lmm_host import SharingPolicy
+from .zone import NetPoint, NetPointType, NetZoneImpl
+
+
+def parse_radical(radical: str) -> List[int]:
+    """Expand "0-9,12,15-20" to the explicit id list (sg_platf.cpp)."""
+    ids: List[int] = []
+    for group in radical.split(","):
+        group = group.strip()
+        if not group:
+            continue
+        if "-" in group:
+            start, end = group.split("-")
+            ids.extend(range(int(start), int(end) + 1))
+        else:
+            ids.append(int(group))
+    return ids
+
+
+class ClusterZone(NetZoneImpl):
+    """Flat cluster: private links + optional backbone."""
+
+    def __init__(self, engine, father, name):
+        super().__init__(engine, father, name)
+        self.private_links: Dict[int, Tuple[Optional[object], Optional[object]]] = {}
+        self.backbone = None
+        self.router: Optional[NetPoint] = None
+        self.has_loopback = False
+        self.has_limiter = False
+        self.num_links_per_node = 1
+
+    # position helpers (reference ClusterZone.hpp node_pos* )
+    def node_pos(self, node_id: int) -> int:
+        return node_id * self.num_links_per_node
+
+    def node_pos_with_loopback(self, node_id: int) -> int:
+        return self.node_pos(node_id) + (1 if self.has_loopback else 0)
+
+    def node_pos_with_loopback_limiter(self, node_id: int) -> int:
+        return self.node_pos_with_loopback(node_id) + (1 if self.has_limiter else 0)
+
+    def add_private_link(self, position: int, link_up, link_down) -> None:
+        self.private_links[position] = (link_up, link_down)
+
+    def get_local_route(self, src: NetPoint, dst: NetPoint, route,
+                        latency) -> None:
+        assert self.private_links, \
+            "Cluster routing: no links attached to the source node"
+        if src.id == dst.id and self.has_loopback:
+            if not src.is_router():
+                up, _ = self.private_links[self.node_pos(src.id)]
+                self._add_link_latency(route.links, up, latency)
+            return
+
+        if not src.is_router():
+            if self.has_limiter:
+                up, _ = self.private_links[self.node_pos_with_loopback(src.id)]
+                route.links.append(up)
+            up, _ = self.private_links[self.node_pos_with_loopback_limiter(src.id)]
+            if up is not None:
+                self._add_link_latency(route.links, up, latency)
+
+        if self.backbone is not None:
+            self._add_link_latency(route.links, self.backbone, latency)
+
+        if not dst.is_router():
+            _, down = self.private_links[self.node_pos_with_loopback_limiter(dst.id)]
+            if down is not None:
+                self._add_link_latency(route.links, down, latency)
+            if self.has_limiter:
+                up, _ = self.private_links[self.node_pos_with_loopback(dst.id)]
+                route.links.append(up)
+
+
+#: topology-string parsers registered by fat_tree/torus/dragonfly modules
+_TOPO_ZONES = {}
+
+
+def register_topo_zone(kind: str, cls) -> None:
+    _TOPO_ZONES[kind] = cls
+
+
+def parse_cluster_tag(loader, elem, father) -> None:
+    """Create a cluster per the <cluster> tag (sg_platf_new_cluster)."""
+    from ..models.host import Host
+
+    engine = loader.engine
+    name = elem.get("id")
+    prefix = elem.get("prefix", "")
+    suffix = elem.get("suffix", "")
+    radical = elem.get("radical")
+    speeds = elem.get("speed")
+    bw = elem.get("bw")
+    lat = elem.get("lat")
+    core = int(elem.get("core", "1"))
+    topology = elem.get("topology", "FLAT").upper()
+    sharing_policy = elem.get("sharing_policy", "SPLITDUPLEX" if False else "SHARED")
+    bb_sharing = elem.get("bb_sharing_policy", "SHARED")
+
+    if topology == "FLAT":
+        zone = ClusterZone(engine, father, name)
+    elif topology in _TOPO_ZONES:
+        zone = _TOPO_ZONES[topology](engine, father, name,
+                                     elem.get("topo_parameters", ""))
+    else:
+        raise ParseError(f"Unknown cluster topology {topology}")
+
+    from ..platform.units import (parse_bandwidth, parse_speeds, parse_time)
+    speed_list = parse_speeds(speeds)
+    bw_value = parse_bandwidth(bw)
+    lat_value = parse_time(lat)
+
+    loopback_bw = elem.get("loopback_bw")
+    loopback_lat = elem.get("loopback_lat")
+    limiter_link = elem.get("limiter_link")
+    if loopback_bw or loopback_lat:
+        zone.has_loopback = True
+    if limiter_link:
+        zone.has_limiter = True
+    zone.num_links_per_node = 1 + (1 if zone.has_loopback else 0) + \
+        (1 if zone.has_limiter else 0)
+
+    ids = parse_radical(radical)
+    for rank, node_id in enumerate(ids):
+        host_name = f"{prefix}{node_id}{suffix}"
+        host = Host(engine, host_name)
+        host.netpoint = NetPoint(engine, host_name, NetPointType.HOST, zone)
+        engine.cpu_model.create_cpu(host, speed_list, core)
+        position = zone.node_pos(host.netpoint.id)
+
+        if zone.has_loopback:
+            lb = engine.network_model.create_link(
+                f"{name}_link_{node_id}_loopback",
+                parse_bandwidth(loopback_bw), parse_time(loopback_lat),
+                SharingPolicy.FATPIPE)
+            zone.add_private_link(zone.node_pos(host.netpoint.id), lb, lb)
+
+        if zone.has_limiter:
+            lim = engine.network_model.create_link(
+                f"{name}_link_{node_id}_limiter",
+                parse_bandwidth(limiter_link), 0.0, SharingPolicy.SHARED)
+            zone.add_private_link(zone.node_pos_with_loopback(host.netpoint.id),
+                                  lim, lim)
+
+        link = engine.network_model.create_link(
+            f"{name}_link_{node_id}", bw_value, lat_value,
+            SharingPolicy.SHARED if sharing_policy != "FATPIPE"
+            else SharingPolicy.FATPIPE)
+        zone.add_private_link(
+            zone.node_pos_with_loopback_limiter(host.netpoint.id), link, link)
+
+        if hasattr(zone, "add_processing_node"):
+            zone.add_processing_node(host.netpoint, rank)
+
+    # cluster router (for inter-zone routing)
+    router_name = elem.get("router_id") or f"{prefix}{name}_router{suffix}"
+    zone.router = NetPoint(engine, router_name, NetPointType.ROUTER, zone)
+
+    bb_bw = elem.get("bb_bw")
+    bb_lat = elem.get("bb_lat")
+    if bb_bw or bb_lat:
+        zone.backbone = engine.network_model.create_link(
+            f"{name}_backbone", parse_bandwidth(bb_bw), parse_time(bb_lat),
+            SharingPolicy.FATPIPE if bb_sharing == "FATPIPE"
+            else SharingPolicy.SHARED)
+
+    if hasattr(zone, "build_interconnect"):
+        zone.build_interconnect(bw_value, lat_value, sharing_policy)
+
+    for child in elem:
+        if child.tag == "prop":
+            zone.properties[child.get("id")] = child.get("value")
+
+
+def parse_cabinet_tag(loader, elem, father) -> None:
+    raise ParseError("<cabinet> is not supported yet")
+
+
+def parse_peer_tag(loader, elem, father) -> None:
+    """<peer>: a host with up/down private links in a Vivaldi zone
+    (sg_platf_new_peer)."""
+    from ..models.host import Host
+    from ..platform.units import parse_bandwidth, parse_speed, parse_time
+
+    engine = loader.engine
+    name = elem.get("id")
+    host = Host(engine, name)
+    host.netpoint = NetPoint(engine, name, NetPointType.HOST, father)
+    engine.cpu_model.create_cpu(host, [parse_speed(elem.get("speed"))], 1)
+    coords = elem.get("coordinates")
+    if coords:
+        host.netpoint.coords = [float(x) for x in coords.split()]
+    engine.network_model.create_link(
+        f"private_{name}", parse_bandwidth(elem.get("bw_in")),
+        parse_time(elem.get("lat", "0")), SharingPolicy.SHARED)

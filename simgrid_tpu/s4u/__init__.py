@@ -1,0 +1,26 @@
+"""s4u — the user-facing simulation API (reference include/simgrid/s4u/).
+
+Usage:
+    from simgrid_tpu import s4u
+
+    def pinger():
+        mbox = s4u.Mailbox.by_name("ping")
+        mbox.put("hello", 1_000_000)
+
+    e = s4u.Engine()
+    e.load_platform("small_platform.xml")
+    s4u.Actor.create("pinger", e.host_by_name("Tremblay"), pinger)
+    e.run()
+"""
+
+from ..models.host import Host
+from ..models.network import LinkImpl as Link
+from .activity import Activity, Comm, Exec, Io
+from .actor import Actor, this_actor
+from .engine import Engine, get_clock
+from .mailbox import Mailbox
+from .synchro import Barrier, ConditionVariable, Mutex, Semaphore
+
+__all__ = ["Engine", "Actor", "this_actor", "Host", "Link", "Mailbox",
+           "Comm", "Exec", "Io", "Activity", "Mutex", "ConditionVariable",
+           "Semaphore", "Barrier", "get_clock"]

@@ -1,0 +1,347 @@
+"""s4u async activities: Comm, Exec, Io.
+
+Reference: /root/reference/src/s4u/{s4u_Comm,s4u_Exec,s4u_Io}.cpp — handles
+with start/wait/test/cancel/wait_any/wait_all composing the kernel
+activities via simcalls.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import List, Optional
+
+from ..exceptions import TimeoutException
+from ..kernel import activity as kact
+from ..utils.signal import Signal
+from .engine import Engine
+
+
+class ActivityState(Enum):
+    INITED = 0
+    STARTING = 1
+    STARTED = 2
+    CANCELED = 3
+    FINISHED = 4
+
+
+class Activity:
+    def __init__(self):
+        self.state = ActivityState.INITED
+        self.pimpl: Optional[kact.ActivityImpl] = None
+        self.remains = 0.0
+
+    def is_finished(self) -> bool:
+        return self.state == ActivityState.FINISHED
+
+
+class Comm(Activity):
+    """One communication, sender or receiver side (s4u_Comm.cpp)."""
+
+    on_sender_start = Signal()
+    on_receiver_start = Signal()
+    on_completion = Signal()
+
+    def __init__(self, mailbox=None):
+        super().__init__()
+        self.mailbox = mailbox
+        self.sender = None       # ActorImpl
+        self.receiver = None
+        self.payload = None      # what the sender ships
+        self._src_buff = None
+        self._dst_buff = None
+        self.size = 0.0
+        self.rate = -1.0
+        self.detached_ = False
+        self.match_fun = None
+        self.copy_data_fun = None
+        self.clean_fun = None
+
+    # -- declaration -------------------------------------------------------
+    def set_payload(self, payload, size: float) -> "Comm":
+        self.payload = payload
+        self.size = size
+        return self
+
+    def set_rate(self, rate: float) -> "Comm":
+        self.rate = rate
+        return self
+
+    def detach(self) -> "Comm":
+        self.detached_ = True
+        return self
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "Comm":
+        from .actor import _current_impl
+        assert self.state == ActivityState.INITED
+        issuer = _current_impl()
+        mbox_impl = self.mailbox.pimpl
+
+        if self.sender is not None:
+            Comm.on_sender_start(self)
+            self._src_buff = [self.payload]
+
+            def handler(sc):
+                sc.result = kact.comm_isend(
+                    sc.issuer.engine, sc.issuer, mbox_impl, self.size,
+                    self.rate, self._src_buff, self.match_fun, self.clean_fun,
+                    self.copy_data_fun, self.payload, self.detached_)
+                sc.issuer.simcall_answer()
+            self.pimpl = issuer.simcall("comm_isend", handler)
+        else:
+            Comm.on_receiver_start(self)
+            self._dst_buff = [None]
+
+            def handler(sc):
+                sc.result = kact.comm_irecv(
+                    sc.issuer.engine, sc.issuer, mbox_impl, self._dst_buff,
+                    self.match_fun, self.copy_data_fun, None, self.rate)
+                sc.issuer.simcall_answer()
+            self.pimpl = issuer.simcall("comm_irecv", handler)
+        self.state = ActivityState.STARTED
+        return self
+
+    def wait(self) -> "Comm":
+        return self.wait_for(-1.0)
+
+    def wait_for(self, timeout: float) -> "Comm":
+        from .actor import _current_impl
+        issuer = _current_impl()
+        if self.state == ActivityState.INITED:
+            self.start()
+        assert self.state == ActivityState.STARTED
+        comm_impl = self.pimpl
+
+        def handler(sc):
+            kact.comm_wait(sc, comm_impl, timeout)
+        issuer.simcall("comm_wait", handler)
+        self.state = ActivityState.FINISHED
+        Comm.on_completion(self)
+        return self
+
+    def test(self) -> bool:
+        from .actor import _current_impl
+        issuer = _current_impl()
+        if self.state in (ActivityState.INITED, ActivityState.STARTING):
+            self.start()
+        if self.state == ActivityState.FINISHED:
+            return True
+        comm_impl = self.pimpl
+        res = issuer.simcall("comm_test", lambda sc: kact.comm_test(sc, comm_impl))
+        if res:
+            self.state = ActivityState.FINISHED
+            Comm.on_completion(self)
+        return res
+
+    def cancel(self) -> "Comm":
+        from .actor import _current_impl
+        issuer = _current_impl()
+        comm_impl = self.pimpl
+        if comm_impl is not None:
+            def handler(sc):
+                comm_impl.cancel()
+                sc.issuer.simcall_answer()
+            issuer.simcall("comm_cancel", handler)
+        self.state = ActivityState.CANCELED
+        return self
+
+    def get_payload(self):
+        """Receiver side: the delivered payload (valid after wait)."""
+        return self._dst_buff[0] if self._dst_buff is not None else None
+
+    # -- collections -------------------------------------------------------
+    @staticmethod
+    def wait_any(comms: List["Comm"]) -> int:
+        return Comm.wait_any_for(comms, -1.0)
+
+    @staticmethod
+    def wait_any_for(comms: List["Comm"], timeout: float) -> int:
+        from .actor import _current_impl
+        issuer = _current_impl()
+        impls = [c.pimpl for c in comms]
+
+        def handler(sc):
+            kact.comm_waitany(sc, impls, timeout)
+        idx = issuer.simcall("comm_waitany", handler)
+        if idx is not None and idx >= 0:
+            comms[idx].state = ActivityState.FINISHED
+            Comm.on_completion(comms[idx])
+            return idx
+        return -1
+
+    @staticmethod
+    def test_any(comms: List["Comm"]) -> int:
+        from .actor import _current_impl
+        issuer = _current_impl()
+        impls = [c.pimpl for c in comms]
+        idx = issuer.simcall("comm_testany",
+                             lambda sc: kact.comm_testany(sc, impls))
+        if idx is not None and idx >= 0:
+            comms[idx].state = ActivityState.FINISHED
+            Comm.on_completion(comms[idx])
+            return idx
+        return -1
+
+    @staticmethod
+    def wait_all(comms: List["Comm"]) -> None:
+        for comm in comms:
+            comm.wait()
+
+
+class Exec(Activity):
+    """A computation activity (s4u_Exec.cpp)."""
+
+    on_start = Signal()
+    on_completion = Signal()
+
+    def __init__(self):
+        super().__init__()
+        self.hosts = []
+        self.flops_amounts: List[float] = []
+        self.bytes_amounts: List[float] = []
+        self.priority = 1.0
+        self.bound = 0.0
+        self.timeout = -1.0
+        self.name = ""
+
+    def set_priority(self, priority: float) -> "Exec":
+        self.priority = priority
+        return self
+
+    def set_bound(self, bound: float) -> "Exec":
+        self.bound = bound
+        return self
+
+    def set_host(self, host) -> "Exec":
+        self.hosts = [host]
+        return self
+
+    def set_flops_amount(self, flops: float) -> "Exec":
+        self.flops_amounts = [flops]
+        return self
+
+    def set_timeout(self, timeout: float) -> "Exec":
+        self.timeout = timeout
+        return self
+
+    def set_name(self, name: str) -> "Exec":
+        self.name = name
+        return self
+
+    def start(self) -> "Exec":
+        from .actor import _current_impl
+        issuer = _current_impl()
+
+        def handler(sc):
+            impl = kact.ExecImpl(sc.issuer.engine, self.name)
+            impl.hosts = list(self.hosts)
+            impl.flops_amounts = list(self.flops_amounts)
+            impl.bytes_amounts = list(self.bytes_amounts)
+            impl.sharing_penalty = 1.0 / self.priority
+            impl.bound = self.bound
+            if self.timeout > 0:
+                impl.set_timeout(self.timeout)
+            impl.start()
+            sc.result = impl
+            sc.issuer.simcall_answer()
+        self.pimpl = issuer.simcall("execution_start", handler)
+        self.state = ActivityState.STARTED
+        Exec.on_start(self)
+        return self
+
+    def wait(self) -> "Exec":
+        from .actor import _current_impl
+        if self.state == ActivityState.INITED:
+            self.start()
+        issuer = _current_impl()
+        exec_impl = self.pimpl
+
+        def handler(sc):
+            exec_impl.register_simcall(sc)
+            if exec_impl.state not in (kact.State.WAITING, kact.State.RUNNING):
+                exec_impl.finish()
+        issuer.simcall("execution_wait", handler)
+        self.state = ActivityState.FINISHED
+        Exec.on_completion(self)
+        return self
+
+    def test(self) -> bool:
+        if self.state == ActivityState.INITED:
+            self.start()
+        if self.pimpl.state not in (kact.State.WAITING, kact.State.RUNNING):
+            self.wait()
+            return True
+        return False
+
+    def cancel(self) -> "Exec":
+        from .actor import _current_impl
+        issuer = _current_impl()
+        exec_impl = self.pimpl
+
+        def handler(sc):
+            if exec_impl is not None:
+                exec_impl.cancel()
+            sc.issuer.simcall_answer()
+        issuer.simcall("execution_cancel", handler)
+        self.state = ActivityState.CANCELED
+        return self
+
+    def get_remaining(self) -> float:
+        return self.pimpl.get_remaining() if self.pimpl else 0.0
+
+    def get_remaining_ratio(self) -> float:
+        if self.pimpl is None or self.pimpl.surf_action is None:
+            return 0.0
+        act = self.pimpl.surf_action
+        if len(self.hosts) > 1:
+            return act.get_remains()
+        return act.get_remains() / act.cost
+
+
+class Io(Activity):
+    """A disk I/O activity (s4u_Io.cpp)."""
+
+    class OpType(Enum):
+        READ = 0
+        WRITE = 1
+
+    def __init__(self, storage, size: float, op_type: "Io.OpType"):
+        super().__init__()
+        self.storage = storage
+        self.size = size
+        self.op_type = op_type
+
+    def start(self) -> "Io":
+        from .actor import _current_impl
+        issuer = _current_impl()
+
+        def handler(sc):
+            impl = kact.IoImpl(sc.issuer.engine)
+            impl.storage = self.storage
+            impl.size = self.size
+            impl.io_type = ("read" if self.op_type == Io.OpType.READ
+                            else "write")
+            impl.start()
+            sc.result = impl
+            sc.issuer.simcall_answer()
+        self.pimpl = issuer.simcall("io_start", handler)
+        self.state = ActivityState.STARTED
+        return self
+
+    def wait(self) -> "Io":
+        from .actor import _current_impl
+        if self.state == ActivityState.INITED:
+            self.start()
+        issuer = _current_impl()
+        io_impl = self.pimpl
+
+        def handler(sc):
+            io_impl.register_simcall(sc)
+            if io_impl.state not in (kact.State.WAITING, kact.State.RUNNING):
+                io_impl.finish()
+        issuer.simcall("io_wait", handler)
+        self.state = ActivityState.FINISHED
+        return self
+
+    def get_performed_ioops(self) -> float:
+        return self.pimpl.performed_ioops if self.pimpl else 0.0

@@ -1,0 +1,286 @@
+"""s4u::Actor + this_actor: the user-facing actor API.
+
+Reference: /root/reference/src/s4u/s4u_Actor.cpp and
+include/simgrid/s4u/Actor.hpp: create, daemonize, suspend/resume, join,
+kill, migrate, on_exit; this_actor::{sleep_for, sleep_until, execute,
+yield, exit, ...} issuing simcalls under the hood.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from ..exceptions import ForcefulKillException
+from ..kernel import activity as kact
+from ..kernel.actor import ActorImpl
+from ..utils.signal import Signal
+from .engine import Engine
+
+
+class Actor:
+    """User handle on an actor."""
+
+    on_creation = ActorImpl.on_creation
+    on_termination = ActorImpl.on_termination
+    on_destruction = ActorImpl.on_destruction
+    on_suspend = Signal()
+    on_resume = Signal()
+    on_sleep = Signal()
+    on_wake_up = Signal()
+    on_migration = Signal()
+
+    def __init__(self, pimpl: ActorImpl):
+        self.pimpl = pimpl
+        pimpl.s4u_actor = self
+
+    # -- creation ----------------------------------------------------------
+    @staticmethod
+    def create(name: str, host, code: Callable, *args, **kwargs) -> "Actor":
+        engine = Engine.get_instance().pimpl
+        pimpl = engine.create_actor(name, host,
+                                    lambda: code(*args, **kwargs))
+        return Actor(pimpl)
+
+    @staticmethod
+    def self() -> Optional["Actor"]:
+        engine = Engine.get_instance().pimpl
+        actor = engine.context_factory.current_actor
+        if actor is None:
+            return None
+        return getattr(actor, "s4u_actor", None) or Actor(actor)
+
+    # -- properties --------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self.pimpl.name
+
+    @property
+    def pid(self) -> int:
+        return self.pimpl.pid
+
+    @property
+    def ppid(self) -> int:
+        return self.pimpl.ppid
+
+    @property
+    def host(self):
+        return self.pimpl.host
+
+    def get_properties(self):
+        return self.pimpl.properties
+
+    def is_daemon(self) -> bool:
+        return self.pimpl.daemonized
+
+    def is_suspended(self) -> bool:
+        return self.pimpl.suspended
+
+    # -- control (issued from any actor) -----------------------------------
+    def daemonize(self) -> "Actor":
+        issuer = _current_impl()
+        issuer.simcall("actor_daemonize",
+                       lambda sc: (self.pimpl.daemonize(),
+                                   sc.issuer.simcall_answer()))
+        return self
+
+    def suspend(self) -> None:
+        issuer = _current_impl()
+        target = self.pimpl
+        if issuer is target:
+            # suspending myself: block until someone resumes me
+            Actor.on_suspend(self)
+            issuer.suspended = True
+            issuer.simcall("actor_suspend", lambda sc: None)
+        else:
+            def handler(sc):
+                target.suspend_actor()
+                sc.issuer.simcall_answer()
+            Actor.on_suspend(self)
+            issuer.simcall("actor_suspend_other", handler)
+
+    def resume(self) -> None:
+        issuer = _current_impl()
+
+        def handler(sc):
+            self.pimpl.resume_actor()
+            sc.issuer.simcall_answer()
+        issuer.simcall("actor_resume", handler)
+        Actor.on_resume(self)
+
+    def join(self, timeout: float = -1.0) -> None:
+        """Block until this actor terminates (reference s4u_Actor.cpp join:
+        a simcall answered from the target's termination)."""
+        issuer = _current_impl()
+        target = self.pimpl
+
+        def handler(sc):
+            if target.finished:
+                sc.issuer.simcall_answer()
+                return
+            waiters = getattr(target, "_join_simcalls", None)
+            if waiters is None:
+                waiters = target._join_simcalls = []
+            waiters.append(sc)
+            if timeout >= 0:
+                def on_timeout():
+                    if sc in waiters:
+                        waiters.remove(sc)
+                        sc.issuer.simcall_answer()
+                sc.timeout_cb = sc.issuer.engine.timer_set(
+                    sc.issuer.engine.now + timeout, on_timeout)
+        issuer.simcall("actor_join", handler)
+
+    def kill(self) -> None:
+        issuer = _current_impl()
+
+        def handler(sc):
+            sc.issuer.engine.maestro.kill(self.pimpl)
+            if sc.issuer is not self.pimpl:
+                sc.issuer.simcall_answer()
+        issuer.simcall("actor_kill", handler)
+
+    @staticmethod
+    def kill_all() -> None:
+        issuer = _current_impl()
+
+        def handler(sc):
+            engine = sc.issuer.engine
+            for actor in list(engine.process_list.values()):
+                if actor is not sc.issuer:
+                    engine.maestro.kill(actor)
+            sc.issuer.simcall_answer()
+        issuer.simcall("actor_kill_all", handler)
+
+    def set_kill_time(self, time: float) -> None:
+        engine = Engine.get_instance().pimpl
+        target = self.pimpl
+        engine.timer_set(time, lambda: engine.maestro.kill(target))
+
+    def set_auto_restart(self, autorestart: bool = True) -> None:
+        self.pimpl.auto_restart = autorestart
+
+    def set_host(self, new_host) -> None:
+        issuer = _current_impl()
+        target = self.pimpl
+
+        def handler(sc):
+            if target.host is not None and target in target.host.actor_list:
+                target.host.actor_list.remove(target)
+            target.host = new_host
+            new_host.actor_list.append(target)
+            sc.issuer.simcall_answer()
+        issuer.simcall("actor_set_host", handler)
+        Actor.on_migration(self)
+
+    migrate = set_host
+
+    def on_exit(self, callback: Callable[[bool], None]) -> None:
+        self.pimpl.on_exit_callbacks.append(callback)
+
+
+def _current_impl() -> ActorImpl:
+    engine = Engine.get_instance().pimpl
+    actor = engine.context_factory.current_actor
+    # Outside any actor context (main thread / maestro): simcalls execute
+    # inline through the maestro pseudo-actor.
+    return actor if actor is not None else engine.maestro
+
+
+# ---------------------------------------------------------------------------
+# this_actor: the current-actor namespace
+# ---------------------------------------------------------------------------
+
+class this_actor:
+    """Static namespace mirroring simgrid::s4u::this_actor."""
+
+    @staticmethod
+    def get_pid() -> int:
+        return _current_impl().pid
+
+    @staticmethod
+    def get_ppid() -> int:
+        return _current_impl().ppid
+
+    @staticmethod
+    def get_name() -> str:
+        return _current_impl().name
+
+    @staticmethod
+    def get_cname() -> str:
+        return _current_impl().name
+
+    @staticmethod
+    def get_host():
+        return _current_impl().host
+
+    @staticmethod
+    def set_host(host) -> None:
+        Actor(_current_impl()).set_host(host)
+
+    @staticmethod
+    def is_maestro() -> bool:
+        return Engine.get_instance().pimpl.context_factory.current_actor is None
+
+    @staticmethod
+    def sleep_for(duration: float) -> None:
+        issuer = _current_impl()
+        if duration <= 0:
+            return
+        Actor.on_sleep(getattr(issuer, "s4u_actor", None))
+
+        def handler(sc):
+            sleep = kact.SleepImpl(sc.issuer.engine)
+            sleep.host = sc.issuer.host
+            sleep.duration = duration
+            sleep.start()
+            sleep.register_simcall(sc)
+        issuer.simcall("process_sleep", handler)
+        Actor.on_wake_up(getattr(issuer, "s4u_actor", None))
+
+    @staticmethod
+    def sleep_until(wakeup_time: float) -> None:
+        now = Engine.get_clock()
+        if wakeup_time > now:
+            this_actor.sleep_for(wakeup_time - now)
+
+    @staticmethod
+    def yield_() -> None:
+        issuer = _current_impl()
+        issuer.simcall("actor_yield", lambda sc: sc.issuer.simcall_answer())
+
+    @staticmethod
+    def execute(flops: float, priority: float = 1.0) -> None:
+        this_actor.exec_init(flops).set_priority(priority).wait()
+
+    @staticmethod
+    def parallel_execute(hosts, flops_amounts, bytes_amounts) -> None:
+        from .activity import Exec
+        exec_ = Exec()
+        exec_.hosts = list(hosts)
+        exec_.flops_amounts = list(flops_amounts)
+        exec_.bytes_amounts = list(bytes_amounts)
+        exec_.wait()
+
+    @staticmethod
+    def exec_init(flops: float) -> "Exec":
+        from .activity import Exec
+        exec_ = Exec()
+        exec_.hosts = [_current_impl().host]
+        exec_.flops_amounts = [flops]
+        return exec_
+
+    @staticmethod
+    def exec_async(flops: float) -> "Exec":
+        return this_actor.exec_init(flops).start()
+
+    @staticmethod
+    def suspend() -> None:
+        Actor(_current_impl()).suspend()
+
+    @staticmethod
+    def exit() -> None:
+        raise ForcefulKillException("exited")
+
+    @staticmethod
+    def on_exit(callback: Callable[[bool], None]) -> None:
+        _current_impl().on_exit_callbacks.append(callback)

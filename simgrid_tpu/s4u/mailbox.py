@@ -1,0 +1,95 @@
+"""s4u::Mailbox: named rendezvous points.
+
+Reference: /root/reference/src/s4u/s4u_Mailbox.cpp — put/get (+ _async,
+_init variants), iprobe, listen, ready, set_receiver (permanent receiver
+for eager delivery).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..kernel import activity as kact
+from .activity import Comm
+from .engine import Engine
+
+
+class Mailbox:
+    _instances = {}
+
+    def __init__(self, pimpl: kact.MailboxImpl):
+        self.pimpl = pimpl
+
+    @staticmethod
+    def by_name(name: str) -> "Mailbox":
+        mbox = Mailbox._instances.get(name)
+        if mbox is None:
+            engine = Engine.get_instance().pimpl
+            mbox = Mailbox(engine.mailbox_by_name_or_create(name))
+            Mailbox._instances[name] = mbox
+        return mbox
+
+    @property
+    def name(self) -> str:
+        return self.pimpl.name
+
+    # -- sending -----------------------------------------------------------
+    def put_init(self, payload=None, size: float = 0.0) -> Comm:
+        from .actor import _current_impl
+        comm = Comm(self)
+        comm.sender = _current_impl()
+        comm.payload = payload
+        comm.size = size
+        return comm
+
+    def put_async(self, payload, size: float) -> Comm:
+        assert payload is not None, "Cannot send nullptr data"
+        return self.put_init(payload, size).start()
+
+    def put(self, payload, size: float, timeout: float = -1.0) -> None:
+        assert payload is not None, "Cannot send nullptr data"
+        self.put_init(payload, size).start().wait_for(timeout)
+
+    # -- receiving ---------------------------------------------------------
+    def get_init(self) -> Comm:
+        from .actor import _current_impl
+        comm = Comm(self)
+        comm.receiver = _current_impl()
+        return comm
+
+    def get_async(self) -> Comm:
+        return self.get_init().start()
+
+    def get(self, timeout: float = -1.0) -> Any:
+        comm = self.get_async()
+        comm.wait_for(timeout)
+        return comm.get_payload()
+
+    # -- probing -----------------------------------------------------------
+    def iprobe(self, sender_side: bool = False, match_fun=None,
+               data=None) -> Optional[kact.CommImpl]:
+        from .actor import _current_impl
+        issuer = _current_impl()
+
+        def handler(sc):
+            sc.result = self.pimpl.iprobe(sender_side, match_fun, data)
+            sc.issuer.simcall_answer()
+        return issuer.simcall("mbox_iprobe", handler)
+
+    def listen(self) -> bool:
+        """True if something is queued for reception."""
+        return bool(self.pimpl.comm_queue) or bool(self.pimpl.done_comm_queue)
+
+    def ready(self) -> bool:
+        """True if a completed comm is deliverable right now."""
+        if self.pimpl.comm_queue:
+            return self.pimpl.comm_queue[0].state == kact.State.DONE
+        return False
+
+    def set_receiver(self, actor) -> None:
+        """Declare a permanent receiver: messages start flowing upon send,
+        without waiting for the matching receive (SMPI eager mode)."""
+        self.pimpl.set_receiver(actor.pimpl if actor is not None else None)
+
+    def get_receiver(self):
+        return self.pimpl.permanent_receiver

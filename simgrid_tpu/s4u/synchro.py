@@ -1,0 +1,158 @@
+"""s4u synchronization: Mutex, ConditionVariable, Semaphore, Barrier.
+
+Reference: /root/reference/src/s4u/{s4u_Mutex,s4u_ConditionVariable,
+s4u_Semaphore,s4u_Barrier}.cpp, over the kernel synchro implementations.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..exceptions import TimeoutException
+from ..kernel import activity as kact
+from .engine import Engine
+
+
+class Mutex:
+    def __init__(self):
+        self.pimpl = kact.MutexImpl(Engine.get_instance().pimpl)
+
+    def lock(self) -> None:
+        from .actor import _current_impl
+        issuer = _current_impl()
+        issuer.simcall("mutex_lock", lambda sc: self.pimpl.lock(sc))
+
+    def try_lock(self) -> bool:
+        from .actor import _current_impl
+        issuer = _current_impl()
+
+        def handler(sc):
+            sc.result = self.pimpl.try_lock(sc.issuer)
+            sc.issuer.simcall_answer()
+        return issuer.simcall("mutex_trylock", handler)
+
+    def unlock(self) -> None:
+        from .actor import _current_impl
+        issuer = _current_impl()
+
+        def handler(sc):
+            self.pimpl.unlock(sc.issuer)
+            sc.issuer.simcall_answer()
+        issuer.simcall("mutex_unlock", handler)
+
+    def __enter__(self):
+        self.lock()
+        return self
+
+    def __exit__(self, *exc):
+        self.unlock()
+        return False
+
+
+class ConditionVariable:
+    def __init__(self):
+        self.pimpl = kact.CondVarImpl(Engine.get_instance().pimpl)
+
+    def wait(self, mutex: Mutex) -> None:
+        from .actor import _current_impl
+        issuer = _current_impl()
+        issuer.simcall("cond_wait",
+                       lambda sc: self.pimpl.wait(mutex.pimpl, -1.0, sc))
+
+    def wait_for(self, mutex: Mutex, timeout: float) -> bool:
+        """Returns True on timeout (std::cv_status semantics)."""
+        from .actor import _current_impl
+        issuer = _current_impl()
+        try:
+            issuer.simcall("cond_wait_timeout",
+                           lambda sc: self.pimpl.wait(mutex.pimpl, timeout, sc))
+            return False
+        except TimeoutException:
+            # per the reference (s4u_ConditionVariable.cpp:73-80): on timeout
+            # the mutex must be re-acquired before returning
+            mutex.lock()
+            return True
+
+    def wait_until(self, mutex: Mutex, timeout_time: float) -> bool:
+        now = Engine.get_clock()
+        return self.wait_for(mutex, max(0.0, timeout_time - now))
+
+    def notify_one(self) -> None:
+        from .actor import _current_impl
+        issuer = _current_impl()
+
+        def handler(sc):
+            self.pimpl.signal()
+            sc.issuer.simcall_answer()
+        issuer.simcall("cond_signal", handler)
+
+    def notify_all(self) -> None:
+        from .actor import _current_impl
+        issuer = _current_impl()
+
+        def handler(sc):
+            self.pimpl.broadcast()
+            sc.issuer.simcall_answer()
+        issuer.simcall("cond_broadcast", handler)
+
+
+class Semaphore:
+    def __init__(self, initial_capacity: int):
+        self.pimpl = kact.SemImpl(Engine.get_instance().pimpl,
+                                  initial_capacity)
+
+    def acquire(self) -> None:
+        from .actor import _current_impl
+        issuer = _current_impl()
+        issuer.simcall("sem_acquire", lambda sc: self.pimpl.acquire(sc, -1.0))
+
+    def acquire_timeout(self, timeout: float) -> bool:
+        """Returns True on timeout."""
+        from .actor import _current_impl
+        issuer = _current_impl()
+        try:
+            issuer.simcall("sem_acquire_timeout",
+                           lambda sc: self.pimpl.acquire(sc, timeout))
+            return False
+        except TimeoutException:
+            return True
+
+    def release(self) -> None:
+        from .actor import _current_impl
+        issuer = _current_impl()
+
+        def handler(sc):
+            self.pimpl.release()
+            sc.issuer.simcall_answer()
+        issuer.simcall("sem_release", handler)
+
+    def get_capacity(self) -> int:
+        return self.pimpl.value
+
+    def would_block(self) -> bool:
+        return self.pimpl.would_block()
+
+
+class Barrier:
+    """Cyclic barrier over mutex+condvar (reference s4u_Barrier.cpp)."""
+
+    def __init__(self, expected_actors: int):
+        assert expected_actors > 0
+        self.expected = expected_actors
+        self.arrived = 0
+        self.mutex = Mutex()
+        self.cond = ConditionVariable()
+
+    def wait(self) -> bool:
+        """Returns True for exactly one of the participants (the 'serial'
+        actor), False for the others."""
+        self.mutex.lock()
+        self.arrived += 1
+        if self.arrived == self.expected:
+            self.cond.notify_all()
+            self.mutex.unlock()
+            self.arrived = 0
+            return True
+        self.cond.wait(self.mutex)
+        self.mutex.unlock()
+        return False

@@ -19,7 +19,8 @@ class ConfigError(Exception):
 
 
 class _Flag:
-    __slots__ = ("name", "description", "default", "value", "type", "callback", "aliases")
+    __slots__ = ("name", "description", "default", "value", "type", "callback",
+                 "aliases", "touched")
 
     def __init__(self, name: str, description: str, default: Any,
                  callback: Optional[Callable[[Any], None]] = None,
@@ -31,6 +32,10 @@ class _Flag:
         self.type = type(default)
         self.callback = callback
         self.aliases = aliases or []
+        # Explicit-set tracking (the reference's isdefault flag,
+        # config.cpp:141,171,240): an explicit set that happens to equal the
+        # default still counts as touched.
+        self.touched = False
 
 
 _TRUTHY = {"yes", "on", "true", "1"}
@@ -82,6 +87,7 @@ class Config:
             raise ConfigError(f"Invalid value {value!r} for flag '{flag.name}' "
                               f"of type {flag.type.__name__}")
         flag.value = value
+        flag.touched = True
         if flag.callback is not None:
             flag.callback(value)
 
@@ -89,8 +95,16 @@ class Config:
         self.set(name, value)
 
     def is_default(self, name: str) -> bool:
+        return not self._resolve(name).touched
+
+    def set_default(self, name: str, value: Any) -> None:
+        """Change the default (and the value if never explicitly set) — the
+        reference's config::set_default used by model initializers."""
         flag = self._resolve(name)
-        return flag.value == flag.default
+        if not flag.touched:
+            self.set(name, value)        # validates the type first
+            flag.touched = False         # still counts as a default
+        flag.default = value
 
     @staticmethod
     def _parse(flag: _Flag, text: str) -> Any:

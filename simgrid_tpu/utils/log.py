@@ -61,11 +61,12 @@ class Category:
             return
         if args:
             msg = msg % args
-        prefix = ""
+        parts = []
         if context_getter is not None:
-            prefix += f"[{context_getter()}] "
-        elif clock_getter is not None:
-            prefix += f"[{clock_getter():.6f}] "
+            parts.append(context_getter())
+        if clock_getter is not None:
+            parts.append(f"{clock_getter():.6f}")
+        prefix = f"[{' '.join(parts)}] " if parts else ""
         lvl = _LEVEL_NAMES.get(level, str(level))
         sys.stderr.write(f"{prefix}[{self.name}/{lvl}] {msg}\n")
 
@@ -96,14 +97,23 @@ def new_category(name: str, description: str = "") -> Category:
 
 
 def apply_control(control: str) -> None:
-    """Apply a ``cat.thresh:level`` (space-separated list) log control."""
+    """Apply a ``cat.thresh:level`` (space-separated list) log control.
+
+    Like the reference (log.cpp _xbt_log_parse_setting), any prefix of
+    ``threshold`` of length >= 2 is accepted (``th``, ``thres``, ...);
+    unknown settings raise instead of being silently dropped."""
     for token in control.split():
         if ":" not in token:
-            continue
+            raise ValueError(f"Invalid log control {token!r}: expected "
+                             f"'category.setting:value'")
         key, value = token.split(":", 1)
-        if key.endswith(".thresh") or key.endswith(".threshold"):
-            cat_name = key.rsplit(".", 1)[0]
-            level = _LEVELS.get(value.lower())
-            if level is None:
-                raise ValueError(f"Unknown log level '{value}'")
-            get_category(cat_name).threshold = level
+        cat_name, _, setting = key.rpartition(".")
+        if (not cat_name or len(setting) < 2
+                or not "threshold".startswith(setting)):
+            if setting in ("fmt", "app", "add"):  # layout/appender controls
+                continue  # accepted but not implemented: formats are fixed
+            raise ValueError(f"Unknown log setting {setting!r} in {token!r}")
+        level = _LEVELS.get(value.lower())
+        if level is None:
+            raise ValueError(f"Unknown log level '{value}'")
+        get_category(cat_name).threshold = level

@@ -17,9 +17,14 @@ import pytest  # noqa: E402
 
 @pytest.fixture(autouse=True)
 def _fresh_config():
-    """Snapshot/restore the global flag registry around each test."""
+    """Snapshot/restore the global flag registry around each test
+    (both values and defaults: model initializers use set_default)."""
     from simgrid_tpu.utils.config import config
-    saved = {name: f.value for name, f in config._flags.items()}
+    saved = {name: (f.value, f.default, f.touched)
+             for name, f in config._flags.items()}
     yield
-    for name, value in saved.items():
-        config._flags[name].value = value
+    for name, (value, default, touched) in saved.items():
+        flag = config._flags[name]
+        flag.value = value
+        flag.default = default
+        flag.touched = touched
