@@ -61,8 +61,11 @@ class Profile:
         fes.add_event(0.0, event)
         return event
 
-    def next(self, event: Event) -> DatedValue:
-        event_date = self.fes.next_date()
+    def next(self, event: Event, event_date: float) -> DatedValue:
+        """Advance the stream past `event` (which just fired at
+        `event_date`) and reschedule the follow-up occurrence.  The
+        reference reads the date off the heap top (Profile.cpp:53) because
+        it pops only afterwards; we take it as an argument instead."""
         date_val = self.event_list[event.idx]
         if event.idx < len(self.event_list) - 1:
             self.fes.add_event(event_date + date_val.date, event)
@@ -133,8 +136,8 @@ class FutureEvtSet:
         (event, value, resource) or None."""
         if not self._heap or self._heap[0][0] > date:
             return None
-        _, _, event = heapq.heappop(self._heap)
-        date_val = event.profile.next(event)
+        event_date, _, event = heapq.heappop(self._heap)
+        date_val = event.profile.next(event, event_date)
         return event, date_val.value, event.resource
 
     def empty(self) -> bool:

@@ -12,6 +12,7 @@ weight 0.05; TCP-gamma window bound rate <= gamma/(2*RTT).
 
 from __future__ import annotations
 
+import math
 from typing import List, Optional
 
 from ..kernel.resource import (Action, ActionState, HeapType, Model, Resource,
@@ -331,7 +332,10 @@ class NetworkCm02Link(LinkImpl):
         LinkImpl.on_bandwidth_change(self)
         weight_s = config["network/weight-S"]
         if weight_s > 0:
-            delta = weight_s / value - weight_s / old
+            # C++ float semantics: x/0 is inf, not an error (a zero-bandwidth
+            # trace event must park the flows, not abort the simulation).
+            delta = (weight_s / value if value else math.inf) \
+                - (weight_s / old if old else math.inf)
             for var in list(self.constraint.iter_variables()):
                 action = var.id
                 if isinstance(action, NetworkAction):
@@ -351,13 +355,14 @@ class NetworkCm02Link(LinkImpl):
                 continue
             action.lat_current += delta
             action.sharing_penalty += delta
+            lat_bound = (gamma / (2.0 * action.lat_current)
+                         if action.lat_current else math.inf)
             if action.rate < 0:
                 self.model.system.update_variable_bound(
-                    action.variable, gamma / (2.0 * action.lat_current))
+                    action.variable, lat_bound)
             else:
                 self.model.system.update_variable_bound(
-                    action.variable,
-                    min(action.rate, gamma / (2.0 * action.lat_current)))
+                    action.variable, min(action.rate, lat_bound))
             if not action.is_suspended():
                 self.model.system.update_variable_penalty(
                     action.variable, action.sharing_penalty)

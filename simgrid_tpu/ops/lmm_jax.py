@@ -63,12 +63,24 @@ def _bucket(n: int) -> int:
     return 1 << (n - 1).bit_length()
 
 
-@functools.partial(jax.jit, static_argnames=("n_c", "n_v"))
-def _solve_kernel(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
-                  eps, n_c: int, n_v: int):
-    """The saturate-bottleneck fixpoint over padded arrays."""
+def fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
+             eps, n_c: int, n_v: int, axis: Optional[str] = None):
+    """The saturate-bottleneck fixpoint over padded COO arrays.
+
+    The single implementation behind every solve path: single-device
+    (``axis=None`` — the reductions are plain segment ops), vmapped
+    batches, and mesh-sharded element lists (``axis`` names the shard_map
+    mesh axis; cross-shard combines become one psum/pmax pair per round —
+    see simgrid_tpu.parallel.sharded).
+    """
     dtype = e_w.dtype
     inf = jnp.array(jnp.inf, dtype)
+
+    def allsum(x):
+        return lax.psum(x, axis) if axis else x
+
+    def allmax(x):
+        return lax.pmax(x, axis) if axis else x
 
     v_enabled = v_penalty > 0
     e_valid = (e_w > 0) & jnp.take(v_enabled, e_var, fill_value=False)
@@ -76,8 +88,8 @@ def _solve_kernel(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
     e_upen = jnp.where(e_valid, e_w / jnp.take(safe_pen, e_var), 0.0)
 
     # Initial usage per constraint: sum for SHARED, max for FATPIPE.
-    usage_sum = jnp.zeros(n_c, dtype).at[e_cnst].add(e_upen)
-    usage_max = jnp.zeros(n_c, dtype).at[e_cnst].max(e_upen)
+    usage_sum = allsum(jnp.zeros(n_c, dtype).at[e_cnst].add(e_upen))
+    usage_max = allmax(jnp.zeros(n_c, dtype).at[e_cnst].max(e_upen))
     usage0 = jnp.where(c_fatpipe, usage_max, usage_sum)
 
     remaining0 = c_bound
@@ -85,8 +97,10 @@ def _solve_kernel(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
     # remaining above the relative epsilon (maxmin.cpp:524).
     light0 = (remaining0 > c_bound * eps) & (usage0 > 0)
 
-    v_value0 = jnp.zeros(n_v, dtype)
-    v_fixed0 = jnp.zeros(n_v, dtype=bool)
+    # Derive the initial carry from the inputs (not fresh constants) so its
+    # varying-manual-axes match the loop output under shard_map+vmap.
+    v_value0 = v_penalty * 0.0
+    v_fixed0 = v_penalty < 0
 
     def cond(state):
         _, _, _, _, light, it = state
@@ -102,7 +116,7 @@ def _solve_kernel(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
         # Saturated variables: any live element inside a saturated constraint.
         e_live = e_valid & ~jnp.take(v_fixed, e_var)
         e_sat = e_live & jnp.take(saturated_c, e_cnst)
-        v_sat = jnp.zeros(n_v, dtype=bool).at[e_var].max(e_sat)
+        v_sat = allmax(jnp.zeros(n_v, dtype=bool).at[e_var].max(e_sat))
 
         # Bound-first rule (maxmin.cpp:566-596): if any saturated variable's
         # bound*penalty sits below min_usage, fix (only) the variables whose
@@ -122,10 +136,10 @@ def _solve_kernel(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
 
         # Batched double_update on every constraint touched by fixed vars.
         e_fix = e_valid & jnp.take(fix_now, e_var)
-        d_rem = jnp.zeros(n_c, dtype).at[e_cnst].add(
-            jnp.where(e_fix, e_w * jnp.take(v_value, e_var), 0.0))
-        d_use = jnp.zeros(n_c, dtype).at[e_cnst].add(
-            jnp.where(e_fix, e_upen, 0.0))
+        d_rem = allsum(jnp.zeros(n_c, dtype).at[e_cnst].add(
+            jnp.where(e_fix, e_w * jnp.take(v_value, e_var), 0.0)))
+        d_use = allsum(jnp.zeros(n_c, dtype).at[e_cnst].add(
+            jnp.where(e_fix, e_upen, 0.0)))
 
         new_remaining = remaining - d_rem
         new_remaining = jnp.where(new_remaining < c_bound * eps, 0.0, new_remaining)
@@ -134,10 +148,10 @@ def _solve_kernel(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
 
         # FATPIPE: usage is re-derived as the max over still-unset variables.
         e_live2 = e_valid & ~jnp.take(v_fixed, e_var)
-        new_usage_max = jnp.zeros(n_c, dtype).at[e_cnst].max(
-            jnp.where(e_live2, e_upen, 0.0))
+        new_usage_max = allmax(jnp.zeros(n_c, dtype).at[e_cnst].max(
+            jnp.where(e_live2, e_upen, 0.0)))
 
-        touched = jnp.zeros(n_c, dtype=bool).at[e_cnst].max(e_fix)
+        touched = allmax(jnp.zeros(n_c, dtype=bool).at[e_cnst].max(e_fix))
         new_usage = jnp.where(c_fatpipe, new_usage_max, new_usage_sum)
         usage = jnp.where(touched, new_usage, usage)
         remaining = jnp.where(touched & ~c_fatpipe, new_remaining, remaining)
@@ -153,6 +167,13 @@ def _solve_kernel(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
         cond, body, (v_value0, v_fixed0, remaining0, usage0, light0,
                      jnp.array(0, jnp.int32)))
     return v_value, remaining, usage, rounds
+
+
+@functools.partial(jax.jit, static_argnames=("n_c", "n_v"))
+def _solve_kernel(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
+                  eps, n_c: int, n_v: int):
+    return fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty,
+                    v_bound, eps, n_c, n_v, axis=None)
 
 
 def flatten(cnst_list: List[Constraint], dtype=np.float64
@@ -220,12 +241,16 @@ def solve_arrays(arrays: LmmArrays, eps: float, device=None):
     values, remaining, usage, rounds = _solve_kernel(
         *args, n_c=len(arrays.c_bound), n_v=len(arrays.v_penalty))
     rounds = int(rounds)
+    check_convergence(rounds, arrays.n_cnst, arrays.n_var)
+    return np.asarray(values), np.asarray(remaining), np.asarray(usage), rounds
+
+
+def check_convergence(rounds: int, n_cnst, n_var) -> None:
     if rounds >= _MAX_ROUNDS:
         raise RuntimeError(
             f"LMM JAX solve did not converge within {_MAX_ROUNDS} saturation "
-            f"rounds ({arrays.n_cnst} constraints, {arrays.n_var} variables); "
+            f"rounds ({n_cnst} constraints, {n_var} variables); "
             f"check maxmin/precision vs the system's magnitudes")
-    return np.asarray(values), np.asarray(remaining), np.asarray(usage), rounds
 
 
 def solve_jax(system: System) -> None:

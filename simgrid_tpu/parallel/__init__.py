@@ -1,0 +1,23 @@
+"""Multi-chip execution of the simulation kernel.
+
+SimGrid scales by algorithmic sparsity on one core (selective update,
+lazy heaps — maxmin.cpp:898-937, Model.cpp:40-101).  The TPU-native
+answer is data parallelism over a ``jax.sharding.Mesh``:
+
+* **element sharding** (``sharded.sharded_solve``): the COO element list
+  of one huge LMM system is split across devices; every saturation round
+  does local segment-sums and one ``psum`` over ICI so 100k+-flow systems
+  solve in lockstep across chips;
+* **simulation batching** (``sharded.batched_solve``): many independent
+  systems (parameter sweeps, MC branches) are vmapped and the batch axis
+  is sharded over the mesh — the "data-parallel" axis;
+* both compose in one 2-D mesh ``("sim", "elem")`` — see
+  ``__graft_entry__.dryrun_multichip``.
+"""
+
+from .sharded import (  # noqa: F401
+    batched_solve,
+    make_mesh,
+    sharded_solve,
+    sharded_step,
+)

@@ -111,7 +111,11 @@ def parse_cluster_tag(loader, elem, father) -> None:
     lat = elem.get("lat")
     core = int(elem.get("core", "1"))
     topology = elem.get("topology", "FLAT").upper()
-    sharing_policy = elem.get("sharing_policy", "SPLITDUPLEX" if False else "SHARED")
+    # DTD default is SPLITDUPLEX (simgrid.dtd:173): two directed links per
+    # node.  FULLDUPLEX is the deprecated alias.
+    sharing_policy = elem.get("sharing_policy", "SPLITDUPLEX").upper()
+    if sharing_policy == "FULLDUPLEX":
+        sharing_policy = "SPLITDUPLEX"
     bb_sharing = elem.get("bb_sharing_policy", "SHARED")
 
     if topology == "FLAT":
@@ -159,12 +163,22 @@ def parse_cluster_tag(loader, elem, father) -> None:
             zone.add_private_link(zone.node_pos_with_loopback(host.netpoint.id),
                                   lim, lim)
 
-        link = engine.network_model.create_link(
-            f"{name}_link_{node_id}", bw_value, lat_value,
-            SharingPolicy.SHARED if sharing_policy != "FATPIPE"
-            else SharingPolicy.FATPIPE)
+        link_id = f"{name}_link_{node_id}"
+        if sharing_policy == "SPLITDUPLEX":
+            # Two directed links per node (ClusterZone::create_links_for_node
+            # + sg_platf_new_link's _UP/_DOWN split, sg_platf.cpp:132-134).
+            link_up = engine.network_model.create_link(
+                f"{link_id}_UP", bw_value, lat_value, SharingPolicy.SHARED)
+            link_down = engine.network_model.create_link(
+                f"{link_id}_DOWN", bw_value, lat_value, SharingPolicy.SHARED)
+        else:
+            link_up = link_down = engine.network_model.create_link(
+                link_id, bw_value, lat_value,
+                SharingPolicy.FATPIPE if sharing_policy == "FATPIPE"
+                else SharingPolicy.SHARED)
         zone.add_private_link(
-            zone.node_pos_with_loopback_limiter(host.netpoint.id), link, link)
+            zone.node_pos_with_loopback_limiter(host.netpoint.id),
+            link_up, link_down)
 
         if hasattr(zone, "add_processing_node"):
             zone.add_processing_node(host.netpoint, rank)
@@ -207,6 +221,8 @@ def parse_peer_tag(loader, elem, father) -> None:
     coords = elem.get("coordinates")
     if coords:
         host.netpoint.coords = [float(x) for x in coords.split()]
-    engine.network_model.create_link(
-        f"private_{name}", parse_bandwidth(elem.get("bw_in")),
-        parse_time(elem.get("lat", "0")), SharingPolicy.SHARED)
+    assert hasattr(father, "set_peer_link"), \
+        "<peer> tag can only be used in Vivaldi netzones"
+    father.set_peer_link(host.netpoint,
+                         parse_bandwidth(elem.get("bw_in")),
+                         parse_bandwidth(elem.get("bw_out")))

@@ -206,23 +206,39 @@ class EmptyZone(NetZoneImpl):
 
 
 class VivaldiZone(NetZoneImpl):
-    """Coordinate-based latency (reference VivaldiZone.cpp): hosts carry
-    (x, y, h) network coordinates; latency = euclidean distance + heights;
-    each endpoint may have private up/down links named private_<name>."""
+    """Coordinate-based latency (reference VivaldiZone.cpp): endpoints
+    carry (x, y, h) network coordinates; latency = euclidean xy distance
+    plus both heights, in ms; peers get directed private links
+    link_<name>_{UP,DOWN} (set_peer_link, VivaldiZone.cpp:67-81)."""
+
+    def __init__(self, engine, father, name):
+        super().__init__(engine, father, name)
+        self.private_links = {}  # netpoint.id -> (link_up, link_down)
 
     def add_route(self, src, dst, gw_src, gw_dst, links,
                   symmetrical: bool = True) -> None:
         raise AssertionError("No explicit routes in Vivaldi zones")
 
+    def set_peer_link(self, netpoint, bw_in: float, bw_out: float) -> None:
+        up = self.engine.network_model.create_link(
+            f"link_{netpoint.name}_UP", bw_out, 0.0, _SHARED())
+        down = self.engine.network_model.create_link(
+            f"link_{netpoint.name}_DOWN", bw_in, 0.0, _SHARED())
+        self.private_links[netpoint.id] = (up, down)
+
     def get_local_route(self, src, dst, route, latency) -> None:
         if src.is_netzone():
-            route.gw_src = self.engine.netpoints.get(f"netzone@{src.name}")
-            route.gw_dst = self.engine.netpoints.get(f"netzone@{dst.name}")
+            # Gateways follow the child-router naming convention
+            # (VivaldiZone.cpp:88-92).
+            route.gw_src = self.engine.netpoints.get(f"router_{src.name}")
+            route.gw_dst = self.engine.netpoints.get(f"router_{dst.name}")
 
-        for endpoint, _ in ((src, "up"), (dst, "down")):
-            link = self.engine.links.get(f"private_{endpoint.name}")
-            if link is not None:
-                self._add_link_latency(route.links, link, latency)
+        src_links = self.private_links.get(src.id)
+        if src_links is not None and src_links[0] is not None:
+            self._add_link_latency(route.links, src_links[0], latency)
+        dst_links = self.private_links.get(dst.id)
+        if dst_links is not None and dst_links[1] is not None:
+            self._add_link_latency(route.links, dst_links[1], latency)
 
         if latency is not None:
             c_src = src.coords
@@ -231,4 +247,9 @@ class VivaldiZone(NetZoneImpl):
                 f"Missing coordinates for {src.name} or {dst.name}"
             dist = math.sqrt((c_src[0] - c_dst[0]) ** 2
                              + (c_src[1] - c_dst[1]) ** 2)
-            latency[0] += (dist + c_src[2] + c_dst[2]) / 1000.0  # ms -> s
+            latency[0] += (dist + abs(c_src[2]) + abs(c_dst[2])) / 1000.0
+
+
+def _SHARED():
+    from ..ops.lmm_host import SharingPolicy
+    return SharingPolicy.SHARED

@@ -1,0 +1,54 @@
+"""Profile (trace) stream semantics vs the reference's delta encoding
+(src/kernel/resource/profile/Profile.cpp:52-68)."""
+
+import pytest
+
+from simgrid_tpu.kernel.profile import (FutureEvtSet, Profile,
+                                        clear_trace_registry)
+
+
+@pytest.fixture(autouse=True)
+def _clear():
+    clear_trace_registry()
+    yield
+    clear_trace_registry()
+
+
+def _drain(profile, horizon):
+    """Fire events in date order up to `horizon`; return [(date, value)]."""
+    fes = FutureEvtSet()
+    profile.schedule(fes, resource=None)
+    out = []
+    while not fes.empty() and fes.next_date() <= horizon:
+        date = fes.next_date()
+        event, value, _ = fes.pop_leq(date)
+        out.append((date, value))
+        if event.free_me:
+            break
+    return out
+
+def test_periodic_profile_dates_monotonic():
+    # Two events + loop-after-10: cycle restarts 10s after the last event.
+    prof = Profile.from_string("p1", "0 1.0\n5 0.5\n", periodicity=10)
+    fired = _drain(prof, horizon=40)
+    dates = [d for d, _ in fired]
+    assert dates == sorted(dates), f"dates went backwards: {fired}"
+    # Skip the idx-0 placeholder (value -1, reference Profile.cpp:26-31).
+    real = [(d, v) for d, v in fired if v >= 0]
+    assert real == [(0, 1.0), (5, 0.5), (15, 1.0), (20, 0.5),
+                    (30, 1.0), (35, 0.5)]
+
+
+def test_aperiodic_profile_ends():
+    prof = Profile.from_string("p2", "0 1.0\n3 0.25\n", periodicity=-1)
+    fired = _drain(prof, horizon=100)
+    real = [(d, v) for d, v in fired if v >= 0]
+    assert real == [(0, 1.0), (3, 0.25)]
+
+
+def test_offset_start_places_first_event():
+    # A trace starting at t=7: the placeholder stores the offset.
+    prof = Profile.from_string("p3", "7 0.5\n9 1.0\n", periodicity=-1)
+    fired = _drain(prof, horizon=100)
+    real = [(d, v) for d, v in fired if v >= 0]
+    assert real == [(7, 0.5), (9, 1.0)]
