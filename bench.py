@@ -1,0 +1,142 @@
+"""Benchmark: LMM max-min solve on device vs the exact host list solver.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}
+
+* value        — device (JAX/TPU) solve latency in ms on a 100k-flow
+                 system (the BASELINE.json target scale: 100k+ concurrent
+                 flows over a 16k-link platform).
+* vs_baseline  — speedup of the device solve over the exact host list
+                 solver (the reference architecture's algorithm,
+                 maxmin.cpp:502-693 semantics) measured on the largest
+                 maxmin_bench-style class the host can finish quickly
+                 (teshsuite/surf/maxmin_bench/maxmin_bench.cpp classes).
+
+All diagnostics go to stderr; stdout carries exactly the JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build_arrays(rng, n_c, n_v, deg, dtype):
+    from simgrid_tpu.ops.lmm_jax import LmmArrays, _bucket
+
+    E = n_v * deg
+    Eb, Cb, Vb = _bucket(E), _bucket(n_c), _bucket(n_v)
+    e_var = np.zeros(Eb, np.int32)
+    e_cnst = np.zeros(Eb, np.int32)
+    e_w = np.zeros(Eb, dtype)
+    e_var[:E] = np.repeat(np.arange(n_v, dtype=np.int32), deg)
+    e_cnst[:E] = rng.integers(0, n_c, size=E).astype(np.int32)
+    e_w[:E] = rng.uniform(0.5, 1.5, size=E).astype(dtype)
+    c_bound = np.zeros(Cb, dtype)
+    c_bound[:n_c] = rng.uniform(1.0, 10.0, size=n_c).astype(dtype)
+    c_fatpipe = np.zeros(Cb, bool)
+    v_penalty = np.zeros(Vb, dtype)
+    v_penalty[:n_v] = 1.0
+    v_bound = np.full(Vb, -1.0, dtype)
+    return LmmArrays(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty,
+                     v_bound, E, n_c, n_v)
+
+
+def host_solve_time(arrays) -> float:
+    """Build the same system in the exact host solver and time one solve."""
+    from simgrid_tpu.ops.lmm_host import System
+
+    sys_ = System(selective_update=False)
+    cnsts = [sys_.constraint_new(None, float(arrays.c_bound[i]))
+             for i in range(arrays.n_cnst)]
+    E = arrays.n_elem
+    by_var = {}
+    for k in range(E):
+        by_var.setdefault(int(arrays.e_var[k]), []).append(k)
+    for vi, elems in by_var.items():
+        var = sys_.variable_new(None, 1.0, -1.0, len(elems))
+        seen = set()
+        for k in elems:
+            ci = int(arrays.e_cnst[k])
+            if ci in seen:
+                sys_.expand_add(cnsts[ci], var, float(arrays.e_w[k]))
+            else:
+                seen.add(ci)
+                sys_.expand(cnsts[ci], var, float(arrays.e_w[k]))
+    t0 = time.perf_counter()
+    sys_.solve_exact()
+    return time.perf_counter() - t0
+
+
+def device_solve_time(arrays, eps, reps=5) -> float:
+    import jax
+
+    from simgrid_tpu.ops.lmm_jax import solve_arrays
+
+    solve_arrays(arrays, eps)  # compile + warm
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        solve_arrays(arrays, eps)
+        times.append(time.perf_counter() - t0)
+    del jax
+    return float(np.median(times))
+
+
+def main():
+    import jax
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    dtype = np.float32 if on_tpu else np.float64
+    eps = 1e-5 if on_tpu else 1e-9
+    log(f"device: {dev} platform={dev.platform} dtype={dtype.__name__}")
+
+    rng = np.random.default_rng(42)
+
+    # --- headline: 100k flows over 16k links, 4 links per flow ---------
+    # (on a CPU-only dev box, drop to 20k flows so the bench stays fast)
+    n_flows = 100_000 if on_tpu else 20_000
+    big = build_arrays(rng, 16384, n_flows, 4, dtype)
+    t_dev_100k = device_solve_time(big, eps)
+    log(f"device solve @{n_flows} flows: {t_dev_100k*1e3:.2f} ms")
+
+    # --- speedup vs exact host solver on maxmin_bench classes ----------
+    # Start at the reference's "big" class (2000x2000), escalate to
+    # "huge" (20000x20000) only if the host is fast enough to finish.
+    cls = dict(n_c=2000, n_v=2000, deg=3, name="big 2000x2000")
+    arrays = build_arrays(np.random.default_rng(1), dtype=dtype, **{
+        k: cls[k] for k in ("n_c", "n_v", "deg")})
+    t_host = host_solve_time(arrays)
+    t_dev = device_solve_time(arrays, eps)
+    log(f"{cls['name']}: host {t_host*1e3:.1f} ms, device {t_dev*1e3:.2f} ms")
+
+    if t_host < 0.8:  # projected huge host time ~100x big: keep under ~80 s
+        cls = dict(n_c=20000, n_v=20000, deg=3, name="huge 20000x20000")
+        arrays = build_arrays(np.random.default_rng(2), dtype=dtype, **{
+            k: cls[k] for k in ("n_c", "n_v", "deg")})
+        t_host = host_solve_time(arrays)
+        t_dev = device_solve_time(arrays, eps)
+        log(f"{cls['name']}: host {t_host*1e3:.1f} ms, "
+            f"device {t_dev*1e3:.2f} ms")
+
+    speedup = t_host / t_dev if t_dev > 0 else float("inf")
+    print(json.dumps({
+        "metric": f"LMM solve latency @{n_flows} flows on {dev.platform} "
+                  f"(vs_baseline: speedup over exact host list solver, "
+                  f"{cls['name']} class)",
+        "value": round(t_dev_100k * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": round(speedup, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -193,6 +193,18 @@ class EngineImpl:
             if (time_delta < 0.0 or next_event_model < time_delta) and next_event_model >= 0.0:
                 time_delta = next_event_model
 
+        # Stalled-resume upgrade over the reference: if no action can ever
+        # complete (time_delta < 0, e.g. every flow parked on a
+        # zero-bandwidth link) but actions are running and a future profile
+        # event could unblock them, jump to that event instead of
+        # deadlocking (the reference bails out here, surf_c_bindings.cpp:
+        # 128-134 — its own FIXME admits the availability-0 case is broken).
+        if time_delta < 0.0:
+            next_event_date = self.future_evt_set.next_date()
+            if next_event_date >= 0.0 and any(
+                    model.started_action_set for model in self.models):
+                time_delta = next_event_date - self.now
+
         # Consume profile events up to the chosen horizon.
         while True:
             next_event_date = self.future_evt_set.next_date()
@@ -212,6 +224,14 @@ class EngineImpl:
                 if popped is None:
                     break
                 event, value, resource = popped
+                if value < 0:
+                    # Profile idx-0 placeholder (value -1, Profile.cpp:26-31).
+                    # The reference applies it anyway (surf_c_bindings.cpp:
+                    # 112-125), which is only harmless because conventional
+                    # traces start at t=0 and instantly overwrite it; we skip
+                    # it so traces starting at t>0 keep the platform value
+                    # until their first real event.
+                    continue
                 if (resource.is_used()
                         or resource.name in self.watched_hosts):
                     time_delta = next_event_date - self.now

@@ -309,8 +309,13 @@ class Model:
             max_duration_flag = False
             if action.state_set is not self.started_action_set:
                 continue
-            if (action.sharing_penalty <= 0
-                    or action.heap_type == HeapType.LATENCY):
+            # "Bogus priority" skip (Model.cpp:55): use the effective
+            # penalty where defined — a parked flow (every weight-S term
+            # gone because its links are at bandwidth 0) has finite part 0
+            # but effective penalty inf, and must still be processed so its
+            # stale completion date is dropped.
+            if (getattr(action, "effective_penalty", action.sharing_penalty)
+                    <= 0 or action.heap_type == HeapType.LATENCY):
                 continue
             action.update_remains_lazy(now)
             min_date = -1.0
@@ -326,7 +331,13 @@ class Model:
                          or action.start_time + action.max_duration < min_date)):
                 min_date = action.start_time + action.max_duration
                 max_duration_flag = True
-            assert min_date > -1
+            if min_date <= -1:
+                # Share 0 and no deadline: the action is parked (e.g. on a
+                # zero-bandwidth link).  The reference dies here
+                # (Model.cpp:89 DIE_IMPOSSIBLE); we drop the stale
+                # completion date instead — a profile event may revive it.
+                self.action_heap.remove(action)
+                continue
             self.action_heap.update(
                 action, min_date,
                 HeapType.MAX_DURATION if max_duration_flag else HeapType.NORMAL)

@@ -33,6 +33,15 @@ class NetworkAction(Action):
         self.latency = 0.0
         self.lat_current = 0.0
         self.rate = -1.0
+        # Links on the route whose bandwidth is currently 0: the flow is
+        # parked (infinite penalty) while any exist.  sharing_penalty keeps
+        # only the *finite* part so a later bandwidth restore can undo the
+        # park without inf-inf arithmetic (C++ would NaN here).
+        self.parked_links = 0
+
+    @property
+    def effective_penalty(self) -> float:
+        return math.inf if self.parked_links else self.sharing_penalty
 
     def set_state(self, state: ActionState) -> None:
         super().set_state(state)
@@ -190,7 +199,7 @@ class NetworkCm02Model(NetworkModel):
             if action.heap_type == HeapType.LATENCY:
                 # latency paid: open the flow
                 self.system.update_variable_penalty(action.variable,
-                                                    action.sharing_penalty)
+                                                    action.effective_penalty)
                 self.action_heap.remove(action)
                 action.set_last_update()
             else:
@@ -210,7 +219,7 @@ class NetworkCm02Model(NetworkModel):
                     action.latency = 0.0
                 if action.latency <= 0.0 and not action.is_suspended():
                     self.system.update_variable_penalty(action.variable,
-                                                        action.sharing_penalty)
+                                                        action.effective_penalty)
             if not action.variable.get_number_of_constraint():
                 # no link on the route (e.g. vivaldi): complete immediately
                 action.update_remains(action.get_remains_no_update())
@@ -248,7 +257,11 @@ class NetworkCm02Model(NetworkModel):
         weight_s = config["network/weight-S"]
         if weight_s > 0:
             for link in route:
-                action.sharing_penalty += weight_s / link.get_bandwidth()
+                bw = link.get_bandwidth()
+                if bw > 0:
+                    action.sharing_penalty += weight_s / bw
+                else:
+                    action.parked_links += 1
 
         bw_factor = self.get_bandwidth_factor(size)
         bandwidth_bound = -1.0 if not route else bw_factor * route[0].get_bandwidth()
@@ -332,17 +345,23 @@ class NetworkCm02Link(LinkImpl):
         LinkImpl.on_bandwidth_change(self)
         weight_s = config["network/weight-S"]
         if weight_s > 0:
-            # C++ float semantics: x/0 is inf, not an error (a zero-bandwidth
-            # trace event must park the flows, not abort the simulation).
-            delta = (weight_s / value if value else math.inf) \
-                - (weight_s / old if old else math.inf)
+            # A zero-bandwidth trace event parks the flows (infinite
+            # penalty) instead of aborting; the park is tracked as a count
+            # so a later restore works (delta arithmetic with inf would NaN).
             for var in list(self.constraint.iter_variables()):
                 action = var.id
                 if isinstance(action, NetworkAction):
-                    action.sharing_penalty += delta
+                    if old > 0:
+                        action.sharing_penalty -= weight_s / old
+                    else:
+                        action.parked_links -= 1
+                    if value > 0:
+                        action.sharing_penalty += weight_s / value
+                    else:
+                        action.parked_links += 1
                     if not action.is_suspended():
                         self.model.system.update_variable_penalty(
-                            action.variable, action.sharing_penalty)
+                            action.variable, action.effective_penalty)
 
     def set_latency(self, value: float) -> None:
         # reference NetworkCm02Link::set_latency (network_cm02.cpp:351-381)
@@ -365,7 +384,7 @@ class NetworkCm02Link(LinkImpl):
                     action.variable, min(action.rate, lat_bound))
             if not action.is_suspended():
                 self.model.system.update_variable_penalty(
-                    action.variable, action.sharing_penalty)
+                    action.variable, action.effective_penalty)
 
 
 class NetworkConstantModel(NetworkModel):

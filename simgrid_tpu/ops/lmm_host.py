@@ -536,6 +536,18 @@ class System:
         for cnst in cnst_list:
             cnst.remaining = cnst.bound
             if not double_positive(cnst.remaining, cnst.bound * eps):
+                # Zero-capacity constraint: its flows get rate 0 this round.
+                # Unlike the reference (maxmin.cpp:523-525), still report the
+                # actions as modified so the lazy model drops their stale
+                # completion dates (park support, see Model lazy path).
+                if self.modified_actions is not None:
+                    for elem in cnst.enabled_element_set:
+                        action = elem.variable.id
+                        if (elem.consumption_weight > 0 and action is not None
+                                and not getattr(action, "in_modified_set",
+                                                False)):
+                            action.in_modified_set = True
+                            self.modified_actions.append(action)
                 continue
             cnst.usage = 0.0
             for elem in cnst.enabled_element_set:

@@ -99,7 +99,8 @@ def fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
 
     # Derive the initial carry from the inputs (not fresh constants) so its
     # varying-manual-axes match the loop output under shard_map+vmap.
-    v_value0 = v_penalty * 0.0
+    # Parked variables carry penalty=inf and inf*0.0 is NaN, so sanitize.
+    v_value0 = jnp.where(jnp.isfinite(v_penalty), v_penalty, 0.0) * 0.0
     v_fixed0 = v_penalty < 0
 
     def cond(state):
@@ -274,9 +275,10 @@ def solve_jax(system: System) -> None:
         for elem in cnst.enabled_element_set:
             elem.variable.value = 0.0
     if system.modified_actions is not None:
+        # Unlike the reference (maxmin.cpp:523-525) zero-bound constraints'
+        # actions are reported too, so the lazy model drops their stale
+        # completion dates (park support, see Model lazy path).
         for cnst in cnst_list:
-            if not (cnst.bound > cnst.bound * eps):
-                continue
             for elem in cnst.enabled_element_set:
                 if elem.consumption_weight > 0:
                     action = elem.variable.id

@@ -40,6 +40,8 @@ def make_mesh(n_devices: Optional[int] = None, sim: int = 1,
         devices = jax.devices()
     if n_devices is None:
         n_devices = len(devices)
+    assert n_devices % sim == 0, \
+        f"sim={sim} must divide n_devices={n_devices} for a (sim, elem) mesh"
     devices = np.asarray(devices[:n_devices]).reshape(sim, n_devices // sim)
     return Mesh(devices, axis_names=("sim", "elem"))
 
@@ -50,6 +52,36 @@ def _pad_to(x: np.ndarray, n: int, fill=0):
     out = np.full(n, fill, x.dtype)
     out[:len(x)] = x
     return out
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_run(mesh: Mesh, axis: str, n_c: int, n_v: int):
+    """Memoized jitted element-sharded fixpoint (jax.jit caches per
+    function identity, so the wrapper must be reused across calls)."""
+    espec = NamedSharding(mesh, P(axis))
+    rspec = NamedSharding(mesh, P())
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=(espec, espec, espec, rspec, rspec, rspec, rspec, rspec),
+        out_shardings=rspec)
+    def run(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound, eps):
+        fn = jax.shard_map(
+            functools.partial(fixpoint, n_c=n_c, n_v=n_v, axis=axis),
+            mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(), P(), P(), P(), P()),
+            out_specs=P())
+        return fn(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty,
+                  v_bound, eps)
+
+    return run
+
+
+@functools.lru_cache(maxsize=64)
+def _batched_run(n_c: int, n_v: int):
+    """Memoized jitted vmapped fixpoint for batches of independent systems."""
+    solve1 = functools.partial(fixpoint, n_c=n_c, n_v=n_v, axis=None)
+    return jax.jit(jax.vmap(solve1, in_axes=(0, 0, 0, 0, 0, 0, 0, None)))
 
 
 def sharded_solve(arrays: LmmArrays, eps: float, mesh: Mesh,
@@ -69,22 +101,7 @@ def sharded_solve(arrays: LmmArrays, eps: float, mesh: Mesh,
     e_w = _pad_to(arrays.e_w, Ep)
     n_c, n_v = len(arrays.c_bound), len(arrays.v_penalty)
 
-    espec = NamedSharding(mesh, P(axis))
-    rspec = NamedSharding(mesh, P())
-
-    @functools.partial(
-        jax.jit,
-        in_shardings=(espec, espec, espec, rspec, rspec, rspec, rspec, rspec),
-        out_shardings=rspec)
-    def run(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound, eps):
-        fn = jax.shard_map(
-            functools.partial(fixpoint, n_c=n_c, n_v=n_v, axis=axis),
-            mesh=mesh,
-            in_specs=(P(axis), P(axis), P(axis), P(), P(), P(), P(), P()),
-            out_specs=P())
-        return fn(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty,
-                  v_bound, eps)
-
+    run = _sharded_run(mesh, axis, n_c, n_v)
     values, remaining, usage, rounds = run(
         e_var, e_cnst, e_w, arrays.c_bound, arrays.c_fatpipe,
         arrays.v_penalty, arrays.v_bound, np.asarray(eps, e_w.dtype))
@@ -103,17 +120,15 @@ def batched_solve(batch: LmmArrays, eps: float, mesh: Optional[Mesh] = None,
     n_c = batch.c_bound.shape[-1]
     n_v = batch.v_penalty.shape[-1]
 
-    solve1 = functools.partial(fixpoint, n_c=n_c, n_v=n_v, axis=None)
+    vsolve = _batched_run(n_c, n_v)
     eps_arr = np.asarray(eps, batch.e_w.dtype)
-    vsolve = jax.vmap(lambda ev, ec, ew, cb, cf, vp, vb:
-                      solve1(ev, ec, ew, cb, cf, vp, vb, eps_arr))
 
     args = (batch.e_var, batch.e_cnst, batch.e_w, batch.c_bound,
             batch.c_fatpipe, batch.v_penalty, batch.v_bound)
     if mesh is not None:
         bspec = NamedSharding(mesh, P(axis))
         args = tuple(jax.device_put(a, bspec) for a in args)
-    values, remaining, usage, rounds = jax.jit(vsolve)(*args)
+    values, remaining, usage, rounds = vsolve(*args, eps_arr)
     rounds = np.asarray(rounds)
     check_convergence(int(rounds.max()), n_c, n_v)
     return (np.asarray(values), np.asarray(remaining), np.asarray(usage),

@@ -13,6 +13,7 @@ import math
 from typing import Dict, List, Optional, Tuple
 
 from ..exceptions import ParseError
+from ..ops.lmm_host import SharingPolicy
 from .zone import NetPoint, NetPointType, NetZoneImpl, Route
 
 
@@ -221,9 +222,9 @@ class VivaldiZone(NetZoneImpl):
 
     def set_peer_link(self, netpoint, bw_in: float, bw_out: float) -> None:
         up = self.engine.network_model.create_link(
-            f"link_{netpoint.name}_UP", bw_out, 0.0, _SHARED())
+            f"link_{netpoint.name}_UP", bw_out, 0.0, SharingPolicy.SHARED)
         down = self.engine.network_model.create_link(
-            f"link_{netpoint.name}_DOWN", bw_in, 0.0, _SHARED())
+            f"link_{netpoint.name}_DOWN", bw_in, 0.0, SharingPolicy.SHARED)
         self.private_links[netpoint.id] = (up, down)
 
     def get_local_route(self, src, dst, route, latency) -> None:
@@ -249,7 +250,3 @@ class VivaldiZone(NetZoneImpl):
                              + (c_src[1] - c_dst[1]) ** 2)
             latency[0] += (dist + abs(c_src[2]) + abs(c_dst[2])) / 1000.0
 
-
-def _SHARED():
-    from ..ops.lmm_host import SharingPolicy
-    return SharingPolicy.SHARED

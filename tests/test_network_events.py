@@ -1,0 +1,96 @@
+"""Regression tests for runtime link events: a zero-bandwidth trace event
+must park in-flight flows (infinite penalty) and a later restore must
+resume them with finite rates — no inf/NaN leakage through the weight-S
+penalty arithmetic (reference NetworkCm02Link::set_bandwidth semantics,
+network_cm02.cpp:326-349, where C++ delta arithmetic would produce
+inf-inf = NaN on restore)."""
+
+import math
+import os
+
+import pytest
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils.config import config
+
+
+@pytest.fixture(autouse=True)
+def fresh_engine():
+    s4u.Engine._reset()
+    yield
+    s4u.Engine._reset()
+
+
+def _outage_platform(tmp_path, trace_body):
+    xml = f"""<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <host id="src" speed="100Mf"/>
+    <host id="dst" speed="100Mf"/>
+    <link id="wire" bandwidth="1MBps" latency="0"/>
+    <route src="src" dst="dst"><link_ctn id="wire"/></route>
+    <trace id="bwtrace" periodicity="-1">
+{trace_body}
+    </trace>
+    <trace_connect kind="BANDWIDTH" trace="bwtrace" element="wire"/>
+  </zone>
+</platform>
+"""
+    path = os.path.join(tmp_path, "outage.xml")
+    with open(path, "w") as f:
+        f.write(xml)
+    return path
+
+
+def _run_transfer(platform, nbytes):
+    state = {}
+
+    def sender(mb):
+        mb.put("payload", nbytes)
+
+    def receiver(mb):
+        mb.get()
+        state["recv_at"] = s4u.Engine.get_clock()
+
+    # crosstraffic off so the expected rate is exactly bw_factor * bw
+    e = s4u.Engine(["outage", "--cfg=network/crosstraffic:0"])
+    e.load_platform(platform)
+    mb = s4u.Mailbox.by_name("mb")
+    s4u.Actor.create("sender", e.host_by_name("src"), sender, mb)
+    s4u.Actor.create("receiver", e.host_by_name("dst"), receiver, mb)
+    e.run()
+    state["clock"] = e.clock
+    return state
+
+
+def test_bandwidth_outage_parks_and_restores(tmp_path):
+    # 10 MB at 1 MBps (0.97 bw factor): without outage finishes ~10.3 s.
+    # Bandwidth drops to 0 at t=2 and is restored at t=6: the flow must
+    # pause for the 4 s outage and then finish at a finite, larger date.
+    plat = _outage_platform(tmp_path, "2.0 0\n6.0 1e6")
+    state = _run_transfer(plat, 1e7)
+    assert "recv_at" in state, "transfer never completed after restore"
+    t = state["recv_at"]
+    assert math.isfinite(t)
+    no_outage = 1e7 / (0.97 * 1e6)
+    assert t == pytest.approx(no_outage + 4.0, rel=1e-6)
+
+
+def test_bandwidth_outage_from_start(tmp_path):
+    # Link starts dead, comes alive at t=3: flow waits, then completes.
+    plat = _outage_platform(tmp_path, "0.0 0\n3.0 1e6")
+    state = _run_transfer(plat, 1e6)
+    assert "recv_at" in state
+    assert state["recv_at"] == pytest.approx(3.0 + 1e6 / (0.97 * 1e6),
+                                             rel=1e-6)
+
+
+def test_bandwidth_halved_midway(tmp_path):
+    # Plain (finite) bandwidth change for comparison: 1 MBps -> 0.5 MBps
+    # at t=5; remaining bytes drain at half rate.
+    plat = _outage_platform(tmp_path, "5.0 5e5")
+    state = _run_transfer(plat, 1e7)
+    assert "recv_at" in state
+    sent_by_5 = 0.97 * 1e6 * 5.0
+    rest = (1e7 - sent_by_5) / (0.97 * 5e5)
+    assert state["recv_at"] == pytest.approx(5.0 + rest, rel=1e-6)
