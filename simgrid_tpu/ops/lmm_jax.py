@@ -64,14 +64,27 @@ def _bucket(n: int) -> int:
 
 
 def fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
-             eps, n_c: int, n_v: int, axis: Optional[str] = None):
+             eps, n_c: int, n_v: int, axis: Optional[str] = None,
+             parallel_rounds: bool = False):
     """The saturate-bottleneck fixpoint over padded COO arrays.
 
     The single implementation behind every solve path: single-device
     (``axis=None`` — the reductions are plain segment ops), vmapped
     batches, and mesh-sharded element lists (``axis`` names the shard_map
-    mesh axis; cross-shard combines become one psum/pmax pair per round —
+    mesh axis; cross-shard combines are one psum/pmax pair per round in
+    global mode and ~7 psum/pmax/pmin collectives per round in local
+    mode, which still wins because local mode needs far fewer rounds —
     see simgrid_tpu.parallel.sharded).
+
+    ``parallel_rounds=False`` replays the reference's sequential order
+    exactly: one global bottleneck level per round.  ``True`` fixes every
+    *local-minimum* constraint per round (a constraint whose rou is <= the
+    rou of every constraint it shares a live variable with): since a
+    constraint's remaining/usage ratio only increases as other variables
+    get fixed, a local minimum's level is already final, so whole
+    independent regions of the constraint graph saturate concurrently and
+    the device round count drops from O(#distinct levels) to O(level-chain
+    depth of the graph).
     """
     dtype = e_w.dtype
     inf = jnp.array(jnp.inf, dtype)
@@ -81,6 +94,9 @@ def fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
 
     def allmax(x):
         return lax.pmax(x, axis) if axis else x
+
+    def allmin(x):
+        return lax.pmin(x, axis) if axis else x
 
     v_enabled = v_penalty > 0
     e_valid = (e_w > 0) & jnp.take(v_enabled, e_var, fill_value=False)
@@ -107,31 +123,10 @@ def fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
         _, _, _, _, light, it = state
         return jnp.any(light) & (it < _MAX_ROUNDS)
 
-    def body(state):
+    def apply_fixes(state, fix_now, new_value):
+        """Shared round tail: write fixed values, batched double_update of
+        every touched constraint, epsilon-based light-set pruning."""
         v_value, v_fixed, remaining, usage, light, it = state
-
-        rou = jnp.where(light, remaining / jnp.where(light, usage, 1.0), inf)
-        min_usage = jnp.min(rou)
-        saturated_c = light & (rou == min_usage)
-
-        # Saturated variables: any live element inside a saturated constraint.
-        e_live = e_valid & ~jnp.take(v_fixed, e_var)
-        e_sat = e_live & jnp.take(saturated_c, e_cnst)
-        v_sat = allmax(jnp.zeros(n_v, dtype=bool).at[e_var].max(e_sat))
-
-        # Bound-first rule (maxmin.cpp:566-596): if any saturated variable's
-        # bound*penalty sits below min_usage, fix (only) the variables whose
-        # bound*penalty equals the smallest such value this round.
-        bp = v_bound * v_penalty
-        has_low_bound = v_sat & (v_bound > 0) & (bp < min_usage)
-        min_bound = jnp.min(jnp.where(has_low_bound, bp, inf))
-        use_bounds = jnp.isfinite(min_bound)
-
-        fix_now = jnp.where(use_bounds,
-                            v_sat & (jnp.abs(bp - min_bound) < eps),
-                            v_sat)
-        new_value = jnp.where(use_bounds, v_bound,
-                              min_usage / jnp.where(v_enabled, v_penalty, 1.0))
         v_value = jnp.where(fix_now, new_value, v_value)
         v_fixed = v_fixed | fix_now
 
@@ -164,17 +159,102 @@ def fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
         light = light & ~drop
         return v_value, v_fixed, remaining, usage, light, it + 1
 
+    def body_global(state):
+        """One global bottleneck level per round (reference order,
+        maxmin.cpp:560-680)."""
+        v_value, v_fixed, remaining, usage, light, it = state
+
+        rou = jnp.where(light, remaining / jnp.where(light, usage, 1.0), inf)
+        min_usage = jnp.min(rou)
+        saturated_c = light & (rou == min_usage)
+
+        # Saturated variables: any live element inside a saturated constraint.
+        e_live = e_valid & ~jnp.take(v_fixed, e_var)
+        e_sat = e_live & jnp.take(saturated_c, e_cnst)
+        v_sat = allmax(jnp.zeros(n_v, dtype=bool).at[e_var].max(e_sat))
+
+        # Bound-first rule (maxmin.cpp:566-596): if any saturated variable's
+        # bound*penalty sits below min_usage, fix (only) the variables whose
+        # bound*penalty equals the smallest such value this round.
+        bp = v_bound * v_penalty
+        has_low_bound = v_sat & (v_bound > 0) & (bp < min_usage)
+        min_bound = jnp.min(jnp.where(has_low_bound, bp, inf))
+        use_bounds = jnp.isfinite(min_bound)
+
+        fix_now = jnp.where(use_bounds,
+                            v_sat & (jnp.abs(bp - min_bound) < eps),
+                            v_sat)
+        new_value = jnp.where(use_bounds, v_bound,
+                              min_usage / jnp.where(v_enabled, v_penalty, 1.0))
+        return apply_fixes(state, fix_now, new_value)
+
+    def body_local(state):
+        """Fix every local-minimum constraint per round.  Exact: a
+        constraint's rou = remaining/usage only ever increases when other
+        variables are fixed (fixing removes a below-average contribution),
+        so a constraint whose rou is minimal among every constraint it
+        shares a live variable with already sits at its final level, no
+        matter in which order the rest of the graph saturates."""
+        v_value, v_fixed, remaining, usage, light, it = state
+
+        rou = jnp.where(light, remaining / jnp.where(light, usage, 1.0), inf)
+        e_live = e_valid & ~jnp.take(v_fixed, e_var)
+
+        # Two-hop neighborhood min of rou: constraint -> vars -> constraint.
+        e_rou = jnp.where(e_live, jnp.take(rou, e_cnst), inf)
+        nmin_v = allmin(jnp.full(n_v, inf, dtype).at[e_var].min(e_rou))
+        e_nmin = jnp.where(e_live, jnp.take(nmin_v, e_var), inf)
+        nmin_c = allmin(jnp.full(n_c, inf, dtype).at[e_cnst].min(e_nmin))
+        processable = light & (rou <= nmin_c)
+
+        # Saturated vars and their levels (min processable rou containing v).
+        e_proc = e_live & jnp.take(processable, e_cnst)
+        v_sat = allmax(jnp.zeros(n_v, dtype=bool).at[e_var].max(e_proc))
+        level_v = nmin_v
+
+        # Bound-first rule, localized: a processable constraint holding a
+        # below-level bounded variable only fixes its minimal such bounds
+        # this round (the constraint re-enters with an updated rou), and
+        # any constraint sharing a variable with it must wait, exactly as
+        # the reference's global-min-bound round defers level fixing.
+        bp = v_bound * v_penalty
+        low_v = v_sat & (v_bound > 0) & (bp < level_v)
+        e_bp = jnp.where(e_live & jnp.take(low_v, e_var),
+                         jnp.take(bp, e_var), inf)
+        mb_c = allmin(jnp.full(n_c, inf, dtype).at[e_cnst].min(e_bp))
+        mb_c = jnp.where(processable, mb_c, inf)
+        e_mb = jnp.where(e_proc, jnp.take(mb_c, e_cnst), inf)
+        mb_v = allmin(jnp.full(n_v, inf, dtype).at[e_var].min(e_mb))
+        e_blocked = e_proc & jnp.isfinite(jnp.take(mb_v, e_var))
+        blocked_c = allmax(jnp.zeros(n_c, dtype=bool).at[e_cnst].max(e_blocked))
+
+        # Level-fixing only through processable, unblocked constraints.
+        ok_c = processable & ~blocked_c
+        e_rou_ok = jnp.where(e_live & jnp.take(ok_c, e_cnst),
+                             jnp.take(rou, e_cnst), inf)
+        level2_v = allmin(jnp.full(n_v, inf, dtype).at[e_var].min(e_rou_ok))
+
+        fix_bound = low_v & (jnp.abs(bp - mb_v) < eps)
+        fix_level = jnp.isfinite(level2_v) & ~v_fixed & ~fix_bound
+        fix_now = fix_bound | fix_level
+        new_value = jnp.where(fix_bound, v_bound,
+                              level2_v / jnp.where(v_enabled, v_penalty, 1.0))
+        return apply_fixes(state, fix_now, new_value)
+
     v_value, v_fixed, remaining, usage, light, rounds = lax.while_loop(
-        cond, body, (v_value0, v_fixed0, remaining0, usage0, light0,
-                     jnp.array(0, jnp.int32)))
+        cond, body_local if parallel_rounds else body_global,
+        (v_value0, v_fixed0, remaining0, usage0, light0,
+         jnp.array(0, jnp.int32)))
     return v_value, remaining, usage, rounds
 
 
-@functools.partial(jax.jit, static_argnames=("n_c", "n_v"))
+@functools.partial(jax.jit,
+                   static_argnames=("n_c", "n_v", "parallel_rounds"))
 def _solve_kernel(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
-                  eps, n_c: int, n_v: int):
+                  eps, n_c: int, n_v: int, parallel_rounds: bool = False):
     return fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty,
-                    v_bound, eps, n_c, n_v, axis=None)
+                    v_bound, eps, n_c, n_v, axis=None,
+                    parallel_rounds=parallel_rounds)
 
 
 def flatten(cnst_list: List[Constraint], dtype=np.float64
@@ -232,15 +312,28 @@ def flatten(cnst_list: List[Constraint], dtype=np.float64
     return arrays, vars_in_order
 
 
-def solve_arrays(arrays: LmmArrays, eps: float, device=None):
+def use_local_rounds() -> bool:
+    """Parse + validate the lmm/rounds flag (local|global)."""
+    mode = config["lmm/rounds"]
+    if mode not in ("local", "global"):
+        raise ValueError(f"Unknown lmm/rounds {mode!r} "
+                         "(expected local or global)")
+    return mode == "local"
+
+
+def solve_arrays(arrays: LmmArrays, eps: float, device=None,
+                 parallel_rounds: Optional[bool] = None):
     """Run the jit'd fixpoint; returns (values ndarray, rounds)."""
+    if parallel_rounds is None:
+        parallel_rounds = use_local_rounds()
     args = [arrays.e_var, arrays.e_cnst, arrays.e_w, arrays.c_bound,
             arrays.c_fatpipe, arrays.v_penalty, arrays.v_bound,
             np.asarray(eps, arrays.e_w.dtype)]
     if device is not None:
         args = [jax.device_put(a, device) for a in args]
     values, remaining, usage, rounds = _solve_kernel(
-        *args, n_c=len(arrays.c_bound), n_v=len(arrays.v_penalty))
+        *args, n_c=len(arrays.c_bound), n_v=len(arrays.v_penalty),
+        parallel_rounds=parallel_rounds)
     rounds = int(rounds)
     check_convergence(rounds, arrays.n_cnst, arrays.n_var)
     return np.asarray(values), np.asarray(remaining), np.asarray(usage), rounds

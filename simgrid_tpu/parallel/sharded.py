@@ -30,7 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.lmm_jax import LmmArrays, check_convergence, fixpoint
+from ..ops.lmm_jax import (LmmArrays, check_convergence, fixpoint,
+                           use_local_rounds)
 
 
 def make_mesh(n_devices: Optional[int] = None, sim: int = 1,
@@ -55,7 +56,8 @@ def _pad_to(x: np.ndarray, n: int, fill=0):
 
 
 @functools.lru_cache(maxsize=64)
-def _sharded_run(mesh: Mesh, axis: str, n_c: int, n_v: int):
+def _sharded_run(mesh: Mesh, axis: str, n_c: int, n_v: int,
+                 parallel_rounds: bool = False):
     """Memoized jitted element-sharded fixpoint (jax.jit caches per
     function identity, so the wrapper must be reused across calls)."""
     espec = NamedSharding(mesh, P(axis))
@@ -67,7 +69,8 @@ def _sharded_run(mesh: Mesh, axis: str, n_c: int, n_v: int):
         out_shardings=rspec)
     def run(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound, eps):
         fn = jax.shard_map(
-            functools.partial(fixpoint, n_c=n_c, n_v=n_v, axis=axis),
+            functools.partial(fixpoint, n_c=n_c, n_v=n_v, axis=axis,
+                              parallel_rounds=parallel_rounds),
             mesh=mesh,
             in_specs=(P(axis), P(axis), P(axis), P(), P(), P(), P(), P()),
             out_specs=P())
@@ -78,9 +81,10 @@ def _sharded_run(mesh: Mesh, axis: str, n_c: int, n_v: int):
 
 
 @functools.lru_cache(maxsize=64)
-def _batched_run(n_c: int, n_v: int):
+def _batched_run(n_c: int, n_v: int, parallel_rounds: bool = False):
     """Memoized jitted vmapped fixpoint for batches of independent systems."""
-    solve1 = functools.partial(fixpoint, n_c=n_c, n_v=n_v, axis=None)
+    solve1 = functools.partial(fixpoint, n_c=n_c, n_v=n_v, axis=None,
+                               parallel_rounds=parallel_rounds)
     return jax.jit(jax.vmap(solve1, in_axes=(0, 0, 0, 0, 0, 0, 0, None)))
 
 
@@ -101,7 +105,7 @@ def sharded_solve(arrays: LmmArrays, eps: float, mesh: Mesh,
     e_w = _pad_to(arrays.e_w, Ep)
     n_c, n_v = len(arrays.c_bound), len(arrays.v_penalty)
 
-    run = _sharded_run(mesh, axis, n_c, n_v)
+    run = _sharded_run(mesh, axis, n_c, n_v, use_local_rounds())
     values, remaining, usage, rounds = run(
         e_var, e_cnst, e_w, arrays.c_bound, arrays.c_fatpipe,
         arrays.v_penalty, arrays.v_bound, np.asarray(eps, e_w.dtype))
@@ -120,7 +124,7 @@ def batched_solve(batch: LmmArrays, eps: float, mesh: Optional[Mesh] = None,
     n_c = batch.c_bound.shape[-1]
     n_v = batch.v_penalty.shape[-1]
 
-    vsolve = _batched_run(n_c, n_v)
+    vsolve = _batched_run(n_c, n_v, use_local_rounds())
     eps_arr = np.asarray(eps, batch.e_w.dtype)
 
     args = (batch.e_var, batch.e_cnst, batch.e_w, batch.c_bound,
@@ -135,7 +139,7 @@ def batched_solve(batch: LmmArrays, eps: float, mesh: Optional[Mesh] = None,
             rounds)
 
 
-def sharded_step(mesh: Mesh):
+def sharded_step(mesh: Mesh, parallel_rounds=None):
     """Build the flagship jitted full step on a ("sim", "elem") mesh.
 
     One step of a batch of simulations: solve every system's rate vector
@@ -150,13 +154,18 @@ def sharded_step(mesh: Mesh):
     leading batch axis on every operand.
     """
     n_elem_shards = mesh.shape["elem"]
+    # Captured at factory time (the returned step is a fixed compiled
+    # artifact); pass parallel_rounds explicitly to override the flag.
+    if parallel_rounds is None:
+        parallel_rounds = use_local_rounds()
 
     def one_sim(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
                 v_remains, eps):
         n_c, n_v = c_bound.shape[0], v_penalty.shape[0]
         values, remaining, usage, rounds = fixpoint(
             e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
-            eps, n_c=n_c, n_v=n_v, axis="elem")
+            eps, n_c=n_c, n_v=n_v, axis="elem",
+            parallel_rounds=parallel_rounds)
         live = (v_penalty > 0) & (values > 0) & (v_remains > 0)
         ttc = jnp.where(live, v_remains / jnp.where(live, values, 1.0),
                         jnp.inf)

@@ -202,6 +202,12 @@ declare_flag("lmm/jax-threshold",
              "Minimum live variable count before 'auto' switches the solve "
              "to the JAX backend", 512)
 declare_flag("lmm/dtype", "JAX solver dtype: float64 or float32", "float64")
+declare_flag("lmm/rounds",
+             "JAX solver saturation-round strategy: global (one bottleneck "
+             "level per round, the reference's sequential order) or local "
+             "(fix every local-minimum constraint per round; exact because "
+             "rou levels only increase, and far fewer device rounds)",
+             "local")
 declare_flag("contexts/stack-size", "Actor stack size (bytes)", 131072)
 declare_flag("contexts/factory", "Actor context factory (thread)", "thread")
 declare_flag("tracing", "Enable tracing", False)

@@ -336,3 +336,24 @@ def test_constraint_feasibility_invariant():
     for var in variables:
         if var.bound > 0:
             assert var.value <= var.bound * (1 + EPS) + EPS
+
+
+@pytest.mark.parametrize("rounds_mode", ["global", "local"])
+@pytest.mark.parametrize("seed", range(6))
+def test_round_modes_match_oracle(seed, rounds_mode):
+    """Both device round strategies (one global bottleneck level per round
+    vs all local-minimum constraints per round) must reproduce the exact
+    list solver on systems mixing bounds, penalties and FATPIPE."""
+    from simgrid_tpu.utils.config import config
+    config["lmm/rounds"] = rounds_mode
+    rng = np.random.default_rng(seed)
+    s_exact, v_exact = _random_system(rng, 20, 60, backend="list",
+                                      p_bound=0.5, p_fat=0.3)
+    rng = np.random.default_rng(seed)
+    s_jax, v_jax = _random_system(rng, 20, 60, backend="jax",
+                                  p_bound=0.5, p_fat=0.3)
+    s_exact.solve()
+    s_jax.solve()
+    exact = np.array([v.value for v in v_exact])
+    vect = np.array([v.value for v in v_jax])
+    np.testing.assert_allclose(vect, exact, rtol=1e-9, atol=1e-9)
