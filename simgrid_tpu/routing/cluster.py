@@ -19,6 +19,22 @@ from ..ops.lmm_host import SharingPolicy
 from .zone import NetPoint, NetPointType, NetZoneImpl
 
 
+def make_duplex_link(engine, link_id: str, bw: float, lat: float,
+                     sharing: str):
+    """Create one cluster link; SPLITDUPLEX makes an _UP/_DOWN pair
+    (sg_platf.cpp:132-134 naming).  Returns (link_up, link_down)."""
+    model = engine.network_model
+    if sharing == "SPLITDUPLEX":
+        up = model.create_link(f"{link_id}_UP", bw, lat, SharingPolicy.SHARED)
+        down = model.create_link(f"{link_id}_DOWN", bw, lat,
+                                 SharingPolicy.SHARED)
+        return up, down
+    policy = (SharingPolicy.FATPIPE if sharing == "FATPIPE"
+              else SharingPolicy.SHARED)
+    link = model.create_link(link_id, bw, lat, policy)
+    return link, link
+
+
 def parse_radical(radical: str) -> List[int]:
     """Expand "0-9,12,15-20" to the explicit id list (sg_platf.cpp)."""
     ids: List[int] = []
@@ -44,7 +60,12 @@ class ClusterZone(NetZoneImpl):
         self.router: Optional[NetPoint] = None
         self.has_loopback = False
         self.has_limiter = False
+        self.limiter_bw = 0.0
         self.num_links_per_node = 1
+        # netpoint.id -> 0-based rank.  The reference indexes private links
+        # by raw netpoint id, which only works when the cluster is alone in
+        # the platform; the explicit map keeps multi-zone platforms correct.
+        self.node_rank: Dict[int, int] = {}
 
     # position helpers (reference ClusterZone.hpp node_pos* )
     def node_pos(self, node_id: int) -> int:
@@ -59,21 +80,34 @@ class ClusterZone(NetZoneImpl):
     def add_private_link(self, position: int, link_up, link_down) -> None:
         self.private_links[position] = (link_up, link_down)
 
+    def create_links_for_node(self, cluster_name: str, node_id, rank: int,
+                              position: int, sharing: str, bw: float,
+                              lat: float) -> None:
+        """Default flat-cluster node links: one private (possibly
+        split-duplex) link per node (ClusterZone::create_links_for_node +
+        sg_platf_new_link's _UP/_DOWN split, sg_platf.cpp:132-134)."""
+        link_up, link_down = make_duplex_link(
+            self.engine, f"{cluster_name}_link_{node_id}", bw, lat, sharing)
+        self.add_private_link(position, link_up, link_down)
+
     def get_local_route(self, src: NetPoint, dst: NetPoint, route,
                         latency) -> None:
         assert self.private_links, \
             "Cluster routing: no links attached to the source node"
         if src.id == dst.id and self.has_loopback:
             if not src.is_router():
-                up, _ = self.private_links[self.node_pos(src.id)]
+                up, _ = self.private_links[
+                    self.node_pos(self.node_rank[src.id])]
                 self._add_link_latency(route.links, up, latency)
             return
 
         if not src.is_router():
+            rank = self.node_rank[src.id]
             if self.has_limiter:
-                up, _ = self.private_links[self.node_pos_with_loopback(src.id)]
+                up, _ = self.private_links[self.node_pos_with_loopback(rank)]
                 route.links.append(up)
-            up, _ = self.private_links[self.node_pos_with_loopback_limiter(src.id)]
+            up, _ = self.private_links[
+                self.node_pos_with_loopback_limiter(rank)]
             if up is not None:
                 self._add_link_latency(route.links, up, latency)
 
@@ -81,11 +115,13 @@ class ClusterZone(NetZoneImpl):
             self._add_link_latency(route.links, self.backbone, latency)
 
         if not dst.is_router():
-            _, down = self.private_links[self.node_pos_with_loopback_limiter(dst.id)]
+            rank = self.node_rank[dst.id]
+            _, down = self.private_links[
+                self.node_pos_with_loopback_limiter(rank)]
             if down is not None:
                 self._add_link_latency(route.links, down, latency)
             if self.has_limiter:
-                up, _ = self.private_links[self.node_pos_with_loopback(dst.id)]
+                up, _ = self.private_links[self.node_pos_with_loopback(rank)]
                 route.links.append(up)
 
 
@@ -138,7 +174,11 @@ def parse_cluster_tag(loader, elem, father) -> None:
         zone.has_loopback = True
     if limiter_link:
         zone.has_limiter = True
-    zone.num_links_per_node = 1 + (1 if zone.has_loopback else 0) + \
+        zone.limiter_bw = parse_bandwidth(limiter_link)
+    # Topology zones preset their own per-node link count (e.g. torus:
+    # one per dimension); loopback/limiter slots add to it (sg_platf.cpp:
+    # 174-182 ordering).
+    zone.num_links_per_node += (1 if zone.has_loopback else 0) + \
         (1 if zone.has_limiter else 0)
 
     ids = parse_radical(radical)
@@ -147,41 +187,27 @@ def parse_cluster_tag(loader, elem, father) -> None:
         host = Host(engine, host_name)
         host.netpoint = NetPoint(engine, host_name, NetPointType.HOST, zone)
         engine.cpu_model.create_cpu(host, speed_list, core)
-        position = zone.node_pos(host.netpoint.id)
+        zone.node_rank[host.netpoint.id] = rank
 
         if zone.has_loopback:
             lb = engine.network_model.create_link(
                 f"{name}_link_{node_id}_loopback",
                 parse_bandwidth(loopback_bw), parse_time(loopback_lat),
                 SharingPolicy.FATPIPE)
-            zone.add_private_link(zone.node_pos(host.netpoint.id), lb, lb)
+            zone.add_private_link(zone.node_pos(rank), lb, lb)
 
         if zone.has_limiter:
             lim = engine.network_model.create_link(
                 f"{name}_link_{node_id}_limiter",
-                parse_bandwidth(limiter_link), 0.0, SharingPolicy.SHARED)
-            zone.add_private_link(zone.node_pos_with_loopback(host.netpoint.id),
+                zone.limiter_bw, 0.0, SharingPolicy.SHARED)
+            zone.add_private_link(zone.node_pos_with_loopback(rank),
                                   lim, lim)
-
-        link_id = f"{name}_link_{node_id}"
-        if sharing_policy == "SPLITDUPLEX":
-            # Two directed links per node (ClusterZone::create_links_for_node
-            # + sg_platf_new_link's _UP/_DOWN split, sg_platf.cpp:132-134).
-            link_up = engine.network_model.create_link(
-                f"{link_id}_UP", bw_value, lat_value, SharingPolicy.SHARED)
-            link_down = engine.network_model.create_link(
-                f"{link_id}_DOWN", bw_value, lat_value, SharingPolicy.SHARED)
-        else:
-            link_up = link_down = engine.network_model.create_link(
-                link_id, bw_value, lat_value,
-                SharingPolicy.FATPIPE if sharing_policy == "FATPIPE"
-                else SharingPolicy.SHARED)
-        zone.add_private_link(
-            zone.node_pos_with_loopback_limiter(host.netpoint.id),
-            link_up, link_down)
 
         if hasattr(zone, "add_processing_node"):
             zone.add_processing_node(host.netpoint, rank)
+        zone.create_links_for_node(
+            name, node_id, rank, zone.node_pos_with_loopback_limiter(rank),
+            sharing_policy, bw_value, lat_value)
 
     # cluster router (for inter-zone routing)
     router_name = elem.get("router_id") or f"{prefix}{name}_router{suffix}"
