@@ -1,0 +1,36 @@
+"""SMPI-equivalent: an MPI implementation running on simulated actors.
+
+The reference runs unmodified MPI C/Fortran binaries inside the simulator
+(src/smpi/, 44k LoC).  The tpu-native rebuild keeps the *simulation*
+semantics — eager/rendezvous protocol selection, injected o/Os/Or
+overheads, the collective-algorithm library and its selectors, trace
+replay — behind an mpi4py-style Python API: ranks are actors of the
+deterministic kernel, payloads are numpy arrays, and per-rank "global
+variable privatization" is free because each rank is its own actor
+(reference smpi_global.cpp:540-608's mmap/dlopen machinery has no
+Python analog to need).
+"""
+
+from .datatype import (Datatype, MPI_BYTE, MPI_CHAR, MPI_INT, MPI_LONG,
+                       MPI_FLOAT, MPI_DOUBLE, MPI_DOUBLE_INT, MPI_UNSIGNED,
+                       MPI_UNSIGNED_LONG, MPI_SHORT)
+from .op import (Op, MPI_SUM, MPI_MAX, MPI_MIN, MPI_PROD, MPI_LAND, MPI_LOR,
+                 MPI_BAND, MPI_BOR, MPI_BXOR, MPI_MAXLOC, MPI_MINLOC)
+from .group import Group
+from .comm import Comm
+from .request import (Request, MPI_ANY_SOURCE, MPI_ANY_TAG, Status,
+                      MPI_REQUEST_NULL)
+from .runtime import (smpirun, smpi_main, this_rank, COMM_WORLD,
+                      smpi_execute, smpi_execute_flops, wtime)
+
+__all__ = [
+    "Datatype", "MPI_BYTE", "MPI_CHAR", "MPI_INT", "MPI_LONG", "MPI_FLOAT",
+    "MPI_DOUBLE", "MPI_DOUBLE_INT", "MPI_UNSIGNED", "MPI_UNSIGNED_LONG",
+    "MPI_SHORT",
+    "Op", "MPI_SUM", "MPI_MAX", "MPI_MIN", "MPI_PROD", "MPI_LAND", "MPI_LOR",
+    "MPI_BAND", "MPI_BOR", "MPI_BXOR", "MPI_MAXLOC", "MPI_MINLOC",
+    "Group", "Comm", "Request", "Status", "MPI_ANY_SOURCE", "MPI_ANY_TAG",
+    "MPI_REQUEST_NULL",
+    "smpirun", "smpi_main", "this_rank", "COMM_WORLD", "smpi_execute",
+    "smpi_execute_flops", "wtime",
+]

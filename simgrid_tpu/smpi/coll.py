@@ -1,0 +1,451 @@
+"""SMPI collective algorithms + selector (reference src/smpi/colls/).
+
+Each operation has a registry of named algorithms; the active one is
+chosen by ``--cfg=smpi/<op>:<name>`` with ``default`` mirroring the
+reference's default selector choices (smpi_default_selector.cpp):
+binomial-tree bcast, linear barrier/gather/scatter/allgather,
+reduce+bcast allreduce, size-staged OpenMPI-style alltoall, chained
+scan.  All algorithms decompose into Request send/recv pairs, so the
+eager/rendezvous protocol, detached sends and o/Os/Or overheads apply
+exactly as they do to user point-to-point traffic.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+from ..utils.config import config
+from .datatype import payload_size
+from .op import Op
+
+# Collective tags (negative, outside the user range, one per op family —
+# reference smpi/include/private.hpp COLL_TAG_*)
+TAG_BCAST = -10
+TAG_BARRIER = -11
+TAG_REDUCE = -12
+TAG_ALLREDUCE = -13
+TAG_ALLTOALL = -14
+TAG_GATHER = -15
+TAG_ALLGATHER = -16
+TAG_SCATTER = -17
+TAG_REDUCE_SCATTER = -18
+TAG_SCAN = -19
+
+_ALGOS: Dict[str, Dict[str, Callable]] = {}
+
+
+def register(op: str, name: str):
+    def deco(fn):
+        _ALGOS.setdefault(op, {})[name] = fn
+        return fn
+    return deco
+
+
+def dispatch(op: str) -> Callable:
+    name = config[f"smpi/{op}"]
+    algos = _ALGOS[op]
+    if name not in algos:
+        raise ValueError(
+            f"Unknown {op} algorithm {name!r}; known: {sorted(algos)}")
+    return algos[name]
+
+
+# ---------------------------------------------------------------------------
+# bcast
+# ---------------------------------------------------------------------------
+
+@register("bcast", "default")
+@register("bcast", "binomial_tree")
+def bcast_binomial_tree(comm, obj, root: int = 0):
+    """Binomial tree broadcast (colls/bcast/bcast-binomial-tree.cpp)."""
+    rank, size = comm.rank(), comm.size()
+    relrank = (rank - root + size) % size
+    mask = 1
+    while mask < size:
+        if relrank & mask:
+            obj = comm.recv((rank - mask + size) % size, TAG_BCAST)
+            break
+        mask <<= 1
+    mask >>= 1
+    while mask > 0:
+        if relrank + mask < size:
+            comm.send(obj, (rank + mask) % size, TAG_BCAST)
+        mask >>= 1
+    return obj
+
+
+@register("bcast", "flat_tree")
+def bcast_flat_tree(comm, obj, root: int = 0):
+    """Root sends to everyone (colls/bcast/bcast-flat-tree.cpp)."""
+    rank, size = comm.rank(), comm.size()
+    if rank == root:
+        reqs = [comm.isend(obj, dst, TAG_BCAST)
+                for dst in range(size) if dst != root]
+        for r in reqs:
+            r.wait()
+        return obj
+    return comm.recv(root, TAG_BCAST)
+
+
+# ---------------------------------------------------------------------------
+# barrier
+# ---------------------------------------------------------------------------
+
+@register("barrier", "default")
+@register("barrier", "ompi_basic_linear")
+def barrier_linear(comm):
+    """All ranks report to 0, 0 releases all (barrier-ompi.cpp
+    basic_linear)."""
+    rank, size = comm.rank(), comm.size()
+    if size == 1:
+        return
+    if rank == 0:
+        for _ in range(size - 1):
+            comm.recv(tag=TAG_BARRIER)
+        reqs = [comm.isend(b"", dst, TAG_BARRIER) for dst in range(1, size)]
+        for r in reqs:
+            r.wait()
+    else:
+        comm.send(b"", 0, TAG_BARRIER)
+        comm.recv(0, TAG_BARRIER)
+
+
+@register("barrier", "bruck")
+def barrier_bruck(comm):
+    """log2(n) rounds of shifted token exchange (barrier-bruck.cpp)."""
+    rank, size = comm.rank(), comm.size()
+    distance = 1
+    while distance < size:
+        to = (rank + distance) % size
+        frm = (rank - distance + size) % size
+        comm.sendrecv(b"", to, frm, TAG_BARRIER, TAG_BARRIER)
+        distance <<= 1
+
+
+# ---------------------------------------------------------------------------
+# reduce
+# ---------------------------------------------------------------------------
+
+@register("reduce", "default")
+@register("reduce", "binomial")
+def reduce_binomial(comm, sendobj, op: Op, root: int = 0):
+    """Binomial-tree reduction (colls/reduce/reduce-binomial.cpp);
+    falls back to the order-preserving linear algorithm for
+    non-commutative ops like the reference default selector."""
+    if not op.is_commutative():
+        return reduce_linear(comm, sendobj, op, root)
+    rank, size = comm.rank(), comm.size()
+    relrank = (rank - root + size) % size
+    result = sendobj
+    mask = 1
+    while mask < size:
+        if relrank & mask:
+            comm.send(result, (relrank - mask + root) % size, TAG_REDUCE)
+            break
+        peer_rel = relrank | mask
+        if peer_rel < size:
+            data = comm.recv((peer_rel + root) % size, TAG_REDUCE)
+            result = op(result, data)
+        mask <<= 1
+    return result if rank == root else None
+
+
+@register("reduce", "linear")
+def reduce_linear(comm, sendobj, op: Op, root: int = 0):
+    """Root receives from everyone in rank order and folds right-to-left
+    so non-commutative ops see MPI's canonical ordering
+    (reduce-ompi.cpp basic_linear)."""
+    rank, size = comm.rank(), comm.size()
+    if rank != root:
+        comm.send(sendobj, root, TAG_REDUCE)
+        return None
+    parts = [None] * size
+    parts[root] = sendobj
+    for src in range(size):
+        if src != root:
+            parts[src] = comm.recv(src, TAG_REDUCE)
+    result = parts[size - 1]
+    for i in range(size - 2, -1, -1):
+        result = op(parts[i], result)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# allreduce
+# ---------------------------------------------------------------------------
+
+@register("allreduce", "default")
+@register("allreduce", "redbcast")
+def allreduce_redbcast(comm, sendobj, op: Op):
+    """reduce to 0 + bcast (the reference default,
+    smpi_default_selector.cpp Coll_allreduce_default)."""
+    result = dispatch("reduce")(comm, sendobj, op, 0)
+    return dispatch("bcast")(comm, result, 0)
+
+
+@register("allreduce", "rdb")
+def allreduce_rdb(comm, sendobj, op: Op):
+    """Recursive doubling with non-power-of-two fold-in
+    (colls/allreduce/allreduce-rdb.cpp)."""
+    rank, size = comm.rank(), comm.size()
+    pof2 = 1
+    while pof2 * 2 <= size:
+        pof2 *= 2
+    rem = size - pof2
+    result = sendobj
+
+    if rank < 2 * rem:
+        if rank % 2 == 0:
+            comm.send(result, rank + 1, TAG_ALLREDUCE)
+            newrank = -1
+        else:
+            data = comm.recv(rank - 1, TAG_ALLREDUCE)
+            result = op(data, result)
+            newrank = rank // 2
+    else:
+        newrank = rank - rem
+
+    if newrank >= 0:
+        mask = 1
+        while mask < pof2:
+            peer_new = newrank ^ mask
+            peer = peer_new * 2 + 1 if peer_new < rem else peer_new + rem
+            data = comm.sendrecv(result, peer, peer,
+                                 TAG_ALLREDUCE, TAG_ALLREDUCE)
+            result = op(data, result) if peer < rank else op(result, data)
+            mask <<= 1
+
+    if rank < 2 * rem:
+        if rank % 2:
+            comm.send(result, rank - 1, TAG_ALLREDUCE)
+        else:
+            result = comm.recv(rank + 1, TAG_ALLREDUCE)
+    return result
+
+
+@register("allreduce", "lr")
+def allreduce_lr(comm, sendobj, op: Op):
+    """Ring (logical ring reduce-scatter + allgather) over object chunks
+    (colls/allreduce/allreduce-lr.cpp structure).  Works on any payload
+    by treating the whole object as one chunk per rank when it is not a
+    numpy array divisible into size chunks."""
+    import numpy as np
+    rank, size = comm.rank(), comm.size()
+    if not (isinstance(sendobj, np.ndarray) and len(sendobj) >= size):
+        return allreduce_rdb(comm, sendobj, op)
+    chunks = np.array_split(sendobj.copy(), size)
+    # reduce-scatter phase
+    for step in range(size - 1):
+        send_idx = (rank - step + size) % size
+        recv_idx = (rank - step - 1 + size) % size
+        data = comm.sendrecv(chunks[send_idx], (rank + 1) % size,
+                             (rank - 1 + size) % size,
+                             TAG_ALLREDUCE, TAG_ALLREDUCE)
+        chunks[recv_idx] = op(data, chunks[recv_idx])
+    # allgather phase
+    for step in range(size - 1):
+        send_idx = (rank + 1 - step + size) % size
+        recv_idx = (rank - step + size) % size
+        chunks[recv_idx] = comm.sendrecv(chunks[send_idx],
+                                         (rank + 1) % size,
+                                         (rank - 1 + size) % size,
+                                         TAG_ALLREDUCE, TAG_ALLREDUCE)
+    return np.concatenate(chunks)
+
+
+# ---------------------------------------------------------------------------
+# gather / allgather / scatter
+# ---------------------------------------------------------------------------
+
+@register("gather", "default")
+@register("gather", "linear")
+def gather_linear(comm, sendobj, root: int = 0):
+    rank, size = comm.rank(), comm.size()
+    if rank != root:
+        comm.send(sendobj, root, TAG_GATHER)
+        return None
+    out = [None] * size
+    out[root] = sendobj
+    reqs = [(src, comm.irecv(src, TAG_GATHER))
+            for src in range(size) if src != root]
+    for src, req in reqs:
+        out[src] = req.wait()
+    return out
+
+
+@register("allgather", "default")
+@register("allgather", "linear")
+def allgather_linear(comm, sendobj):
+    """Everyone isends to everyone (the NBC linear scheme the reference
+    default selector uses via iallgather)."""
+    rank, size = comm.rank(), comm.size()
+    out = [None] * size
+    out[rank] = sendobj
+    rreqs = [(src, comm.irecv(src, TAG_ALLGATHER))
+             for src in range(size) if src != rank]
+    sreqs = [comm.isend(sendobj, dst, TAG_ALLGATHER)
+             for dst in range(size) if dst != rank]
+    for src, req in rreqs:
+        out[src] = req.wait()
+    for req in sreqs:
+        req.wait()
+    return out
+
+
+@register("allgather", "ring")
+def allgather_ring(comm, sendobj):
+    rank, size = comm.rank(), comm.size()
+    out = [None] * size
+    out[rank] = sendobj
+    right = (rank + 1) % size
+    left = (rank - 1 + size) % size
+    block = sendobj
+    for step in range(size - 1):
+        block = comm.sendrecv(block, right, left,
+                              TAG_ALLGATHER, TAG_ALLGATHER)
+        out[(rank - step - 1 + size) % size] = block
+    return out
+
+
+@register("allgather", "rdb")
+def allgather_rdb(comm, sendobj):
+    """Recursive doubling (power-of-two comms; falls back to linear)."""
+    rank, size = comm.rank(), comm.size()
+    if size & (size - 1):
+        return allgather_linear(comm, sendobj)
+    have = {rank: sendobj}
+    mask = 1
+    while mask < size:
+        peer = rank ^ mask
+        # ship a snapshot: the live dict is mutated below while the
+        # message is conceptually still in flight
+        got = comm.sendrecv(dict(have), peer, peer,
+                            TAG_ALLGATHER, TAG_ALLGATHER)
+        have.update(got)
+        mask <<= 1
+    return [have[i] for i in range(size)]
+
+
+@register("scatter", "default")
+@register("scatter", "linear")
+def scatter_linear(comm, sendobjs, root: int = 0):
+    rank, size = comm.rank(), comm.size()
+    if rank == root:
+        assert sendobjs is not None and len(sendobjs) == size
+        reqs = [comm.isend(sendobjs[dst], dst, TAG_SCATTER)
+                for dst in range(size) if dst != root]
+        for req in reqs:
+            req.wait()
+        return sendobjs[root]
+    return comm.recv(root, TAG_SCATTER)
+
+
+# ---------------------------------------------------------------------------
+# alltoall
+# ---------------------------------------------------------------------------
+
+@register("alltoall", "basic_linear")
+def alltoall_basic_linear(comm, sendobjs):
+    """Post everything at once (alltoall-basic-linear.cpp)."""
+    rank, size = comm.rank(), comm.size()
+    out = [None] * size
+    out[rank] = sendobjs[rank]
+    rreqs = [(src, comm.irecv(src, TAG_ALLTOALL))
+             for src in range(size) if src != rank]
+    sreqs = [comm.isend(sendobjs[dst], dst, TAG_ALLTOALL)
+             for dst in range(size) if dst != rank]
+    for src, req in rreqs:
+        out[src] = req.wait()
+    for req in sreqs:
+        req.wait()
+    return out
+
+
+@register("alltoall", "pairwise")
+def alltoall_pairwise(comm, sendobjs):
+    """size-1 sendrecv steps with XOR/shift partners
+    (alltoall-pair.cpp)."""
+    rank, size = comm.rank(), comm.size()
+    out = [None] * size
+    out[rank] = sendobjs[rank]
+    for step in range(1, size):
+        dst = (rank + step) % size
+        src = (rank - step + size) % size
+        out[src] = comm.sendrecv(sendobjs[dst], dst, src,
+                                 TAG_ALLTOALL, TAG_ALLTOALL)
+    return out
+
+
+@register("alltoall", "bruck")
+def alltoall_bruck(comm, sendobjs):
+    """log2(n) rounds shipping combined blocks (alltoall-bruck.cpp)."""
+    rank, size = comm.rank(), comm.size()
+    # local rotation: block for destination (rank+i)%size at slot i
+    blocks = [sendobjs[(rank + i) % size] for i in range(size)]
+    pof2 = 1
+    while pof2 < size:
+        to = (rank + pof2) % size
+        frm = (rank - pof2 + size) % size
+        idx = [i for i in range(size) if i & pof2]
+        packed = {i: blocks[i] for i in idx}
+        got = comm.sendrecv(packed, to, frm, TAG_ALLTOALL, TAG_ALLTOALL)
+        for i, blk in got.items():
+            blocks[i] = blk
+        pof2 <<= 1
+    # inverse rotation: what I now hold at slot i came from (rank-i)%size
+    out = [None] * size
+    for i in range(size):
+        out[(rank - i + size) % size] = blocks[i]
+    return out
+
+
+@register("alltoall", "default")
+@register("alltoall", "ompi")
+def alltoall_ompi(comm, sendobjs):
+    """OpenMPI-style size staging (coll_tuned_alltoall: bruck for tiny
+    blocks on big comms, linear for mid, pairwise for large)."""
+    size = comm.size()
+    block = max(payload_size(b, None) for b in sendobjs) if sendobjs else 0
+    if size >= 12 and block <= 200:
+        return alltoall_bruck(comm, sendobjs)
+    if block <= 3000:
+        return alltoall_basic_linear(comm, sendobjs)
+    return alltoall_pairwise(comm, sendobjs)
+
+
+# ---------------------------------------------------------------------------
+# reduce_scatter / scan
+# ---------------------------------------------------------------------------
+
+@register("reduce_scatter", "default")
+def reduce_scatter_default(comm, sendobjs, op: Op):
+    """reduce to 0 then scatter (smpi_default_selector.cpp)."""
+    reduced = dispatch("reduce")(comm, sendobjs, _ListwiseOp(op), 0)
+    return dispatch("scatter")(comm, reduced, 0)
+
+
+class _ListwiseOp(Op):
+    """Lift an element op to per-slot application over rank-indexed
+    lists (for reduce_scatter's reduce phase)."""
+
+    def __init__(self, op: Op):
+        super().__init__(None, f"listwise({op.name})", op.commutative)
+        self._op = op
+
+    def __call__(self, a, b):
+        return [self._op(x, y) for x, y in zip(a, b)]
+
+
+@register("scan", "default")
+@register("scan", "linear")
+def scan_linear(comm, sendobj, op: Op):
+    """Chained prefix reduction: recv partial from rank-1, combine,
+    forward to rank+1."""
+    rank, size = comm.rank(), comm.size()
+    result = sendobj
+    if rank > 0:
+        partial = comm.recv(rank - 1, TAG_SCAN)
+        result = op(partial, result)
+    if rank < size - 1:
+        comm.send(result, rank + 1, TAG_SCAN)
+    return result

@@ -1,0 +1,167 @@
+"""MPI communicators (reference src/smpi/mpi/smpi_comm.cpp) with an
+mpi4py-flavored API: p2p entry points build Requests on the eager/
+rendezvous engine, collectives dispatch through the algorithm selector
+(coll.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .datatype import Datatype, payload_size
+from .group import Group
+from .op import MPI_SUM, Op
+from .request import MPI_ANY_SOURCE, MPI_ANY_TAG, Request, Status
+
+
+class Comm:
+    """Communicator ids must be equal across ranks for "the same"
+    communicator even though every rank builds its own Python object (all
+    ranks share one process in simulation): ids are deterministic tuples
+    (parent id, per-rank creation sequence on that parent, discriminator),
+    relying on MPI's rule that communicator-creating calls are collective
+    and issued in the same order everywhere."""
+
+    def __init__(self, group: Group, id=None):
+        self.group = group
+        self.id = id if id is not None else "world"
+        self._cc_seq: Dict[int, int] = {}
+
+    def _next_cc_id(self, discriminator):
+        from . import runtime
+        me = runtime.this_rank()
+        seq = self._cc_seq.get(me, 0)
+        self._cc_seq[me] = seq + 1
+        return (self.id, seq, discriminator)
+
+    # -- introspection -----------------------------------------------------
+    def rank(self) -> int:
+        from . import runtime
+        return self.group.rank(runtime.this_rank())
+
+    def size(self) -> int:
+        return self.group.size()
+
+    def world_rank_of(self, group_rank: int) -> int:
+        return self.group.actor(group_rank)
+
+    def get_group(self) -> Group:
+        return self.group
+
+    # -- communicator management ------------------------------------------
+    def dup(self) -> "Comm":
+        return Comm(Group(list(self.group.world_ranks)),
+                    self._next_cc_id("dup"))
+
+    def create(self, group: Group) -> Optional["Comm"]:
+        new = Comm(group, self._next_cc_id(tuple(group.world_ranks)))
+        return new if group.rank(self.group.actor(self.rank())) >= 0 else None
+
+    def split(self, color: int, key: int) -> Optional["Comm"]:
+        """Collective over the communicator (smpi_comm.cpp::split)."""
+        me = self.rank()
+        mine = (color, key, me)
+        all_triples = self.allgather(mine)
+        new_id = self._next_cc_id(("split", color))
+        if color < 0:
+            return None
+        members = sorted((k, r) for c, k, r in all_triples if c == color)
+        return Comm(Group([self.group.actor(r) for _, r in members]), new_id)
+
+    # -- point-to-point ----------------------------------------------------
+    def send(self, buf, dest: int, tag: int = 0,
+             count: Optional[int] = None,
+             datatype: Optional[Datatype] = None) -> None:
+        req = Request("send", buf, 1 if count is None else count, datatype, dest, tag, self)
+        req.start()
+        req.wait()
+
+    def ssend(self, buf, dest: int, tag: int = 0,
+              count: Optional[int] = None,
+              datatype: Optional[Datatype] = None) -> None:
+        req = Request("send", buf, 1 if count is None else count, datatype, dest, tag, self,
+                      ssend=True)
+        req.start()
+        req.wait()
+
+    def isend(self, buf, dest: int, tag: int = 0,
+              count: Optional[int] = None,
+              datatype: Optional[Datatype] = None) -> Request:
+        req = Request("send", buf, 1 if count is None else count, datatype, dest, tag, self,
+                      is_isend=True)
+        return req.start()
+
+    def recv(self, source: int = MPI_ANY_SOURCE, tag: int = MPI_ANY_TAG,
+             buf=None, count: Optional[int] = None,
+             datatype: Optional[Datatype] = None,
+             status: Optional[Status] = None) -> Any:
+        req = Request("recv", buf, 1 if count is None else count, datatype, source, tag, self)
+        req.start()
+        return req.wait(status)
+
+    def irecv(self, source: int = MPI_ANY_SOURCE, tag: int = MPI_ANY_TAG,
+              buf=None, count: Optional[int] = None,
+              datatype: Optional[Datatype] = None) -> Request:
+        req = Request("recv", buf, 1 if count is None else count, datatype, source, tag, self)
+        return req.start()
+
+    def sendrecv(self, sendbuf, dest: int, recvsource: int,
+                 sendtag: int = 0, recvtag: int = MPI_ANY_TAG,
+                 status: Optional[Status] = None) -> Any:
+        rreq = self.irecv(recvsource, recvtag)
+        sreq = self.isend(sendbuf, dest, sendtag)
+        data = rreq.wait(status)
+        sreq.wait()
+        return data
+
+    def iprobe(self, source: int = MPI_ANY_SOURCE,
+               tag: int = MPI_ANY_TAG) -> bool:
+        from . import runtime
+        from .request import match_recv
+        probe = Request("recv", None, 1, None, source, tag, self)
+        me = runtime.this_rank_state()
+        return (me.mailbox_small.iprobe(False, match_recv, probe) is not None
+                or me.mailbox.iprobe(False, match_recv, probe) is not None)
+
+    # -- collectives (dispatch through the selector) -----------------------
+    def barrier(self) -> None:
+        from . import coll
+        coll.dispatch("barrier")(self)
+
+    def bcast(self, obj, root: int = 0):
+        from . import coll
+        return coll.dispatch("bcast")(self, obj, root)
+
+    def reduce(self, sendobj, op: Op = MPI_SUM, root: int = 0):
+        from . import coll
+        return coll.dispatch("reduce")(self, sendobj, op, root)
+
+    def allreduce(self, sendobj, op: Op = MPI_SUM):
+        from . import coll
+        return coll.dispatch("allreduce")(self, sendobj, op)
+
+    def gather(self, sendobj, root: int = 0):
+        from . import coll
+        return coll.dispatch("gather")(self, sendobj, root)
+
+    def allgather(self, sendobj) -> List:
+        from . import coll
+        return coll.dispatch("allgather")(self, sendobj)
+
+    def scatter(self, sendobjs: Optional[List], root: int = 0):
+        from . import coll
+        return coll.dispatch("scatter")(self, sendobjs, root)
+
+    def alltoall(self, sendobjs: List) -> List:
+        from . import coll
+        return coll.dispatch("alltoall")(self, sendobjs)
+
+    def reduce_scatter(self, sendobjs: List, op: Op = MPI_SUM):
+        from . import coll
+        return coll.dispatch("reduce_scatter")(self, sendobjs, op)
+
+    def scan(self, sendobj, op: Op = MPI_SUM):
+        from . import coll
+        return coll.dispatch("scan")(self, sendobj, op)
+
+    def __repr__(self):
+        return f"<Comm id={self.id} size={self.size()}>"

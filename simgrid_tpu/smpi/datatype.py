@@ -1,0 +1,102 @@
+"""MPI datatypes (reference src/smpi/mpi/smpi_datatype.cpp).
+
+A datatype carries its wire size (what the network model charges) and,
+when it maps to a numpy dtype, the element type used by reduction ops.
+Derived types (contiguous/vector/indexed/struct) compute their size and
+extent like the reference; data movement itself ships whole Python
+payloads, so pack/unpack layout juggling is unnecessary in simulation.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class Datatype:
+    def __init__(self, size: int, np_dtype=None, name: str = "",
+                 extent: Optional[int] = None):
+        self.size_ = size          # bytes per element on the wire
+        self.np_dtype = np_dtype
+        self.name = name
+        self.extent_ = extent if extent is not None else size
+        self.committed = False
+
+    def size(self) -> int:
+        return self.size_
+
+    def extent(self) -> int:
+        return self.extent_
+
+    def commit(self) -> "Datatype":
+        self.committed = True
+        return self
+
+    def dup(self) -> "Datatype":
+        return Datatype(self.size_, self.np_dtype, self.name, self.extent_)
+
+    def __repr__(self):
+        return f"<Datatype {self.name or self.size_}B>"
+
+    # -- derived constructors (smpi_datatype.cpp create_*) ----------------
+    @staticmethod
+    def create_contiguous(count: int, base: "Datatype") -> "Datatype":
+        return Datatype(count * base.size_, base.np_dtype,
+                        f"contig({count},{base.name})",
+                        count * base.extent_)
+
+    @staticmethod
+    def create_vector(count: int, blocklen: int, stride: int,
+                      base: "Datatype") -> "Datatype":
+        size = count * blocklen * base.size_
+        extent = ((count - 1) * stride + blocklen) * base.extent_
+        return Datatype(size, base.np_dtype,
+                        f"vector({count},{blocklen},{stride})", extent)
+
+    @staticmethod
+    def create_indexed(blocklens: List[int], displs: List[int],
+                       base: "Datatype") -> "Datatype":
+        size = sum(blocklens) * base.size_
+        extent = (max((d + b) for d, b in zip(displs, blocklens))
+                  * base.extent_) if blocklens else 0
+        return Datatype(size, base.np_dtype, "indexed", extent)
+
+    @staticmethod
+    def create_struct(blocklens: List[int], displs: List[int],
+                      types: List["Datatype"]) -> "Datatype":
+        size = sum(b * t.size_ for b, t in zip(blocklens, types))
+        extent = max((d + b * t.extent_)
+                     for d, b, t in zip(displs, blocklens, types)) \
+            if blocklens else 0
+        return Datatype(size, None, "struct", extent)
+
+
+MPI_BYTE = Datatype(1, np.uint8, "MPI_BYTE")
+MPI_CHAR = Datatype(1, np.int8, "MPI_CHAR")
+MPI_SHORT = Datatype(2, np.int16, "MPI_SHORT")
+MPI_INT = Datatype(4, np.int32, "MPI_INT")
+MPI_UNSIGNED = Datatype(4, np.uint32, "MPI_UNSIGNED")
+MPI_LONG = Datatype(8, np.int64, "MPI_LONG")
+MPI_UNSIGNED_LONG = Datatype(8, np.uint64, "MPI_UNSIGNED_LONG")
+MPI_FLOAT = Datatype(4, np.float32, "MPI_FLOAT")
+MPI_DOUBLE = Datatype(8, np.float64, "MPI_DOUBLE")
+# (value, index) pairs for MAXLOC/MINLOC
+MPI_DOUBLE_INT = Datatype(12, None, "MPI_DOUBLE_INT")
+
+
+def payload_size(payload, datatype: Optional[Datatype]) -> float:
+    """Wire size of a payload: count * datatype size for arrays, or a
+    best-effort estimate for plain Python objects."""
+    if isinstance(payload, np.ndarray):
+        if datatype is not None:
+            return payload.size * datatype.size_
+        return payload.nbytes
+    if datatype is not None:
+        try:
+            return len(payload) * datatype.size_
+        except TypeError:
+            return datatype.size_
+    if isinstance(payload, (bytes, bytearray)):
+        return len(payload)
+    return 8.0

@@ -1,0 +1,58 @@
+"""MPI groups (reference src/smpi/mpi/smpi_group.cpp): an ordered set of
+world ranks with the usual set algebra."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+MPI_UNDEFINED = -32766
+
+
+class Group:
+    def __init__(self, world_ranks: List[int]):
+        self.world_ranks = list(world_ranks)
+        self._index = {w: i for i, w in enumerate(self.world_ranks)}
+
+    def size(self) -> int:
+        return len(self.world_ranks)
+
+    def rank(self, world_rank: int) -> int:
+        """Group rank of a world rank (MPI_UNDEFINED if absent)."""
+        return self._index.get(world_rank, MPI_UNDEFINED)
+
+    def actor(self, group_rank: int) -> int:
+        """World rank at position group_rank."""
+        return self.world_ranks[group_rank]
+
+    def incl(self, ranks: List[int]) -> "Group":
+        return Group([self.world_ranks[r] for r in ranks])
+
+    def excl(self, ranks: List[int]) -> "Group":
+        excluded = set(ranks)
+        return Group([w for i, w in enumerate(self.world_ranks)
+                      if i not in excluded])
+
+    def range_incl(self, ranges) -> "Group":
+        out = []
+        for first, last, stride in ranges:
+            out.extend(self.world_ranks[r] for r in
+                       range(first, last + (1 if stride > 0 else -1), stride))
+        return Group(out)
+
+    def union(self, other: "Group") -> "Group":
+        out = list(self.world_ranks)
+        seen = set(out)
+        out.extend(w for w in other.world_ranks if w not in seen)
+        return Group(out)
+
+    def intersection(self, other: "Group") -> "Group":
+        theirs = set(other.world_ranks)
+        return Group([w for w in self.world_ranks if w in theirs])
+
+    def difference(self, other: "Group") -> "Group":
+        theirs = set(other.world_ranks)
+        return Group([w for w in self.world_ranks if w not in theirs])
+
+    def translate_ranks(self, ranks: List[int],
+                        other: "Group") -> List[int]:
+        return [other.rank(self.world_ranks[r]) for r in ranks]

@@ -1,0 +1,67 @@
+"""MPI reduction operations (reference src/smpi/mpi/smpi_op.cpp) as
+numpy element-wise functions."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Op:
+    def __init__(self, fn, name: str = "", commutative: bool = True):
+        self.fn = fn
+        self.name = name
+        self.commutative = commutative
+
+    def __call__(self, a, b):
+        """Combine two buffers: returns op(a, b) element-wise, numpy-aware."""
+        return self.fn(a, b)
+
+    def is_commutative(self) -> bool:
+        return self.commutative
+
+    def __repr__(self):
+        return f"<Op {self.name}>"
+
+
+def _pairwise(np_fn, py_fn):
+    def fn(a, b):
+        if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+            return np_fn(a, b)
+        if isinstance(a, (list, tuple)):
+            return type(a)(py_fn(x, y) for x, y in zip(a, b))
+        return py_fn(a, b)
+    return fn
+
+
+MPI_SUM = Op(_pairwise(np.add, lambda x, y: x + y), "MPI_SUM")
+MPI_PROD = Op(_pairwise(np.multiply, lambda x, y: x * y), "MPI_PROD")
+MPI_MAX = Op(_pairwise(np.maximum, max), "MPI_MAX")
+MPI_MIN = Op(_pairwise(np.minimum, min), "MPI_MIN")
+MPI_LAND = Op(_pairwise(np.logical_and, lambda x, y: bool(x) and bool(y)),
+              "MPI_LAND")
+MPI_LOR = Op(_pairwise(np.logical_or, lambda x, y: bool(x) or bool(y)),
+             "MPI_LOR")
+MPI_BAND = Op(_pairwise(np.bitwise_and, lambda x, y: x & y), "MPI_BAND")
+MPI_BOR = Op(_pairwise(np.bitwise_or, lambda x, y: x | y), "MPI_BOR")
+MPI_BXOR = Op(_pairwise(np.bitwise_xor, lambda x, y: x ^ y), "MPI_BXOR")
+
+
+def _maxloc(a, b):
+    # operands are (value, index) pairs or arrays of them
+    if isinstance(a, np.ndarray):
+        take_b = (b[..., 0] > a[..., 0]) | ((b[..., 0] == a[..., 0])
+                                            & (b[..., 1] < a[..., 1]))
+        return np.where(take_b[..., None], b, a)
+    return b if (b[0] > a[0] or (b[0] == a[0] and b[1] < a[1])) else a
+
+
+def _minloc(a, b):
+    if isinstance(a, np.ndarray):
+        take_b = (b[..., 0] < a[..., 0]) | ((b[..., 0] == a[..., 0])
+                                            & (b[..., 1] < a[..., 1]))
+        return np.where(take_b[..., None], b, a)
+    return b if (b[0] < a[0] or (b[0] == a[0] and b[1] < a[1])) else a
+
+
+MPI_MAXLOC = Op(_maxloc, "MPI_MAXLOC")
+MPI_MINLOC = Op(_minloc, "MPI_MINLOC")

@@ -1,0 +1,292 @@
+"""The SMPI point-to-point engine (reference src/smpi/mpi/smpi_request.cpp).
+
+Keeps the reference's simulation semantics:
+
+* two mailboxes per destination process — eager messages (size below
+  smpi/async-small-thresh) go to the small one, rendezvous messages to
+  the large one, with the posted-peer probing dance of Request::start()
+  (smpi_request.cpp:336-502);
+* sends below smpi/send-is-detached-thresh are detached (the sender does
+  not wait for the receiver; the payload is copied at send time);
+* injected overhead times: os/ois before (i)sends, or at receive
+  completion of a detached message (smpi_request.cpp:433-444, 853-861);
+* two-way match functions on (comm, src, tag) with MPI_ANY_SOURCE /
+  MPI_ANY_TAG wildcards (match_recv/match_send, smpi_request.cpp:60-88).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ..kernel import activity as kact
+from ..utils.config import config
+from .datatype import Datatype, payload_size
+
+MPI_ANY_SOURCE = -555
+MPI_ANY_TAG = -444
+MPI_REQUEST_NULL = None
+
+
+class Status:
+    __slots__ = ("source", "tag", "count", "cancelled")
+
+    def __init__(self):
+        self.source = MPI_ANY_SOURCE
+        self.tag = MPI_ANY_TAG
+        self.count = 0
+        self.cancelled = False
+
+    def __repr__(self):
+        return f"<Status src={self.source} tag={self.tag} count={self.count}>"
+
+
+def _match_common(ref: "Request", req: "Request") -> bool:
+    if ref.comm_id != req.comm_id:
+        return False
+    if ref.src != MPI_ANY_SOURCE and ref.src != req.src:
+        return False
+    if ref.tag != MPI_ANY_TAG and ref.tag != req.tag:
+        return False
+    return True
+
+
+def match_recv(ref: "Request", req: "Request", _comm) -> bool:
+    """Called with ref = the receive request, req = the send request."""
+    if req is None or ref is None or ref.kind != "recv":
+        return True  # non-smpi peer: accept (reference asserts instead)
+    ok = _match_common(ref, req)
+    if ok:
+        ref.real_src = req.src
+        ref.real_tag = req.tag
+        ref.real_size = req.size
+        ref.detached_sender = req if req.detached else None
+    return ok
+
+
+def match_send(ref: "Request", req: "Request", _comm) -> bool:
+    """Called with ref = the send request, req = the receive request."""
+    if req is None or ref is None or req.kind != "recv":
+        return True
+    ok = _match_common(req, ref)
+    if ok:
+        req.real_src = ref.src
+        req.real_tag = ref.tag
+        req.real_size = ref.size
+        req.detached_sender = ref if ref.detached else None
+    return ok
+
+
+class Request:
+    """One pending point-to-point operation."""
+
+    def __init__(self, kind: str, buf, count: int,
+                 datatype: Optional[Datatype], peer: int, tag: int, comm,
+                 detached: bool = False, is_isend: bool = False,
+                 ssend: bool = False):
+        from . import runtime
+        self.kind = kind                   # "send" | "recv"
+        self.buf = buf
+        self.count = count
+        self.datatype = datatype
+        self.comm = comm
+        self.comm_id = comm.id
+        self.tag = tag
+        self.detached = detached
+        self.is_isend = is_isend
+        self.ssend = ssend
+        me = runtime.this_rank_state()
+        if kind == "send":
+            self.src = comm.rank()
+            self.dst = peer
+            self.size = (count * datatype.size() if datatype is not None
+                         else payload_size(buf, None))
+        else:
+            self.src = peer                # may be MPI_ANY_SOURCE
+            self.dst = comm.rank()
+            self.size = (count * datatype.size() if datatype is not None
+                         else float("inf"))
+        self.real_src = self.src
+        self.real_tag = tag
+        self.real_size = self.size
+        self.detached_sender: Optional["Request"] = None
+        self.pimpl: Optional[kact.CommImpl] = None
+        self._dst_slot: Optional[list] = None
+        self._me = me
+        self.finished = False
+
+    # ------------------------------------------------------------------
+    def start(self) -> "Request":
+        from . import runtime
+        from ..s4u import this_actor
+        me = self._me
+        issuer = me.actor_impl
+        thresh = config["smpi/async-small-thresh"]
+
+        if self.kind == "recv":
+            peer_state = runtime.state_of_world_rank(
+                self.comm.world_rank_of(self.dst))
+            if thresh == 0:
+                mbox = peer_state.mailbox
+            elif self.size < thresh:
+                # eager expected: look in the small mailbox first, then the
+                # large one (SSEND goes there), fall back to small
+                mbox = peer_state.mailbox_small
+                if mbox.iprobe(False, match_recv, self) is None:
+                    big = peer_state.mailbox
+                    if big.iprobe(False, match_recv, self) is not None:
+                        mbox = big
+            else:
+                mbox = peer_state.mailbox_small
+                if mbox.iprobe(False, match_recv, self) is None:
+                    mbox = peer_state.mailbox
+
+            self._dst_slot = [None]
+
+            def handler(sc):
+                sc.result = kact.comm_irecv(
+                    sc.issuer.engine, sc.issuer, mbox, self._dst_slot,
+                    match_recv, None, self, -1.0)
+                sc.issuer.simcall_answer()
+            self.pimpl = issuer.simcall("comm_irecv", handler)
+            return self
+
+        # send side
+        payload = self.buf
+        if (not self.ssend
+                and self.size < config["smpi/send-is-detached-thresh"]):
+            self.detached = True
+            if isinstance(payload, np.ndarray):
+                payload = payload.copy()
+
+        sleeptime = 0.0
+        if self.detached or self.is_isend or self.ssend:
+            sleeptime = (self._me.host_factors.oisend(self.size)
+                         if self.is_isend
+                         else self._me.host_factors.osend(self.size))
+        if sleeptime > 0.0:
+            this_actor.sleep_for(sleeptime)
+
+        peer_state = runtime.state_of_world_rank(
+            self.comm.world_rank_of(self.dst))
+        if thresh == 0:
+            mbox = peer_state.mailbox
+        elif self.size < thresh:      # eager mode
+            mbox = peer_state.mailbox
+            if mbox.iprobe(True, match_send, self) is None:
+                mbox = peer_state.mailbox_small
+                # SSEND must rendezvous: if no recv is posted on the small
+                # mailbox either, park the send in the large one
+                if self.ssend and mbox.iprobe(True, match_send, self) is None:
+                    mbox = peer_state.mailbox
+        else:
+            mbox = peer_state.mailbox
+
+        def handler(sc):
+            sc.result = kact.comm_isend(
+                sc.issuer.engine, sc.issuer, mbox, self.size, -1.0,
+                [payload], match_send, None, None, self, self.detached)
+            sc.issuer.simcall_answer()
+        self.pimpl = issuer.simcall("comm_isend", handler)
+        return self
+
+    # ------------------------------------------------------------------
+    def _finish(self, status: Optional[Status]) -> None:
+        from ..s4u import this_actor
+        if self.kind == "recv":
+            data = self._dst_slot[0] if self._dst_slot else None
+            if isinstance(self.buf, np.ndarray) and isinstance(data, np.ndarray):
+                flat = data.reshape(-1)[:self.buf.size]
+                np.copyto(self.buf.reshape(-1)[:flat.size], flat)
+            elif self.buf is None:
+                self.buf = data
+            if status is not None:
+                status.source = self.real_src
+                status.tag = self.real_tag
+                status.count = self.real_size
+            # pseudo-timing for the buffering of a detached (eager) message
+            if self.detached_sender is not None:
+                sleeptime = self._me.host_factors.orecv(self.real_size)
+                if sleeptime > 0.0:
+                    this_actor.sleep_for(sleeptime)
+        self.finished = True
+
+    def wait(self, status: Optional[Status] = None):
+        if self.finished:
+            return self._result()
+        if self.kind == "send" and self.detached:
+            self._finish(status)
+            return self._result()
+        issuer = self._me.actor_impl
+        comm_impl = self.pimpl
+
+        def handler(sc):
+            kact.comm_wait(sc, comm_impl, -1.0)
+        issuer.simcall("comm_wait", handler)
+        self._finish(status)
+        return self._result()
+
+    def test(self, status: Optional[Status] = None) -> bool:
+        if self.finished:
+            return True
+        if self.kind == "send" and self.detached:
+            self._finish(status)
+            return True
+        issuer = self._me.actor_impl
+        comm_impl = self.pimpl
+        res = issuer.simcall("comm_test",
+                             lambda sc: kact.comm_test(sc, comm_impl))
+        if res:
+            self._finish(status)
+        return bool(res)
+
+    def cancel(self) -> None:
+        if self.pimpl is not None and not self.finished:
+            issuer = self._me.actor_impl
+            comm_impl = self.pimpl
+
+            def handler(sc):
+                comm_impl.cancel()
+                sc.issuer.simcall_answer()
+            issuer.simcall("comm_cancel", handler)
+            self.finished = True
+
+    def _result(self):
+        return self.buf if self.kind == "recv" else None
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def waitall(requests: List["Request"],
+                statuses: Optional[List[Status]] = None) -> None:
+        for i, req in enumerate(requests):
+            if req is None:
+                continue
+            req.wait(statuses[i] if statuses else None)
+
+    @staticmethod
+    def waitany(requests: List["Request"],
+                status: Optional[Status] = None) -> int:
+        pending = [(i, r) for i, r in enumerate(requests)
+                   if r is not None and not r.finished]
+        if not pending:
+            return -1
+        for i, r in pending:            # completed detached sends first
+            if r.kind == "send" and r.detached:
+                r._finish(status)
+                return i
+        issuer = pending[0][1]._me.actor_impl
+        impls = [r.pimpl for _, r in pending]
+
+        def handler(sc):
+            kact.comm_waitany(sc, impls, -1.0)
+        idx = issuer.simcall("comm_waitany", handler)
+        if idx is None or idx < 0:
+            return -1
+        i, req = pending[idx]
+        req._finish(status)
+        return i
+
+    @staticmethod
+    def testall(requests: List["Request"]) -> bool:
+        return all(r is None or r.test() for r in requests)

@@ -1,0 +1,215 @@
+"""SMPI runtime: per-rank state, the smpirun launcher, bench hooks.
+
+Reference equivalents: smpi_global.cpp (smpi_main, process setup),
+smpi_actor.cpp (per-rank mailboxes), smpi_host.cpp (os/or/ois injected
+overhead tables), smpi_bench.cpp (smpi_execute / cpu-threshold).
+Per-rank global-variable privatization (smpi_global.cpp:540-608) is
+unnecessary: each rank is an actor with its own Python frame.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..utils.config import config, declare_flag
+
+declare_flag("smpi/async-small-thresh",
+             "Maximal size of messages that are to be sent asynchronously, "
+             "without waiting for the receiver", 0)
+declare_flag("smpi/send-is-detached-thresh",
+             "Threshold of message size where MPI_Send stops behaving like "
+             "MPI_Isend and becomes MPI_Ssend", 65536)
+declare_flag("smpi/host-speed",
+             "Speed of the host running the simulation (in flop/s)",
+             20000.0)
+declare_flag("smpi/cpu-threshold",
+             "Minimal computation time (in seconds) not discarded, "
+             "or -1 for infinity", 1e-6)
+declare_flag("smpi/os",
+             "Small messages timings (MPI_Send minimum time for small "
+             "messages)", "0:0:0:0:0")
+declare_flag("smpi/ois",
+             "Small messages timings (MPI_Isend minimum time for small "
+             "messages)", "0:0:0:0:0")
+declare_flag("smpi/or",
+             "Small messages timings (MPI_Recv minimum time for small "
+             "messages)", "0:0:0:0:0")
+declare_flag("smpi/coll-selector", "Which collective selector to use",
+             "default")
+for _op in ("bcast", "barrier", "reduce", "allreduce", "alltoall",
+            "allgather", "allgatherv", "gather", "scatter",
+            "reduce_scatter", "scan"):
+    declare_flag(f"smpi/{_op}",
+                 f"Which collective algorithm to use for {_op}", "default")
+
+
+def parse_factor(spec: str) -> List[Tuple[float, List[float]]]:
+    """Parse 'size:v0:v1[:..];size2:...' into sorted (threshold, values)
+    (reference smpi_utils.cpp parse_factor)."""
+    out = []
+    for part in spec.split(";"):
+        if not part:
+            continue
+        nums = [float(x) for x in part.split(":")]
+        out.append((nums[0], nums[1:] + [0.0, 0.0]))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def _piecewise(table, size: float) -> float:
+    """Reference smpi_host.cpp os/or/ois evaluation: the last section
+    whose threshold is < size wins; values are (offset, per-byte)."""
+    if not table:
+        return 0.0
+    current = table[0][1][0] + table[0][1][1] * size
+    for factor, values in table:
+        if size <= factor:
+            return current
+        current = values[0] + values[1] * size
+    return current
+
+
+class HostFactors:
+    """Per-host injected overhead tables; host properties smpi/os,
+    smpi/or, smpi/ois override the global config (smpi_host.cpp:90-120)."""
+
+    def __init__(self, host):
+        def table(key):
+            prop = None
+            if host is not None:
+                prop = host.properties.get(key) \
+                    if hasattr(host, "properties") else None
+            return parse_factor(prop if prop else config[key])
+        self._os = table("smpi/os")
+        self._or = table("smpi/or")
+        self._ois = table("smpi/ois")
+
+    def osend(self, size: float) -> float:
+        return _piecewise(self._os, size)
+
+    def orecv(self, size: float) -> float:
+        return _piecewise(self._or, size)
+
+    def oisend(self, size: float) -> float:
+        return _piecewise(self._ois, size)
+
+
+class _RankState:
+    __slots__ = ("world_rank", "actor_impl", "host", "mailbox",
+                 "mailbox_small", "host_factors")
+
+    def __init__(self, world_rank, actor_impl, host, mailbox, mailbox_small,
+                 host_factors):
+        self.world_rank = world_rank
+        self.actor_impl = actor_impl
+        self.host = host
+        self.mailbox = mailbox
+        self.mailbox_small = mailbox_small
+        self.host_factors = host_factors
+
+
+_registry: Dict[int, _RankState] = {}
+_by_world_rank: Dict[int, _RankState] = {}
+_world = None
+
+
+def this_rank_state() -> _RankState:
+    from ..s4u.actor import _current_impl
+    state = _registry.get(id(_current_impl()))
+    assert state is not None, "not inside an SMPI rank actor"
+    return state
+
+
+def this_rank() -> int:
+    return this_rank_state().world_rank
+
+
+def state_of_world_rank(rank: int) -> _RankState:
+    return _by_world_rank[rank]
+
+
+def world():
+    assert _world is not None, "SMPI world not initialized (use smpirun)"
+    return _world
+
+
+class _CommWorldProxy:
+    """Module-level COMM_WORLD handle valid inside any rank actor."""
+
+    def __getattr__(self, name):
+        return getattr(world(), name)
+
+    def __repr__(self):
+        return "<COMM_WORLD proxy>"
+
+
+COMM_WORLD = _CommWorldProxy()
+
+
+def smpi_execute_flops(flops: float) -> None:
+    from ..s4u import this_actor
+    this_actor.execute(flops)
+
+
+def smpi_execute(duration: float) -> None:
+    """Inject `duration` seconds of (benched) host compute as simulated
+    flops at smpi/host-speed, skipping below smpi/cpu-threshold
+    (smpi_bench.cpp:53-78)."""
+    threshold = config["smpi/cpu-threshold"]
+    if duration >= threshold or threshold < 0:
+        smpi_execute_flops(duration * config["smpi/host-speed"])
+
+
+def wtime() -> float:
+    from ..s4u import Engine
+    return Engine.get_clock()
+
+
+def smpi_main(fn, engine, hosts: Optional[Sequence] = None,
+              np: Optional[int] = None, args: tuple = ()) -> None:
+    """Register one actor per rank on an existing engine (reference
+    smpi_global.cpp:612-650 deployment phase)."""
+    global _world
+    from ..s4u import Actor, Mailbox
+    from .comm import Comm
+    from .group import Group
+
+    all_hosts = hosts if hosts is not None else engine.get_all_hosts()
+    assert all_hosts, "platform has no hosts"
+    n = np if np is not None else len(all_hosts)
+
+    _registry.clear()
+    _by_world_rank.clear()
+    _world = Comm(Group(list(range(n))))
+
+    def rank_main():
+        fn(*args)
+
+    # Register every rank's state before any actor runs: rank 0's first
+    # send must be able to resolve rank N's mailboxes.
+    for rank in range(n):
+        host = all_hosts[rank % len(all_hosts)]
+        actor = Actor.create(f"rank-{rank}", host, rank_main)
+        state = _RankState(rank, actor.pimpl, host,
+                           Mailbox.by_name(f"SMPI-{rank}").pimpl,
+                           Mailbox.by_name(f"SMPI-SMALL-{rank}").pimpl,
+                           HostFactors(host))
+        _registry[id(actor.pimpl)] = state
+        _by_world_rank[rank] = state
+
+
+def smpirun(fn, platform: str, np: Optional[int] = None,
+            hosts: Optional[Sequence[str]] = None,
+            configs: Sequence[str] = (), args: tuple = ()):
+    """smpirun equivalent (src/smpi/smpirun.in): build the engine, load
+    the platform, deploy `np` ranks of `fn` round-robin over the hosts,
+    run the simulation.  Returns the Engine (inspect .clock)."""
+    from ..s4u import Engine
+
+    e = Engine(["smpirun"] + [f"--cfg={c}" for c in configs])
+    e.load_platform(platform)
+    host_objs = ([e.host_by_name(h) for h in hosts] if hosts
+                 else e.get_all_hosts())
+    smpi_main(fn, e, hosts=host_objs, np=np, args=args)
+    e.run()
+    return e

@@ -1,0 +1,425 @@
+"""SMPI tests: p2p protocol semantics (eager/rendezvous, detached sends,
+injected overheads), collectives correctness across all registered
+algorithms, communicator management (reference test model:
+teshsuite/smpi/ + the MPICH3 suite's coverage areas)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from simgrid_tpu import s4u, smpi
+from simgrid_tpu.smpi import coll as coll_mod
+from simgrid_tpu.utils.config import config
+
+HERE = os.path.dirname(__file__)
+
+
+@pytest.fixture(autouse=True)
+def fresh_engine():
+    s4u.Engine._reset()
+    yield
+    s4u.Engine._reset()
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    xml = """<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <cluster id="c" prefix="node-" radical="0-7" suffix="" speed="1Gf"
+             bw="125MBps" lat="50us"/>
+  </zone>
+</platform>
+"""
+    path = os.path.join(tmp_path, "cluster8.xml")
+    with open(path, "w") as f:
+        f.write(xml)
+    return path
+
+
+def run_ranks(platform, fn, np_ranks, configs=()):
+    return smpi.smpirun(fn, platform, np=np_ranks, configs=configs)
+
+
+class TestP2P:
+    def test_send_recv_roundtrip(self, cluster):
+        res = {}
+
+        def main():
+            comm = smpi.COMM_WORLD
+            me = comm.rank()
+            if me == 0:
+                comm.send(np.arange(10.0), 1, tag=7)
+                back = comm.recv(1, 8)
+                res["back"] = back
+                res["t"] = smpi.wtime()
+            elif me == 1:
+                data = comm.recv(0, 7)
+                comm.send(data * 2, 0, tag=8)
+
+        run_ranks(cluster, main, 2)
+        np.testing.assert_array_equal(res["back"], np.arange(10.0) * 2)
+        assert res["t"] > 0
+
+    def test_any_source_and_status(self, cluster):
+        res = {}
+
+        def main():
+            comm = smpi.COMM_WORLD
+            me = comm.rank()
+            if me == 0:
+                st = smpi.Status()
+                got = comm.recv(smpi.MPI_ANY_SOURCE, smpi.MPI_ANY_TAG,
+                                status=st)
+                res["data"] = got
+                res["src"] = st.source
+                res["tag"] = st.tag
+            elif me == 2:
+                comm.send("hello", 0, tag=42)
+
+        run_ranks(cluster, main, 3)
+        assert res["data"] == "hello"
+        assert res["src"] == 2 and res["tag"] == 42
+
+    def test_detached_send_returns_before_recv_posted(self, cluster):
+        """Eager/detached: a small send completes without a matching recv
+        (send-is-detached-thresh semantics)."""
+        res = {}
+
+        def main():
+            comm = smpi.COMM_WORLD
+            me = comm.rank()
+            if me == 0:
+                comm.send(np.zeros(8), 1)      # 64B < 65536: detached
+                res["send_done_at"] = smpi.wtime()
+            else:
+                s4u.this_actor.sleep_for(5.0)  # receiver is late
+                comm.recv(0)
+                res["recv_done_at"] = smpi.wtime()
+
+        run_ranks(cluster, main, 2)
+        assert res["send_done_at"] < 1.0
+        assert res["recv_done_at"] >= 5.0
+
+    def test_rendezvous_send_waits_for_receiver(self, cluster):
+        res = {}
+
+        def main():
+            comm = smpi.COMM_WORLD
+            me = comm.rank()
+            if me == 0:
+                comm.send(np.zeros(100_000), 1)   # 800KB: rendezvous
+                res["send_done_at"] = smpi.wtime()
+            else:
+                s4u.this_actor.sleep_for(5.0)
+                comm.recv(0)
+
+        run_ranks(cluster, main, 2)
+        assert res["send_done_at"] > 5.0
+
+    def test_send_buffer_reuse_after_detached_send(self, cluster):
+        """The payload is copied at detached-send time: mutating the
+        buffer afterwards must not corrupt the message."""
+        res = {}
+
+        def main():
+            comm = smpi.COMM_WORLD
+            me = comm.rank()
+            if me == 0:
+                buf = np.ones(4)
+                comm.send(buf, 1)
+                buf[:] = -1
+            else:
+                s4u.this_actor.sleep_for(1.0)
+                res["got"] = comm.recv(0)
+
+        run_ranks(cluster, main, 2)
+        np.testing.assert_array_equal(res["got"], np.ones(4))
+
+    def test_os_or_injection(self, cluster):
+        """smpi/os and smpi/or inject constant overheads on the wire
+        timing of eager messages."""
+        times = {}
+
+        def main():
+            comm = smpi.COMM_WORLD
+            if comm.rank() == 0:
+                comm.send(np.zeros(8), 1)
+            else:
+                comm.recv(0)
+                times["t"] = smpi.wtime()
+
+        run_ranks(cluster, main, 2)
+        base = times["t"]
+
+        s4u.Engine._reset()
+        run_ranks(cluster, main, 2,
+                  configs=["smpi/os:0:0.25:0", "smpi/or:0:0.5:0"])
+        assert times["t"] == pytest.approx(base + 0.75, abs=1e-9)
+
+    def test_isend_irecv_waitany(self, cluster):
+        res = {}
+
+        def main():
+            comm = smpi.COMM_WORLD
+            me = comm.rank()
+            if me == 0:
+                reqs = [comm.irecv(src, 1) for src in (1, 2)]
+                first = smpi.Request.waitany(reqs)
+                assert first in (0, 1)
+                smpi.Request.waitall(reqs)
+                res["ok"] = True
+            else:
+                comm.send(f"from-{me}", 0, tag=1)
+
+        run_ranks(cluster, main, 3)
+        assert res["ok"]
+
+    def test_iprobe(self, cluster):
+        res = {}
+
+        def main():
+            comm = smpi.COMM_WORLD
+            me = comm.rank()
+            if me == 0:
+                assert not comm.iprobe(1, 5)
+                s4u.this_actor.sleep_for(2.0)
+                res["probed"] = comm.iprobe(1, 5)
+                comm.recv(1, 5)
+            else:
+                comm.send(b"x", 0, tag=5)
+
+        run_ranks(cluster, main, 2)
+        assert res["probed"]
+
+
+class TestCollectives:
+    def _run(self, cluster, fn, n=8, configs=()):
+        return run_ranks(cluster, fn, n, configs=configs)
+
+    @pytest.mark.parametrize("algo", ["binomial_tree", "flat_tree"])
+    def test_bcast(self, cluster, algo):
+        res = {}
+
+        def main():
+            comm = smpi.COMM_WORLD
+            data = np.arange(5) if comm.rank() == 2 else None
+            got = comm.bcast(data, root=2)
+            res[comm.rank()] = got
+
+        self._run(cluster, main, configs=[f"smpi/bcast:{algo}"])
+        for r in range(8):
+            np.testing.assert_array_equal(res[r], np.arange(5))
+
+    @pytest.mark.parametrize("algo", ["redbcast", "rdb", "lr"])
+    def test_allreduce(self, cluster, algo):
+        res = {}
+
+        def main():
+            comm = smpi.COMM_WORLD
+            me = comm.rank()
+            out = comm.allreduce(np.full(8, float(me + 1)), smpi.MPI_SUM)
+            res[me] = out
+
+        self._run(cluster, main, configs=[f"smpi/allreduce:{algo}"])
+        expected = np.full(8, float(sum(range(1, 9))))
+        for r in range(8):
+            np.testing.assert_allclose(res[r], expected)
+
+    @pytest.mark.parametrize("n", [5, 8])
+    def test_allreduce_rdb_non_power_of_two(self, cluster, n):
+        res = {}
+
+        def main():
+            comm = smpi.COMM_WORLD
+            res[comm.rank()] = comm.allreduce(comm.rank() + 1, smpi.MPI_MAX)
+
+        self._run(cluster, main, n=n, configs=["smpi/allreduce:rdb"])
+        for r in range(n):
+            assert res[r] == n
+
+    @pytest.mark.parametrize("algo", ["binomial", "linear"])
+    def test_reduce(self, cluster, algo):
+        res = {}
+
+        def main():
+            comm = smpi.COMM_WORLD
+            out = comm.reduce(comm.rank(), smpi.MPI_SUM, root=3)
+            res[comm.rank()] = out
+
+        self._run(cluster, main, configs=[f"smpi/reduce:{algo}"])
+        assert res[3] == sum(range(8))
+        assert all(res[r] is None for r in range(8) if r != 3)
+
+    @pytest.mark.parametrize("algo", ["basic_linear", "pairwise", "bruck"])
+    def test_alltoall(self, cluster, algo):
+        res = {}
+
+        def main():
+            comm = smpi.COMM_WORLD
+            me = comm.rank()
+            out = comm.alltoall([f"{me}->{dst}" for dst in range(8)])
+            res[me] = out
+
+        self._run(cluster, main, configs=[f"smpi/alltoall:{algo}"])
+        for r in range(8):
+            assert res[r] == [f"{src}->{r}" for src in range(8)]
+
+    @pytest.mark.parametrize("algo", ["linear", "ring", "rdb"])
+    def test_allgather(self, cluster, algo):
+        res = {}
+
+        def main():
+            comm = smpi.COMM_WORLD
+            res[comm.rank()] = comm.allgather(comm.rank() * 10)
+
+        self._run(cluster, main, configs=[f"smpi/allgather:{algo}"])
+        for r in range(8):
+            assert res[r] == [i * 10 for i in range(8)]
+
+    def test_gather_scatter(self, cluster):
+        res = {}
+
+        def main():
+            comm = smpi.COMM_WORLD
+            me = comm.rank()
+            gathered = comm.gather(me * me, root=0)
+            if me == 0:
+                res["gathered"] = gathered
+            part = comm.scatter([i + 100 for i in range(8)] if me == 0
+                                else None, root=0)
+            res[me] = part
+
+        self._run(cluster, main)
+        assert res["gathered"] == [i * i for i in range(8)]
+        for r in range(8):
+            assert res[r] == r + 100
+
+    def test_barrier_synchronizes(self, cluster):
+        res = {}
+
+        def main():
+            comm = smpi.COMM_WORLD
+            me = comm.rank()
+            s4u.this_actor.sleep_for(float(me))  # staggered arrivals
+            comm.barrier()
+            res[me] = smpi.wtime()
+
+        self._run(cluster, main)
+        # nobody may leave before the last arrival (t=7)
+        assert all(t >= 7.0 for t in res.values())
+
+    def test_scan(self, cluster):
+        res = {}
+
+        def main():
+            comm = smpi.COMM_WORLD
+            res[comm.rank()] = comm.scan(comm.rank() + 1, smpi.MPI_SUM)
+
+        self._run(cluster, main)
+        for r in range(8):
+            assert res[r] == sum(range(1, r + 2))
+
+    def test_reduce_scatter(self, cluster):
+        res = {}
+
+        def main():
+            comm = smpi.COMM_WORLD
+            me = comm.rank()
+            out = comm.reduce_scatter([np.full(2, float(me))
+                                       for _ in range(8)], smpi.MPI_SUM)
+            res[me] = out
+
+        self._run(cluster, main)
+        for r in range(8):
+            np.testing.assert_allclose(res[r], np.full(2, 28.0))
+
+    def test_reduce_non_commutative_order(self, cluster):
+        """Non-commutative op: MPI requires combination in rank order."""
+        res = {}
+        concat = smpi.Op(lambda a, b: a + b, "concat", commutative=False)
+
+        def main():
+            comm = smpi.COMM_WORLD
+            out = comm.reduce(f"[{comm.rank()}]", concat, root=0)
+            if comm.rank() == 0:
+                res["out"] = out
+
+        self._run(cluster, main, n=4)
+        assert res["out"] == "[0][1][2][3]"
+
+
+class TestCommManagement:
+    def test_split(self, cluster):
+        res = {}
+
+        def main():
+            comm = smpi.COMM_WORLD
+            me = comm.rank()
+            sub = comm.split(me % 2, me)
+            res[me] = (sub.rank(), sub.size(),
+                       sub.allgather(me))
+
+        run_ranks(cluster, main, 8)
+        for r in range(8):
+            sub_rank, sub_size, members = res[r]
+            assert sub_size == 4
+            assert sub_rank == r // 2
+            assert members == [i for i in range(8) if i % 2 == r % 2]
+
+    def test_group_algebra(self):
+        g = smpi.Group(list(range(8)))
+        evens = g.incl([0, 2, 4, 6])
+        assert evens.size() == 4 and evens.actor(1) == 2
+        assert evens.rank(4) == 2
+        odds = g.excl([0, 2, 4, 6])
+        assert odds.world_ranks == [1, 3, 5, 7]
+        assert evens.union(odds).size() == 8
+        assert evens.intersection(odds).size() == 0
+
+    def test_dup_isolates_traffic(self, cluster):
+        """Same (src, tag) on two communicators must not cross-match."""
+        res = {}
+
+        def main():
+            comm = smpi.COMM_WORLD
+            me = comm.rank()
+            other = comm.dup()
+            if me == 0:
+                comm.send("on-world", 1, tag=3)
+                other.send("on-dup", 1, tag=3)
+            else:
+                got_dup = other.recv(0, 3)
+                got_world = comm.recv(0, 3)
+                res["dup"] = got_dup
+                res["world"] = got_world
+
+        run_ranks(cluster, main, 2)
+        assert res["dup"] == "on-dup"
+        assert res["world"] == "on-world"
+
+
+class TestDatatypesOps:
+    def test_derived_sizes(self):
+        v = smpi.Datatype.create_vector(3, 2, 4, smpi.MPI_DOUBLE)
+        assert v.size() == 3 * 2 * 8
+        assert v.extent() == ((3 - 1) * 4 + 2) * 8
+        c = smpi.Datatype.create_contiguous(5, smpi.MPI_INT)
+        assert c.size() == 20
+
+    def test_maxloc(self):
+        a = (3.0, 1)
+        b = (3.0, 0)
+        assert smpi.MPI_MAXLOC(a, b) == (3.0, 0)
+        assert smpi.MPI_MINLOC((1.0, 5), (2.0, 1)) == (1.0, 5)
+
+    def test_execute_advances_clock(self, cluster):
+        res = {}
+
+        def main():
+            smpi.smpi_execute_flops(2e9)   # 2 Gf on a 1 Gf host = 2 s
+            res[smpi.this_rank()] = smpi.wtime()
+
+        run_ranks(cluster, main, 1)
+        assert res[0] == pytest.approx(2.0, rel=1e-9)
