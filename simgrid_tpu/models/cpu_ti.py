@@ -1,0 +1,380 @@
+"""CPU TI model: closed-form trace integration (reference
+src/surf/cpu_ti.cpp).  Instead of stepping through availability-profile
+events, the cumulative integral of the speed profile is precomputed
+(numpy prefix sums) and each action's finish date is solved analytically
+with binary searches — O(log n) per action instead of one simulation
+event per profile point, the fastest mode for traced platforms.
+
+No LMM system is involved: actions on one CPU share it fairly by
+priority, so remaining work evolves as area/(sum_priority * penalty)
+with area = peak * integral of the scale profile.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import numpy as np
+
+from ..kernel import profile as profile_mod
+from ..kernel.resource import (ActionState, HeapType, NO_MAX_DURATION,
+                               SuspendStates, UpdateAlgo)
+from ..utils.config import config
+from .cpu import Cpu, CpuAction, CpuModel
+
+_EPSILON = 1e-12
+
+
+class CpuTiProfile:
+    """Cumulative integral of a delta-encoded speed profile
+    (cpu_ti.cpp:25-40): time_points[i] / integral[i] arrays built as
+    prefix sums."""
+
+    def __init__(self, profile: profile_mod.Profile):
+        times = [0.0]
+        integrals = [0.0]
+        t = 0.0
+        acc = 0.0
+        for val in profile.event_list:
+            # the idx-0 placeholder has value -1 (it only stores the trace
+            # begin offset); contribute its span at scale 0, not -1
+            scale = val.value if val.value >= 0 else 0.0
+            delta = max(val.date, 0.0)
+            t += delta
+            acc += delta * scale
+            times.append(t)
+            integrals.append(acc)
+        # drop the duplicated leading point if the placeholder was empty
+        self.time_points = np.asarray(times)
+        self.integral = np.asarray(integrals)
+
+    @staticmethod
+    def _search(array: np.ndarray, a: float) -> int:
+        """Index of the last point <= a (cpu_ti.cpp:255-261)."""
+        if array[0] > a:
+            return 0
+        return int(np.searchsorted(array, a, side="right")) - 1
+
+    def integrate_simple_point(self, a: float) -> float:
+        ind = self._search(self.time_points, a)
+        if ind >= len(self.time_points) - 1:
+            return float(self.integral[-1])
+        integral = float(self.integral[ind])
+        frac = a - float(self.time_points[ind])
+        if frac > 0:
+            span = float(self.time_points[ind + 1] - self.time_points[ind])
+            if span > 0:
+                integral += (float(self.integral[ind + 1]
+                                   - self.integral[ind]) / span) * frac
+        return integral
+
+    def integrate_simple(self, a: float, b: float) -> float:
+        return self.integrate_simple_point(b) - self.integrate_simple_point(a)
+
+    def solve_simple(self, a: float, amount: float) -> float:
+        """Date at which `amount` of integral is accumulated past a
+        (cpu_ti.cpp:186-196)."""
+        target = self.integrate_simple_point(a) + amount
+        ind = self._search(self.integral, target)
+        ind = min(ind, len(self.time_points) - 2)
+        time = float(self.time_points[ind])
+        span_i = float(self.integral[ind + 1] - self.integral[ind])
+        span_t = float(self.time_points[ind + 1] - self.time_points[ind])
+        if span_i > 0:
+            time += (target - float(self.integral[ind])) / (span_i / span_t)
+        return time
+
+
+class CpuTiTmgr:
+    """Fixed-or-dynamic integration manager with periodic wrap-around
+    (cpu_ti.cpp CpuTiTmgr)."""
+
+    def __init__(self, profile: Optional[profile_mod.Profile],
+                 value: float = 1.0):
+        if profile is None or len(profile.event_list) <= 1:
+            self.fixed = True
+            self.value = (profile.event_list[0].value
+                          if profile is not None and profile.event_list
+                          and profile.event_list[0].value >= 0 else value)
+            self.profile = None
+            return
+        self.fixed = False
+        self.profile = CpuTiProfile(profile)
+        self.last_time = float(self.profile.time_points[-1])
+        self.total = self.profile.integrate_simple(0.0, self.last_time)
+
+    def integrate(self, a: float, b: float) -> float:
+        assert 0.0 <= a <= b + _EPSILON, \
+            f"invalid integration interval [{a}, {b}]"
+        if abs(a - b) < _EPSILON:
+            return 0.0
+        if self.fixed:
+            return (b - a) * self.value
+
+        lt = self.last_time
+        if abs(math.ceil(a / lt) - a / lt) < _EPSILON:
+            a_index = 1 + int(math.ceil(a / lt))
+        else:
+            a_index = int(math.ceil(a / lt))
+        b_index = int(math.floor(b / lt))
+        if a_index > b_index:     # same period chunk
+            return self.profile.integrate_simple(a - (a_index - 1) * lt,
+                                                 b - b_index * lt)
+        first = self.profile.integrate_simple(a - (a_index - 1) * lt, lt)
+        middle = (b_index - a_index) * self.total
+        last = self.profile.integrate_simple(0.0, b - b_index * lt)
+        return first + middle + last
+
+    def solve(self, a: float, amount: float) -> float:
+        if -_EPSILON < a < 0.0:
+            a = 0.0
+        if -_EPSILON < amount < 0.0:
+            amount = 0.0
+        assert a >= 0.0 and amount >= 0.0, \
+            f"invalid solve parameters [a={a}, amount={amount}]"
+        if amount < _EPSILON:
+            return a
+        if self.fixed:
+            return a + amount / self.value
+
+        quotient = int(math.floor(amount / self.total))
+        reduced_amount = self.total * (amount / self.total
+                                       - math.floor(amount / self.total))
+        periods_before = int(math.floor(a / self.last_time))
+        reduced_a = a - self.last_time * periods_before
+
+        amount_till_end = self.integrate(reduced_a, self.last_time)
+        if amount_till_end > reduced_amount:
+            reduced_b = self.profile.solve_simple(reduced_a, reduced_amount)
+        else:
+            reduced_b = self.last_time + self.profile.solve_simple(
+                0.0, reduced_amount - amount_till_end)
+        return (self.last_time * periods_before
+                + quotient * self.last_time + reduced_b)
+
+    def get_power_scale(self, a: float) -> float:
+        if self.fixed:
+            return self.value
+        reduced_a = a - math.floor(a / self.last_time) * self.last_time
+        point = CpuTiProfile._search(self.profile.time_points, reduced_a)
+        # scale in effect after point i is event i's value (placeholder -> 0)
+        sc = self._scales()[min(point, len(self._scales()) - 1)]
+        return sc
+
+    def _scales(self):
+        if not hasattr(self, "_scale_cache"):
+            tp = self.profile.time_points
+            it = self.profile.integral
+            self._scale_cache = [
+                (float(it[i + 1] - it[i]) / float(tp[i + 1] - tp[i])
+                 if tp[i + 1] > tp[i] else 0.0)
+                for i in range(len(tp) - 1)]
+        return self._scale_cache
+
+
+class CpuTiModel(CpuModel):
+    """next_occurring_event: refresh finish dates of actions on modified
+    cpus, then read the heap top (cpu_ti.cpp:293-310)."""
+
+    def __init__(self, engine):
+        super().__init__(engine, UpdateAlgo.FULL)
+        from ..ops.lmm_host import System
+        self.set_maxmin_system(System(False))  # unused; kept for interface
+        self.modified_cpus: List["CpuTi"] = []
+
+    def create_cpu(self, host, speed_per_pstate: List[float],
+                   core_count: int = 1) -> "CpuTi":
+        return CpuTi(self, host, speed_per_pstate, core_count)
+
+    def next_occurring_event(self, now: float) -> float:
+        for cpu in list(self.modified_cpus):
+            cpu.update_actions_finish_time(now)
+        if not self.action_heap.empty():
+            return self.action_heap.top_date() - now
+        return -1.0
+
+    def update_actions_state(self, now: float, delta: float) -> None:
+        eps = config["surf/precision"]
+        while (not self.action_heap.empty()
+               and abs(self.action_heap.top_date() - now) < eps):
+            action = self.action_heap.pop()
+            action.finish(ActionState.FINISHED)
+            action.cpu.update_remaining_amount(now)
+
+
+class CpuTi(Cpu):
+    """A CPU under trace integration (cpu_ti.cpp CpuTi)."""
+
+    def __init__(self, model: CpuTiModel, host,
+                 speed_per_pstate: List[float], core_count: int = 1):
+        assert core_count == 1, "Multi-core not handled by the TI model"
+        super().__init__(model, host, speed_per_pstate, core_count)
+        self.action_set: List["CpuTiAction"] = []
+        self.sum_priority = 0.0
+        self.last_update = 0.0
+        self.tmgr = CpuTiTmgr(None, 1.0)
+        self._modified = False
+
+    def set_speed_profile(self, profile: profile_mod.Profile) -> None:
+        # The whole profile is integrated analytically: no future events
+        # are scheduled for it (that is the point of the TI model).
+        self.tmgr = CpuTiTmgr(profile, self.speed_scale)
+
+    def apply_event(self, event: profile_mod.Event, value: float) -> None:
+        if event is self.speed_event:
+            self.update_remaining_amount(self.model.engine.now)
+            self.set_modified(True)
+            self.tmgr = CpuTiTmgr(None, value)
+            self.speed_scale = value
+        elif event is self.state_event:
+            if value > 0:
+                if not self.is_on():
+                    self.host.turn_on()
+            else:
+                self.host.turn_off()
+                date = self.model.engine.now
+                for action in list(self.action_set):
+                    if action.get_state() in (ActionState.INITED,
+                                              ActionState.STARTED,
+                                              ActionState.IGNORED):
+                        action.finish_time = date
+                        action.set_state(ActionState.FAILED)
+                        self.model.action_heap.remove(action)
+        else:
+            raise AssertionError("Unknown event!")
+
+    def is_used(self) -> bool:
+        return bool(self.action_set)
+
+    def set_modified(self, modified: bool) -> None:
+        lst = self.model.modified_cpus
+        if modified:
+            if self not in lst:
+                lst.append(self)
+        elif self in lst:
+            lst.remove(self)
+
+    def update_actions_finish_time(self, now: float) -> None:
+        # cpu_ti.cpp:407-461
+        self.update_remaining_amount(now)
+
+        self.sum_priority = 0.0
+        for action in self.action_set:
+            if (action.state_set is not self.model.started_action_set
+                    or action.sharing_penalty <= 0
+                    or action.suspended != SuspendStates.RUNNING):
+                continue
+            self.sum_priority += 1.0 / action.sharing_penalty
+
+        for action in self.action_set:
+            min_finish = NO_MAX_DURATION
+            if action.state_set is not self.model.started_action_set:
+                continue
+            if (action.suspended == SuspendStates.RUNNING
+                    and action.sharing_penalty > 0):
+                total_area = (action.remains * self.sum_priority
+                              * action.sharing_penalty) / self.speed_peak
+                action.finish_time = self.tmgr.solve(now, total_area)
+                if (action.max_duration != NO_MAX_DURATION
+                        and action.start_time + action.max_duration
+                        < action.finish_time):
+                    min_finish = action.start_time + action.max_duration
+                else:
+                    min_finish = action.finish_time
+            else:
+                if action.max_duration != NO_MAX_DURATION:
+                    min_finish = action.start_time + action.max_duration
+            if min_finish != NO_MAX_DURATION:
+                self.model.action_heap.update(action, min_finish,
+                                              HeapType.UNSET)
+            else:
+                self.model.action_heap.remove(action)
+        self.set_modified(False)
+
+    def update_remaining_amount(self, now: float) -> None:
+        # cpu_ti.cpp:474-510
+        if self.last_update >= now:
+            return
+        area_total = self.tmgr.integrate(self.last_update, now) \
+            * self.speed_peak
+        for action in self.action_set:
+            if (action.state_set is not self.model.started_action_set
+                    or action.sharing_penalty <= 0
+                    or action.suspended != SuspendStates.RUNNING
+                    or action.start_time >= now):
+                continue
+            if 0 <= action.finish_time <= now:
+                continue
+            if self.sum_priority > 0:
+                action.update_remains(
+                    area_total / (self.sum_priority
+                                  * action.sharing_penalty))
+        self.last_update = now
+
+    def execution_start(self, size: float,
+                        requested_cores: int = 1) -> "CpuTiAction":
+        return CpuTiAction(self, size)
+
+    def sleep(self, duration: float) -> "CpuTiAction":
+        if duration > 0:
+            duration = max(duration, config["surf/precision"])
+        action = CpuTiAction(self, 1.0)
+        action.max_duration = duration
+        action.suspended = SuspendStates.SLEEPING
+        if duration == NO_MAX_DURATION:
+            action.set_state(ActionState.IGNORED)
+        return action
+
+
+class CpuTiAction(CpuAction):
+    """A TI execution: no LMM variable, finish dates solved analytically
+    (cpu_ti.cpp CpuTiAction)."""
+
+    def __init__(self, cpu: CpuTi, cost: float):
+        super().__init__(cpu.model, cost, not cpu.is_on(), variable=None)
+        self.cpu = cpu
+        cpu.action_set.append(self)
+        cpu.set_modified(True)
+
+    def set_state(self, state: ActionState) -> None:
+        super().set_state(state)
+        self.cpu.set_modified(True)
+
+    def cancel(self) -> None:
+        self.set_state(ActionState.FAILED)
+        self.model.action_heap.remove(self)
+        self.cpu.set_modified(True)
+
+    def suspend(self) -> None:
+        if self.suspended != SuspendStates.SLEEPING:
+            self.cpu.update_remaining_amount(self.model.engine.now)
+            self.suspended = SuspendStates.SUSPENDED
+            self.model.action_heap.remove(self)
+            self.cpu.set_modified(True)
+
+    def resume(self) -> None:
+        if self.suspended != SuspendStates.SLEEPING:
+            self.suspended = SuspendStates.RUNNING
+            self.cpu.set_modified(True)
+
+    def set_max_duration(self, duration: float) -> None:
+        self.max_duration = duration
+        self.cpu.set_modified(True)
+
+    def set_sharing_penalty(self, penalty: float) -> None:
+        self.cpu.update_remaining_amount(self.model.engine.now)
+        self.sharing_penalty = penalty
+        self.cpu.set_modified(True)
+
+    def set_bound(self, bound: float) -> None:
+        pass  # no rate bounds under trace integration
+
+    def update_remains_lazy(self, now: float) -> None:
+        raise AssertionError("TI actions never use the lazy LMM path")
+
+    def destroy(self) -> None:
+        if self in self.cpu.action_set:
+            self.cpu.action_set.remove(self)
+        self.cpu.set_modified(True)
+        super().destroy()

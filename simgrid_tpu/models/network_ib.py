@@ -1,0 +1,141 @@
+"""InfiniBand contention model (reference src/surf/network_ib.cpp, after
+Vienne's PhD measurements): each host tracks its active outgoing and
+incoming comms; whenever one starts or ends, penalty factors are
+recomputed over the affected connected component and applied as variable
+bound updates in the LMM system."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..kernel.resource import ActionState
+from ..utils.config import config, declare_flag
+from .network import LinkImpl, NetworkAction
+from .network_smpi import NetworkSmpiModel
+
+declare_flag("smpi/IB-penalty-factors",
+             "Correction factor to communications using Infiniband model "
+             "with contention (default value based on Stampede cluster "
+             "profiling)", "0.965;0.925;1.35")
+
+
+class _ActiveComm:
+    __slots__ = ("action", "destination", "init_rate")
+
+    def __init__(self, action, destination):
+        self.action = action
+        self.destination = destination
+        self.init_rate = -1.0
+
+
+class _IBNode:
+    __slots__ = ("id", "active_comms_up", "active_comms_down",
+                 "nb_active_comms_down")
+
+    def __init__(self, id_: int):
+        self.id = id_
+        self.active_comms_up: List[_ActiveComm] = []
+        self.active_comms_down: Dict["_IBNode", int] = {}
+        self.nb_active_comms_down = 0
+
+
+class NetworkIBModel(NetworkSmpiModel):
+    def __init__(self, engine):
+        super().__init__(engine)
+        parts = config["smpi/IB-penalty-factors"].split(";")
+        assert len(parts) == 3, \
+            "smpi/IB-penalty-factors must have 3 ';'-separated values"
+        self.Be, self.Bs, self.ys = (float(p) for p in parts)
+        self.active_nodes: Dict[str, _IBNode] = {}
+        self.active_comms: Dict[NetworkAction,
+                                Tuple[_IBNode, _IBNode]] = {}
+
+        from .host import Host
+        model = self
+
+        def on_host_creation(host):
+            if model.engine.network_model is model:
+                model.active_nodes[host.name] = _IBNode(
+                    len(model.active_nodes))
+        Host.on_creation.connect(on_host_creation)
+
+        def on_communicate(action, src, dst):
+            # reference IB_action_init_callback (network_ib.cpp:44-53)
+            if model.engine.network_model is not model:
+                return
+            a_src = model.active_nodes[src.name]
+            a_dst = model.active_nodes[dst.name]
+            model.active_comms[action] = (a_src, a_dst)
+            model.update_IB_factors(action, a_src, a_dst, remove=False)
+        LinkImpl.on_communicate.connect(on_communicate)
+
+        def on_state_change(action):
+            # reference IB_action_state_changed_callback (:28-42)
+            if model.engine.network_model is not model:
+                return
+            if action.get_state() != ActionState.FINISHED:
+                return
+            pair = model.active_comms.pop(action, None)
+            if pair is not None:
+                model.update_IB_factors(action, pair[0], pair[1],
+                                        remove=True)
+        NetworkAction.on_state_change.connect(on_state_change)
+
+    # -- penalty machinery (network_ib.cpp:115-214) -----------------------
+    def compute_IB_factors(self, root: _IBNode) -> None:
+        num_comm_out = len(root.active_comms_up)
+        max_penalty_out = 0.0
+        for comm in root.active_comms_up:
+            my_penalty_out = 1.0
+            if num_comm_out != 1:
+                if comm.destination.nb_active_comms_down > 2:
+                    my_penalty_out = num_comm_out * self.Bs * self.ys
+                else:
+                    my_penalty_out = num_comm_out * self.Bs
+            max_penalty_out = max(max_penalty_out, my_penalty_out)
+
+        eps = config["surf/precision"]
+        for comm in root.active_comms_up:
+            my_penalty_in = 1.0
+            if comm.destination.nb_active_comms_down != 1:
+                my_penalty_in = (comm.destination.active_comms_down[root]
+                                 * self.Be
+                                 * len(comm.destination.active_comms_down))
+            penalty = max(my_penalty_in, max_penalty_out)
+
+            rate_before = comm.action.variable.bound
+            if comm.init_rate == -1.0:
+                comm.init_rate = rate_before
+            penalized_bw = (comm.init_rate / penalty if num_comm_out
+                            else comm.init_rate)
+            if abs(penalized_bw - rate_before) > eps:
+                self.system.update_variable_bound(comm.action.variable,
+                                                  penalized_bw)
+
+    def _update_rec(self, root: _IBNode, updated: Dict[int, bool]) -> None:
+        if updated.get(root.id):
+            return
+        self.compute_IB_factors(root)
+        updated[root.id] = True
+        for comm in root.active_comms_up:
+            self._update_rec(comm.destination, updated)
+        for node in root.active_comms_down:
+            self._update_rec(node, updated)
+
+    def update_IB_factors(self, action, src: _IBNode, dst: _IBNode,
+                          remove: bool) -> None:
+        if src is dst:   # local comms use the loopback
+            return
+        if remove:
+            if dst.active_comms_down.get(src, 0) == 1:
+                dst.active_comms_down.pop(src, None)
+            elif src in dst.active_comms_down:
+                dst.active_comms_down[src] -= 1
+            dst.nb_active_comms_down -= 1
+            src.active_comms_up = [c for c in src.active_comms_up
+                                   if c.action is not action]
+        else:
+            src.active_comms_up.append(_ActiveComm(action, dst))
+            dst.active_comms_down[src] = dst.active_comms_down.get(src, 0) + 1
+            dst.nb_active_comms_down += 1
+        self._update_rec(src, {})

@@ -1,0 +1,64 @@
+"""SMPI network model: CM02 with piecewise per-message-size bandwidth and
+latency correction factors calibrated on MPI ping-pongs (reference
+src/surf/network_smpi.cpp; factors from the IPDPS'11 SMPI paper, defaults
+from sg_config.cpp:336-347)."""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from ..utils.config import config, declare_flag
+from .network import NetworkCm02Model
+
+declare_flag("smpi/bw-factor",
+             "Bandwidth factors for smpi. Format: 'threshold0:value0;...'; "
+             "if size >= thresholdN return valueN.",
+             "65472:0.940694;15424:0.697866;9376:0.58729;5776:1.08739;"
+             "3484:0.77493;1426:0.608902;732:0.341987;257:0.338112;"
+             "0:0.812084")
+declare_flag("smpi/lat-factor", "Latency factors for smpi.",
+             "65472:11.6436;15424:3.48845;9376:2.59299;5776:2.18796;"
+             "3484:1.88101;1426:1.61075;732:1.9503;257:1.95341;0:2.01467")
+
+
+def parse_size_factor(spec: str) -> List[Tuple[float, float]]:
+    """'threshold:value;...' sorted ascending by threshold."""
+    out = []
+    for part in spec.split(";"):
+        if not part:
+            continue
+        nums = part.split(":")
+        out.append((float(nums[0]), float(nums[1])))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def staged_value(table: List[Tuple[float, float]], size: float) -> float:
+    """The value of the last threshold below `size` (network_smpi.cpp:
+    50-84 evaluation: factors apply for sizes *above* their threshold)."""
+    current = 1.0
+    for threshold, value in table:
+        if size <= threshold:
+            return current
+        current = value
+    return current
+
+
+class NetworkSmpiModel(NetworkCm02Model):
+    def __init__(self, engine):
+        config.set_default("network/weight-S", 8775.0)
+        super().__init__(engine)
+        self._bw_factor = parse_size_factor(config["smpi/bw-factor"])
+        self._lat_factor = parse_size_factor(config["smpi/lat-factor"])
+
+    def get_bandwidth_factor(self, size: float) -> float:
+        return staged_value(self._bw_factor, size)
+
+    def get_latency_factor(self, size: float) -> float:
+        return staged_value(self._lat_factor, size)
+
+    def get_bandwidth_constraint(self, rate: float, bound: float,
+                                 size: float) -> float:
+        if rate < 0:
+            return bound
+        return min(bound, rate * self.get_bandwidth_factor(size))

@@ -1,0 +1,268 @@
+"""ptask L07 host model (reference src/surf/ptask_L07.cpp): parallel
+tasks consuming CPU flops and link bytes *simultaneously*, solved with
+the fair-bottleneck solver.  One LMM variable per parallel task spans
+every involved cpu constraint (weight = flops on that host) and link
+constraint (weight = summed bytes through that link)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..kernel import profile as profile_mod
+from ..kernel.resource import (ActionState, Model, NO_MAX_DURATION,
+                               SuspendStates, UpdateAlgo, double_update)
+from ..ops.fair_bottleneck import FairBottleneck
+from ..ops.lmm_host import SharingPolicy
+from ..utils.config import config
+from .cpu import Cpu, CpuAction, CpuModel
+from .network import LinkImpl, NetworkModel
+
+
+class HostL07Model(Model):
+    """The composite ptask model owning the shared fair-bottleneck
+    system (ptask_L07.cpp:32-45)."""
+
+    def __init__(self, engine):
+        super().__init__(engine, UpdateAlgo.FULL)
+        self.set_maxmin_system(FairBottleneck(True))
+        engine.host_model = self
+        engine.network_model = NetworkL07Model(self, engine)
+        engine.cpu_model = CpuL07Model(self, engine)
+        from .storage import StorageN11Model
+        engine.storage_model = StorageN11Model(engine)
+
+    def next_occurring_event(self, now: float) -> float:
+        min_date = self.next_occurring_event_full(now)
+        for action in self.started_action_set:
+            if action.latency > 0 and (min_date < 0
+                                       or action.latency < min_date):
+                min_date = action.latency
+        return min_date
+
+    def update_actions_state(self, now: float, delta: float) -> None:
+        # ptask_L07.cpp:86-134
+        eps = config["surf/precision"]
+        for action in list(self.started_action_set):
+            if action.latency > 0:
+                if action.latency > delta:
+                    action.latency = double_update(action.latency, delta, eps)
+                else:
+                    action.latency = 0.0
+                if action.latency <= 0.0 and not action.is_suspended():
+                    action.update_bound()
+                    self.system.update_variable_penalty(action.variable, 1.0)
+                    action.set_last_update()
+            action.update_remains(action.variable.value * delta)
+            action.update_max_duration(delta)
+
+            if ((action.get_remains_no_update() <= 0
+                 and action.variable.sharing_penalty > 0)
+                    or (action.max_duration != NO_MAX_DURATION
+                        and action.max_duration <= 0)):
+                action.finish(ActionState.FINISHED)
+                continue
+
+            # fail the action if any underlying resource is off
+            for elem in action.variable.cnsts:
+                resource = elem.constraint.id
+                if resource is not None and not resource.is_on():
+                    action.finish(ActionState.FAILED)
+                    break
+
+    def execute_parallel(self, host_list, flops_amount, bytes_amount,
+                         rate: float) -> "L07Action":
+        return L07Action(self, host_list, flops_amount, bytes_amount, rate)
+
+
+class CpuL07Model(CpuModel):
+    """CPU facet sharing the host model's fair-bottleneck system."""
+
+    def __init__(self, host_model: HostL07Model, engine):
+        super().__init__(engine, UpdateAlgo.FULL)
+        self.host_model = host_model
+        self.system = host_model.system
+
+    def create_cpu(self, host, speed_per_pstate: List[float],
+                   core_count: int = 1) -> "CpuL07":
+        return CpuL07(self, host, speed_per_pstate, core_count)
+
+    def next_occurring_event(self, now: float) -> float:
+        return -1.0      # the host model owns the actions
+
+    def update_actions_state(self, now: float, delta: float) -> None:
+        pass
+
+
+class NetworkL07Model(NetworkModel):
+    """Network facet sharing the fair-bottleneck system."""
+
+    def __init__(self, host_model: HostL07Model, engine):
+        super().__init__(engine, UpdateAlgo.FULL)
+        self.host_model = host_model
+        self.system = host_model.system
+        self.loopback = self.create_link(
+            "__loopback__", 498000000.0, 0.000015, SharingPolicy.FATPIPE)
+
+    def create_link(self, name: str, bandwidth: float, latency: float,
+                    policy: SharingPolicy = SharingPolicy.SHARED
+                    ) -> "LinkL07":
+        return LinkL07(self, name, bandwidth, latency, policy)
+
+    def communicate(self, src, dst, size: float, rate: float) -> "L07Action":
+        # a 2-host ptask with only bytes (ptask_L07.cpp:211-222)
+        flops = [0.0, 0.0]
+        bytes_ = [0.0, size, 0.0, 0.0]   # flat [src][dst] matrix
+        return self.host_model.execute_parallel([src, dst], flops, bytes_,
+                                                rate)
+
+    def next_occurring_event(self, now: float) -> float:
+        return -1.0
+
+    def update_actions_state(self, now: float, delta: float) -> None:
+        pass
+
+
+class CpuL07(Cpu):
+    def execution_start(self, size: float,
+                        requested_cores: int = 1) -> "L07Action":
+        flops = [size]
+        return self.model.host_model.execute_parallel([self.host], flops,
+                                                      None, -1.0)
+
+    def sleep(self, duration: float) -> "L07Action":
+        action = self.execution_start(1.0)
+        action.set_max_duration(duration)
+        action.suspended = SuspendStates.SLEEPING
+        self.model.system.update_variable_penalty(action.variable, 0.0)
+        return action
+
+    def on_speed_change(self) -> None:
+        self.model.system.update_constraint_bound(
+            self.constraint, self.speed_scale * self.speed_peak)
+        for var in list(self.constraint.iter_variables()):
+            action = var.id
+            if action is not None:
+                self.model.system.update_variable_bound(
+                    action.variable, self.speed_scale * self.speed_peak)
+
+
+class LinkL07(LinkImpl):
+    def __init__(self, model: NetworkL07Model, name: str, bandwidth: float,
+                 latency: float, policy: SharingPolicy):
+        super().__init__(model, name,
+                         model.system.constraint_new(None, bandwidth))
+        self.constraint.id = self
+        self.bandwidth_peak = bandwidth
+        self.latency_peak = latency
+        if policy == SharingPolicy.FATPIPE:
+            self.constraint.sharing_policy = SharingPolicy.FATPIPE
+        LinkImpl.on_creation(self)
+
+    def apply_event(self, event: profile_mod.Event, value: float) -> None:
+        if event is self.bandwidth_event:
+            self.set_bandwidth(value)
+        elif event is self.latency_event:
+            self.set_latency(value)
+        elif event is self.state_event:
+            if value > 0:
+                self.turn_on()
+            else:
+                self.turn_off()
+        else:
+            raise AssertionError("Unknown event!")
+
+    def set_bandwidth(self, value: float) -> None:
+        self.bandwidth_peak = value
+        LinkImpl.on_bandwidth_change(self)
+        self.model.system.update_constraint_bound(
+            self.constraint, self.bandwidth_peak * self.bandwidth_scale)
+
+    def set_latency(self, value: float) -> None:
+        self.latency_peak = value
+        for var in list(self.constraint.iter_variables()):
+            action = var.id
+            if isinstance(action, L07Action):
+                action.update_bound()
+
+
+class L07Action(CpuAction):
+    """One parallel task (ptask_L07.cpp L07Action): flops per host +
+    bytes per (src, dst) pair, one variable over all constraints."""
+
+    def __init__(self, model: HostL07Model, host_list, flops_amount,
+                 bytes_amount, rate: float):
+        super().__init__(model, 1.0, False)
+        self.host_list = list(host_list)
+        self.flops_amount = flops_amount
+        self.bytes_amount = bytes_amount
+        self.rate = rate
+        self.set_last_update()
+
+        n = len(self.host_list)
+        used_host_nb = sum(1 for f in (flops_amount or []) if f > 0)
+
+        latency = 0.0
+        affected_links = set()
+        if bytes_amount:
+            for k in range(n * n):
+                if bytes_amount[k] <= 0:
+                    continue
+                route: List[LinkImpl] = []
+                lat = self.host_list[k // n].route_to(
+                    self.host_list[k % n], route)
+                latency = max(latency, lat)
+                for link in route:
+                    affected_links.add(link.name)
+        link_nb = len(affected_links)
+
+        self.latency = latency
+        self.variable = model.system.variable_new(
+            self, 1.0, rate if rate > 0 else -1.0, n + link_nb)
+        if self.latency > 0:
+            model.system.update_variable_penalty(self.variable, 0.0)
+
+        # expand on every cpu (even 0-flop ones, to notice host failures)
+        for i, host in enumerate(self.host_list):
+            model.system.expand(host.cpu.constraint, self.variable,
+                                flops_amount[i] if flops_amount else 0.0)
+
+        if bytes_amount:
+            for k in range(n * n):
+                if bytes_amount[k] <= 0.0:
+                    continue
+                route = []
+                self.host_list[k // n].route_to(self.host_list[k % n], route)
+                for link in route:
+                    model.system.expand_add(link.constraint, self.variable,
+                                            bytes_amount[k])
+
+        if link_nb + used_host_nb == 0:
+            self.cost = 1.0
+            self.remains = 0.0
+
+    def update_bound(self) -> None:
+        # ptask_L07.cpp:388-418
+        lat_current = 0.0
+        n = len(self.host_list)
+        if self.bytes_amount:
+            for k in range(n * n):
+                if self.bytes_amount[k] > 0:
+                    route: List[LinkImpl] = []
+                    lat = self.host_list[k // n].route_to(
+                        self.host_list[k % n], route)
+                    lat_current = max(lat_current,
+                                      lat * self.bytes_amount[k])
+        gamma = config["network/TCP-gamma"]
+        lat_bound = (gamma / (2.0 * lat_current) if lat_current > 0
+                     else float("inf"))
+        if self.latency <= 0.0 and self.suspended == SuspendStates.RUNNING:
+            if self.rate < 0:
+                self.model.system.update_variable_bound(
+                    self.variable,
+                    lat_bound if lat_bound != float("inf") else -1.0)
+            else:
+                self.model.system.update_variable_bound(
+                    self.variable, min(self.rate, lat_bound))
+
+    def update_remains_lazy(self, now: float) -> None:
+        raise AssertionError("L07 runs in FULL mode only")

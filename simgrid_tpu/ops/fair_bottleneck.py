@@ -1,0 +1,114 @@
+"""Fair-bottleneck solver for parallel tasks (reference
+src/kernel/lmm/fair_bottleneck.cpp).
+
+Unlike max-min (which weighs each variable's total usage), the
+fair-bottleneck fixpoint repeatedly grants every live variable the
+largest equal increment its tightest constraint allows: each round every
+constraint splits its remaining capacity evenly over its live variables,
+each variable takes the minimum offer across its constraints (and its
+own bound), and exhausted constraints retire with their variables."""
+
+from __future__ import annotations
+
+from .lmm_host import SharingPolicy, System, double_update
+from ..utils.config import config
+
+
+class FairBottleneck(System):
+    """An LMM system solved with bottleneck_solve instead of the max-min
+    fixpoint (make_new_fair_bottleneck_system equivalent)."""
+
+    def solve(self) -> None:
+        if not self.modified:
+            return
+        self.solve_count += 1
+        self.bottleneck_solve()
+
+    def bottleneck_solve(self) -> None:
+        eps = config["maxmin/precision"]
+
+        # Init: live variables have a positive penalty and at least one
+        # weighted element (fair_bottleneck.cpp:28-51).
+        var_list = []
+        for var in self.variable_set:
+            var.value = 0.0
+            if var.sharing_penalty > 0.0 and any(
+                    e.consumption_weight != 0.0 for e in var.cnsts):
+                var_list.append(var)
+            elif var.sharing_penalty > 0.0:
+                var.value = 1.0
+
+        cnst_list = list(self.active_constraint_set)
+        for cnst in cnst_list:
+            cnst.remaining = cnst.bound
+            cnst.usage = 0.0
+
+        in_var_list = set(id(v) for v in var_list)
+
+        while var_list:
+            # Offer per constraint: remaining / #live variables (FATPIPE
+            # offers its full remaining to each).
+            next_cnst_list = []
+            for cnst in cnst_list:
+                nb = sum(1 for e in cnst.enabled_element_set
+                         if e.consumption_weight > 0
+                         and id(e.variable) in in_var_list)
+                if nb > 0 and cnst.sharing_policy == SharingPolicy.FATPIPE:
+                    nb = 1
+                if nb == 0:
+                    cnst.remaining = 0.0
+                    cnst.usage = 0.0
+                else:
+                    cnst.usage = cnst.remaining / nb
+                    next_cnst_list.append(cnst)
+            cnst_list = next_cnst_list
+
+            # Every live variable takes its minimal offer.
+            still = []
+            for var in var_list:
+                min_inc = float("inf")
+                for elem in var.cnsts:
+                    if elem.consumption_weight > 0:
+                        min_inc = min(min_inc,
+                                      elem.constraint.usage
+                                      / elem.consumption_weight)
+                if var.bound > 0:
+                    min_inc = min(min_inc, var.bound - var.value)
+                var.mu = min_inc
+                var.value += min_inc
+                if var.value == var.bound:
+                    in_var_list.discard(id(var))
+                else:
+                    still.append(var)
+            var_list = still
+
+            # Charge the increments; retire exhausted constraints and
+            # their variables.
+            next_cnst_list = []
+            for cnst in cnst_list:
+                if cnst.sharing_policy != SharingPolicy.FATPIPE:
+                    for elem in cnst.enabled_element_set:
+                        cnst.remaining = double_update(
+                            cnst.remaining,
+                            elem.consumption_weight * elem.variable.mu, eps)
+                else:
+                    for elem in cnst.enabled_element_set:
+                        cnst.usage = min(cnst.usage,
+                                         elem.consumption_weight
+                                         * elem.variable.mu)
+                    cnst.remaining = double_update(cnst.remaining,
+                                                   cnst.usage, eps)
+                if cnst.remaining <= 0.0:
+                    for elem in cnst.enabled_element_set:
+                        if (elem.consumption_weight > 0
+                                and id(elem.variable) in in_var_list):
+                            in_var_list.discard(id(elem.variable))
+                    var_list = [v for v in var_list
+                                if id(v) in in_var_list]
+                else:
+                    next_cnst_list.append(cnst)
+            cnst_list = next_cnst_list
+
+        self.modified = True
+        if self.selective_update_active:
+            self.remove_all_modified_set()

@@ -1,0 +1,291 @@
+"""Tests for the non-default resource models: SMPI piecewise network
+factors, InfiniBand contention, CPU trace integration, ptask L07 /
+fair bottleneck (reference test model: teshsuite/surf/*)."""
+
+import math
+import os
+
+import pytest
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils.config import config
+
+HERE = os.path.dirname(__file__)
+TRIANGLE = os.path.join(HERE, "platforms", "triangle.xml")
+
+
+@pytest.fixture(autouse=True)
+def fresh_engine():
+    s4u.Engine._reset()
+    yield
+    s4u.Engine._reset()
+
+
+def _two_host_platform(tmp_path, extra_host_attr="", trace_block=""):
+    xml = f"""<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <host id="src" speed="1Gf" {extra_host_attr}/>
+    <host id="dst" speed="1Gf"/>
+    <link id="wire" bandwidth="1MBps" latency="1ms"/>
+    <route src="src" dst="dst"><link_ctn id="wire"/></route>
+{trace_block}
+  </zone>
+</platform>
+"""
+    path = os.path.join(tmp_path, "p.xml")
+    with open(path, "w") as f:
+        f.write(xml)
+    return path
+
+
+def _timed_transfer(platform, nbytes, cfg=()):
+    res = {}
+
+    def sender(mb):
+        mb.put("x", nbytes)
+
+    def receiver(mb):
+        mb.get()
+        res["t"] = s4u.Engine.get_clock()
+
+    e = s4u.Engine(["t"] + [f"--cfg={c}" for c in cfg])
+    e.load_platform(platform)
+    mb = s4u.Mailbox.by_name("mb")
+    s4u.Actor.create("s", e.host_by_name("src"), sender, mb)
+    s4u.Actor.create("r", e.host_by_name("dst"), receiver, mb)
+    e.run()
+    return res["t"]
+
+
+class TestNetworkSmpi:
+    def test_piecewise_factors_apply(self, tmp_path):
+        plat = _two_host_platform(tmp_path)
+        cfg = ["network/model:SMPI", "network/crosstraffic:0"]
+        # 100B message: threshold 0 segment -> bw x0.812084, lat x2.01467
+        t_small = _timed_transfer(plat, 100, cfg)
+        s4u.Engine._reset()
+        expected = 2.01467 * 1e-3 + 100 / (0.812084 * 1e6)
+        assert t_small == pytest.approx(expected, rel=1e-6)
+
+        # 100KB message: >=65472 segment -> bw x0.940694, lat x11.6436
+        t_big = _timed_transfer(plat, 100_000, cfg)
+        expected = 11.6436 * 1e-3 + 100_000 / (0.940694 * 1e6)
+        assert t_big == pytest.approx(expected, rel=1e-6)
+
+
+class TestNetworkIB:
+    def test_ib_penalizes_fan_in(self, tmp_path):
+        """Two senders to one receiver: the IB model caps each flow's
+        rate bound below its solo rate (network_ib.cpp penalties)."""
+        xml = """<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <host id="a" speed="1Gf"/>
+    <host id="b" speed="1Gf"/>
+    <host id="dst" speed="1Gf"/>
+    <link id="la" bandwidth="1MBps" latency="1us"/>
+    <link id="lb" bandwidth="1MBps" latency="1us"/>
+    <route src="a" dst="dst"><link_ctn id="la"/></route>
+    <route src="b" dst="dst"><link_ctn id="lb"/></route>
+  </zone>
+</platform>
+"""
+        plat = os.path.join(tmp_path, "ib.xml")
+        with open(plat, "w") as f:
+            f.write(xml)
+        res = {}
+
+        def sender(name, mb):
+            mb.put(name, 4_000_000)
+
+        def receiver(mb1, mb2):
+            # both flows must be in flight together: async gets
+            c1 = mb1.get_async()
+            c2 = mb2.get_async()
+            c1.wait()
+            c2.wait()
+            res["t"] = s4u.Engine.get_clock()
+
+        def run(model):
+            s4u.Engine._reset()
+            e = s4u.Engine(["t", f"--cfg=network/model:{model}",
+                            "--cfg=network/crosstraffic:0"])
+            e.load_platform(plat)
+            mb1 = s4u.Mailbox.by_name("m1")
+            mb2 = s4u.Mailbox.by_name("m2")
+            s4u.Actor.create("sa", e.host_by_name("a"), sender, "a", mb1)
+            s4u.Actor.create("sb", e.host_by_name("b"), sender, "b", mb2)
+            s4u.Actor.create("r", e.host_by_name("dst"), receiver, mb1, mb2)
+            e.run()
+            return res["t"]
+
+        t_smpi = run("SMPI")
+        t_ib = run("IB")
+        # Both flows enter dst simultaneously: the IB contention penalty
+        # (Be factor over 2 incoming flows) must slow the transfer down
+        # vs the plain SMPI model on the same platform.
+        assert t_ib > t_smpi * 1.5
+
+
+class TestCpuTi:
+    def _plat(self, tmp_path, trace):
+        xml = f"""<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <host id="h" speed="100Mf"/>
+    <trace id="sp" periodicity="1.0">
+{trace}
+    </trace>
+    <trace_connect kind="SPEED" trace="sp" element="h"/>
+  </zone>
+</platform>
+"""
+        path = os.path.join(tmp_path, "ti.xml")
+        with open(path, "w") as f:
+            f.write(xml)
+        return path
+
+    def _run_exec(self, plat, flops, cfg=()):
+        res = {}
+
+        def worker():
+            s4u.this_actor.execute(flops)
+            res["t"] = s4u.Engine.get_clock()
+
+        e = s4u.Engine(["t", "--cfg=cpu/optim:TI"] +
+                       [f"--cfg={c}" for c in cfg])
+        e.load_platform(plat)
+        s4u.Actor.create("w", e.host_by_name("h"), worker)
+        e.run()
+        return res["t"]
+
+    def test_fixed_speed_no_trace(self, tmp_path):
+        xml = """<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full"><host id="h" speed="100Mf"/></zone>
+</platform>
+"""
+        plat = os.path.join(tmp_path, "plain.xml")
+        with open(plat, "w") as f:
+            f.write(xml)
+        assert self._run_exec(plat, 250e6) == pytest.approx(2.5, rel=1e-9)
+
+    def test_periodic_availability_trace(self, tmp_path):
+        # availability alternates 1.0 for 0.5s, 0.5 for 0.5s (period 1s):
+        # average speed = 75Mf/s; 150Mf of work needs exactly 2 s
+        # (integral(0,2) = 2 * (0.5*1.0 + 0.5*0.5) * 100Mf = 150Mf).
+        plat = self._plat(tmp_path, "0.0 1.0\n0.5 0.5")
+        assert self._run_exec(plat, 150e6) == pytest.approx(2.0, rel=1e-6)
+
+    def test_sub_period_solve(self, tmp_path):
+        # 40Mf at scale 1.0 (100Mf/s) takes 0.4 s, inside the first chunk.
+        plat = self._plat(tmp_path, "0.0 1.0\n0.5 0.5")
+        assert self._run_exec(plat, 40e6) == pytest.approx(0.4, rel=1e-6)
+
+    def test_crossing_chunk_boundary(self, tmp_path):
+        # 62.5Mf: 50Mf in [0,0.5] at full speed, the remaining 12.5Mf at
+        # 50Mf/s takes 0.25 s -> finish at 0.75 s.
+        plat = self._plat(tmp_path, "0.0 1.0\n0.5 0.5")
+        assert self._run_exec(plat, 62.5e6) == pytest.approx(0.75, rel=1e-6)
+
+    def test_two_actions_share(self, tmp_path):
+        plat = self._plat(tmp_path, "0.0 1.0\n0.5 0.5")
+        res = {}
+
+        def worker(name):
+            s4u.this_actor.execute(75e6)
+            res[name] = s4u.Engine.get_clock()
+
+        e = s4u.Engine(["t", "--cfg=cpu/optim:TI"])
+        e.load_platform(plat)
+        s4u.Actor.create("w1", e.host_by_name("h"), worker, "w1")
+        s4u.Actor.create("w2", e.host_by_name("h"), worker, "w2")
+        e.run()
+        # both get half the integrated area: 2x75Mf = 150Mf total -> 2 s
+        assert res["w1"] == pytest.approx(2.0, rel=1e-6)
+        assert res["w2"] == pytest.approx(2.0, rel=1e-6)
+
+
+class TestPtaskL07:
+    def _engine(self, cfg=()):
+        e = s4u.Engine(["t", "--cfg=host/model:ptask_L07"] +
+                       [f"--cfg={c}" for c in cfg])
+        e.load_platform(TRIANGLE)
+        return e
+
+    def test_single_exec(self):
+        res = {}
+
+        def worker():
+            s4u.this_actor.execute(50e6)   # alpha: 100Mf/s -> 0.5 s
+            res["t"] = s4u.Engine.get_clock()
+
+        e = self._engine()
+        s4u.Actor.create("w", e.host_by_name("alpha"), worker)
+        e.run()
+        assert res["t"] == pytest.approx(0.5, rel=1e-6)
+
+    def test_parallel_task_couples_cpu_and_network(self):
+        res = {}
+
+        def worker():
+            hosts = [s4u.Engine._instance.host_by_name("alpha"),
+                     s4u.Engine._instance.host_by_name("beta")]
+            # 100Mf on alpha (1s alone), 50Mf on beta (1s alone at 50Mf/s),
+            # and 10MB alpha->beta over ab+shared (min bw 8MBps -> 1.25s).
+            flops = [100e6, 50e6]
+            bytes_ = [0.0, 10e6, 0.0, 0.0]
+            s4u.this_actor.parallel_execute(hosts, flops, bytes_)
+            res["t"] = s4u.Engine.get_clock()
+
+        e = self._engine()
+        s4u.Actor.create("w", e.host_by_name("alpha"), worker)
+        e.run()
+        # The ptask finishes when its slowest component does: the 10MB
+        # transfer through the 8MBps shared link (1.25 s) plus latency.
+        assert res["t"] == pytest.approx(1.25, rel=1e-2)
+        assert res["t"] > 1.0
+
+    def test_comm_via_ptask_model(self):
+        res = {}
+
+        def sender(mb):
+            mb.put("x", 8e6)
+
+        def receiver(mb):
+            mb.get()
+            res["t"] = s4u.Engine.get_clock()
+
+        e = self._engine()
+        mb = s4u.Mailbox.by_name("mb")
+        s4u.Actor.create("s", e.host_by_name("alpha"), sender, mb)
+        s4u.Actor.create("r", e.host_by_name("gamma"), receiver, mb)
+        e.run()
+        # route alpha->gamma: ab (10MB) + shared (8MB) + bc (5MB): the
+        # bottleneck gives 8e6/5e6 = 1.6 s plus the 3.5 ms latency.
+        assert res["t"] == pytest.approx(1.6 + 0.0035, rel=1e-3)
+
+    def test_fair_bottleneck_two_flows(self):
+        """Two flows sharing one 8MBps link while each also crosses a
+        private link: fair-bottleneck splits the shared link evenly."""
+        res = {}
+
+        def sender(mb, nbytes):
+            mb.put("x", nbytes)
+
+        def receiver(mb, key):
+            mb.get()
+            res[key] = s4u.Engine.get_clock()
+
+        e = self._engine()
+        mb1 = s4u.Mailbox.by_name("m1")
+        mb2 = s4u.Mailbox.by_name("m2")
+        s4u.Actor.create("s1", e.host_by_name("alpha"), sender, mb1, 4e6)
+        s4u.Actor.create("s2", e.host_by_name("beta"), sender, mb2, 4e6)
+        s4u.Actor.create("r1", e.host_by_name("beta"), receiver, mb1, "f1")
+        s4u.Actor.create("r2", e.host_by_name("gamma"), receiver, mb2, "f2")
+        e.run()
+        # each flow gets 4MBps of the shared link: 4e6/4e6 = 1 s-ish
+        assert res["f1"] == pytest.approx(1.0, rel=5e-2)
+        assert res["f2"] == pytest.approx(1.0, rel=5e-2)
