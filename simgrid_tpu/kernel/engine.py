@@ -81,7 +81,27 @@ class EngineImpl:
         self.storages: Dict[str, object] = {}
         self.netzone_root = None
         self._breakpoint = -1.0
+        # (signal, fn) pairs auto-disconnected on engine teardown: models
+        # and plugins hook class-level signals through here so a dead
+        # engine's callbacks never fire into a fresh engine (the reference
+        # installs its hooks once per process, network_ib.cpp:17-54; we
+        # support many engines per process for tests/MC branches).
+        self._signal_connections: List = []
         _log.clock_getter = lambda: self.now
+
+    # -- engine-scoped signal subscriptions ------------------------------
+    def connect_signal(self, signal, fn) -> None:
+        """Connect fn to a (class-level) signal for this engine's lifetime."""
+        signal.connect(fn)
+        self._signal_connections.append((signal, fn))
+
+    def disconnect_signals(self) -> None:
+        for signal, fn in self._signal_connections:
+            try:
+                signal.disconnect(fn)
+            except ValueError:
+                pass
+        self._signal_connections.clear()
 
     # ------------------------------------------------------------------
     def next_pid(self) -> int:

@@ -53,33 +53,39 @@ class NetworkIBModel(NetworkSmpiModel):
         from .host import Host
         model = self
 
+        def register(host) -> _IBNode:
+            node = model.active_nodes.get(host.name)
+            if node is None:
+                node = _IBNode(len(model.active_nodes))
+                model.active_nodes[host.name] = node
+            return node
+
         def on_host_creation(host):
-            if model.engine.network_model is model:
-                model.active_nodes[host.name] = _IBNode(
-                    len(model.active_nodes))
-        Host.on_creation.connect(on_host_creation)
+            register(host)
+
+        # Engine-scoped subscriptions: auto-disconnected on engine
+        # teardown, so stale IB models never fire into later engines.
+        engine.connect_signal(Host.on_creation, on_host_creation)
 
         def on_communicate(action, src, dst):
-            # reference IB_action_init_callback (network_ib.cpp:44-53)
-            if model.engine.network_model is not model:
-                return
-            a_src = model.active_nodes[src.name]
-            a_dst = model.active_nodes[dst.name]
+            # reference IB_action_init_callback (network_ib.cpp:44-53);
+            # hosts created before the model (or by paths that don't fire
+            # on_creation) are registered lazily.
+            a_src = register(src)
+            a_dst = register(dst)
             model.active_comms[action] = (a_src, a_dst)
             model.update_IB_factors(action, a_src, a_dst, remove=False)
-        LinkImpl.on_communicate.connect(on_communicate)
+        engine.connect_signal(LinkImpl.on_communicate, on_communicate)
 
         def on_state_change(action):
             # reference IB_action_state_changed_callback (:28-42)
-            if model.engine.network_model is not model:
-                return
             if action.get_state() != ActionState.FINISHED:
                 return
             pair = model.active_comms.pop(action, None)
             if pair is not None:
                 model.update_IB_factors(action, pair[0], pair[1],
                                         remove=True)
-        NetworkAction.on_state_change.connect(on_state_change)
+        engine.connect_signal(NetworkAction.on_state_change, on_state_change)
 
     # -- penalty machinery (network_ib.cpp:115-214) -----------------------
     def compute_IB_factors(self, root: _IBNode) -> None:

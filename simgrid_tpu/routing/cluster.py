@@ -208,6 +208,11 @@ def parse_cluster_tag(loader, elem, father) -> None:
         zone.create_links_for_node(
             name, node_id, rank, zone.node_pos_with_loopback_limiter(rank),
             sharing_policy, bw_value, lat_value)
+        # Completion signal fires last, after links/rank wiring, matching
+        # the <host> tag path (platform/xml.py) so listeners observe a
+        # fully-built node (sg_platf.cpp fires s4u::Host::on_creation for
+        # cluster nodes too — IB model and energy plugin key off it).
+        Host.on_creation(host)
 
     # cluster router (for inter-zone routing)
     router_name = elem.get("router_id") or f"{prefix}{name}_router{suffix}"
@@ -252,3 +257,5 @@ def parse_peer_tag(loader, elem, father) -> None:
     father.set_peer_link(host.netpoint,
                          parse_bandwidth(elem.get("bw_in")),
                          parse_bandwidth(elem.get("bw_out")))
+    # Fires last so listeners observe coords + peer links (see cluster path).
+    Host.on_creation(host)

@@ -27,6 +27,11 @@ class Engine:
     on_deadlock = EngineImpl.on_deadlock
 
     def __init__(self, argv: Optional[List[str]] = None):
+        # Replacing the engine singleton retires the previous engine: its
+        # signal subscriptions must not fire into this one (same guarantee
+        # as _reset, for code that constructs engines back-to-back).
+        if Engine._instance is not None:
+            Engine._instance.pimpl.disconnect_signals()
         self.pimpl = EngineImpl()
         self._registered_functions: Dict[str, Callable] = {}
         self._default_function: Optional[Callable] = None
@@ -50,6 +55,8 @@ class Engine:
         normal use, like the reference)."""
         from ..kernel import profile as profile_mod
         from .mailbox import Mailbox
+        if cls._instance is not None:
+            cls._instance.pimpl.disconnect_signals()
         cls._instance = None
         EngineImpl.instance = None
         Mailbox._instances.clear()

@@ -207,7 +207,7 @@ declare_flag("lmm/rounds",
              "level per round, the reference's sequential order) or local "
              "(fix every local-minimum constraint per round; exact because "
              "rou levels only increase, and far fewer device rounds)",
-             "local")
+             "global")
 declare_flag("contexts/stack-size", "Actor stack size (bytes)", 131072)
 declare_flag("contexts/factory", "Actor context factory (thread)", "thread")
 declare_flag("tracing", "Enable tracing", False)

@@ -1,0 +1,102 @@
+"""Engine lifecycle: serial engines with different network models must not
+leak state (signals, singletons) into each other.
+
+Regression for the round-1 failure where NetworkIBModel's class-level
+signal subscriptions outlived their engine and crashed every later engine
+in the process (reference installs hooks once per process,
+network_ib.cpp:17-54; we scope them to the engine instead)."""
+
+import os
+
+import pytest
+
+from simgrid_tpu import s4u
+from simgrid_tpu.models.host import Host
+from simgrid_tpu.models.network import LinkImpl, NetworkAction
+
+HERE = os.path.dirname(__file__)
+
+
+@pytest.fixture(autouse=True)
+def fresh_engine():
+    s4u.Engine._reset()
+    yield
+    s4u.Engine._reset()
+
+
+def _cluster_platform(tmp_path):
+    xml = """<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <cluster id="c" prefix="node-" suffix="" radical="0-3"
+             speed="1Gf" bw="125MBps" lat="50us"/>
+  </zone>
+</platform>
+"""
+    path = os.path.join(tmp_path, "cluster.xml")
+    with open(path, "w") as f:
+        f.write(xml)
+    return path
+
+
+def _run_pingpong(platform, model):
+    res = {}
+
+    def sender(mb):
+        mb.put("x", 1_000_000)
+
+    def receiver(mb):
+        mb.get()
+        res["t"] = s4u.Engine.get_clock()
+
+    e = s4u.Engine(["t", f"--cfg=network/model:{model}"])
+    e.load_platform(platform)
+    mb = s4u.Mailbox.by_name("mb")
+    s4u.Actor.create("s", e.host_by_name("node-0"), sender, mb)
+    s4u.Actor.create("r", e.host_by_name("node-1"), receiver, mb)
+    e.run()
+    return res["t"]
+
+
+def _slot_count():
+    return (len(Host.on_creation._slots)
+            + len(LinkImpl.on_communicate._slots)
+            + len(NetworkAction.on_state_change._slots))
+
+
+def test_ib_on_cluster_platform(tmp_path):
+    """The IB model must work on <cluster> platforms (the canonical IB
+    shape): cluster-created hosts register in active_nodes."""
+    plat = _cluster_platform(tmp_path)
+    t = _run_pingpong(plat, "IB")
+    assert t > 0
+
+
+def test_three_engines_serially_different_models(tmp_path):
+    """IB -> CM02 -> SMPI in one process: each run works and no signal
+    subscriptions accumulate across engines."""
+    plat = _cluster_platform(tmp_path)
+    base = _slot_count()
+    times = {}
+    for model in ("IB", "CM02", "SMPI"):
+        times[model] = _run_pingpong(plat, model)
+        s4u.Engine._reset()
+        assert _slot_count() == base, \
+            f"signal subscriptions leaked after {model} run"
+    # All three produced a sane, model-dependent completion time.
+    assert times["CM02"] > 0
+    assert times["IB"] > 0
+    assert times["SMPI"] > 0
+
+
+def test_ib_then_cm02_interleaved_hosts(tmp_path):
+    """After an IB engine is torn down, a CM02 engine's host creation must
+    not touch the dead IB model's tables."""
+    plat = _cluster_platform(tmp_path)
+    _run_pingpong(plat, "IB")
+    ib_model = s4u.Engine._instance.pimpl.network_model
+    n_nodes = len(ib_model.active_nodes)
+    s4u.Engine._reset()
+    _run_pingpong(plat, "CM02")
+    assert len(ib_model.active_nodes) == n_nodes, \
+        "dead IB model kept registering hosts from the new engine"

@@ -357,3 +357,32 @@ def test_round_modes_match_oracle(seed, rounds_mode):
     exact = np.array([v.value for v in v_exact])
     vect = np.array([v.value for v in v_jax])
     np.testing.assert_allclose(vect, exact, rtol=1e-9, atol=1e-9)
+
+
+@pytest.mark.parametrize("rounds_mode", ["global", "local"])
+@pytest.mark.parametrize("seed,n_c,n_v,p_bound,p_fat", [
+    (10, 100, 300, 0.0, 0.0),    # plain shared constraints at scale
+    (11, 100, 300, 0.8, 0.0),    # bound-heavy (bound-first rule stress)
+    (12, 100, 300, 0.0, 0.8),    # FATPIPE-heavy (max-sharing stress)
+    (13, 150, 400, 0.5, 0.5),    # heavy mix of both
+    (14, 60, 600, 0.3, 0.2),     # many variables per constraint
+])
+def test_round_modes_match_oracle_large(seed, n_c, n_v, p_bound, p_fat,
+                                        rounds_mode):
+    """Larger randomized systems with heavy bound/FATPIPE mixes: both round
+    strategies must still agree with the exact list solver (validates the
+    local-minimum mode's tie-breaking corners beyond the 20x60 smoke
+    matrix)."""
+    from simgrid_tpu.utils.config import config
+    config["lmm/rounds"] = rounds_mode
+    rng = np.random.default_rng(seed)
+    s_exact, v_exact = _random_system(rng, n_c, n_v, backend="list",
+                                      p_bound=p_bound, p_fat=p_fat)
+    rng = np.random.default_rng(seed)
+    s_jax, v_jax = _random_system(rng, n_c, n_v, backend="jax",
+                                  p_bound=p_bound, p_fat=p_fat)
+    s_exact.solve()
+    s_jax.solve()
+    exact = np.array([v.value for v in v_exact])
+    vect = np.array([v.value for v in v_jax])
+    np.testing.assert_allclose(vect, exact, rtol=1e-9, atol=1e-9)
