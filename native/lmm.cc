@@ -1,0 +1,610 @@
+// Native (C++) exact max-min fairness solver.
+//
+// Host-side companion of the JAX/TPU fixpoint (simgrid_tpu/ops/lmm_jax.py):
+// the framework dispatches small live sets to this solver and large ones to
+// the device, so the crossover floor is native-speed, not Python-speed.
+// Also the measured stand-in for the reference's C++ solver in
+// BASELINE_MEASURED.md (the reference itself needs boost::intrusive, absent
+// here): same algorithm — the saturate-bottleneck fixpoint with bound-first
+// rounds, epsilon double_update semantics, concurrency limits/shares and
+// FATPIPE max-sharing (reference semantics:
+// /root/reference/src/kernel/lmm/maxmin.cpp:502-693, concurrency
+// maxmin.hpp:104-129) — but an arena/index design (flat vectors, integer
+// handles, index-linked element lists, swap-erase light list) instead of
+// boost intrusive lists.  List orderings (enabled push-front, disabled
+// push-back, staged wake-up scan order) mirror the Python host solver
+// (simgrid_tpu/ops/lmm_host.py), which is the validated oracle.
+//
+// Exposed as a C ABI for ctypes:
+//   * incremental ops (constraint_new/variable_new/expand/solve/value) used
+//     by the maxmin_bench replica driver;
+//   * one-shot lmm_solve_coo() over flattened arrays used as the Python
+//     System's "native" backend (same handoff shape as the JAX backend).
+
+#include <cassert>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <vector>
+
+namespace {
+
+inline double double_update(double value, double delta, double precision) {
+  value -= delta;
+  if (value < precision)
+    value = 0.0;
+  return value;
+}
+
+inline bool double_positive(double value, double precision) {
+  return value > precision;
+}
+
+inline bool double_equals(double a, double b, double precision) {
+  return std::fabs(a - b) < precision;
+}
+
+enum class ListId : uint8_t { kNone, kEnabled, kDisabled };
+
+struct Element {
+  int32_t cnst = -1;
+  int32_t var = -1;
+  double consumption_weight = 0.0;
+  // membership in the constraint's enabled/disabled element list
+  ListId list = ListId::kNone;
+  int32_t prev = -1;
+  int32_t next = -1;
+  bool active = false;  // participates in the current solve round
+
+  // weight < 1 (e.g. cross-traffic at 0.05) does not count toward the
+  // constraint's concurrency (maxmin.cpp:30-40).
+  int32_t concurrency() const { return consumption_weight >= 1 ? 1 : 0; }
+};
+
+struct ElemList {
+  int32_t head = -1;
+  int32_t tail = -1;
+  int32_t size = 0;
+};
+
+struct Constraint {
+  double bound = 0.0;
+  bool fatpipe = false;
+  int32_t concurrency_limit = -1;  // -1 = unlimited
+  int32_t concurrency_current = 0;
+  int32_t concurrency_maximum = 0;
+  ElemList enabled;
+  ElemList disabled;
+  // solve scratch
+  double remaining = 0.0;
+  double usage = 0.0;
+  int32_t light_idx = -1;
+  bool active = false;
+};
+
+struct Variable {
+  double sharing_penalty = 0.0;
+  double staged_penalty = 0.0;
+  double bound = -1.0;
+  double value = 0.0;
+  int32_t concurrency_share = 1;
+  std::vector<int32_t> elems;  // element indices, creation order
+  bool saturated = false;
+};
+
+struct LightEntry {
+  int32_t cnst;
+  double rou;  // remaining / usage
+};
+
+class System {
+ public:
+  explicit System(double precision) : eps_(precision) {}
+
+  // -- element list plumbing (index-linked, per constraint) ---------------
+  ElemList& list_of(Element& e) {
+    Constraint& c = cnsts_[e.cnst];
+    return e.list == ListId::kEnabled ? c.enabled : c.disabled;
+  }
+
+  void list_push_front(ElemList& l, int32_t ei, ListId which) {
+    Element& e = elems_[ei];
+    e.list = which;
+    e.prev = -1;
+    e.next = l.head;
+    if (l.head >= 0)
+      elems_[l.head].prev = ei;
+    l.head = ei;
+    if (l.tail < 0)
+      l.tail = ei;
+    ++l.size;
+  }
+
+  void list_push_back(ElemList& l, int32_t ei, ListId which) {
+    Element& e = elems_[ei];
+    e.list = which;
+    e.next = -1;
+    e.prev = l.tail;
+    if (l.tail >= 0)
+      elems_[l.tail].next = ei;
+    l.tail = ei;
+    if (l.head < 0)
+      l.head = ei;
+    ++l.size;
+  }
+
+  void list_remove(int32_t ei) {
+    Element& e = elems_[ei];
+    if (e.list == ListId::kNone)
+      return;
+    ElemList& l = list_of(e);
+    if (e.prev >= 0)
+      elems_[e.prev].next = e.next;
+    else
+      l.head = e.next;
+    if (e.next >= 0)
+      elems_[e.next].prev = e.prev;
+    else
+      l.tail = e.prev;
+    --l.size;
+    e.list = ListId::kNone;
+    e.prev = e.next = -1;
+  }
+
+  // -- construction -------------------------------------------------------
+  int32_t constraint_new(double bound) {
+    Constraint c;
+    c.bound = bound;
+    cnsts_.push_back(std::move(c));
+    return static_cast<int32_t>(cnsts_.size() - 1);
+  }
+
+  int32_t variable_new(double sharing_penalty, double bound) {
+    Variable v;
+    v.sharing_penalty = sharing_penalty > 0 ? sharing_penalty : 0.0;
+    v.bound = bound;
+    vars_.push_back(std::move(v));
+    return static_cast<int32_t>(vars_.size() - 1);
+  }
+
+  void variable_set_share(int32_t v, int32_t share) {
+    vars_[v].concurrency_share = share;
+  }
+
+  void constraint_set_limit(int32_t c, int32_t limit) {
+    cnsts_[c].concurrency_limit = limit;
+  }
+
+  void constraint_set_fatpipe(int32_t c, bool fat) {
+    cnsts_[c].fatpipe = fat;
+  }
+
+  int32_t concurrency_slack(const Constraint& c) const {
+    if (c.concurrency_limit < 0)
+      return INT32_MAX;
+    return c.concurrency_limit - c.concurrency_current;
+  }
+
+  int32_t min_concurrency_slack(const Variable& v) const {
+    int32_t minslack = INT32_MAX;
+    for (int32_t ei : v.elems) {
+      int32_t slack = concurrency_slack(cnsts_[elems_[ei].cnst]);
+      if (slack < minslack)
+        minslack = slack;
+    }
+    return minslack;
+  }
+
+  bool can_enable(const Variable& v) const {
+    return v.staged_penalty > 0 &&
+           min_concurrency_slack(v) >= v.concurrency_share;
+  }
+
+  void increase_concurrency(int32_t ei) {
+    Element& e = elems_[ei];
+    Constraint& c = cnsts_[e.cnst];
+    c.concurrency_current += e.concurrency();
+    if (c.concurrency_current > c.concurrency_maximum)
+      c.concurrency_maximum = c.concurrency_current;
+  }
+
+  void decrease_concurrency(int32_t ei) {
+    Element& e = elems_[ei];
+    cnsts_[e.cnst].concurrency_current -= e.concurrency();
+  }
+
+  void expand(int32_t c, int32_t v, double weight) {
+    // lmm_host.System.expand (maxmin.cpp:234-285 behavior)
+    modified_ = true;
+    Variable& var = vars_[v];
+    Constraint& cnst = cnsts_[c];
+
+    int32_t current_share = 0;
+    if (var.concurrency_share > 1)
+      for (int32_t ei : var.elems)
+        if (elems_[ei].cnst == c && elems_[ei].list == ListId::kEnabled)
+          current_share += elems_[ei].concurrency();
+
+    if (var.sharing_penalty > 0 &&
+        var.concurrency_share - current_share > concurrency_slack(cnst)) {
+      double penalty = var.sharing_penalty;
+      disable_var(v);
+      for (int32_t ei : var.elems)
+        on_disabled_var(elems_[ei].cnst);
+      weight = 0.0;
+      var.staged_penalty = penalty;
+    }
+
+    Element e;
+    e.cnst = c;
+    e.var = v;
+    e.consumption_weight = weight;
+    int32_t ei = static_cast<int32_t>(elems_.size());
+    elems_.push_back(e);
+    var.elems.push_back(ei);
+
+    if (var.sharing_penalty > 0) {
+      list_push_front(cnst.enabled, ei, ListId::kEnabled);
+      increase_concurrency(ei);
+    } else {
+      list_push_back(cnst.disabled, ei, ListId::kDisabled);
+    }
+    if (!cnst.active) {
+      cnst.active = true;
+      active_cnsts_.push_back(c);
+    }
+  }
+
+  void expand_add(int32_t c, int32_t v, double weight) {
+    // lmm_host.System.expand_add
+    modified_ = true;
+    Variable& var = vars_[v];
+    int32_t found = -1;
+    for (int32_t ei : var.elems)
+      if (elems_[ei].cnst == c) {
+        found = ei;
+        break;
+      }
+    if (found < 0) {
+      expand(c, v, weight);
+      return;
+    }
+    Element& e = elems_[found];
+    if (var.sharing_penalty > 0)
+      decrease_concurrency(found);
+    if (!cnsts_[c].fatpipe)
+      e.consumption_weight += weight;
+    else if (e.consumption_weight < weight)
+      e.consumption_weight = weight;
+    if (var.sharing_penalty > 0) {
+      if (concurrency_slack(cnsts_[c]) < e.concurrency()) {
+        double penalty = var.sharing_penalty;
+        disable_var(v);
+        for (int32_t ei : var.elems)
+          on_disabled_var(elems_[ei].cnst);
+        var.staged_penalty = penalty;
+      }
+      increase_concurrency(found);
+    }
+  }
+
+  void enable_var(int32_t v) {
+    Variable& var = vars_[v];
+    var.sharing_penalty = var.staged_penalty;
+    var.staged_penalty = 0.0;
+    for (int32_t ei : var.elems) {
+      list_remove(ei);
+      list_push_front(cnsts_[elems_[ei].cnst].enabled, ei, ListId::kEnabled);
+      increase_concurrency(ei);
+    }
+  }
+
+  void disable_var(int32_t v) {
+    // NB: unlike enable, callers trigger on_disabled_var themselves
+    // (mirrors lmm_host.System.disable_var).
+    Variable& var = vars_[v];
+    for (int32_t ei : var.elems) {
+      Element& e = elems_[ei];
+      list_remove(ei);
+      list_push_back(cnsts_[e.cnst].disabled, ei, ListId::kDisabled);
+      e.active = false;
+      decrease_concurrency(ei);
+    }
+    var.sharing_penalty = 0.0;
+    var.staged_penalty = 0.0;
+    var.value = 0.0;
+  }
+
+  void on_disabled_var(int32_t c) {
+    // Wake staged variables now that a slot freed up
+    // (lmm_host.System.on_disabled_var).
+    Constraint& cnst = cnsts_[c];
+    if (cnst.concurrency_limit < 0)
+      return;
+    int32_t numelem = cnst.disabled.size;
+    int32_t ei = cnst.disabled.head;
+    while (numelem > 0 && ei >= 0) {
+      --numelem;
+      int32_t next = elems_[ei].next;
+      Variable& var = vars_[elems_[ei].var];
+      if (var.staged_penalty > 0 && can_enable(var))
+        enable_var(elems_[ei].var);  // unlinks ei from this list
+      if (cnst.concurrency_current == cnst.concurrency_limit)
+        break;
+      ei = next;
+    }
+  }
+
+  void variable_free(int32_t v) {
+    // lmm_host.System._var_free
+    modified_ = true;
+    Variable& var = vars_[v];
+    for (int32_t ei : var.elems) {
+      if (var.sharing_penalty > 0)
+        decrease_concurrency(ei);
+      list_remove(ei);
+      Constraint& c = cnsts_[elems_[ei].cnst];
+      if (c.enabled.size + c.disabled.size > 0)
+        on_disabled_var(elems_[ei].cnst);
+    }
+    var.elems.clear();
+    var.sharing_penalty = 0.0;
+    var.staged_penalty = 0.0;
+  }
+
+  double value(int32_t v) const { return vars_[v].value; }
+
+  void solve() {
+    if (!modified_)
+      return;
+    solve_list(active_cnsts_);
+    modified_ = false;
+  }
+
+ public:
+  // The saturate-bottleneck fixpoint (maxmin.cpp:502-693 semantics).
+  void solve_list(const std::vector<int32_t>& cnst_list) {
+    light_.clear();
+    saturated_cnsts_.clear();
+    saturated_vars_.clear();
+
+    for (int32_t ci : cnst_list)
+      for (int32_t ei = cnsts_[ci].enabled.head; ei >= 0;
+           ei = elems_[ei].next)
+        vars_[elems_[ei].var].value = 0.0;
+
+    double min_usage = -1.0;
+    double min_bound = -1.0;
+
+    for (int32_t ci : cnst_list) {
+      Constraint& c = cnsts_[ci];
+      c.light_idx = -1;
+      c.remaining = c.bound;
+      if (!double_positive(c.remaining, c.bound * eps_))
+        continue;
+      c.usage = 0.0;
+      for (int32_t ei = c.enabled.head; ei >= 0; ei = elems_[ei].next) {
+        Element& e = elems_[ei];
+        if (e.consumption_weight > 0) {
+          double w = e.consumption_weight / vars_[e.var].sharing_penalty;
+          if (!c.fatpipe)
+            c.usage += w;
+          else if (c.usage < w)
+            c.usage = w;
+          e.active = true;
+        }
+      }
+      if (c.usage > 0) {
+        c.light_idx = static_cast<int32_t>(light_.size());
+        light_.push_back({ci, c.remaining / c.usage});
+        saturated_constraint_update(light_.back().rou, c.light_idx,
+                                    &min_usage);
+      }
+    }
+    light_size_ = light_.size();
+    saturated_variable_set_update();
+
+    while (true) {
+      for (int32_t v : saturated_vars_) {
+        Variable& var = vars_[v];
+        if (var.bound > 0 && var.bound * var.sharing_penalty < min_usage) {
+          double bp = var.bound * var.sharing_penalty;
+          if (min_bound < 0 || bp < min_bound)
+            min_bound = bp;
+        }
+      }
+
+      for (int32_t v : saturated_vars_) {
+        Variable& var = vars_[v];
+        var.saturated = false;
+        if (min_bound < 0) {
+          var.value = min_usage / var.sharing_penalty;
+        } else if (double_equals(min_bound, var.bound * var.sharing_penalty,
+                                 eps_)) {
+          var.value = var.bound;
+        } else {
+          continue;  // not part of this bound-first round
+        }
+
+        for (int32_t ei : var.elems) {
+          Element& e = elems_[ei];
+          if (e.list != ListId::kEnabled)
+            continue;
+          Constraint& c = cnsts_[e.cnst];
+          if (!c.fatpipe) {
+            c.remaining = double_update(
+                c.remaining, e.consumption_weight * var.value,
+                c.bound * eps_);
+            c.usage = double_update(
+                c.usage, e.consumption_weight / var.sharing_penalty, eps_);
+            e.active = false;
+            light_update(c);
+          } else {
+            c.usage = 0.0;
+            e.active = false;
+            for (int32_t ei2 = c.enabled.head; ei2 >= 0;
+                 ei2 = elems_[ei2].next) {
+              const Element& e2 = elems_[ei2];
+              if (vars_[e2.var].value > 0 || e2.consumption_weight <= 0)
+                continue;
+              double w = e2.consumption_weight / vars_[e2.var].sharing_penalty;
+              if (c.usage < w)
+                c.usage = w;
+            }
+            light_update(c);
+          }
+        }
+      }
+      saturated_vars_.clear();
+
+      min_usage = -1.0;
+      min_bound = -1.0;
+      saturated_cnsts_.clear();
+      for (size_t pos = 0; pos < light_size_; ++pos)
+        saturated_constraint_update(light_[pos].rou,
+                                    static_cast<int32_t>(pos), &min_usage);
+      saturated_variable_set_update();
+      if (light_size_ == 0)
+        break;
+    }
+  }
+
+ private:
+  // swap-erase a constraint out of the light list when it empties,
+  // else refresh its rou.
+  void light_update(Constraint& c) {
+    if (!double_positive(c.usage, eps_) ||
+        !double_positive(c.remaining, c.bound * eps_)) {
+      if (c.light_idx >= 0) {
+        int32_t idx = c.light_idx;
+        light_[idx] = light_[light_size_ - 1];
+        cnsts_[light_[idx].cnst].light_idx = idx;
+        --light_size_;
+        c.light_idx = -1;
+      }
+    } else if (c.light_idx >= 0) {
+      light_[c.light_idx].rou = c.remaining / c.usage;
+    }
+  }
+
+  void saturated_constraint_update(double rou, int32_t pos,
+                                   double* min_usage) {
+    // reference saturated_constraints_update (maxmin.cpp:397-417)
+    if (rou <= 0)
+      return;
+    if (*min_usage < 0 || *min_usage > rou) {
+      *min_usage = rou;
+      saturated_cnsts_.clear();
+      saturated_cnsts_.push_back(pos);
+    } else if (*min_usage == rou) {
+      saturated_cnsts_.push_back(pos);
+    }
+  }
+
+  void saturated_variable_set_update() {
+    // reference saturated_variable_set_update (maxmin.cpp:419-430)
+    for (int32_t pos : saturated_cnsts_) {
+      const Constraint& c = cnsts_[light_[pos].cnst];
+      for (int32_t ei = c.enabled.head; ei >= 0; ei = elems_[ei].next) {
+        const Element& e = elems_[ei];
+        if (e.active && !vars_[e.var].saturated) {
+          vars_[e.var].saturated = true;
+          saturated_vars_.push_back(e.var);
+        }
+      }
+    }
+  }
+
+ public:
+  double eps_;
+  bool modified_ = false;
+  std::vector<Constraint> cnsts_;
+  std::vector<Variable> vars_;
+  std::vector<Element> elems_;
+  std::vector<int32_t> active_cnsts_;
+  std::vector<LightEntry> light_;
+  size_t light_size_ = 0;
+  std::vector<int32_t> saturated_cnsts_;  // positions in light_
+  std::vector<int32_t> saturated_vars_;
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* lmm_system_new(double precision) { return new System(precision); }
+
+void lmm_system_free(void* sys) { delete static_cast<System*>(sys); }
+
+int32_t lmm_constraint_new(void* sys, double bound) {
+  return static_cast<System*>(sys)->constraint_new(bound);
+}
+
+void lmm_constraint_set_limit(void* sys, int32_t c, int32_t limit) {
+  static_cast<System*>(sys)->constraint_set_limit(c, limit);
+}
+
+void lmm_constraint_set_fatpipe(void* sys, int32_t c, int32_t fat) {
+  static_cast<System*>(sys)->constraint_set_fatpipe(c, fat != 0);
+}
+
+int32_t lmm_variable_new(void* sys, double penalty, double bound) {
+  return static_cast<System*>(sys)->variable_new(penalty, bound);
+}
+
+void lmm_variable_set_share(void* sys, int32_t v, int32_t share) {
+  static_cast<System*>(sys)->variable_set_share(v, share);
+}
+
+void lmm_expand(void* sys, int32_t c, int32_t v, double w) {
+  static_cast<System*>(sys)->expand(c, v, w);
+}
+
+void lmm_expand_add(void* sys, int32_t c, int32_t v, double w) {
+  static_cast<System*>(sys)->expand_add(c, v, w);
+}
+
+void lmm_variable_free(void* sys, int32_t v) {
+  static_cast<System*>(sys)->variable_free(v);
+}
+
+void lmm_solve(void* sys) { static_cast<System*>(sys)->solve(); }
+
+double lmm_variable_value(void* sys, int32_t v) {
+  return static_cast<System*>(sys)->value(v);
+}
+
+// One-shot solve over flattened COO arrays: the Python System's "native"
+// backend entry (same flatten/solve/scatter handoff as the JAX backend —
+// concurrency staging already happened host-side, only enabled elements
+// arrive here).
+int32_t lmm_solve_coo(int32_t n_c, int32_t n_v, int32_t n_e,
+                      const int32_t* e_var, const int32_t* e_cnst,
+                      const double* e_w, const double* c_bound,
+                      const uint8_t* c_fatpipe, const double* v_penalty,
+                      const double* v_bound, double eps, double* values_out,
+                      double* c_remaining_out, double* c_usage_out) {
+  System sys(eps);
+  for (int32_t i = 0; i < n_c; ++i) {
+    int32_t c = sys.constraint_new(c_bound[i]);
+    sys.constraint_set_fatpipe(c, c_fatpipe[i] != 0);
+  }
+  for (int32_t i = 0; i < n_v; ++i)
+    sys.variable_new(v_penalty[i], v_bound[i]);
+  for (int32_t k = 0; k < n_e; ++k)
+    sys.expand_add(e_cnst[k], e_var[k], e_w[k]);
+  sys.solve();
+  for (int32_t i = 0; i < n_v; ++i)
+    values_out[i] = sys.value(i);
+  for (int32_t i = 0; i < n_c; ++i) {
+    c_remaining_out[i] = sys.cnsts_[i].remaining;
+    c_usage_out[i] = sys.cnsts_[i].usage;
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,172 @@
+// maxmin_bench replica: drives the native solver through the exact same
+// system-construction protocol as the reference's benchmark
+// (/root/reference/teshsuite/surf/maxmin_bench/maxmin_bench.cpp:37-129) —
+// same LCG (Lehmer 16807 mod 2^31-1, seeded per iteration), same four
+// classes (small/medium/big/huge), same concurrency-limit draws — so the
+// timed solves run on structurally identical systems and the numbers are
+// comparable across the reference, this native solver, the Python host
+// solver and the JAX backends (see BASELINE_MEASURED.md).
+//
+// Usage: maxmin_bench <small|medium|big|huge> <count> [test|perf]
+
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+void* lmm_system_new(double precision);
+void lmm_system_free(void* sys);
+int32_t lmm_constraint_new(void* sys, double bound);
+void lmm_constraint_set_limit(void* sys, int32_t c, int32_t limit);
+int32_t lmm_variable_new(void* sys, double penalty, double bound);
+void lmm_variable_set_share(void* sys, int32_t v, int32_t share);
+void lmm_expand(void* sys, int32_t c, int32_t v, double w);
+void lmm_expand_add(void* sys, int32_t c, int32_t v, double w);
+void lmm_variable_free(void* sys, int32_t v);
+void lmm_solve(void* sys);
+double lmm_variable_value(void* sys, int32_t v);
+}
+
+static int64_t seedx = 0;
+static double date_us = 0;
+
+static int myrand() {
+  seedx = seedx * 16807 % 2147483647;
+  return static_cast<int32_t>(seedx % 1000);
+}
+
+static double float_random(double max) {
+  constexpr double MYRANDMAX = 1000.0;
+  return ((max * myrand()) / (MYRANDMAX + 1.0));
+}
+
+static unsigned int int_random(int max) {
+  return static_cast<uint32_t>(float_random(max));
+}
+
+static void test(int nb_cnst, int nb_var, int nb_elem,
+                 unsigned pw_base_limit, unsigned pw_max_limit,
+                 float rate_no_limit, int max_share, int mode) {
+  std::vector<int32_t> cnst(nb_cnst);
+  std::vector<int32_t> var(nb_var);
+  std::vector<int> used(nb_cnst);
+
+  void* sys = lmm_system_new(1e-5 /* maxmin/precision default */);
+
+  for (int i = 0; i < nb_cnst; i++) {
+    cnst[i] = lmm_constraint_new(sys, float_random(10.0));
+    int l;
+    if (rate_no_limit > float_random(1.0))
+      l = -1;
+    else
+      l = (1 << pw_base_limit) + (1 << int_random(static_cast<int>(pw_max_limit)));
+    lmm_constraint_set_limit(sys, cnst[i], l);
+  }
+
+  for (int i = 0; i < nb_var; i++) {
+    var[i] = lmm_variable_new(sys, 1.0, -1.0);
+    int concurrency_share = 1 + static_cast<int>(int_random(max_share));
+    lmm_variable_set_share(sys, var[i], concurrency_share);
+
+    for (int j = 0; j < nb_cnst; j++)
+      used[j] = 0;
+    for (int j = 0; j < nb_elem; j++) {
+      int k = static_cast<int>(int_random(nb_cnst));
+      if (used[k] >= concurrency_share) {
+        j--;
+        continue;
+      }
+      lmm_expand(sys, cnst[k], var[i], float_random(1.5));
+      lmm_expand_add(sys, cnst[k], var[i], float_random(1.5));
+      used[k]++;
+    }
+  }
+
+  fprintf(stderr, "Starting to solve(%i)\n", myrand() % 1000);
+  auto t0 = std::chrono::steady_clock::now();
+  lmm_solve(sys);
+  auto t1 = std::chrono::steady_clock::now();
+  date_us = std::chrono::duration<double, std::micro>(t1 - t0).count();
+
+  if (mode == 1) {
+    // "test" mode: print a few variable values for cross-checking.
+    for (int i = 0; i < nb_var && i < 16; i++)
+      printf("var %d = %.9g\n", i, lmm_variable_value(sys, var[i]));
+  }
+
+  for (int i = 0; i < nb_var; i++)
+    lmm_variable_free(sys, var[i]);
+  lmm_system_free(sys);
+}
+
+static unsigned TestClasses[][4] = {
+    // Nbcnst Nbvar Baselimit Maxlimit
+    {10, 10, 1, 2},        // small
+    {100, 100, 3, 6},      // medium
+    {2000, 2000, 5, 8},    // big
+    {20000, 20000, 7, 10}  // huge
+};
+
+int main(int argc, char** argv) {
+  float rate_no_limit = 0.2f;
+  double acc_date = 0, acc_date2 = 0;
+  int testclass;
+
+  if (argc < 3) {
+    fprintf(stderr, "Syntax: <small|medium|big|huge> <count> [test|perf]\n");
+    return -1;
+  }
+  if (!strcmp(argv[1], "small"))
+    testclass = 0;
+  else if (!strcmp(argv[1], "medium"))
+    testclass = 1;
+  else if (!strcmp(argv[1], "big"))
+    testclass = 2;
+  else if (!strcmp(argv[1], "huge"))
+    testclass = 3;
+  else {
+    fprintf(stderr, "Unknown class \"%s\", aborting!\n", argv[1]);
+    return -2;
+  }
+
+  int testcount = atoi(argv[2]);
+  int mode = 0;
+  if (argc >= 4 && strcmp(argv[3], "test") == 0)
+    mode = 1;
+  if (argc >= 4 && strcmp(argv[3], "perf") == 0)
+    mode = 3;
+
+  unsigned nb_cnst = TestClasses[testclass][0];
+  unsigned nb_var = TestClasses[testclass][1];
+  unsigned pw_base_limit = TestClasses[testclass][2];
+  unsigned pw_max_limit = TestClasses[testclass][3];
+  unsigned max_share = 2;
+  unsigned nb_elem = (1 << pw_base_limit) + (1 << (8 * pw_max_limit / 10));
+
+  for (int i = 0; i < testcount; i++) {
+    seedx = i + 1;
+    fprintf(stderr, "Starting %i: (%i)\n", i, myrand() % 1000);
+    test(static_cast<int>(nb_cnst), static_cast<int>(nb_var),
+         static_cast<int>(nb_elem), pw_base_limit, pw_max_limit,
+         rate_no_limit, static_cast<int>(max_share), mode);
+    acc_date += date_us;
+    acc_date2 += date_us * date_us;
+    if (mode == 3)
+      fprintf(stderr, "  solve %d: %.1f us\n", i, date_us);
+  }
+
+  double mean = acc_date / testcount;
+  double stdev = std::sqrt(acc_date2 / testcount - mean * mean);
+  fprintf(stderr,
+          "%ix One shot execution time for a total of %u constraints, %u "
+          "variables with %u active constraint each, concurrency in [%i,%i] "
+          "and max concurrency share %u\n",
+          testcount, nb_cnst, nb_var, nb_elem, 1 << pw_base_limit,
+          (1 << pw_base_limit) + (1 << pw_max_limit), max_share);
+  printf("mean_us=%.1f stdev_us=%.1f\n", mean, stdev);
+  return 0;
+}

@@ -65,7 +65,8 @@ def _bucket(n: int) -> int:
 
 def fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
              eps, n_c: int, n_v: int, axis: Optional[str] = None,
-             parallel_rounds: bool = False):
+             parallel_rounds: bool = False, carry=None,
+             max_rounds: Optional[int] = None, return_carry: bool = False):
     """The saturate-bottleneck fixpoint over padded COO arrays.
 
     The single implementation behind every solve path: single-device
@@ -85,6 +86,13 @@ def fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
     independent regions of the constraint graph saturate concurrently and
     the device round count drops from O(#distinct levels) to O(level-chain
     depth of the graph).
+
+    ``carry``/``max_rounds``/``return_carry`` support *chunked* execution:
+    run at most ``max_rounds`` additional rounds from ``carry`` (or the
+    fresh initial state) and hand the full loop state back, so the host
+    can bound device-kernel run time per dispatch and check convergence
+    between chunks (a non-converging f32 solve must surface as a Python
+    error, not a TPU watchdog kill).
     """
     dtype = e_w.dtype
     inf = jnp.array(jnp.inf, dtype)
@@ -119,9 +127,17 @@ def fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
     v_value0 = jnp.where(jnp.isfinite(v_penalty), v_penalty, 0.0) * 0.0
     v_fixed0 = v_penalty < 0
 
+    if carry is None:
+        carry = (v_value0, v_fixed0, remaining0, usage0, light0,
+                 jnp.array(0, jnp.int32))
+    start_it = carry[5]
+    if max_rounds is None:
+        max_rounds = _MAX_ROUNDS
+
     def cond(state):
         _, _, _, _, light, it = state
-        return jnp.any(light) & (it < _MAX_ROUNDS)
+        return (jnp.any(light) & (it < _MAX_ROUNDS)
+                & (it - start_it < max_rounds))
 
     def apply_fixes(state, fix_now, new_value):
         """Shared round tail: write fixed values, batched double_update of
@@ -157,6 +173,15 @@ def fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
         # untouched constraints with tiny-but-positive usage stay in.
         drop = touched & (~(usage > eps) | ~(remaining > c_bound * eps))
         light = light & ~drop
+        # Numerical safety net (no effect in exact arithmetic, where
+        # usage - d_use reaches 0 exactly and the epsilon drop fires): a
+        # constraint with no live variable left can never fix anything
+        # again, so it must leave the light set even when f32 rounding of
+        # the usage residual keeps it above eps — otherwise the loop spins
+        # on an unfixable min-rou constraint until _MAX_ROUNDS (the round-1
+        # TPU watchdog kill at 100k flows).
+        has_live = allmax(jnp.zeros(n_c, bool).at[e_cnst].max(e_live2))
+        light = light & has_live
         return v_value, v_fixed, remaining, usage, light, it + 1
 
     def body_global(state):
@@ -241,20 +266,25 @@ def fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
                               level2_v / jnp.where(v_enabled, v_penalty, 1.0))
         return apply_fixes(state, fix_now, new_value)
 
-    v_value, v_fixed, remaining, usage, light, rounds = lax.while_loop(
-        cond, body_local if parallel_rounds else body_global,
-        (v_value0, v_fixed0, remaining0, usage0, light0,
-         jnp.array(0, jnp.int32)))
+    out = lax.while_loop(
+        cond, body_local if parallel_rounds else body_global, carry)
+    v_value, v_fixed, remaining, usage, light, rounds = out
+    if return_carry:
+        return v_value, remaining, usage, rounds, out
     return v_value, remaining, usage, rounds
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("n_c", "n_v", "parallel_rounds"))
-def _solve_kernel(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
-                  eps, n_c: int, n_v: int, parallel_rounds: bool = False):
+                   static_argnames=("n_c", "n_v", "parallel_rounds", "chunk"))
+def _solve_kernel_chunk(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty,
+                        v_bound, eps, carry, n_c: int, n_v: int,
+                        parallel_rounds: bool, chunk: int):
+    """Run at most `chunk` more saturation rounds from `carry` (None =
+    fresh start) and return (values, remaining, usage, rounds, carry)."""
     return fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty,
                     v_bound, eps, n_c, n_v, axis=None,
-                    parallel_rounds=parallel_rounds)
+                    parallel_rounds=parallel_rounds, carry=carry,
+                    max_rounds=chunk, return_carry=True)
 
 
 def flatten(cnst_list: List[Constraint], dtype=np.float64
@@ -321,9 +351,19 @@ def use_local_rounds() -> bool:
     return mode == "local"
 
 
+# Device rounds per dispatch: bounds single-kernel run time (a spinning
+# f32 solve must come back to the host and raise, not trip the TPU
+# watchdog) while keeping the per-dispatch overhead negligible for the
+# common small-round case.
+_CHUNK_ROUNDS = 4096
+
+
 def solve_arrays(arrays: LmmArrays, eps: float, device=None,
-                 parallel_rounds: Optional[bool] = None):
-    """Run the jit'd fixpoint; returns (values ndarray, rounds)."""
+                 parallel_rounds: Optional[bool] = None,
+                 chunk: int = _CHUNK_ROUNDS):
+    """Run the jit'd fixpoint in bounded-round chunks with host-side
+    convergence checks between dispatches; returns
+    (values, remaining, usage, rounds)."""
     if parallel_rounds is None:
         parallel_rounds = use_local_rounds()
     args = [arrays.e_var, arrays.e_cnst, arrays.e_w, arrays.c_bound,
@@ -331,15 +371,43 @@ def solve_arrays(arrays: LmmArrays, eps: float, device=None,
             np.asarray(eps, arrays.e_w.dtype)]
     if device is not None:
         args = [jax.device_put(a, device) for a in args]
-    values, remaining, usage, rounds = _solve_kernel(
-        *args, n_c=len(arrays.c_bound), n_v=len(arrays.v_penalty),
-        parallel_rounds=parallel_rounds)
-    rounds = int(rounds)
-    check_convergence(rounds, arrays.n_cnst, arrays.n_var)
+    n_c, n_v = len(arrays.c_bound), len(arrays.v_penalty)
+
+    carry = None
+    prev_progress = None
+    while True:
+        values, remaining, usage, rounds, carry = _solve_kernel_chunk(
+            *args, carry=carry, n_c=n_c, n_v=n_v,
+            parallel_rounds=parallel_rounds, chunk=chunk)
+        # One host sync per chunk: rounds + light count + fixed count.
+        light = carry[4]
+        n_light = int(jnp.count_nonzero(light))
+        rounds = int(rounds)
+        if n_light == 0:
+            break
+        if rounds >= _MAX_ROUNDS:
+            raise RuntimeError(
+                f"LMM JAX solve did not converge within {_MAX_ROUNDS} "
+                f"saturation rounds ({arrays.n_cnst} constraints, "
+                f"{arrays.n_var} variables, {n_light} still active); "
+                f"check maxmin/precision vs the system's magnitudes")
+        n_fixed = int(jnp.count_nonzero(carry[1]))
+        progress = (n_light, n_fixed)
+        if progress == prev_progress:
+            raise RuntimeError(
+                f"LMM JAX solve stalled after {rounds} rounds: "
+                f"{n_light} active constraints and {n_fixed} fixed "
+                f"variables unchanged over {chunk} rounds "
+                f"({arrays.n_cnst} constraints, {arrays.n_var} variables); "
+                f"the system does not converge at eps={eps} in "
+                f"{arrays.e_w.dtype} precision")
+        prev_progress = progress
     return np.asarray(values), np.asarray(remaining), np.asarray(usage), rounds
 
 
 def check_convergence(rounds: int, n_cnst, n_var) -> None:
+    """Raise if a (non-chunked) fixpoint hit the round cap (used by the
+    sharded paths, which run the loop to completion in one dispatch)."""
     if rounds >= _MAX_ROUNDS:
         raise RuntimeError(
             f"LMM JAX solve did not converge within {_MAX_ROUNDS} saturation "
@@ -347,12 +415,14 @@ def check_convergence(rounds: int, n_cnst, n_var) -> None:
             f"check maxmin/precision vs the system's magnitudes")
 
 
-def solve_jax(system: System) -> None:
-    """Backend entry: flatten host graph, solve on device, scatter back.
+def solve_flattened(system: System, dtype, solve_flat) -> None:
+    """Shared backend wrapper: flatten host graph, solve, scatter back.
 
     Mirrors the side effects of System::lmm_solve (maxmin.cpp:487-500):
     values written to variables, modified-action collection for lazy model
     updates, constraint usage left consistent, modified flags cleared.
+    ``solve_flat(arrays, eps) -> (values, remaining, usage)`` is the
+    actual solver (device fixpoint or native C++).
     """
     if system.selective_update_active:
         cnst_list = list(system.modified_constraint_set)
@@ -360,7 +430,6 @@ def solve_jax(system: System) -> None:
         cnst_list = list(system.active_constraint_set)
 
     eps = config["maxmin/precision"]
-    dtype = np.float32 if config["lmm/dtype"] == "float32" else np.float64
 
     # Reset + collect modified actions exactly like the init pass of the
     # list solver (maxmin.cpp:509-539).
@@ -382,7 +451,7 @@ def solve_jax(system: System) -> None:
     flat = flatten(cnst_list, dtype)
     if flat is not None:
         arrays, vars_in_order = flat
-        values, remaining, usage, _ = solve_arrays(arrays, eps)
+        values, remaining, usage = solve_flat(arrays, eps)
         for slot, var in enumerate(vars_in_order):
             var.value = float(values[slot])
         # Scatter back the kernel's end-state remaining/usage so constraint
@@ -396,6 +465,17 @@ def solve_jax(system: System) -> None:
         system.remove_all_modified_set()
 
 
+def solve_jax(system: System) -> None:
+    """Backend entry: flatten host graph, solve on device, scatter back."""
+    dtype = np.float32 if config["lmm/dtype"] == "float32" else np.float64
+
+    def solve_flat(arrays, eps):
+        values, remaining, usage, _ = solve_arrays(arrays, eps)
+        return values, remaining, usage
+
+    solve_flattened(system, dtype, solve_flat)
+
+
 def _count_live_vars(system: System) -> int:
     n = 0
     for var in system.variable_set:
@@ -406,12 +486,17 @@ def _count_live_vars(system: System) -> int:
 
 
 def dispatching_solve(system: System) -> None:
-    """'auto' backend: exact list solver for small live sets, JAX above
-    the lmm/jax-threshold crossover (SURVEY.md hard part (e))."""
+    """'auto' backend: exact host solver for small live sets (native C++
+    when available, Python list solver otherwise), JAX above the
+    lmm/jax-threshold crossover (SURVEY.md hard part (e))."""
     if _count_live_vars(system) >= config["lmm/jax-threshold"]:
         solve_jax(system)
     else:
-        system.solve_exact()
+        from . import lmm_native
+        if lmm_native.available():
+            lmm_native.solve_native(system)
+        else:
+            system.solve_exact()
 
 
 def install(system: System, backend: Optional[str] = None) -> System:
@@ -421,9 +506,12 @@ def install(system: System, backend: Optional[str] = None) -> System:
         system.solve_fn = solve_jax
     elif backend == "auto":
         system.solve_fn = dispatching_solve
+    elif backend == "native":
+        from . import lmm_native
+        system.solve_fn = lmm_native.solve_native
     elif backend == "list":
         system.solve_fn = None
     else:
         raise ValueError(f"Unknown lmm/backend {backend!r} "
-                         "(expected list, jax or auto)")
+                         "(expected list, native, jax or auto)")
     return system

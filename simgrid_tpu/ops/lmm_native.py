@@ -1,0 +1,108 @@
+"""ctypes bridge to the native (C++) exact LMM solver (native/lmm.cc).
+
+Third solver backend next to the exact Python list solver and the JAX
+fixpoint: same flatten/solve/scatter handoff as the JAX backend
+(lmm_jax.solve_jax), but the solve itself runs in native code — the
+host-side floor of the auto dispatch (small live sets stay native-fast,
+large ones go to the device; SURVEY.md hard part (e)).
+
+The shared library is built on demand from native/lmm.cc with g++ (no
+pip/pybind11 dependency; plain C ABI)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+from .lmm_host import System
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libsimgrid_lmm.so")
+
+_lib = None
+_lib_error: Optional[str] = None
+
+
+def _build_library() -> None:
+    src = os.path.join(_NATIVE_DIR, "lmm.cc")
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", _LIB_PATH,
+         src],
+        check=True, capture_output=True, text=True)
+
+
+def load_library():
+    """Load (building if needed) the native solver; None if unavailable."""
+    global _lib, _lib_error
+    if _lib is not None or _lib_error is not None:
+        return _lib
+    try:
+        if not os.path.exists(_LIB_PATH):
+            _build_library()
+        lib = ctypes.CDLL(_LIB_PATH)
+    except (OSError, subprocess.CalledProcessError) as exc:
+        _lib_error = str(exc)
+        return None
+    lib.lmm_solve_coo.restype = ctypes.c_int32
+    lib.lmm_solve_coo.argtypes = [
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        ctypes.c_double,
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+    ]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load_library() is not None
+
+
+def solve_coo(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
+              eps: float, n_e: int, n_c: int, n_v: int):
+    """Solve a flattened COO system natively; returns (values, remaining,
+    usage) over the first n_v / n_c slots."""
+    lib = load_library()
+    if lib is None:
+        raise RuntimeError(f"native LMM solver unavailable: {_lib_error}")
+    values = np.zeros(n_v, np.float64)
+    remaining = np.zeros(n_c, np.float64)
+    usage = np.zeros(n_c, np.float64)
+    lib.lmm_solve_coo(
+        n_c, n_v, n_e,
+        np.ascontiguousarray(e_var[:n_e], np.int32),
+        np.ascontiguousarray(e_cnst[:n_e], np.int32),
+        np.ascontiguousarray(e_w[:n_e], np.float64),
+        np.ascontiguousarray(c_bound[:n_c], np.float64),
+        np.ascontiguousarray(c_fatpipe[:n_c], np.uint8),
+        np.ascontiguousarray(v_penalty[:n_v], np.float64),
+        np.ascontiguousarray(v_bound[:n_v], np.float64),
+        float(eps), values, remaining, usage)
+    return values, remaining, usage
+
+
+def _solve_flat(arrays, eps):
+    return solve_coo(
+        arrays.e_var, arrays.e_cnst, arrays.e_w, arrays.c_bound,
+        arrays.c_fatpipe, arrays.v_penalty, arrays.v_bound, eps,
+        arrays.n_elem, arrays.n_cnst, arrays.n_var)
+
+
+def solve_native(system: System) -> None:
+    """Backend entry: flatten host graph, solve natively, scatter back
+    (same side-effect contract as lmm_jax.solve_jax)."""
+    from .lmm_jax import solve_flattened
+    solve_flattened(system, np.float64, _solve_flat)

@@ -196,8 +196,9 @@ declare_flag("network/weight-S",
 declare_flag("network/loopback-bw", "Default loopback bandwidth", 498000000.0)
 declare_flag("network/loopback-lat", "Default loopback latency", 0.000015)
 declare_flag("lmm/backend",
-             "Max-min solver backend: list (exact host), jax (vectorized, "
-             "TPU/CPU), auto (jax above lmm/jax-threshold variables)", "auto")
+             "Max-min solver backend: list (exact host, Python), native "
+             "(exact host, C++), jax (vectorized, TPU/CPU), auto (native "
+             "below lmm/jax-threshold variables, jax above)", "auto")
 declare_flag("lmm/jax-threshold",
              "Minimum live variable count before 'auto' switches the solve "
              "to the JAX backend", 512)

@@ -6,6 +6,11 @@ multi-chip path via __graft_entry__.dryrun_multichip).
 """
 
 import os
+import sys
+
+# Repo root on sys.path: tests import helpers from root-level modules
+# (e.g. bench.build_arrays) regardless of how pytest was invoked.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # Force CPU even when the environment points JAX at a real accelerator
 # (JAX_PLATFORMS=axon): the suite needs 8 virtual devices for mesh tests,

@@ -13,13 +13,18 @@ EPS = 1e-5
 
 
 def both_backends(test):
-    return pytest.mark.parametrize("backend", ["list", "jax"])(test)
+    return pytest.mark.parametrize("backend", ["list", "jax", "native"])(test)
 
 
 def make_system(backend, selective=False):
     sys_ = make_new_maxmin_system(selective)
     if backend == "jax":
         sys_.solve_fn = lmm_jax.solve_jax
+    elif backend == "native":
+        from simgrid_tpu.ops import lmm_native
+        if not lmm_native.available():
+            pytest.skip("native solver unavailable (no g++?)")
+        sys_.solve_fn = lmm_native.solve_native
     return sys_
 
 
@@ -386,3 +391,134 @@ def test_round_modes_match_oracle_large(seed, n_c, n_v, p_bound, p_fat,
     exact = np.array([v.value for v in v_exact])
     vect = np.array([v.value for v in v_jax])
     np.testing.assert_allclose(vect, exact, rtol=1e-9, atol=1e-9)
+
+
+def _bench_arrays(rng, n_c, n_v, deg, dtype):
+    """maxmin_bench-style COO system (the exact generator bench.py times,
+    so the f32-convergence regression covers the benched system)."""
+    from bench import build_arrays
+    return build_arrays(rng, n_c, n_v, deg, dtype)
+
+
+def test_chunked_solve_matches_single_dispatch():
+    """Chunked execution (tiny chunk => many dispatches with carry
+    continuation) must give the same answer as one big dispatch."""
+    from simgrid_tpu.ops.lmm_jax import solve_arrays
+    arrays = _bench_arrays(np.random.default_rng(5), 50, 200, 3, np.float64)
+    v1, r1, u1, rounds1 = solve_arrays(arrays, 1e-9, parallel_rounds=False)
+    v2, r2, u2, rounds2 = solve_arrays(arrays, 1e-9, parallel_rounds=False,
+                                       chunk=3)
+    assert rounds1 == rounds2
+    np.testing.assert_array_equal(v1, v2)
+    np.testing.assert_array_equal(r1, r2)
+    np.testing.assert_array_equal(u1, u2)
+
+
+@pytest.mark.parametrize("rounds_mode", [False, True])
+def test_f32_convergence_100k_flows(rounds_mode):
+    """The round-1 TPU failure mode: a 100k-flow / 16k-link system in f32
+    must converge (stuck constraints with no live variables are pruned
+    even when f32 rounding keeps their usage residual above eps) — and
+    produce a feasible, near-f64 solution."""
+    from simgrid_tpu.ops.lmm_jax import solve_arrays
+    n_c, n_v, deg = 16384, 100_000, 4
+    arrays32 = _bench_arrays(np.random.default_rng(9), n_c, n_v, deg,
+                             np.float32)
+    v32, r32, u32, rounds = solve_arrays(arrays32, 1e-5,
+                                         parallel_rounds=rounds_mode)
+    assert rounds < 100_000
+    assert np.all(v32[:n_v] > 0)
+    # feasibility: per-constraint usage within bound (+f32 slack)
+    used = np.zeros(len(arrays32.c_bound), np.float64)
+    np.add.at(used, arrays32.e_cnst[:n_v * deg],
+              (arrays32.e_w[:n_v * deg].astype(np.float64)
+               * v32[arrays32.e_var[:n_v * deg]].astype(np.float64)))
+    assert np.all(used <= arrays32.c_bound.astype(np.float64) * (1 + 1e-3)
+                  + 1e-3)
+
+
+class _Lehmer:
+    """The reference maxmin_bench's LCG (maxmin_bench.cpp:20-35), for
+    building byte-identical bench systems across implementations."""
+
+    def __init__(self, seed):
+        self.seedx = seed
+
+    def myrand(self):
+        self.seedx = self.seedx * 16807 % 2147483647
+        return self.seedx % 1000
+
+    def float_random(self, mx):
+        return (mx * self.myrand()) / 1001.0
+
+    def int_random(self, mx):
+        return int(self.float_random(mx))
+
+
+def _bench_system_python(seed, nb_cnst, nb_var, nb_elem, pw_base_limit,
+                         pw_max_limit, rate_no_limit, max_share):
+    """Replicates maxmin_bench.cpp:37-78 construction on the Python
+    solver, returning (system, vars)."""
+    rng = _Lehmer(seed)
+    rng.myrand()  # the bench prints one draw before test()
+    s = make_new_maxmin_system(False)
+    cnsts = []
+    for _ in range(nb_cnst):
+        c = s.constraint_new(None, rng.float_random(10.0))
+        if rate_no_limit > rng.float_random(1.0):
+            limit = -1
+        else:
+            limit = (1 << pw_base_limit) + (1 << rng.int_random(pw_max_limit))
+        c.set_concurrency_limit(limit)
+        cnsts.append(c)
+    variables = []
+    for _ in range(nb_var):
+        v = s.variable_new(None, 1.0, -1.0, nb_elem)
+        share = 1 + rng.int_random(max_share)
+        v.set_concurrency_share(share)
+        used = [0] * nb_cnst
+        j = 0
+        while j < nb_elem:
+            k = rng.int_random(nb_cnst)
+            if used[k] >= share:
+                continue
+            s.expand(cnsts[k], v, rng.float_random(1.5))
+            s.expand_add(cnsts[k], v, rng.float_random(1.5))
+            used[k] += 1
+            j += 1
+        variables.append(v)
+    return s, variables
+
+
+def test_native_bench_matches_python_oracle():
+    """The native maxmin_bench binary's 'test' mode output (first 16
+    variable values, 2 iterations of the small class) must match the
+    Python solver run on the identically-constructed system."""
+    import os
+    import subprocess
+    from simgrid_tpu.ops import lmm_native
+
+    if not lmm_native.available():
+        pytest.skip("native solver unavailable")
+    bench = os.path.join(os.path.dirname(lmm_native._LIB_PATH),
+                         "maxmin_bench")
+    if not os.path.exists(bench):
+        subprocess.run(["make", "-C", os.path.dirname(bench), "maxmin_bench"],
+                       check=True, capture_output=True)
+    out = subprocess.run([bench, "small", "2", "test"], check=True,
+                         capture_output=True, text=True).stdout
+    native_vals = [float(line.split("=")[1]) for line in out.splitlines()
+                   if line.startswith("var ")]
+    assert len(native_vals) == 20
+
+    config["maxmin/precision"] = 1e-5
+    py_vals = []
+    for it in range(2):
+        s, variables = _bench_system_python(
+            # small class: nb_elem = (1<<1) + (1<<(8*2/10)) = 4 (int division,
+            # maxmin_bench.cpp:172)
+            seed=it + 1, nb_cnst=10, nb_var=10, nb_elem=4,
+            pw_base_limit=1, pw_max_limit=2, rate_no_limit=0.2, max_share=2)
+        s.solve_exact()
+        py_vals.extend(v.value for v in variables)
+    np.testing.assert_allclose(native_vals, py_vals, rtol=1e-6, atol=1e-9)
