@@ -196,8 +196,18 @@ class System {
   }
 
   bool can_enable(const Variable& v) const {
-    return v.staged_penalty > 0 &&
-           min_concurrency_slack(v) >= v.concurrency_share;
+    // Early-exit slack scan: on dense systems most constraints are at
+    // their concurrency limit, so the first saturated constraint
+    // already answers 'no' — without this, bench-protocol construction
+    // on the huge class (20k vars x 384 elems) is quadratic in the
+    // staged-variable population (the reference scans fully,
+    // maxmin.hpp get_min_concurrency_slack; result is identical).
+    if (v.staged_penalty <= 0)
+      return false;
+    for (int32_t ei : v.elems)
+      if (concurrency_slack(cnsts_[elems_[ei].cnst]) < v.concurrency_share)
+        return false;
+    return true;
   }
 
   void increase_concurrency(int32_t ei) {

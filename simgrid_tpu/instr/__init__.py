@@ -1,0 +1,366 @@
+"""Instrumentation: Paje + TI trace sinks over the kernel's signals.
+
+The reference hooks its tracing into the kernel via xbt::signal
+callbacks and flushes the event buffer on every time advance
+(surf_c_bindings.cpp:148 -> instr_paje_trace.cpp:47); this package does
+the same over the Python kernel's engine-scoped signals. Enable with
+--cfg=tracing:yes (+ tracing/platform, tracing/actor,
+tracing/uncategorized, tracing/smpi, tracing/filename, tracing/format).
+
+TPU note: tracing is a pure host-side sink — it observes the event loop,
+never the device solve, so enabling it does not perturb the jitted LMM
+path (device steps are surfaced via jax.profiler annotations instead).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, Optional
+
+from ..utils.config import config, declare_flag
+from . import ti
+from .paje import (Container, PAJE_EndLink, PAJE_PopState, PAJE_PushState,
+                   PAJE_SetVariable, PAJE_StartLink, PajeEvent, TIEvent,
+                   Trace, TI_FORMAT, PAJE_FORMAT)
+
+declare_flag("tracing/precision",
+             "Numerical precision used when timestamping events", 9)
+declare_flag("tracing/smpi/display-sizes",
+             "Add message size information to the SMPI states/links", False)
+declare_flag("tracing/smpi/grouped",
+             "Group MPI rank containers under their host container", True)
+
+# Known state colors (instr_smpi.cpp:30-80); others are hash-derived.
+_COLORS = {
+    "computing": "0 1 1",
+    "sleeping": "0 0.5 0.5",
+    "MPI_STATE": "",
+}
+
+_trace: Optional[Trace] = None
+_rank_hosts: Dict[int, object] = {}
+_link_keys: Dict[str, list] = {}
+_link_key_counter = 0
+
+
+def find_color(name: str) -> str:
+    color = _COLORS.get(name)
+    if color is None:
+        h = hashlib.md5(name.encode()).digest()
+        color = (f"{h[0] / 255:.3f} {h[1] / 255:.3f} {h[2] / 255:.3f}")
+        _COLORS[name] = color
+    return color
+
+
+def is_enabled() -> bool:
+    return _trace is not None
+
+
+def trace() -> Trace:
+    return _trace
+
+
+def container(name: str) -> Container:
+    return _trace.containers_by_name[name]
+
+
+# ---------------------------------------------------------------------------
+# Session lifecycle
+# ---------------------------------------------------------------------------
+
+def start(engine_impl) -> None:
+    """TRACE_start equivalent (instr_config.cpp): open the sink, build
+    the platform container tree, wire flush + plugin signals."""
+    global _trace
+    if _trace is not None or not config["tracing"]:
+        return
+    fmt = TI_FORMAT if config["tracing/format"].upper() == "TI" \
+        else PAJE_FORMAT
+    _trace = Trace(config["tracing/filename"], fmt,
+                   clock_getter=lambda: engine_impl.now,
+                   precision=config["tracing/precision"],
+                   display_sizes=config["tracing/smpi/display-sizes"])
+
+    root = Container(_trace, engine_impl.netzone_root.name
+                     if engine_impl.netzone_root else "root", "", None)
+
+    if fmt == PAJE_FORMAT:
+        # Platform/actor containers only make sense for visualization;
+        # in TI mode only MPI rank containers get (replayable) files.
+        if config["tracing/platform"] or config["tracing/uncategorized"]:
+            _build_platform_containers(engine_impl, root)
+        if config["tracing/uncategorized"]:
+            _wire_utilization(engine_impl)
+        if config["tracing/actor"]:
+            _wire_actors(engine_impl)
+
+    from ..kernel.engine import EngineImpl
+    engine_impl.connect_signal(EngineImpl.on_time_advance,
+                               lambda delta: _trace and _trace.flush(
+                                   up_to=engine_impl.now))
+    engine_impl.connect_signal(EngineImpl.on_simulation_end, stop)
+
+
+def stop() -> None:
+    global _trace
+    if _trace is not None:
+        _trace.close()
+        _trace = None
+    _rank_hosts.clear()
+    _link_keys.clear()
+
+
+# ---------------------------------------------------------------------------
+# Platform containers (instr_platform.cpp)
+# ---------------------------------------------------------------------------
+
+def _build_platform_containers(engine_impl, root: Container) -> None:
+    def walk(zone, father: Container, level: int):
+        cont = father if zone.netpoint.name == father.name else \
+            father.child(zone.name, f"L{level}")
+        for host in zone.get_hosts():
+            hc = cont.child(host.name, "HOST")
+            hc.type.variable_type("power", "1 0 0")
+        for child in zone.children:
+            walk(child, cont, level + 1)
+
+    walk(engine_impl.netzone_root, root, 1)
+    # Links live at the root level container of their zone; give each a
+    # container + bandwidth/latency variables (instr_platform.cpp).
+    for link in engine_impl.links.values():
+        lc = root.child(link.name, "LINK")
+        lc.type.variable_type("bandwidth", "1 1 1")
+        lc.type.variable_type("latency", "1 1 1")
+        bw_type = lc.type.children["bandwidth"]
+        lat_type = lc.type.children["latency"]
+        PajeEvent(_trace, lc, bw_type, PAJE_SetVariable,
+                  tail=_fmt_val(link.get_bandwidth()), timestamp=0.0)
+        PajeEvent(_trace, lc, lat_type, PAJE_SetVariable,
+                  tail=_fmt_val(link.get_latency()), timestamp=0.0)
+    for host_cont_name, host in engine_impl.hosts.items():
+        cont = _trace.containers_by_name.get(host_cont_name)
+        if cont is not None:
+            PajeEvent(_trace, cont, cont.type.children["power"],
+                      PAJE_SetVariable, tail=_fmt_val(host.get_speed()),
+                      timestamp=0.0)
+
+
+def _fmt_val(v: float) -> str:
+    return f"{v:f}" if v == int(v) else repr(v)
+
+
+# ---------------------------------------------------------------------------
+# Uncategorized resource utilization (instr_resource_utilization.cpp)
+# ---------------------------------------------------------------------------
+
+def _wire_utilization(engine_impl) -> None:
+    from ..kernel.engine import EngineImpl
+    last: Dict[str, float] = {}
+
+    def on_advance(delta: float) -> None:
+        if _trace is None:
+            return
+        start_t = engine_impl.now - delta
+        for link in engine_impl.links.values():
+            cont = _trace.containers_by_name.get(link.name)
+            if cont is None:
+                continue
+            usage = _cnst_usage(link)
+            if last.get(link.name) != usage:
+                vt = cont.type.variable_type("bandwidth_used", "0.5 0 0")
+                PajeEvent(_trace, cont, vt, PAJE_SetVariable,
+                          tail=_fmt_val(usage), timestamp=start_t)
+                last[link.name] = usage
+        for host in engine_impl.hosts.values():
+            cont = _trace.containers_by_name.get(host.name)
+            if cont is None:
+                continue
+            usage = _cnst_usage(host.cpu)
+            key = "cpu!" + host.name
+            if last.get(key) != usage:
+                vt = cont.type.variable_type("power_used", "0.5 0 0")
+                PajeEvent(_trace, cont, vt, PAJE_SetVariable,
+                          tail=_fmt_val(usage), timestamp=start_t)
+                last[key] = usage
+
+    engine_impl.connect_signal(EngineImpl.on_time_advance, on_advance)
+
+
+def _cnst_usage(resource) -> float:
+    cnst = getattr(resource, "constraint", None)
+    if cnst is None:
+        return 0.0
+    return sum(e.consumption_weight * e.variable.value
+               for e in cnst.enabled_element_set
+               if e.consumption_weight > 0)
+
+
+# ---------------------------------------------------------------------------
+# Actor tracing (instr_platform.cpp actor signal hooks)
+# ---------------------------------------------------------------------------
+
+def _wire_actors(engine_impl) -> None:
+    from ..kernel.actor import ActorImpl
+    from ..s4u.actor import Actor
+
+    def actor_container(actor_impl) -> Optional[Container]:
+        return _trace.containers_by_name.get(
+            f"{actor_impl.name}-{actor_impl.pid}")
+
+    def on_creation(actor_impl) -> None:
+        if _trace is None or actor_impl.host is None:
+            return
+        father = _trace.containers_by_name.get(actor_impl.host.name,
+                                               _trace.root_container)
+        cont = father.child(f"{actor_impl.name}-{actor_impl.pid}", "ACTOR")
+        st = cont.type.state_type("ACTOR_STATE")
+        for name in ("suspend", "sleep", "receive", "send", "execute"):
+            st.value(name, find_color(name))
+
+    def push(actor_impl, state: str) -> None:
+        cont = _trace and actor_container(actor_impl)
+        if cont:
+            st = cont.type.state_type("ACTOR_STATE")
+            ev = PajeEvent(_trace, cont, st, PAJE_PushState)
+            ev.tail = str(st.value(state).id)
+
+    def pop(actor_impl) -> None:
+        cont = _trace and actor_container(actor_impl)
+        if cont:
+            PajeEvent(_trace, cont,
+                      cont.type.state_type("ACTOR_STATE"), PAJE_PopState)
+
+    def on_destruction(actor_impl) -> None:
+        cont = _trace and actor_container(actor_impl)
+        if cont:
+            cont.remove_from_parent()
+
+    engine_impl.connect_signal(ActorImpl.on_creation, on_creation)
+    engine_impl.connect_signal(ActorImpl.on_termination, on_destruction)
+    engine_impl.connect_signal(Actor.on_suspend,
+                               lambda a: a and push(a.pimpl, "suspend"))
+    engine_impl.connect_signal(Actor.on_resume,
+                               lambda a: a and pop(a.pimpl))
+    engine_impl.connect_signal(Actor.on_sleep,
+                               lambda a: a and push(a.pimpl, "sleep"))
+    engine_impl.connect_signal(Actor.on_wake_up,
+                               lambda a: a and pop(a.pimpl))
+
+
+# ---------------------------------------------------------------------------
+# SMPI tracing (instr_smpi.cpp)
+# ---------------------------------------------------------------------------
+
+def smpi_enabled() -> bool:
+    return _trace is not None and config["tracing/smpi"]
+
+
+def _rank_container(rank: int) -> Container:
+    return _trace.containers_by_name[f"rank-{rank}"]
+
+
+def smpi_init(rank: int, host) -> None:
+    """TRACE_smpi_init + setup_container (instr_smpi.cpp:139-168);
+    idempotent so arrows can pre-create a peer's container."""
+    if not smpi_enabled() or f"rank-{rank}" in _trace.containers_by_name:
+        return
+    father = _trace.root_container
+    if config["tracing/smpi/grouped"]:
+        father = _trace.containers_by_name.get(host.name, father)
+    cont = father.child(f"rank-{rank}", "MPI")
+    st = cont.type.state_type("MPI_STATE")
+    if config["tracing/smpi/computing"]:
+        st.value("computing", find_color("computing"))
+    # The pt2pt link type lives on the root type, rank -> rank.
+    _trace.root_container.type.link_type("MPI_LINK", cont.type, cont.type)
+
+
+def smpi_finalize(rank: int) -> None:
+    if smpi_enabled():
+        _rank_container(rank).remove_from_parent()
+
+
+def smpi_in(rank: int, op_name: str, extra: ti.TIData,
+            ti_line: bool = True) -> None:
+    """TRACE_smpi_comm_in: push the MPI call state; in TI mode emit the
+    replayable action line instead (instr_paje_events.cpp StateEvent).
+    ti_line=False marks calls the TI/replay grammar does not support
+    (waitany etc., instr_paje_events.cpp:110 comment)."""
+    if not smpi_enabled():
+        return
+    cont = _rank_container(rank)
+    if _trace.format == TI_FORMAT:
+        if ti_line:
+            TIEvent(_trace, cont, f"{rank} {extra.print()}")
+        return
+    st = cont.type.state_type("MPI_STATE")
+    ev = PajeEvent(_trace, cont, st, PAJE_PushState)
+    ev.tail = str(st.value(op_name, find_color(op_name)).id)
+    if _trace.display_sizes:
+        ev.tail += f" {extra.display_size()}"
+
+
+def smpi_out(rank: int) -> None:
+    if not smpi_enabled():
+        return
+    if _trace.format == TI_FORMAT:
+        return
+    cont = _rank_container(rank)
+    PajeEvent(_trace, cont, cont.type.state_type("MPI_STATE"),
+              PAJE_PopState)
+
+
+def smpi_computing_in(rank: int, amount: float) -> None:
+    if smpi_enabled() and config["tracing/smpi/computing"]:
+        smpi_in(rank, "computing", ti.CpuTIData("compute", amount))
+
+
+def smpi_computing_out(rank: int) -> None:
+    if smpi_enabled() and config["tracing/smpi/computing"]:
+        smpi_out(rank)
+
+
+def _pt2pt_key(src: int, dst: int, tag: int, send: int) -> str:
+    """Matching key generation for pt2pt link arrows
+    (instr_smpi.cpp:105-137): the first side to reach the rendezvous
+    mints the key, the other pops it."""
+    global _link_key_counter
+    aux = f"{src}#{dst}#{tag}#{1 - send}"
+    queue = _link_keys.get(aux)
+    if queue:
+        key = queue.pop(0)
+        if not queue:
+            del _link_keys[aux]
+        return key
+    _link_key_counter += 1
+    key = f"{src}_{dst}_{tag}_{_link_key_counter}"
+    _link_keys.setdefault(f"{src}#{dst}#{tag}#{send}", []).append(key)
+    return key
+
+
+def smpi_send(rank: int, src: int, dst: int, tag: int, size: int) -> None:
+    """TRACE_smpi_send: StartLink arrow from the sender."""
+    if not smpi_enabled() or _trace.format == TI_FORMAT:
+        return
+    key = _pt2pt_key(src, dst, tag, send=1)
+    root = _trace.root_container
+    lt = root.type.link_type("MPI_LINK",
+                             _rank_container(src).type,
+                             _rank_container(dst).type)
+    ev = PajeEvent(_trace, root, lt, PAJE_StartLink,
+                   tail=f"PTP {_rank_container(src).id} {key}")
+    if _trace.display_sizes:
+        ev.tail += f" {size}"
+
+
+def smpi_recv(rank_src: int, rank_dst: int, tag: int) -> None:
+    """TRACE_smpi_recv: EndLink arrow at the receiver."""
+    if not smpi_enabled() or _trace.format == TI_FORMAT:
+        return
+    key = _pt2pt_key(rank_src, rank_dst, tag, send=0)
+    root = _trace.root_container
+    lt = root.type.link_type("MPI_LINK",
+                             _rank_container(rank_src).type,
+                             _rank_container(rank_dst).type)
+    PajeEvent(_trace, root, lt, PAJE_EndLink,
+              tail=f"PTP {_rank_container(rank_dst).id} {key}")

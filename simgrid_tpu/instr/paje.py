@@ -1,0 +1,374 @@
+"""Paje trace core: typed container hierarchy, event buffer, sinks.
+
+Re-implements the reference's instrumentation data model
+(src/instr/instr_paje_{types,containers,events,header,trace}.cpp) in
+host Python: a tree of trace *types* (container/state/variable/link/
+event), a tree of *containers* mirroring the platform, and timestamped
+events buffered in nondecreasing order and flushed whenever simulated
+time advances (TRACE_paje_dump_buffer, instr_paje_trace.cpp:47-70).
+
+The same event stream doubles as the TI (time-independent) trace writer
+(instr_private.hpp:35-41): in TI mode StateEvents carrying TIData are
+written as replayable action lines to per-rank files instead.
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+from typing import Dict, List, Optional, TextIO
+
+# e_event_type (instr_paje_events.hpp:19-38) — the numeric codes used in
+# both the header %EventDef lines and the event records.
+PAJE_DefineContainerType = 0
+PAJE_DefineVariableType = 1
+PAJE_DefineStateType = 2
+PAJE_DefineEventType = 3
+PAJE_DefineLinkType = 4
+PAJE_DefineEntityValue = 5
+PAJE_CreateContainer = 6
+PAJE_DestroyContainer = 7
+PAJE_SetVariable = 8
+PAJE_AddVariable = 9
+PAJE_SubVariable = 10
+PAJE_SetState = 11
+PAJE_PushState = 12
+PAJE_PopState = 13
+PAJE_ResetState = 14
+PAJE_StartLink = 15
+PAJE_EndLink = 16
+PAJE_NewEvent = 17
+
+PAJE_FORMAT = "Paje"
+TI_FORMAT = "TI"
+
+
+def _fmt_time(t: float, precision: int = 9) -> str:
+    return f"{t:.{precision}f}"
+
+
+class EntityValue:
+    """A named value of a state/event type (instr_paje_values.cpp)."""
+
+    def __init__(self, trace: "Trace", name: str, color: str,
+                 father: "Type"):
+        self.id = trace.new_id()
+        self.name = name
+        self.color = color
+        self.father = father
+        if trace.format == PAJE_FORMAT:
+            line = (f"{PAJE_DefineEntityValue} {self.id} "
+                    f"{father.id} {name}")
+            if color:
+                line += f' "{color}"'
+            trace.write_line(line)
+
+
+class Type:
+    """A node of the trace type tree (instr_paje_types.cpp)."""
+
+    def __init__(self, trace: "Trace", kind: int, name: str,
+                 father: Optional["Type"], color: str = "",
+                 source: Optional["Type"] = None,
+                 dest: Optional["Type"] = None):
+        self.trace = trace
+        self.kind = kind
+        self.name = name
+        self.father = father
+        self.color = color
+        self.children: Dict[str, "Type"] = {}
+        self.values: Dict[str, EntityValue] = {}
+        self.id = trace.new_id()
+        if father is not None:
+            father.children[name] = self
+            self._log_definition(source, dest)
+
+    def _log_definition(self, source, dest) -> None:
+        if self.trace.format != PAJE_FORMAT:
+            return
+        if self.kind == PAJE_DefineLinkType:
+            self.trace.write_line(
+                f"{self.kind} {self.id} {self.father.id} {source.id} "
+                f"{dest.id} {self.name}")
+        else:
+            line = f"{self.kind} {self.id} {self.father.id} {self.name}"
+            if self.color:
+                line += f' "{self.color}"'
+            self.trace.write_line(line)
+
+    # -- child type factories (Type::by_name_or_create) -------------------
+    def container_type(self, name: str) -> "Type":
+        return self.children.get(name) or Type(
+            self.trace, PAJE_DefineContainerType, name, self)
+
+    def state_type(self, name: str) -> "Type":
+        return self.children.get(name) or Type(
+            self.trace, PAJE_DefineStateType, name, self)
+
+    def variable_type(self, name: str, color: str = "") -> "Type":
+        return self.children.get(name) or Type(
+            self.trace, PAJE_DefineVariableType, name, self, color=color)
+
+    def event_type(self, name: str) -> "Type":
+        return self.children.get(name) or Type(
+            self.trace, PAJE_DefineEventType, name, self)
+
+    def link_type(self, name: str, source: "Type", dest: "Type") -> "Type":
+        full = f"{name}-{source.id}-{dest.id}"
+        return self.children.get(full) or Type(
+            self.trace, PAJE_DefineLinkType, full, self,
+            source=source, dest=dest)
+
+    def value(self, name: str, color: str = "") -> EntityValue:
+        val = self.values.get(name)
+        if val is None:
+            val = EntityValue(self.trace, name, color, self)
+            self.values[name] = val
+        return val
+
+
+class Container:
+    """A node of the container tree (instr_paje_containers.cpp)."""
+
+    def __init__(self, trace: "Trace", name: str, type_name: str,
+                 father: Optional["Container"]):
+        self.trace = trace
+        self.name = name
+        self.father = father
+        self.children: Dict[str, "Container"] = {}
+        if father is None:
+            self.type = Type(trace, PAJE_DefineContainerType, "0", None)
+            self.id = "0"
+            trace.root_container = self
+        else:
+            self.type = father.type.container_type(type_name)
+            self.id = str(trace.new_id())
+            father.children[name] = self
+        trace.containers_by_name[name] = self
+        self._log_creation()
+
+    def _log_creation(self) -> None:
+        t = self.trace
+        if t.format == PAJE_FORMAT:
+            if self.father is not None:
+                t.write_line(
+                    f"{PAJE_CreateContainer} {_fmt_time(t.clock())} "
+                    f"{self.id} {self.type.id} {self.father.id} "
+                    f'"{self._display_name()}"')
+        elif t.format == TI_FORMAT and self.type.name == "MPI":
+            # Only MPI rank containers produce replayable TI files.
+            t.open_ti_file(self)
+
+    def _display_name(self) -> str:
+        # rank-N containers are renamed to the 0-based rank in the trace
+        # (instr_paje_containers.cpp Container::log_creation).
+        return self.name
+
+    def remove_from_parent(self) -> None:
+        t = self.trace
+        for child in list(self.children.values()):
+            child.remove_from_parent()
+        if t.format == PAJE_FORMAT and self.father is not None:
+            t.flush(force=True)
+            t.write_line(f"{PAJE_DestroyContainer} {_fmt_time(t.clock())} "
+                         f"{self.type.id} {self.id}")
+        elif t.format == TI_FORMAT:
+            t.close_ti_file(self)
+        if self.father is not None:
+            self.father.children.pop(self.name, None)
+        t.containers_by_name.pop(self.name, None)
+
+    def child(self, name: str, type_name: str) -> "Container":
+        return self.children.get(name) or Container(
+            self.trace, name, type_name, self)
+
+
+class PajeEvent:
+    """A buffered timestamped event (instr_paje_events.cpp)."""
+
+    __slots__ = ("event_type", "timestamp", "type", "container", "tail")
+
+    def __init__(self, trace: "Trace", container: Container, type_: Type,
+                 event_type: int, tail: str = "", timestamp=None):
+        self.event_type = event_type
+        self.timestamp = trace.clock() if timestamp is None else timestamp
+        self.type = type_
+        self.container = container
+        self.tail = tail
+        trace.insert_into_buffer(self)
+
+    def render(self, precision: int) -> str:
+        line = (f"{self.event_type} {_fmt_time(self.timestamp, precision)} "
+                f"{self.type.id} {self.container.id}")
+        if self.tail:
+            line += f" {self.tail}"
+        return line
+
+
+class TIEvent:
+    """A TI-mode action line routed to its rank's trace file; buffered in
+    the same stream as Paje events to keep flush ordering uniform."""
+
+    __slots__ = ("timestamp", "container", "line", "event_type")
+
+    def __init__(self, trace: "Trace", container: Container, line: str,
+                 timestamp=None):
+        self.event_type = -1
+        self.timestamp = trace.clock() if timestamp is None else timestamp
+        self.container = container
+        self.line = line
+        trace.insert_into_buffer(self)
+
+
+class Trace:
+    """One tracing session: output file(s), type/container trees, buffer.
+
+    Owned by the engine that started tracing; `flush()` is wired to the
+    engine's time-advance signal so events with timestamps at or before
+    the new simulated NOW hit the file in order, exactly when the
+    reference calls TRACE_paje_dump_buffer (surf_c_bindings.cpp:148).
+    """
+
+    def __init__(self, filename: str, fmt: str, clock_getter,
+                 precision: int = 9, display_sizes: bool = False):
+        self.format = fmt
+        self.filename = filename
+        self.clock = clock_getter
+        self.precision = precision
+        self.display_sizes = display_sizes
+        self._next_id = 0
+        self.containers_by_name: Dict[str, Container] = {}
+        self.root_container: Optional[Container] = None
+        self._buffer: List = []
+        self._keys: List[float] = []  # timestamps, for bisect insertion
+        self.ti_files: Dict[str, TextIO] = {}
+        self.file: Optional[TextIO] = open(filename, "w")
+        if fmt == PAJE_FORMAT:
+            self._write_header()
+
+    # -- ids ---------------------------------------------------------------
+    def new_id(self) -> int:
+        self._next_id += 1
+        return self._next_id
+
+    # -- raw write ---------------------------------------------------------
+    def write_line(self, line: str) -> None:
+        self.file.write(line + "\n")
+
+    def comment(self, text: str) -> None:
+        self.write_line(f"# {text}")
+
+    # -- header (instr_paje_header.cpp; non-basic, sizes optional) --------
+    def _write_header(self) -> None:
+        w = self.write_line
+        defs = [
+            ("PajeDefineContainerType", PAJE_DefineContainerType,
+             ["Alias string", "Type string", "Name string"]),
+            ("PajeDefineVariableType", PAJE_DefineVariableType,
+             ["Alias string", "Type string", "Name string", "Color color"]),
+            ("PajeDefineStateType", PAJE_DefineStateType,
+             ["Alias string", "Type string", "Name string"]),
+            ("PajeDefineEventType", PAJE_DefineEventType,
+             ["Alias string", "Type string", "Name string"]),
+            ("PajeDefineLinkType", PAJE_DefineLinkType,
+             ["Alias string", "Type string", "StartContainerType string",
+              "EndContainerType string", "Name string"]),
+            ("PajeDefineEntityValue", PAJE_DefineEntityValue,
+             ["Alias string", "Type string", "Name string", "Color color"]),
+            ("PajeCreateContainer", PAJE_CreateContainer,
+             ["Time date", "Alias string", "Type string",
+              "Container string", "Name string"]),
+            ("PajeDestroyContainer", PAJE_DestroyContainer,
+             ["Time date", "Type string", "Name string"]),
+            ("PajeSetVariable", PAJE_SetVariable,
+             ["Time date", "Type string", "Container string",
+              "Value double"]),
+            ("PajeAddVariable", PAJE_AddVariable,
+             ["Time date", "Type string", "Container string",
+              "Value double"]),
+            ("PajeSubVariable", PAJE_SubVariable,
+             ["Time date", "Type string", "Container string",
+              "Value double"]),
+            ("PajeSetState", PAJE_SetState,
+             ["Time date", "Type string", "Container string",
+              "Value string"]),
+            ("PajePushState", PAJE_PushState,
+             ["Time date", "Type string", "Container string",
+              "Value string"]
+             + (["Size int"] if self.display_sizes else [])),
+            ("PajePopState", PAJE_PopState,
+             ["Time date", "Type string", "Container string"]),
+            ("PajeResetState", PAJE_ResetState,
+             ["Time date", "Type string", "Container string"]),
+            ("PajeStartLink", PAJE_StartLink,
+             ["Time date", "Type string", "Container string",
+              "Value string", "StartContainer string", "Key string"]
+             + (["Size int"] if self.display_sizes else [])),
+            ("PajeEndLink", PAJE_EndLink,
+             ["Time date", "Type string", "Container string",
+              "Value string", "EndContainer string", "Key string"]),
+            ("PajeNewEvent", PAJE_NewEvent,
+             ["Time date", "Type string", "Container string",
+              "Value string"]),
+        ]
+        for name, code, fields in defs:
+            w(f"%EventDef {name} {code}")
+            for field in fields:
+                w(f"%       {field}")
+            w("%EndEventDef")
+
+    # -- TI per-rank files -------------------------------------------------
+    def open_ti_file(self, container: Container) -> None:
+        folder = self.filename + "_files"
+        os.makedirs(folder, exist_ok=True)
+        path = os.path.join(folder, f"{container.name}.txt")
+        self.ti_files[container.name] = open(path, "w")
+        # The master trace file lists the per-rank files (what
+        # smpi_replay consumes as the trace-file list).
+        self.write_line(path)
+
+    def close_ti_file(self, container: Container) -> None:
+        if container.name in self.ti_files:
+            # Flush while the file is still registered — pending events
+            # for this rank must land before the handle goes away.
+            self.flush(force=True)
+            self.ti_files.pop(container.name).close()
+
+    # -- buffer (insert_into_buffer, instr_paje_trace.cpp:76-100) ---------
+    def insert_into_buffer(self, event) -> None:
+        pos = bisect.bisect_right(self._keys, event.timestamp)
+        self._keys.insert(pos, event.timestamp)
+        self._buffer.insert(pos, event)
+
+    def flush(self, up_to: Optional[float] = None, force: bool = False
+              ) -> None:
+        """Dump buffered events with timestamp <= up_to (all if force)."""
+        if force or up_to is None:
+            n = len(self._buffer)
+        else:
+            n = bisect.bisect_right(self._keys, up_to)
+        for event in self._buffer[:n]:
+            self._print(event)
+        del self._buffer[:n]
+        del self._keys[:n]
+
+    def _print(self, event) -> None:
+        if isinstance(event, TIEvent):
+            f = self.ti_files.get(event.container.name)
+            if f is not None:
+                f.write(event.line + "\n")
+        elif self.format == PAJE_FORMAT:
+            self.write_line(event.render(self.precision))
+
+    def close(self) -> None:
+        self.flush(force=True)
+        if self.root_container is not None:
+            self.root_container.remove_from_parent()
+            self.root_container = None
+        self.flush(force=True)
+        for f in self.ti_files.values():
+            f.close()
+        self.ti_files.clear()
+        if self.file is not None:
+            self.file.close()
+            self.file = None

@@ -1,0 +1,143 @@
+"""TI (time-independent) action payloads attached to SMPI state events.
+
+Mirrors the reference's TIData class family (instr_private.hpp:42-190):
+each SMPI call carries one of these; in TI trace mode its `print()`
+becomes the replayable action line (consumed by smpi.replay), in Paje
+mode `display_size()` is appended to the PushState event when
+tracing/smpi/display-sizes is on.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+def _num(x: float) -> str:
+    """Render like C++ ostream<<double: ints stay bare."""
+    f = float(x)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+class TIData:
+    def __init__(self, name: str):
+        self.name = name
+
+    def print(self) -> str:
+        return self.name
+
+    def display_size(self) -> str:
+        return "NA"
+
+
+class NoOpTIData(TIData):
+    """init, finalize, test, wait, barrier."""
+
+
+class CpuTIData(TIData):
+    """compute, sleep (instr_private.hpp:106-116)."""
+
+    def __init__(self, name: str, amount: float):
+        super().__init__(name)
+        self.amount = amount
+
+    def print(self) -> str:
+        return f"{self.name} {_num(self.amount)}"
+
+    def display_size(self) -> str:
+        return _num(self.amount)
+
+
+class Pt2PtTIData(TIData):
+    """send, isend, recv, irecv (instr_private.hpp:118-134)."""
+
+    def __init__(self, name: str, endpoint: int, size: int, tag: int,
+                 datatype: str = ""):
+        super().__init__(name)
+        self.endpoint = endpoint
+        self.size = size
+        self.tag = tag
+        self.datatype = datatype
+
+    def print(self) -> str:
+        return (f"{self.name} {self.endpoint} {self.tag} "
+                f"{self.size} {self.datatype}")
+
+    def display_size(self) -> str:
+        return str(self.size)
+
+
+class WaitTIData(TIData):
+    """wait carries the (src, dst, tag) of the awaited request
+    (WaitTIData in instr_smpi.hpp)."""
+
+    def __init__(self, src: int, dst: int, tag: int):
+        super().__init__("wait")
+        self.src, self.dst, self.tag = src, dst, tag
+
+    def print(self) -> str:
+        return f"wait {self.src} {self.dst} {self.tag}"
+
+
+class CollTIData(TIData):
+    """bcast, reduce, allreduce, gather, scatter, allgather, alltoall
+    (instr_private.hpp:136-158)."""
+
+    def __init__(self, name: str, root: int, amount: float, send_size: int,
+                 recv_size: int, send_type: str = "", recv_type: str = ""):
+        super().__init__(name)
+        self.root = root
+        self.amount = amount
+        self.send_size = send_size
+        self.recv_size = recv_size
+        self.send_type = send_type
+        self.recv_type = recv_type
+
+    def print(self) -> str:
+        parts = [self.name, str(self.send_size)]
+        if self.recv_size >= 0:
+            parts.append(str(self.recv_size))
+        if self.amount >= 0.0:
+            parts.append(_num(self.amount))
+        if self.root > 0 or (self.root == 0 and self.send_type):
+            parts.append(str(self.root))
+        parts.append(f"{self.send_type} {self.recv_type}")
+        return " ".join(parts)
+
+    def display_size(self) -> str:
+        return str(self.send_size)
+
+
+class VarCollTIData(TIData):
+    """gatherv, scatterv, allgatherv, alltoallv, reducescatter
+    (instr_private.hpp:160-190)."""
+
+    def __init__(self, name: str, root: int, send_size: int,
+                 sendcounts: Optional[List[int]], recv_size: int,
+                 recvcounts: Optional[List[int]], send_type: str = "",
+                 recv_type: str = ""):
+        super().__init__(name)
+        self.root = root
+        self.send_size = send_size
+        self.sendcounts = sendcounts
+        self.recv_size = recv_size
+        self.recvcounts = recvcounts
+        self.send_type = send_type
+        self.recv_type = recv_type
+
+    def print(self) -> str:
+        parts = [self.name]
+        if self.send_size >= 0:
+            parts.append(str(self.send_size))
+        if self.sendcounts is not None:
+            parts.extend(str(c) for c in self.sendcounts)
+        if self.recv_size >= 0:
+            parts.append(str(self.recv_size))
+        if self.recvcounts is not None:
+            parts.extend(str(c) for c in self.recvcounts)
+        if self.root > 0 or (self.root == 0 and self.send_type):
+            parts.append(str(self.root))
+        parts.append(f"{self.send_type} {self.recv_type}")
+        return " ".join(parts)
+
+    def display_size(self) -> str:
+        return str(self.send_size if self.send_size > 0 else self.recv_size)

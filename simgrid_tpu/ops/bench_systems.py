@@ -1,0 +1,89 @@
+"""Reference-identical LMM benchmark system construction.
+
+Replicates the construction protocol of the reference's solver benchmark
+(/root/reference/teshsuite/surf/maxmin_bench/maxmin_bench.cpp:20-78,110-116):
+the Lehmer LCG (16807 mod 2^31-1), the four size classes, the
+concurrency-limit and share draws, and the expand/expand_add element
+pattern — so the same seed produces a byte-identical system here, in the
+native C++ bench replica, and (by validated equivalence) the reference.
+Shared by tests/test_lmm.py and tools/measure_baseline.py.
+"""
+
+from .lmm_host import make_new_maxmin_system
+
+#: name -> (nb_cnst, nb_var, pw_base_limit, pw_max_limit)
+#: (maxmin_bench.cpp:110-116)
+CLASSES = {
+    "small": (10, 10, 1, 2),
+    "medium": (100, 100, 3, 6),
+    "big": (2000, 2000, 5, 8),
+    "huge": (20000, 20000, 7, 10),
+}
+
+RATE_NO_LIMIT = 0.2
+MAX_SHARE = 2
+
+
+def nb_elem(pw_base_limit, pw_max_limit):
+    """Elements per variable (maxmin_bench.cpp:172: int division)."""
+    return (1 << pw_base_limit) + (1 << (8 * pw_max_limit // 10))
+
+
+class Lehmer:
+    """The reference bench's LCG (maxmin_bench.cpp:20-35)."""
+
+    def __init__(self, seed):
+        self.seedx = seed
+
+    def myrand(self):
+        self.seedx = self.seedx * 16807 % 2147483647
+        return self.seedx % 1000
+
+    def float_random(self, mx):
+        return (mx * self.myrand()) / 1001.0
+
+    def int_random(self, mx):
+        return int(self.float_random(mx))
+
+
+def build_bench_system(seed, nb_cnst, nb_var, nb_elem, pw_base_limit,
+                       pw_max_limit, rate_no_limit=RATE_NO_LIMIT,
+                       max_share=MAX_SHARE):
+    """Build one bench system on the Python host solver
+    (maxmin_bench.cpp:37-78). Returns (system, variables)."""
+    rng = Lehmer(seed)
+    rng.myrand()  # the bench prints one draw before test()
+    s = make_new_maxmin_system(False)
+    cnsts = []
+    for _ in range(nb_cnst):
+        c = s.constraint_new(None, rng.float_random(10.0))
+        if rate_no_limit > rng.float_random(1.0):
+            limit = -1
+        else:
+            limit = (1 << pw_base_limit) + (1 << rng.int_random(pw_max_limit))
+        c.set_concurrency_limit(limit)
+        cnsts.append(c)
+    variables = []
+    for _ in range(nb_var):
+        v = s.variable_new(None, 1.0, -1.0, nb_elem)
+        share = 1 + rng.int_random(max_share)
+        v.set_concurrency_share(share)
+        used = [0] * nb_cnst
+        j = 0
+        while j < nb_elem:
+            k = rng.int_random(nb_cnst)
+            if used[k] >= share:
+                continue
+            s.expand(cnsts[k], v, rng.float_random(1.5))
+            s.expand_add(cnsts[k], v, rng.float_random(1.5))
+            used[k] += 1
+            j += 1
+        variables.append(v)
+    return s, variables
+
+
+def build_class(name, seed=1):
+    """Build one system of a named reference bench class."""
+    nb_cnst, nb_var, pw_base, pw_max = CLASSES[name]
+    return build_bench_system(seed, nb_cnst, nb_var,
+                              nb_elem(pw_base, pw_max), pw_base, pw_max)

@@ -208,8 +208,17 @@ class Variable:
         return minslack
 
     def can_enable(self) -> bool:
-        return (self.staged_penalty > 0
-                and self.get_min_concurrency_slack() >= self.concurrency_share)
+        # Early-exit slack scan (vs the reference's full
+        # get_min_concurrency_slack): the first constraint below the
+        # required share answers 'no' — keeps dense bench-protocol
+        # construction from going quadratic in staged variables.
+        if self.staged_penalty <= 0:
+            return False
+        share = self.concurrency_share
+        for elem in self.cnsts:
+            if elem.constraint.get_concurrency_slack() < share:
+                return False
+        return True
 
     def get_constraint(self, num: int) -> Optional[Constraint]:
         return self.cnsts[num].constraint if num < len(self.cnsts) else None

@@ -55,6 +55,8 @@ class Engine:
         normal use, like the reference)."""
         from ..kernel import profile as profile_mod
         from .mailbox import Mailbox
+        from .. import instr
+        instr.stop()
         if cls._instance is not None:
             cls._instance.pimpl.disconnect_signals()
         cls._instance = None
@@ -86,6 +88,12 @@ class Engine:
     def load_platform(self, path: str) -> None:
         self._ensure_models()
         PlatformLoader(self.pimpl).load(path)
+        # TRACE_start fires on platform creation in the reference
+        # (instr_config.cpp:297); same here so actors created before
+        # run() are captured.
+        if config["tracing"]:
+            from .. import instr
+            instr.start(self.pimpl)
 
     def create_root_zone(self, name: str, routing: str = "Full"):
         """Programmatic platform building entry."""
@@ -171,6 +179,9 @@ class Engine:
 
     # -- run ---------------------------------------------------------------
     def run(self) -> None:
+        if config["tracing"]:
+            from .. import instr
+            instr.start(self.pimpl)
         self.pimpl.run()
 
 

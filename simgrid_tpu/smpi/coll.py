@@ -127,6 +127,30 @@ def barrier_bruck(comm):
 # ---------------------------------------------------------------------------
 
 @register("reduce", "default")
+def reduce_flat_ireduce(comm, sendobj, op: Op, root: int = 0):
+    """The reference default: Colls::ireduce + wait
+    (smpi_default_selector.cpp Coll_reduce_default) — the root posts
+    irecvs from every rank up front so all incoming transfers share the
+    network concurrently, then folds in canonical order."""
+    if not op.is_commutative():
+        return reduce_linear(comm, sendobj, op, root)
+    rank, size = comm.rank(), comm.size()
+    if rank != root:
+        comm.send(sendobj, root, TAG_REDUCE)
+        return None
+    reqs = [comm.irecv(src, TAG_REDUCE) for src in range(size)
+            if src != root]
+    parts = [None] * size
+    parts[root] = sendobj
+    others = [src for src in range(size) if src != root]
+    for src, req in zip(others, reqs):
+        parts[src] = req.wait()
+    result = parts[size - 1]
+    for i in range(size - 2, -1, -1):
+        result = op(parts[i], result)
+    return result
+
+
 @register("reduce", "binomial")
 def reduce_binomial(comm, sendobj, op: Op, root: int = 0):
     """Binomial-tree reduction (colls/reduce/reduce-binomial.cpp);
@@ -225,32 +249,59 @@ def allreduce_rdb(comm, sendobj, op: Op):
 
 @register("allreduce", "lr")
 def allreduce_lr(comm, sendobj, op: Op):
-    """Ring (logical ring reduce-scatter + allgather) over object chunks
-    (colls/allreduce/allreduce-lr.cpp structure).  Works on any payload
-    by treating the whole object as one chunk per rank when it is not a
-    numpy array divisible into size chunks."""
+    """Logical-ring reduce-scatter + all-gather
+    (colls/allreduce/allreduce-lr.cpp:24-108), including its observable
+    timing quirks: an initial sendrecv-to-self copy (which rides the
+    loopback link in simulation), equal rcount//size chunks, and the
+    remaining rcount % size elements reduced by a recursive default
+    allreduce at the end."""
     import numpy as np
     rank, size = comm.rank(), comm.size()
     if not (isinstance(sendobj, np.ndarray) and len(sendobj) >= size):
+        # "when communication size is smaller than number of process
+        # (not support)" -> default (allreduce-lr.cpp:41-45)
         return allreduce_rdb(comm, sendobj, op)
-    chunks = np.array_split(sendobj.copy(), size)
-    # reduce-scatter phase
-    for step in range(size - 1):
-        send_idx = (rank - step + size) % size
-        recv_idx = (rank - step - 1 + size) % size
-        data = comm.sendrecv(chunks[send_idx], (rank + 1) % size,
+    rcount = len(sendobj)
+    count = rcount // size
+    remainder = rcount % size
+    buf = sendobj.copy()
+    chunk = lambda idx: buf[idx * count:(idx + 1) * count]
+
+    # One constant tag throughout: the reference's per-step tag + i walk
+    # would leave the reserved negative range and collide with other
+    # collectives' tags (and user tags); per-(pair,tag) FIFO ordering
+    # already sequences the ring steps, so one tag is equivalent and safe.
+    # copy partial data: sendrecv to self (allreduce-lr.cpp:69-73)
+    idx0 = (rank - 1 + size) % size
+    chunk_copy = comm.sendrecv(chunk(idx0).copy(), rank, rank,
+                               TAG_ALLREDUCE, TAG_ALLREDUCE)
+    buf[idx0 * count:(idx0 + 1) * count] = chunk_copy
+
+    # reduce-scatter (allreduce-lr.cpp:76-88); reduction applies
+    # sbuf + rbuf into the received chunk
+    for i in range(size - 1):
+        send_idx = (rank - 1 - i + 2 * size) % size
+        recv_idx = (rank - 2 - i + 2 * size) % size
+        data = comm.sendrecv(chunk(send_idx).copy(), (rank + 1) % size,
                              (rank - 1 + size) % size,
                              TAG_ALLREDUCE, TAG_ALLREDUCE)
-        chunks[recv_idx] = op(data, chunks[recv_idx])
-    # allgather phase
-    for step in range(size - 1):
-        send_idx = (rank + 1 - step + size) % size
-        recv_idx = (rank - step + size) % size
-        chunks[recv_idx] = comm.sendrecv(chunks[send_idx],
-                                         (rank + 1) % size,
-                                         (rank - 1 + size) % size,
-                                         TAG_ALLREDUCE, TAG_ALLREDUCE)
-    return np.concatenate(chunks)
+        reduced = op(sendobj[recv_idx * count:(recv_idx + 1) * count], data)
+        buf[recv_idx * count:(recv_idx + 1) * count] = reduced
+
+    # all-gather (allreduce-lr.cpp:91-97)
+    for i in range(size - 1):
+        send_idx = (rank - i + 2 * size) % size
+        recv_idx = (rank - 1 - i + 2 * size) % size
+        data = comm.sendrecv(chunk(send_idx).copy(), (rank + 1) % size,
+                             (rank - 1 + size) % size,
+                             TAG_ALLREDUCE, TAG_ALLREDUCE)
+        buf[recv_idx * count:(recv_idx + 1) * count] = data
+
+    if remainder:
+        # remainder chunk via the default algorithm (allreduce-lr.cpp:101-105)
+        tail = dispatch("allreduce")(comm, sendobj[size * count:], op)
+        buf[size * count:] = tail
+    return buf
 
 
 # ---------------------------------------------------------------------------

@@ -71,38 +71,57 @@ class Comm:
     def send(self, buf, dest: int, tag: int = 0,
              count: Optional[int] = None,
              datatype: Optional[Datatype] = None) -> None:
+        from . import instr_hooks as tr
         req = Request("send", buf, 1 if count is None else count, datatype, dest, tag, self)
-        req.start()
-        req.wait()
+        with tr.p2p_span("send", dest, tag, req) as visible:
+            if visible:
+                tr.send_arrow(self, dest, tag, req.size)
+            req.start()
+            req.wait()
 
     def ssend(self, buf, dest: int, tag: int = 0,
               count: Optional[int] = None,
               datatype: Optional[Datatype] = None) -> None:
+        from . import instr_hooks as tr
         req = Request("send", buf, 1 if count is None else count, datatype, dest, tag, self,
                       ssend=True)
-        req.start()
-        req.wait()
+        with tr.p2p_span("send", dest, tag, req) as visible:
+            if visible:
+                tr.send_arrow(self, dest, tag, req.size)
+            req.start()
+            req.wait()
 
     def isend(self, buf, dest: int, tag: int = 0,
               count: Optional[int] = None,
               datatype: Optional[Datatype] = None) -> Request:
+        from . import instr_hooks as tr
         req = Request("send", buf, 1 if count is None else count, datatype, dest, tag, self,
                       is_isend=True)
-        return req.start()
+        with tr.p2p_span("isend", dest, tag, req) as visible:
+            if visible:
+                tr.send_arrow(self, dest, tag, req.size)
+            return req.start()
 
     def recv(self, source: int = MPI_ANY_SOURCE, tag: int = MPI_ANY_TAG,
              buf=None, count: Optional[int] = None,
              datatype: Optional[Datatype] = None,
              status: Optional[Status] = None) -> Any:
+        from . import instr_hooks as tr
         req = Request("recv", buf, 1 if count is None else count, datatype, source, tag, self)
-        req.start()
-        return req.wait(status)
+        with tr.p2p_span("recv", source, tag, req) as visible:
+            req.start()
+            result = req._wait_inner(status)
+            if visible:
+                tr.recv_arrow_once(req)
+            return result
 
     def irecv(self, source: int = MPI_ANY_SOURCE, tag: int = MPI_ANY_TAG,
               buf=None, count: Optional[int] = None,
               datatype: Optional[Datatype] = None) -> Request:
+        from . import instr_hooks as tr
         req = Request("recv", buf, 1 if count is None else count, datatype, source, tag, self)
-        return req.start()
+        with tr.p2p_span("irecv", source, tag, req):
+            return req.start()
 
     def sendrecv(self, sendbuf, dest: int, recvsource: int,
                  sendtag: int = 0, recvtag: int = MPI_ANY_TAG,
@@ -124,44 +143,66 @@ class Comm:
 
     # -- collectives (dispatch through the selector) -----------------------
     def barrier(self) -> None:
-        from . import coll
-        coll.dispatch("barrier")(self)
+        from . import coll, instr_hooks as tr
+        with tr.noop_span("barrier"):
+            coll.dispatch("barrier")(self)
 
     def bcast(self, obj, root: int = 0):
-        from . import coll
-        return coll.dispatch("bcast")(self, obj, root)
+        from . import coll, instr_hooks as tr
+        with tr.coll_span("bcast", payload_size(obj, None), root=root):
+            return coll.dispatch("bcast")(self, obj, root)
 
     def reduce(self, sendobj, op: Op = MPI_SUM, root: int = 0):
-        from . import coll
-        return coll.dispatch("reduce")(self, sendobj, op, root)
+        from . import coll, instr_hooks as tr
+        with tr.coll_span("reduce", payload_size(sendobj, None),
+                          amount=0.0, root=root):
+            return coll.dispatch("reduce")(self, sendobj, op, root)
 
     def allreduce(self, sendobj, op: Op = MPI_SUM):
-        from . import coll
-        return coll.dispatch("allreduce")(self, sendobj, op)
+        from . import coll, instr_hooks as tr
+        with tr.coll_span("allreduce", payload_size(sendobj, None),
+                          amount=0.0):
+            return coll.dispatch("allreduce")(self, sendobj, op)
 
     def gather(self, sendobj, root: int = 0):
-        from . import coll
-        return coll.dispatch("gather")(self, sendobj, root)
+        from . import coll, instr_hooks as tr
+        with tr.coll_span("gather", payload_size(sendobj, None),
+                          recv_size=0, root=root):
+            return coll.dispatch("gather")(self, sendobj, root)
 
     def allgather(self, sendobj) -> List:
-        from . import coll
-        return coll.dispatch("allgather")(self, sendobj)
+        from . import coll, instr_hooks as tr
+        with tr.coll_span("allgather", payload_size(sendobj, None),
+                          recv_size=0):
+            return coll.dispatch("allgather")(self, sendobj)
 
     def scatter(self, sendobjs: Optional[List], root: int = 0):
-        from . import coll
-        return coll.dispatch("scatter")(self, sendobjs, root)
+        from . import coll, instr_hooks as tr
+        size = payload_size(sendobjs[0], None) if sendobjs else 0
+        with tr.coll_span("scatter", size, recv_size=int(size), root=root):
+            return coll.dispatch("scatter")(self, sendobjs, root)
 
     def alltoall(self, sendobjs: List) -> List:
-        from . import coll
-        return coll.dispatch("alltoall")(self, sendobjs)
+        from . import coll, instr_hooks as tr
+        size = payload_size(sendobjs[0], None) if sendobjs else 0
+        with tr.coll_span("alltoall", size, recv_size=int(size)):
+            return coll.dispatch("alltoall")(self, sendobjs)
 
     def reduce_scatter(self, sendobjs: List, op: Op = MPI_SUM):
-        from . import coll
-        return coll.dispatch("reduce_scatter")(self, sendobjs, op)
+        from . import coll, instr_hooks as tr
+        counts = [int(payload_size(o, None)) for o in (sendobjs or [])]
+        # Reference shape: "reducescatter 0 <recvcounts...> <comp> <dt>"
+        # (VarCollTIData with send_size=0, comp_size riding send_type,
+        # smpi_replay.cpp ReduceScatterAction).
+        with tr.varcoll_span("reducescatter", send_size=0, recv_size=-1,
+                             recvcounts=counts, send_type="0",
+                             recv_type="6"):
+            return coll.dispatch("reduce_scatter")(self, sendobjs, op)
 
     def scan(self, sendobj, op: Op = MPI_SUM):
-        from . import coll
-        return coll.dispatch("scan")(self, sendobj, op)
+        from . import coll, instr_hooks as tr
+        with tr.noop_span("scan"):
+            return coll.dispatch("scan")(self, sendobj, op)
 
     def __repr__(self):
         return f"<Comm id={self.id} size={self.size()}>"

@@ -84,6 +84,36 @@ MPI_DOUBLE = Datatype(8, np.float64, "MPI_DOUBLE")
 # (value, index) pairs for MAXLOC/MINLOC
 MPI_DOUBLE_INT = Datatype(12, None, "MPI_DOUBLE_INT")
 
+# Trace ids: the numeric datatype codes used in TI traces, matching the
+# reference's id2type registry (smpi_datatype.cpp:37-66) so traces are
+# interchangeable with the reference's replay engine.
+_TRACE_IDS = {
+    "MPI_DOUBLE": "0", "MPI_INT": "1", "MPI_CHAR": "2", "MPI_SHORT": "3",
+    "MPI_LONG": "4", "MPI_FLOAT": "5", "MPI_BYTE": "6",
+    "MPI_UNSIGNED": "11", "MPI_UNSIGNED_LONG": "12",
+    "MPI_DOUBLE_INT": "32",
+}
+_ID_TO_TYPE = {}
+
+
+def encode(datatype: Optional[Datatype]) -> str:
+    """Datatype -> trace id (Datatype::encode)."""
+    if datatype is None:
+        return _TRACE_IDS["MPI_DOUBLE"]
+    return _TRACE_IDS.get(datatype.name, "6")
+
+
+def decode(datatype_id: str) -> Datatype:
+    """Trace id (or name) -> Datatype (Datatype::decode); unknown ids
+    fall back to MPI_BYTE like unrecognized TAU trace types."""
+    if not _ID_TO_TYPE:
+        by_name = {name: obj for name, obj in globals().items()
+                   if isinstance(obj, Datatype)}
+        for name, tid in _TRACE_IDS.items():
+            _ID_TO_TYPE[tid] = by_name[name]
+            _ID_TO_TYPE[name] = by_name[name]
+    return _ID_TO_TYPE.get(datatype_id, MPI_BYTE)
+
 
 def payload_size(payload, datatype: Optional[Datatype]) -> float:
     """Wire size of a payload: count * datatype size for arrays, or a

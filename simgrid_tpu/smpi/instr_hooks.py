@@ -1,0 +1,171 @@
+"""SMPI binding-layer tracing hooks.
+
+The reference instruments every MPI entry point in its PMPI bindings
+(TRACE_smpi_comm_in/out, smpi_pmpi_*.cpp) and hides the point-to-point
+traffic generated *inside* collective algorithms unless
+tracing/smpi/internals is set (TRACE_smpi_view_internals). Here the
+binding layer is Comm's public methods; each span tracks per-world-rank
+nesting depth and yields its own visibility, which call sites use to
+gate the pt2pt arrows — so suppression is symmetric on both sides of a
+matched message and free of cross-rank depth confusion.
+
+When tracing is off every span builder returns one shared null context:
+no lambda, no generator, no TIData — the hot p2p path pays a single
+enabled() check.
+"""
+
+from __future__ import annotations
+
+from contextlib import nullcontext
+from typing import Callable, Dict
+
+from .. import instr
+from ..instr import ti
+from ..utils.config import config, declare_flag
+
+declare_flag("tracing/smpi/internals",
+             "Also display the communications produced inside collective "
+             "operations", False)
+
+_depth: Dict[int, int] = {}
+
+#: Shared disabled-span: `with span(...) as visible` yields False.
+_NULL = nullcontext(False)
+
+
+def _rank() -> int:
+    from . import runtime
+    return runtime.this_rank()
+
+
+class _Span:
+    """One traced MPI call: push state (or emit the TI action line) on
+    entry, pop on exit. Yields True when this call is visible (top-level
+    or internals tracing on)."""
+
+    __slots__ = ("op_name", "extra_factory", "ti_line", "rank", "depth",
+                 "visible")
+
+    def __init__(self, op_name: str,
+                 extra_factory: Callable[[], ti.TIData], ti_line: bool):
+        self.op_name = op_name
+        self.extra_factory = extra_factory
+        self.ti_line = ti_line
+
+    def __enter__(self) -> bool:
+        self.rank = _rank()
+        self.depth = _depth.get(self.rank, 0)
+        _depth[self.rank] = self.depth + 1
+        self.visible = self.depth == 0 or config["tracing/smpi/internals"]
+        if self.visible:
+            instr.smpi_in(self.rank, self.op_name, self.extra_factory(),
+                          ti_line=self.ti_line)
+        return self.visible
+
+    def __exit__(self, *exc) -> None:
+        _depth[self.rank] = self.depth
+        if self.visible:
+            instr.smpi_out(self.rank)
+
+
+def span(op_name: str, extra_factory: Callable[[], ti.TIData],
+         ti_line: bool = True):
+    if not instr.smpi_enabled():
+        return _NULL
+    return _Span(op_name, extra_factory, ti_line)
+
+
+def _elem_count(req) -> int:
+    """TI traces size p2p ops in datatype elements when a datatype is
+    known, bytes (MPI_BYTE) otherwise — matching the reference's
+    Pt2PtTIData usage in smpi_pmpi_request.cpp. An any-size recv
+    (unknown until matched) is encoded as -1; the replay engine probes
+    for the real size (smpi_replay.cpp RecvAction)."""
+    if req.datatype is not None:
+        return int(req.count)
+    return int(req.size) if req.size != float("inf") else -1
+
+
+def _encode(datatype) -> str:
+    from .datatype import encode
+    return encode(datatype) if datatype is not None else "6"
+
+
+def p2p_span(name: str, peer: int, tag: int, req):
+    if not instr.smpi_enabled():
+        return _NULL
+    return _Span(name, lambda: ti.Pt2PtTIData(
+        name, peer, _elem_count(req), tag, _encode(req.datatype)), True)
+
+
+def wait_span(req):
+    if not instr.smpi_enabled():
+        return _NULL
+    return _Span("wait",
+                 lambda: ti.WaitTIData(req.src, req.dst, req.tag), True)
+
+
+def coll_span(name: str, send_size, recv_size=-1, amount=-1.0, root=-1,
+              send_type: str = "6", recv_type: str = ""):
+    if not instr.smpi_enabled():
+        return _NULL
+    return _Span(name, lambda: ti.CollTIData(
+        name, root, amount, int(send_size), int(recv_size),
+        send_type, recv_type), True)
+
+
+def varcoll_span(name: str, root: int = -1, send_size: int = -1,
+                 sendcounts=None, recv_size: int = 0, recvcounts=None,
+                 send_type: str = "0", recv_type: str = "6"):
+    if not instr.smpi_enabled():
+        return _NULL
+    return _Span(name, lambda: ti.VarCollTIData(
+        name, root, send_size, sendcounts, recv_size, recvcounts,
+        send_type, recv_type), True)
+
+
+def cpu_span(name: str, amount: float):
+    """compute/sleep states; gated like TRACE_smpi_computing_in
+    (instr_smpi.cpp:191-202)."""
+    if not instr.smpi_enabled() or not config["tracing/smpi/computing"]:
+        return _NULL
+    return _Span(name, lambda: ti.CpuTIData(name, amount), True)
+
+
+def noop_span(name: str, ti_line: bool = True):
+    if not instr.smpi_enabled():
+        return _NULL
+    return _Span(name, lambda: ti.NoOpTIData(name), ti_line)
+
+
+# ---------------------------------------------------------------------------
+# pt2pt arrows — call ONLY when the enclosing span yielded visible=True.
+# ---------------------------------------------------------------------------
+
+def _ensure_rank_container(world_rank: int) -> None:
+    """The pt2pt arrow may reference a peer whose actor has not started
+    yet (so its own smpi_init has not run); create its container now."""
+    from . import runtime
+    instr.smpi_init(world_rank, runtime.state_of_world_rank(world_rank).host)
+
+
+def send_arrow(comm, dst: int, tag: int, size) -> None:
+    rank = comm.rank()
+    world_dst = comm.world_rank_of(dst)
+    _ensure_rank_container(world_dst)
+    instr.smpi_send(rank, comm.world_rank_of(rank), world_dst, tag,
+                    int(size))
+
+
+def recv_arrow_once(req) -> None:
+    """Emit the EndLink for a completed recv request exactly once, no
+    matter how it completed (wait, recv, test, waitany)."""
+    if getattr(req, "_arrow_done", False) or req.real_src < 0 \
+            or not req.finished:
+        return
+    req._arrow_done = True
+    comm = req.comm
+    world_src = comm.world_rank_of(req.real_src)
+    _ensure_rank_container(world_src)
+    instr.smpi_recv(world_src, comm.world_rank_of(comm.rank()),
+                    req.real_tag)

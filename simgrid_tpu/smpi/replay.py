@@ -1,0 +1,366 @@
+"""SMPI time-independent trace replay.
+
+Re-implements the reference's replay engine
+(src/smpi/internals/smpi_replay.cpp): each rank actor reads its action
+stream (one file per rank, or a merged file whose lines start with the
+rank id — src/xbt/xbt_replay.cpp queues per-rank), parses args with the
+same grammars (smpi_replay.cpp:143-200), and executes the corresponding
+MPI calls with dummy payloads sized by count x datatype. Asynchronous
+requests live in a per-rank RequestStorage keyed by (src, dst, tag)
+(smpi_replay.cpp:87-140).
+
+Replay is the fast path for studying real applications: the network/
+compute timings come entirely from the simulated platform, so a 16-rank
+allreduce trace replays in milliseconds while exercising the full
+collective + LMM stack (BASELINE config #1).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import datatype as dt
+from .request import MPI_ANY_SOURCE, Request, Status
+
+
+def _parse_double(s: str) -> float:
+    return float(s)
+
+
+def _buf(nbytes: float):
+    """Replay payloads only need a wire size; a tiny ndarray views work
+    as well as the reference's shared send/recv scratch buffers
+    (smpi_replay.cpp send_buffer/recv_buffer)."""
+    return None
+
+
+class RequestStorage:
+    """Pending request registry keyed (src, dst, tag) in world ranks
+    (smpi_replay.cpp:87-140)."""
+
+    def __init__(self):
+        self.store: Dict[Tuple[int, int, int], Optional[Request]] = {}
+
+    def find(self, src: int, dst: int, tag: int) -> Optional[Request]:
+        return self.store.get((src, dst, tag))
+
+    def remove(self, key: Tuple[int, int, int]) -> None:
+        self.store.pop(key, None)
+
+    def add(self, req: Request) -> None:
+        if req is not None:
+            self.store[(req.src, req.dst, req.tag)] = req
+
+    def add_null(self, src: int, dst: int, tag: int) -> None:
+        self.store[(src, dst, tag)] = None
+
+    def all_requests(self) -> List[Request]:
+        return [r for r in self.store.values() if r is not None]
+
+    def clear(self) -> None:
+        self.store.clear()
+
+
+class ReplayContext:
+    """Per-rank replay state: request storage + the default datatype
+    chosen by the init action (MPE double vs TAU byte)."""
+
+    def __init__(self, comm):
+        self.comm = comm
+        self.storage = RequestStorage()
+        self.default_type = dt.MPI_BYTE
+
+    def decode(self, token: Optional[str]) -> dt.Datatype:
+        return dt.decode(token) if token else self.default_type
+
+
+ActionHandler = Callable[[ReplayContext, List[str]], None]
+_handlers: Dict[str, ActionHandler] = {}
+
+
+def action(name: str):
+    def deco(fn: ActionHandler) -> ActionHandler:
+        _handlers[name] = fn
+        return fn
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# Action kernels (smpi_replay.cpp:398-700). action[0]=rank, action[1]=name.
+# ---------------------------------------------------------------------------
+
+@action("init")
+def _init(ctx, args):
+    # action[2] selects the MPE flavor whose default datatype is double
+    # (InitAction::kernel, smpi_replay.cpp:514-520).
+    ctx.default_type = dt.MPI_DOUBLE if len(args) > 2 else dt.MPI_BYTE
+
+
+@action("finalize")
+def _finalize(ctx, args):
+    pass
+
+
+@action("comm_size")
+def _comm_size(ctx, args):
+    pass  # communicator actions only sync in the reference too
+
+
+@action("comm_split")
+def _comm_split(ctx, args):
+    pass
+
+
+@action("comm_dup")
+def _comm_dup(ctx, args):
+    pass
+
+
+@action("compute")
+def _compute(ctx, args):
+    from .runtime import smpi_execute_flops
+    smpi_execute_flops(_parse_double(args[2]))
+
+
+@action("sleep")
+def _sleep(ctx, args):
+    from ..s4u import this_actor
+    this_actor.sleep_for(_parse_double(args[2]))
+
+
+@action("send")
+def _send(ctx, args):
+    partner, tag = int(args[2]), int(args[3])
+    size = _parse_double(args[4])
+    datatype = ctx.decode(args[5] if len(args) > 5 else None)
+    ctx.comm.send(_buf(size), partner, tag, count=int(size),
+                  datatype=datatype)
+
+
+@action("isend")
+def _isend(ctx, args):
+    partner, tag = int(args[2]), int(args[3])
+    size = _parse_double(args[4])
+    datatype = ctx.decode(args[5] if len(args) > 5 else None)
+    req = ctx.comm.isend(_buf(size), partner, tag, count=int(size),
+                         datatype=datatype)
+    ctx.storage.add(req)
+
+
+@action("recv")
+def _recv(ctx, args):
+    partner, tag = int(args[2]), int(args[3])
+    size = _parse_double(args[4]) if len(args) > 4 else -1.0
+    datatype = ctx.decode(args[5] if len(args) > 5 else None)
+    count = int(size) if size > 0 else None
+    ctx.comm.recv(partner, tag, count=count,
+                  datatype=datatype if size > 0 else None)
+
+
+@action("irecv")
+def _irecv(ctx, args):
+    partner, tag = int(args[2]), int(args[3])
+    size = _parse_double(args[4]) if len(args) > 4 else -1.0
+    datatype = ctx.decode(args[5] if len(args) > 5 else None)
+    req = ctx.comm.irecv(partner, tag,
+                         count=int(size) if size > 0 else None,
+                         datatype=datatype if size > 0 else None)
+    ctx.storage.add(req)
+
+
+@action("test")
+def _test(ctx, args):
+    src, dst, tag = int(args[2]), int(args[3]), int(args[4])
+    req = ctx.storage.find(src, dst, tag)
+    ctx.storage.remove((src, dst, tag))
+    if req is not None:
+        if req.test(Status()):
+            ctx.storage.add_null(src, dst, tag)
+        else:
+            ctx.storage.add(req)
+
+
+@action("wait")
+def _wait(ctx, args):
+    src, dst, tag = int(args[2]), int(args[3]), int(args[4])
+    req = ctx.storage.find(src, dst, tag)
+    ctx.storage.remove((src, dst, tag))
+    if req is None:
+        # Possibly completed by an earlier test (WaitAction::kernel).
+        return
+    req.wait(Status())
+
+
+@action("waitall")
+def _waitall(ctx, args):
+    reqs = ctx.storage.all_requests()
+    ctx.storage.clear()
+    if reqs:
+        Request.waitall(reqs)
+
+
+@action("barrier")
+def _barrier(ctx, args):
+    ctx.comm.barrier()
+
+
+@action("bcast")
+def _bcast(ctx, args):
+    size = _parse_double(args[2])
+    root = int(args[3]) if len(args) > 3 else 0
+    datatype = ctx.decode(args[4] if len(args) > 4 else None)
+    ctx.comm.bcast(_payload(size, datatype), root=root)
+
+
+@action("reduce")
+def _reduce(ctx, args):
+    comm_size = _parse_double(args[2])
+    comp_size = _parse_double(args[3])
+    root = int(args[4]) if len(args) > 4 else 0
+    datatype = ctx.decode(args[5] if len(args) > 5 else None)
+    from .op import MPI_SUM
+    from .runtime import smpi_execute_flops
+    ctx.comm.reduce(_payload(comm_size, datatype), MPI_SUM, root=root)
+    smpi_execute_flops(comp_size)
+
+
+@action("allreduce")
+def _allreduce(ctx, args):
+    comm_size = _parse_double(args[2])
+    comp_size = _parse_double(args[3])
+    datatype = ctx.decode(args[4] if len(args) > 4 else None)
+    from .op import MPI_SUM
+    from .runtime import smpi_execute_flops
+    ctx.comm.allreduce(_payload(comm_size, datatype), MPI_SUM)
+    smpi_execute_flops(comp_size)
+
+
+@action("alltoall")
+def _alltoall(ctx, args):
+    send_size = _parse_double(args[2])
+    recv_size = _parse_double(args[3]) if len(args) > 3 else send_size
+    datatype = ctx.decode(args[4] if len(args) > 4 else None)
+    n = ctx.comm.size()
+    ctx.comm.alltoall([_payload(send_size, datatype) for _ in range(n)])
+
+
+@action("gather")
+def _gather(ctx, args):
+    send_size = _parse_double(args[2])
+    root = int(args[4]) if len(args) > 4 else 0
+    datatype = ctx.decode(args[5] if len(args) > 5 else None)
+    ctx.comm.gather(_payload(send_size, datatype), root=root)
+
+
+@action("allgather")
+def _allgather(ctx, args):
+    send_size = _parse_double(args[2])
+    datatype = ctx.decode(args[4] if len(args) > 4 else None)
+    ctx.comm.allgather(_payload(send_size, datatype))
+
+
+@action("scatter")
+def _scatter(ctx, args):
+    send_size = _parse_double(args[2])
+    root = int(args[4]) if len(args) > 4 else 0
+    datatype = ctx.decode(args[5] if len(args) > 5 else None)
+    n = ctx.comm.size()
+    objs = [_payload(send_size, datatype) for _ in range(n)] \
+        if ctx.comm.rank() == root else None
+    ctx.comm.scatter(objs, root=root)
+
+
+@action("reducescatter")
+def _reducescatter(ctx, args):
+    # "reducescatter 0 <recvcounts x n> <comp_size> <datatype>"
+    # (ReduceScatterArgParser, smpi_replay.cpp:330-346).
+    n = ctx.comm.size()
+    recvcounts = [int(args[3 + i]) for i in range(n)]
+    comp_size = _parse_double(args[3 + n]) if len(args) > 3 + n else 0.0
+    from .op import MPI_SUM
+    from .runtime import smpi_execute_flops
+    ctx.comm.reduce_scatter(
+        [np.zeros(max(c // 8, 1)) for c in recvcounts], MPI_SUM)
+    smpi_execute_flops(comp_size)
+
+
+@action("alltoallv")
+def _alltoallv(ctx, args):
+    # send_buf_size, n sendcounts, recv_buf_size, n recvcounts
+    # (AllToAllVArgParser, smpi_replay.cpp:370-396).
+    n = ctx.comm.size()
+    sendcounts = [int(args[3 + i]) for i in range(n)]
+    datatype = ctx.decode(args[4 + 2 * n] if len(args) > 5 + 2 * n
+                          else None)
+    ctx.comm.alltoall([_payload(c, datatype) for c in sendcounts])
+
+
+def _payload(count: float, datatype: dt.Datatype):
+    """A dummy payload whose wire size is exactly count x datatype bytes
+    (byte-granular so chunking algorithms split like the reference)."""
+    return np.zeros(max(int(count * datatype.size()), 1), np.uint8)
+
+
+# ---------------------------------------------------------------------------
+# Trace reading (xbt_replay.cpp): merged file => per-rank queues.
+# ---------------------------------------------------------------------------
+
+def _actions_for_rank(trace_path: str, rank: int) -> List[List[str]]:
+    """Read this rank's action list. trace_path may be (a) a merged
+    action file whose lines start with the rank, (b) a file listing one
+    action file per rank (what the TI tracer emits as master file), or
+    (c) a per-rank file directly."""
+    with open(trace_path) as f:
+        first = f.readline().split()
+    if first and len(first) == 1 and os.path.exists(first[0]):
+        # (b) master list: one path per rank. Containers are created in
+        # first-touch order (a send arrow can pre-create a peer's file),
+        # so the list is NOT rank-ordered — match by the rank-N filename
+        # the TI tracer uses, falling back to list position for
+        # foreign-named files.
+        with open(trace_path) as f:
+            paths = f.read().split()
+        wanted = f"rank-{rank}.txt"
+        path = next((p for p in paths
+                     if os.path.basename(p) == wanted), None)
+        if path is None:
+            path = paths[rank]
+        with open(path) as f:
+            return [l.split() for l in f if l.strip()
+                    and not l.startswith("#")]
+    actions = []
+    with open(trace_path) as f:
+        for line in f:
+            parts = line.split("#", 1)[0].split()
+            if parts and parts[0] == str(rank):
+                actions.append(parts)
+    return actions
+
+
+def replay_main(trace_path: str) -> None:
+    """The per-rank replay actor body (smpi_replay_main)."""
+    from . import runtime
+    comm = runtime.world()
+    rank = comm.rank()
+    ctx = ReplayContext(comm)
+    for act in _actions_for_rank(trace_path, rank):
+        name = act[1]
+        handler = _handlers.get(name)
+        assert handler is not None, f"Replay action '{name}' unknown"
+        handler(ctx, act)
+    # Drain leftover async requests (smpi_replay_main:783-800).
+    leftovers = ctx.storage.all_requests()
+    if leftovers:
+        Request.waitall(leftovers)
+
+
+def smpi_replay_run(platform: str, trace_path: str, np_ranks: int,
+                    configs=()):
+    """Replay a TI trace end-to-end: build engine + ranks, run, return
+    the engine (inspect .clock for the simulated makespan)."""
+    from .runtime import smpirun
+    return smpirun(lambda: replay_main(trace_path), platform, np=np_ranks,
+                   configs=list(configs))

@@ -111,6 +111,7 @@ class Request:
         self.real_tag = tag
         self.real_size = self.size
         self.detached_sender: Optional["Request"] = None
+        self._arrow_done = False        # pt2pt EndLink emitted (tracing)
         self.pimpl: Optional[kact.CommImpl] = None
         self._dst_slot: Optional[list] = None
         self._me = me
@@ -213,6 +214,14 @@ class Request:
         self.finished = True
 
     def wait(self, status: Optional[Status] = None):
+        from . import instr_hooks as tr
+        with tr.wait_span(self) as visible:
+            result = self._wait_inner(status)
+            if visible and self.kind == "recv":
+                tr.recv_arrow_once(self)
+            return result
+
+    def _wait_inner(self, status: Optional[Status] = None):
         if self.finished:
             return self._result()
         if self.kind == "send" and self.detached:
@@ -228,6 +237,11 @@ class Request:
         return self._result()
 
     def test(self, status: Optional[Status] = None) -> bool:
+        from . import instr_hooks as tr
+        with tr.noop_span("test") as visible:
+            return self._test_inner(status, visible, tr)
+
+    def _test_inner(self, status, visible=False, tr=None) -> bool:
         if self.finished:
             return True
         if self.kind == "send" and self.detached:
@@ -239,6 +253,8 @@ class Request:
                              lambda sc: kact.comm_test(sc, comm_impl))
         if res:
             self._finish(status)
+            if visible and self.kind == "recv":
+                tr.recv_arrow_once(self)
         return bool(res)
 
     def cancel(self) -> None:
@@ -267,6 +283,15 @@ class Request:
     @staticmethod
     def waitany(requests: List["Request"],
                 status: Optional[Status] = None) -> int:
+        from . import instr_hooks as tr
+        # The TI/replay grammar has no waitany action (the reference's
+        # own StateEvent::print lists it as unimplemented); Paje gets
+        # the state push only.
+        with tr.noop_span("waitAny", ti_line=False) as visible:
+            return Request._waitany_inner(requests, status, visible, tr)
+
+    @staticmethod
+    def _waitany_inner(requests, status, visible=False, tr=None) -> int:
         pending = [(i, r) for i, r in enumerate(requests)
                    if r is not None and not r.finished]
         if not pending:
@@ -285,6 +310,8 @@ class Request:
             return -1
         i, req = pending[idx]
         req._finish(status)
+        if visible and req.kind == "recv":
+            tr.recv_arrow_once(req)
         return i
 
     @staticmethod

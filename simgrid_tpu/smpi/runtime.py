@@ -148,7 +148,9 @@ COMM_WORLD = _CommWorldProxy()
 
 def smpi_execute_flops(flops: float) -> None:
     from ..s4u import this_actor
-    this_actor.execute(flops)
+    from . import instr_hooks as tr
+    with tr.cpu_span("compute", flops):
+        this_actor.execute(flops)
 
 
 def smpi_execute(duration: float) -> None:
@@ -183,7 +185,13 @@ def smpi_main(fn, engine, hosts: Optional[Sequence] = None,
     _world = Comm(Group(list(range(n))))
 
     def rank_main():
-        fn(*args)
+        from .. import instr
+        state = this_rank_state()
+        instr.smpi_init(state.world_rank, state.host)
+        try:
+            fn(*args)
+        finally:
+            instr.smpi_finalize(state.world_rank)
 
     # Register every rank's state before any actor runs: rank 0's first
     # send must be able to resolve rank N's mailboxes.

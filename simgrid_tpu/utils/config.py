@@ -187,12 +187,15 @@ declare_flag("network/crosstraffic",
              "Model cross-traffic (bidirectional flows interfere)", True)
 declare_flag("network/TCP-gamma",
              "Maximum TCP window size (bytes)", 4194304.0)
+# Global defaults come from the LV08 model (sg_config.cpp:270-279); the
+# plain CM02 init resets them to 1.0/1.0/0.0, SMPI/IB override weight-S
+# only (network_smpi.cpp:24-31, network_ib.cpp init).
 declare_flag("network/latency-factor",
-             "Multiplier for link latencies", 1.0)
+             "Multiplier for link latencies", 13.01)
 declare_flag("network/bandwidth-factor",
-             "Multiplier for link bandwidths", 1.0)
+             "Multiplier for link bandwidths", 0.97)
 declare_flag("network/weight-S",
-             "RTT cost correction added per link (LV08: 20537)", 0.0)
+             "RTT cost correction added per link (LV08: 20537)", 20537.0)
 declare_flag("network/loopback-bw", "Default loopback bandwidth", 498000000.0)
 declare_flag("network/loopback-lat", "Default loopback latency", 0.000015)
 declare_flag("lmm/backend",

@@ -1,0 +1,125 @@
+"""Instrumentation tests: Paje trace structure + TI trace content.
+
+Reference test model: the examples' tracing tesh files
+(examples/s4u/trace-*/*.tesh) pin trace output; here we pin structural
+invariants (header, container balance, timestamp monotonicity) and the
+exact TI action lines (which double as the replay engine's input,
+smpi_replay.cpp).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from simgrid_tpu import s4u, smpi
+
+CLUSTER_XML = """<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <cluster id="c" prefix="node-" radical="0-3" suffix="" speed="1Gf"
+             bw="125MBps" lat="50us"/>
+  </zone>
+</platform>
+"""
+
+
+@pytest.fixture(autouse=True)
+def fresh_engine():
+    s4u.Engine._reset()
+    yield
+    s4u.Engine._reset()
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    path = os.path.join(tmp_path, "cluster4.xml")
+    with open(path, "w") as f:
+        f.write(CLUSTER_XML)
+    return path
+
+
+def mpi_main():
+    comm = smpi.COMM_WORLD
+    me = comm.rank()
+    if me == 0:
+        comm.send(np.arange(1000.0), 1, tag=7)
+    elif me == 1:
+        comm.recv(0, 7)
+    smpi.runtime.smpi_execute_flops(1e6)
+    comm.allreduce(np.arange(4.0))
+
+
+def test_paje_trace_structure(cluster, tmp_path):
+    trace_path = os.path.join(tmp_path, "out.trace")
+    smpi.smpirun(mpi_main, cluster, np=4, configs=[
+        "tracing:yes", f"tracing/filename:{trace_path}",
+        "tracing/platform:yes", "tracing/uncategorized:yes",
+        "tracing/smpi:yes", "tracing/smpi/computing:yes"])
+    lines = open(trace_path).read().splitlines()
+
+    # Header defines all 18 Paje event types.
+    assert sum(1 for l in lines if l.startswith("%EventDef")) == 18
+    body = [l for l in lines if not l.startswith("%") and l.strip()]
+
+    # Containers balance: every created container is destroyed.
+    created = [l for l in body if l.split()[0] == "6"]
+    destroyed = [l for l in body if l.split()[0] == "7"]
+    assert created and len(created) == len(destroyed)
+    # 4 hosts + 9 links (8 up/down + backbone/loopback) + 4 ranks exist.
+    names = " ".join(created)
+    for expected in ("node-0", "node-2", "rank-0", "rank-3"):
+        assert expected in names
+
+    # Event timestamps are nondecreasing (buffered flush ordering).
+    times = [float(l.split()[1]) for l in body
+             if l.split()[0] in "89" or l.split()[0] in ("11", "12", "13")]
+    assert times == sorted(times)
+
+    # MPI push/pop states balance per run.
+    pushes = [l for l in body if l.split()[0] == "12"]
+    pops = [l for l in body if l.split()[0] == "13"]
+    assert len(pushes) == len(pops) and pushes
+
+
+def test_ti_trace_content(cluster, tmp_path):
+    trace_path = os.path.join(tmp_path, "ti.trace")
+    smpi.smpirun(mpi_main, cluster, np=4, configs=[
+        "tracing:yes", f"tracing/filename:{trace_path}",
+        "tracing/format:TI", "tracing/smpi:yes",
+        "tracing/smpi/computing:yes"])
+    files = open(trace_path).read().split()
+    assert len(files) == 4
+    rank0 = open(files[0]).read().splitlines()
+    assert rank0 == ["0 send 1 7 8000 6", "0 compute 1000000",
+                     "0 allreduce 32 0 6 "]
+    rank2 = open(files[2]).read().splitlines()
+    assert rank2 == ["2 compute 1000000", "2 allreduce 32 0 6 "]
+
+
+def test_actor_tracing_s4u(cluster, tmp_path):
+    trace_path = os.path.join(tmp_path, "actor.trace")
+    e = s4u.Engine(["test", "--cfg=tracing:yes",
+                    f"--cfg=tracing/filename:{trace_path}",
+                    "--cfg=tracing/actor:yes"])
+    e.load_platform(cluster)
+
+    def worker():
+        s4u.this_actor.sleep_for(1.0)
+
+    s4u.Actor.create("w", e.host_by_name("node-0"), worker)
+    e.run()
+    body = [l for l in open(trace_path).read().splitlines()
+            if not l.startswith("%")]
+    # The actor container w-<pid> was created and destroyed, and its
+    # sleep state pushed/popped.
+    assert any("w-" in l for l in body if l.split()[0] == "6")
+    assert sum(1 for l in body if l.split()[0] == "12") == \
+        sum(1 for l in body if l.split()[0] == "13") == 1
+
+
+def test_tracing_off_no_file(cluster, tmp_path):
+    trace_path = os.path.join(tmp_path, "none.trace")
+    smpi.smpirun(mpi_main, cluster, np=4, configs=[
+        f"tracing/filename:{trace_path}"])
+    assert not os.path.exists(trace_path)

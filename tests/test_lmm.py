@@ -437,57 +437,8 @@ def test_f32_convergence_100k_flows(rounds_mode):
                   + 1e-3)
 
 
-class _Lehmer:
-    """The reference maxmin_bench's LCG (maxmin_bench.cpp:20-35), for
-    building byte-identical bench systems across implementations."""
-
-    def __init__(self, seed):
-        self.seedx = seed
-
-    def myrand(self):
-        self.seedx = self.seedx * 16807 % 2147483647
-        return self.seedx % 1000
-
-    def float_random(self, mx):
-        return (mx * self.myrand()) / 1001.0
-
-    def int_random(self, mx):
-        return int(self.float_random(mx))
-
-
-def _bench_system_python(seed, nb_cnst, nb_var, nb_elem, pw_base_limit,
-                         pw_max_limit, rate_no_limit, max_share):
-    """Replicates maxmin_bench.cpp:37-78 construction on the Python
-    solver, returning (system, vars)."""
-    rng = _Lehmer(seed)
-    rng.myrand()  # the bench prints one draw before test()
-    s = make_new_maxmin_system(False)
-    cnsts = []
-    for _ in range(nb_cnst):
-        c = s.constraint_new(None, rng.float_random(10.0))
-        if rate_no_limit > rng.float_random(1.0):
-            limit = -1
-        else:
-            limit = (1 << pw_base_limit) + (1 << rng.int_random(pw_max_limit))
-        c.set_concurrency_limit(limit)
-        cnsts.append(c)
-    variables = []
-    for _ in range(nb_var):
-        v = s.variable_new(None, 1.0, -1.0, nb_elem)
-        share = 1 + rng.int_random(max_share)
-        v.set_concurrency_share(share)
-        used = [0] * nb_cnst
-        j = 0
-        while j < nb_elem:
-            k = rng.int_random(nb_cnst)
-            if used[k] >= share:
-                continue
-            s.expand(cnsts[k], v, rng.float_random(1.5))
-            s.expand_add(cnsts[k], v, rng.float_random(1.5))
-            used[k] += 1
-            j += 1
-        variables.append(v)
-    return s, variables
+from simgrid_tpu.ops.bench_systems import build_bench_system as \
+    _bench_system_python  # shared with tools/measure_baseline.py
 
 
 def test_native_bench_matches_python_oracle():

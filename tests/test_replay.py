@@ -1,0 +1,163 @@
+"""SMPI trace-replay tests.
+
+The reference's replay tesh (examples/smpi/replay/replay.tesh) pins the
+simulated makespan of each trace on small_platform.xml under smpirun's
+default config (surf/precision:1e-9, network/model:SMPI); those numbers
+are reproduced here bit-for-bit. Plus a round-trip property: a TI trace
+captured from a live run replays to the identical makespan.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from simgrid_tpu import s4u, smpi
+from simgrid_tpu.smpi import replay
+from simgrid_tpu.smpi.runtime import smpirun
+
+REF_PLATFORMS = "/root/reference/examples/platforms"
+REF_REPLAY = "/root/reference/examples/smpi/replay"
+SMPIRUN_CFG = ["tracing:no", "surf/precision:1e-9", "network/model:SMPI"]
+
+needs_reference = pytest.mark.skipif(
+    not os.path.exists(REF_PLATFORMS), reason="reference files unavailable")
+
+
+@pytest.fixture(autouse=True)
+def fresh_engine():
+    s4u.Engine._reset()
+    yield
+    s4u.Engine._reset()
+
+
+def replay_on_small_platform(trace, n, hosts):
+    e = smpirun(lambda: replay.replay_main(trace),
+                f"{REF_PLATFORMS}/small_platform.xml", np=n, hosts=hosts,
+                configs=SMPIRUN_CFG)
+    return e.clock
+
+
+@needs_reference
+class TestReferenceOracles:
+    """Pinned makespans from examples/smpi/replay/replay.tesh."""
+
+    def test_p2p_trace(self, tmp_path):
+        # actions0/actions1: send/recv/compute/isend/irecv/wait mix
+        merged = os.path.join(tmp_path, "p2p.txt")
+        with open(merged, "w") as f:
+            f.write(open(f"{REF_REPLAY}/actions0.txt").read())
+            f.write(open(f"{REF_REPLAY}/actions1.txt").read())
+        clock = replay_on_small_platform(merged, 2, ["Tremblay", "Jupiter"])
+        assert clock == pytest.approx(13.608320, abs=5e-7)
+
+    def test_allreduce_trace(self, tmp_path):
+        trace = os.path.join(tmp_path, "ar.txt")
+        with open(trace, "w") as f:
+            for r in range(3):
+                f.write(f"{r} init\n")
+            for r in range(3):
+                f.write(f"{r} allreduce 5e4 5e8\n")
+            for r in range(3):
+                f.write(f"{r} compute 5e8\n")
+            for r in range(3):
+                f.write(f"{r} finalize\n")
+        clock = replay_on_small_platform(trace, 3,
+                                         ["Tremblay", "Jupiter", "Fafard"])
+        assert clock == pytest.approx(13.138198, abs=5e-7)
+
+    def test_bcast_reduce_trace(self):
+        clock = replay_on_small_platform(
+            f"{REF_REPLAY}/actions_bcast.txt", 3,
+            ["Tremblay", "Jupiter", "Fafard"])
+        assert clock == pytest.approx(19.691622, abs=5e-7)
+
+    def test_barrier_trace(self):
+        clock = replay_on_small_platform(
+            f"{REF_REPLAY}/actions_barrier.txt", 3,
+            ["Tremblay", "Jupiter", "Fafard"])
+        assert clock > 0
+
+
+CLUSTER_XML = """<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <cluster id="c" prefix="node-" radical="0-15" suffix="" speed="100Mf"
+             bw="125MBps" lat="50us"/>
+  </zone>
+</platform>
+"""
+
+
+@pytest.fixture
+def cluster16(tmp_path):
+    path = os.path.join(tmp_path, "c16.xml")
+    with open(path, "w") as f:
+        f.write(CLUSTER_XML)
+    return path
+
+
+def test_roundtrip_trace_then_replay(cluster16, tmp_path):
+    """A TI trace captured from a live run replays to the identical
+    makespan (the TI writer and the replay parser agree)."""
+    trace_path = os.path.join(tmp_path, "rt.trace")
+
+    def main():
+        comm = smpi.COMM_WORLD
+        me = comm.rank()
+        if me == 0:
+            comm.send(np.arange(1000.0), 1, tag=7)
+        elif me == 1:
+            comm.recv(0, 7)
+        smpi.runtime.smpi_execute_flops(1e6)
+        comm.allreduce(np.arange(4.0))
+        comm.barrier()
+
+    e1 = smpirun(main, cluster16, np=4, configs=[
+        "tracing:yes", f"tracing/filename:{trace_path}",
+        "tracing/format:TI", "tracing/smpi:yes",
+        "tracing/smpi/computing:yes"])
+    s4u.Engine._reset()
+    e2 = replay.smpi_replay_run(cluster16, trace_path, 4,
+                                configs=["tracing:no"])
+    assert e2.clock == pytest.approx(e1.clock, abs=1e-12)
+
+
+def test_16_rank_allreduce_baseline_shape(cluster16, tmp_path):
+    """BASELINE config #1 shape: 16-rank allreduce replay (merged trace)
+    completes with a pinned makespan."""
+    trace = os.path.join(tmp_path, "ar16.txt")
+    with open(trace, "w") as f:
+        for r in range(16):
+            f.write(f"{r} init\n")
+        for r in range(16):
+            f.write(f"{r} allreduce 5e4 5e8\n")
+        for r in range(16):
+            f.write(f"{r} finalize\n")
+    e = replay.smpi_replay_run(cluster16, trace, 16,
+                               configs=["tracing:no"])
+    # Deterministic: 5e8 flops at 100Mf = 5s + allreduce comm time.
+    assert 5.0 < e.clock < 5.2
+    first = e.clock
+    s4u.Engine._reset()
+    e = replay.smpi_replay_run(cluster16, trace, 16,
+                               configs=["tracing:no"])
+    assert e.clock == first
+
+
+def test_waitall_and_test_actions(cluster16, tmp_path):
+    trace = os.path.join(tmp_path, "wa.txt")
+    with open(trace, "w") as f:
+        f.write("0 init\n"
+                "0 isend 1 3 1e5\n"
+                "0 isend 1 4 1e5\n"
+                "0 waitall\n"
+                "0 finalize\n"
+                "1 init\n"
+                "1 irecv 0 3 1e5\n"
+                "1 test 0 1 3\n"
+                "1 irecv 0 4 1e5\n"
+                "1 waitall\n"
+                "1 finalize\n")
+    e = replay.smpi_replay_run(cluster16, trace, 2, configs=["tracing:no"])
+    assert e.clock > 0
