@@ -63,6 +63,236 @@ def _bucket(n: int) -> int:
     return 1 << (n - 1).bit_length()
 
 
+class LmmEllArrays(NamedTuple):
+    """ELL (padded-row) layout of an LMM system, the accelerator-native
+    form: every constraint owns a fixed-width row of (variable, weight)
+    slots and every variable a fixed-width row of constraint slots, so
+    each solver round is gathers + dense 2D row-reductions — no scatter
+    at all. Unsorted scatters are the one op class TPUs execute poorly
+    (the COO kernel spends ~100ms/round at 100k flows on them; this
+    layout runs the same round in ~1ms). Skewed systems (one backbone
+    constraint touching everything) would blow the row width up, so
+    conversion falls back to COO beyond a width cap."""
+    cv_var: np.ndarray    # [C, Wc] int32 — variable slot per element
+    cv_w: np.ndarray      # [C, Wc] float — weight (0 padding)
+    cv_valid: np.ndarray  # [C, Wc] bool
+    vc_cnst: np.ndarray   # [V, Wv] int32 — constraint slot per element
+    vc_valid: np.ndarray  # [V, Wv] bool
+    c_bound: np.ndarray
+    c_fatpipe: np.ndarray
+    v_penalty: np.ndarray
+    v_bound: np.ndarray
+    n_cnst: int
+    n_var: int
+
+
+#: Conversion to ELL is refused when a row would exceed this width
+#: (memory blow-up on skewed graphs) — COO handles those.
+_ELL_MAX_WIDTH = 512
+#: ...or when padding would inflate total slots by more than this
+#: factor over the element count.
+_ELL_MAX_FILL = 8.0
+
+
+def ell_from_arrays(arrays: LmmArrays) -> Optional[LmmEllArrays]:
+    """Host-side repack of the COO arrays into ELL rows (numpy)."""
+    E, C, V = arrays.n_elem, len(arrays.c_bound), len(arrays.v_penalty)
+    e_var = arrays.e_var[:E]
+    e_cnst = arrays.e_cnst[:E]
+    e_w = arrays.e_w[:E]
+
+    c_deg = np.bincount(e_cnst, minlength=C)
+    v_deg = np.bincount(e_var, minlength=V)
+    wc = int(c_deg.max()) if E else 1
+    wv = int(v_deg.max()) if E else 1
+    if wc > _ELL_MAX_WIDTH or wv > _ELL_MAX_WIDTH:
+        return None
+    Wc, Wv = _bucket(max(wc, 1)), _bucket(max(wv, 1))
+    if E and (C * Wc + V * Wv) > _ELL_MAX_FILL * 2 * E:
+        return None
+
+    def row_slots(keys, n_rows):
+        """Vectorized within-group slot index per element (stable)."""
+        order = np.argsort(keys, kind="stable")
+        sorted_keys = keys[order]
+        group_start = np.searchsorted(sorted_keys, np.arange(n_rows))
+        slots = np.arange(E, dtype=np.int64) - group_start[sorted_keys]
+        return order, sorted_keys, slots
+
+    cv_var = np.zeros((C, Wc), np.int32)
+    cv_w = np.zeros((C, Wc), arrays.e_w.dtype)
+    cv_valid = np.zeros((C, Wc), bool)
+    order, rows, slots = row_slots(e_cnst, C)
+    cv_var[rows, slots] = e_var[order]
+    cv_w[rows, slots] = e_w[order]
+    cv_valid[rows, slots] = e_w[order] > 0
+
+    vc_cnst = np.zeros((V, Wv), np.int32)
+    vc_valid = np.zeros((V, Wv), bool)
+    order, rows, slots = row_slots(e_var, V)
+    vc_cnst[rows, slots] = e_cnst[order]
+    vc_valid[rows, slots] = e_w[order] > 0
+
+    return LmmEllArrays(cv_var, cv_w, cv_valid, vc_cnst, vc_valid,
+                        arrays.c_bound, arrays.c_fatpipe,
+                        arrays.v_penalty, arrays.v_bound,
+                        arrays.n_cnst, arrays.n_var)
+
+
+def fixpoint_ell(ell: LmmEllArrays, eps, carry=None,
+                 parallel_rounds: bool = False,
+                 max_rounds: Optional[int] = None,
+                 return_carry: bool = False):
+    """The saturate-bottleneck fixpoint on the ELL layout: identical
+    round structure and epsilon semantics to `fixpoint` (see there for
+    the algorithm), with every segment reduction expressed as a masked
+    dense 2D row-reduction."""
+    cv_var, cv_w, cv_valid = ell.cv_var, ell.cv_w, ell.cv_valid
+    vc_cnst, vc_valid = ell.vc_cnst, ell.vc_valid
+    c_bound, c_fatpipe = ell.c_bound, ell.c_fatpipe
+    v_penalty, v_bound = ell.v_penalty, ell.v_bound
+    n_c = c_bound.shape[0]
+
+    dtype = cv_w.dtype
+    inf = jnp.array(jnp.inf, dtype)
+
+    v_enabled = v_penalty > 0
+    cv_evalid = cv_valid & jnp.take(v_enabled, cv_var)
+    safe_pen = jnp.where(v_enabled, v_penalty, 1.0)
+    cv_upen = jnp.where(cv_evalid, cv_w / jnp.take(safe_pen, cv_var), 0.0)
+
+    usage_sum = cv_upen.sum(axis=1)
+    usage_max = cv_upen.max(axis=1, initial=0.0)
+    usage0 = jnp.where(c_fatpipe, usage_max, usage_sum)
+
+    remaining0 = c_bound
+    light0 = (remaining0 > c_bound * eps) & (usage0 > 0)
+
+    v_value0 = jnp.where(jnp.isfinite(v_penalty), v_penalty, 0.0) * 0.0
+    v_fixed0 = v_penalty < 0
+
+    if carry is None:
+        carry = (v_value0, v_fixed0, remaining0, usage0, light0,
+                 jnp.array(0, jnp.int32))
+    start_it = carry[5]
+    if max_rounds is None:
+        max_rounds = _MAX_ROUNDS
+
+    # Variable-row element validity: a var row is enabled as a whole.
+    vc_evalid = vc_valid & v_enabled[:, None]
+
+    def cond(state):
+        light = state[4]
+        it = state[5]
+        return (jnp.any(light) & (it < _MAX_ROUNDS)
+                & (it - start_it < max_rounds))
+
+    def apply_fixes(state, fix_now, new_value):
+        v_value, v_fixed, remaining, usage, light, it = state
+        v_value = jnp.where(fix_now, new_value, v_value)
+        v_fixed = v_fixed | fix_now
+
+        cv_fix = cv_evalid & jnp.take(fix_now, cv_var)
+        d_rem = jnp.where(cv_fix, cv_w * jnp.take(v_value, cv_var),
+                          0.0).sum(axis=1)
+        d_use = jnp.where(cv_fix, cv_upen, 0.0).sum(axis=1)
+
+        new_remaining = remaining - d_rem
+        new_remaining = jnp.where(new_remaining < c_bound * eps, 0.0,
+                                  new_remaining)
+        new_usage_sum = usage - d_use
+        new_usage_sum = jnp.where(new_usage_sum < eps, 0.0, new_usage_sum)
+
+        cv_live2 = cv_evalid & ~jnp.take(v_fixed, cv_var)
+        new_usage_max = jnp.where(cv_live2, cv_upen,
+                                  0.0).max(axis=1, initial=0.0)
+
+        touched = cv_fix.any(axis=1)
+        new_usage = jnp.where(c_fatpipe, new_usage_max, new_usage_sum)
+        usage = jnp.where(touched, new_usage, usage)
+        remaining = jnp.where(touched & ~c_fatpipe, new_remaining,
+                              remaining)
+
+        drop = touched & (~(usage > eps) | ~(remaining > c_bound * eps))
+        light = light & ~drop
+        has_live = cv_live2.any(axis=1)
+        light = light & has_live
+        return v_value, v_fixed, remaining, usage, light, it + 1
+
+    def body_global(state):
+        v_value, v_fixed, remaining, usage, light, it = state
+        rou = jnp.where(light, remaining / jnp.where(light, usage, 1.0),
+                        inf)
+        min_usage = jnp.min(rou)
+        saturated_c = light & (rou == min_usage)
+
+        vc_live = vc_evalid & ~v_fixed[:, None]
+        v_sat = (vc_live & jnp.take(saturated_c, vc_cnst)).any(axis=1)
+
+        bp = v_bound * v_penalty
+        has_low_bound = v_sat & (v_bound > 0) & (bp < min_usage)
+        min_bound = jnp.min(jnp.where(has_low_bound, bp, inf))
+        use_bounds = jnp.isfinite(min_bound)
+
+        fix_now = jnp.where(use_bounds,
+                            v_sat & (jnp.abs(bp - min_bound) < eps),
+                            v_sat)
+        new_value = jnp.where(use_bounds, v_bound,
+                              min_usage / jnp.where(v_enabled, v_penalty,
+                                                    1.0))
+        return apply_fixes(state, fix_now, new_value)
+
+    def body_local(state):
+        v_value, v_fixed, remaining, usage, light, it = state
+        rou = jnp.where(light, remaining / jnp.where(light, usage, 1.0),
+                        inf)
+        vc_live = vc_evalid & ~v_fixed[:, None]
+        cv_live = cv_evalid & ~jnp.take(v_fixed, cv_var)
+
+        # Two-hop neighborhood min of rou: constraint -> vars -> cnst.
+        nmin_v = jnp.where(vc_live, jnp.take(rou, vc_cnst),
+                           inf).min(axis=1, initial=jnp.inf)
+        nmin_c = jnp.where(cv_live, jnp.take(nmin_v, cv_var),
+                           inf).min(axis=1, initial=jnp.inf)
+        processable = light & (rou <= nmin_c)
+
+        v_sat = (vc_live & jnp.take(processable, vc_cnst)).any(axis=1)
+        level_v = nmin_v
+
+        bp = v_bound * v_penalty
+        low_v = v_sat & (v_bound > 0) & (bp < level_v)
+        cv_bp = jnp.where(cv_live & jnp.take(low_v, cv_var),
+                          jnp.take(bp, cv_var), inf)
+        mb_c = cv_bp.min(axis=1, initial=jnp.inf)
+        mb_c = jnp.where(processable, mb_c, inf)
+        vc_proc = vc_live & jnp.take(processable, vc_cnst)
+        mb_v = jnp.where(vc_proc, jnp.take(mb_c, vc_cnst),
+                         inf).min(axis=1, initial=jnp.inf)
+        cv_proc = cv_live & processable[:, None]
+        blocked_c = (cv_proc
+                     & jnp.isfinite(jnp.take(mb_v, cv_var))).any(axis=1)
+
+        ok_c = processable & ~blocked_c
+        level2_v = jnp.where(vc_live & jnp.take(ok_c, vc_cnst),
+                             jnp.take(rou, vc_cnst),
+                             inf).min(axis=1, initial=jnp.inf)
+
+        fix_bound = low_v & (jnp.abs(bp - mb_v) < eps)
+        fix_level = jnp.isfinite(level2_v) & ~v_fixed & ~fix_bound
+        fix_now = fix_bound | fix_level
+        new_value = jnp.where(fix_bound, v_bound,
+                              level2_v / jnp.where(v_enabled, v_penalty,
+                                                   1.0))
+        return apply_fixes(state, fix_now, new_value)
+
+    out = lax.while_loop(
+        cond, body_local if parallel_rounds else body_global, carry)
+    v_value, v_fixed, remaining, usage, light, rounds = out
+    if return_carry:
+        return v_value, remaining, usage, rounds, out
+    return v_value, remaining, usage, rounds
+
+
 def fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
              eps, n_c: int, n_v: int, axis: Optional[str] = None,
              parallel_rounds: bool = False, carry=None,
@@ -274,6 +504,39 @@ def fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
     return v_value, remaining, usage, rounds
 
 
+@functools.partial(jax.jit, static_argnames=("parallel_rounds", "chunk"))
+def _solve_ell_chunk(cv_var, cv_w, cv_valid, vc_cnst, vc_valid, c_bound,
+                     c_fatpipe, v_penalty, v_bound, eps, carry,
+                     parallel_rounds: bool, chunk: int):
+    ell = LmmEllArrays(cv_var, cv_w, cv_valid, vc_cnst, vc_valid, c_bound,
+                       c_fatpipe, v_penalty, v_bound, 0, 0)
+    return fixpoint_ell(ell, eps, carry=carry,
+                        parallel_rounds=parallel_rounds, max_rounds=chunk,
+                        return_carry=True)
+
+
+#: Tiny memo for COO->ELL conversions so repeated solves of the same
+#: arrays (benchmarks, retries) do not re-pack on the host every call.
+#: Values hold the source LmmArrays, which (a) keeps the ids in the key
+#: alive so they cannot be recycled onto new arrays, and (b) allows an
+#: identity check on every field before a hit is trusted.
+_ELL_CACHE: dict = {}
+
+
+def _ell_cached(arrays: LmmArrays) -> Optional[LmmEllArrays]:
+    key = (id(arrays.e_var), id(arrays.e_cnst))
+    hit = _ELL_CACHE.get(key)
+    if hit is not None:
+        src, ell = hit
+        if all(a is b for a, b in zip(src, arrays)):
+            return ell
+    ell = ell_from_arrays(arrays)
+    if len(_ELL_CACHE) >= 8:
+        _ELL_CACHE.clear()
+    _ELL_CACHE[key] = (arrays, ell)
+    return ell
+
+
 @functools.partial(jax.jit,
                    static_argnames=("n_c", "n_v", "parallel_rounds", "chunk"))
 def _solve_kernel_chunk(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty,
@@ -354,31 +617,77 @@ def use_local_rounds() -> bool:
 # Device rounds per dispatch: bounds single-kernel run time (a spinning
 # f32 solve must come back to the host and raise, not trip the TPU
 # watchdog) while keeping the per-dispatch overhead negligible for the
-# common small-round case.
+# common small-round case. On an accelerator the cap is much lower: at
+# 100k flows one COO round costs ~100ms of device time (scatter-bound),
+# so 4096 rounds in one dispatch is minutes of kernel runtime — that,
+# not the math, is what killed the TPU worker in round 1 (the axon
+# watchdog kills kernels that run too long). 64 rounds keeps a
+# dispatch under ~10s worst-case while local-rounds solves typically
+# finish in one.
 _CHUNK_ROUNDS = 4096
+_CHUNK_ROUNDS_ACCEL = 64
+
+
+def _default_platform() -> str:
+    try:
+        return jax.devices()[0].platform
+    except Exception:
+        return "cpu"
+
+
+def _default_chunk() -> int:
+    return _CHUNK_ROUNDS if _default_platform() == "cpu" \
+        else _CHUNK_ROUNDS_ACCEL
 
 
 def solve_arrays(arrays: LmmArrays, eps: float, device=None,
                  parallel_rounds: Optional[bool] = None,
-                 chunk: int = _CHUNK_ROUNDS):
+                 chunk: Optional[int] = None):
     """Run the jit'd fixpoint in bounded-round chunks with host-side
     convergence checks between dispatches; returns
     (values, remaining, usage, rounds)."""
     if parallel_rounds is None:
         parallel_rounds = use_local_rounds()
-    args = [arrays.e_var, arrays.e_cnst, arrays.e_w, arrays.c_bound,
-            arrays.c_fatpipe, arrays.v_penalty, arrays.v_bound,
-            np.asarray(eps, arrays.e_w.dtype)]
-    if device is not None:
-        args = [jax.device_put(a, device) for a in args]
-    n_c, n_v = len(arrays.c_bound), len(arrays.v_penalty)
+    if chunk is None:
+        chunk = _default_chunk()
+
+    # Layout: ELL (dense padded rows, no scatters) on accelerators when
+    # the graph is not too skewed; COO everywhere else. lmm/layout
+    # overrides (coo|ell|auto).
+    layout = config["lmm/layout"]
+    ell = None
+    if layout == "ell" or (layout == "auto" and _default_platform() != "cpu"):
+        ell = _ell_cached(arrays)
+
+    eps_arr = np.asarray(eps, arrays.e_w.dtype)
+    if ell is not None:
+        args = [ell.cv_var, ell.cv_w, ell.cv_valid, ell.vc_cnst,
+                ell.vc_valid, ell.c_bound, ell.c_fatpipe, ell.v_penalty,
+                ell.v_bound, eps_arr]
+        if device is not None:
+            args = [jax.device_put(a, device) for a in args]
+
+        def run_chunk(carry):
+            return _solve_ell_chunk(*args, carry=carry,
+                                    parallel_rounds=parallel_rounds,
+                                    chunk=chunk)
+    else:
+        args = [arrays.e_var, arrays.e_cnst, arrays.e_w, arrays.c_bound,
+                arrays.c_fatpipe, arrays.v_penalty, arrays.v_bound,
+                eps_arr]
+        if device is not None:
+            args = [jax.device_put(a, device) for a in args]
+        n_c, n_v = len(arrays.c_bound), len(arrays.v_penalty)
+
+        def run_chunk(carry):
+            return _solve_kernel_chunk(
+                *args, carry=carry, n_c=n_c, n_v=n_v,
+                parallel_rounds=parallel_rounds, chunk=chunk)
 
     carry = None
     prev_progress = None
     while True:
-        values, remaining, usage, rounds, carry = _solve_kernel_chunk(
-            *args, carry=carry, n_c=n_c, n_v=n_v,
-            parallel_rounds=parallel_rounds, chunk=chunk)
+        values, remaining, usage, rounds, carry = run_chunk(carry)
         # One host sync per chunk: rounds + light count + fixed count.
         light = carry[4]
         n_light = int(jnp.count_nonzero(light))

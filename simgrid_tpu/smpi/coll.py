@@ -34,20 +34,46 @@ TAG_SCAN = -19
 _ALGOS: Dict[str, Dict[str, Callable]] = {}
 
 
+_SELECTORS = ("default", "mpich", "ompi")
+
+
 def register(op: str, name: str):
     def deco(fn):
-        _ALGOS.setdefault(op, {})[name] = fn
+        registry = _ALGOS.setdefault(op, {})
+        assert name not in registry, \
+            f"duplicate registration of {op}/{name}"
+        registry[name] = fn
         return fn
     return deco
 
 
 def dispatch(op: str) -> Callable:
+    """Pick the active algorithm: the per-op smpi/<op> flag wins; when
+    it is 'default', the smpi/coll-selector flag (default|mpich|ompi)
+    routes through the matching decision tree (smpi_coll.cpp:33-118
+    COLL_SETTER precedence). Ops a selector doesn't cover fall back to
+    the default algorithm."""
     name = config[f"smpi/{op}"]
     algos = _ALGOS[op]
+    if name == "default":
+        selector = config["smpi/coll-selector"]
+        if selector not in _SELECTORS:
+            # Unknown selectors abort like the reference's COLL_SETTER
+            # lookup (smpi_coll.cpp) instead of silently running default.
+            raise ValueError(
+                f"Unknown smpi/coll-selector {selector!r}; "
+                f"known: {_SELECTORS}")
+        if selector != "default" and selector in algos:
+            name = selector
     if name not in algos:
         raise ValueError(
             f"Unknown {op} algorithm {name!r}; known: {sorted(algos)}")
     return algos[name]
+
+
+def dispatch_name(op: str, name: str) -> Callable:
+    """Fetch a specific named algorithm (used by the selector trees)."""
+    return _ALGOS[op][name]
 
 
 # ---------------------------------------------------------------------------
@@ -451,10 +477,11 @@ def alltoall_bruck(comm, sendobjs):
 
 
 @register("alltoall", "default")
-@register("alltoall", "ompi")
 def alltoall_ompi(comm, sendobjs):
-    """OpenMPI-style size staging (coll_tuned_alltoall: bruck for tiny
-    blocks on big comms, linear for mid, pairwise for large)."""
+    """The default selector's size staging (Coll_alltoall_default mirrors
+    the ompi shape: bruck for tiny blocks on big comms, linear for mid,
+    pairwise for large). The faithful ompi decision tree lives in
+    coll_selectors.py under the name "ompi"."""
     size = comm.size()
     block = max(payload_size(b, None) for b in sendobjs) if sendobjs else 0
     if size >= 12 and block <= 200:
@@ -500,3 +527,10 @@ def scan_linear(comm, sendobj, op: Op):
     if rank < size - 1:
         comm.send(result, rank + 1, TAG_SCAN)
     return result
+
+
+# Extra algorithms + the mpich/ompi selector decision trees register
+# themselves into _ALGOS on import (kept in separate modules to keep
+# this one at the reference's default-selector scope).
+from . import coll_extra  # noqa: E402,F401  (registration side effects)
+from . import coll_selectors  # noqa: E402,F401

@@ -1,0 +1,318 @@
+"""mpich and ompi collective-selector decision trees.
+
+Re-implements the decision functions of smpi_mpich_selector.cpp and
+smpi_openmpi_selector.cpp: pick a concrete algorithm from message size
+and communicator size, with the same thresholds. Registered as
+algorithms named "mpich"/"ompi" for every operation, so either
+``--cfg=smpi/coll-selector:mpich`` (all ops at once) or
+``--cfg=smpi/<op>:mpich`` (a single op) selects them.
+
+Like MPI itself, size-staged selection assumes every rank passes a
+same-shaped payload to the collective (message_size must agree across
+ranks or different ranks would pick different algorithms).
+
+SMP-topology branches (mvapich2 two-level, SMP-binomial) are not taken:
+simulated deployments place one rank per host, where those algorithms
+degenerate to the flat equivalents chosen here (see coll_extra.py).
+"""
+
+from __future__ import annotations
+
+from .coll import dispatch_name, register
+from .datatype import payload_size
+from .op import Op
+
+
+def _pof2_below(n: int) -> int:
+    p = 1
+    while p <= n:
+        p <<= 1
+    return p >> 1
+
+
+def _is_pof2(n: int) -> bool:
+    return n & (n - 1) == 0
+
+
+def _require_symmetric(payload, what: str):
+    """Size-staged selection for rooted collectives needs the message
+    size on *every* rank (MPI gets it from count/datatype, which all
+    ranks pass). A None payload on a non-root rank would silently pick
+    a different algorithm than the root and deadlock — fail fast with
+    the contract instead."""
+    if payload is None:
+        raise ValueError(
+            f"smpi/coll-selector requires every rank to pass a "
+            f"same-shaped payload to {what} (the MPI count contract); "
+            f"pass a buffer of the right size on non-root ranks or use "
+            f"the default selector")
+
+
+# ---------------------------------------------------------------------------
+# mpich (smpi_mpich_selector.cpp)
+# ---------------------------------------------------------------------------
+
+@register("allreduce", "mpich")
+def allreduce_mpich(comm, sendobj, op: Op):
+    """smpi_mpich_selector.cpp:61-92 (SMP branch degenerate, see
+    module docstring)."""
+    block_dsize = payload_size(sendobj, None)
+    pof2 = _pof2_below(comm.size())
+    count = len(sendobj) if hasattr(sendobj, "__len__") else 1
+    if block_dsize > 2048 and count >= pof2 and op.is_commutative():
+        return dispatch_name("allreduce", "rab_rdb")(comm, sendobj, op)
+    return dispatch_name("allreduce", "rdb")(comm, sendobj, op)
+
+
+@register("alltoall", "mpich")
+def alltoall_mpich(comm, sendobjs):
+    """smpi_mpich_selector.cpp:141-188."""
+    size = comm.size()
+    block_dsize = payload_size(sendobjs[0], None) if sendobjs else 0
+    if block_dsize < 256 and size >= 8:
+        return dispatch_name("alltoall", "bruck")(comm, sendobjs)
+    if block_dsize < 32768:
+        return dispatch_name("alltoall",
+                             "mvapich2_scatter_dest")(comm, sendobjs)
+    if size % 2:
+        return dispatch_name("alltoall", "pair")(comm, sendobjs)
+    return dispatch_name("alltoall", "ring")(comm, sendobjs)
+
+
+@register("barrier", "mpich")
+def barrier_mpich(comm):
+    """smpi_mpich_selector.cpp:204-207: always ompi_bruck."""
+    return dispatch_name("barrier", "ompi_bruck")(comm)
+
+
+@register("bcast", "mpich")
+def bcast_mpich(comm, obj, root: int = 0):
+    """smpi_mpich_selector.cpp:252-296."""
+    _require_symmetric(obj, "bcast")
+    size = comm.size()
+    message_size = payload_size(obj, None)
+    if message_size < 12288 or size <= 8:
+        return dispatch_name("bcast", "binomial_tree")(comm, obj, root)
+    if message_size < 524288 and size % 2 == 0:
+        return dispatch_name("bcast",
+                             "scatter_rdb_allgather")(comm, obj, root)
+    return dispatch_name("bcast", "scatter_LR_allgather")(comm, obj, root)
+
+
+@register("reduce", "mpich")
+def reduce_mpich(comm, sendobj, op: Op, root: int = 0):
+    """smpi_mpich_selector.cpp:356-390."""
+    message_size = payload_size(sendobj, None)
+    pof2 = _pof2_below(comm.size())
+    count = len(sendobj) if hasattr(sendobj, "__len__") else 1
+    if count < pof2 or message_size < 2048 or not op.is_commutative():
+        return dispatch_name("reduce", "binomial")(comm, sendobj, op, root)
+    return dispatch_name("reduce", "scatter_gather")(comm, sendobj, op,
+                                                     root)
+
+
+@register("reduce_scatter", "mpich")
+def reduce_scatter_mpich(comm, sendobjs, op: Op):
+    """smpi_mpich_selector.cpp:439-482. The threshold is over total
+    *element counts* (the reference sums rcounts, never multiplied by
+    the datatype size)."""
+    total = sum(len(o) if hasattr(o, "__len__") else 1 for o in sendobjs)
+    if op.is_commutative() and total > 524288:
+        return dispatch_name("reduce_scatter",
+                             "mpich_pair")(comm, sendobjs, op)
+    if not op.is_commutative():
+        sizes = [payload_size(o, None) for o in sendobjs]
+        regular = all(s == sizes[0] for s in sizes)
+        if _is_pof2(comm.size()) and regular:
+            return dispatch_name("reduce_scatter",
+                                 "mpich_noncomm")(comm, sendobjs, op)
+    return dispatch_name("reduce_scatter", "mpich_rdb")(comm, sendobjs, op)
+
+
+@register("allgather", "mpich")
+def allgather_mpich(comm, sendobj):
+    """smpi_mpich_selector.cpp:535-570."""
+    size = comm.size()
+    total_dsize = payload_size(sendobj, None) * size
+    if _is_pof2(size) and total_dsize < 524288:
+        return dispatch_name("allgather", "rdb")(comm, sendobj)
+    if total_dsize <= 81920:
+        return dispatch_name("allgather", "bruck")(comm, sendobj)
+    return dispatch_name("allgather", "ring")(comm, sendobj)
+
+
+@register("gather", "mpich")
+def gather_mpich(comm, sendobj, root: int = 0):
+    """smpi_mpich_selector.cpp:671-683: always ompi_binomial."""
+    return dispatch_name("gather", "ompi_binomial")(comm, sendobj, root)
+
+
+@register("scatter", "mpich")
+def scatter_mpich(comm, sendobjs, root: int = 0):
+    """smpi_mpich_selector.cpp:706-723: always ompi_binomial."""
+    _require_symmetric(sendobjs, "scatter")
+    return dispatch_name("scatter", "ompi_binomial")(comm, sendobjs, root)
+
+
+# ---------------------------------------------------------------------------
+# ompi (smpi_openmpi_selector.cpp)
+# ---------------------------------------------------------------------------
+
+@register("allreduce", "ompi")
+def allreduce_ompi(comm, sendobj, op: Op):
+    """smpi_openmpi_selector.cpp:14-56."""
+    size = comm.size()
+    block_dsize = payload_size(sendobj, None)
+    count = len(sendobj) if hasattr(sendobj, "__len__") else 1
+    if block_dsize < 10000:
+        return dispatch_name("allreduce", "rdb")(comm, sendobj, op)
+    if op.is_commutative() and count > size:
+        if size * (1 << 20) >= block_dsize:
+            return dispatch_name("allreduce", "lr")(comm, sendobj, op)
+        return dispatch_name("allreduce",
+                             "ompi_ring_segmented")(comm, sendobj, op)
+    return dispatch_name("allreduce", "redbcast")(comm, sendobj, op)
+
+
+@register("alltoall", "ompi")
+def alltoall_ompi_selector(comm, sendobjs):
+    """smpi_openmpi_selector.cpp:58-89."""
+    size = comm.size()
+    block_dsize = payload_size(sendobjs[0], None) if sendobjs else 0
+    if block_dsize < 200 and size > 12:
+        return dispatch_name("alltoall", "bruck")(comm, sendobjs)
+    if block_dsize < 3000:
+        return dispatch_name("alltoall", "basic_linear")(comm, sendobjs)
+    return dispatch_name("alltoall", "ring")(comm, sendobjs)
+
+
+@register("barrier", "ompi")
+def barrier_ompi(comm):
+    """smpi_openmpi_selector.cpp:105-124."""
+    size = comm.size()
+    if size == 2:
+        return dispatch_name("barrier", "ompi_two_procs")(comm)
+    if _is_pof2(size):
+        return dispatch_name("barrier", "ompi_recursivedoubling")(comm)
+    return dispatch_name("barrier", "ompi_bruck")(comm)
+
+
+@register("bcast", "ompi")
+def bcast_ompi(comm, obj, root: int = 0):
+    """smpi_openmpi_selector.cpp:126-199 (segment sizes are folded into
+    the single pipeline implementation)."""
+    _require_symmetric(obj, "bcast")
+    size = comm.size()
+    message_size = payload_size(obj, None)
+    count = len(obj) if hasattr(obj, "__len__") else 1
+    if message_size < 2048 or count <= 1:
+        return dispatch_name("bcast", "binomial_tree")(comm, obj, root)
+    if message_size < 370728:
+        return dispatch_name("bcast",
+                             "ompi_split_bintree")(comm, obj, root)
+    if size < (1.6134e-6 * message_size + 2.1102):
+        return dispatch_name("bcast", "ompi_pipeline")(comm, obj, root)
+    if size < 13:
+        return dispatch_name("bcast",
+                             "ompi_split_bintree")(comm, obj, root)
+    if size < (2.3679e-6 * message_size + 1.1787) or \
+            size < (3.2118e-6 * message_size + 8.7936):
+        return dispatch_name("bcast", "ompi_pipeline")(comm, obj, root)
+    return dispatch_name("bcast", "flattree_pipeline")(comm, obj, root)
+
+
+@register("reduce", "ompi")
+def reduce_ompi_selector(comm, sendobj, op: Op, root: int = 0):
+    """smpi_openmpi_selector.cpp:227-302."""
+    size = comm.size()
+    message_size = payload_size(sendobj, None)
+    if not op.is_commutative():
+        if size < 12 and message_size < 2048:
+            return dispatch_name("reduce",
+                                 "ompi_basic_linear")(comm, sendobj, op,
+                                                      root)
+        return dispatch_name("reduce",
+                             "ompi_in_order_binary")(comm, sendobj, op,
+                                                     root)
+    count = len(sendobj) if hasattr(sendobj, "__len__") else 1
+    if size < 8 and message_size < 512:
+        return dispatch_name("reduce", "ompi_basic_linear")(comm, sendobj,
+                                                            op, root)
+    if (size < 8 and message_size < 20480) or message_size < 2048 \
+            or count <= 1:
+        return dispatch_name("reduce", "ompi_binomial")(comm, sendobj, op,
+                                                        root)
+    if size > (0.6016 / 1024.0 * message_size + 1.3496):
+        return dispatch_name("reduce", "ompi_binomial")(comm, sendobj, op,
+                                                        root)
+    if size > (0.0410 / 1024.0 * message_size + 9.7128):
+        return dispatch_name("reduce", "ompi_pipeline")(comm, sendobj, op,
+                                                        root)
+    if size > (0.0422 / 1024.0 * message_size + 1.1614):
+        return dispatch_name("reduce", "ompi_binary")(comm, sendobj, op,
+                                                      root)
+    return dispatch_name("reduce", "ompi_pipeline")(comm, sendobj, op,
+                                                    root)
+
+
+@register("reduce_scatter", "ompi")
+def reduce_scatter_ompi_selector(comm, sendobjs, op: Op):
+    """smpi_openmpi_selector.cpp:330-373."""
+    size = comm.size()
+    total = sum(payload_size(o, None) for o in sendobjs)
+    if not op.is_commutative():
+        return dispatch_name("reduce_scatter",
+                             "default")(comm, sendobjs, op)
+    pof2 = _is_pof2(size)
+    if total <= 12 * 1024 or (total <= 256 * 1024 and pof2) or \
+            size >= 0.0012 * total + 8.0:
+        return dispatch_name(
+            "reduce_scatter",
+            "ompi_basic_recursivehalving")(comm, sendobjs, op)
+    return dispatch_name("reduce_scatter", "ompi_ring")(comm, sendobjs, op)
+
+
+@register("allgather", "ompi")
+def allgather_ompi(comm, sendobj):
+    """smpi_openmpi_selector.cpp:384-427."""
+    size = comm.size()
+    if size == 2:
+        return dispatch_name("allgather", "pair")(comm, sendobj)
+    total_dsize = payload_size(sendobj, None) * size
+    if total_dsize < 50000:
+        if _is_pof2(size):
+            return dispatch_name("allgather", "rdb")(comm, sendobj)
+        return dispatch_name("allgather", "bruck")(comm, sendobj)
+    if size % 2:
+        return dispatch_name("allgather", "ring")(comm, sendobj)
+    return dispatch_name("allgather",
+                         "ompi_neighborexchange")(comm, sendobj)
+
+
+@register("gather", "ompi")
+def gather_ompi(comm, sendobj, root: int = 0):
+    """smpi_openmpi_selector.cpp:511-556 (the large-block linear_sync
+    branch included)."""
+    size = comm.size()
+    block_size = payload_size(sendobj, None)
+    if block_size > 6000:
+        return dispatch_name("gather", "ompi_linear_sync")(comm, sendobj,
+                                                           root)
+    if size > 60 or (size > 10 and block_size < 1024):
+        return dispatch_name("gather", "ompi_binomial")(comm, sendobj,
+                                                        root)
+    return dispatch_name("gather", "ompi_basic_linear")(comm, sendobj,
+                                                        root)
+
+
+@register("scatter", "ompi")
+def scatter_ompi(comm, sendobjs, root: int = 0):
+    """smpi_openmpi_selector.cpp:571-603."""
+    _require_symmetric(sendobjs, "scatter")
+    size = comm.size()
+    block_size = payload_size(sendobjs[0], None) if sendobjs else 0
+    if size > 10 and block_size < 300:
+        return dispatch_name("scatter", "ompi_binomial")(comm, sendobjs,
+                                                         root)
+    return dispatch_name("scatter", "ompi_basic_linear")(comm, sendobjs,
+                                                         root)

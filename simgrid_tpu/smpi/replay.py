@@ -268,8 +268,10 @@ def _scatter(ctx, args):
     root = int(args[4]) if len(args) > 4 else 0
     datatype = ctx.decode(args[5] if len(args) > 5 else None)
     n = ctx.comm.size()
-    objs = [_payload(send_size, datatype) for _ in range(n)] \
-        if ctx.comm.rank() == root else None
+    # Every rank passes the full (same-shaped) list: size-staged
+    # selectors need the message size everywhere (the MPI count
+    # contract); non-root payloads are never shipped.
+    objs = [_payload(send_size, datatype) for _ in range(n)]
     ctx.comm.scatter(objs, root=root)
 
 

@@ -206,6 +206,11 @@ declare_flag("lmm/jax-threshold",
              "Minimum live variable count before 'auto' switches the solve "
              "to the JAX backend", 512)
 declare_flag("lmm/dtype", "JAX solver dtype: float64 or float32", "float64")
+declare_flag("lmm/layout",
+             "Device solver element layout: coo (scatter/segment ops), "
+             "ell (dense padded rows — accelerator-native, no scatters), "
+             "auto (ell on accelerators when the graph is not too skewed)",
+             "auto")
 declare_flag("lmm/rounds",
              "JAX solver saturation-round strategy: global (one bottleneck "
              "level per round, the reference's sequential order) or local "

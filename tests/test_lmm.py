@@ -473,3 +473,60 @@ def test_native_bench_matches_python_oracle():
         s.solve_exact()
         py_vals.extend(v.value for v in variables)
     np.testing.assert_allclose(native_vals, py_vals, rtol=1e-6, atol=1e-9)
+
+
+@pytest.mark.parametrize("rounds_mode", ["global", "local"])
+def test_ell_layout_matches_coo(rounds_mode):
+    """The ELL (dense padded rows) kernel is the accelerator-native
+    layout; it must reproduce the COO kernel's solutions and round
+    counts exactly on randomized systems (same algorithm, different
+    storage)."""
+    from simgrid_tpu.ops import lmm_jax as lj
+
+    parallel = rounds_mode == "local"
+    for seed in range(6):
+        rng = np.random.default_rng(seed)
+        n_c, n_v, deg = 40, 120, 3
+        e_var = np.repeat(np.arange(n_v, dtype=np.int32), deg)
+        e_cnst = rng.integers(0, n_c, size=n_v * deg).astype(np.int32)
+        e_w = rng.uniform(0.5, 1.5, size=n_v * deg)
+        E, C, V = lj._bucket(n_v * deg), lj._bucket(n_c), lj._bucket(n_v)
+        arrays = lj.LmmArrays(
+            e_var=np.resize(e_var, E).astype(np.int32),
+            e_cnst=np.resize(e_cnst, E).astype(np.int32),
+            e_w=np.concatenate([e_w, np.zeros(E - n_v * deg)]),
+            c_bound=np.concatenate([rng.uniform(1, 10, n_c),
+                                    np.zeros(C - n_c)]),
+            c_fatpipe=np.zeros(C, bool),
+            v_penalty=np.concatenate([np.ones(n_v), np.zeros(V - n_v)]),
+            v_bound=np.full(V, -1.0),
+            n_elem=n_v * deg, n_cnst=n_c, n_var=n_v)
+        # resized e_var/e_cnst padding is inert (zero weights)
+        try:
+            config["lmm/layout"] = "coo"
+            v1, r1, u1, rounds1 = lj.solve_arrays(
+                arrays, 1e-9, parallel_rounds=parallel)
+            config["lmm/layout"] = "ell"
+            v2, r2, u2, rounds2 = lj.solve_arrays(
+                arrays, 1e-9, parallel_rounds=parallel)
+        finally:
+            config["lmm/layout"] = "auto"
+        assert rounds1 == rounds2
+        np.testing.assert_allclose(v1[:n_v], v2[:n_v], rtol=1e-12)
+        np.testing.assert_allclose(r1[:n_c], r2[:n_c], rtol=1e-12)
+
+
+def test_ell_conversion_refuses_skew():
+    """A backbone-style constraint touching every flow must fall back
+    to COO (the ELL row would explode)."""
+    from simgrid_tpu.ops import lmm_jax as lj
+
+    n_v = 2000
+    e_var = np.arange(n_v, dtype=np.int32)
+    e_cnst = np.zeros(n_v, np.int32)     # all on one constraint
+    arrays = lj.LmmArrays(
+        e_var=e_var, e_cnst=e_cnst, e_w=np.ones(n_v),
+        c_bound=np.array([5.0]), c_fatpipe=np.zeros(1, bool),
+        v_penalty=np.ones(n_v), v_bound=np.full(n_v, -1.0),
+        n_elem=n_v, n_cnst=1, n_var=n_v)
+    assert lj.ell_from_arrays(arrays) is None
