@@ -72,6 +72,22 @@ class Host:
     def get_load(self) -> float:
         return self.cpu.get_load()
 
+    # -- pstates (s4u::Host::set_pstate & friends) ------------------------
+    def set_pstate(self, index: int) -> None:
+        self.cpu.set_pstate(index)
+
+    def get_pstate(self) -> int:
+        return self.cpu.pstate
+
+    def get_pstate_count(self) -> int:
+        return self.cpu.get_pstate_count()
+
+    def get_pstate_speed(self, index: int) -> float:
+        assert 0 <= index < len(self.cpu.speed_per_pstate), \
+            (f"Invalid pstate {index} (must be in "
+             f"[0, {len(self.cpu.speed_per_pstate)})")
+        return self.cpu.speed_per_pstate[index]
+
     # -- routing ----------------------------------------------------------
     def route_to(self, dst: "Host", links: List) -> float:
         """Fill `links` with the route to dst; returns the summed latency

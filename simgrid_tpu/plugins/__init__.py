@@ -1,0 +1,12 @@
+"""Plugin ecosystem (reference src/plugins/): opt-in extensions hooked
+into the kernel's signals, activated per engine.
+
+Each plugin module exposes ``<name>_plugin_init(engine=None)`` mirroring
+the reference's ``sg_<name>_plugin_init()`` registration entry points
+(e.g. host_energy.cpp:481-500); subscriptions are engine-scoped so a
+torn-down engine's plugins never fire into a fresh one.
+"""
+
+from . import file_system, host_energy, host_load, link_energy, vm  # noqa: F401
+
+__all__ = ["host_energy", "host_load", "link_energy", "file_system", "vm"]

@@ -1,0 +1,170 @@
+"""file_system plugin: files and mount points over simulated storage.
+
+Reference: src/plugins/file_system/s4u_FileSystem.cpp. Each storage
+carries a content map (path -> size) and a used-size counter; a File
+resolves its mount point by longest-prefix match over the host's
+mounted storages (s4u_FileSystem.cpp:28-60), and read/write issue
+blocking I/O activities on the backing storage sized by the actual
+transferred bytes (:93-160). Writes grow the file and the storage's
+used size until the disk is full.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..s4u.activity import Io
+
+
+class FileSystemStorageExt:
+    """Per-storage content map + used size (FileSystemStorageExt)."""
+
+    def __init__(self, storage):
+        self.storage = storage
+        self.content: Dict[str, int] = {}
+        self.used_size = 0
+
+    def size(self) -> float:
+        return self.storage.size
+
+
+_EXT: Dict[int, FileSystemStorageExt] = {}
+
+
+def _storage_ext(storage) -> FileSystemStorageExt:
+    ext = _EXT.get(id(storage))
+    if ext is None:
+        ext = FileSystemStorageExt(storage)
+        _EXT[id(storage)] = ext
+    return ext
+
+
+def _mounts_of(host, engine) -> Dict[str, object]:
+    """mount_point -> storage for one host: every storage attached to
+    the host, mounted at its <mount name=...> point (default '/')."""
+    mounts = {}
+    for storage in engine.storages.values():
+        if storage.attach == host.name:
+            mounts[getattr(storage, "mount_point", "/") or "/"] = storage
+    return mounts
+
+
+class File:
+    """An open file (s4u_FileSystem.cpp File)."""
+
+    def __init__(self, fullpath: str, host=None):
+        from ..kernel.engine import EngineImpl
+        from ..s4u.actor import _current_impl
+        engine = EngineImpl.instance
+        if host is None:
+            host = _current_impl().host
+        self.host = host
+        self.fullpath = fullpath
+        mounts = _mounts_of(host, engine)
+        best = ""
+        for mount_point in mounts:
+            if fullpath.startswith(mount_point) and \
+                    len(mount_point) > len(best):
+                best = mount_point
+        assert best or "/" in mounts, \
+            f"Can't find mount point for '{fullpath}' on '{host.name}'"
+        self.mount_point = best or "/"
+        self.local_storage = mounts[self.mount_point]
+        self.path = fullpath[len(best):] if best else fullpath
+        ext = _storage_ext(self.local_storage)
+        self.size = ext.content.get(self.path, 0)
+        if self.path not in ext.content:
+            ext.content[self.path] = 0
+        self.current_position = 0
+
+    # -- I/O (s4u_FileSystem.cpp:93-160) ----------------------------------
+    def read(self, size: int) -> int:
+        if self.size == 0:
+            return 0
+        read_size = min(int(size), self.size - self.current_position)
+        if read_size <= 0:
+            return 0
+        Io(self.local_storage, read_size, Io.OpType.READ).wait()
+        self.current_position += read_size
+        return read_size
+
+    def write(self, size: int) -> int:
+        ext = _storage_ext(self.local_storage)
+        if ext.used_size >= self.local_storage.size:
+            return 0  # disk full (s4u_FileSystem.cpp:135-136)
+        write_size = min(int(size),
+                         int(self.local_storage.size - ext.used_size))
+        Io(self.local_storage, write_size, Io.OpType.WRITE).wait()
+        self.current_position += write_size
+        if self.current_position > self.size:
+            ext.used_size += self.current_position - self.size
+            self.size = self.current_position
+            ext.content[self.path] = self.size
+        return write_size
+
+    # -- metadata ----------------------------------------------------------
+    def seek(self, pos: int, origin: int = 0) -> None:
+        """origin: 0=SEEK_SET, 1=SEEK_CUR, 2=SEEK_END."""
+        if origin == 0:
+            self.current_position = pos
+        elif origin == 1:
+            self.current_position += pos
+        else:
+            self.current_position = self.size + pos
+
+    def tell(self) -> int:
+        return self.current_position
+
+    def get_size(self) -> int:
+        return self.size
+
+    def unlink(self) -> None:
+        ext = _storage_ext(self.local_storage)
+        ext.used_size -= ext.content.pop(self.path, 0)
+        self.size = 0
+
+    def move(self, new_fullpath: str) -> None:
+        """Rename within the same mount (File::move)."""
+        assert new_fullpath.startswith(self.mount_point), \
+            "Cannot move a file across mount points"
+        ext = _storage_ext(self.local_storage)
+        new_path = new_fullpath[len(self.mount_point):]
+        ext.content[new_path] = ext.content.pop(self.path, self.size)
+        self.path = new_path
+        self.fullpath = new_fullpath
+
+    def remote_copy(self, to_host, to_fullpath: str) -> "File":
+        """Read here, ship over the network, write there; blocks until
+        the destination write completed like the reference
+        (File::remote_copy)."""
+        from ..s4u.actor import Actor
+        from ..s4u.mailbox import Mailbox
+        self.seek(0)
+        read = self.read(self.size)
+        mbox = Mailbox.by_name(f"__fs_copy__{id(self)}")
+        done = Mailbox.by_name(f"__fs_copy_done__{id(self)}")
+
+        def receiver():
+            mbox.get()
+            dst = File(to_fullpath, to_host)
+            dst.write(read)
+            done.put(b"", 1)
+
+        Actor.create("__fs_copy__", to_host, receiver)
+        mbox.put(b"", read or 1)
+        done.get()
+        return File(to_fullpath, to_host)
+
+
+def storage_used_size(storage) -> int:
+    return _storage_ext(storage).used_size
+
+
+def storage_content(storage) -> Dict[str, int]:
+    return _storage_ext(storage).content
+
+
+def file_system_plugin_init(engine=None) -> None:
+    """sg_storage_file_system_init: content maps start empty and fill
+    lazily; nothing else to hook (files are purely host-side state)."""
+    _EXT.clear()

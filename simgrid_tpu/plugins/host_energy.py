@@ -1,0 +1,214 @@
+"""host_energy plugin: joules = integral of P(cpu load, pstate) dt.
+
+Reference: src/plugins/host_energy.cpp. Hosts declare a
+``watt_per_state`` property ("Idle:OneCore:AllCores" triples per
+pstate, comma-separated; "Idle:FullSpeed" pairs on single-core hosts,
+host_energy.cpp:344-397) and optionally ``watt_off``. Consumption is
+updated lazily at every CPU action state change / host state or speed
+change / exec start, using the pstate and load of the *elapsed*
+interval (host_energy.cpp:167-197).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..utils import log as _log
+
+_logger = _log.get_category("plugin_energy")
+
+
+class PowerRange:
+    __slots__ = ("idle", "min", "max")
+
+    def __init__(self, idle: float, min_: float, max_: float):
+        self.idle = idle
+        self.min = min_
+        self.max = max_
+
+
+class HostEnergy:
+    """Per-host energy accounting (host_energy.cpp HostEnergy)."""
+
+    def __init__(self, host, clock_getter):
+        self.host = host
+        self._clock = clock_getter
+        self.total_energy = 0.0
+        self.last_updated = clock_getter()
+        self.host_was_used = False
+        self.watts_off = float(host.properties.get("watt_off", 0.0))
+        self.power_ranges = self._parse_ranges()
+        # pstate of the *elapsed* interval (-1 = off), saved so a change
+        # notification bills the old state (host_energy.cpp:148-151).
+        self._pstate = host.cpu.pstate if host.is_on() else -1
+
+    def _parse_ranges(self) -> List[PowerRange]:
+        spec = self.host.properties.get("watt_per_state")
+        if spec is None:
+            return []
+        ranges = []
+        cores = self.host.cpu.core_count
+        for part in spec.split(","):
+            vals = [float(x) for x in part.strip().split(":")]
+            if cores == 1:
+                assert len(vals) in (2, 3), \
+                    (f"Power properties incorrectly defined for host "
+                     f"{self.host.name}: expected 'Idle:FullSpeed' for a "
+                     f"single-core host")
+                if len(vals) == 2:
+                    vals = [vals[0], vals[1], vals[1]]
+                else:
+                    # single core: only the AllCores value is meaningful
+                    vals = [vals[0], vals[2], vals[2]]
+            else:
+                assert len(vals) == 3, \
+                    (f"Power properties incorrectly defined for host "
+                     f"{self.host.name}: expected 'Idle:OneCore:AllCores'")
+            ranges.append(PowerRange(vals[0], vals[1], vals[2]))
+        return ranges
+
+    # -- power model (host_energy.cpp:240-332) ---------------------------
+    def get_current_watts_value(self,
+                                cpu_load: Optional[float] = None) -> float:
+        if self._pstate == -1:
+            return self.watts_off
+        assert self.power_ranges, \
+            f"No power range properties specified for host {self.host.name}"
+        if cpu_load is None:
+            current_speed = self.host.cpu.speed_per_pstate[self._pstate]
+            if current_speed <= 0:
+                cpu_load = 1.0
+            else:
+                cpu_load = (self.host.cpu.constraint.get_usage()
+                            / current_speed
+                            / self.host.cpu.core_count)
+                cpu_load = min(cpu_load, 1.0)
+            if cpu_load > 0:
+                self.host_was_used = True
+        rng = self.power_ranges[self._pstate]
+        if cpu_load <= 0:
+            return rng.idle
+        cores = self.host.cpu.core_count
+        core_recip = 1.0 / cores
+        slope = ((rng.max - rng.min) / (1 - core_recip)) if cores > 1 else 0.0
+        return rng.min + (cpu_load - core_recip) * slope
+
+    def update(self) -> None:
+        start, finish = self.last_updated, self._clock()
+        if start < finish:
+            watts = self.get_current_watts_value()
+            self.total_energy += watts * (finish - start)
+            self.last_updated = finish
+        self._pstate = self.host.cpu.pstate if self.host.is_on() else -1
+
+    def get_consumed_energy(self) -> float:
+        if self.last_updated < self._clock():
+            self.update()
+        return self.total_energy
+
+    def get_idle_consumption(self) -> float:
+        return self.power_ranges[0].idle
+
+    def get_watt_min_at(self, pstate: int) -> float:
+        return self.power_ranges[pstate].min
+
+    def get_watt_max_at(self, pstate: int) -> float:
+        return self.power_ranges[pstate].max
+
+
+_EXT: Dict[int, HostEnergy] = {}
+_active_engine = None
+
+
+def host_energy_plugin_init(engine=None) -> None:
+    """sg_host_energy_plugin_init (host_energy.cpp:481-512): hook every
+    update trigger through engine-scoped signal subscriptions."""
+    global _active_engine
+    from ..kernel.activity import ExecImpl
+    from ..kernel.engine import EngineImpl
+    from ..models.cpu import CpuAction
+    from ..models.host import Host
+
+    impl = engine.pimpl if hasattr(engine, "pimpl") else engine
+    if impl is None:
+        impl = EngineImpl.instance
+    if _active_engine is impl:
+        return
+    _EXT.clear()
+    _active_engine = impl
+    clock = lambda: impl.now
+
+    def ext(host) -> HostEnergy:
+        he = _EXT.get(id(host))
+        if he is None:
+            he = HostEnergy(host, clock)
+            _EXT[id(host)] = he
+        return he
+
+    for host in impl.hosts.values():
+        ext(host)
+    impl.connect_signal(Host.on_creation, lambda h: ext(h))
+
+    def on_host_change(host, *_):
+        ext(host).update()
+
+    impl.connect_signal(Host.on_state_change, on_host_change)
+    impl.connect_signal(Host.on_speed_change_sig, on_host_change)
+
+    def on_action_state_change(action, *_):
+        # Recover the CPUs from the action's LMM variable elements
+        # (reference CpuAction::cpus walks the same structure).
+        var = action.variable
+        if var is None:
+            return
+        for elem in var.cnsts:
+            cpu = elem.constraint.id
+            host = getattr(cpu, "host", None)
+            if host is not None:
+                ext(host).update()
+
+    impl.connect_signal(CpuAction.on_state_change, on_action_state_change)
+
+    def on_exec_creation(exec_impl):
+        # compute -> recv -> compute must bill the idle gap
+        # (host_energy.cpp:495-509).
+        if len(exec_impl.hosts) == 1:
+            host = exec_impl.hosts[0]
+            host = getattr(host, "pm", host)  # VM -> physical machine
+            he = ext(host)
+            if he.last_updated < clock():
+                he.update()
+
+    impl.connect_signal(ExecImpl.on_creation, on_exec_creation)
+
+    def on_end():
+        total = used = 0.0
+        for host in impl.hosts.values():
+            he = _EXT.get(id(host))
+            if he is None or not he.power_ranges:
+                continue
+            energy = he.get_consumed_energy()
+            total += energy
+            if he.host_was_used:
+                used += energy
+        _logger.info("Total energy consumption: %f Joules "
+                     "(used hosts: %f Joules; unused/idle hosts: %f)",
+                     total, used, total - used)
+
+    impl.connect_signal(EngineImpl.on_simulation_end, on_end)
+
+
+def get_consumed_energy(host) -> float:
+    """sg_host_get_consumed_energy."""
+    he = _EXT.get(id(host))
+    assert he is not None, \
+        "The Energy plugin is not active on this engine"
+    return he.get_consumed_energy()
+
+
+def get_current_consumption(host) -> float:
+    """sg_host_get_current_consumption (watts right now)."""
+    he = _EXT.get(id(host))
+    assert he is not None
+    he.update()
+    return he.get_current_watts_value()

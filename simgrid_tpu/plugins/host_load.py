@@ -1,0 +1,156 @@
+"""host_load plugin: per-host computed-flops and average-load tracking.
+
+Reference: src/plugins/host_load.cpp (HostLoad extension): tracks
+current load (used speed / available speed), cumulative computed flops,
+average load since reset, and idle/total time split. Updated on the
+same triggers as host_energy.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+
+class HostLoad:
+    def __init__(self, host, clock_getter):
+        self.host = host
+        self._clock = clock_getter
+        self.last_updated = clock_getter()
+        self.last_reset = clock_getter()
+        self.current_flops = 0.0      # running total at current speed
+        self.computed_flops = 0.0
+        self.idle_time = 0.0
+        self.total_idle_time = 0.0
+        self.theor_max_flops = 0.0
+        self.current_speed = host.get_speed()
+        self.current_load = self._instantaneous_load()
+
+    def _instantaneous_load(self) -> float:
+        speed = self.host.cpu.get_speed() * self.host.cpu.core_count
+        if speed <= 0:
+            return 0.0
+        return min(self.host.cpu.constraint.get_usage() / speed, 1.0)
+
+    def update(self) -> None:
+        """Bill the elapsed constant-rate interval. Callers hook the
+        *ends* of such intervals (action start/finish, speed change),
+        where the LMM values of the elapsed interval are still current
+        — so the interval is billed with the usage sampled now."""
+        now = self._clock()
+        delta = now - self.last_updated
+        if delta > 0:
+            # usage is flop/s directly — no speed factor to get stale
+            # across a pstate change mid-billing.
+            usage = self.host.cpu.constraint.get_usage()
+            self.computed_flops += usage * delta
+            self.theor_max_flops += self.current_speed \
+                * self.host.cpu.core_count * delta
+            if usage == 0:
+                self.idle_time += delta
+                self.total_idle_time += delta
+            self.last_updated = now
+        self.current_load = self._instantaneous_load()
+        self.current_speed = self.host.get_speed()
+
+    def get_average_load(self) -> float:
+        self.update()
+        if self.theor_max_flops <= 0:
+            return 0.0
+        return self.computed_flops / self.theor_max_flops
+
+    def reset(self) -> None:
+        self.update()
+        self.computed_flops = 0.0
+        self.theor_max_flops = 0.0
+        self.idle_time = 0.0
+        self.last_reset = self._clock()
+
+
+_EXT: Dict[int, HostLoad] = {}
+_active_engine = None
+
+
+def host_load_plugin_init(engine=None) -> None:
+    """sg_host_load_plugin_init (host_load.cpp registration)."""
+    global _active_engine
+    from ..kernel.engine import EngineImpl
+    from ..models.cpu import CpuAction
+    from ..models.host import Host
+
+    impl = engine.pimpl if hasattr(engine, "pimpl") else engine
+    if impl is None:
+        impl = EngineImpl.instance
+    if _active_engine is impl:
+        return
+    _EXT.clear()
+    _active_engine = impl
+    clock = lambda: impl.now
+
+    def ext(host) -> HostLoad:
+        hl = _EXT.get(id(host))
+        if hl is None:
+            hl = HostLoad(host, clock)
+            _EXT[id(host)] = hl
+        return hl
+
+    for host in impl.hosts.values():
+        ext(host)
+    impl.connect_signal(Host.on_creation, lambda h: ext(h))
+    impl.connect_signal(Host.on_state_change, lambda h, *a: ext(h).update())
+    impl.connect_signal(Host.on_speed_change_sig,
+                        lambda h, *a: ext(h).update())
+
+    def on_action_state_change(action, *_):
+        var = action.variable
+        if var is None:
+            return
+        for elem in var.cnsts:
+            cpu = elem.constraint.id
+            host = getattr(cpu, "host", None)
+            if host is not None:
+                ext(host).update()
+
+    impl.connect_signal(CpuAction.on_state_change, on_action_state_change)
+
+    def on_exec_creation(exec_impl):
+        # compute -> recv -> compute: bill the idle gap before the new
+        # exec's rates are solved (same trap as host_energy.cpp:495).
+        if len(exec_impl.hosts) == 1:
+            ext(getattr(exec_impl.hosts[0], "pm",
+                        exec_impl.hosts[0])).update()
+
+    from ..kernel.activity import ExecImpl
+    impl.connect_signal(ExecImpl.on_creation, on_exec_creation)
+
+
+def get_current_load(host) -> float:
+    hl = _EXT.get(id(host))
+    assert hl is not None, "The host_load plugin is not active"
+    hl.update()
+    return hl.current_load
+
+
+def get_computed_flops(host) -> float:
+    hl = _EXT.get(id(host))
+    assert hl is not None, "The host_load plugin is not active"
+    hl.update()
+    return hl.computed_flops
+
+
+def get_average_load(host) -> float:
+    hl = _EXT.get(id(host))
+    assert hl is not None, "The host_load plugin is not active"
+    return hl.get_average_load()
+
+
+def get_idle_time(host) -> float:
+    hl = _EXT.get(id(host))
+    assert hl is not None, "The host_load plugin is not active"
+    hl.update()
+    return hl.idle_time
+
+
+def reset(host) -> None:
+    hl = _EXT.get(id(host))
+    assert hl is not None, "The host_load plugin is not active"
+    hl.reset()

@@ -1,0 +1,109 @@
+"""link_energy plugin: joules = integral of P(link utilization) dt.
+
+Reference: src/plugins/link_energy.cpp: links declare ``wattage_range``
+("idle_watts:busy_watts") and ``wattage_off`` properties; instantaneous
+power interpolates linearly between idle and busy with utilization
+(= used bandwidth / capacity). Updated on every communicate and link
+state change.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+
+class LinkEnergy:
+    def __init__(self, link, clock_getter):
+        self.link = link
+        self._clock = clock_getter
+        self.total_energy = 0.0
+        self.last_updated = clock_getter()
+        rng = link.properties.get("wattage_range") if hasattr(
+            link, "properties") else None
+        if rng:
+            idle, busy = (float(x) for x in rng.split(":"))
+            self.range: Optional[Tuple[float, float]] = (idle, busy)
+        else:
+            self.range = None
+        self.wattage_off = float(getattr(link, "properties", {})
+                                 .get("wattage_off", 0.0))
+
+    def _utilization(self) -> float:
+        bw = self.link.get_bandwidth()
+        if bw <= 0:
+            return 0.0
+        # get_usage honors the sharing policy (max for FATPIPE links).
+        return min(self.link.constraint.get_usage() / bw, 1.0)
+
+    def get_power(self) -> float:
+        if self.range is None:
+            return 0.0
+        if not self.link.is_on():
+            return self.wattage_off
+        idle, busy = self.range
+        return idle + self._utilization() * (busy - idle)
+
+    def update(self) -> None:
+        now = self._clock()
+        if now > self.last_updated:
+            self.total_energy += self.get_power() \
+                * (now - self.last_updated)
+            self.last_updated = now
+
+    def get_consumed_energy(self) -> float:
+        self.update()
+        return self.total_energy
+
+
+_EXT: Dict[int, LinkEnergy] = {}
+_active_engine = None
+
+
+def link_energy_plugin_init(engine=None) -> None:
+    """sg_link_energy_plugin_init (link_energy.cpp registration)."""
+    global _active_engine
+    from ..kernel.engine import EngineImpl
+    from ..models.network import LinkImpl, NetworkAction
+
+    impl = engine.pimpl if hasattr(engine, "pimpl") else engine
+    if impl is None:
+        impl = EngineImpl.instance
+    if _active_engine is impl:
+        return
+    _EXT.clear()
+    _active_engine = impl
+    clock = lambda: impl.now
+
+    def ext(link) -> LinkEnergy:
+        le = _EXT.get(id(link))
+        if le is None:
+            le = LinkEnergy(link, clock)
+            _EXT[id(link)] = le
+        return le
+
+    for link in impl.links.values():
+        ext(link)
+
+    def on_communicate(action, src, dst):
+        # Bill the elapsed interval on every link the new flow crosses
+        # (the utilization is about to change).
+        var = action.variable
+        if var is None:
+            return
+        for elem in var.cnsts:
+            link = elem.constraint.id
+            if id(link) in _EXT or hasattr(link, "bandwidth_peak"):
+                ext(link).update()
+
+    impl.connect_signal(LinkImpl.on_communicate, on_communicate)
+    impl.connect_signal(LinkImpl.on_state_change,
+                        lambda link, *a: ext(link).update())
+    impl.connect_signal(NetworkAction.on_state_change,
+                        lambda action, *a: on_communicate(action, None,
+                                                          None))
+
+
+def get_consumed_energy(link) -> float:
+    le = _EXT.get(id(link))
+    assert le is not None, "The link_energy plugin is not active"
+    return le.get_consumed_energy()

@@ -1,0 +1,298 @@
+"""VirtualMachine support: VM-as-host with VCPU coupling + live
+migration.
+
+Reference: src/plugins/vm/{VirtualMachineImpl,VmLiveMigration}.cpp and
+s4u_VirtualMachine.cpp. A VM is a Host whose CPU lives in a *separate*
+VM CPU model; the VM appears on its physical machine as one CpuAction
+whose solved share (X1) is fed back as the VM CPU's constraint bound on
+every time-advance — the two-layer fairness of VMModel::next_occuring_
+event (VirtualMachineImpl.cpp:90-132): PM solves X1+X2=C, the VM layer
+solves P1+P2=X1 under that bound. The VM's impact on the PM scales with
+min(#active tasks, core amount) (update_action_weight, :298-309).
+
+Live migration implements the reference's three-stage pre-copy
+(VmLiveMigration.cpp): (1) transfer the RAM working set, (2) iterate
+re-sending pages dirtied while transferring (dirty-page intensity x
+elapsed), (3) stop-and-copy the final residue, then re-home the VM and
+its actors onto the destination PM.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..models.cpu import CpuCas01Model
+from ..models.host import Host
+from ..kernel.resource import ActionState, UpdateAlgo
+from ..utils.signal import Signal
+
+
+#: Cost of the dummy VM process on the PM: never reached in any
+#: simulation (the action exists only for its solved share).
+_VM_ACTION_COST = 1e300
+
+
+class VMModel(CpuCas01Model):
+    """The VM CPU layer (surf_cpu_model_vm): its own LMM system whose
+    constraint bounds are refreshed from the PM layer's solution before
+    every solve."""
+
+    def __init__(self, engine):
+        super().__init__(engine, UpdateAlgo.FULL)  # base registers us
+        engine.vm_model = self
+        self.vms: List["VirtualMachine"] = []
+
+    def next_occurring_event(self, now: float) -> float:
+        # Step 1 (VirtualMachineImpl.cpp:90-129): propagate each VM's
+        # PM-layer share into the VM-layer constraint bound.
+        for vm in self.vms:
+            if vm.pm_action is not None and vm.pm_action.variable is not None:
+                solved = vm.pm_action.variable.value
+                self.system.update_constraint_bound(vm.cpu.constraint,
+                                                    max(solved, 0.0))
+        # Step 2: the usual min over this model's actions.
+        return super().next_occurring_event(now)
+
+
+def _vm_model(engine) -> VMModel:
+    if engine.vm_model is None:
+        VMModel(engine)
+    return engine.vm_model
+
+
+class VirtualMachine(Host):
+    """A VM: a schedulable host backed by a slice of a physical host
+    (s4u_VirtualMachine.cpp + VirtualMachineImpl)."""
+
+    on_creation = Signal()
+    on_start = Signal()
+    on_suspend = Signal()
+    on_resume = Signal()
+    on_shutdown = Signal()
+    on_destruction = Signal()
+    on_migration_start = Signal()
+    on_migration_end = Signal()
+
+    # lifecycle states (s4u::VirtualMachine::state)
+    CREATED, RUNNING, SUSPENDED, DESTROYED = range(4)
+
+    def __init__(self, name: str, pm: Host, core_amount: int = 1,
+                 ramsize: int = 0):
+        engine = pm.engine
+        super().__init__(engine, name)
+        model = _vm_model(engine)
+        self.pm = pm
+        self.core_amount = core_amount
+        self.ramsize = ramsize
+        self.user_bound = float("inf")
+        self.active_tasks = 0
+        self.state = VirtualMachine.CREATED
+        self.params = {"dp_intensity": 0.0, "dp_cap": 0.9,
+                       "mig_speed": -1.0}
+        # VCPU: a cpu in the VM model, capacity core_amount x PM speed
+        # for now; the real bound arrives from the PM solution each
+        # round.
+        model.create_cpu(self, [pm.cpu.get_speed()] * 1, core_amount)
+        # The VM process on the PM's operating system
+        # (VirtualMachineImpl.cpp:150-153). The reference gives it cost
+        # 0 and keeps it alive through its lazy-heap bookkeeping; here
+        # an effectively infinite cost expresses the same "never
+        # completes by itself" lifetime in both optim modes — only its
+        # solved share (X1) is ever read.
+        self.pm_action = pm.cpu.execution_start(_VM_ACTION_COST,
+                                                core_amount)
+        self._update_action_weight()
+        # Network position: a VM rides its PM's NIC.
+        self.netpoint = pm.netpoint
+        model.vms.append(self)
+        VirtualMachine.on_creation(self)
+
+    # -- PM coupling (VirtualMachineImpl.cpp:298-309) ---------------------
+    def _update_action_weight(self) -> None:
+        impact = min(self.active_tasks, self.core_amount)
+        sys = self.pm.cpu.model.system
+        if impact > 0:
+            sys.update_variable_penalty(self.pm_action.variable,
+                                        1.0 / impact)
+        else:
+            sys.update_variable_penalty(self.pm_action.variable, 0.0)
+        bound = min(impact * self.pm.get_speed(), self.user_bound)
+        sys.update_variable_bound(self.pm_action.variable, bound)
+
+    def add_active_task(self) -> None:
+        self.active_tasks += 1
+        self._update_action_weight()
+
+    def remove_active_task(self) -> None:
+        self.active_tasks -= 1
+        self._update_action_weight()
+
+    def set_bound(self, bound: float) -> None:
+        self.user_bound = bound
+        self._update_action_weight()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "VirtualMachine":
+        assert self.state == VirtualMachine.CREATED, \
+            f"Cannot start VM {self.name} in state {self.state}"
+        # Core availability check (s4u_VirtualMachine.cpp start): sum of
+        # running VMs' cores on this PM must fit.
+        used = sum(vm.core_amount
+                   for vm in self.engine.vm_model.vms
+                   if vm is not self and vm.pm is self.pm
+                   and vm.state == VirtualMachine.RUNNING)
+        assert used + self.core_amount <= self.pm.cpu.core_count, \
+            (f"Cannot start VM {self.name}: {self.pm.name} has "
+             f"{self.pm.cpu.core_count} cores, {used} already assigned")
+        self.state = VirtualMachine.RUNNING
+        VirtualMachine.on_start(self)
+        return self
+
+    def suspend(self) -> None:
+        assert self.state == VirtualMachine.RUNNING
+        from ..s4u.actor import _current_impl
+        issuer = _current_impl()
+        assert issuer not in self.actor_list, \
+            (f"Actor {issuer.name} cannot suspend the VM {self.name} in "
+             f"which it runs (VirtualMachineImpl.cpp:178-180)")
+        for actor in list(self.actor_list):
+            s4u_actor = getattr(actor, "s4u_actor", None)
+            if s4u_actor is not None:
+                s4u_actor.suspend()
+        self.pm_action.suspend()
+        self.state = VirtualMachine.SUSPENDED
+        VirtualMachine.on_suspend(self)
+
+    def resume(self) -> None:
+        assert self.state == VirtualMachine.SUSPENDED
+        self.pm_action.resume()
+        for actor in list(self.actor_list):
+            s4u_actor = getattr(actor, "s4u_actor", None)
+            if s4u_actor is not None:
+                s4u_actor.resume()
+        self.state = VirtualMachine.RUNNING
+        VirtualMachine.on_resume(self)
+
+    def shutdown(self) -> None:
+        killer = self.engine.maestro
+        for actor in list(self.actor_list):
+            killer.kill(actor)
+        self.state = VirtualMachine.CREATED
+        VirtualMachine.on_shutdown(self)
+
+    def destroy(self) -> None:
+        if self.state == VirtualMachine.RUNNING:
+            self.shutdown()
+        self.pm_action.cancel()
+        self.engine.vm_model.vms.remove(self)
+        self.engine.hosts.pop(self.name, None)
+        self.state = VirtualMachine.DESTROYED
+        VirtualMachine.on_destruction(self)
+
+    # -- migration (VirtualMachineImpl::migrate + VmLiveMigration) --------
+    def migrate_now(self, dst_pm: Host) -> None:
+        """Instant re-homing (VirtualMachineImpl::migrate): move the PM
+        action and every hosted actor to the destination."""
+        if self.pm_action.get_state() in (ActionState.INITED,
+                                          ActionState.STARTED,
+                                          ActionState.IGNORED):
+            self.pm_action.cancel()
+        self.pm = dst_pm
+        self.pm_action = dst_pm.cpu.execution_start(_VM_ACTION_COST,
+                                                    self.core_amount)
+        # _update_action_weight derives the bound from the VM's task
+        # population, which migrates with it.
+        self._update_action_weight()
+        self.netpoint = dst_pm.netpoint
+
+
+_active_engine = None
+
+
+def vm_live_migration_plugin_init(engine=None) -> None:
+    """sg_vm_live_migration_plugin_init: wire the active-task counters
+    (VMModel::VMModel connects ExecImpl on_creation/on_completion)."""
+    global _active_engine
+    from ..kernel.activity import ExecImpl
+    from ..kernel.engine import EngineImpl
+
+    impl = engine.pimpl if hasattr(engine, "pimpl") else engine
+    if impl is None:
+        impl = EngineImpl.instance
+    if _active_engine is impl:
+        return
+    _active_engine = impl
+
+    def on_exec_creation(exec_impl):
+        for host in exec_impl.hosts:
+            if isinstance(host, VirtualMachine):
+                host.add_active_task()
+
+    def on_exec_completion(exec_impl):
+        for host in exec_impl.hosts:
+            if isinstance(host, VirtualMachine):
+                host.remove_active_task()
+
+    impl.connect_signal(ExecImpl.on_creation, on_exec_creation)
+    impl.connect_signal(ExecImpl.on_completion, on_exec_completion)
+
+
+def migrate(vm: VirtualMachine, dst_pm: Host) -> None:
+    """Live migration with the reference's three-stage pre-copy
+    (VmLiveMigration.cpp MigrationTx::operator()); must be called from
+    inside an actor. Stage 1 ships the RAM working set, stage 2
+    iterates over pages dirtied during the previous transfer
+    (dp_intensity x migration throughput, capped at dp_cap x ramsize),
+    stage 3 stops the VM and ships the residue."""
+    from ..s4u import Engine, Mailbox
+    from ..s4u.actor import Actor
+
+    assert vm.state == VirtualMachine.RUNNING, \
+        "Cannot migrate a VM that is not running"
+    VirtualMachine.on_migration_start(vm)
+    ramsize = vm.ramsize or 1
+    dp_intensity = vm.params["dp_intensity"]
+    dp_cap = vm.params["dp_cap"]
+    mig_speed = vm.params["mig_speed"]
+
+    mbox = Mailbox.by_name(f"__mig__{vm.name}")
+    done = Mailbox.by_name(f"__mig_done__{vm.name}")
+
+    _EOS = "__mig_eos__"
+
+    def rx():
+        while mbox.get() != _EOS:
+            pass
+        done.put(b"", 4)
+
+    Actor.create(f"__mig_rx__{vm.name}", dst_pm, rx)
+
+    del mig_speed   # rate-capping the stream is not modeled yet
+
+    def put(size: float) -> float:
+        t0 = Engine.get_clock()
+        mbox.put(b"m", max(size, 1.0))
+        return Engine.get_clock() - t0
+
+    # Stage 1: the whole RAM working set.
+    elapsed = put(ramsize)
+    # Stage 2: iterative pre-copy of dirtied pages; geometric decrease
+    # unless the dirtying rate outruns the link.
+    threshold = ramsize * 0.01
+    updated = min(dp_intensity * ramsize * min(elapsed, 1.0),
+                  dp_cap * ramsize)
+    for _ in range(4):
+        if updated <= threshold:
+            break
+        elapsed = put(updated)
+        updated = min(dp_intensity * ramsize * min(elapsed, 1.0),
+                      dp_cap * ramsize)
+    # Stage 3: stop-and-copy.
+    vm.suspend()
+    if updated > 0:
+        put(updated)
+    mbox.put(_EOS, 4)      # close stream
+    done.get()
+    vm.migrate_now(dst_pm)
+    vm.resume()
+    VirtualMachine.on_migration_end(vm)

@@ -1,0 +1,354 @@
+"""Plugin tests: host_energy joule accounting (pinned against the
+reference's energy-exec tesh oracle), host_load, link_energy,
+file_system, and the VM lifecycle + two-layer CPU coupling + live
+migration.
+
+Reference oracles: examples/s4u/energy-exec/s4u-energy-exec.tesh pins
+MyHost1=2905 J / MyHost2=2100 J / MyHost3=3000 J on
+energy_platform.xml; the VM coupling semantics come from
+VirtualMachineImpl.cpp (X1+X2=C on the PM, P1+P2=X1 in the VM layer).
+"""
+
+import os
+
+import pytest
+
+from simgrid_tpu import s4u
+from simgrid_tpu.plugins import (file_system, host_energy, host_load,
+                                 link_energy, vm)
+
+ENERGY_PLATFORM = "/root/reference/examples/platforms/energy_platform.xml"
+
+needs_reference = pytest.mark.skipif(
+    not os.path.exists(ENERGY_PLATFORM),
+    reason="reference platform files unavailable")
+
+
+@pytest.fixture(autouse=True)
+def fresh_engine():
+    s4u.Engine._reset()
+    yield
+    s4u.Engine._reset()
+
+
+@needs_reference
+def test_host_energy_reference_oracle():
+    """Replicates examples/s4u/energy-exec: sleep 10, task 1e8, pstate
+    2, task 1e8, sleep 4, turn MyHost2 off, sleep 10. Pinned joules
+    from the tesh: 2905 / 2100 / 3000."""
+    e = s4u.Engine(["t"])
+    e.load_platform(ENERGY_PLATFORM)
+    host_energy.host_energy_plugin_init(e)
+    host1 = e.host_by_name("MyHost1")
+    host2 = e.host_by_name("MyHost2")
+    host3 = e.host_by_name("MyHost3")
+
+    def dvfs_test():
+        s4u.this_actor.sleep_for(10.0)
+        s4u.this_actor.execute(1e8)
+        host1.set_pstate(2)
+        s4u.this_actor.execute(1e8)
+        s4u.this_actor.sleep_for(4.0)
+        host2.turn_off()
+        s4u.this_actor.sleep_for(10.0)
+
+    s4u.Actor.create("dvfs_test", host1, dvfs_test)
+    e.run()
+    assert e.clock == pytest.approx(30.0)
+    assert host_energy.get_consumed_energy(host1) == pytest.approx(2905.0)
+    assert host_energy.get_consumed_energy(host2) == pytest.approx(2100.0)
+    assert host_energy.get_consumed_energy(host3) == pytest.approx(3000.0)
+
+
+CLUSTER_XML = """<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <host id="h1" speed="100Mf" core="2">
+      <prop id="watt_per_state" value="100.0:120.0:200.0"/>
+    </host>
+    <host id="h2" speed="100Mf"/>
+    <link id="l1" bandwidth="100MBps" latency="1ms">
+      <prop id="wattage_range" value="10:20"/>
+    </link>
+    <route src="h1" dst="h2"><link_ctn id="l1"/></route>
+  </zone>
+</platform>
+"""
+
+
+@pytest.fixture
+def small(tmp_path):
+    path = os.path.join(tmp_path, "plat.xml")
+    with open(path, "w") as f:
+        f.write(CLUSTER_XML)
+    return path
+
+
+def test_host_load(small):
+    e = s4u.Engine(["t"])
+    e.load_platform(small)
+    host_load.host_load_plugin_init(e)
+    h1 = e.host_by_name("h1")
+    seen = {}
+
+    def worker():
+        s4u.this_actor.execute(1e8)      # 1s on one of 2 cores
+        seen["flops"] = host_load.get_computed_flops(h1)
+        seen["avg"] = host_load.get_average_load(h1)
+        s4u.this_actor.sleep_for(1.0)
+        seen["idle"] = host_load.get_idle_time(h1)
+
+    s4u.Actor.create("w", h1, worker)
+    e.run()
+    assert seen["flops"] == pytest.approx(1e8)
+    assert seen["avg"] == pytest.approx(0.5)    # 1 of 2 cores busy
+    assert seen["idle"] == pytest.approx(1.0)
+
+
+def test_link_energy(small):
+    e = s4u.Engine(["t"])
+    e.load_platform(small)
+    link_energy.link_energy_plugin_init(e)
+    l1 = e.link_by_name("l1")
+
+    def sender():
+        s4u.Mailbox.by_name("m").put(b"x" * 1000, 1e8)
+
+    def receiver():
+        s4u.Mailbox.by_name("m").get()
+        s4u.this_actor.sleep_for(1.0)
+
+    s4u.Actor.create("s", e.host_by_name("h1"), sender)
+    s4u.Actor.create("r", e.host_by_name("h2"), receiver)
+    e.run()
+    energy = link_energy.get_consumed_energy(l1)
+    # Transfer keeps the link ~fully busy (power ~20 W) for its
+    # duration, then idle (10 W) for the remaining sleep.
+    assert energy > 10.0 * e.clock  # strictly above always-idle
+    assert energy < 20.0 * e.clock  # strictly below always-busy
+
+
+STORAGE_XML = """<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <storage_type id="crucial" size="500GiB">
+      <model_prop id="Bwrite" value="60MBps"/>
+      <model_prop id="Bread" value="200MBps"/>
+    </storage_type>
+    <storage id="Disk1" typeId="crucial" attach="alice"/>
+    <host id="alice" speed="1Gf"/>
+    <host id="bob" speed="1Gf"/>
+    <link id="l1" bandwidth="100MBps" latency="1ms"/>
+    <route src="alice" dst="bob"><link_ctn id="l1"/></route>
+  </zone>
+</platform>
+"""
+
+
+def test_file_system(tmp_path):
+    path = os.path.join(tmp_path, "sto.xml")
+    with open(path, "w") as f:
+        f.write(STORAGE_XML)
+    e = s4u.Engine(["t"])
+    e.load_platform(path)
+    file_system.file_system_plugin_init(e)
+    out = {}
+
+    def worker():
+        f = file_system.File("/scratch/data.bin",
+                             e.host_by_name("alice"))
+        assert f.get_size() == 0
+        written = f.write(120_000_000)          # 2s at 60MBps
+        out["written"] = written
+        out["t_write"] = s4u.Engine.get_clock()
+        f.seek(0)
+        read = f.read(120_000_000)              # 0.6s at 200MBps
+        out["read"] = read
+        out["t_read"] = s4u.Engine.get_clock()
+        out["used"] = file_system.storage_used_size(
+            e.pimpl.storages["Disk1"])
+        f.unlink()
+        out["used_after"] = file_system.storage_used_size(
+            e.pimpl.storages["Disk1"])
+
+    s4u.Actor.create("w", e.host_by_name("alice"), worker)
+    e.run()
+    assert out["written"] == 120_000_000
+    assert out["read"] == 120_000_000
+    assert out["t_write"] == pytest.approx(2.0)
+    assert out["t_read"] == pytest.approx(2.6)
+    assert out["used"] == 120_000_000
+    assert out["used_after"] == 0
+
+
+VM_XML = """<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <host id="pm1" speed="100Mf" core="4"/>
+    <host id="pm2" speed="100Mf" core="4"/>
+    <link id="l1" bandwidth="125MBps" latency="50us"/>
+    <route src="pm1" dst="pm2"><link_ctn id="l1"/></route>
+  </zone>
+</platform>
+"""
+
+
+@pytest.fixture
+def vmplat(tmp_path):
+    path = os.path.join(tmp_path, "vm.xml")
+    with open(path, "w") as f:
+        f.write(VM_XML)
+    return path
+
+
+def test_vm_lifecycle_and_coupling(vmplat):
+    """Two 1-core VMs on one PM core-compete: each exec runs at the
+    per-core speed (no contention on a 4-core PM); a single VM with two
+    tasks shares its one VCPU (VirtualMachineImpl two-layer LMM)."""
+    e = s4u.Engine(["t"])
+    e.load_platform(vmplat)
+    vm.vm_live_migration_plugin_init(e)
+    pm1 = e.host_by_name("pm1")
+    times = {}
+
+    vm1 = vm.VirtualMachine("vm1", pm1, core_amount=1).start()
+
+    def one_task():
+        s4u.this_actor.execute(1e8)      # 1s at full core speed
+        times["one"] = s4u.Engine.get_clock()
+
+    s4u.Actor.create("t1", vm1, one_task)
+    e.run()
+    assert times["one"] == pytest.approx(1.0)
+
+    # Two concurrent tasks on a 1-core VM halve each other: 2s each.
+    s4u.Engine._reset()
+    e = s4u.Engine(["t"])
+    e.load_platform(vmplat)
+    vm.vm_live_migration_plugin_init(e)
+    pm1 = e.host_by_name("pm1")
+    vm1 = vm.VirtualMachine("vm1", pm1, core_amount=1).start()
+    done = []
+
+    def task():
+        s4u.this_actor.execute(1e8)
+        done.append(s4u.Engine.get_clock())
+
+    s4u.Actor.create("t1", vm1, task)
+    s4u.Actor.create("t2", vm1, task)
+    e.run()
+    assert done[0] == pytest.approx(2.0)
+    assert done[1] == pytest.approx(2.0)
+
+
+def test_vm_suspend_resume(vmplat):
+    e = s4u.Engine(["t"])
+    e.load_platform(vmplat)
+    vm.vm_live_migration_plugin_init(e)
+    pm1 = e.host_by_name("pm1")
+    vm1 = vm.VirtualMachine("vm1", pm1, core_amount=1).start()
+    times = {}
+
+    def task():
+        s4u.this_actor.execute(1e8)
+        times["done"] = s4u.Engine.get_clock()
+
+    def controller():
+        s4u.this_actor.sleep_for(0.5)
+        vm1.suspend()                    # freeze mid-task
+        s4u.this_actor.sleep_for(2.0)
+        vm1.resume()
+
+    s4u.Actor.create("task", vm1, task)
+    s4u.Actor.create("ctl", pm1, controller)
+    e.run()
+    # 0.5s run + 2s frozen + 0.5s run
+    assert times["done"] == pytest.approx(3.0)
+
+
+def test_vm_live_migration(vmplat):
+    e = s4u.Engine(["t"])
+    e.load_platform(vmplat)
+    vm.vm_live_migration_plugin_init(e)
+    pm1, pm2 = e.host_by_name("pm1"), e.host_by_name("pm2")
+    vm1 = vm.VirtualMachine("vm1", pm1, core_amount=1,
+                            ramsize=125_000_000).start()
+    vm1.params["dp_intensity"] = 0.5
+    log = {}
+
+    def worker():
+        s4u.this_actor.execute(5e8)      # long task riding the VM
+        log["task_done"] = s4u.Engine.get_clock()
+
+    def migrator():
+        s4u.this_actor.sleep_for(0.1)
+        vm.migrate(vm1, pm2)
+        log["migrated"] = s4u.Engine.get_clock()
+        assert vm1.pm is pm2
+
+    s4u.Actor.create("w", vm1, worker)
+    s4u.Actor.create("m", pm1, migrator)
+    e.run()
+    # RAM is 1s of link time; with pre-copy iterations migration takes
+    # >1s; the task keeps computing during pre-copy and finishes.
+    assert 1.0 < log["migrated"] < 10.0
+    assert log["task_done"] > 0
+    assert vm1.pm is pm2
+
+
+def test_vm_core_capacity_check(vmplat):
+    e = s4u.Engine(["t"])
+    e.load_platform(vmplat)
+    pm1 = e.host_by_name("pm1")
+    vm.VirtualMachine("a", pm1, core_amount=3).start()
+    with pytest.raises(AssertionError):
+        vm.VirtualMachine("b", pm1, core_amount=2).start()
+
+
+def test_file_remote_copy(tmp_path):
+    path = os.path.join(tmp_path, "sto2.xml")
+    xml = STORAGE_XML.replace(
+        '<storage id="Disk1" typeId="crucial" attach="alice"/>',
+        '<storage id="Disk1" typeId="crucial" attach="alice"/>\n'
+        '    <storage id="Disk2" typeId="crucial" attach="bob"/>')
+    with open(path, "w") as f:
+        f.write(xml)
+    e = s4u.Engine(["t"])
+    e.load_platform(path)
+    file_system.file_system_plugin_init(e)
+    out = {}
+
+    def worker():
+        f = file_system.File("/data", e.host_by_name("alice"))
+        f.write(60_000_000)
+        dst = f.remote_copy(e.host_by_name("bob"), "/copy")
+        # remote_copy returns only after the destination write landed
+        out["dst_size"] = dst.get_size()
+        out["dst_used"] = file_system.storage_used_size(
+            e.pimpl.storages["Disk2"])
+        out["t"] = s4u.Engine.get_clock()
+
+    s4u.Actor.create("w", e.host_by_name("alice"), worker)
+    e.run()
+    assert out["dst_size"] == 60_000_000
+    assert out["dst_used"] == 60_000_000
+    # write 1s + read 0.3s + transfer 0.6s + remote write 1s
+    assert out["t"] > 2.8
+
+
+def test_vm_self_suspend_rejected(vmplat):
+    e = s4u.Engine(["t"])
+    e.load_platform(vmplat)
+    pm1 = e.host_by_name("pm1")
+    vm1 = vm.VirtualMachine("vm1", pm1, core_amount=1).start()
+    seen = {}
+
+    def inside():
+        try:
+            vm1.suspend()
+        except AssertionError as exc:
+            seen["err"] = str(exc)
+
+    s4u.Actor.create("in", vm1, inside)
+    e.run()
+    assert "cannot suspend the VM" in seen["err"]
