@@ -685,6 +685,10 @@ class MutexImpl:
 
     def __init__(self, engine):
         self.engine = engine
+        # Replay-stable identity for the model checker's
+        # dependence test (objects are rebuilt on each MC
+        # re-execution; the creation sequence is deterministic).
+        self.mc_key = (type(self).__name__, engine.next_mc_seq())
         self.locked = False
         self.owner = None
         self.sleeping: deque = deque()
@@ -738,6 +742,10 @@ class CondVarImpl:
 
     def __init__(self, engine):
         self.engine = engine
+        # Replay-stable identity for the model checker's
+        # dependence test (objects are rebuilt on each MC
+        # re-execution; the creation sequence is deterministic).
+        self.mc_key = (type(self).__name__, engine.next_mc_seq())
         self.sleeping: deque = deque()
 
     def wait(self, mutex: Optional[MutexImpl], timeout: float, simcall) -> None:
@@ -781,6 +789,10 @@ class SemImpl:
 
     def __init__(self, engine, value: int):
         self.engine = engine
+        # Replay-stable identity for the model checker's
+        # dependence test (objects are rebuilt on each MC
+        # re-execution; the creation sequence is deterministic).
+        self.mc_key = (type(self).__name__, engine.next_mc_seq())
         self.value = value
         self.sleeping: deque = deque()
 

@@ -71,17 +71,24 @@ class ActorImpl:
     # ------------------------------------------------------------------
     # Actor-side API (runs in the actor's context)
     # ------------------------------------------------------------------
-    def simcall(self, name: str, handler: Callable[[Simcall], None]) -> Any:
+    def simcall(self, name: str, handler: Callable[[Simcall], None],
+                mc_object=None) -> Any:
         """Issue a simcall: record it, yield to maestro, return the answer.
 
         The handler runs maestro-side; it must either call
         ``simcall_answer()`` on the issuer (immediate answer) or register
         the simcall on an activity that will answer it later ([[block]]
-        semantics of simcalls.in:38-66)."""
+        semantics of simcalls.in:38-66).
+
+        ``mc_object`` labels the kernel object this simcall touches
+        (mailbox, mutex, ...) for the model checker's dependence test
+        (mc/explorer.py, the request_depend analogue); None means the
+        call only touches the issuer."""
         sc = self.simcall_
         sc.call = name
         sc.handler = handler
         sc.result = None
+        sc.payload["mc_object"] = mc_object
         if self.is_maestro():
             # Maestro (or the main thread before run()) executes simcalls
             # inline (reference: maestro handles its own simcalls directly).

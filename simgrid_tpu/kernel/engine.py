@@ -64,6 +64,7 @@ class EngineImpl:
 
         self.context_factory = ContextFactory()
         self._pid = 1
+        self._mc_seq = 0
         self.maestro = ActorImpl(self, "maestro", None)
         self.maestro.pid = 0
         self.actors_to_run: List[ActorImpl] = []
@@ -108,6 +109,12 @@ class EngineImpl:
         pid = self._pid
         self._pid += 1
         return pid
+
+    def next_mc_seq(self) -> int:
+        """Deterministic creation counter labeling kernel objects for
+        the model checker (stable across MC re-executions)."""
+        self._mc_seq += 1
+        return self._mc_seq
 
     def add_model(self, model) -> None:
         self.models.append(model)

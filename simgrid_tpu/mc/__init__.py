@@ -1,0 +1,17 @@
+"""Mc SimGrid equivalent: a stateless safety model checker.
+
+The reference runs the checker as a separate ptrace-ing OS process with
+page-level snapshots (src/mc/Session.cpp, sosp/). This rebuild follows
+SURVEY §2.6 note 5 instead: the kernel is deterministic Python, so
+exploration is *stateless* — backtracking re-executes the program from
+scratch and replays the recorded transition prefix (the same
+record/replay SimGrid exposes as --cfg=model-check/replay, promoted to
+the backtracking mechanism). Dynamic partial-order reduction prunes
+commuting interleavings like SafetyChecker.cpp:284-295.
+"""
+
+from .explorer import (DeadlockError, PropertyError, SafetyChecker,
+                       Session, TerminationError)
+
+__all__ = ["SafetyChecker", "Session", "PropertyError", "DeadlockError",
+           "TerminationError"]

@@ -1,0 +1,348 @@
+"""Stateless safety checker with dynamic partial-order reduction.
+
+The exploration model matches the reference's (SafetyChecker.cpp): a
+program state is the set of actors with a pending (unhandled) simcall;
+a transition executes one of them; DFS walks interleavings, and on
+backtrack DPOR marks the latest *dependent* earlier transition for
+re-interleaving (SafetyChecker.cpp:284-295). Two transitions are
+dependent when they touch the same kernel object (the mc_object simcall
+label — mailbox, mutex, semaphore) or the same actor, the conservative
+core of the reference's request_depend (mc_request.cpp).
+
+Where the reference snapshots the MCed process's pages to backtrack
+(sosp/PageStore), this checker re-executes: the kernel is deterministic
+Python, so replaying a transition prefix from a fresh engine
+reconstructs the state exactly — SimGrid's own record/replay
+(mc_record.cpp) promoted to the backtracking mechanism.
+
+Timing is not explored: activities complete through zero-cost model
+steps between transitions, so the checker verifies all *orderings*, not
+durations (same scope as the reference's safety mode).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..exceptions import SimgridException
+from ..utils import log as _log
+from ..utils.config import config, declare_flag
+
+_logger = _log.get_category("mc")
+
+declare_flag("model-check/max-visited-states",
+             "Maximum number of visited states (0 = unlimited)", 0)
+
+
+class PropertyError(SimgridException):
+    """A safety property (assertion in an actor) was violated."""
+
+    def __init__(self, message, trace):
+        super().__init__(message)
+        self.trace = trace
+
+
+class DeadlockError(SimgridException):
+    def __init__(self, message, trace):
+        super().__init__(message)
+        self.trace = trace
+
+
+class TerminationError(SimgridException):
+    pass
+
+
+def _obj_key(obj):
+    """Replay-stable identity of a kernel object: transitions from
+    different re-executions must compare equal, so raw object identity
+    is useless (each replay rebuilds fresh objects). Mailboxes key by
+    name, sync objects by their deterministic creation sequence."""
+    if obj is None:
+        return None
+    key = getattr(obj, "mc_key", None)
+    if key is not None:
+        return key
+    name = getattr(obj, "name", None)
+    if name is not None:
+        return (type(obj).__name__, name)
+    return (type(obj).__name__, id(obj))  # last resort, same-session only
+
+
+def _obj_keys(obj) -> frozenset:
+    """A simcall may touch several kernel objects (cond_wait touches
+    the condition AND the mutex); mc_object accepts a tuple for that."""
+    if obj is None:
+        return frozenset()
+    if isinstance(obj, tuple):
+        return frozenset(_obj_key(o) for o in obj if o is not None)
+    return frozenset((_obj_key(obj),))
+
+
+class Transition:
+    """One executed scheduling decision."""
+
+    __slots__ = ("pid", "call", "objs")
+
+    def __init__(self, pid: int, call: str, obj):
+        self.pid = pid
+        self.call = call
+        self.objs = _obj_keys(obj)
+
+    def depends_on(self, other: "Transition") -> bool:
+        """Conservative request_depend: same actor, or any kernel
+        object touched by both (mc_request.cpp dependence core)."""
+        if self.pid == other.pid:
+            return True
+        return bool(self.objs & other.objs)
+
+    def __repr__(self):
+        tail = " on " + "+".join(sorted(k[0] for k in self.objs)) \
+            if self.objs else ""
+        return f"[pid {self.pid}] {self.call}{tail}"
+
+
+class _State:
+    """One node of the DFS stack (reference mc::State)."""
+
+    __slots__ = ("enabled", "todo", "done", "executed")
+
+    def __init__(self, enabled: List[int]):
+        self.enabled = list(enabled)
+        self.todo: List[int] = []
+        self.done: Set[int] = set()
+        self.executed: Optional[Transition] = None
+
+    def pick(self) -> Optional[int]:
+        while self.todo:
+            pid = self.todo.pop(0)
+            if pid not in self.done:
+                return pid
+        return None
+
+    def add_todo(self, pid: int) -> None:
+        if pid not in self.done and pid not in self.todo:
+            self.todo.append(pid)
+
+
+class Session:
+    """One controlled execution of the program under test.
+
+    ``program`` builds a fresh Engine with its actors and returns it
+    (or the s4u Engine wrapper); the session then drives the kernel one
+    scheduling decision at a time."""
+
+    def __init__(self, program: Callable):
+        from ..s4u import Engine
+        Engine._reset()
+        self.violation: Optional[str] = None
+        engine = program()
+        self.engine = engine.pimpl if hasattr(engine, "pimpl") else engine
+        # Intercept actor crashes: an uncaught exception in an actor is
+        # the safety property violation (mc-failing-assert model).
+        self._orig_crashed = self.engine.actor_crashed
+
+        def record_crash(actor, exc):
+            self.violation = (f"Actor {actor.name} (pid {actor.pid}) "
+                              f"violated its assertion: {exc!r}")
+        self.engine.actor_crashed = record_crash
+        self._quiesce()
+
+    # -- kernel driving ----------------------------------------------------
+    def _run_ready_actors(self) -> None:
+        """Run runnable actors until each parks at a simcall (their
+        code between simcalls is invisible to other actors, so no
+        interleaving is lost — same argument as smx_global.cpp's
+        determinism note)."""
+        engine = self.engine
+        while engine.actors_to_run:
+            batch = engine.actors_to_run
+            engine.actors_to_run = []
+            engine.context_factory.run_all(batch)
+
+    def _quiesce(self) -> None:
+        """Advance everything that needs no scheduling decision: run
+        ready actors to their next simcall, fire wakes, and let started
+        activities complete through (deterministic) time advances. Only
+        the *ordering* of simcall handling is explored; durations run
+        their deterministic course between decisions."""
+        engine = self.engine
+        stalls = 0
+        while True:
+            self._run_ready_actors()
+            engine._execute_tasks()
+            engine._wake_processes()
+            if engine.actors_to_run:
+                stalls = 0
+                continue
+            if engine.process_list and not self.pending_pids():
+                advanced = engine.surf_solve(engine.next_timer_date())
+                engine._execute_timers()
+                engine._execute_tasks()
+                engine._wake_processes()
+                if engine.actors_to_run:
+                    stalls = 0
+                    continue
+                if advanced < 0:
+                    break        # nothing can move: deadlock leaf
+                stalls += 1
+                if stalls > 1000:
+                    break        # profile-event churn with no progress
+                continue
+            break
+
+    def pending_pids(self) -> List[int]:
+        """Actors whose simcall awaits a scheduling decision: issued
+        (call set) but not yet executed (handler unconsumed) — an
+        already-handled blocking simcall keeps its call name until
+        answered and is not a decision point."""
+        return [actor.pid for actor in self.engine.process_list.values()
+                if actor.simcall_.call is not None
+                and actor.simcall_.handler is not None]
+
+    def execute(self, pid: int) -> Transition:
+        actor = self.engine.process_list[pid]
+        sc = actor.simcall_
+        transition = Transition(pid, sc.call,
+                                sc.payload.get("mc_object"))
+        actor.simcall_handle()
+        self._quiesce()
+        return transition
+
+    def alive(self) -> bool:
+        return bool(self.engine.process_list)
+
+    def close(self) -> None:
+        self.engine.actor_crashed = self._orig_crashed
+
+
+class SafetyChecker:
+    """DFS + DPOR over scheduling decisions (SafetyChecker.cpp:80-295).
+
+    ``checker = SafetyChecker(program); checker.run()`` raises
+    PropertyError/DeadlockError with a counterexample trace, or returns
+    statistics when the full (reduced) state space is clean."""
+
+    def __init__(self, program: Callable):
+        self.program = program
+        self.reduction = config["model-check/reduction"]
+        assert self.reduction in ("dpor", "none"), \
+            f"Unknown model-check/reduction {self.reduction!r}"
+        self.max_depth = int(config["model-check/max-depth"])
+        self.visited_states = 0
+        self.executed_transitions = 0
+        self.expanded_states = 0
+
+    # -- replay-based navigation ------------------------------------------
+    def _replay(self, prefix: List[int]) -> Session:
+        session = Session(self.program)
+        for pid in prefix:
+            session.execute(pid)
+        return session
+
+    def run(self) -> Dict[str, int]:
+        stack: List[_State] = []
+        path: List[int] = []
+        session = Session(self.program)
+        if session.violation is not None:
+            raise PropertyError(session.violation, [])
+
+        root = _State(session.pending_pids())
+        self._seed_todo(root)
+        stack.append(root)
+
+        while stack:
+            state = stack[-1]
+            self.visited_states += 1
+            cap = int(config["model-check/max-visited-states"])
+            if cap > 0 and self.visited_states > cap:
+                raise TerminationError(
+                    f"model-check/max-visited-states ({cap}) exceeded")
+
+            if len(stack) > self.max_depth:
+                _logger.warning("/!\\ Max depth reached! /!\\")
+                session = self._backtrack(stack, path)
+                continue
+
+            pid = state.pick()
+            if pid is None:
+                session = self._backtrack(stack, path)
+                continue
+
+            state.done.add(pid)
+            self.executed_transitions += 1
+            state.executed = session.execute(pid)
+            path.append(pid)
+
+            if session.violation is not None:
+                raise PropertyError(session.violation, self._trace(stack))
+
+            nxt = _State(session.pending_pids())
+            if not nxt.enabled and session.alive():
+                raise DeadlockError(
+                    "Deadlock: actors remain but no transition is "
+                    "enabled", self._trace(stack))
+            self._seed_todo(nxt)
+            self.expanded_states += 1
+            stack.append(nxt)
+
+        _logger.info("No property violation found.")
+        _logger.info("Expanded states = %d", self.expanded_states)
+        _logger.info("Visited states = %d", self.visited_states)
+        _logger.info("Executed transitions = %d",
+                     self.executed_transitions)
+        return {"expanded_states": self.expanded_states,
+                "visited_states": self.visited_states,
+                "executed_transitions": self.executed_transitions}
+
+    def _seed_todo(self, state: _State) -> None:
+        """With DPOR, start from the first enabled transition only; the
+        backtracking dependence analysis adds the rest on demand
+        (SafetyChecker.cpp:255-260). Without reduction, try them all."""
+        if not state.enabled:
+            return
+        if self.reduction == "dpor":
+            state.add_todo(state.enabled[0])
+        else:
+            for pid in state.enabled:
+                state.add_todo(pid)
+
+    def _backtrack(self, stack: List[_State], path: List[int]):
+        """Undo the last transition(s). For each undone transition t,
+        DPOR walks the remaining stack backwards: the latest earlier
+        state whose outgoing transition is dependent on t (and from a
+        different actor) must also try t's actor
+        (SafetyChecker.cpp:284-295); the walk stops at a transition of
+        t's own actor (program order)."""
+        stack.pop()                       # the exhausted leaf
+        while stack:
+            state = stack[-1]
+            t = state.executed            # transition being undone
+            state.executed = None
+            if path:
+                path.pop()
+            if self.reduction == "dpor" and t is not None:
+                for prev in reversed(stack[:-1]):
+                    pt = prev.executed
+                    if pt is None:
+                        continue
+                    if pt.pid == t.pid:
+                        break
+                    if t.depends_on(pt):
+                        # Flanagan-Godefroid: schedule t's actor in that
+                        # state if it was enabled there; otherwise every
+                        # enabled actor must be tried (the actor only
+                        # becomes co-enabled through one of them).
+                        if t.pid in prev.enabled:
+                            prev.add_todo(t.pid)
+                        else:
+                            for p in prev.enabled:
+                                prev.add_todo(p)
+                        break
+            if any(p not in state.done for p in state.todo):
+                return self._replay(path)
+            stack.pop()
+        return None
+
+    def _trace(self, stack: List[_State]) -> List[str]:
+        return [repr(state.executed) for state in stack
+                if state.executed is not None]

@@ -1,0 +1,60 @@
+"""Shared plugin scaffolding: engine resolution, per-object extension
+maps, and the CpuAction -> hosts walker (the bits every plugin's
+registration entry point needs)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterator
+
+
+def resolve_engine(engine=None):
+    """Accept an s4u Engine, an EngineImpl, or None (current engine)."""
+    from ..kernel.engine import EngineImpl
+    impl = engine.pimpl if hasattr(engine, "pimpl") else engine
+    return impl if impl is not None else EngineImpl.instance
+
+
+class ExtensionMap:
+    """Lazy id()-keyed extension registry bound to one engine at a time
+    (the reference's xbt::Extendable, scoped like our engine-scoped
+    signals)."""
+
+    def __init__(self, factory: Callable):
+        self._factory = factory
+        self._map: Dict[int, object] = {}
+        self.engine = None
+
+    def activate(self, engine) -> bool:
+        """Bind to an engine; returns False when already active on it
+        (registration entry points are idempotent)."""
+        if self.engine is engine:
+            return False
+        self._map.clear()
+        self.engine = engine
+        return True
+
+    def of(self, obj):
+        ext = self._map.get(id(obj))
+        if ext is None:
+            ext = self._factory(obj, lambda: self.engine.now)
+            self._map[id(obj)] = ext
+        return ext
+
+    def get(self, obj):
+        return self._map.get(id(obj))
+
+    def values(self):
+        return self._map.values()
+
+
+def cpu_hosts_of_action(action) -> Iterator:
+    """The hosts whose CPUs an action's LMM variable touches (reference
+    CpuAction::cpus walks the same element structure)."""
+    var = action.variable
+    if var is None:
+        return
+    for elem in var.cnsts:
+        cpu = elem.constraint.id
+        host = getattr(cpu, "host", None)
+        if host is not None:
+            yield host

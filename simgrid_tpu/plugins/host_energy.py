@@ -116,34 +116,24 @@ class HostEnergy:
         return self.power_ranges[pstate].max
 
 
-_EXT: Dict[int, HostEnergy] = {}
-_active_engine = None
+from ._base import ExtensionMap, cpu_hosts_of_action, resolve_engine
+
+_EXT = ExtensionMap(HostEnergy)
 
 
 def host_energy_plugin_init(engine=None) -> None:
     """sg_host_energy_plugin_init (host_energy.cpp:481-512): hook every
     update trigger through engine-scoped signal subscriptions."""
-    global _active_engine
     from ..kernel.activity import ExecImpl
     from ..kernel.engine import EngineImpl
     from ..models.cpu import CpuAction
     from ..models.host import Host
 
-    impl = engine.pimpl if hasattr(engine, "pimpl") else engine
-    if impl is None:
-        impl = EngineImpl.instance
-    if _active_engine is impl:
+    impl = resolve_engine(engine)
+    if not _EXT.activate(impl):
         return
-    _EXT.clear()
-    _active_engine = impl
     clock = lambda: impl.now
-
-    def ext(host) -> HostEnergy:
-        he = _EXT.get(id(host))
-        if he is None:
-            he = HostEnergy(host, clock)
-            _EXT[id(host)] = he
-        return he
+    ext = _EXT.of
 
     for host in impl.hosts.values():
         ext(host)
@@ -156,16 +146,8 @@ def host_energy_plugin_init(engine=None) -> None:
     impl.connect_signal(Host.on_speed_change_sig, on_host_change)
 
     def on_action_state_change(action, *_):
-        # Recover the CPUs from the action's LMM variable elements
-        # (reference CpuAction::cpus walks the same structure).
-        var = action.variable
-        if var is None:
-            return
-        for elem in var.cnsts:
-            cpu = elem.constraint.id
-            host = getattr(cpu, "host", None)
-            if host is not None:
-                ext(host).update()
+        for host in cpu_hosts_of_action(action):
+            ext(host).update()
 
     impl.connect_signal(CpuAction.on_state_change, on_action_state_change)
 
@@ -184,7 +166,7 @@ def host_energy_plugin_init(engine=None) -> None:
     def on_end():
         total = used = 0.0
         for host in impl.hosts.values():
-            he = _EXT.get(id(host))
+            he = _EXT.get(host)
             if he is None or not he.power_ranges:
                 continue
             energy = he.get_consumed_energy()
@@ -200,7 +182,7 @@ def host_energy_plugin_init(engine=None) -> None:
 
 def get_consumed_energy(host) -> float:
     """sg_host_get_consumed_energy."""
-    he = _EXT.get(id(host))
+    he = _EXT.get(host)
     assert he is not None, \
         "The Energy plugin is not active on this engine"
     return he.get_consumed_energy()
@@ -208,7 +190,7 @@ def get_consumed_energy(host) -> float:
 
 def get_current_consumption(host) -> float:
     """sg_host_get_current_consumption (watts right now)."""
-    he = _EXT.get(id(host))
+    he = _EXT.get(host)
     assert he is not None
     he.update()
     return he.get_current_watts_value()

@@ -8,7 +8,7 @@ same triggers as host_energy.
 
 from __future__ import annotations
 
-from typing import Dict
+from ._base import ExtensionMap, cpu_hosts_of_action, resolve_engine
 
 
 class HostLoad:
@@ -17,7 +17,6 @@ class HostLoad:
         self._clock = clock_getter
         self.last_updated = clock_getter()
         self.last_reset = clock_getter()
-        self.current_flops = 0.0      # running total at current speed
         self.computed_flops = 0.0
         self.idle_time = 0.0
         self.total_idle_time = 0.0
@@ -66,32 +65,19 @@ class HostLoad:
         self.last_reset = self._clock()
 
 
-_EXT: Dict[int, HostLoad] = {}
-_active_engine = None
+_EXT = ExtensionMap(HostLoad)
 
 
 def host_load_plugin_init(engine=None) -> None:
     """sg_host_load_plugin_init (host_load.cpp registration)."""
-    global _active_engine
-    from ..kernel.engine import EngineImpl
+    from ..kernel.activity import ExecImpl
     from ..models.cpu import CpuAction
     from ..models.host import Host
 
-    impl = engine.pimpl if hasattr(engine, "pimpl") else engine
-    if impl is None:
-        impl = EngineImpl.instance
-    if _active_engine is impl:
+    impl = resolve_engine(engine)
+    if not _EXT.activate(impl):
         return
-    _EXT.clear()
-    _active_engine = impl
-    clock = lambda: impl.now
-
-    def ext(host) -> HostLoad:
-        hl = _EXT.get(id(host))
-        if hl is None:
-            hl = HostLoad(host, clock)
-            _EXT[id(host)] = hl
-        return hl
+    ext = _EXT.of
 
     for host in impl.hosts.values():
         ext(host)
@@ -101,14 +87,8 @@ def host_load_plugin_init(engine=None) -> None:
                         lambda h, *a: ext(h).update())
 
     def on_action_state_change(action, *_):
-        var = action.variable
-        if var is None:
-            return
-        for elem in var.cnsts:
-            cpu = elem.constraint.id
-            host = getattr(cpu, "host", None)
-            if host is not None:
-                ext(host).update()
+        for host in cpu_hosts_of_action(action):
+            ext(host).update()
 
     impl.connect_signal(CpuAction.on_state_change, on_action_state_change)
 
@@ -119,38 +99,37 @@ def host_load_plugin_init(engine=None) -> None:
             ext(getattr(exec_impl.hosts[0], "pm",
                         exec_impl.hosts[0])).update()
 
-    from ..kernel.activity import ExecImpl
     impl.connect_signal(ExecImpl.on_creation, on_exec_creation)
 
 
 def get_current_load(host) -> float:
-    hl = _EXT.get(id(host))
+    hl = _EXT.get(host)
     assert hl is not None, "The host_load plugin is not active"
     hl.update()
     return hl.current_load
 
 
 def get_computed_flops(host) -> float:
-    hl = _EXT.get(id(host))
+    hl = _EXT.get(host)
     assert hl is not None, "The host_load plugin is not active"
     hl.update()
     return hl.computed_flops
 
 
 def get_average_load(host) -> float:
-    hl = _EXT.get(id(host))
+    hl = _EXT.get(host)
     assert hl is not None, "The host_load plugin is not active"
     return hl.get_average_load()
 
 
 def get_idle_time(host) -> float:
-    hl = _EXT.get(id(host))
+    hl = _EXT.get(host)
     assert hl is not None, "The host_load plugin is not active"
     hl.update()
     return hl.idle_time
 
 
 def reset(host) -> None:
-    hl = _EXT.get(id(host))
+    hl = _EXT.get(host)
     assert hl is not None, "The host_load plugin is not active"
     hl.reset()

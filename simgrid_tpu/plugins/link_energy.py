@@ -55,31 +55,19 @@ class LinkEnergy:
         return self.total_energy
 
 
-_EXT: Dict[int, LinkEnergy] = {}
-_active_engine = None
+from ._base import ExtensionMap, resolve_engine
+
+_EXT = ExtensionMap(LinkEnergy)
 
 
 def link_energy_plugin_init(engine=None) -> None:
     """sg_link_energy_plugin_init (link_energy.cpp registration)."""
-    global _active_engine
-    from ..kernel.engine import EngineImpl
     from ..models.network import LinkImpl, NetworkAction
 
-    impl = engine.pimpl if hasattr(engine, "pimpl") else engine
-    if impl is None:
-        impl = EngineImpl.instance
-    if _active_engine is impl:
+    impl = resolve_engine(engine)
+    if not _EXT.activate(impl):
         return
-    _EXT.clear()
-    _active_engine = impl
-    clock = lambda: impl.now
-
-    def ext(link) -> LinkEnergy:
-        le = _EXT.get(id(link))
-        if le is None:
-            le = LinkEnergy(link, clock)
-            _EXT[id(link)] = le
-        return le
+    ext = _EXT.of
 
     for link in impl.links.values():
         ext(link)
@@ -92,7 +80,8 @@ def link_energy_plugin_init(engine=None) -> None:
             return
         for elem in var.cnsts:
             link = elem.constraint.id
-            if id(link) in _EXT or hasattr(link, "bandwidth_peak"):
+            if _EXT.get(link) is not None \
+                    or hasattr(link, "bandwidth_peak"):
                 ext(link).update()
 
     impl.connect_signal(LinkImpl.on_communicate, on_communicate)
@@ -104,6 +93,6 @@ def link_energy_plugin_init(engine=None) -> None:
 
 
 def get_consumed_energy(link) -> float:
-    le = _EXT.get(id(link))
+    le = _EXT.get(link)
     assert le is not None, "The link_energy plugin is not active"
     return le.get_consumed_energy()

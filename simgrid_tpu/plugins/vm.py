@@ -41,6 +41,24 @@ class VMModel(CpuCas01Model):
         super().__init__(engine, UpdateAlgo.FULL)  # base registers us
         engine.vm_model = self
         self.vms: List["VirtualMachine"] = []
+        # Active-task counters belong to the model itself (the reference
+        # wires them in VMModel::VMModel, VirtualMachineImpl.cpp:83-88):
+        # a VM with zero counted tasks has bound 0 on the PM, so execs
+        # on it would deadlock if this were left to an optional plugin.
+        from ..kernel.activity import ExecImpl
+
+        def on_exec_creation(exec_impl):
+            for host in exec_impl.hosts:
+                if isinstance(host, VirtualMachine):
+                    host.add_active_task()
+
+        def on_exec_completion(exec_impl):
+            for host in exec_impl.hosts:
+                if isinstance(host, VirtualMachine):
+                    host.remove_active_task()
+
+        engine.connect_signal(ExecImpl.on_creation, on_exec_creation)
+        engine.connect_signal(ExecImpl.on_completion, on_exec_completion)
 
     def next_occurring_event(self, now: float) -> float:
         # Step 1 (VirtualMachineImpl.cpp:90-129): propagate each VM's
@@ -206,35 +224,11 @@ class VirtualMachine(Host):
         self.netpoint = dst_pm.netpoint
 
 
-_active_engine = None
-
-
 def vm_live_migration_plugin_init(engine=None) -> None:
-    """sg_vm_live_migration_plugin_init: wire the active-task counters
-    (VMModel::VMModel connects ExecImpl on_creation/on_completion)."""
-    global _active_engine
-    from ..kernel.activity import ExecImpl
-    from ..kernel.engine import EngineImpl
-
-    impl = engine.pimpl if hasattr(engine, "pimpl") else engine
-    if impl is None:
-        impl = EngineImpl.instance
-    if _active_engine is impl:
-        return
-    _active_engine = impl
-
-    def on_exec_creation(exec_impl):
-        for host in exec_impl.hosts:
-            if isinstance(host, VirtualMachine):
-                host.add_active_task()
-
-    def on_exec_completion(exec_impl):
-        for host in exec_impl.hosts:
-            if isinstance(host, VirtualMachine):
-                host.remove_active_task()
-
-    impl.connect_signal(ExecImpl.on_creation, on_exec_creation)
-    impl.connect_signal(ExecImpl.on_completion, on_exec_completion)
+    """sg_vm_live_migration_plugin_init: ensure the VM model (and its
+    task counters, wired in VMModel.__init__) exists on this engine."""
+    from ._base import resolve_engine
+    _vm_model(resolve_engine(engine))
 
 
 def migrate(vm: VirtualMachine, dst_pm: Host) -> None:

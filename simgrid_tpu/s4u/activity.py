@@ -87,7 +87,8 @@ class Comm(Activity):
                     self.rate, self._src_buff, self.match_fun, self.clean_fun,
                     self.copy_data_fun, self.payload, self.detached_)
                 sc.issuer.simcall_answer()
-            self.pimpl = issuer.simcall("comm_isend", handler)
+            self.pimpl = issuer.simcall("comm_isend", handler,
+                                        mc_object=mbox_impl)
         else:
             Comm.on_receiver_start(self)
             self._dst_buff = [None]
@@ -97,7 +98,8 @@ class Comm(Activity):
                     sc.issuer.engine, sc.issuer, mbox_impl, self._dst_buff,
                     self.match_fun, self.copy_data_fun, None, self.rate)
                 sc.issuer.simcall_answer()
-            self.pimpl = issuer.simcall("comm_irecv", handler)
+            self.pimpl = issuer.simcall("comm_irecv", handler,
+                                        mc_object=mbox_impl)
         self.state = ActivityState.STARTED
         return self
 

@@ -20,7 +20,8 @@ class Mutex:
     def lock(self) -> None:
         from .actor import _current_impl
         issuer = _current_impl()
-        issuer.simcall("mutex_lock", lambda sc: self.pimpl.lock(sc))
+        issuer.simcall("mutex_lock", lambda sc: self.pimpl.lock(sc),
+                       mc_object=self.pimpl)
 
     def try_lock(self) -> bool:
         from .actor import _current_impl
@@ -29,7 +30,8 @@ class Mutex:
         def handler(sc):
             sc.result = self.pimpl.try_lock(sc.issuer)
             sc.issuer.simcall_answer()
-        return issuer.simcall("mutex_trylock", handler)
+        return issuer.simcall("mutex_trylock", handler,
+                              mc_object=self.pimpl)
 
     def unlock(self) -> None:
         from .actor import _current_impl
@@ -38,7 +40,7 @@ class Mutex:
         def handler(sc):
             self.pimpl.unlock(sc.issuer)
             sc.issuer.simcall_answer()
-        issuer.simcall("mutex_unlock", handler)
+        issuer.simcall("mutex_unlock", handler, mc_object=self.pimpl)
 
     def __enter__(self):
         self.lock()
@@ -57,7 +59,8 @@ class ConditionVariable:
         from .actor import _current_impl
         issuer = _current_impl()
         issuer.simcall("cond_wait",
-                       lambda sc: self.pimpl.wait(mutex.pimpl, -1.0, sc))
+                       lambda sc: self.pimpl.wait(mutex.pimpl, -1.0, sc),
+                       mc_object=(self.pimpl, mutex.pimpl))
 
     def wait_for(self, mutex: Mutex, timeout: float) -> bool:
         """Returns True on timeout (std::cv_status semantics)."""
@@ -65,7 +68,8 @@ class ConditionVariable:
         issuer = _current_impl()
         try:
             issuer.simcall("cond_wait_timeout",
-                           lambda sc: self.pimpl.wait(mutex.pimpl, timeout, sc))
+                           lambda sc: self.pimpl.wait(mutex.pimpl, timeout, sc),
+                           mc_object=(self.pimpl, mutex.pimpl))
             return False
         except TimeoutException:
             # per the reference (s4u_ConditionVariable.cpp:73-80): on timeout
@@ -84,7 +88,7 @@ class ConditionVariable:
         def handler(sc):
             self.pimpl.signal()
             sc.issuer.simcall_answer()
-        issuer.simcall("cond_signal", handler)
+        issuer.simcall("cond_signal", handler, mc_object=self.pimpl)
 
     def notify_all(self) -> None:
         from .actor import _current_impl
@@ -93,7 +97,7 @@ class ConditionVariable:
         def handler(sc):
             self.pimpl.broadcast()
             sc.issuer.simcall_answer()
-        issuer.simcall("cond_broadcast", handler)
+        issuer.simcall("cond_broadcast", handler, mc_object=self.pimpl)
 
 
 class Semaphore:
@@ -104,7 +108,8 @@ class Semaphore:
     def acquire(self) -> None:
         from .actor import _current_impl
         issuer = _current_impl()
-        issuer.simcall("sem_acquire", lambda sc: self.pimpl.acquire(sc, -1.0))
+        issuer.simcall("sem_acquire", lambda sc: self.pimpl.acquire(sc, -1.0),
+                       mc_object=self.pimpl)
 
     def acquire_timeout(self, timeout: float) -> bool:
         """Returns True on timeout."""
@@ -112,7 +117,8 @@ class Semaphore:
         issuer = _current_impl()
         try:
             issuer.simcall("sem_acquire_timeout",
-                           lambda sc: self.pimpl.acquire(sc, timeout))
+                           lambda sc: self.pimpl.acquire(sc, timeout),
+                           mc_object=self.pimpl)
             return False
         except TimeoutException:
             return True
@@ -124,7 +130,7 @@ class Semaphore:
         def handler(sc):
             self.pimpl.release()
             sc.issuer.simcall_answer()
-        issuer.simcall("sem_release", handler)
+        issuer.simcall("sem_release", handler, mc_object=self.pimpl)
 
     def get_capacity(self) -> int:
         return self.pimpl.value
