@@ -21,7 +21,12 @@ from .comm import Comm
 from .request import (Request, MPI_ANY_SOURCE, MPI_ANY_TAG, Status,
                       MPI_REQUEST_NULL)
 from .runtime import (smpirun, smpi_main, this_rank, COMM_WORLD,
-                      smpi_execute, smpi_execute_flops, wtime)
+                      smpi_execute, smpi_execute_flops, wtime,
+                      sample, shared_malloc, shared_free)
+from .nbc import (NbcRequest, iallgather, iallreduce, ialltoall, ibarrier,
+                  ibcast, igather, ireduce, iscatter)
+from .topo import (CartTopology, GraphTopology, MPI_PROC_NULL, dims_create)
+from .win import Win
 
 __all__ = [
     "Datatype", "MPI_BYTE", "MPI_CHAR", "MPI_INT", "MPI_LONG", "MPI_FLOAT",
@@ -32,5 +37,8 @@ __all__ = [
     "Group", "Comm", "Request", "Status", "MPI_ANY_SOURCE", "MPI_ANY_TAG",
     "MPI_REQUEST_NULL",
     "smpirun", "smpi_main", "this_rank", "COMM_WORLD", "smpi_execute",
-    "smpi_execute_flops", "wtime",
+    "smpi_execute_flops", "wtime", "sample", "shared_malloc", "shared_free",
+    "NbcRequest", "ibarrier", "ibcast", "ireduce", "iallreduce", "igather",
+    "iscatter", "iallgather", "ialltoall",
+    "CartTopology", "GraphTopology", "MPI_PROC_NULL", "dims_create", "Win",
 ]

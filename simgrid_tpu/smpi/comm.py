@@ -204,5 +204,53 @@ class Comm:
         with tr.noop_span("scan"):
             return coll.dispatch("scan")(self, sendobj, op)
 
+    # -- non-blocking collectives (smpi_nbc_impl.cpp) ----------------------
+    def ibarrier(self):
+        from . import nbc
+        return nbc.ibarrier(self)
+
+    def ibcast(self, obj, root: int = 0):
+        from . import nbc
+        return nbc.ibcast(self, obj, root)
+
+    def ireduce(self, sendobj, op: Op = MPI_SUM, root: int = 0):
+        from . import nbc
+        return nbc.ireduce(self, sendobj, op, root)
+
+    def iallreduce(self, sendobj, op: Op = MPI_SUM):
+        from . import nbc
+        return nbc.iallreduce(self, sendobj, op)
+
+    def igather(self, sendobj, root: int = 0):
+        from . import nbc
+        return nbc.igather(self, sendobj, root)
+
+    def iscatter(self, sendobjs, root: int = 0):
+        from . import nbc
+        return nbc.iscatter(self, sendobjs, root)
+
+    def iallgather(self, sendobj):
+        from . import nbc
+        return nbc.iallgather(self, sendobj)
+
+    def ialltoall(self, sendobjs):
+        from . import nbc
+        return nbc.ialltoall(self, sendobjs)
+
+    # -- topologies (smpi_topo.cpp) ----------------------------------------
+    def cart_create(self, dims, periodic, reorder: bool = False):
+        """Returns None (MPI_COMM_NULL) for ranks beyond the grid."""
+        from .topo import CartTopology
+        nnodes = 1
+        for d in dims:
+            nnodes *= d
+        if self.rank() >= nnodes:
+            return None
+        return CartTopology(self, dims, periodic, reorder)
+
+    def graph_create(self, index, edges, reorder: bool = False):
+        from .topo import GraphTopology
+        return GraphTopology(self, index, edges, reorder)
+
     def __repr__(self):
         return f"<Comm id={self.id} size={self.size()}>"

@@ -1,0 +1,182 @@
+"""Non-blocking collectives (reference src/smpi/internals/
+smpi_nbc_impl.cpp): each I-collective posts its whole point-to-point
+pattern immediately (the reference NBC implementations are the flat/
+linear algorithms precisely so every request can be posted up front)
+and returns a request completed by wait/test, with the reduction
+applied at completion time."""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from .coll import (TAG_ALLGATHER, TAG_ALLREDUCE, TAG_ALLTOALL, TAG_BARRIER,
+                   TAG_BCAST, TAG_GATHER, TAG_REDUCE, TAG_SCATTER)
+from .op import MPI_SUM, Op
+from .request import Request
+
+
+class NbcRequest:
+    """A collective-in-flight: sub-requests + a completion combiner."""
+
+    def __init__(self, sends: List[Request], recvs: List[Request],
+                 finish: Optional[Callable[[List], object]] = None):
+        self._sends = sends
+        self._recvs = recvs
+        self._finish = finish
+        self.finished = False
+        self._result = None
+
+    def wait(self):
+        if self.finished:
+            return self._result
+        data = [r.wait() for r in self._recvs]
+        for r in self._sends:
+            r.wait()
+        self.finished = True
+        if self._finish is not None:
+            self._result = self._finish(data)
+        return self._result
+
+    def test(self) -> bool:
+        if self.finished:
+            return True
+        if all(r.finished or r.test() for r in self._recvs + self._sends):
+            self.wait()
+            return True
+        return False
+
+
+def ibarrier(comm) -> NbcRequest:
+    """Flat ibarrier (smpi_nbc_impl.cpp ibarrier): everyone -> 0, then
+    0 -> everyone; all requests posted now."""
+    rank, size = comm.rank(), comm.size()
+    if size == 1:
+        return NbcRequest([], [])
+    if rank == 0:
+        recvs = [comm.irecv(src, TAG_BARRIER) for src in range(1, size)]
+
+        def finish(_):
+            reqs = [comm.isend(b"", dst, TAG_BARRIER)
+                    for dst in range(1, size)]
+            for r in reqs:
+                r.wait()
+        return NbcRequest([], recvs, finish)
+    send = comm.isend(b"", 0, TAG_BARRIER)
+    recv = comm.irecv(0, TAG_BARRIER)
+    return NbcRequest([send], [recv], lambda _: None)
+
+
+def ibcast(comm, obj, root: int = 0) -> NbcRequest:
+    """Flat ibcast (smpi_nbc_impl.cpp ibcast): root isends to all."""
+    rank, size = comm.rank(), comm.size()
+    if size == 1:
+        return NbcRequest([], [], lambda _: obj)
+    if rank == root:
+        sends = [comm.isend(obj, dst, TAG_BCAST)
+                 for dst in range(size) if dst != root]
+        return NbcRequest(sends, [], lambda _: obj)
+    recv = comm.irecv(root, TAG_BCAST)
+    return NbcRequest([], [recv], lambda data: data[0])
+
+
+def ireduce(comm, sendobj, op: Op = MPI_SUM, root: int = 0) -> NbcRequest:
+    """Flat ireduce: root irecvs from all, folds at completion."""
+    rank, size = comm.rank(), comm.size()
+    if size == 1:
+        return NbcRequest([], [], lambda _: sendobj)
+    if rank != root:
+        return NbcRequest([comm.isend(sendobj, root, TAG_REDUCE)], [],
+                          lambda _: None)
+    others = [src for src in range(size) if src != root]
+    recvs = [comm.irecv(src, TAG_REDUCE) for src in others]
+
+    def finish(data):
+        parts = [None] * size
+        parts[root] = sendobj
+        for src, d in zip(others, data):
+            parts[src] = d
+        result = parts[size - 1]
+        for i in range(size - 2, -1, -1):
+            result = op(parts[i], result)
+        return result
+    return NbcRequest([], recvs, finish)
+
+
+def iallreduce(comm, sendobj, op: Op = MPI_SUM) -> NbcRequest:
+    """Flat iallreduce: exchange with everyone, fold at completion
+    (smpi_nbc_impl.cpp iallreduce)."""
+    rank, size = comm.rank(), comm.size()
+    if size == 1:
+        return NbcRequest([], [], lambda _: sendobj)
+    others = [r for r in range(size) if r != rank]
+    sends = [comm.isend(sendobj, dst, TAG_ALLREDUCE) for dst in others]
+    recvs = [comm.irecv(src, TAG_ALLREDUCE) for src in others]
+
+    def finish(data):
+        parts = [None] * size
+        parts[rank] = sendobj
+        for src, d in zip(others, data):
+            parts[src] = d
+        result = parts[size - 1]
+        for i in range(size - 2, -1, -1):
+            result = op(parts[i], result)
+        return result
+    return NbcRequest(sends, recvs, finish)
+
+
+def igather(comm, sendobj, root: int = 0) -> NbcRequest:
+    rank, size = comm.rank(), comm.size()
+    if rank != root:
+        return NbcRequest([comm.isend(sendobj, root, TAG_GATHER)], [],
+                          lambda _: None)
+    others = [src for src in range(size) if src != root]
+    recvs = [comm.irecv(src, TAG_GATHER) for src in others]
+
+    def finish(data):
+        parts = [None] * size
+        parts[root] = sendobj
+        for src, d in zip(others, data):
+            parts[src] = d
+        return parts
+    return NbcRequest([], recvs, finish)
+
+
+def iscatter(comm, sendobjs, root: int = 0) -> NbcRequest:
+    rank, size = comm.rank(), comm.size()
+    if rank == root:
+        sends = [comm.isend(sendobjs[dst], dst, TAG_SCATTER)
+                 for dst in range(size) if dst != root]
+        return NbcRequest(sends, [], lambda _: sendobjs[root])
+    recv = comm.irecv(root, TAG_SCATTER)
+    return NbcRequest([], [recv], lambda data: data[0])
+
+
+def iallgather(comm, sendobj) -> NbcRequest:
+    rank, size = comm.rank(), comm.size()
+    others = [r for r in range(size) if r != rank]
+    sends = [comm.isend(sendobj, dst, TAG_ALLGATHER) for dst in others]
+    recvs = [comm.irecv(src, TAG_ALLGATHER) for src in others]
+
+    def finish(data):
+        parts = [None] * size
+        parts[rank] = sendobj
+        for src, d in zip(others, data):
+            parts[src] = d
+        return parts
+    return NbcRequest(sends, recvs, finish)
+
+
+def ialltoall(comm, sendobjs) -> NbcRequest:
+    rank, size = comm.rank(), comm.size()
+    others = [r for r in range(size) if r != rank]
+    sends = [comm.isend(sendobjs[dst], dst, TAG_ALLTOALL)
+             for dst in others]
+    recvs = [comm.irecv(src, TAG_ALLTOALL) for src in others]
+
+    def finish(data):
+        parts = [None] * size
+        parts[rank] = sendobjs[rank]
+        for src, d in zip(others, data):
+            parts[src] = d
+        return parts
+    return NbcRequest(sends, recvs, finish)

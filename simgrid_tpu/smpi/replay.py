@@ -79,6 +79,8 @@ class ReplayContext:
 
 ActionHandler = Callable[[ReplayContext, List[str]], None]
 _handlers: Dict[str, ActionHandler] = {}
+#: out-of-band per-rank checkpoint staging (replay_main)
+_ckpt_pending: Dict[str, dict] = {}
 
 
 def action(name: str):
@@ -342,14 +344,56 @@ def _actions_for_rank(trace_path: str, rank: int) -> List[List[str]]:
     return actions
 
 
-def replay_main(trace_path: str) -> None:
-    """The per-rank replay actor body (smpi_replay_main)."""
+def replay_main(trace_path: str, checkpoint_file: Optional[str] = None,
+                resume_from: Optional[dict] = None) -> None:
+    """The per-rank replay actor body (smpi_replay_main).
+
+    Checkpoint/resume (the SURVEY §5 upgrade over the reference, which
+    has no user-facing simulation checkpointing): a ``checkpoint``
+    action in the trace barriers all ranks — a globally quiescent point
+    with no traffic in flight — and dumps {clock, per-rank action
+    index} to ``checkpoint_file``. Resuming replays the same trace on a
+    fresh engine with each rank fast-forwarded past its recorded index
+    and the clock pre-advanced, reaching the identical final timestamp
+    as an uninterrupted run (determinism makes the state at a quiescent
+    point a pure function of (trace, index, clock))."""
+    import json
+
     from . import runtime
     comm = runtime.world()
     rank = comm.rank()
     ctx = ReplayContext(comm)
-    for act in _actions_for_rank(trace_path, rank):
+    actions = _actions_for_rank(trace_path, rank)
+    start_index = 0
+    if resume_from is not None:
+        mine = resume_from["ranks"][str(rank)]
+        start_index = mine["index"]
+        # Re-establish this rank's local clock: at a quiescent point
+        # the per-rank state is exactly (position, local time) — ranks
+        # exit the checkpoint barrier at different times and must
+        # resume at their own.
+        from ..s4u import this_actor
+        if mine["clock"] > 0:
+            this_actor.sleep_for(mine["clock"])
+    for index, act in enumerate(actions):
+        if index < start_index:
+            continue
         name = act[1]
+        if name == "checkpoint":
+            comm.barrier()
+            if checkpoint_file is not None:
+                # Out-of-band state capture (no simulated cost — the
+                # checkpointer observes the simulation from outside,
+                # like the reference MC reads the MCed process): each
+                # rank records (next index, local clock); the last one
+                # writes the file.
+                _ckpt_pending[str(rank)] = {"index": index + 1,
+                                            "clock": runtime.wtime()}
+                if len(_ckpt_pending) == comm.size():
+                    with open(checkpoint_file, "w") as f:
+                        json.dump({"ranks": dict(_ckpt_pending)}, f)
+                    _ckpt_pending.clear()
+            continue
         handler = _handlers.get(name)
         assert handler is not None, f"Replay action '{name}' unknown"
         handler(ctx, act)
@@ -360,9 +404,22 @@ def replay_main(trace_path: str) -> None:
 
 
 def smpi_replay_run(platform: str, trace_path: str, np_ranks: int,
-                    configs=()):
+                    configs=(), checkpoint_file: Optional[str] = None,
+                    resume_from: Optional[str] = None):
     """Replay a TI trace end-to-end: build engine + ranks, run, return
-    the engine (inspect .clock for the simulated makespan)."""
+    the engine (inspect .clock for the simulated makespan).
+
+    ``checkpoint_file`` records the state at the trace's `checkpoint`
+    action; ``resume_from`` restarts from such a file (fresh engine,
+    clock pre-advanced, ranks fast-forwarded)."""
+    import json
+
     from .runtime import smpirun
-    return smpirun(lambda: replay_main(trace_path), platform, np=np_ranks,
-                   configs=list(configs))
+
+    state = None
+    if resume_from is not None:
+        with open(resume_from) as f:
+            state = json.load(f)
+    _ckpt_pending.clear()   # an aborted run must not leak staged state
+    return smpirun(lambda: replay_main(trace_path, checkpoint_file, state),
+                   platform, np=np_ranks, configs=list(configs))

@@ -150,7 +150,8 @@ class Request:
                     sc.issuer.engine, sc.issuer, mbox, self._dst_slot,
                     match_recv, None, self, -1.0)
                 sc.issuer.simcall_answer()
-            self.pimpl = issuer.simcall("comm_irecv", handler)
+            self.pimpl = issuer.simcall("comm_irecv", handler,
+                                        mc_object=mbox)
             return self
 
         # send side
@@ -189,7 +190,8 @@ class Request:
                 sc.issuer.engine, sc.issuer, mbox, self.size, -1.0,
                 [payload], match_send, None, None, self, self.detached)
             sc.issuer.simcall_answer()
-        self.pimpl = issuer.simcall("comm_isend", handler)
+        self.pimpl = issuer.simcall("comm_isend", handler,
+                                    mc_object=mbox)
         return self
 
     # ------------------------------------------------------------------

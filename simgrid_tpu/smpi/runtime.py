@@ -167,6 +167,90 @@ def wtime() -> float:
     return Engine.get_clock()
 
 
+# ---------------------------------------------------------------------------
+# SMPI_SAMPLE loop extrapolation (smpi_bench.cpp:150-280)
+# ---------------------------------------------------------------------------
+
+class _SampleState:
+    __slots__ = ("count", "sum")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+
+_samples: Dict = {}
+
+
+def sample(key, iters: int, flops_per_iter=None, threshold: int = 3,
+           shared: bool = False):
+    """SMPI_SAMPLE_LOCAL/GLOBAL analog: a generator driving a benched
+    loop. The first `threshold` iterations run (and cost) their real
+    simulated work; afterwards each remaining iteration is *skipped*
+    and charged `flops_per_iter` as compute when given, or the measured
+    mean simulated duration otherwise — the loop still "executes"
+    `iters` times observably but only samples pay the full path
+    (smpi_bench.cpp sample_enough_benchs).
+
+    Usage:
+        for running in smpi.sample("kernel", 100):
+            if running:
+                this_actor.execute(1e7)    # the real benched body
+    With shared=True the sample state is shared by all ranks (GLOBAL
+    flavor: one rank's measurements serve everyone)."""
+    from ..s4u import Engine, this_actor
+    state_key = key if shared else (key, this_rank())
+    state = _samples.get(state_key)
+    if state is None:
+        state = _samples[state_key] = _SampleState()
+    for _ in range(iters):
+        if state.count < threshold:
+            t0 = Engine.get_clock()
+            yield True                      # caller runs the real body
+            state.count += 1
+            state.sum += Engine.get_clock() - t0
+        else:
+            # skip the body, inject the extrapolated cost
+            if flops_per_iter is not None:
+                this_actor.execute(flops_per_iter)
+            elif state.mean() > 0:
+                this_actor.sleep_for(state.mean())
+            yield False
+
+
+# ---------------------------------------------------------------------------
+# SMPI_SHARED_MALLOC analog (smpi_shared.cpp)
+# ---------------------------------------------------------------------------
+
+_shared_blocks: Dict = {}
+
+
+def shared_malloc(key, shape, dtype=None):
+    """One physical buffer per call-site key, shared by every rank —
+    the memory-footprint trick of SMPI_SHARED_MALLOC (smpi_shared.cpp:
+    6-60: all ranks' "allocations" alias the same backing block, fine
+    because replayed kernels don't care about the data)."""
+    import numpy as np
+    block = _shared_blocks.get(key)
+    if block is None:
+        block = np.zeros(shape, dtype or np.float64)
+        _shared_blocks[key] = block
+    return block
+
+
+def shared_free(key) -> None:
+    _shared_blocks.pop(key, None)
+
+
+def clear_process_data() -> None:
+    """Reset cross-run module state (new smpirun)."""
+    _samples.clear()
+    _shared_blocks.clear()
+
+
 def smpi_main(fn, engine, hosts: Optional[Sequence] = None,
               np: Optional[int] = None, args: tuple = ()) -> None:
     """Register one actor per rank on an existing engine (reference
@@ -182,6 +266,7 @@ def smpi_main(fn, engine, hosts: Optional[Sequence] = None,
 
     _registry.clear()
     _by_world_rank.clear()
+    clear_process_data()
     _world = Comm(Group(list(range(n))))
 
     def rank_main():

@@ -1,0 +1,181 @@
+"""MPI process topologies: cartesian + graph (reference
+src/smpi/mpi/smpi_topo.cpp).
+
+Pure rank arithmetic over an existing communicator: Cart_create slices
+(or reorders trivially — like the reference, reorder is accepted and
+ignored), rank<->coords conversion is row-major, shifts wrap on
+periodic dimensions and return MPI_PROC_NULL (-1) off the edge
+(smpi_topo.cpp Topo_Cart::shift), and Dims_create factors nnodes into
+balanced dimensions (:273-322)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+MPI_PROC_NULL = -1
+
+
+class CartTopology:
+    """MPI_Cart_create result (Topo_Cart). Ranks >= nnodes are excluded
+    — MPI gives them MPI_COMM_NULL, here ``comm.cart_create`` returns
+    None for them and this constructor refuses direct misuse."""
+
+    def __init__(self, comm, dims: Sequence[int],
+                 periodic: Sequence[int], reorder: bool = False):
+        assert len(dims) == len(periodic)
+        nnodes = 1
+        for d in dims:
+            nnodes *= d
+        assert nnodes <= comm.size(), \
+            (f"Cart topology of {nnodes} nodes over a communicator of "
+             f"{comm.size()}")
+        assert comm.rank() < nnodes, \
+            (f"Rank {comm.rank()} is not part of this {nnodes}-node "
+             f"cartesian topology (MPI_COMM_NULL)")
+        self.dims = list(dims)
+        self.periodic = [bool(p) for p in periodic]
+        self.nnodes = nnodes
+        self.comm = comm
+
+    # -- rank <-> coords (row-major, smpi_topo.cpp Topo_Cart::coords) ----
+    def rank(self, coords: Sequence[int]) -> int:
+        r = 0
+        for dim, per, c in zip(self.dims, self.periodic, coords):
+            if c < 0 or c >= dim:
+                assert per, f"Coordinate {c} out of non-periodic dim {dim}"
+                c %= dim
+            r = r * dim + c
+        return r
+
+    def coords(self, rank: int) -> List[int]:
+        out = [0] * len(self.dims)
+        for i in range(len(self.dims) - 1, -1, -1):
+            out[i] = rank % self.dims[i]
+            rank //= self.dims[i]
+        return out
+
+    def shift(self, direction: int, disp: int,
+              rank: Optional[int] = None) -> Tuple[int, int]:
+        """MPI_Cart_shift: (rank_source, rank_dest) for a displacement
+        along a dimension; MPI_PROC_NULL past non-periodic edges."""
+        if rank is None:
+            rank = self.comm.rank()
+        coords = self.coords(rank)
+
+        def neighbor(offset: int) -> int:
+            c = list(coords)
+            c[direction] += offset
+            if not self.periodic[direction] and \
+                    not (0 <= c[direction] < self.dims[direction]):
+                return MPI_PROC_NULL
+            c[direction] %= self.dims[direction]
+            return self.rank(c)
+
+        return neighbor(-disp), neighbor(disp)
+
+    def get(self) -> Tuple[List[int], List[bool], List[int]]:
+        """MPI_Cart_get: (dims, periods, my coords)."""
+        return (list(self.dims), list(self.periodic),
+                self.coords(self.comm.rank()))
+
+    def sub(self, remain_dims: Sequence[bool]) -> "SubCartTopology":
+        """MPI_Cart_sub: the slice of ranks sharing this rank's dropped
+        coordinates, projected onto the remaining dimensions. Neighbor
+        queries return ranks of the PARENT communicator (what halo code
+        sends to)."""
+        return SubCartTopology(self, remain_dims)
+
+
+class SubCartTopology:
+    """A cartesian sub-grid (MPI_Cart_sub result): dims are the kept
+    dimensions, ranks translate back to the parent communicator."""
+
+    def __init__(self, parent: CartTopology, remain_dims: Sequence[bool]):
+        self.parent = parent
+        self.remain = [bool(k) for k in remain_dims]
+        self.dims = [d for d, keep in zip(parent.dims, self.remain)
+                     if keep] or [1]
+        self.periodic = [p for p, keep in zip(parent.periodic, self.remain)
+                         if keep] or [False]
+        self._my_full = parent.coords(parent.comm.rank())
+
+    def _to_parent_rank(self, sub_coords: Sequence[int]) -> int:
+        full = list(self._my_full)
+        it = iter(sub_coords)
+        for i, keep in enumerate(self.remain):
+            if keep:
+                full[i] = next(it)
+        return self.parent.rank(full)
+
+    def my_coords(self) -> List[int]:
+        return [c for c, keep in zip(self._my_full, self.remain) if keep]
+
+    def shift(self, direction: int, disp: int) -> Tuple[int, int]:
+        """(source, dest) as PARENT communicator ranks."""
+        coords = self.my_coords()
+
+        def neighbor(offset: int) -> int:
+            c = list(coords)
+            c[direction] += offset
+            if not self.periodic[direction] and \
+                    not (0 <= c[direction] < self.dims[direction]):
+                return MPI_PROC_NULL
+            c[direction] %= self.dims[direction]
+            return self._to_parent_rank(c)
+
+        return neighbor(-disp), neighbor(disp)
+
+
+def dims_create(nnodes: int, ndims: int,
+                dims: Optional[List[int]] = None) -> List[int]:
+    """MPI_Dims_create (smpi_topo.cpp:273-322): factor nnodes into
+    ndims balanced dimensions, honoring pre-set (non-zero) entries."""
+    out = list(dims) if dims else [0] * ndims
+    assert len(out) == ndims
+    fixed = 1
+    free_slots = []
+    for i, d in enumerate(out):
+        if d > 0:
+            fixed *= d
+        else:
+            free_slots.append(i)
+    assert nnodes % fixed == 0, \
+        f"nnodes {nnodes} not divisible by fixed dims product {fixed}"
+    remaining = nnodes // fixed
+    if not free_slots:
+        assert remaining == 1
+        return out
+
+    # Prime-factorize and distribute largest-first onto smallest dims.
+    factors = []
+    n, p = remaining, 2
+    while p * p <= n:
+        while n % p == 0:
+            factors.append(p)
+            n //= p
+        p += 1
+    if n > 1:
+        factors.append(n)
+    sizes = [1] * len(free_slots)
+    for f in sorted(factors, reverse=True):
+        sizes[sizes.index(min(sizes))] *= f
+    for slot, size in zip(free_slots, sorted(sizes, reverse=True)):
+        out[slot] = size
+    return out
+
+
+class GraphTopology:
+    """MPI_Graph_create result (Topo_Graph): index/edges adjacency."""
+
+    def __init__(self, comm, index: Sequence[int], edges: Sequence[int],
+                 reorder: bool = False):
+        self.comm = comm
+        self.index = list(index)
+        self.edges = list(edges)
+
+    def neighbors(self, rank: int) -> List[int]:
+        lo = self.index[rank - 1] if rank > 0 else 0
+        return self.edges[lo:self.index[rank]]
+
+    def neighbors_count(self, rank: int) -> int:
+        return len(self.neighbors(rank))

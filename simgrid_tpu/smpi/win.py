@@ -1,0 +1,137 @@
+"""One-sided communication: MPI_Win put/get/accumulate + fence
+(reference src/smpi/mpi/smpi_win.cpp).
+
+The reference issues both sides of each RMA transfer itself (it owns
+every rank's request queues, smpi_win.cpp Win::put posts the send *and*
+the matching receive). Here passive progress is modeled explicitly: Win
+creation spawns one daemon actor per rank on the window's host that
+serves its mailbox — so an RMA transfer is a real simulated message
+riding the origin->target route, applied by the target-side daemon
+without the target rank's participation. fence() follows the
+reference's semantics: it completes all outstanding accesses (an
+alltoall of op counts tells each daemon how much traffic to expect,
+the daemon signals local completion, then a barrier closes the epoch).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .op import Op
+
+_win_seq = 0
+
+
+class Win:
+    """Collective window object: every rank constructs it with its
+    local data object (an np.ndarray or dict-like)."""
+
+    def __init__(self, comm, local_data, size_bytes: Optional[int] = None):
+        global _win_seq
+        from ..s4u import Actor, Mailbox, Semaphore
+        from . import runtime
+
+        self.comm = comm
+        self.local_data = local_data
+        rank = comm.rank()
+        # Deterministic collective id without communication: window
+        # creation is collective and ordered, so every rank's per-comm
+        # creation sequence agrees (same rule as communicator ids).
+        self.win_id = str(comm._next_cc_id("win"))
+        self._mbox = Mailbox.by_name(f"__win{self.win_id}-{rank}")
+        self._pending_counts = [0] * comm.size()   # ops sent per target
+        self._sends: List = []
+        self._consumed = 0          # ops my daemon applied this epoch
+        self._expected: Optional[int] = None
+        self._epoch_sem = Semaphore(0)
+
+        me = runtime.this_rank_state()
+        win = self
+
+        def daemon():
+            while True:
+                msg = win._mbox.get()
+                if msg == "__win_free__":
+                    break
+                kind, payload = msg
+                if kind == "put":
+                    slot, data = payload
+                    win._apply_put(slot, data)
+                elif kind == "acc":
+                    slot, data, op = payload
+                    win._apply_acc(slot, data, op)
+                elif kind == "get":
+                    reply_to, slot, nbytes = payload
+                    data = win._read(slot)
+                    Mailbox.by_name(reply_to).put(data, nbytes)
+                win._consumed += 1
+                if win._expected is not None and \
+                        win._consumed >= win._expected:
+                    win._epoch_sem.release()
+
+        self._daemon = Actor.create(f"__win{self.win_id}_rma_{rank}",
+                                    me.host, daemon)
+        self._daemon.daemonize()
+        comm.barrier()
+
+    # -- local window application -----------------------------------------
+    def _apply_put(self, slot, data) -> None:
+        try:
+            self.local_data[slot] = data
+        except TypeError:
+            setattr(self.local_data, slot, data)
+
+    def _apply_acc(self, slot, data, op: Op) -> None:
+        self.local_data[slot] = op(self.local_data[slot], data)
+
+    def _read(self, slot):
+        return self.local_data[slot] if slot is not None else \
+            self.local_data
+
+    # -- RMA calls (smpi_win.cpp put/get/accumulate) ----------------------
+    def put(self, target_rank: int, slot, data, nbytes: int) -> None:
+        from ..s4u import Mailbox
+        mbox = Mailbox.by_name(f"__win{self.win_id}-{target_rank}")
+        self._sends.append(mbox.put_async(("put", (slot, data)), nbytes))
+        self._pending_counts[target_rank] += 1
+
+    def accumulate(self, target_rank: int, slot, data, nbytes: int,
+                   op: Op) -> None:
+        from ..s4u import Mailbox
+        mbox = Mailbox.by_name(f"__win{self.win_id}-{target_rank}")
+        self._sends.append(
+            mbox.put_async(("acc", (slot, data, op)), nbytes))
+        self._pending_counts[target_rank] += 1
+
+    def get(self, target_rank: int, slot, nbytes: int) -> Any:
+        """Synchronous within the access epoch (the reference's get is
+        also a paired transfer): a tiny request message to the target's
+        daemon, the data rides back over the same route."""
+        from ..s4u import Mailbox
+        reply = f"__win{self.win_id}-get-{self.comm.rank()}-{target_rank}"
+        mbox = Mailbox.by_name(f"__win{self.win_id}-{target_rank}")
+        self._pending_counts[target_rank] += 1
+        mbox.put(("get", (reply, slot, nbytes)), 8)
+        return Mailbox.by_name(reply).get()
+
+    # -- synchronization ---------------------------------------------------
+    def fence(self) -> None:
+        """Close the access epoch (Win::fence): local sends complete,
+        every daemon has applied the traffic addressed to it, barrier."""
+        for req in self._sends:
+            req.wait()
+        self._sends.clear()
+        incoming = self.comm.alltoall(list(self._pending_counts))
+        self._pending_counts = [0] * self.comm.size()
+        expected = sum(incoming)
+        if expected > self._consumed:
+            self._expected = expected
+            self._epoch_sem.acquire()
+        self._expected = None
+        self._consumed = 0
+        self.comm.barrier()
+
+    def free(self) -> None:
+        """Collective destructor: stop the daemons."""
+        self.fence()
+        self._mbox.put("__win_free__", 1)

@@ -161,3 +161,52 @@ def test_waitall_and_test_actions(cluster16, tmp_path):
                 "1 finalize\n")
     e = replay.smpi_replay_run(cluster16, trace, 2, configs=["tracing:no"])
     assert e.clock > 0
+
+
+def test_checkpoint_resume_identical_final_time(cluster16, tmp_path):
+    """A replay checkpointed at a quiescent point and resumed on a
+    fresh engine reaches the identical final timestamp (SURVEY §5's
+    promised upgrade: kernel determinism makes the quiescent state a
+    pure function of trace position + clock)."""
+    trace = os.path.join(tmp_path, "ckpt_trace.txt")
+    with open(trace, "w") as f:
+        for r in range(4):
+            f.write(f"{r} init\n")
+        for r in range(4):
+            f.write(f"{r} compute 2e8\n")
+        for r in range(4):
+            f.write(f"{r} allreduce 5e4 0\n")
+        for r in range(4):
+            f.write(f"{r} checkpoint\n")
+        for r in range(4):
+            f.write(f"{r} compute 3e8\n")
+        for r in range(4):
+            f.write(f"{r} bcast 1e5\n")
+        for r in range(4):
+            f.write(f"{r} finalize\n")
+
+    # Uninterrupted reference run.
+    e_full = replay.smpi_replay_run(cluster16, trace, 4,
+                                    configs=["tracing:no"])
+    t_final = e_full.clock
+
+    # Run with checkpointing: same result + a state file.
+    ckpt = os.path.join(tmp_path, "state.json")
+    s4u.Engine._reset()
+    e_ck = replay.smpi_replay_run(cluster16, trace, 4,
+                                  configs=["tracing:no"],
+                                  checkpoint_file=ckpt)
+    assert e_ck.clock == t_final
+    assert os.path.exists(ckpt)
+
+    # Resume from the checkpoint on a fresh engine.
+    s4u.Engine._reset()
+    e_res = replay.smpi_replay_run(cluster16, trace, 4,
+                                   configs=["tracing:no"],
+                                   resume_from=ckpt)
+    assert e_res.clock == t_final
+    # And the resumed run really skipped the pre-checkpoint work: it
+    # starts at the checkpoint clock, which is past the first compute.
+    import json
+    state = json.load(open(ckpt))
+    assert all(0 < r["clock"] < t_final for r in state["ranks"].values())

@@ -1,0 +1,265 @@
+"""SMPI completeness tests: non-blocking collectives, RMA windows,
+cartesian topology, SMPI_SAMPLE extrapolation, shared malloc
+(reference models: smpi_nbc_impl.cpp, smpi_win.cpp, smpi_topo.cpp,
+smpi_bench.cpp:150-280, smpi_shared.cpp)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from simgrid_tpu import s4u, smpi
+from simgrid_tpu.smpi.runtime import smpirun
+
+XML = """<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <cluster id="c" prefix="n-" radical="0-7" suffix="" speed="1Gf"
+             bw="125MBps" lat="50us"/>
+  </zone>
+</platform>
+"""
+
+
+@pytest.fixture(autouse=True)
+def fresh_engine():
+    s4u.Engine._reset()
+    yield
+    s4u.Engine._reset()
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    path = os.path.join(tmp_path, "c8.xml")
+    with open(path, "w") as f:
+        f.write(XML)
+    return path
+
+
+def run(cluster, n, fn):
+    out = {}
+
+    def main():
+        fn(smpi.COMM_WORLD, out)
+    smpirun(main, cluster, np=n, configs=["tracing:no"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Non-blocking collectives
+# ---------------------------------------------------------------------------
+
+def test_iallreduce_overlaps_compute(cluster):
+    """The collective progresses while the rank computes: total time is
+    max(comm, compute), not their sum."""
+    def f(comm, out):
+        req = comm.iallreduce(np.ones(100000))
+        smpi.smpi_execute_flops(1e9)     # 1s of compute
+        result = req.wait()
+        out[comm.rank()] = (result, smpi.wtime())
+    out = run(cluster, 4, f)
+    for r in range(4):
+        result, t = out[r]
+        np.testing.assert_allclose(result, np.full(100000, 4.0))
+        assert t == pytest.approx(1.0, rel=0.05)  # hidden behind compute
+
+
+def test_ibcast_ibarrier_igather(cluster):
+    def f(comm, out):
+        me = comm.rank()
+        data = np.arange(10.0) if me == 0 else None
+        got = comm.ibcast(data, root=0).wait()
+        comm.ibarrier().wait()
+        gathered = comm.igather(np.full(3, float(me)), root=0).wait()
+        out[me] = (got, gathered)
+    out = run(cluster, 4, f)
+    for r in range(4):
+        np.testing.assert_allclose(out[r][0], np.arange(10.0))
+    for i in range(4):
+        np.testing.assert_allclose(out[0][1][i], np.full(3, float(i)))
+    assert out[1][1] is None
+
+
+def test_ialltoall_iscatter_test(cluster):
+    def f(comm, out):
+        me, n = comm.rank(), comm.size()
+        req = comm.ialltoall([np.full(4, float(me * 10 + i))
+                              for i in range(n)])
+        while not req.test():
+            s4u.this_actor.sleep_for(0.001)
+        result = req.wait()
+        out[me] = result
+        objs = [np.full(2, float(i)) for i in range(n)] \
+            if me == 0 else None
+        if me == 0:
+            out["scattered"] = comm.iscatter(objs, root=0).wait()
+        else:
+            comm.iscatter(None, root=0).wait()
+    out = run(cluster, 4, f)
+    for r in range(4):
+        for i in range(4):
+            np.testing.assert_allclose(out[r][i], np.full(4, i * 10 + r))
+    np.testing.assert_allclose(out["scattered"], np.zeros(2))
+
+
+# ---------------------------------------------------------------------------
+# RMA windows
+# ---------------------------------------------------------------------------
+
+def test_win_put_get_fence(cluster):
+    def f(comm, out):
+        me, n = comm.rank(), comm.size()
+        local = {i: None for i in range(n)}
+        win = smpi.Win(comm, local)
+        # everyone puts its rank into slot[me] of its right neighbor
+        win.put((me + 1) % n, me, float(me), 1000)
+        win.fence()
+        out[f"slot{me}"] = dict(local)
+        # read back my own contribution from my right neighbor
+        got = win.get((me + 1) % n, me, 1000)
+        win.fence()
+        out[f"got{me}"] = got
+        win.free()
+    out = run(cluster, 4, f)
+    for me in range(4):
+        left = (me - 1 + 4) % 4
+        assert out[f"slot{me}"][left] == float(left)
+        assert out[f"got{me}"] == float(me)
+
+
+def test_win_accumulate(cluster):
+    def f(comm, out):
+        me, n = comm.rank(), comm.size()
+        local = {0: 0.0}
+        win = smpi.Win(comm, local)
+        win.accumulate(0, 0, float(me + 1), 100, smpi.MPI_SUM)
+        win.fence()
+        if me == 0:
+            out["sum"] = local[0]
+        win.free()
+    out = run(cluster, 4, f)
+    assert out["sum"] == 1 + 2 + 3 + 4
+
+
+def test_win_timing_rides_network(cluster):
+    """A put of 125MB over a 125MBps link takes ~1s, paid at fence."""
+    def f(comm, out):
+        me = comm.rank()
+        local = {0: None}
+        win = smpi.Win(comm, local)
+        if me == 0:
+            win.put(1, 0, b"x", 125_000_000)
+        win.fence()
+        out[me] = smpi.wtime()
+        win.free()
+    out = run(cluster, 2, f)
+    assert out[0] > 0.9
+
+
+# ---------------------------------------------------------------------------
+# Topologies
+# ---------------------------------------------------------------------------
+
+def test_cart_topology(cluster):
+    def f(comm, out):
+        cart = comm.cart_create([2, 4], [True, False])
+        me = comm.rank()
+        coords = cart.coords(me)
+        assert cart.rank(coords) == me
+        left, right = cart.shift(1, 1)
+        out[me] = (coords, left, right)
+    out = run(cluster, 8, f)
+    # rank 0 = (0,0): along dim 1 (non-periodic): no left, right=(0,1)=1
+    assert out[0] == ([0, 0], smpi.MPI_PROC_NULL, 1)
+    # rank 3 = (0,3): right edge -> dest NULL, src=(0,2)=2
+    assert out[3] == ([0, 3], 2, smpi.MPI_PROC_NULL)
+    # rank 7 = (1,3)
+    assert out[7][0] == [1, 3]
+
+
+def test_cart_periodic_shift_and_sub(cluster):
+    def f(comm, out):
+        cart = comm.cart_create([4, 2], [True, True])
+        me = comm.rank()
+        src, dst = cart.shift(0, 1)
+        out[me] = (src, dst)
+        sub = cart.sub([True, False])
+        out[f"sub{me}"] = sub.dims
+    out = run(cluster, 8, f)
+    # rank 0 = (0,0): dim0 periodic: src=(3,0)=6, dst=(1,0)=2
+    assert out[0] == (6, 2)
+    assert out["sub0"] == [4]
+
+
+def test_dims_create():
+    assert smpi.dims_create(8, 2) in ([4, 2], [2, 4])
+    assert smpi.dims_create(12, 2, [4, 0]) == [4, 3]
+    assert sorted(smpi.dims_create(30, 3)) == [2, 3, 5]
+
+
+# ---------------------------------------------------------------------------
+# Sampling + shared malloc
+# ---------------------------------------------------------------------------
+
+def test_sample_extrapolates(cluster):
+    """First `threshold` iterations run the real body; the rest are
+    skipped and charged the measured mean."""
+    def f(comm, out):
+        ran = 0
+        for running in smpi.sample("k", 10, threshold=3):
+            if running:
+                s4u.this_actor.execute(1e8)   # 0.1s each at 1Gf
+                ran += 1
+        out["ran"] = ran
+        out["t"] = smpi.wtime()
+    out = run(cluster, 1, f)
+    assert out["ran"] == 3
+    # 3 real iterations + 7 extrapolated at the same mean ~ 10 x 0.1s
+    assert out["t"] == pytest.approx(1.0, rel=0.05)
+
+
+def test_shared_malloc_aliases(cluster):
+    def f(comm, out):
+        buf = smpi.shared_malloc("blk", 1000)
+        buf[comm.rank()] = 1.0
+        comm.barrier()
+        out[comm.rank()] = float(buf[:comm.size()].sum())
+    out = run(cluster, 4, f)
+    # every rank sees every other rank's write: one backing block
+    assert out[0] == 4.0
+
+
+def test_cart_excluded_ranks_get_null(cluster):
+    def f(comm, out):
+        cart = comm.cart_create([2, 2], [False, False])
+        out[comm.rank()] = cart is None
+    out = run(cluster, 8, f)
+    for r in range(4):
+        assert out[r] is False
+    for r in range(4, 8):
+        assert out[r] is True
+
+
+def test_cart_sub_parent_ranks(cluster):
+    """Cart_sub neighbor queries translate to parent-comm ranks: the
+    column sub-grid of rank 5 = coords (2,1) on a [4,2] grid shifts to
+    ranks (1,1)=3 and (3,1)=7."""
+    def f(comm, out):
+        cart = comm.cart_create([4, 2], [True, True])
+        sub = cart.sub([True, False])
+        out[comm.rank()] = (sub.my_coords(), sub.shift(0, 1))
+    out = run(cluster, 8, f)
+    assert out[5] == ([2], (3, 7))
+    assert out[0] == ([0], (6, 2))
+
+
+def test_sample_flops_extrapolation(cluster):
+    def f(comm, out):
+        for running in smpi.sample("fk", 10, flops_per_iter=1e8,
+                                   threshold=2):
+            if running:
+                s4u.this_actor.execute(1e8)
+        out["t"] = smpi.wtime()
+    out = run(cluster, 1, f)
+    # 2 sampled + 8 extrapolated as compute: 10 x 0.1s at 1Gf
+    assert out["t"] == pytest.approx(1.0, rel=0.02)
