@@ -1,0 +1,17 @@
+"""SimDag equivalent: DAG scheduling without actors.
+
+Reference: src/simdag/ — typed tasks (sequential computation,
+end-to-end communication) with dependencies, scheduled onto hosts and
+executed directly as kernel-model actions (the reference's SimDag layer
+has no actors either: SD_simulate drives surf directly,
+sd_global.cpp). Includes the Pegasus DAX workflow loader
+(sd_daxloader.cpp) with the same conventions: runtimes scaled by the
+assumed 4.2 GFlops reference machine, per-file transfer tasks named
+parent_file_child, synthetic root/end tasks.
+"""
+
+from .task import Task, TaskKind, TaskState
+from .engine import DagEngine
+from .dax import load_dax
+
+__all__ = ["Task", "TaskKind", "TaskState", "DagEngine", "load_dax"]

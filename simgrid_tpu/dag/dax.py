@@ -1,0 +1,139 @@
+"""Pegasus DAX workflow loader (reference sd_daxloader.cpp).
+
+Jobs become sequential computation tasks with flops = runtime x 4.2e9
+(the reference assumes timings from a 4.2 GFlops machine,
+sd_daxloader.cpp:252). Every file becomes one end-to-end transfer task
+per (producer, consumer) pair, named parent_file_child (:210
+uniq_transfer_task_name); files no job produces come from the synthetic
+`root` task, files no job consumes feed the synthetic `end` task
+(:164-183). The result is verified acyclic."""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+from typing import Dict, List
+
+from ..exceptions import ParseError
+from ..utils import log as _log
+from .task import Task, TaskKind, TaskState
+
+_logger = _log.get_category("sd_daxparse")
+
+#: flops per unit of DAX "runtime" (sd_daxloader.cpp:252)
+RUNTIME_SCALE = 4_200_000_000.0
+
+
+def _local(tag: str) -> str:
+    return tag.rsplit("}", 1)[-1]
+
+
+def load_dax(path: str) -> List[Task]:
+    try:
+        tree = ET.parse(path)
+    except ET.ParseError as exc:
+        raise ParseError(f"{path}: {exc}") from None
+
+    root_task = Task.create_comp_seq("root", 0.0)
+    root_task.state = TaskState.SCHEDULABLE
+    end_task = Task.create_comp_seq("end", 0.0)
+
+    jobs: Dict[str, Task] = {}
+    file_sizes: Dict[str, float] = {}
+    producers: Dict[str, List[Task]] = {}
+    consumers: Dict[str, List[Task]] = {}
+    file_io: Dict[int, List[int]] = {}   # id(task) -> [n_in, n_out]
+
+    for job in tree.getroot():
+        if _local(job.tag) != "job":
+            continue
+        job_id = job.get("id")
+        name = f"{job_id}@{job.get('name', '')}"
+        runtime = float(job.get("runtime")) * RUNTIME_SCALE
+        task = Task.create_comp_seq(name, runtime)
+        jobs[job_id] = task
+        file_io[id(task)] = [0, 0]
+        for uses in job:
+            if _local(uses.tag) != "uses":
+                continue
+            fname = uses.get("file")
+            size = float(uses.get("size", 0))
+            if fname in file_sizes and file_sizes[fname] != size:
+                _logger.warning("Ignore file %s size redefinition from %.0f"
+                                " to %.0f", fname, file_sizes[fname], size)
+            else:
+                file_sizes[fname] = size
+            if uses.get("link") == "input":
+                consumers.setdefault(fname, []).append(task)
+                file_io[id(task)][0] += 1
+            else:
+                producers.setdefault(fname, []).append(task)
+                file_io[id(task)][1] += 1
+
+    # <child ref><parent ref/></child>: control dependencies.
+    for child in tree.getroot():
+        if _local(child.tag) != "child":
+            continue
+        child_task = jobs[child.get("ref")]
+        for parent in child:
+            if _local(parent.tag) == "parent":
+                child_task.depends_on(jobs[parent.get("ref")])
+
+    # Files: one transfer task per (producer, consumer) pair; files
+    # nobody produces come from root, files nobody consumes go to end
+    # (sd_daxloader.cpp:164-200).
+    transfers: List[Task] = []
+
+    def add_transfer(producer: Task, fname: str, consumer: Task) -> None:
+        transfer = Task.create_comm_e2e(
+            f"{producer.name}_{fname}_{consumer.name}", file_sizes[fname])
+        transfer.depends_on(producer)
+        consumer.depends_on(transfer)
+        transfers.append(transfer)
+
+    for fname in file_sizes:
+        prods = producers.get(fname, [])
+        cons = consumers.get(fname, [])
+        if not prods:
+            for consumer in cons:
+                add_transfer(root_task, fname, consumer)
+        if not cons:
+            for producer in prods:
+                add_transfer(producer, fname, end_task)
+        for producer in prods:
+            for consumer in cons:
+                if producer is consumer:
+                    _logger.warning(
+                        "File %s is produced and consumed by task %s. "
+                        "This loop dependency will prevent the execution "
+                        "of the task.", fname, producer.name)
+                add_transfer(producer, fname, consumer)
+
+    # Jobs touching no files hook directly to root/end
+    # (sd_daxloader.cpp:216-222).
+    for task in jobs.values():
+        n_in, n_out = file_io[id(task)]
+        if n_in == 0:
+            task.depends_on(root_task)
+        if n_out == 0:
+            end_task.depends_on(task)
+
+    tasks = [root_task] + list(jobs.values()) + transfers + [end_task]
+    _check_acyclic(tasks)
+    return tasks
+
+
+def _check_acyclic(tasks: List[Task]) -> None:
+    """Kahn's algorithm over the built DAG (acyclic_graph_detail)."""
+    indeg = {id(t): len(t.predecessors) for t in tasks}
+    queue = [t for t in tasks if indeg[id(t)] == 0]
+    seen = 0
+    while queue:
+        task = queue.pop()
+        seen += 1
+        for succ in task.successors:
+            indeg[id(succ)] -= 1
+            if indeg[id(succ)] == 0:
+                queue.append(succ)
+    if seen != len(tasks):
+        raise ParseError("The loaded DAX workflow is not a DAG "
+                         "(cycle detected)")

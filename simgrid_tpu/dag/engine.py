@@ -1,0 +1,123 @@
+"""The DAG simulation loop (reference SD_simulate, sd_global.cpp):
+start every runnable scheduled task as a kernel-model action, advance
+surf time, and on completion release the dependents — no actors
+involved."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..utils import log as _log
+from .task import Task, TaskKind, TaskState
+
+_logger = _log.get_category("sd")
+
+
+class DagEngine:
+    """Drives a set of DAG tasks over an s4u Engine's platform."""
+
+    def __init__(self, engine):
+        self.engine = engine.pimpl if hasattr(engine, "pimpl") else engine
+        self.tasks: List[Task] = []
+        self._running: Dict[int, Task] = {}
+
+    def add(self, *tasks: Task) -> None:
+        self.tasks.extend(tasks)
+
+    # -- execution ---------------------------------------------------------
+    def _start(self, task: Task) -> None:
+        e = self.engine
+        task.state = TaskState.RUNNING
+        task.start_time = e.now
+        if task.kind == TaskKind.COMM_E2E:
+            src, dst = task.hosts
+            action = e.network_model.communicate(src, dst,
+                                                 task.bytes_amount, -1.0)
+        elif task.kind == TaskKind.COMP_PAR_AMDAHL:
+            # One execution per host; the task completes when all do.
+            # Modeled as the max share on one action per host; for
+            # simplicity the amounts are equal, so one representative
+            # action per host tracked jointly.
+            actions = [host.cpu.execution_start(fl, 1)
+                       for host, fl in zip(task.hosts,
+                                           task.flops_amounts)]
+            task._action = actions
+            for a in actions:
+                self._running[id(a)] = task
+            return
+        else:
+            host = task.hosts[0]
+            action = host.cpu.execution_start(task.flops_amounts[0], 1)
+        task._action = action
+        self._running[id(action)] = task
+
+    def _collect_finished(self) -> List[Task]:
+        done = []
+        for model in self.engine.models:
+            action = model.extract_done_action()
+            while action is not None:
+                task = self._running.pop(id(action), None)
+                if task is not None:
+                    if isinstance(task._action, list):
+                        task._action.remove(action)
+                        if not task._action:
+                            done.append(task)
+                    else:
+                        done.append(task)
+                # No actor holds a reference: release the LMM variable
+                # now or the dead action keeps consuming its resource's
+                # share forever.
+                action.unref()
+                action = model.extract_done_action()
+            action = model.extract_failed_action()
+            while action is not None:
+                task = self._running.pop(id(action), None)
+                if task is not None:
+                    task.state = TaskState.FAILED
+                action.unref()
+                action = model.extract_failed_action()
+        return done
+
+    def simulate(self, until: float = -1.0) -> List[Task]:
+        """SD_simulate: run until every scheduled task completed (or
+        `until`); returns the tasks completed during the call."""
+        e = self.engine
+        completed: List[Task] = []
+
+        def launch_ready():
+            started = 0
+            for task in self.tasks:
+                if task.state == TaskState.SCHEDULED and task.is_ready():
+                    task.state = TaskState.RUNNABLE
+                if task.state == TaskState.RUNNABLE:
+                    self._start(task)
+                    started += 1
+            return started
+
+        launch_ready()
+        while self._running:
+            delta = e.surf_solve(until if until > 0 else -1.0)
+            if delta < 0:
+                break
+            for task in self._collect_finished():
+                task.state = TaskState.DONE
+                task.finish_time = e.now
+                completed.append(task)
+                _logger.debug("Task '%s' done at %f", task.name, e.now)
+            launch_ready()
+            if until > 0 and e.now >= until:
+                break
+        return completed
+
+    @property
+    def clock(self) -> float:
+        return self.engine.now
+
+    # -- introspection -----------------------------------------------------
+    def schedulable_tasks(self) -> List[Task]:
+        return [t for t in self.tasks
+                if t.state == TaskState.NOT_SCHEDULED and t.is_ready()]
+
+    def makespan(self) -> float:
+        return max((t.finish_time for t in self.tasks
+                    if t.state == TaskState.DONE), default=0.0)

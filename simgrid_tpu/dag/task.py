@@ -1,0 +1,101 @@
+"""DAG tasks (reference src/simdag/sd_task.cpp)."""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import List, Optional
+
+
+class TaskKind(Enum):
+    NOT_TYPED = 0
+    COMP_SEQ = 1        # sequential computation (flops)
+    COMM_E2E = 2        # end-to-end transfer (bytes)
+    COMP_PAR_AMDAHL = 3  # parallel computation with serial fraction
+
+
+class TaskState(Enum):
+    NOT_SCHEDULED = 0
+    SCHEDULABLE = 1     # dependencies satisfied, awaiting scheduling
+    SCHEDULED = 2
+    RUNNABLE = 3        # scheduled + dependencies satisfied
+    RUNNING = 4
+    DONE = 5
+    FAILED = 6
+
+
+class Task:
+    """A node of the DAG (SD_task_t)."""
+
+    def __init__(self, name: str, amount: float,
+                 kind: TaskKind = TaskKind.NOT_TYPED, data=None):
+        self.name = name
+        self.amount = amount
+        self.kind = kind
+        self.data = data
+        self.state = TaskState.NOT_SCHEDULED
+        self.predecessors: List["Task"] = []
+        self.successors: List["Task"] = []
+        self.hosts: List = []
+        self.flops_amounts: List[float] = []
+        self.bytes_amount: float = 0.0
+        self.alpha = 0.0              # Amdahl serial fraction
+        self.start_time = -1.0
+        self.finish_time = -1.0
+        self._unsatisfied = 0
+        self._action = None
+
+    # -- constructors (simgrid/simdag.h:104-107) --------------------------
+    @staticmethod
+    def create_comp_seq(name: str, amount: float, data=None) -> "Task":
+        return Task(name, amount, TaskKind.COMP_SEQ, data)
+
+    @staticmethod
+    def create_comm_e2e(name: str, amount: float, data=None) -> "Task":
+        return Task(name, amount, TaskKind.COMM_E2E, data)
+
+    @staticmethod
+    def create_comp_par_amdahl(name: str, amount: float, alpha: float,
+                               data=None) -> "Task":
+        task = Task(name, amount, TaskKind.COMP_PAR_AMDAHL, data)
+        task.alpha = alpha
+        return task
+
+    # -- dependencies (sd_task.cpp SD_task_dependency_add) ---------------
+    def depends_on(self, other: "Task") -> None:
+        """other -> self ordering."""
+        assert self not in other.successors, \
+            f"Dependency {other.name} -> {self.name} already exists"
+        other.successors.append(self)
+        self.predecessors.append(other)
+
+    @staticmethod
+    def dependency_add(src: "Task", dst: "Task") -> None:
+        dst.depends_on(src)
+
+    # -- scheduling (SD_task_schedule / schedulev) ------------------------
+    def schedule(self, hosts, flops_amounts=None,
+                 bytes_amount: Optional[float] = None) -> None:
+        assert self.state in (TaskState.NOT_SCHEDULED,
+                              TaskState.SCHEDULABLE), \
+            f"Task {self.name} cannot be scheduled in state {self.state}"
+        self.hosts = list(hosts)
+        if self.kind == TaskKind.COMP_SEQ:
+            assert len(self.hosts) == 1
+            self.flops_amounts = list(flops_amounts) if flops_amounts \
+                else [self.amount]
+        elif self.kind == TaskKind.COMM_E2E:
+            assert len(self.hosts) == 2
+            self.bytes_amount = bytes_amount if bytes_amount is not None \
+                else self.amount
+        elif self.kind == TaskKind.COMP_PAR_AMDAHL:
+            n = len(self.hosts)
+            share = self.amount * (self.alpha + (1 - self.alpha) / n)
+            self.flops_amounts = [share] * n
+        self.state = TaskState.SCHEDULED
+
+    def is_ready(self) -> bool:
+        return all(p.state == TaskState.DONE for p in self.predecessors)
+
+    def __repr__(self):
+        return (f"<Task {self.name} {self.kind.name} {self.state.name} "
+                f"amount={self.amount:g}>")

@@ -9,10 +9,21 @@ from __future__ import annotations
 
 from typing import Callable, List, Optional
 
-from .coll import (TAG_ALLGATHER, TAG_ALLREDUCE, TAG_ALLTOALL, TAG_BARRIER,
-                   TAG_BCAST, TAG_GATHER, TAG_REDUCE, TAG_SCATTER)
 from .op import MPI_SUM, Op
 from .request import Request
+
+# NBC tags live in their own reserved range so an outstanding
+# I-collective never cross-matches a concurrent *blocking* collective
+# on the same communicator (the reference keeps separate system tags
+# for its nbc implementations too).
+TAG_IBARRIER = -111
+TAG_IBCAST = -110
+TAG_IREDUCE = -112
+TAG_IALLREDUCE = -113
+TAG_IALLTOALL = -114
+TAG_IGATHER = -115
+TAG_IALLGATHER = -116
+TAG_ISCATTER = -117
 
 
 class NbcRequest:
@@ -53,16 +64,16 @@ def ibarrier(comm) -> NbcRequest:
     if size == 1:
         return NbcRequest([], [])
     if rank == 0:
-        recvs = [comm.irecv(src, TAG_BARRIER) for src in range(1, size)]
+        recvs = [comm.irecv(src, TAG_IBARRIER) for src in range(1, size)]
 
         def finish(_):
-            reqs = [comm.isend(b"", dst, TAG_BARRIER)
+            reqs = [comm.isend(b"", dst, TAG_IBARRIER)
                     for dst in range(1, size)]
             for r in reqs:
                 r.wait()
         return NbcRequest([], recvs, finish)
-    send = comm.isend(b"", 0, TAG_BARRIER)
-    recv = comm.irecv(0, TAG_BARRIER)
+    send = comm.isend(b"", 0, TAG_IBARRIER)
+    recv = comm.irecv(0, TAG_IBARRIER)
     return NbcRequest([send], [recv], lambda _: None)
 
 
@@ -72,10 +83,10 @@ def ibcast(comm, obj, root: int = 0) -> NbcRequest:
     if size == 1:
         return NbcRequest([], [], lambda _: obj)
     if rank == root:
-        sends = [comm.isend(obj, dst, TAG_BCAST)
+        sends = [comm.isend(obj, dst, TAG_IBCAST)
                  for dst in range(size) if dst != root]
         return NbcRequest(sends, [], lambda _: obj)
-    recv = comm.irecv(root, TAG_BCAST)
+    recv = comm.irecv(root, TAG_IBCAST)
     return NbcRequest([], [recv], lambda data: data[0])
 
 
@@ -85,10 +96,10 @@ def ireduce(comm, sendobj, op: Op = MPI_SUM, root: int = 0) -> NbcRequest:
     if size == 1:
         return NbcRequest([], [], lambda _: sendobj)
     if rank != root:
-        return NbcRequest([comm.isend(sendobj, root, TAG_REDUCE)], [],
+        return NbcRequest([comm.isend(sendobj, root, TAG_IREDUCE)], [],
                           lambda _: None)
     others = [src for src in range(size) if src != root]
-    recvs = [comm.irecv(src, TAG_REDUCE) for src in others]
+    recvs = [comm.irecv(src, TAG_IREDUCE) for src in others]
 
     def finish(data):
         parts = [None] * size
@@ -109,8 +120,8 @@ def iallreduce(comm, sendobj, op: Op = MPI_SUM) -> NbcRequest:
     if size == 1:
         return NbcRequest([], [], lambda _: sendobj)
     others = [r for r in range(size) if r != rank]
-    sends = [comm.isend(sendobj, dst, TAG_ALLREDUCE) for dst in others]
-    recvs = [comm.irecv(src, TAG_ALLREDUCE) for src in others]
+    sends = [comm.isend(sendobj, dst, TAG_IALLREDUCE) for dst in others]
+    recvs = [comm.irecv(src, TAG_IALLREDUCE) for src in others]
 
     def finish(data):
         parts = [None] * size
@@ -127,10 +138,10 @@ def iallreduce(comm, sendobj, op: Op = MPI_SUM) -> NbcRequest:
 def igather(comm, sendobj, root: int = 0) -> NbcRequest:
     rank, size = comm.rank(), comm.size()
     if rank != root:
-        return NbcRequest([comm.isend(sendobj, root, TAG_GATHER)], [],
+        return NbcRequest([comm.isend(sendobj, root, TAG_IGATHER)], [],
                           lambda _: None)
     others = [src for src in range(size) if src != root]
-    recvs = [comm.irecv(src, TAG_GATHER) for src in others]
+    recvs = [comm.irecv(src, TAG_IGATHER) for src in others]
 
     def finish(data):
         parts = [None] * size
@@ -144,18 +155,18 @@ def igather(comm, sendobj, root: int = 0) -> NbcRequest:
 def iscatter(comm, sendobjs, root: int = 0) -> NbcRequest:
     rank, size = comm.rank(), comm.size()
     if rank == root:
-        sends = [comm.isend(sendobjs[dst], dst, TAG_SCATTER)
+        sends = [comm.isend(sendobjs[dst], dst, TAG_ISCATTER)
                  for dst in range(size) if dst != root]
         return NbcRequest(sends, [], lambda _: sendobjs[root])
-    recv = comm.irecv(root, TAG_SCATTER)
+    recv = comm.irecv(root, TAG_ISCATTER)
     return NbcRequest([], [recv], lambda data: data[0])
 
 
 def iallgather(comm, sendobj) -> NbcRequest:
     rank, size = comm.rank(), comm.size()
     others = [r for r in range(size) if r != rank]
-    sends = [comm.isend(sendobj, dst, TAG_ALLGATHER) for dst in others]
-    recvs = [comm.irecv(src, TAG_ALLGATHER) for src in others]
+    sends = [comm.isend(sendobj, dst, TAG_IALLGATHER) for dst in others]
+    recvs = [comm.irecv(src, TAG_IALLGATHER) for src in others]
 
     def finish(data):
         parts = [None] * size
@@ -169,9 +180,9 @@ def iallgather(comm, sendobj) -> NbcRequest:
 def ialltoall(comm, sendobjs) -> NbcRequest:
     rank, size = comm.rank(), comm.size()
     others = [r for r in range(size) if r != rank]
-    sends = [comm.isend(sendobjs[dst], dst, TAG_ALLTOALL)
+    sends = [comm.isend(sendobjs[dst], dst, TAG_IALLTOALL)
              for dst in others]
-    recvs = [comm.irecv(src, TAG_ALLTOALL) for src in others]
+    recvs = [comm.irecv(src, TAG_IALLTOALL) for src in others]
 
     def finish(data):
         parts = [None] * size

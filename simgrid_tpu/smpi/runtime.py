@@ -206,18 +206,23 @@ def sample(key, iters: int, flops_per_iter=None, threshold: int = 3,
     state = _samples.get(state_key)
     if state is None:
         state = _samples[state_key] = _SampleState()
-    for _ in range(iters):
+    charged_rest = False
+    for it in range(iters):
         if state.count < threshold:
             t0 = Engine.get_clock()
             yield True                      # caller runs the real body
             state.count += 1
             state.sum += Engine.get_clock() - t0
         else:
-            # skip the body, inject the extrapolated cost
-            if flops_per_iter is not None:
-                this_actor.execute(flops_per_iter)
-            elif state.mean() > 0:
-                this_actor.sleep_for(state.mean())
+            # Charge ALL remaining iterations in one kernel event (the
+            # point of SMPI_SAMPLE: O(1) events for the skipped tail).
+            if not charged_rest:
+                remaining = iters - it
+                if flops_per_iter is not None:
+                    this_actor.execute(flops_per_iter * remaining)
+                elif state.mean() > 0:
+                    this_actor.sleep_for(state.mean() * remaining)
+                charged_rest = True
             yield False
 
 

@@ -113,6 +113,9 @@ class SubCartTopology:
     def shift(self, direction: int, disp: int) -> Tuple[int, int]:
         """(source, dest) as PARENT communicator ranks."""
         coords = self.my_coords()
+        if not coords:
+            # Every dimension dropped: a 1-node grid has no neighbors.
+            return MPI_PROC_NULL, MPI_PROC_NULL
 
         def neighbor(offset: int) -> int:
             c = list(coords)

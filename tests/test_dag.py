@@ -1,0 +1,179 @@
+"""SimDag-equivalent tests: task graph semantics, SD_simulate over the
+kernel models, the DAX loader on the reference's own example workflows
+(examples/deprecated/simdag/daxload/), and a greedy list-scheduling
+run producing a deterministic makespan."""
+
+import os
+
+import pytest
+
+from simgrid_tpu import dag, s4u
+from simgrid_tpu.dag import Task, TaskKind, TaskState
+from simgrid_tpu.exceptions import ParseError
+
+SMALLDAX = ("/root/reference/examples/deprecated/simdag/daxload/"
+            "smalldax.xml")
+CYCLEDAX = ("/root/reference/examples/deprecated/simdag/daxload/"
+            "simple_dax_with_cycle.xml")
+
+needs_reference = pytest.mark.skipif(
+    not os.path.exists(SMALLDAX), reason="reference files unavailable")
+
+XML = """<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <host id="h0" speed="1Gf"/>
+    <host id="h1" speed="2Gf"/>
+    <link id="l" bandwidth="125MBps" latency="1ms"/>
+    <route src="h0" dst="h1"><link_ctn id="l"/></route>
+  </zone>
+</platform>"""
+
+
+@pytest.fixture(autouse=True)
+def fresh_engine():
+    s4u.Engine._reset()
+    yield
+    s4u.Engine._reset()
+
+
+@pytest.fixture
+def engine(tmp_path):
+    path = os.path.join(tmp_path, "p.xml")
+    with open(path, "w") as f:
+        f.write(XML)
+    e = s4u.Engine(["t"])
+    e.load_platform(path)
+    return e
+
+
+def test_diamond_dag_execution(engine):
+    """root -> (a, b) -> join: the join starts only after both parents
+    and the simulated times follow host speeds + transfer costs."""
+    h0, h1 = engine.host_by_name("h0"), engine.host_by_name("h1")
+    root = Task.create_comp_seq("root", 1e9)          # 1s on h0
+    a = Task.create_comp_seq("a", 2e9)                # 2s on h0
+    b = Task.create_comp_seq("b", 2e9)                # 1s on h1
+    xfer = Task.create_comm_e2e("root->b", 125e6)     # ~1s on the link
+    join = Task.create_comp_seq("join", 1e9)
+    a.depends_on(root)
+    xfer.depends_on(root)
+    b.depends_on(xfer)
+    join.depends_on(a)
+    join.depends_on(b)
+
+    root.schedule([h0])
+    a.schedule([h0])
+    b.schedule([h1])
+    xfer.schedule([h0, h1])
+    join.schedule([h1])
+
+    sd = dag.DagEngine(engine)
+    sd.add(root, a, b, xfer, join)
+    done = sd.simulate()
+    assert len(done) == 5
+    assert root.finish_time == pytest.approx(1.0)
+    assert a.finish_time == pytest.approx(3.0)
+    # transfer starts at 1.0, ~1s + latency; b (1s on h1) after it
+    assert b.finish_time > 2.9
+    assert join.start_time >= max(a.finish_time, b.finish_time)
+    assert sd.makespan() == join.finish_time
+
+
+def test_dependency_blocks_execution(engine):
+    h0 = engine.host_by_name("h0")
+    first = Task.create_comp_seq("first", 1e9)
+    second = Task.create_comp_seq("second", 1e9)
+    second.depends_on(first)
+    second.schedule([h0])
+    sd = dag.DagEngine(engine)
+    sd.add(first, second)
+    # first is never scheduled: nothing can run to completion
+    done = sd.simulate()
+    assert second.state != TaskState.DONE
+    assert not done or all(t.name != "second" for t in done)
+
+
+def test_amdahl_parallel_task(engine):
+    h0, h1 = engine.host_by_name("h0"), engine.host_by_name("h1")
+    par = Task.create_comp_par_amdahl("par", 2e9, alpha=0.5)
+    par.schedule([h0, h1])
+    sd = dag.DagEngine(engine)
+    sd.add(par)
+    sd.simulate()
+    assert par.state == TaskState.DONE
+    # share per host = 2e9 * (0.5 + 0.25) = 1.5e9 -> 1.5s on h0 (slower)
+    assert par.finish_time == pytest.approx(1.5)
+
+
+@needs_reference
+def test_dax_loader_structure():
+    tasks = dag.load_dax(SMALLDAX)
+    names = {t.name for t in tasks}
+    # 3 jobs + root + end + 5 file transfers (i1,i2 from root; o1,o2
+    # between jobs; o3 to end)
+    assert len(tasks) == 10
+    assert {"root", "end", "1@task1", "2@task2", "3@task1"} <= names
+    assert "root_i1_1@task1" in names
+    assert "1@task1_o1_3@task1" in names
+    assert "3@task1_o3_end" in names
+    job1 = next(t for t in tasks if t.name == "1@task1")
+    # runtime 10 x 4.2e9 (sd_daxloader.cpp:252)
+    assert job1.amount == pytest.approx(42000000000.0)
+    # dependency chain: 1@task1 -> o1 transfer -> 3@task1
+    o1 = next(t for t in tasks if t.name == "1@task1_o1_3@task1")
+    assert o1.predecessors == [job1]
+    assert o1.successors[0].name == "3@task1"
+
+
+@needs_reference
+def test_dax_cycle_detection():
+    with pytest.raises(ParseError, match="cycle"):
+        dag.load_dax(CYCLEDAX)
+
+
+@needs_reference
+def test_dax_end_to_end_schedule_and_run(engine):
+    """Load the reference workflow, greedy-schedule it round-robin,
+    simulate, check a deterministic makespan with all tasks done."""
+    tasks = dag.load_dax(SMALLDAX)
+    hosts = engine.get_all_hosts()
+    sd = dag.DagEngine(engine)
+    sd.add(*tasks)
+    i = 0
+    for t in tasks:
+        if t.kind == TaskKind.COMP_SEQ:
+            t.schedule([hosts[i % len(hosts)]])
+            i += 1
+    for t in tasks:
+        if t.kind == TaskKind.COMM_E2E:
+            src = t.predecessors[0].hosts[0]
+            dst = t.successors[0].hosts[0]
+            t.schedule([src, dst])
+    done = sd.simulate()
+    assert len(done) == len(tasks)
+    assert all(t.state == TaskState.DONE for t in tasks)
+    makespan = sd.makespan()
+    assert makespan > 10.0          # three 10s-class jobs, partly serial
+    # determinism
+    s4u.Engine._reset()
+    path = "/tmp/dag_determinism_p2.xml"
+    with open(path, "w") as f:
+        f.write(XML)
+    e2 = s4u.Engine(["t"])
+    e2.load_platform(path)
+    tasks2 = dag.load_dax(SMALLDAX)
+    hosts2 = e2.get_all_hosts()
+    sd2 = dag.DagEngine(e2)
+    sd2.add(*tasks2)
+    i = 0
+    for t in tasks2:
+        if t.kind == TaskKind.COMP_SEQ:
+            t.schedule([hosts2[i % len(hosts2)]])
+            i += 1
+    for t in tasks2:
+        if t.kind == TaskKind.COMM_E2E:
+            t.schedule([t.predecessors[0].hosts[0],
+                        t.successors[0].hosts[0]])
+    sd2.simulate()
+    assert sd2.makespan() == makespan

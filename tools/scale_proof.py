@@ -8,7 +8,7 @@ count, not actor count, is the scaling axis under test: route
 resolution over the dragonfly topology, LMM system construction, the
 vectorized solve, and a few time advances.
 
-Usage: python tools/scale_proof.py [--hosts 65536] [--flows 100000]
+Usage: python tools/scale_proof.py [--flows 100000]
            [--backend jax] [--out SCALE_PROOF.md]
 """
 
@@ -42,7 +42,6 @@ def build_platform(path: str, n_hosts: int) -> str:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--hosts", type=int, default=65536)
     ap.add_argument("--flows", type=int, default=100_000)
     ap.add_argument("--backend", default="jax")
     ap.add_argument("--layout", default="auto")
@@ -55,7 +54,6 @@ def main() -> None:
     import numpy as np
 
     from simgrid_tpu import s4u
-    from simgrid_tpu.utils.config import config
 
     lines = []
 
@@ -64,7 +62,7 @@ def main() -> None:
         lines.append(msg)
 
     t0 = time.perf_counter()
-    platform = build_platform("/tmp/dragonfly65k.xml", args.hosts)
+    platform = build_platform("/tmp/dragonfly65k.xml", 65536)
     e = s4u.Engine(["scale", f"--cfg=lmm/backend:{args.backend}",
                     f"--cfg=lmm/layout:{args.layout}",
                     "--cfg=network/maxmin-selective-update:no",
