@@ -1,0 +1,23 @@
+"""Golden-output example: the reference replay.tesh allreduce oracle."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from simgrid_tpu.smpi import replay
+
+TRACE = "/tmp/example_ar3.txt"
+with open(TRACE, "w") as f:
+    for r in range(3):
+        f.write(f"{r} init\n")
+    for r in range(3):
+        f.write(f"{r} allreduce 5e4 5e8\n")
+    for r in range(3):
+        f.write(f"{r} compute 5e8\n")
+    for r in range(3):
+        f.write(f"{r} finalize\n")
+
+e = replay.smpi_replay_run(
+    "/root/reference/examples/platforms/small_platform.xml", TRACE, 3,
+    configs=["tracing:no", "surf/precision:1e-9", "network/model:SMPI"])
+print(f"clock {e.clock:.6f}")

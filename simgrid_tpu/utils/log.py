@@ -39,19 +39,109 @@ context_getter: Optional[Callable[[], str]] = None
 _categories: Dict[str, "Category"] = {}
 
 
+class Appender:
+    """Where rendered log lines go (xbt_log_appender_file.cpp): a
+    standard stream (resolved at write time so redirection and pytest
+    capture keep working), a file, or a size-rolling file."""
+
+    def __init__(self, stream_name: Optional[str] = None,
+                 path: Optional[str] = None, roll_bytes: int = 0):
+        self._stream_name = stream_name    # "stderr" | "stdout" | None
+        self._path = path
+        self._roll = roll_bytes
+        self._written = 0
+        self._file = open(path, "w") if path is not None else None
+
+    def _stream(self):
+        if self._stream_name is not None:
+            return getattr(sys, self._stream_name)
+        return self._file
+
+    def write(self, line: str) -> None:
+        nbytes = len(line.encode("utf-8", errors="replace"))
+        if self._roll and self._written + nbytes > self._roll:
+            # rolling appender: restart the file (append_file.cpp roll)
+            self._file.close()
+            self._file = open(self._path, "w")
+            self._written = 0
+        stream = self._stream()
+        stream.write(line)
+        stream.flush()
+        self._written += nbytes
+
+
+_stderr_appender = Appender(stream_name="stderr")
+
+
+def render_layout(fmt: str, category: str, level_name: str,
+                  msg: str) -> str:
+    """The %-pattern layout language (xbt_log_layout_format.cpp):
+    %r simulated clock (width.precision honored), %c category,
+    %p priority, %m message, %n newline, %e space, %a actor context,
+    %% literal percent. Unknown specifiers render verbatim."""
+    out = []
+    i = 0
+    while i < len(fmt):
+        ch = fmt[i]
+        if ch != "%":
+            out.append(ch)
+            i += 1
+            continue
+        # parse optional width[.precision]
+        j = i + 1
+        spec = ""
+        while j < len(fmt) and (fmt[j].isdigit() or fmt[j] in ".-"):
+            spec += fmt[j]
+            j += 1
+        code = fmt[j] if j < len(fmt) else "%"
+        if code == "r":
+            clock = clock_getter() if clock_getter else 0.0
+            try:
+                out.append(f"%{spec}f" % clock if spec else f"{clock:.6f}")
+            except (ValueError, TypeError):
+                # malformed width spec: render verbatim as documented
+                out.append("%" + spec + code)
+        elif code == "c":
+            out.append(category)
+        elif code == "p":
+            out.append(level_name)
+        elif code == "m":
+            out.append(msg)
+        elif code == "n":
+            out.append("\n")
+        elif code == "e":
+            out.append(" ")
+        elif code == "a":
+            out.append(context_getter() if context_getter else "")
+        elif code == "%":
+            out.append("%")
+        else:
+            out.append("%" + spec + code)
+        i = j + 1
+    return "".join(out)
+
+
 class Category:
     def __init__(self, name: str, parent: Optional["Category"]):
         self.name = name
         self.parent = parent
         self.threshold: Optional[int] = None  # None = inherit
+        self.layout: Optional[str] = None     # None = inherit/default
+        self.appender: Optional[Appender] = None
+        self.additional: list = []            # 'add' appenders
 
     def effective_threshold(self) -> int:
+        value = self._effective("threshold")
+        return INFO if value is None else value
+
+    def _effective(self, attr):
         cat: Optional[Category] = self
         while cat is not None:
-            if cat.threshold is not None:
-                return cat.threshold
+            value = getattr(cat, attr)
+            if value is not None:
+                return value
             cat = cat.parent
-        return INFO
+        return None
 
     def is_enabled(self, level: int) -> bool:
         return level >= self.effective_threshold()
@@ -61,14 +151,27 @@ class Category:
             return
         if args:
             msg = msg % args
-        parts = []
-        if context_getter is not None:
-            parts.append(context_getter())
-        if clock_getter is not None:
-            parts.append(f"{clock_getter():.6f}")
-        prefix = f"[{' '.join(parts)}] " if parts else ""
         lvl = _LEVEL_NAMES.get(level, str(level))
-        sys.stderr.write(f"{prefix}[{self.name}/{lvl}] {msg}\n")
+        fmt = self._effective("layout")
+        if fmt is not None:
+            line = render_layout(fmt, self.name, lvl, msg)
+            if not line.endswith("\n"):
+                line += "\n"
+        else:
+            parts = []
+            if context_getter is not None:
+                parts.append(context_getter())
+            if clock_getter is not None:
+                parts.append(f"{clock_getter():.6f}")
+            prefix = f"[{' '.join(parts)}] " if parts else ""
+            line = f"{prefix}[{self.name}/{lvl}] {msg}\n"
+        appender = self._effective("appender") or _stderr_appender
+        appender.write(line)
+        cat: Optional[Category] = self
+        while cat is not None:
+            for extra in cat.additional:
+                extra.write(line)
+            cat = cat.parent
 
     def trace(self, msg, *a): self._emit(TRACE, msg, *a)
     def debug(self, msg, *a): self._emit(DEBUG, msg, *a)
@@ -96,8 +199,24 @@ def new_category(name: str, description: str = "") -> Category:
     return get_category(name)
 
 
+def _make_appender(spec: str) -> Appender:
+    """'file:PATH', 'rollfile:SIZE:PATH', or 'stderr'/'stdout'
+    (xbt_log_appender_file.cpp appender syntax)."""
+    if spec in ("stderr", "stdout"):
+        return Appender(stream_name=spec)
+    if spec.startswith("file:"):
+        return Appender(path=spec[len("file:"):])
+    if spec.startswith("rollfile:"):
+        _, size, path = spec.split(":", 2)
+        return Appender(path=path, roll_bytes=int(size))
+    raise ValueError(f"Unknown appender spec {spec!r}")
+
+
 def apply_control(control: str) -> None:
-    """Apply a ``cat.thresh:level`` (space-separated list) log control.
+    """Apply ``cat.setting:value`` (space-separated list) log controls:
+    thresholds (``cat.thresh:debug``), layouts (``cat.fmt:%m%n``),
+    appenders (``cat.app:file:PATH``) and additional appenders
+    (``cat.add:file:PATH``).
 
     Like the reference (log.cpp _xbt_log_parse_setting), any prefix of
     ``threshold`` of length >= 2 is accepted (``th``, ``thres``, ...);
@@ -108,10 +227,18 @@ def apply_control(control: str) -> None:
                              f"'category.setting:value'")
         key, value = token.split(":", 1)
         cat_name, _, setting = key.rpartition(".")
-        if (not cat_name or len(setting) < 2
-                or not "threshold".startswith(setting)):
-            if setting in ("fmt", "app", "add"):  # layout/appender controls
-                continue  # accepted but not implemented: formats are fixed
+        if not cat_name:
+            raise ValueError(f"Unknown log setting {setting!r} in {token!r}")
+        if setting == "fmt":
+            get_category(cat_name).layout = value
+            continue
+        if setting == "app":
+            get_category(cat_name).appender = _make_appender(value)
+            continue
+        if setting == "add":
+            get_category(cat_name).additional.append(_make_appender(value))
+            continue
+        if len(setting) < 2 or not "threshold".startswith(setting):
             raise ValueError(f"Unknown log setting {setting!r} in {token!r}")
         level = _LEVELS.get(value.lower())
         if level is None:

@@ -1,0 +1,204 @@
+"""XBT extras + tools: log appenders/layouts, RngStream, the tesh
+golden-output runner, graphicator (reference: xbt_log_layout_format.cpp,
+xbt_log_appender_file.cpp, src/xbt/RngStream.c, tools/tesh/tesh.py,
+tools/graphicator/)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+from simgrid_tpu.utils.rngstream import RngStream
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def fresh_engine():
+    s4u.Engine._reset()
+    yield
+    s4u.Engine._reset()
+
+
+# ---------------------------------------------------------------------------
+# Log layouts + appenders
+# ---------------------------------------------------------------------------
+
+def test_log_layout_format(tmp_path):
+    out = os.path.join(tmp_path, "log.txt")
+    cat = xlog.get_category("layout_test")
+    # %e is the space (log controls are space-separated, so layouts
+    # spell spaces as %e — same convention as the reference's
+    # --log=root.fmt:[%10.6r]%e(%i:%P@%h)%e%m%n).
+    xlog.apply_control(f"layout_test.fmt:[%10.6r]%e(%c/%p)%e%m%n "
+                       f"layout_test.app:file:{out}")
+    old_clock = xlog.clock_getter
+    xlog.clock_getter = lambda: 1.5
+    try:
+        cat.info("hello %s", "world")
+    finally:
+        xlog.clock_getter = old_clock
+        cat.layout = None
+        cat.appender = None
+    assert open(out).read() == "[  1.500000] (layout_test/INFO) hello world\n"
+
+
+def test_log_additional_appender(tmp_path):
+    out = os.path.join(tmp_path, "extra.txt")
+    cat = xlog.get_category("add_test")
+    xlog.apply_control(f"add_test.add:file:{out}")
+    try:
+        cat.info("captured")
+    finally:
+        cat.additional.clear()
+    assert "captured" in open(out).read()
+
+
+def test_log_rolling_appender(tmp_path):
+    out = os.path.join(tmp_path, "roll.txt")
+    cat = xlog.get_category("roll_test")
+    xlog.apply_control(f"roll_test.fmt:%m%n roll_test.app:rollfile:64:{out}")
+    try:
+        for i in range(20):
+            cat.info("line-%04d" % i)
+    finally:
+        cat.layout = None
+        cat.appender = None
+    content = open(out).read()
+    assert len(content) <= 64
+    assert "line-0019" in content    # latest lines survive the roll
+
+
+# ---------------------------------------------------------------------------
+# RngStream
+# ---------------------------------------------------------------------------
+
+def test_rngstream_known_value():
+    """The canonical first draw of MRG32k3a from the all-12345 seed
+    (published in L'Ecuyer's paper and every implementation)."""
+    RngStream.set_package_seed([12345] * 6)
+    g = RngStream("g1")
+    assert g.rand_u01() == pytest.approx(0.127011122046059, abs=1e-12)
+
+
+def test_rngstream_streams_differ_and_reset():
+    RngStream.set_package_seed([12345] * 6)
+    g1 = RngStream("g1")
+    g2 = RngStream("g2")
+    seq1 = [g1.rand_u01() for _ in range(5)]
+    seq2 = [g2.rand_u01() for _ in range(5)]
+    assert seq1 != seq2            # 2^127 apart
+    g1.reset_start_stream()
+    assert [g1.rand_u01() for _ in range(5)] == seq1
+
+
+def test_rngstream_substreams():
+    RngStream.set_package_seed([12345] * 6)
+    g = RngStream("g")
+    first = [g.rand_u01() for _ in range(3)]
+    g.reset_next_substream()
+    second = [g.rand_u01() for _ in range(3)]
+    assert first != second
+    g.reset_start_substream()
+    assert [g.rand_u01() for _ in range(3)] == second
+    ints = [g.rand_int(1, 6) for _ in range(20)]
+    assert all(1 <= v <= 6 for v in ints)
+
+
+# ---------------------------------------------------------------------------
+# tesh runner
+# ---------------------------------------------------------------------------
+
+def run_tesh_file(tmp_path, content, extra_args=()):
+    path = os.path.join(tmp_path, "t.tesh")
+    with open(path, "w") as f:
+        f.write(content)
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tesh.py"), path,
+         *extra_args], capture_output=True, text=True)
+
+
+def test_tesh_pass(tmp_path):
+    res = run_tesh_file(tmp_path, """\
+p A passing test
+$ printf 'one\\ntwo\\n'
+> one
+> two
+""")
+    assert res.returncode == 0, res.stderr
+
+
+def test_tesh_mismatch_fails(tmp_path):
+    res = run_tesh_file(tmp_path, """\
+$ echo actual
+> expected
+""")
+    assert res.returncode == 1
+    assert "Output mismatch" in res.stderr
+
+
+def test_tesh_sort_return_stdin_env(tmp_path):
+    res = run_tesh_file(tmp_path, """\
+! output sort
+$ printf 'b\\na\\n'
+> a
+> b
+! expect return 3
+$ sh -c 'exit 3'
+< hello
+$ cat
+> hello
+! setenv GREETING=hi
+$ sh -c 'echo $GREETING'
+> hi
+$ echo ${myvar:=fallback}
+> fallback
+""")
+    assert res.returncode == 0, res.stderr
+
+
+def test_tesh_variable_substitution(tmp_path):
+    res = run_tesh_file(tmp_path, """\
+$ echo ${bindir}/prog
+> /opt/bin/prog
+""", extra_args=["--cfg", "bindir=/opt/bin"])
+    assert res.returncode == 0, res.stderr
+
+
+def test_tesh_timeout(tmp_path):
+    res = run_tesh_file(tmp_path, """\
+! timeout 1
+$ sleep 5
+""")
+    assert res.returncode == 1
+    assert "timed out" in res.stderr
+
+
+# ---------------------------------------------------------------------------
+# graphicator
+# ---------------------------------------------------------------------------
+
+def test_graphicator(tmp_path):
+    platform = os.path.join(tmp_path, "p.xml")
+    with open(platform, "w") as f:
+        f.write("""<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <host id="h0" speed="1Gf"/>
+    <host id="h1" speed="1Gf"/>
+    <link id="l" bandwidth="1GBps" latency="1ms"/>
+    <route src="h0" dst="h1"><link_ctn id="l"/></route>
+  </zone>
+</platform>""")
+    out = os.path.join(tmp_path, "g.dot")
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "graphicator.py"),
+         platform, out], capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+    dot = open(out).read()
+    assert '"h0" [shape=box];' in dot
+    assert '"h0" -- "l";' in dot
+    assert '"l" -- "h1";' in dot

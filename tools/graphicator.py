@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""graphicator: dump a platform's routing graph as Graphviz dot
+(reference tools/graphicator/graphicator.cpp).
+
+Usage: python tools/graphicator.py platform.xml out.dot
+Hosts are boxes, routers are points, links are edges labeled with
+bandwidth; every host-pair route contributes its edges once."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def graphicator(platform: str, out_path: str) -> None:
+    from simgrid_tpu import s4u
+    from simgrid_tpu.routing.zone import NetPointType
+
+    e = s4u.Engine(["graphicator"])
+    e.load_platform(platform)
+    engine = e.pimpl
+
+    lines = ["graph platform {", "  overlap=scale;"]
+    for netpoint in engine.netpoints.values():
+        if netpoint.kind == NetPointType.HOST:
+            lines.append(f'  "{netpoint.name}" [shape=box];')
+        elif netpoint.kind == NetPointType.ROUTER:
+            lines.append(f'  "{netpoint.name}" [shape=point];')
+
+    # Edge per link: endpoint resolution via every host-pair route.
+    edges = set()
+    hosts = list(engine.hosts.values())
+    for i, src in enumerate(hosts):
+        for dst in hosts[i + 1:]:
+            route = []
+            try:
+                src.route_to(dst, route)
+            except AssertionError:
+                continue
+            prev = src.name
+            for link in route:
+                edge = (prev, link.name)
+                if edge not in edges:
+                    edges.add(edge)
+                prev = link.name
+            edge = (prev, dst.name)
+            edges.add(edge)
+    for link in engine.links.values():
+        lines.append(f'  "{link.name}" [shape=ellipse, '
+                     f'label="{link.name}\\n{link.get_bandwidth():.3g}bps"];')
+    for a, b in sorted(edges):
+        lines.append(f'  "{a}" -- "{b}";')
+    lines.append("}")
+    with open(out_path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"{out_path}: {len(engine.hosts)} hosts, "
+          f"{len(engine.links)} links, {len(edges)} edges")
+
+
+if __name__ == "__main__":
+    graphicator(sys.argv[1], sys.argv[2])

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""tesh: the golden-output testing shell (reference tools/tesh/tesh.py).
+
+Runs the commands of a ``.tesh`` file and diffs every stdout line
+against the ``>``-prefixed expectations. Supported syntax:
+
+    $ cmd                run cmd, diff its output
+    & cmd                run cmd in background (not diffed)
+    > line               expected output line of the preceding command
+    < line               stdin line fed to the next command
+    ! timeout N          per-command timeout in seconds
+    ! expect return N    expected exit code of the next command
+    ! output sort        sort actual+expected output before diffing
+    ! output ignore      discard the next command's output
+    ! setenv K=V         environment for subsequent commands
+    p message            progress message
+    # comment
+
+Variable substitution: ``${name:=default}`` and ``${name}`` from the
+environment (bindir/srcdir settable via --cfg bindir=... srcdir=...).
+Exit code 0 when every command matched."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+from typing import List, Optional
+
+
+class Cmd:
+    def __init__(self):
+        self.args: Optional[str] = None
+        self.input: List[str] = []
+        self.expected: List[str] = []
+        self.timeout: Optional[float] = None
+        self.expect_return = 0
+        self.sort_output = False
+        self.ignore_output = False
+        self.background = False
+
+
+def _substitute(line: str, env: dict) -> str:
+    def repl(m):
+        name, default = m.group(1), m.group(2)
+        return env.get(name, default if default is not None else "")
+    return re.sub(r"\$\{(\w+)(?::=([^}]*))?\}", repl, line)
+
+
+def run_cmd(cmd: Cmd, env: dict, verbose: bool) -> bool:
+    args = _substitute(cmd.args, env)
+    if verbose:
+        print(f"[tesh] $ {args}", file=sys.stderr)
+    try:
+        proc = subprocess.run(
+            args, shell=True, text=True, capture_output=True,
+            input="\n".join(cmd.input) + ("\n" if cmd.input else ""),
+            timeout=cmd.timeout, env={**os.environ, **env})
+    except subprocess.TimeoutExpired:
+        print(f"Test suite timed out: {args}", file=sys.stderr)
+        return False
+    if proc.returncode != cmd.expect_return:
+        print(f"Command returned {proc.returncode}, expected "
+              f"{cmd.expect_return}: {args}", file=sys.stderr)
+        sys.stderr.write(proc.stdout + proc.stderr)
+        return False
+    if cmd.ignore_output:
+        return True
+    actual = [l for l in proc.stdout.splitlines()]
+    expected = list(cmd.expected)
+    if cmd.sort_output:
+        actual, expected = sorted(actual), sorted(expected)
+    if actual != expected:
+        print(f"Output mismatch for: {args}", file=sys.stderr)
+        import difflib
+        for line in difflib.unified_diff(expected, actual,
+                                         "expected", "actual",
+                                         lineterm=""):
+            print(line, file=sys.stderr)
+        return False
+    return True
+
+
+def run_tesh(path: str, env: dict, verbose: bool = False) -> bool:
+    cmds: List[Cmd] = []
+    current = Cmd()
+    pending_input: List[str] = []
+
+    with open(path) as f:
+        for raw in f:
+            line = raw.rstrip("\n")
+            if not line or line.startswith("#"):
+                continue
+            tag, rest = line[:1], line[2:] if len(line) > 2 else ""
+            if tag == "$" or tag == "&":
+                if current.args is not None:
+                    cmds.append(current)
+                    current = Cmd()
+                current.args = line[1:].strip()
+                current.background = tag == "&"
+                current.input = pending_input
+                pending_input = []
+            elif tag == ">":
+                current.expected.append(rest)
+            elif tag == "<":
+                pending_input.append(rest)
+            elif tag == "!":
+                # Directives configure the NEXT command: close the
+                # previous one first.
+                if current.args is not None:
+                    cmds.append(current)
+                    current = Cmd()
+                directive = line[1:].strip()
+                if directive.startswith("timeout"):
+                    current.timeout = float(directive.split()[1])
+                elif directive.startswith("expect return"):
+                    current.expect_return = int(directive.split()[2])
+                elif directive == "output sort":
+                    current.sort_output = True
+                elif directive == "output ignore":
+                    current.ignore_output = True
+                elif directive.startswith("setenv"):
+                    key, _, value = directive[len("setenv"):].strip() \
+                        .partition("=")
+                    env[key] = _substitute(value, env)
+                else:
+                    print(f"[tesh] unknown directive: {directive}",
+                          file=sys.stderr)
+            elif tag == "p":
+                print(f"[tesh] {line[1:].strip()}", file=sys.stderr)
+    if current.args is not None:
+        cmds.append(current)
+
+    ok = True
+    for cmd in cmds:
+        if cmd.background:
+            subprocess.Popen(_substitute(cmd.args, env), shell=True)
+            continue
+        if not run_cmd(cmd, env, verbose):
+            ok = False
+            break
+    return ok
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("tesh_file")
+    ap.add_argument("--cfg", action="append", default=[],
+                    help="variable definitions name=value")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+    env = dict(os.environ)
+    for cfg in args.cfg:
+        key, _, value = cfg.partition("=")
+        env[key] = value
+    ok = run_tesh(args.tesh_file, env, args.verbose)
+    print("[tesh] " + ("OK" if ok else "FAILED"), file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
