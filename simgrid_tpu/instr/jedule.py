@@ -1,0 +1,34 @@
+"""Jedule output: DAG schedule visualization XML (reference
+src/instr/jedule/): platform topology + one event per completed task
+with its host set and start/end times, loadable by the Jedule
+visualizer."""
+
+from __future__ import annotations
+
+from xml.sax.saxutils import quoteattr
+
+
+def dump_jedule(dag_engine, path: str) -> None:
+    """Write the schedule of a completed DagEngine run
+    (jedule_sd_binding.cpp jedule_sd_dump)."""
+    engine = dag_engine.engine
+    lines = ['<?xml version="1.0"?>', "<jedule>", "  <jedule_meta>",
+             '    <prop key="description" value="simgrid_tpu jedule"/>',
+             "  </jedule_meta>", "  <platform>",
+             '    <container name="root">']
+    for host in engine.hosts.values():
+        lines.append(f'      <resource name={quoteattr(host.name)} '
+                     f'type="host"/>')
+    lines += ["    </container>", "  </platform>", "  <events>"]
+    for task in dag_engine.tasks:
+        if task.finish_time < 0:
+            continue
+        hosts = " ".join(h.name for h in task.hosts)
+        lines.append(
+            f'    <event name={quoteattr(task.name)} '
+            f'start="{task.start_time:.9f}" end="{task.finish_time:.9f}" '
+            f'resources={quoteattr(hosts)} '
+            f'type="{task.kind.name.lower()}"/>')
+    lines += ["  </events>", "</jedule>"]
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")

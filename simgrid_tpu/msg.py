@@ -1,0 +1,97 @@
+"""MSG legacy API: the task-oriented C interface as a thin shim over
+s4u (reference src/msg/msg_legacy.cpp does exactly this over its own
+s4u). Kept for parity with the reference's migration-era API surface;
+new code should use s4u directly."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from . import s4u
+
+OK = 0
+TASK_CANCELED = 1
+TRANSFER_FAILURE = 2
+HOST_FAILURE = 3
+TIMEOUT = 4
+
+
+class Task:
+    """m_task_t: computation + payload container (msg_task.cpp)."""
+
+    def __init__(self, name: str, flops_amount: float = 0.0,
+                 bytes_amount: float = 0.0, data: Any = None):
+        self.name = name
+        self.flops_amount = flops_amount
+        self.bytes_amount = bytes_amount
+        self.data = data
+        self.sender: Optional[s4u.Actor] = None
+
+
+def task_create(name: str, flops: float, nbytes: float,
+                data: Any = None) -> Task:
+    return Task(name, flops, nbytes, data)
+
+
+def task_execute(task: Task) -> int:
+    """MSG_task_execute."""
+    s4u.this_actor.execute(task.flops_amount)
+    return OK
+
+
+def task_send(task: Task, mailbox: str) -> int:
+    """MSG_task_send: payload is the Task itself."""
+    s4u.Mailbox.by_name(mailbox).put(task, task.bytes_amount)
+    return OK
+
+
+def task_receive(mailbox: str, timeout: float = -1.0) -> Task:
+    """MSG_task_receive (raises TimeoutException past `timeout`)."""
+    return s4u.Mailbox.by_name(mailbox).get(timeout=timeout)
+
+
+def task_isend(task: Task, mailbox: str):
+    return s4u.Mailbox.by_name(mailbox).put_async(task,
+                                                  task.bytes_amount)
+
+
+def process_create(name: str, code, host, *args) -> s4u.Actor:
+    """MSG_process_create."""
+    if isinstance(host, str):
+        host = s4u.Engine.get_instance().host_by_name(host)
+    return s4u.Actor.create(name, host, code, *args)
+
+
+def process_sleep(duration: float) -> int:
+    s4u.this_actor.sleep_for(duration)
+    return OK
+
+
+def process_kill(actor: s4u.Actor) -> None:
+    actor.kill()
+
+
+def get_clock() -> float:
+    return s4u.Engine.get_clock()
+
+
+def get_host_number() -> int:
+    return s4u.Engine.get_instance().get_host_count()
+
+
+def hosts() -> List:
+    return s4u.Engine.get_instance().get_all_hosts()
+
+
+def host_by_name(name: str):
+    return s4u.Engine.get_instance().host_by_name(name)
+
+
+def create_environment(platform: str) -> None:
+    """MSG_create_environment."""
+    s4u.Engine.get_instance().load_platform(platform)
+
+
+def main() -> None:
+    """MSG_main."""
+    s4u.Engine.get_instance().run()

@@ -1,0 +1,75 @@
+"""host_dvfs plugin: pstate governors (reference src/plugins/
+host_dvfs.cpp): a per-host daemon samples the load every
+``plugin/dvfs/sampling-rate`` simulated seconds and drives the pstate
+like the Linux cpufreq governors — performance (fastest), powersave
+(slowest), ondemand (jump to fastest above the up-threshold, else
+proportional), conservative (step one pstate at a time)."""
+
+from __future__ import annotations
+
+from ..utils.config import config, declare_flag
+from . import host_load
+
+declare_flag("plugin/dvfs/sampling-rate",
+             "Sampling rate of the DVFS governors (seconds)", 0.1)
+declare_flag("plugin/dvfs/governor",
+             "Default DVFS governor "
+             "(performance|powersave|ondemand|conservative)",
+             "performance")
+
+
+def _governor_step(host, governor: str, up_threshold: float = 0.8) -> None:
+    """One sampling decision (host_dvfs.cpp update())."""
+    n = host.get_pstate_count()
+    if n <= 1:
+        return
+    if governor == "performance":
+        target = 0
+    elif governor == "powersave":
+        target = n - 1
+    else:
+        load = host_load.get_current_load(host)
+        current = host.get_pstate()
+        if governor == "ondemand":
+            # above the threshold: full speed; below: the slowest
+            # pstate that still covers the demand (host_dvfs.cpp
+            # OnDemand::update).
+            if load > up_threshold:
+                target = 0
+            else:
+                target = min(n - 1, int((1 - load) * n))
+        else:   # conservative: one step at a time
+            if load > up_threshold:
+                target = max(0, current - 1)
+            elif load < up_threshold / 2:
+                target = min(n - 1, current + 1)
+            else:
+                target = current
+    if target != host.get_pstate():
+        host.set_pstate(target)
+
+
+def host_dvfs_plugin_init(engine=None) -> None:
+    """sg_host_dvfs_plugin_init: spawn one governor daemon per host
+    whose properties (or the global flag) request one."""
+    from ..s4u import Actor, this_actor
+    from ._base import resolve_engine
+
+    impl = resolve_engine(engine)
+    host_load.host_load_plugin_init(impl)
+    rate = config["plugin/dvfs/sampling-rate"]
+
+    for host in list(impl.hosts.values()):
+        governor = host.properties.get("plugin/dvfs/governor",
+                                       config["plugin/dvfs/governor"])
+        if governor == "performance" and \
+                "plugin/dvfs/governor" not in host.properties:
+            continue    # no daemon needed for the default no-op case
+
+        def daemon(host=host, governor=governor):
+            while True:
+                this_actor.sleep_for(rate)
+                _governor_step(host, governor)
+
+        Actor.create(f"dvfs-daemon-{host.name}", host,
+                     daemon).daemonize()

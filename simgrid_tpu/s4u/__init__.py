@@ -21,6 +21,8 @@ from .engine import Engine, get_clock
 from .mailbox import Mailbox
 from .synchro import Barrier, ConditionVariable, Mutex, Semaphore
 
+from ..plugins.vm import VirtualMachine  # noqa: E402  (s4u::VirtualMachine)
+
 __all__ = ["Engine", "Actor", "this_actor", "Host", "Link", "Mailbox",
            "Comm", "Exec", "Io", "Activity", "Mutex", "ConditionVariable",
-           "Semaphore", "Barrier", "get_clock"]
+           "Semaphore", "Barrier", "get_clock", "VirtualMachine"]

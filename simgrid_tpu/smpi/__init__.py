@@ -20,7 +20,8 @@ from .group import Group
 from .comm import Comm
 from .request import (Request, MPI_ANY_SOURCE, MPI_ANY_TAG, Status,
                       MPI_REQUEST_NULL)
-from .runtime import (smpirun, smpi_main, this_rank, COMM_WORLD,
+from .runtime import (smpirun, smpirun_multi, smpi_main,
+                      smpi_instance_register, this_rank, COMM_WORLD,
                       smpi_execute, smpi_execute_flops, wtime,
                       sample, shared_malloc, shared_free)
 from .nbc import (NbcRequest, iallgather, iallreduce, ialltoall, ibarrier,
@@ -36,7 +37,8 @@ __all__ = [
     "MPI_BAND", "MPI_BOR", "MPI_BXOR", "MPI_MAXLOC", "MPI_MINLOC",
     "Group", "Comm", "Request", "Status", "MPI_ANY_SOURCE", "MPI_ANY_TAG",
     "MPI_REQUEST_NULL",
-    "smpirun", "smpi_main", "this_rank", "COMM_WORLD", "smpi_execute",
+    "smpirun", "smpirun_multi", "smpi_main", "smpi_instance_register",
+    "this_rank", "COMM_WORLD", "smpi_execute",
     "smpi_execute_flops", "wtime", "sample", "shared_malloc", "shared_free",
     "NbcRequest", "ibarrier", "ibcast", "ireduce", "iallreduce", "igather",
     "iscatter", "iallgather", "ialltoall",

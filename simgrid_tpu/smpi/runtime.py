@@ -96,20 +96,22 @@ class HostFactors:
 
 class _RankState:
     __slots__ = ("world_rank", "actor_impl", "host", "mailbox",
-                 "mailbox_small", "host_factors")
+                 "mailbox_small", "host_factors", "instance", "world")
 
     def __init__(self, world_rank, actor_impl, host, mailbox, mailbox_small,
-                 host_factors):
+                 host_factors, instance="main", world=None):
         self.world_rank = world_rank
         self.actor_impl = actor_impl
         self.host = host
         self.mailbox = mailbox
         self.mailbox_small = mailbox_small
         self.host_factors = host_factors
+        self.instance = instance    # multi-instance/AMPI job name
+        self.world = world          # this instance's MPI_COMM_WORLD
 
 
 _registry: Dict[int, _RankState] = {}
-_by_world_rank: Dict[int, _RankState] = {}
+_by_world_rank: Dict[tuple, _RankState] = {}
 _world = None
 
 
@@ -125,10 +127,20 @@ def this_rank() -> int:
 
 
 def state_of_world_rank(rank: int) -> _RankState:
-    return _by_world_rank[rank]
+    """Resolve within the calling actor's instance (each MPI job of a
+    multi-instance simulation has its own rank space,
+    smpi_deployment.cpp)."""
+    instance = this_rank_state().instance
+    return _by_world_rank[(instance, rank)]
 
 
 def world():
+    """The calling rank's MPI_COMM_WORLD (instance-local); outside a
+    rank actor, the last deployment's world (post-run inspection)."""
+    from ..s4u.actor import _current_impl
+    state = _registry.get(id(_current_impl()))
+    if state is not None and state.world is not None:
+        return state.world
     assert _world is not None, "SMPI world not initialized (use smpirun)"
     return _world
 
@@ -256,23 +268,19 @@ def clear_process_data() -> None:
     _shared_blocks.clear()
 
 
-def smpi_main(fn, engine, hosts: Optional[Sequence] = None,
-              np: Optional[int] = None, args: tuple = ()) -> None:
-    """Register one actor per rank on an existing engine (reference
-    smpi_global.cpp:612-650 deployment phase)."""
-    global _world
+def smpi_instance_register(engine, fn, hosts: Sequence,
+                           np: Optional[int] = None, args: tuple = (),
+                           instance: str = "main") -> None:
+    """Deploy one MPI job (SMPI_app_instance_register +
+    smpi_deployment.cpp): its own COMM_WORLD, rank space and mailbox
+    namespace, so several MPI applications share one simulation."""
     from ..s4u import Actor, Mailbox
     from .comm import Comm
     from .group import Group
 
-    all_hosts = hosts if hosts is not None else engine.get_all_hosts()
-    assert all_hosts, "platform has no hosts"
-    n = np if np is not None else len(all_hosts)
-
-    _registry.clear()
-    _by_world_rank.clear()
-    clear_process_data()
-    _world = Comm(Group(list(range(n))))
+    assert hosts, "platform has no hosts"
+    n = np if np is not None else len(hosts)
+    world = Comm(Group(list(range(n))), id=("world", instance))
 
     def rank_main():
         from .. import instr
@@ -285,29 +293,158 @@ def smpi_main(fn, engine, hosts: Optional[Sequence] = None,
 
     # Register every rank's state before any actor runs: rank 0's first
     # send must be able to resolve rank N's mailboxes.
+    prefix = "" if instance == "main" else f"{instance}-"
     for rank in range(n):
-        host = all_hosts[rank % len(all_hosts)]
-        actor = Actor.create(f"rank-{rank}", host, rank_main)
-        state = _RankState(rank, actor.pimpl, host,
-                           Mailbox.by_name(f"SMPI-{rank}").pimpl,
-                           Mailbox.by_name(f"SMPI-SMALL-{rank}").pimpl,
-                           HostFactors(host))
+        host = hosts[rank % len(hosts)]
+        actor = Actor.create(f"{prefix}rank-{rank}", host, rank_main)
+        state = _RankState(
+            rank, actor.pimpl, host,
+            Mailbox.by_name(f"SMPI-{prefix}{rank}").pimpl,
+            Mailbox.by_name(f"SMPI-SMALL-{prefix}{rank}").pimpl,
+            HostFactors(host), instance=instance, world=world)
         _registry[id(actor.pimpl)] = state
-        _by_world_rank[rank] = state
+        _by_world_rank[(instance, rank)] = state
 
 
-def smpirun(fn, platform: str, np: Optional[int] = None,
+def smpi_main(fn, engine, hosts: Optional[Sequence] = None,
+              np: Optional[int] = None, args: tuple = ()) -> None:
+    """Register one actor per rank on an existing engine (reference
+    smpi_global.cpp:612-650 deployment phase)."""
+    global _world
+    all_hosts = hosts if hosts is not None else engine.get_all_hosts()
+    _registry.clear()
+    _by_world_rank.clear()
+    clear_process_data()
+    smpi_instance_register(engine, fn, all_hosts, np=np, args=args)
+    _world = _by_world_rank[("main", 0)].world
+
+
+#: smpirun default fabric (smpirun.in:13-18)
+_FABRIC_LOOPBACK_BW = "498000000Bps"
+_FABRIC_LOOPBACK_LAT = "0.000004s"
+_FABRIC_NETWORK_BW = f"{26 * 1024 * 1024}Bps"
+_FABRIC_NETWORK_LAT = "0.000005s"
+_FABRIC_SPEED = "100Mf"
+
+
+def fabricate_platform(n_hosts: int, path: str,
+                       names: Optional[Sequence[str]] = None) -> str:
+    """Generate the smpirun default fabric (smpirun.in:371-406): per
+    host a loopback link and a private uplink; route i->j rides
+    link_i + link_j. ``names`` overrides the default host1..hostN
+    naming (hostfile-driven fabrication)."""
+    if names is None:
+        names = [f"host{i}" for i in range(1, n_hosts + 1)]
+    assert len(names) == n_hosts
+    lines = ["<?xml version='1.0'?>", '<platform version="4.1">',
+             '<zone id="AS0" routing="Full">']
+    for i, name in enumerate(names, start=1):
+        lines.append(f'  <host id="{name}" speed="{_FABRIC_SPEED}"/>')
+        lines.append(f'  <link id="loop{i}" '
+                     f'bandwidth="{_FABRIC_LOOPBACK_BW}" '
+                     f'latency="{_FABRIC_LOOPBACK_LAT}"/>')
+        lines.append(f'  <link id="link{i}" '
+                     f'bandwidth="{_FABRIC_NETWORK_BW}" '
+                     f'latency="{_FABRIC_NETWORK_LAT}"/>')
+    for i, src in enumerate(names, start=1):
+        for j, dst in enumerate(names, start=1):
+            if i == j:
+                lines.append(f'  <route src="{src}" dst="{dst}" '
+                             f'symmetrical="NO">'
+                             f'<link_ctn id="loop{i}"/></route>')
+            else:
+                lines.append(f'  <route src="{src}" dst="{dst}" '
+                             f'symmetrical="NO">'
+                             f'<link_ctn id="link{i}"/>'
+                             f'<link_ctn id="link{j}"/></route>')
+    lines += ["</zone>", "</platform>"]
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return path
+
+
+def parse_hostfile(path: str) -> List[str]:
+    """Hostnames, honoring 'name:count' multiplicity (smpirun.in
+    hostfile unrolling)."""
+    out: List[str] = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            name, _, count = line.partition(":")
+            out.extend([name] * (int(count) if count else 1))
+    return out
+
+
+def smpirun(fn, platform: Optional[str] = None, np: Optional[int] = None,
             hosts: Optional[Sequence[str]] = None,
+            hostfile: Optional[str] = None,
             configs: Sequence[str] = (), args: tuple = ()):
     """smpirun equivalent (src/smpi/smpirun.in): build the engine, load
-    the platform, deploy `np` ranks of `fn` round-robin over the hosts,
-    run the simulation.  Returns the Engine (inspect .clock)."""
+    (or fabricate) the platform, deploy `np` ranks of `fn` round-robin
+    over the hosts, run the simulation. Returns the Engine (inspect
+    .clock). Without a platform, the default fabric is generated for
+    `np` hosts (smpirun.in:371-406); a hostfile selects/duplicates
+    hosts like `-hostfile` (including name:count lines)."""
+    import os
+    import tempfile
+
+    from ..s4u import Engine
+
+    if hostfile is not None:
+        assert hosts is None, "pass either hosts or hostfile"
+        hosts = parse_hostfile(hostfile)
+        if np is None:
+            np = len(hosts)
+    tmp_platform = None
+    if platform is None:
+        if hosts:
+            # Fabricate a host per distinct hostfile name (rank
+            # multiplicity maps several ranks per host).
+            names = list(dict.fromkeys(hosts))
+        else:
+            n = np if np is not None else 4
+            names = [f"host{i}" for i in range(1, n + 1)]
+            hosts = list(names)
+        fd, tmp_platform = tempfile.mkstemp(suffix=".xml",
+                                            prefix="smpitmp-plat")
+        os.close(fd)
+        platform = fabricate_platform(len(names), tmp_platform, names)
+
+    try:
+        e = Engine(["smpirun"] + [f"--cfg={c}" for c in configs])
+        e.load_platform(platform)
+        host_objs = ([e.host_by_name(h) for h in hosts] if hosts
+                     else e.get_all_hosts())
+        smpi_main(fn, e, hosts=host_objs, np=np, args=args)
+        e.run()
+        return e
+    finally:
+        if tmp_platform is not None:
+            os.unlink(tmp_platform)   # the reference removes its temps too
+
+
+def smpirun_multi(instances, platform: str, configs: Sequence[str] = ()):
+    """Run several MPI jobs in one simulation (the reference's
+    multi-instance mode, examples/smpi/replay_multiple):
+    ``instances`` is a list of (name, fn, np[, hosts]) tuples, each
+    getting its own COMM_WORLD and rank namespace."""
     from ..s4u import Engine
 
     e = Engine(["smpirun"] + [f"--cfg={c}" for c in configs])
     e.load_platform(platform)
-    host_objs = ([e.host_by_name(h) for h in hosts] if hosts
-                 else e.get_all_hosts())
-    smpi_main(fn, e, hosts=host_objs, np=np, args=args)
+    _registry.clear()
+    _by_world_rank.clear()
+    clear_process_data()
+    all_hosts = e.get_all_hosts()
+    offset = 0
+    for spec in instances:
+        name, fn, n = spec[0], spec[1], spec[2]
+        hosts = ([e.host_by_name(h) for h in spec[3]] if len(spec) > 3
+                 else [all_hosts[(offset + i) % len(all_hosts)]
+                       for i in range(n)])
+        smpi_instance_register(e, fn, hosts, np=n, instance=name)
+        offset += n
     e.run()
     return e

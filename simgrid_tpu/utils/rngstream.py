@@ -103,10 +103,9 @@ class RngStream:
         s[0], s[1], s[2] = s[1], s[2], p1
         p2 = (_A21 * s[5] - _A23N * s[3]) % _M2
         s[3], s[4], s[5] = s[4], s[5], p2
-        u = p1 - p2
-        if u < 0:
-            u += _M1
-        return (u + 1.0) * _NORM if u == 0 else u * _NORM
+        # RngStream.c U01: (p1 > p2) ? (p1-p2)*norm : (p1-p2+m1)*norm —
+        # equality maps to ~1-eps, not ~0.
+        return (p1 - p2) * _NORM if p1 > p2 else (p1 - p2 + _M1) * _NORM
 
     def rand_int(self, low: int, high: int) -> int:
         return low + int(self.rand_u01() * (high - low + 1))

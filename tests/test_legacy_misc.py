@@ -1,0 +1,196 @@
+"""Coverage-closing tests: smpirun platform fabrication + hostfiles,
+SMPI multi-instance, the MSG legacy shim, s4u.VirtualMachine export,
+host_dvfs governors, Jedule output (reference: smpirun.in:371-406,
+smpi_deployment.cpp, msg_legacy.cpp, host_dvfs.cpp, instr/jedule/)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from simgrid_tpu import dag, msg, s4u, smpi
+from simgrid_tpu.instr.jedule import dump_jedule
+from simgrid_tpu.plugins import host_dvfs
+
+
+@pytest.fixture(autouse=True)
+def fresh_engine():
+    s4u.Engine._reset()
+    yield
+    s4u.Engine._reset()
+
+
+def test_smpirun_fabricated_platform():
+    """No platform: smpirun generates the default fabric
+    (smpirun.in:371-406) — 100Mf hosts, per-host links."""
+    out = {}
+
+    def main():
+        comm = smpi.COMM_WORLD
+        if comm.rank() == 0:
+            comm.send(np.ones(1000), 1)
+            out["t"] = smpi.wtime()
+        elif comm.rank() == 1:
+            comm.recv(0)
+        smpi.smpi_execute_flops(1e8)    # 1s at the fabric's 100Mf
+
+    e = smpirun_result = smpi.smpirun(main, np=4, configs=["tracing:no"])
+    assert e.get_host_count() == 4
+    assert e.host_by_name("host1").get_speed() == pytest.approx(100e6)
+    assert e.clock > 1.0                 # the compute happened
+
+
+def test_smpirun_hostfile(tmp_path):
+    hf = os.path.join(tmp_path, "hosts")
+    with open(hf, "w") as f:
+        f.write("host1:2\nhost2\n")
+    ranks = {}
+
+    def main():
+        comm = smpi.COMM_WORLD
+        ranks[comm.rank()] = smpi.runtime.this_rank_state().host.name
+
+    smpi.smpirun(main, hostfile=hf, configs=["tracing:no"])
+    assert ranks == {0: "host1", 1: "host1", 2: "host2"}
+
+
+def test_smpi_multi_instance():
+    """Two MPI jobs share the simulation with separate COMM_WORLDs and
+    rank spaces (multi-instance, smpi_deployment.cpp)."""
+    out = {"a": {}, "b": {}}
+
+    def job(tag):
+        def run():
+            comm = smpi.COMM_WORLD
+            total = comm.allreduce(np.array([float(comm.rank())]))
+            out[tag][comm.rank()] = (comm.size(), float(total[0]))
+        return run
+
+    import simgrid_tpu.smpi.runtime as rt
+    e = s4u.Engine(["t"])
+    # fabricate a 6-host platform for both jobs
+    plat = "/tmp/multi_inst.xml"
+    rt.fabricate_platform(6, plat)
+    e.load_platform(plat)
+    rt._registry.clear()
+    rt._by_world_rank.clear()
+    rt.clear_process_data()
+    hosts = e.get_all_hosts()
+    rt.smpi_instance_register(e, job("a"), hosts[:4], np=4, instance="a")
+    rt.smpi_instance_register(e, job("b"), hosts[4:], np=2, instance="b")
+    e.run()
+    assert out["a"] == {r: (4, 6.0) for r in range(4)}
+    assert out["b"] == {r: (2, 1.0) for r in range(2)}
+
+
+def test_msg_shim(tmp_path):
+    plat = os.path.join(tmp_path, "p.xml")
+    with open(plat, "w") as f:
+        f.write("""<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <host id="h0" speed="1Gf"/>
+    <host id="h1" speed="1Gf"/>
+    <link id="l" bandwidth="125MBps" latency="1ms"/>
+    <route src="h0" dst="h1"><link_ctn id="l"/></route>
+  </zone>
+</platform>""")
+    out = {}
+
+    def worker():
+        task = msg.task_receive("mb")
+        msg.task_execute(task)
+        out["done"] = msg.get_clock()
+        out["data"] = task.data
+
+    def master():
+        task = msg.task_create("job", 1e9, 125e6, data="payload")
+        msg.task_send(task, "mb")
+
+    msg.create_environment(plat)
+    msg.process_create("master", master, "h0")
+    msg.process_create("worker", worker, msg.host_by_name("h1"))
+    msg.main()
+    # ~1s transfer + 1s compute
+    assert out["done"] > 1.9
+    assert out["data"] == "payload"
+
+
+def test_s4u_virtualmachine_export(tmp_path):
+    plat = os.path.join(tmp_path, "p.xml")
+    with open(plat, "w") as f:
+        f.write("""<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <host id="pm" speed="1Gf" core="2"/>
+  </zone>
+</platform>""")
+    e = s4u.Engine(["t"])
+    e.load_platform(plat)
+    vm = s4u.VirtualMachine("vm0", e.host_by_name("pm"), 1).start()
+    done = {}
+
+    def task():
+        s4u.this_actor.execute(1e9)
+        done["t"] = s4u.Engine.get_clock()
+
+    s4u.Actor.create("t", vm, task)
+    e.run()
+    assert done["t"] == pytest.approx(1.0)
+
+
+def test_host_dvfs_powersave(tmp_path):
+    plat = os.path.join(tmp_path, "p.xml")
+    with open(plat, "w") as f:
+        f.write("""<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <host id="h0" speed="1Gf,500Mf,100Mf">
+      <prop id="plugin/dvfs/governor" value="powersave"/>
+    </host>
+  </zone>
+</platform>""")
+    e = s4u.Engine(["t"])
+    e.load_platform(plat)
+    host_dvfs.host_dvfs_plugin_init(e)
+    h0 = e.host_by_name("h0")
+    seen = {}
+
+    def probe():
+        s4u.this_actor.sleep_for(1.0)
+        seen["pstate"] = h0.get_pstate()
+        seen["speed"] = h0.get_speed()
+
+    s4u.Actor.create("p", h0, probe)
+    e.run()
+    assert seen["pstate"] == 2          # powersave pins the slowest
+    assert seen["speed"] == pytest.approx(100e6)
+
+
+def test_jedule_output(tmp_path):
+    plat = os.path.join(tmp_path, "p.xml")
+    with open(plat, "w") as f:
+        f.write("""<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <host id="h0" speed="1Gf"/>
+  </zone>
+</platform>""")
+    e = s4u.Engine(["t"])
+    e.load_platform(plat)
+    t1 = dag.Task.create_comp_seq("t1", 1e9)
+    t2 = dag.Task.create_comp_seq("t2", 1e9)
+    t2.depends_on(t1)
+    h0 = e.host_by_name("h0")
+    t1.schedule([h0])
+    t2.schedule([h0])
+    sd = dag.DagEngine(e)
+    sd.add(t1, t2)
+    sd.simulate()
+    out = os.path.join(tmp_path, "sched.jed")
+    dump_jedule(sd, out)
+    content = open(out).read()
+    assert "<jedule>" in content
+    assert '<event name="t1" start="0.000000000" end="1.000000000"' \
+        in content
+    assert 'resources="h0"' in content

@@ -134,13 +134,26 @@ def run_tesh(path: str, env: dict, verbose: bool = False) -> bool:
         cmds.append(current)
 
     ok = True
-    for cmd in cmds:
-        if cmd.background:
-            subprocess.Popen(_substitute(cmd.args, env), shell=True)
-            continue
-        if not run_cmd(cmd, env, verbose):
-            ok = False
-            break
+    background: List[subprocess.Popen] = []
+    try:
+        for cmd in cmds:
+            if cmd.background:
+                background.append(subprocess.Popen(
+                    _substitute(cmd.args, env), shell=True))
+                continue
+            if not run_cmd(cmd, env, verbose):
+                ok = False
+                break
+    finally:
+        # Background commands die with the file (reference tesh kills
+        # them at end-of-file).
+        for proc in background:
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
     return ok
 
 
