@@ -255,19 +255,28 @@ def smpi_enabled() -> bool:
     return _trace is not None and config["tracing/smpi"]
 
 
-def _rank_container(rank: int) -> Container:
-    return _trace.containers_by_name[f"rank-{rank}"]
+def _rank_name(rank: int, instance: str = "main") -> str:
+    # Multi-instance jobs each restart ranks at 0: the instance name
+    # disambiguates containers (main keeps the reference's bare
+    # "rank-N" so traces stay interchangeable).
+    return f"rank-{rank}" if instance == "main" else \
+        f"{instance}#rank-{rank}"
 
 
-def smpi_init(rank: int, host) -> None:
+def _rank_container(rank: int, instance: str = "main") -> Container:
+    return _trace.containers_by_name[_rank_name(rank, instance)]
+
+
+def smpi_init(rank: int, host, instance: str = "main") -> None:
     """TRACE_smpi_init + setup_container (instr_smpi.cpp:139-168);
     idempotent so arrows can pre-create a peer's container."""
-    if not smpi_enabled() or f"rank-{rank}" in _trace.containers_by_name:
+    name = _rank_name(rank, instance)
+    if not smpi_enabled() or name in _trace.containers_by_name:
         return
     father = _trace.root_container
     if config["tracing/smpi/grouped"]:
         father = _trace.containers_by_name.get(host.name, father)
-    cont = father.child(f"rank-{rank}", "MPI")
+    cont = father.child(name, "MPI")
     st = cont.type.state_type("MPI_STATE")
     if config["tracing/smpi/computing"]:
         st.value("computing", find_color("computing"))
@@ -275,20 +284,20 @@ def smpi_init(rank: int, host) -> None:
     _trace.root_container.type.link_type("MPI_LINK", cont.type, cont.type)
 
 
-def smpi_finalize(rank: int) -> None:
+def smpi_finalize(rank: int, instance: str = "main") -> None:
     if smpi_enabled():
-        _rank_container(rank).remove_from_parent()
+        _rank_container(rank, instance).remove_from_parent()
 
 
 def smpi_in(rank: int, op_name: str, extra: ti.TIData,
-            ti_line: bool = True) -> None:
+            ti_line: bool = True, instance: str = "main") -> None:
     """TRACE_smpi_comm_in: push the MPI call state; in TI mode emit the
     replayable action line instead (instr_paje_events.cpp StateEvent).
     ti_line=False marks calls the TI/replay grammar does not support
     (waitany etc., instr_paje_events.cpp:110 comment)."""
     if not smpi_enabled():
         return
-    cont = _rank_container(rank)
+    cont = _rank_container(rank, instance)
     if _trace.format == TI_FORMAT:
         if ti_line:
             TIEvent(_trace, cont, f"{rank} {extra.print()}")
@@ -300,12 +309,12 @@ def smpi_in(rank: int, op_name: str, extra: ti.TIData,
         ev.tail += f" {extra.display_size()}"
 
 
-def smpi_out(rank: int) -> None:
+def smpi_out(rank: int, instance: str = "main") -> None:
     if not smpi_enabled():
         return
     if _trace.format == TI_FORMAT:
         return
-    cont = _rank_container(rank)
+    cont = _rank_container(rank, instance)
     PajeEvent(_trace, cont, cont.type.state_type("MPI_STATE"),
               PAJE_PopState)
 
@@ -338,29 +347,32 @@ def _pt2pt_key(src: int, dst: int, tag: int, send: int) -> str:
     return key
 
 
-def smpi_send(rank: int, src: int, dst: int, tag: int, size: int) -> None:
+def smpi_send(rank: int, src: int, dst: int, tag: int, size: int,
+              instance: str = "main") -> None:
     """TRACE_smpi_send: StartLink arrow from the sender."""
     if not smpi_enabled() or _trace.format == TI_FORMAT:
         return
-    key = _pt2pt_key(src, dst, tag, send=1)
+    key = _pt2pt_key(f"{instance}.{src}", f"{instance}.{dst}", tag, send=1)
     root = _trace.root_container
     lt = root.type.link_type("MPI_LINK",
-                             _rank_container(src).type,
-                             _rank_container(dst).type)
+                             _rank_container(src, instance).type,
+                             _rank_container(dst, instance).type)
     ev = PajeEvent(_trace, root, lt, PAJE_StartLink,
-                   tail=f"PTP {_rank_container(src).id} {key}")
+                   tail=f"PTP {_rank_container(src, instance).id} {key}")
     if _trace.display_sizes:
         ev.tail += f" {size}"
 
 
-def smpi_recv(rank_src: int, rank_dst: int, tag: int) -> None:
+def smpi_recv(rank_src: int, rank_dst: int, tag: int,
+              instance: str = "main") -> None:
     """TRACE_smpi_recv: EndLink arrow at the receiver."""
     if not smpi_enabled() or _trace.format == TI_FORMAT:
         return
-    key = _pt2pt_key(rank_src, rank_dst, tag, send=0)
+    key = _pt2pt_key(f"{instance}.{rank_src}", f"{instance}.{rank_dst}",
+                     tag, send=0)
     root = _trace.root_container
     lt = root.type.link_type("MPI_LINK",
-                             _rank_container(rank_src).type,
-                             _rank_container(rank_dst).type)
+                             _rank_container(rank_src, instance).type,
+                             _rank_container(rank_dst, instance).type)
     PajeEvent(_trace, root, lt, PAJE_EndLink,
-              tail=f"PTP {_rank_container(rank_dst).id} {key}")
+              tail=f"PTP {_rank_container(rank_dst, instance).id} {key}")

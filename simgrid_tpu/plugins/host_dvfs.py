@@ -28,16 +28,18 @@ def _governor_step(host, governor: str, up_threshold: float = 0.8) -> None:
     elif governor == "powersave":
         target = n - 1
     else:
-        load = host_load.get_current_load(host)
+        # Governors decide on the load averaged over the sampling
+        # interval, then reset it (host_dvfs.cpp update()).
+        load = host_load.get_average_load(host)
+        host_load.reset(host)
         current = host.get_pstate()
         if governor == "ondemand":
-            # above the threshold: full speed; below: the slowest
-            # pstate that still covers the demand (host_dvfs.cpp
-            # OnDemand::update).
+            # host_dvfs.cpp OnDemand::update: above the threshold jump
+            # to full speed, else pstate = max_pstate - load*(max+1).
             if load > up_threshold:
                 target = 0
             else:
-                target = min(n - 1, int((1 - load) * n))
+                target = max(0, min(n - 1, int((n - 1) - load * n)))
         else:   # conservative: one step at a time
             if load > up_threshold:
                 target = max(0, current - 1)

@@ -204,6 +204,43 @@ class Comm:
         with tr.noop_span("scan"):
             return coll.dispatch("scan")(self, sendobj, op)
 
+    # -- v-variants: per-peer payloads naturally carry their own sizes
+    # in the object model, so the same algorithms serve (the reference
+    # needs separate *v entry points only because C buffers cannot).
+    def allgatherv(self, sendobj) -> List:
+        from . import instr_hooks as tr
+        with tr.varcoll_span("allgatherv",
+                             send_size=int(payload_size(sendobj, None)),
+                             recv_size=-1, recvcounts=None):
+            from . import coll
+            return coll.dispatch("allgather")(self, sendobj)
+
+    def alltoallv(self, sendobjs: List) -> List:
+        from . import instr_hooks as tr
+        counts = [int(payload_size(o, None)) for o in sendobjs]
+        with tr.varcoll_span("alltoallv", send_size=sum(counts),
+                             sendcounts=counts, recv_size=-1,
+                             recvcounts=None):
+            from . import coll
+            return coll.dispatch("alltoall")(self, sendobjs)
+
+    def gatherv(self, sendobj, root: int = 0):
+        from . import instr_hooks as tr
+        with tr.varcoll_span("gatherv", root=root,
+                             send_size=int(payload_size(sendobj, None)),
+                             recv_size=-1, recvcounts=None):
+            from . import coll
+            return coll.dispatch("gather")(self, sendobj, root)
+
+    def scatterv(self, sendobjs: Optional[List], root: int = 0):
+        from . import instr_hooks as tr
+        counts = [int(payload_size(o, None)) for o in (sendobjs or [])]
+        with tr.varcoll_span("scatterv", root=root, send_size=-1,
+                             sendcounts=counts or None, recv_size=-1,
+                             recvcounts=None):
+            from . import coll
+            return coll.dispatch("scatter")(self, sendobjs, root)
+
     # -- non-blocking collectives (smpi_nbc_impl.cpp) ----------------------
     def ibarrier(self):
         from . import nbc

@@ -38,6 +38,11 @@ def _rank() -> int:
     return runtime.this_rank()
 
 
+def _instance() -> str:
+    from . import runtime
+    return runtime.this_rank_state().instance
+
+
 class _Span:
     """One traced MPI call: push state (or emit the TI action line) on
     entry, pop on exit. Yields True when this call is visible (top-level
@@ -59,13 +64,13 @@ class _Span:
         self.visible = self.depth == 0 or config["tracing/smpi/internals"]
         if self.visible:
             instr.smpi_in(self.rank, self.op_name, self.extra_factory(),
-                          ti_line=self.ti_line)
+                          ti_line=self.ti_line, instance=_instance())
         return self.visible
 
     def __exit__(self, *exc) -> None:
         _depth[self.rank] = self.depth
         if self.visible:
-            instr.smpi_out(self.rank)
+            instr.smpi_out(self.rank, instance=_instance())
 
 
 def span(op_name: str, extra_factory: Callable[[], ti.TIData],
@@ -146,7 +151,8 @@ def _ensure_rank_container(world_rank: int) -> None:
     """The pt2pt arrow may reference a peer whose actor has not started
     yet (so its own smpi_init has not run); create its container now."""
     from . import runtime
-    instr.smpi_init(world_rank, runtime.state_of_world_rank(world_rank).host)
+    state = runtime.state_of_world_rank(world_rank)
+    instr.smpi_init(world_rank, state.host, instance=state.instance)
 
 
 def send_arrow(comm, dst: int, tag: int, size) -> None:
@@ -154,7 +160,7 @@ def send_arrow(comm, dst: int, tag: int, size) -> None:
     world_dst = comm.world_rank_of(dst)
     _ensure_rank_container(world_dst)
     instr.smpi_send(rank, comm.world_rank_of(rank), world_dst, tag,
-                    int(size))
+                    int(size), instance=_instance())
 
 
 def recv_arrow_once(req) -> None:
@@ -168,4 +174,4 @@ def recv_arrow_once(req) -> None:
     world_src = comm.world_rank_of(req.real_src)
     _ensure_rank_container(world_src)
     instr.smpi_recv(world_src, comm.world_rank_of(comm.rank()),
-                    req.real_tag)
+                    req.real_tag, instance=_instance())

@@ -285,11 +285,13 @@ def smpi_instance_register(engine, fn, hosts: Sequence,
     def rank_main():
         from .. import instr
         state = this_rank_state()
-        instr.smpi_init(state.world_rank, state.host)
+        instr.smpi_init(state.world_rank, state.host,
+                        instance=state.instance)
         try:
             fn(*args)
         finally:
-            instr.smpi_finalize(state.world_rank)
+            instr.smpi_finalize(state.world_rank,
+                                instance=state.instance)
 
     # Register every rank's state before any actor runs: rank 0's first
     # send must be able to resolve rank N's mailboxes.
@@ -324,7 +326,8 @@ _FABRIC_LOOPBACK_BW = "498000000Bps"
 _FABRIC_LOOPBACK_LAT = "0.000004s"
 _FABRIC_NETWORK_BW = f"{26 * 1024 * 1024}Bps"
 _FABRIC_NETWORK_LAT = "0.000005s"
-_FABRIC_SPEED = "100Mf"
+_FABRIC_SPEED = "100flops"   # yes, 100 flop/s — the reference's own
+                             # DEFAULT_SPEED (smpirun.in:18)
 
 
 def fabricate_platform(n_hosts: int, path: str,
@@ -338,8 +341,10 @@ def fabricate_platform(n_hosts: int, path: str,
     assert len(names) == n_hosts
     lines = ["<?xml version='1.0'?>", '<platform version="4.1">',
              '<zone id="AS0" routing="Full">']
+    from xml.sax.saxutils import quoteattr
     for i, name in enumerate(names, start=1):
-        lines.append(f'  <host id="{name}" speed="{_FABRIC_SPEED}"/>')
+        lines.append(f'  <host id={quoteattr(name)} '
+                     f'speed="{_FABRIC_SPEED}"/>')
         lines.append(f'  <link id="loop{i}" '
                      f'bandwidth="{_FABRIC_LOOPBACK_BW}" '
                      f'latency="{_FABRIC_LOOPBACK_LAT}"/>')
@@ -349,12 +354,12 @@ def fabricate_platform(n_hosts: int, path: str,
     for i, src in enumerate(names, start=1):
         for j, dst in enumerate(names, start=1):
             if i == j:
-                lines.append(f'  <route src="{src}" dst="{dst}" '
-                             f'symmetrical="NO">'
+                lines.append(f'  <route src={quoteattr(src)} '
+                             f'dst={quoteattr(dst)} symmetrical="NO">'
                              f'<link_ctn id="loop{i}"/></route>')
             else:
-                lines.append(f'  <route src="{src}" dst="{dst}" '
-                             f'symmetrical="NO">'
+                lines.append(f'  <route src={quoteattr(src)} '
+                             f'dst={quoteattr(dst)} symmetrical="NO">'
                              f'<link_ctn id="link{i}"/>'
                              f'<link_ctn id="link{j}"/></route>')
     lines += ["</zone>", "</platform>"]
@@ -432,11 +437,13 @@ def smpirun_multi(instances, platform: str, configs: Sequence[str] = ()):
     getting its own COMM_WORLD and rank namespace."""
     from ..s4u import Engine
 
+    global _world
     e = Engine(["smpirun"] + [f"--cfg={c}" for c in configs])
     e.load_platform(platform)
     _registry.clear()
     _by_world_rank.clear()
     clear_process_data()
+    _world = None    # multi-instance: worlds are per-instance only
     all_hosts = e.get_all_hosts()
     offset = 0
     for spec in instances:

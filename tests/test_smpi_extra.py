@@ -263,3 +263,24 @@ def test_sample_flops_extrapolation(cluster):
     out = run(cluster, 1, f)
     # 2 sampled + 8 extrapolated as compute: 10 x 0.1s at 1Gf
     assert out["t"] == pytest.approx(1.0, rel=0.02)
+
+
+def test_v_variant_collectives(cluster):
+    """allgatherv/alltoallv/gatherv/scatterv: per-peer payloads carry
+    their own sizes in the object model."""
+    def f(comm, out):
+        me, n = comm.rank(), comm.size()
+        got = comm.allgatherv(np.ones(10 * (me + 1)))
+        out[f"ag{me}"] = [len(g) for g in got]
+        a2a = comm.alltoallv([np.full(i + 1, float(me)) for i in range(n)])
+        out[f"a2a{me}"] = [len(x) for x in a2a]
+        gat = comm.gatherv(np.ones(me + 1), root=0)
+        if me == 0:
+            out["gat"] = [len(g) for g in gat]
+        objs = [np.ones(i + 2) for i in range(n)] if me == 0 else None
+        out[f"sc{me}"] = len(comm.scatterv(objs, root=0))
+    out = run(cluster, 4, f)
+    assert out["ag0"] == [10, 20, 30, 40]
+    assert out["a2a2"] == [3, 3, 3, 3]
+    assert out["gat"] == [1, 2, 3, 4]
+    assert out["sc3"] == 5
