@@ -352,7 +352,9 @@ def smpi_send(rank: int, src: int, dst: int, tag: int, size: int,
     """TRACE_smpi_send: StartLink arrow from the sender."""
     if not smpi_enabled() or _trace.format == TI_FORMAT:
         return
-    key = _pt2pt_key(f"{instance}.{src}", f"{instance}.{dst}", tag, send=1)
+    src_key = src if instance == "main" else f"{instance}.{src}"
+    dst_key = dst if instance == "main" else f"{instance}.{dst}"
+    key = _pt2pt_key(src_key, dst_key, tag, send=1)
     root = _trace.root_container
     lt = root.type.link_type("MPI_LINK",
                              _rank_container(src, instance).type,
@@ -368,8 +370,9 @@ def smpi_recv(rank_src: int, rank_dst: int, tag: int,
     """TRACE_smpi_recv: EndLink arrow at the receiver."""
     if not smpi_enabled() or _trace.format == TI_FORMAT:
         return
-    key = _pt2pt_key(f"{instance}.{rank_src}", f"{instance}.{rank_dst}",
-                     tag, send=0)
+    src_key = rank_src if instance == "main" else f"{instance}.{rank_src}"
+    dst_key = rank_dst if instance == "main" else f"{instance}.{rank_dst}"
+    key = _pt2pt_key(src_key, dst_key, tag, send=0)
     root = _trace.root_container
     lt = root.type.link_type("MPI_LINK",
                              _rank_container(rank_src, instance).type,

@@ -100,6 +100,10 @@ class CommType(Enum):
 class CommImpl(ActivityImpl):
     """A point-to-point communication (reference CommImpl.cpp)."""
 
+    #: fired when a comm completes: (comm) — consumed by the
+    #: communication-determinism checker (mc/comm_determinism.py).
+    on_completion = Signal()
+
     def __init__(self, engine):
         super().__init__(engine)
         self.type = CommType.SEND
@@ -185,6 +189,8 @@ class CommImpl(ActivityImpl):
         else:
             self.state = State.DONE
         self.cleanup_surf()
+        if self.state == State.DONE:
+            CommImpl.on_completion(self)
         self.finish()
 
     def finish(self) -> None:
@@ -276,6 +282,10 @@ class MailboxImpl:
 
     def push(self, comm: CommImpl) -> None:
         comm.mailbox = self
+        # Sticky name: `mailbox` is nulled when the comm leaves the
+        # queue, but pattern observers (mc/comm_determinism) need the
+        # rendezvous identity at completion time.
+        comm.mbox_name = self.name
         self.comm_queue.append(comm)
 
     def remove(self, comm: CommImpl) -> None:
@@ -599,6 +609,10 @@ def comm_irecv(engine, receiver, mbox: "MailboxImpl", dst_buff, match_fun,
                 other_comm.state = State.DONE
                 other_comm.type = CommType.DONE
                 other_comm.mailbox = None
+                # The permanent-receiver fast path completes without
+                # going through post(): pattern observers still need
+                # the completion event.
+                CommImpl.on_completion(other_comm)
     else:
         other_comm = mbox.find_matching_comm(CommType.SEND, match_fun, data,
                                              this_synchro, False, True)

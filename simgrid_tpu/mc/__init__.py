@@ -15,3 +15,8 @@ from .explorer import (DeadlockError, PropertyError, SafetyChecker,
 
 __all__ = ["SafetyChecker", "Session", "PropertyError", "DeadlockError",
            "TerminationError"]
+
+from .comm_determinism import (CommunicationDeterminismChecker,  # noqa: E402
+                               NonDeterminismError)
+
+__all__ += ["CommunicationDeterminismChecker", "NonDeterminismError"]

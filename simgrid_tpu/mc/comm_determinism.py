@@ -1,0 +1,88 @@
+"""Communication-determinism checker (reference
+src/mc/checker/CommunicationDeterminismChecker.cpp).
+
+Explores scheduling interleavings like the safety checker and records
+every completed communication as a pattern (mailbox, src pid, dst pid)
+in per-actor order. The first completed execution fixes the reference
+patterns (initial_communications_pattern); any later interleaving whose
+per-actor sequences differ makes the application non-send-deterministic
+and/or non-recv-deterministic — the MPI message-race detector (an
+MPI_ANY_SOURCE whose match depends on scheduling, etc.)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..exceptions import SimgridException
+from ..utils import log as _log
+from .explorer import SafetyChecker, Session
+
+_logger = _log.get_category("mc_comm_determinism")
+
+Pattern = Tuple[str, int, int]   # (mailbox, src pid, dst pid)
+
+
+class NonDeterminismError(SimgridException):
+    def __init__(self, message, kind, actor, reference, observed):
+        super().__init__(message)
+        self.kind = kind            # "send" | "recv"
+        self.actor = actor
+        self.reference = reference
+        self.observed = observed
+
+
+class CommunicationDeterminismChecker(SafetyChecker):
+    """SafetyChecker + per-path communication-pattern comparison."""
+
+    def __init__(self, program):
+        super().__init__(program)
+        self.reference_sends: Optional[Dict[int, List[Pattern]]] = None
+        self.reference_recvs: Optional[Dict[int, List[Pattern]]] = None
+        self.paths_checked = 0
+        self._sends: Dict[int, List[Pattern]] = {}
+        self._recvs: Dict[int, List[Pattern]] = {}
+
+    def _make_session(self) -> Session:
+        from ..kernel.activity import CommImpl
+        session = super()._make_session()
+        self._sends = {}
+        self._recvs = {}
+
+        def on_comm(comm):
+            src = comm.src_actor.pid if comm.src_actor else -1
+            dst = comm.dst_actor.pid if comm.dst_actor else -1
+            mbox = getattr(comm, "mbox_name", "?")
+            pattern = (mbox, src, dst)
+            self._sends.setdefault(src, []).append(pattern)
+            self._recvs.setdefault(dst, []).append(pattern)
+
+        session.engine.connect_signal(CommImpl.on_completion, on_comm)
+        return session
+
+    def _on_path_complete(self, session: Session) -> None:
+        self.paths_checked += 1
+        if self.reference_sends is None:
+            # compare_comm_pattern: the first path defines the law
+            self.reference_sends = {k: list(v)
+                                    for k, v in self._sends.items()}
+            self.reference_recvs = {k: list(v)
+                                    for k, v in self._recvs.items()}
+            return
+        for pid in set(self.reference_sends) | set(self._sends):
+            ref = self.reference_sends.get(pid, [])
+            got = self._sends.get(pid, [])
+            if got != ref:
+                _logger.info("***** Non-send-deterministic communications "
+                             "pattern *****")
+                raise NonDeterminismError(
+                    f"Non-send-deterministic communications pattern for "
+                    f"actor {pid}", "send", pid, ref, got)
+        for pid in set(self.reference_recvs) | set(self._recvs):
+            ref = self.reference_recvs.get(pid, [])
+            got = self._recvs.get(pid, [])
+            if got != ref:
+                _logger.info("***** Non-recv-deterministic communications "
+                             "pattern *****")
+                raise NonDeterminismError(
+                    f"Non-recv-deterministic communications pattern for "
+                    f"actor {pid}", "recv", pid, ref, got)

@@ -232,9 +232,18 @@ class SafetyChecker:
         self.executed_transitions = 0
         self.expanded_states = 0
 
+    # -- subclass hooks ----------------------------------------------------
+    def _make_session(self) -> Session:
+        """Session factory; checker variants attach observers here."""
+        return Session(self.program)
+
+    def _on_path_complete(self, session: Session) -> None:
+        """Called at every fully-executed path (leaf without live
+        actors) — the comm-determinism checker compares patterns here."""
+
     # -- replay-based navigation ------------------------------------------
     def _replay(self, prefix: List[int]) -> Session:
-        session = Session(self.program)
+        session = self._make_session()
         for pid in prefix:
             session.execute(pid)
         return session
@@ -242,7 +251,7 @@ class SafetyChecker:
     def run(self) -> Dict[str, int]:
         stack: List[_State] = []
         path: List[int] = []
-        session = Session(self.program)
+        session = self._make_session()
         if session.violation is not None:
             raise PropertyError(session.violation, [])
 
@@ -277,10 +286,12 @@ class SafetyChecker:
                 raise PropertyError(session.violation, self._trace(stack))
 
             nxt = _State(session.pending_pids())
-            if not nxt.enabled and session.alive():
-                raise DeadlockError(
-                    "Deadlock: actors remain but no transition is "
-                    "enabled", self._trace(stack))
+            if not nxt.enabled:
+                if session.alive():
+                    raise DeadlockError(
+                        "Deadlock: actors remain but no transition is "
+                        "enabled", self._trace(stack))
+                self._on_path_complete(session)
             self._seed_todo(nxt)
             self.expanded_states += 1
             stack.append(nxt)

@@ -30,7 +30,9 @@ def _governor_step(host, governor: str, up_threshold: float = 0.8) -> None:
     else:
         # Governors decide on the load averaged over the sampling
         # interval, then reset it (host_dvfs.cpp update()).
-        load = host_load.get_average_load(host)
+        # The reference scales by core count (host_dvfs.cpp:191,239):
+        # "load" counts busy cores, not a [0,1] fraction.
+        load = host.get_core_count() * host_load.get_average_load(host)
         host_load.reset(host)
         current = host.get_pstate()
         if governor == "ondemand":

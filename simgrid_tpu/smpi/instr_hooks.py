@@ -49,7 +49,7 @@ class _Span:
     or internals tracing on)."""
 
     __slots__ = ("op_name", "extra_factory", "ti_line", "rank", "depth",
-                 "visible")
+                 "visible", "_key")
 
     def __init__(self, op_name: str,
                  extra_factory: Callable[[], ti.TIData], ti_line: bool):
@@ -59,18 +59,19 @@ class _Span:
 
     def __enter__(self) -> bool:
         self.rank = _rank()
-        self.depth = _depth.get(self.rank, 0)
-        _depth[self.rank] = self.depth + 1
+        self._key = (_instance(), self.rank)
+        self.depth = _depth.get(self._key, 0)
+        _depth[self._key] = self.depth + 1
         self.visible = self.depth == 0 or config["tracing/smpi/internals"]
         if self.visible:
             instr.smpi_in(self.rank, self.op_name, self.extra_factory(),
-                          ti_line=self.ti_line, instance=_instance())
+                          ti_line=self.ti_line, instance=self._key[0])
         return self.visible
 
     def __exit__(self, *exc) -> None:
-        _depth[self.rank] = self.depth
+        _depth[self._key] = self.depth
         if self.visible:
-            instr.smpi_out(self.rank, instance=_instance())
+            instr.smpi_out(self.rank, instance=self._key[0])
 
 
 def span(op_name: str, extra_factory: Callable[[], ti.TIData],

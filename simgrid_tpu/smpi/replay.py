@@ -291,6 +291,32 @@ def _reducescatter(ctx, args):
     smpi_execute_flops(comp_size)
 
 
+@action("allgatherv")
+def _allgatherv(ctx, args):
+    # our TI writer emits "allgatherv <send_size> <st> <rt>"
+    send_size = _parse_double(args[2])
+    ctx.comm.allgatherv(_payload(send_size, dt.MPI_BYTE))
+
+
+@action("gatherv")
+def _gatherv(ctx, args):
+    # "gatherv <send_size> <root> <st> <rt>" (root printed when >= 0)
+    send_size = _parse_double(args[2])
+    root = int(args[3]) if len(args) > 3 and args[3].isdigit() else 0
+    ctx.comm.gatherv(_payload(send_size, dt.MPI_BYTE), root=root)
+
+
+@action("scatterv")
+def _scatterv(ctx, args):
+    # "scatterv <sendcounts x n> <root> <st> <rt>"
+    n = ctx.comm.size()
+    counts = [int(float(args[2 + i])) for i in range(n)]
+    root = int(args[2 + n]) if len(args) > 2 + n and \
+        args[2 + n].lstrip("-").isdigit() else 0
+    objs = [_payload(c, dt.MPI_BYTE) for c in counts]
+    ctx.comm.scatterv(objs, root=max(root, 0))
+
+
 @action("alltoallv")
 def _alltoallv(ctx, args):
     # send_buf_size, n sendcounts, recv_buf_size, n recvcounts

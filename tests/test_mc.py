@@ -184,3 +184,46 @@ def test_condvar_lost_wakeup_found_under_dpor(platform):
             mc.SafetyChecker(program).run()
     finally:
         config["model-check/reduction"] = "dpor"
+
+
+def test_comm_determinism_detects_any_source_race(platform):
+    """Two senders into ONE mailbox: the receiver's match order depends
+    on scheduling — non-recv-deterministic
+    (CommunicationDeterminismChecker.cpp's MPI race detector)."""
+    def make(shared_mailbox):
+        def program():
+            e = s4u.Engine(["mc"])
+            e.load_platform(platform)
+
+            def sender(v, mbox):
+                s4u.Mailbox.by_name(mbox).put(v, 8)
+
+            def receiver():
+                if shared_mailbox:
+                    s4u.Mailbox.by_name("m").get()
+                    s4u.Mailbox.by_name("m").get()
+                else:
+                    s4u.Mailbox.by_name("m1").get()
+                    s4u.Mailbox.by_name("m2").get()
+
+            boxes = ("m", "m") if shared_mailbox else ("m1", "m2")
+            s4u.Actor.create("s1", e.host_by_name("h1"),
+                             lambda: sender(1, boxes[0]))
+            s4u.Actor.create("s2", e.host_by_name("h2"),
+                             lambda: sender(2, boxes[1]))
+            s4u.Actor.create("r", e.host_by_name("h0"), receiver)
+            return e
+        return program
+
+    # Distinct mailboxes: deterministic across all interleavings.
+    clean = mc.CommunicationDeterminismChecker(make(False))
+    clean.run()
+    assert clean.paths_checked >= 2
+
+    # Shared mailbox: the race is reported with both patterns.
+    racy = mc.CommunicationDeterminismChecker(make(True))
+    with pytest.raises(mc.NonDeterminismError) as exc:
+        racy.run()
+    assert exc.value.kind == "recv"
+    assert exc.value.reference != exc.value.observed
+    assert all(p[0] == "m" for p in exc.value.reference)

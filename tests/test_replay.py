@@ -111,6 +111,8 @@ def test_roundtrip_trace_then_replay(cluster16, tmp_path):
             comm.recv(0, 7)
         smpi.runtime.smpi_execute_flops(1e6)
         comm.allreduce(np.arange(4.0))
+        comm.allgatherv(np.ones(10 * (me + 1)))
+        comm.alltoallv([np.ones(2 + i) for i in range(comm.size())])
         comm.barrier()
 
     e1 = smpirun(main, cluster16, np=4, configs=[
