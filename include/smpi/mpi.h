@@ -1,0 +1,252 @@
+/* MPI C header for the simulator's PMPI bindings.
+ *
+ * Role equivalent of the reference's include/smpi/smpi.h (the header
+ * smpicc puts on the include path so *unmodified* MPI C programs
+ * compile against the simulator).  Handles are plain ints resolved in
+ * the Python runtime (simgrid_tpu/smpi/c_api.py); every MPI call
+ * forwards through one dispatch callback installed at load time
+ * (native/smpi_shim.c).  The constants below are this ABI's own —
+ * programs are recompiled by smpicc, so no foreign-MPI binary
+ * compatibility is needed (same stance as the reference).
+ */
+#ifndef SIMGRID_TPU_MPI_H
+#define SIMGRID_TPU_MPI_H
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* -- handles ----------------------------------------------------------- */
+typedef int MPI_Comm;
+typedef int MPI_Datatype;
+typedef int MPI_Op;
+typedef int MPI_Request;
+typedef int MPI_Group;
+typedef int MPI_Win;
+typedef int MPI_Fint;
+typedef long long MPI_Aint;
+typedef long long MPI_Offset;
+typedef long long MPI_Count;
+
+typedef struct MPI_Status {
+  int MPI_SOURCE;
+  int MPI_TAG;
+  int MPI_ERROR;
+  int count_; /* received bytes (internal) */
+} MPI_Status;
+
+#define MPI_COMM_NULL 0
+#define MPI_COMM_WORLD 1
+#define MPI_COMM_SELF 2
+
+#define MPI_GROUP_NULL 0
+#define MPI_GROUP_EMPTY 1
+
+#define MPI_REQUEST_NULL 0
+#define MPI_WIN_NULL 0
+
+/* -- predefined datatypes (values mirrored in c_api.py) ---------------- */
+#define MPI_DATATYPE_NULL 0
+#define MPI_BYTE 1
+#define MPI_CHAR 2
+#define MPI_SHORT 3
+#define MPI_INT 4
+#define MPI_LONG 5
+#define MPI_LONG_LONG 6
+#define MPI_LONG_LONG_INT MPI_LONG_LONG
+#define MPI_SIGNED_CHAR 7
+#define MPI_UNSIGNED_CHAR 8
+#define MPI_UNSIGNED_SHORT 9
+#define MPI_UNSIGNED 10
+#define MPI_UNSIGNED_LONG 11
+#define MPI_UNSIGNED_LONG_LONG 12
+#define MPI_FLOAT 13
+#define MPI_DOUBLE 14
+#define MPI_LONG_DOUBLE 15
+#define MPI_WCHAR 16
+#define MPI_C_BOOL 17
+#define MPI_INT8_T 18
+#define MPI_INT16_T 19
+#define MPI_INT32_T 20
+#define MPI_INT64_T 21
+#define MPI_UINT8_T 22
+#define MPI_UINT16_T 23
+#define MPI_UINT32_T 24
+#define MPI_UINT64_T 25
+#define MPI_DOUBLE_INT 26
+#define MPI_FLOAT_INT 27
+#define MPI_LONG_INT 28
+#define MPI_2INT 29
+#define MPI_AINT 30
+#define MPI_OFFSET 31
+#define MPI_COUNT 32
+#define MPI_PACKED 33
+
+/* -- predefined reduction ops ------------------------------------------ */
+#define MPI_OP_NULL 0
+#define MPI_MAX 1
+#define MPI_MIN 2
+#define MPI_SUM 3
+#define MPI_PROD 4
+#define MPI_LAND 5
+#define MPI_BAND 6
+#define MPI_LOR 7
+#define MPI_BOR 8
+#define MPI_LXOR 9
+#define MPI_BXOR 10
+#define MPI_MAXLOC 11
+#define MPI_MINLOC 12
+
+/* -- wildcards & sentinels --------------------------------------------- */
+#define MPI_ANY_SOURCE -1
+#define MPI_ANY_TAG -1
+#define MPI_PROC_NULL -2
+#define MPI_ROOT -3
+#define MPI_UNDEFINED -32766
+#define MPI_IN_PLACE ((void*)-222)
+#define MPI_BOTTOM ((void*)0)
+#define MPI_STATUS_IGNORE ((MPI_Status*)0)
+#define MPI_STATUSES_IGNORE ((MPI_Status*)0)
+#define MPI_MAX_PROCESSOR_NAME 256
+#define MPI_MAX_ERROR_STRING 256
+#define MPI_MAX_OBJECT_NAME 128
+
+/* -- error codes -------------------------------------------------------- */
+#define MPI_SUCCESS 0
+#define MPI_ERR_COMM 1
+#define MPI_ERR_ARG 2
+#define MPI_ERR_TYPE 3
+#define MPI_ERR_REQUEST 4
+#define MPI_ERR_INTERN 5
+#define MPI_ERR_COUNT 6
+#define MPI_ERR_RANK 7
+#define MPI_ERR_TAG 8
+#define MPI_ERR_TRUNCATE 9
+#define MPI_ERR_OP 10
+#define MPI_ERR_OTHER 16
+#define MPI_ERR_LASTCODE 74
+
+typedef void MPI_User_function(void* invec, void* inoutvec, int* len,
+                               MPI_Datatype* datatype);
+
+/* -- environment -------------------------------------------------------- */
+int MPI_Init(int* argc, char*** argv);
+int MPI_Finalize(void);
+int MPI_Initialized(int* flag);
+int MPI_Finalized(int* flag);
+int MPI_Abort(MPI_Comm comm, int errorcode);
+double MPI_Wtime(void);
+double MPI_Wtick(void);
+int MPI_Get_processor_name(char* name, int* resultlen);
+int MPI_Error_string(int errorcode, char* string, int* resultlen);
+int MPI_Get_version(int* version, int* subversion);
+
+/* -- communicators ------------------------------------------------------ */
+int MPI_Comm_rank(MPI_Comm comm, int* rank);
+int MPI_Comm_size(MPI_Comm comm, int* size);
+int MPI_Comm_dup(MPI_Comm comm, MPI_Comm* newcomm);
+int MPI_Comm_split(MPI_Comm comm, int color, int key, MPI_Comm* newcomm);
+int MPI_Comm_free(MPI_Comm* comm);
+int MPI_Comm_group(MPI_Comm comm, MPI_Group* group);
+int MPI_Group_free(MPI_Group* group);
+int MPI_Group_size(MPI_Group group, int* size);
+int MPI_Group_rank(MPI_Group group, int* rank);
+
+/* -- point-to-point ------------------------------------------------------ */
+int MPI_Send(const void* buf, int count, MPI_Datatype datatype, int dest,
+             int tag, MPI_Comm comm);
+int MPI_Ssend(const void* buf, int count, MPI_Datatype datatype, int dest,
+              int tag, MPI_Comm comm);
+int MPI_Recv(void* buf, int count, MPI_Datatype datatype, int source,
+             int tag, MPI_Comm comm, MPI_Status* status);
+int MPI_Isend(const void* buf, int count, MPI_Datatype datatype, int dest,
+              int tag, MPI_Comm comm, MPI_Request* request);
+int MPI_Issend(const void* buf, int count, MPI_Datatype datatype, int dest,
+               int tag, MPI_Comm comm, MPI_Request* request);
+int MPI_Irecv(void* buf, int count, MPI_Datatype datatype, int source,
+              int tag, MPI_Comm comm, MPI_Request* request);
+int MPI_Wait(MPI_Request* request, MPI_Status* status);
+int MPI_Test(MPI_Request* request, int* flag, MPI_Status* status);
+int MPI_Waitall(int count, MPI_Request* requests, MPI_Status* statuses);
+int MPI_Waitany(int count, MPI_Request* requests, int* index,
+                MPI_Status* status);
+int MPI_Testall(int count, MPI_Request* requests, int* flag,
+                MPI_Status* statuses);
+int MPI_Probe(int source, int tag, MPI_Comm comm, MPI_Status* status);
+int MPI_Iprobe(int source, int tag, MPI_Comm comm, int* flag,
+               MPI_Status* status);
+int MPI_Sendrecv(const void* sendbuf, int sendcount, MPI_Datatype sendtype,
+                 int dest, int sendtag, void* recvbuf, int recvcount,
+                 MPI_Datatype recvtype, int source, int recvtag,
+                 MPI_Comm comm, MPI_Status* status);
+int MPI_Get_count(const MPI_Status* status, MPI_Datatype datatype,
+                  int* count);
+
+/* -- collectives --------------------------------------------------------- */
+int MPI_Barrier(MPI_Comm comm);
+int MPI_Bcast(void* buf, int count, MPI_Datatype datatype, int root,
+              MPI_Comm comm);
+int MPI_Reduce(const void* sendbuf, void* recvbuf, int count,
+               MPI_Datatype datatype, MPI_Op op, int root, MPI_Comm comm);
+int MPI_Allreduce(const void* sendbuf, void* recvbuf, int count,
+                  MPI_Datatype datatype, MPI_Op op, MPI_Comm comm);
+int MPI_Gather(const void* sendbuf, int sendcount, MPI_Datatype sendtype,
+               void* recvbuf, int recvcount, MPI_Datatype recvtype,
+               int root, MPI_Comm comm);
+int MPI_Gatherv(const void* sendbuf, int sendcount, MPI_Datatype sendtype,
+                void* recvbuf, const int* recvcounts, const int* displs,
+                MPI_Datatype recvtype, int root, MPI_Comm comm);
+int MPI_Allgather(const void* sendbuf, int sendcount, MPI_Datatype sendtype,
+                  void* recvbuf, int recvcount, MPI_Datatype recvtype,
+                  MPI_Comm comm);
+int MPI_Allgatherv(const void* sendbuf, int sendcount,
+                   MPI_Datatype sendtype, void* recvbuf,
+                   const int* recvcounts, const int* displs,
+                   MPI_Datatype recvtype, MPI_Comm comm);
+int MPI_Scatter(const void* sendbuf, int sendcount, MPI_Datatype sendtype,
+                void* recvbuf, int recvcount, MPI_Datatype recvtype,
+                int root, MPI_Comm comm);
+int MPI_Scatterv(const void* sendbuf, const int* sendcounts,
+                 const int* displs, MPI_Datatype sendtype, void* recvbuf,
+                 int recvcount, MPI_Datatype recvtype, int root,
+                 MPI_Comm comm);
+int MPI_Alltoall(const void* sendbuf, int sendcount, MPI_Datatype sendtype,
+                 void* recvbuf, int recvcount, MPI_Datatype recvtype,
+                 MPI_Comm comm);
+int MPI_Alltoallv(const void* sendbuf, const int* sendcounts,
+                  const int* sdispls, MPI_Datatype sendtype, void* recvbuf,
+                  const int* recvcounts, const int* rdispls,
+                  MPI_Datatype recvtype, MPI_Comm comm);
+int MPI_Scan(const void* sendbuf, void* recvbuf, int count,
+             MPI_Datatype datatype, MPI_Op op, MPI_Comm comm);
+int MPI_Exscan(const void* sendbuf, void* recvbuf, int count,
+               MPI_Datatype datatype, MPI_Op op, MPI_Comm comm);
+int MPI_Reduce_scatter(const void* sendbuf, void* recvbuf,
+                       const int* recvcounts, MPI_Datatype datatype,
+                       MPI_Op op, MPI_Comm comm);
+int MPI_Reduce_scatter_block(const void* sendbuf, void* recvbuf,
+                             int recvcount, MPI_Datatype datatype,
+                             MPI_Op op, MPI_Comm comm);
+
+/* -- datatypes ----------------------------------------------------------- */
+int MPI_Type_size(MPI_Datatype datatype, int* size);
+int MPI_Type_get_extent(MPI_Datatype datatype, MPI_Aint* lb,
+                        MPI_Aint* extent);
+int MPI_Type_contiguous(int count, MPI_Datatype oldtype,
+                        MPI_Datatype* newtype);
+int MPI_Type_vector(int count, int blocklength, int stride,
+                    MPI_Datatype oldtype, MPI_Datatype* newtype);
+int MPI_Type_commit(MPI_Datatype* datatype);
+int MPI_Type_free(MPI_Datatype* datatype);
+
+/* -- reduction ops ------------------------------------------------------- */
+int MPI_Op_create(MPI_User_function* fn, int commute, MPI_Op* op);
+int MPI_Op_free(MPI_Op* op);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* SIMGRID_TPU_MPI_H */

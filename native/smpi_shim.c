@@ -1,0 +1,336 @@
+/* MPI shim: compiled by tools/smpicc INTO every simulated MPI program.
+ *
+ * Every MPI_* entry point marshals its arguments into a flat long-long
+ * array and forwards to ONE dispatch callback that the Python runtime
+ * (simgrid_tpu/smpi/c_api.py) installs via smpi_set_callbacks() right
+ * after dlopen'ing the program.  The callback runs on the calling rank's
+ * actor thread, issues the simcall, and blocks until the simulated
+ * operation completes — so unmodified MPI C code runs against the
+ * simulation kernel (role of the reference's src/smpi/bindings/
+ * smpi_pmpi*.cpp, redesigned: one generic trampoline instead of 300
+ * hand-written PMPI wrappers, since Python does the semantic work).
+ *
+ * Rank isolation: smpirun dlopens a PRIVATE COPY of the program .so per
+ * rank, so each rank gets its own globals (.data/.bss) — the in-process
+ * equivalent of the reference's mmap-based privatization
+ * (smpi_global.cpp:540-608).
+ */
+#include "../include/smpi/mpi.h"
+
+typedef long long smpi_arg_t;
+typedef int (*smpi_dispatch_fn)(int opcode, smpi_arg_t* args);
+typedef double (*smpi_time_fn)(void);
+
+static smpi_dispatch_fn smpi_dispatch = 0;
+static smpi_time_fn smpi_wtime_cb = 0;
+
+void smpi_set_callbacks(smpi_dispatch_fn dispatch, smpi_time_fn wtime) {
+  smpi_dispatch = dispatch;
+  smpi_wtime_cb = wtime;
+}
+
+/* Opcode values are mirrored byte-for-byte in c_api.py (_OPCODES). */
+enum {
+  SMPI_OP_INIT = 1,
+  SMPI_OP_FINALIZE,
+  SMPI_OP_INITIALIZED,
+  SMPI_OP_FINALIZED,
+  SMPI_OP_ABORT,
+  SMPI_OP_COMM_RANK,
+  SMPI_OP_COMM_SIZE,
+  SMPI_OP_COMM_DUP,
+  SMPI_OP_COMM_SPLIT,
+  SMPI_OP_COMM_FREE,
+  SMPI_OP_SEND,
+  SMPI_OP_SSEND,
+  SMPI_OP_RECV,
+  SMPI_OP_ISEND,
+  SMPI_OP_IRECV,
+  SMPI_OP_WAIT,
+  SMPI_OP_TEST,
+  SMPI_OP_WAITALL,
+  SMPI_OP_WAITANY,
+  SMPI_OP_TESTALL,
+  SMPI_OP_PROBE,
+  SMPI_OP_IPROBE,
+  SMPI_OP_SENDRECV,
+  SMPI_OP_GET_COUNT,
+  SMPI_OP_BARRIER,
+  SMPI_OP_BCAST,
+  SMPI_OP_REDUCE,
+  SMPI_OP_ALLREDUCE,
+  SMPI_OP_GATHER,
+  SMPI_OP_GATHERV,
+  SMPI_OP_ALLGATHER,
+  SMPI_OP_ALLGATHERV,
+  SMPI_OP_SCATTER,
+  SMPI_OP_SCATTERV,
+  SMPI_OP_ALLTOALL,
+  SMPI_OP_ALLTOALLV,
+  SMPI_OP_SCAN,
+  SMPI_OP_EXSCAN,
+  SMPI_OP_REDUCE_SCATTER,
+  SMPI_OP_REDUCE_SCATTER_BLOCK,
+  SMPI_OP_TYPE_SIZE,
+  SMPI_OP_TYPE_GET_EXTENT,
+  SMPI_OP_TYPE_CONTIGUOUS,
+  SMPI_OP_TYPE_VECTOR,
+  SMPI_OP_TYPE_COMMIT,
+  SMPI_OP_TYPE_FREE,
+  SMPI_OP_OP_CREATE,
+  SMPI_OP_OP_FREE,
+  SMPI_OP_COMM_GROUP,
+  SMPI_OP_GROUP_SIZE,
+  SMPI_OP_GROUP_RANK,
+  SMPI_OP_GET_PROCESSOR_NAME,
+};
+
+#define A(x) ((smpi_arg_t)(x))
+#define CALL(op, ...)                                  \
+  do {                                                 \
+    smpi_arg_t args_[] = {__VA_ARGS__};                \
+    if (!smpi_dispatch) return MPI_ERR_INTERN;         \
+    return smpi_dispatch(op, args_);                   \
+  } while (0)
+
+/* -- environment -------------------------------------------------------- */
+int MPI_Init(int* argc, char*** argv) { CALL(SMPI_OP_INIT, A(argc), A(argv)); }
+int MPI_Finalize(void) { CALL(SMPI_OP_FINALIZE, 0); }
+int MPI_Initialized(int* flag) { CALL(SMPI_OP_INITIALIZED, A(flag)); }
+int MPI_Finalized(int* flag) { CALL(SMPI_OP_FINALIZED, A(flag)); }
+int MPI_Abort(MPI_Comm comm, int errorcode) {
+  CALL(SMPI_OP_ABORT, A(comm), A(errorcode));
+}
+double MPI_Wtime(void) { return smpi_wtime_cb ? smpi_wtime_cb() : 0.0; }
+double MPI_Wtick(void) { return 1e-9; }
+int MPI_Get_processor_name(char* name, int* resultlen) {
+  CALL(SMPI_OP_GET_PROCESSOR_NAME, A(name), A(resultlen));
+}
+int MPI_Error_string(int errorcode, char* string, int* resultlen) {
+  static const char msg[] = "MPI error";
+  int i = 0;
+  (void)errorcode;
+  for (; msg[i]; i++) string[i] = msg[i];
+  string[i] = 0;
+  *resultlen = i;
+  return MPI_SUCCESS;
+}
+int MPI_Get_version(int* version, int* subversion) {
+  *version = 2;
+  *subversion = 2;
+  return MPI_SUCCESS;
+}
+
+/* -- communicators ------------------------------------------------------- */
+int MPI_Comm_rank(MPI_Comm comm, int* rank) {
+  CALL(SMPI_OP_COMM_RANK, A(comm), A(rank));
+}
+int MPI_Comm_size(MPI_Comm comm, int* size) {
+  CALL(SMPI_OP_COMM_SIZE, A(comm), A(size));
+}
+int MPI_Comm_dup(MPI_Comm comm, MPI_Comm* newcomm) {
+  CALL(SMPI_OP_COMM_DUP, A(comm), A(newcomm));
+}
+int MPI_Comm_split(MPI_Comm comm, int color, int key, MPI_Comm* newcomm) {
+  CALL(SMPI_OP_COMM_SPLIT, A(comm), A(color), A(key), A(newcomm));
+}
+int MPI_Comm_free(MPI_Comm* comm) { CALL(SMPI_OP_COMM_FREE, A(comm)); }
+int MPI_Comm_group(MPI_Comm comm, MPI_Group* group) {
+  CALL(SMPI_OP_COMM_GROUP, A(comm), A(group));
+}
+int MPI_Group_free(MPI_Group* group) {
+  *group = MPI_GROUP_NULL;
+  return MPI_SUCCESS;
+}
+int MPI_Group_size(MPI_Group group, int* size) {
+  CALL(SMPI_OP_GROUP_SIZE, A(group), A(size));
+}
+int MPI_Group_rank(MPI_Group group, int* rank) {
+  CALL(SMPI_OP_GROUP_RANK, A(group), A(rank));
+}
+
+/* -- point-to-point ------------------------------------------------------- */
+int MPI_Send(const void* buf, int count, MPI_Datatype datatype, int dest,
+             int tag, MPI_Comm comm) {
+  CALL(SMPI_OP_SEND, A(buf), A(count), A(datatype), A(dest), A(tag), A(comm));
+}
+int MPI_Ssend(const void* buf, int count, MPI_Datatype datatype, int dest,
+              int tag, MPI_Comm comm) {
+  CALL(SMPI_OP_SSEND, A(buf), A(count), A(datatype), A(dest), A(tag), A(comm));
+}
+int MPI_Recv(void* buf, int count, MPI_Datatype datatype, int source,
+             int tag, MPI_Comm comm, MPI_Status* status) {
+  CALL(SMPI_OP_RECV, A(buf), A(count), A(datatype), A(source), A(tag),
+       A(comm), A(status));
+}
+int MPI_Isend(const void* buf, int count, MPI_Datatype datatype, int dest,
+              int tag, MPI_Comm comm, MPI_Request* request) {
+  CALL(SMPI_OP_ISEND, A(buf), A(count), A(datatype), A(dest), A(tag),
+       A(comm), A(request), 0);
+}
+int MPI_Issend(const void* buf, int count, MPI_Datatype datatype, int dest,
+               int tag, MPI_Comm comm, MPI_Request* request) {
+  CALL(SMPI_OP_ISEND, A(buf), A(count), A(datatype), A(dest), A(tag),
+       A(comm), A(request), 1);
+}
+int MPI_Irecv(void* buf, int count, MPI_Datatype datatype, int source,
+              int tag, MPI_Comm comm, MPI_Request* request) {
+  CALL(SMPI_OP_IRECV, A(buf), A(count), A(datatype), A(source), A(tag),
+       A(comm), A(request));
+}
+int MPI_Wait(MPI_Request* request, MPI_Status* status) {
+  CALL(SMPI_OP_WAIT, A(request), A(status));
+}
+int MPI_Test(MPI_Request* request, int* flag, MPI_Status* status) {
+  CALL(SMPI_OP_TEST, A(request), A(flag), A(status));
+}
+int MPI_Waitall(int count, MPI_Request* requests, MPI_Status* statuses) {
+  CALL(SMPI_OP_WAITALL, A(count), A(requests), A(statuses));
+}
+int MPI_Waitany(int count, MPI_Request* requests, int* index,
+                MPI_Status* status) {
+  CALL(SMPI_OP_WAITANY, A(count), A(requests), A(index), A(status));
+}
+int MPI_Testall(int count, MPI_Request* requests, int* flag,
+                MPI_Status* statuses) {
+  CALL(SMPI_OP_TESTALL, A(count), A(requests), A(flag), A(statuses));
+}
+int MPI_Probe(int source, int tag, MPI_Comm comm, MPI_Status* status) {
+  CALL(SMPI_OP_PROBE, A(source), A(tag), A(comm), A(status));
+}
+int MPI_Iprobe(int source, int tag, MPI_Comm comm, int* flag,
+               MPI_Status* status) {
+  CALL(SMPI_OP_IPROBE, A(source), A(tag), A(comm), A(flag), A(status));
+}
+int MPI_Sendrecv(const void* sendbuf, int sendcount, MPI_Datatype sendtype,
+                 int dest, int sendtag, void* recvbuf, int recvcount,
+                 MPI_Datatype recvtype, int source, int recvtag,
+                 MPI_Comm comm, MPI_Status* status) {
+  CALL(SMPI_OP_SENDRECV, A(sendbuf), A(sendcount), A(sendtype), A(dest),
+       A(sendtag), A(recvbuf), A(recvcount), A(recvtype), A(source),
+       A(recvtag), A(comm), A(status));
+}
+int MPI_Get_count(const MPI_Status* status, MPI_Datatype datatype,
+                  int* count) {
+  CALL(SMPI_OP_GET_COUNT, A(status), A(datatype), A(count));
+}
+
+/* -- collectives ---------------------------------------------------------- */
+int MPI_Barrier(MPI_Comm comm) { CALL(SMPI_OP_BARRIER, A(comm)); }
+int MPI_Bcast(void* buf, int count, MPI_Datatype datatype, int root,
+              MPI_Comm comm) {
+  CALL(SMPI_OP_BCAST, A(buf), A(count), A(datatype), A(root), A(comm));
+}
+int MPI_Reduce(const void* sendbuf, void* recvbuf, int count,
+               MPI_Datatype datatype, MPI_Op op, int root, MPI_Comm comm) {
+  CALL(SMPI_OP_REDUCE, A(sendbuf), A(recvbuf), A(count), A(datatype), A(op),
+       A(root), A(comm));
+}
+int MPI_Allreduce(const void* sendbuf, void* recvbuf, int count,
+                  MPI_Datatype datatype, MPI_Op op, MPI_Comm comm) {
+  CALL(SMPI_OP_ALLREDUCE, A(sendbuf), A(recvbuf), A(count), A(datatype),
+       A(op), A(comm));
+}
+int MPI_Gather(const void* sendbuf, int sendcount, MPI_Datatype sendtype,
+               void* recvbuf, int recvcount, MPI_Datatype recvtype,
+               int root, MPI_Comm comm) {
+  CALL(SMPI_OP_GATHER, A(sendbuf), A(sendcount), A(sendtype), A(recvbuf),
+       A(recvcount), A(recvtype), A(root), A(comm));
+}
+int MPI_Gatherv(const void* sendbuf, int sendcount, MPI_Datatype sendtype,
+                void* recvbuf, const int* recvcounts, const int* displs,
+                MPI_Datatype recvtype, int root, MPI_Comm comm) {
+  CALL(SMPI_OP_GATHERV, A(sendbuf), A(sendcount), A(sendtype), A(recvbuf),
+       A(recvcounts), A(displs), A(recvtype), A(root), A(comm));
+}
+int MPI_Allgather(const void* sendbuf, int sendcount, MPI_Datatype sendtype,
+                  void* recvbuf, int recvcount, MPI_Datatype recvtype,
+                  MPI_Comm comm) {
+  CALL(SMPI_OP_ALLGATHER, A(sendbuf), A(sendcount), A(sendtype), A(recvbuf),
+       A(recvcount), A(recvtype), A(comm));
+}
+int MPI_Allgatherv(const void* sendbuf, int sendcount,
+                   MPI_Datatype sendtype, void* recvbuf,
+                   const int* recvcounts, const int* displs,
+                   MPI_Datatype recvtype, MPI_Comm comm) {
+  CALL(SMPI_OP_ALLGATHERV, A(sendbuf), A(sendcount), A(sendtype),
+       A(recvbuf), A(recvcounts), A(displs), A(recvtype), A(comm));
+}
+int MPI_Scatter(const void* sendbuf, int sendcount, MPI_Datatype sendtype,
+                void* recvbuf, int recvcount, MPI_Datatype recvtype,
+                int root, MPI_Comm comm) {
+  CALL(SMPI_OP_SCATTER, A(sendbuf), A(sendcount), A(sendtype), A(recvbuf),
+       A(recvcount), A(recvtype), A(root), A(comm));
+}
+int MPI_Scatterv(const void* sendbuf, const int* sendcounts,
+                 const int* displs, MPI_Datatype sendtype, void* recvbuf,
+                 int recvcount, MPI_Datatype recvtype, int root,
+                 MPI_Comm comm) {
+  CALL(SMPI_OP_SCATTERV, A(sendbuf), A(sendcounts), A(displs), A(sendtype),
+       A(recvbuf), A(recvcount), A(recvtype), A(root), A(comm));
+}
+int MPI_Alltoall(const void* sendbuf, int sendcount, MPI_Datatype sendtype,
+                 void* recvbuf, int recvcount, MPI_Datatype recvtype,
+                 MPI_Comm comm) {
+  CALL(SMPI_OP_ALLTOALL, A(sendbuf), A(sendcount), A(sendtype), A(recvbuf),
+       A(recvcount), A(recvtype), A(comm));
+}
+int MPI_Alltoallv(const void* sendbuf, const int* sendcounts,
+                  const int* sdispls, MPI_Datatype sendtype, void* recvbuf,
+                  const int* recvcounts, const int* rdispls,
+                  MPI_Datatype recvtype, MPI_Comm comm) {
+  CALL(SMPI_OP_ALLTOALLV, A(sendbuf), A(sendcounts), A(sdispls), A(sendtype),
+       A(recvbuf), A(recvcounts), A(rdispls), A(recvtype), A(comm));
+}
+int MPI_Scan(const void* sendbuf, void* recvbuf, int count,
+             MPI_Datatype datatype, MPI_Op op, MPI_Comm comm) {
+  CALL(SMPI_OP_SCAN, A(sendbuf), A(recvbuf), A(count), A(datatype), A(op),
+       A(comm));
+}
+int MPI_Exscan(const void* sendbuf, void* recvbuf, int count,
+               MPI_Datatype datatype, MPI_Op op, MPI_Comm comm) {
+  CALL(SMPI_OP_EXSCAN, A(sendbuf), A(recvbuf), A(count), A(datatype), A(op),
+       A(comm));
+}
+int MPI_Reduce_scatter(const void* sendbuf, void* recvbuf,
+                       const int* recvcounts, MPI_Datatype datatype,
+                       MPI_Op op, MPI_Comm comm) {
+  CALL(SMPI_OP_REDUCE_SCATTER, A(sendbuf), A(recvbuf), A(recvcounts),
+       A(datatype), A(op), A(comm));
+}
+int MPI_Reduce_scatter_block(const void* sendbuf, void* recvbuf,
+                             int recvcount, MPI_Datatype datatype,
+                             MPI_Op op, MPI_Comm comm) {
+  CALL(SMPI_OP_REDUCE_SCATTER_BLOCK, A(sendbuf), A(recvbuf), A(recvcount),
+       A(datatype), A(op), A(comm));
+}
+
+/* -- datatypes ------------------------------------------------------------- */
+int MPI_Type_size(MPI_Datatype datatype, int* size) {
+  CALL(SMPI_OP_TYPE_SIZE, A(datatype), A(size));
+}
+int MPI_Type_get_extent(MPI_Datatype datatype, MPI_Aint* lb,
+                        MPI_Aint* extent) {
+  CALL(SMPI_OP_TYPE_GET_EXTENT, A(datatype), A(lb), A(extent));
+}
+int MPI_Type_contiguous(int count, MPI_Datatype oldtype,
+                        MPI_Datatype* newtype) {
+  CALL(SMPI_OP_TYPE_CONTIGUOUS, A(count), A(oldtype), A(newtype));
+}
+int MPI_Type_vector(int count, int blocklength, int stride,
+                    MPI_Datatype oldtype, MPI_Datatype* newtype) {
+  CALL(SMPI_OP_TYPE_VECTOR, A(count), A(blocklength), A(stride), A(oldtype),
+       A(newtype));
+}
+int MPI_Type_commit(MPI_Datatype* datatype) {
+  CALL(SMPI_OP_TYPE_COMMIT, A(datatype));
+}
+int MPI_Type_free(MPI_Datatype* datatype) {
+  CALL(SMPI_OP_TYPE_FREE, A(datatype));
+}
+
+/* -- reduction ops ---------------------------------------------------------- */
+int MPI_Op_create(MPI_User_function* fn, int commute, MPI_Op* op) {
+  CALL(SMPI_OP_OP_CREATE, A(fn), A(commute), A(op));
+}
+int MPI_Op_free(MPI_Op* op) { CALL(SMPI_OP_OP_FREE, A(op)); }

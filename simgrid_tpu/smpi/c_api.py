@@ -1,0 +1,1295 @@
+"""PMPI C bindings: run *unmodified* MPI C programs on the simulator.
+
+Role equivalent of the reference's src/smpi/bindings/smpi_pmpi*.cpp +
+smpicc + mmap privatization (smpi_global.cpp:540-608), redesigned for
+this framework:
+
+* ``tools/smpicc`` compiles the user's C sources into a shared object,
+  renaming ``main`` to ``smpi_c_main`` and linking in one generic
+  trampoline (native/smpi_shim.c) instead of 300 PMPI wrappers;
+* every rank actor dlopens a PRIVATE COPY of that .so, giving each rank
+  its own globals (.data/.bss) — in-process privatization without mmap
+  games;
+* every MPI call in C marshals its arguments into a flat array and
+  forwards to ``_dispatch`` below, which runs on the rank's actor
+  thread, translates handles, moves bytes between C buffers and numpy
+  payloads, and issues the same Request/collective machinery the Python
+  API uses (so algorithms, selectors, tracing and replay all apply);
+* host compute between MPI calls is measured with a monotonic clock and
+  injected as simulated flops, exactly the reference's bench loop
+  (smpi_bench.cpp:53-78 smpi_bench_begin/end), honoring
+  smpi/simulate-computation and smpi/cpu-threshold.
+
+Known divergences (documented, by design):
+* MPI_Abort returns to the caller (the callback boundary cannot
+  longjmp over C frames); other ranks' subsequent MPI calls fail with
+  MPI_ERR_OTHER and the simulation ends when mains return.
+* An actor kill that lands while the rank executes C code terminates
+  the MPI call with an error instead of unwinding the C stack.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.config import config
+from . import op as _ops
+from . import runtime
+from .comm import Comm
+from .datatype import Datatype
+from .group import Group
+from .op import Op
+from .request import (MPI_ANY_SOURCE as PY_ANY_SOURCE,
+                      MPI_ANY_TAG as PY_ANY_TAG, Request, Status)
+
+# (smpi/simulate-computation is declared in utils/config.py)
+
+# -- C-side constants (mirror include/smpi/mpi.h) ---------------------------
+MPI_SUCCESS = 0
+MPI_ERR_COMM = 1
+MPI_ERR_ARG = 2
+MPI_ERR_TYPE = 3
+MPI_ERR_REQUEST = 4
+MPI_ERR_INTERN = 5
+MPI_ERR_OTHER = 16
+
+C_ANY_SOURCE = -1
+C_ANY_TAG = -1
+C_PROC_NULL = -2
+C_UNDEFINED = -32766
+C_IN_PLACE = -222          # (void*)-222 seen as a signed long long
+
+COMM_NULL, COMM_WORLD, COMM_SELF = 0, 1, 2
+
+_i32 = ctypes.c_int
+_pi32 = ctypes.POINTER(ctypes.c_int)
+_pi64 = ctypes.POINTER(ctypes.c_longlong)
+
+
+def _dt_struct(fields):
+    return np.dtype(fields, align=True)
+
+
+#: predefined datatype handles -> Datatype (sizes are the C ABI's)
+_PREDEF_DTYPES: Dict[int, Datatype] = {}
+
+
+def _predef(handle, size, np_dtype, name):
+    _PREDEF_DTYPES[handle] = Datatype(size, np_dtype, name)
+
+
+_predef(1, 1, np.uint8, "MPI_BYTE")
+_predef(2, 1, np.int8, "MPI_CHAR")
+_predef(3, 2, np.int16, "MPI_SHORT")
+_predef(4, 4, np.int32, "MPI_INT")
+_predef(5, 8, np.int64, "MPI_LONG")
+_predef(6, 8, np.int64, "MPI_LONG_LONG")
+_predef(7, 1, np.int8, "MPI_SIGNED_CHAR")
+_predef(8, 1, np.uint8, "MPI_UNSIGNED_CHAR")
+_predef(9, 2, np.uint16, "MPI_UNSIGNED_SHORT")
+_predef(10, 4, np.uint32, "MPI_UNSIGNED")
+_predef(11, 8, np.uint64, "MPI_UNSIGNED_LONG")
+_predef(12, 8, np.uint64, "MPI_UNSIGNED_LONG_LONG")
+_predef(13, 4, np.float32, "MPI_FLOAT")
+_predef(14, 8, np.float64, "MPI_DOUBLE")
+_predef(15, 16, np.longdouble, "MPI_LONG_DOUBLE")
+_predef(16, 4, np.int32, "MPI_WCHAR")
+_predef(17, 1, np.uint8, "MPI_C_BOOL")
+_predef(18, 1, np.int8, "MPI_INT8_T")
+_predef(19, 2, np.int16, "MPI_INT16_T")
+_predef(20, 4, np.int32, "MPI_INT32_T")
+_predef(21, 8, np.int64, "MPI_INT64_T")
+_predef(22, 1, np.uint8, "MPI_UINT8_T")
+_predef(23, 2, np.uint16, "MPI_UINT16_T")
+_predef(24, 4, np.uint32, "MPI_UINT32_T")
+_predef(25, 8, np.uint64, "MPI_UINT64_T")
+# value+index pairs use the C struct layout (alignment padding and all),
+# so MAXLOC/MINLOC see exactly what the C program wrote
+_di = _dt_struct([("v", "<f8"), ("i", "<i4")])
+_predef(26, _di.itemsize, _di, "MPI_DOUBLE_INT")
+_fi = _dt_struct([("v", "<f4"), ("i", "<i4")])
+_predef(27, _fi.itemsize, _fi, "MPI_FLOAT_INT")
+_li = _dt_struct([("v", "<i8"), ("i", "<i4")])
+_predef(28, _li.itemsize, _li, "MPI_LONG_INT")
+_ii = _dt_struct([("v", "<i4"), ("i", "<i4")])
+_predef(29, _ii.itemsize, _ii, "MPI_2INT")
+_predef(30, 8, np.int64, "MPI_AINT")
+_predef(31, 8, np.int64, "MPI_OFFSET")
+_predef(32, 8, np.int64, "MPI_COUNT")
+_predef(33, 1, np.uint8, "MPI_PACKED")
+
+#: predefined op handles -> Op ("loc" ops resolved separately)
+_PREDEF_OPS: Dict[int, Op] = {
+    1: _ops.MPI_MAX, 2: _ops.MPI_MIN, 3: _ops.MPI_SUM, 4: _ops.MPI_PROD,
+    5: _ops.MPI_LAND, 6: _ops.MPI_BAND, 7: _ops.MPI_LOR, 8: _ops.MPI_BOR,
+    9: _ops.MPI_LXOR, 10: _ops.MPI_BXOR,
+}
+OP_MAXLOC, OP_MINLOC = 11, 12
+
+
+def _loc_op(minloc: bool) -> Op:
+    """MAXLOC/MINLOC over structured (value, index) arrays laid out as
+    the C pair structs."""
+    def fn(a, b):
+        if minloc:
+            take_b = (b["v"] < a["v"]) | ((b["v"] == a["v"])
+                                          & (b["i"] < a["i"]))
+        else:
+            take_b = (b["v"] > a["v"]) | ((b["v"] == a["v"])
+                                          & (b["i"] < a["i"]))
+        out = a.copy()
+        out[take_b] = b[take_b]
+        return out
+    return Op(fn, "MPI_MINLOC" if minloc else "MPI_MAXLOC")
+
+
+_OP_MAXLOC_STRUCT = _loc_op(False)
+_OP_MINLOC_STRUCT = _loc_op(True)
+
+
+class _CRankCtx:
+    """Per-rank handle tables + bench clock."""
+
+    def __init__(self):
+        self.comms: Dict[int, Comm] = {}
+        self.next_comm = 10
+        self.dtypes: Dict[int, Datatype] = dict(_PREDEF_DTYPES)
+        self.next_dtype = 100
+        self.ops: Dict[int, Op] = dict(_PREDEF_OPS)
+        self.next_op = 32
+        self.reqs: Dict[int, "_CReq"] = {}
+        self.next_req = 1
+        self.groups: Dict[int, Group] = {}
+        self.next_group = 10
+        self.bench_t0: Optional[float] = None
+        self.initialized = False
+        self.finalized = False
+        self.dead = False
+        self.exit_code: Optional[int] = None
+
+
+class _CReq:
+    __slots__ = ("req", "c_addr", "arr", "kind", "dt")
+
+    def __init__(self, req: Request, c_addr: int, arr, kind: str,
+                 dt: Optional[Datatype] = None):
+        self.req = req
+        self.c_addr = c_addr
+        self.arr = arr
+        self.kind = kind
+        self.dt = dt
+
+
+_ctxs: Dict[int, _CRankCtx] = {}
+
+
+def _ctx() -> _CRankCtx:
+    state = runtime.this_rank_state()
+    key = id(state.actor_impl)
+    ctx = _ctxs.get(key)
+    if ctx is None:
+        ctx = _ctxs[key] = _CRankCtx()
+    return ctx
+
+
+# ---------------------------------------------------------------------------
+# Bench loop (smpi_bench.cpp:53-78)
+# ---------------------------------------------------------------------------
+
+def _now() -> float:
+    import time
+    return time.perf_counter()
+
+
+def _bench_end(ctx: _CRankCtx) -> None:
+    """Host time since the last MPI call returned -> simulated compute."""
+    if ctx.bench_t0 is None:
+        return
+    elapsed = _now() - ctx.bench_t0
+    ctx.bench_t0 = None
+    if config["smpi/simulate-computation"]:
+        runtime.smpi_execute(elapsed)
+
+
+def _bench_begin(ctx: _CRankCtx) -> None:
+    ctx.bench_t0 = _now()
+
+
+# ---------------------------------------------------------------------------
+# Buffer <-> numpy marshalling
+# ---------------------------------------------------------------------------
+
+def _dt(ctx: _CRankCtx, handle: int) -> Datatype:
+    return ctx.dtypes[int(handle)]
+
+
+def _vector_block_offsets(dt: Datatype, count: int):
+    """Byte offsets + block length for a strided (vector) datatype:
+    `count` datatype elements, each spanning extent_ bytes with
+    nblocks blocks of blocklen*base_size bytes at stride intervals."""
+    nblocks, blocklen, stride, base_size = dt.c_layout
+    blk = blocklen * base_size
+    offsets = []
+    for e in range(int(count)):
+        base = e * dt.extent_
+        for b in range(nblocks):
+            offsets.append(base + b * stride * base_size)
+    return offsets, blk
+
+
+def _arr_in(addr: int, count: int, dt: Datatype):
+    """Copy `count` elements out of the C buffer into a fresh numpy
+    array (typed when the datatype maps to a numpy dtype).  Strided
+    vector datatypes gather their blocks from the C layout."""
+    count = int(count)
+    nbytes = count * dt.size_
+    if addr == 0 or nbytes <= 0:
+        return np.zeros(0, dt.np_dtype if dt.np_dtype is not None
+                        else np.uint8)
+    if getattr(dt, "c_layout", None) is not None:
+        offsets, blk = _vector_block_offsets(dt, count)
+        raw = bytearray()
+        for off in offsets:
+            raw += ctypes.string_at(int(addr) + off, blk)
+    else:
+        raw = bytearray(ctypes.string_at(addr, int(nbytes)))
+    if dt.np_dtype is not None and len(raw) % np.dtype(dt.np_dtype).itemsize == 0:
+        return np.frombuffer(raw, dtype=dt.np_dtype)
+    return np.frombuffer(raw, dtype=np.uint8)
+
+
+def _arr_out(addr: int, arr, max_bytes: Optional[int] = None,
+             dt: Optional[Datatype] = None) -> None:
+    """Copy a numpy payload into the C buffer at `addr`; strided
+    vector datatypes scatter their blocks back into the C layout."""
+    if addr == 0 or arr is None:
+        return
+    a = np.ascontiguousarray(arr)
+    data = a.tobytes()
+    if dt is not None and getattr(dt, "c_layout", None) is not None:
+        count = len(data) // dt.size_ if dt.size_ else 0
+        offsets, blk = _vector_block_offsets(dt, count)
+        pos = 0
+        for off in offsets:
+            chunk = data[pos:pos + blk]
+            if not chunk:
+                break
+            ctypes.memmove(int(addr) + off, chunk, len(chunk))
+            pos += blk
+        return
+    n = len(data) if max_bytes is None else min(len(data), int(max_bytes))
+    if n:
+        ctypes.memmove(int(addr), data, n)
+
+
+def _recv_buf(count: int, dt: Datatype):
+    nbytes = int(count) * dt.size_
+    if dt.np_dtype is not None:
+        itemsize = np.dtype(dt.np_dtype).itemsize
+        if nbytes % itemsize == 0:
+            return np.zeros(nbytes // itemsize, dt.np_dtype)
+    return np.zeros(nbytes, np.uint8)
+
+
+def _set_status(addr: int, src: int, tag: int, err: int, nbytes) -> None:
+    if addr == 0:
+        return
+    p = ctypes.cast(int(addr), _pi32)
+    p[0] = int(src)
+    p[1] = int(tag)
+    p[2] = int(err)
+    try:
+        p[3] = int(min(nbytes, 2**31 - 1))
+    except (OverflowError, ValueError):
+        p[3] = 0
+
+
+def _status_from(addr: int, st: Status) -> None:
+    src = st.source if st.source != PY_ANY_SOURCE else C_ANY_SOURCE
+    tag = st.tag if st.tag != PY_ANY_TAG else C_ANY_TAG
+    _set_status(addr, src, tag, MPI_SUCCESS, st.count)
+
+
+def _write_i32(addr: int, value: int) -> None:
+    if addr:
+        ctypes.cast(int(addr), _pi32)[0] = int(value)
+
+
+def _write_i64(addr: int, value: int) -> None:
+    if addr:
+        ctypes.cast(int(addr), _pi64)[0] = int(value)
+
+
+def _read_i32s(addr: int, n: int) -> List[int]:
+    p = ctypes.cast(int(addr), _pi32)
+    return [p[i] for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# Handle resolution
+# ---------------------------------------------------------------------------
+
+def _comm_of(ctx: _CRankCtx, handle: int) -> Optional[Comm]:
+    handle = int(handle)
+    if handle == COMM_WORLD:
+        return runtime.world()
+    if handle == COMM_SELF:
+        comm = ctx.comms.get(COMM_SELF)
+        if comm is None:
+            me = runtime.this_rank()
+            comm = Comm(Group([me]), id=("self", me))
+            ctx.comms[COMM_SELF] = comm
+        return comm
+    return ctx.comms.get(handle)
+
+
+def _new_comm_handle(ctx: _CRankCtx, comm: Optional[Comm]) -> int:
+    if comm is None:
+        return COMM_NULL
+    h = ctx.next_comm
+    ctx.next_comm += 1
+    ctx.comms[h] = comm
+    return h
+
+
+def _op_of(ctx: _CRankCtx, handle: int, dt: Datatype,
+           dt_handle: int = 0, count: Optional[int] = None) -> Op:
+    handle = int(handle)
+    if handle in (OP_MAXLOC, OP_MINLOC):
+        if dt.np_dtype is not None and np.dtype(dt.np_dtype).names:
+            return (_OP_MINLOC_STRUCT if handle == OP_MINLOC
+                    else _OP_MAXLOC_STRUCT)
+        return _ops.MPI_MINLOC if handle == OP_MINLOC else _ops.MPI_MAXLOC
+    op = ctx.ops[handle]
+    hint = getattr(op, "_dt_hint", None)
+    if hint is not None:
+        # user MPI_User_function: pass the real datatype handle and
+        # element count through to the C callback
+        hint["handle"] = int(dt_handle)
+        hint["count"] = None if count is None else int(count)
+    return op
+
+
+_USER_OP_CFUNC = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_void_p,
+                                  _pi32, _pi32)
+
+
+def _user_op(fn_addr: int, commute: bool, dt_hint: Dict) -> Op:
+    cfn = _USER_OP_CFUNC(fn_addr)
+
+    def fn(a, b):
+        a = np.ascontiguousarray(a)
+        inout = np.ascontiguousarray(b).copy()
+        n = _i32(int(dt_hint.get("count") or a.size))
+        dth = _i32(int(dt_hint.get("handle") or 0))
+        cfn(a.ctypes.data, inout.ctypes.data, ctypes.byref(n),
+            ctypes.byref(dth))
+        return inout
+
+    op = Op(fn, "user", commutative=bool(commute))
+    op._cfn = cfn          # keep the callback alive
+    op._dt_hint = dt_hint
+    return op
+
+
+# ---------------------------------------------------------------------------
+# Request helpers
+# ---------------------------------------------------------------------------
+
+def _new_req_handle(ctx: _CRankCtx, creq: _CReq) -> int:
+    h = ctx.next_req
+    ctx.next_req += 1
+    ctx.reqs[h] = creq
+    return h
+
+
+def _complete_creq(ctx: _CRankCtx, handle: int) -> None:
+    creq = ctx.reqs.pop(int(handle), None)
+    if creq is None:
+        return
+    if creq.kind == "recv":
+        _arr_out(creq.c_addr, creq.arr, dt=creq.dt)
+
+
+def _translate_src(src: int) -> int:
+    return PY_ANY_SOURCE if int(src) == C_ANY_SOURCE else int(src)
+
+
+def _translate_tag(tag: int) -> int:
+    return PY_ANY_TAG if int(tag) == C_ANY_TAG else int(tag)
+
+
+# ---------------------------------------------------------------------------
+# Operation handlers (each takes (ctx, args) -> int error code)
+# ---------------------------------------------------------------------------
+
+def _h_init(ctx, a):
+    ctx.initialized = True
+    return MPI_SUCCESS
+
+
+def _h_finalize(ctx, a):
+    ctx.finalized = True
+    return MPI_SUCCESS
+
+
+def _h_initialized(ctx, a):
+    _write_i32(a[0], 1 if ctx.initialized else 0)
+    return MPI_SUCCESS
+
+
+def _h_finalized(ctx, a):
+    _write_i32(a[0], 1 if ctx.finalized else 0)
+    return MPI_SUCCESS
+
+
+def _h_abort(ctx, a):
+    """Kill every other rank; the caller's C main keeps running (the
+    callback cannot unwind C frames) but all its later MPI calls fail."""
+    ctx.dead = True
+    ctx.exit_code = int(a[1])
+    from ..s4u import Actor
+    Actor.kill_all()
+    return MPI_SUCCESS
+
+
+def _h_comm_rank(ctx, a):
+    comm = _comm_of(ctx, a[0])
+    if comm is None:
+        return MPI_ERR_COMM
+    _write_i32(a[1], comm.rank())
+    return MPI_SUCCESS
+
+
+def _h_comm_size(ctx, a):
+    comm = _comm_of(ctx, a[0])
+    if comm is None:
+        return MPI_ERR_COMM
+    _write_i32(a[1], comm.size())
+    return MPI_SUCCESS
+
+
+def _h_comm_dup(ctx, a):
+    comm = _comm_of(ctx, a[0])
+    if comm is None:
+        return MPI_ERR_COMM
+    _write_i32(a[1], _new_comm_handle(ctx, comm.dup()))
+    return MPI_SUCCESS
+
+
+def _h_comm_split(ctx, a):
+    comm = _comm_of(ctx, a[0])
+    if comm is None:
+        return MPI_ERR_COMM
+    color, key = int(a[1]), int(a[2])
+    new = comm.split(-1 if color == C_UNDEFINED else color, key)
+    _write_i32(a[3], _new_comm_handle(ctx, new))
+    return MPI_SUCCESS
+
+
+def _h_comm_free(ctx, a):
+    h = ctypes.cast(int(a[0]), _pi32)[0] if a[0] else 0
+    ctx.comms.pop(int(h), None)
+    _write_i32(a[0], COMM_NULL)
+    return MPI_SUCCESS
+
+
+def _h_comm_group(ctx, a):
+    comm = _comm_of(ctx, a[0])
+    if comm is None:
+        return MPI_ERR_COMM
+    h = ctx.next_group
+    ctx.next_group += 1
+    ctx.groups[h] = comm.get_group()
+    _write_i32(a[1], h)
+    return MPI_SUCCESS
+
+
+def _h_group_size(ctx, a):
+    g = ctx.groups.get(int(a[0]))
+    _write_i32(a[1], g.size() if g is not None else 0)
+    return MPI_SUCCESS
+
+
+def _h_group_rank(ctx, a):
+    g = ctx.groups.get(int(a[0]))
+    if g is None:
+        _write_i32(a[1], C_UNDEFINED)
+        return MPI_SUCCESS
+    r = g.rank(runtime.this_rank())
+    _write_i32(a[1], r if r >= 0 else C_UNDEFINED)
+    return MPI_SUCCESS
+
+
+def _h_get_processor_name(ctx, a):
+    name = runtime.this_rank_state().host.name.encode()[:255]
+    ctypes.memmove(int(a[0]), name + b"\0", len(name) + 1)
+    _write_i32(a[1], len(name))
+    return MPI_SUCCESS
+
+
+# -- point-to-point ---------------------------------------------------------
+
+def _h_send(ctx, a, ssend=False):
+    buf, count, dth, dest, tag, ch = a[0], a[1], a[2], int(a[3]), int(a[4]), a[5]
+    if dest == C_PROC_NULL:
+        return MPI_SUCCESS
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    dt = _dt(ctx, dth)
+    arr = _arr_in(buf, count, dt)
+    if ssend:
+        comm.ssend(arr, dest, tag, count=int(count), datatype=dt)
+    else:
+        comm.send(arr, dest, tag, count=int(count), datatype=dt)
+    return MPI_SUCCESS
+
+
+def _h_recv(ctx, a):
+    buf, count, dth, src, tag, ch, st_addr = (a[0], a[1], a[2], int(a[3]),
+                                              int(a[4]), a[5], a[6])
+    if src == C_PROC_NULL:
+        _set_status(st_addr, C_PROC_NULL, C_ANY_TAG, MPI_SUCCESS, 0)
+        return MPI_SUCCESS
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    dt = _dt(ctx, dth)
+    arr = _recv_buf(count, dt)
+    status = Status()
+    comm.recv(_translate_src(src), _translate_tag(tag), buf=arr,
+              count=int(count), datatype=dt, status=status)
+    _arr_out(buf, arr, dt=dt)
+    _status_from(st_addr, status)
+    return MPI_SUCCESS
+
+
+def _h_isend(ctx, a):
+    buf, count, dth, dest, tag, ch, req_addr, ssend = \
+        a[0], a[1], a[2], int(a[3]), int(a[4]), a[5], a[6], int(a[7])
+    if dest == C_PROC_NULL:
+        _write_i32(req_addr, 0)
+        return MPI_SUCCESS
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    dt = _dt(ctx, dth)
+    arr = _arr_in(buf, count, dt)
+    req = comm.isend(arr, dest, int(tag), count=int(count), datatype=dt,
+                     ssend=bool(ssend))
+    _write_i32(req_addr, _new_req_handle(ctx, _CReq(req, 0, arr, "send")))
+    return MPI_SUCCESS
+
+
+def _h_irecv(ctx, a):
+    buf, count, dth, src, tag, ch, req_addr = (a[0], a[1], a[2], int(a[3]),
+                                               int(a[4]), a[5], a[6])
+    if src == C_PROC_NULL:
+        _write_i32(req_addr, 0)
+        return MPI_SUCCESS
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    dt = _dt(ctx, dth)
+    arr = _recv_buf(count, dt)
+    req = comm.irecv(_translate_src(src), _translate_tag(tag), buf=arr,
+                     count=int(count), datatype=dt)
+    _write_i32(req_addr, _new_req_handle(ctx, _CReq(req, int(buf), arr,
+                                                    "recv", dt)))
+    return MPI_SUCCESS
+
+
+def _h_wait(ctx, a):
+    req_addr, st_addr = a[0], a[1]
+    h = ctypes.cast(int(req_addr), _pi32)[0] if req_addr else 0
+    if h == 0:
+        _set_status(st_addr, C_ANY_SOURCE, C_ANY_TAG, MPI_SUCCESS, 0)
+        return MPI_SUCCESS
+    creq = ctx.reqs.get(int(h))
+    if creq is None:
+        return MPI_ERR_REQUEST
+    status = Status()
+    creq.req.wait(status)
+    _complete_creq(ctx, h)
+    _status_from(st_addr, status)
+    _write_i32(req_addr, 0)
+    return MPI_SUCCESS
+
+
+def _h_test(ctx, a):
+    req_addr, flag_addr, st_addr = a[0], a[1], a[2]
+    h = ctypes.cast(int(req_addr), _pi32)[0] if req_addr else 0
+    if h == 0:
+        _write_i32(flag_addr, 1)
+        return MPI_SUCCESS
+    creq = ctx.reqs.get(int(h))
+    if creq is None:
+        return MPI_ERR_REQUEST
+    status = Status()
+    done = creq.req.test(status)
+    _write_i32(flag_addr, 1 if done else 0)
+    if done:
+        _complete_creq(ctx, h)
+        _status_from(st_addr, status)
+        _write_i32(req_addr, 0)
+    return MPI_SUCCESS
+
+
+def _h_waitall(ctx, a):
+    n, reqs_addr, sts_addr = int(a[0]), a[1], a[2]
+    handles = _read_i32s(reqs_addr, n) if reqs_addr else []
+    for i, h in enumerate(handles):
+        if h == 0:
+            continue
+        creq = ctx.reqs.get(h)
+        if creq is None:
+            continue
+        status = Status()
+        creq.req.wait(status)
+        _complete_creq(ctx, h)
+        if sts_addr:
+            _status_from(int(sts_addr) + 16 * i, status)
+        ctypes.cast(int(reqs_addr), _pi32)[i] = 0
+    return MPI_SUCCESS
+
+
+def _h_waitany(ctx, a):
+    n, reqs_addr, idx_addr, st_addr = int(a[0]), a[1], a[2], a[3]
+    handles = _read_i32s(reqs_addr, n) if reqs_addr else []
+    live = [(i, h, ctx.reqs[h]) for i, h in enumerate(handles)
+            if h != 0 and h in ctx.reqs]
+    if not live:
+        _write_i32(idx_addr, C_UNDEFINED)
+        return MPI_SUCCESS
+    status = Status()
+    k = Request.waitany([c.req for _, _, c in live], status)
+    if k < 0:
+        _write_i32(idx_addr, C_UNDEFINED)
+        return MPI_SUCCESS
+    i, h, _creq = live[k]
+    _complete_creq(ctx, h)
+    _status_from(st_addr, status)
+    ctypes.cast(int(reqs_addr), _pi32)[i] = 0
+    _write_i32(idx_addr, i)
+    return MPI_SUCCESS
+
+
+def _h_testall(ctx, a):
+    n, reqs_addr, flag_addr, sts_addr = int(a[0]), a[1], a[2], a[3]
+    handles = _read_i32s(reqs_addr, n) if reqs_addr else []
+    live = [(i, h, ctx.reqs[h]) for i, h in enumerate(handles)
+            if h != 0 and h in ctx.reqs]
+    all_done = all(c.req.test() for _, _, c in live)
+    _write_i32(flag_addr, 1 if all_done else 0)
+    if all_done:
+        for i, h, c in live:
+            status = Status()
+            c.req.wait(status)      # already finished; fills status
+            _complete_creq(ctx, h)
+            if sts_addr:
+                _status_from(int(sts_addr) + 16 * i, status)
+            ctypes.cast(int(reqs_addr), _pi32)[i] = 0
+    return MPI_SUCCESS
+
+
+def _probe_once(comm, src, tag):
+    """One iprobe pass; on a match returns (src, tag, nbytes)."""
+    st = Status()
+    if not comm.iprobe(_translate_src(src), _translate_tag(tag),
+                       status=st):
+        return None
+    return (st.source, st.tag, st.count)
+
+
+def _h_probe(ctx, a):
+    src, tag, ch, st_addr = int(a[0]), int(a[1]), a[2], a[3]
+    if src == C_PROC_NULL:
+        _set_status(st_addr, C_PROC_NULL, C_ANY_TAG, MPI_SUCCESS, 0)
+        return MPI_SUCCESS
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    from ..s4u import this_actor
+    nsleeps = 1
+    while True:
+        hit = _probe_once(comm, src, tag)
+        if hit is not None:
+            break
+        # the reference's probe sleeps between polls so simulated time
+        # advances (smpi_request.cpp iprobe nsleeps escalation)
+        this_actor.sleep_for(1e-4 * nsleeps)
+        nsleeps = min(nsleeps + 1, 10)
+    _set_status(st_addr, hit[0], hit[1], MPI_SUCCESS, hit[2])
+    return MPI_SUCCESS
+
+
+def _h_iprobe(ctx, a):
+    src, tag, ch, flag_addr, st_addr = (int(a[0]), int(a[1]), a[2], a[3],
+                                        a[4])
+    if src == C_PROC_NULL:
+        _write_i32(flag_addr, 1)
+        _set_status(st_addr, C_PROC_NULL, C_ANY_TAG, MPI_SUCCESS, 0)
+        return MPI_SUCCESS
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    hit = _probe_once(comm, src, tag)
+    _write_i32(flag_addr, 0 if hit is None else 1)
+    if hit is not None:
+        _set_status(st_addr, hit[0], hit[1], MPI_SUCCESS, hit[2])
+    return MPI_SUCCESS
+
+
+def _h_sendrecv(ctx, a):
+    (sbuf, scount, stype, dest, stag,
+     rbuf, rcount, rtype, src, rtag, ch, st_addr) = a[:12]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    sdt, rdt = _dt(ctx, stype), _dt(ctx, rtype)
+    rreq = None
+    status = Status()
+    if int(src) != C_PROC_NULL:
+        rarr = _recv_buf(rcount, rdt)
+        rreq = comm.irecv(_translate_src(int(src)),
+                          _translate_tag(int(rtag)), buf=rarr,
+                          count=int(rcount), datatype=rdt)
+    sreq = None
+    if int(dest) != C_PROC_NULL:
+        sarr = _arr_in(sbuf, scount, sdt)
+        sreq = comm.isend(sarr, int(dest), int(stag), count=int(scount),
+                          datatype=sdt)
+    if rreq is not None:
+        rreq.wait(status)
+        _arr_out(rbuf, rarr, dt=rdt)
+    else:
+        status.source, status.tag, status.count = C_PROC_NULL, C_ANY_TAG, 0
+    if sreq is not None:
+        sreq.wait()
+    _status_from(st_addr, status)
+    return MPI_SUCCESS
+
+
+def _h_get_count(ctx, a):
+    st_addr, dth, count_addr = a[0], a[1], a[2]
+    if st_addr == 0:
+        _write_i32(count_addr, 0)
+        return MPI_SUCCESS
+    nbytes = ctypes.cast(int(st_addr), _pi32)[3]
+    dt = _dt(ctx, dth)
+    _write_i32(count_addr, nbytes // dt.size_ if dt.size_ else 0)
+    return MPI_SUCCESS
+
+
+# -- collectives ------------------------------------------------------------
+
+def _h_barrier(ctx, a):
+    comm = _comm_of(ctx, a[0])
+    if comm is None:
+        return MPI_ERR_COMM
+    comm.barrier()
+    return MPI_SUCCESS
+
+
+def _h_bcast(ctx, a):
+    buf, count, dth, root, ch = a[0], a[1], a[2], int(a[3]), a[4]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    dt = _dt(ctx, dth)
+    me = comm.rank()
+    obj = _arr_in(buf, count, dt) if me == root else None
+    out = comm.bcast(obj, root)
+    if me != root:
+        _arr_out(buf, out, int(count) * dt.size_)
+    return MPI_SUCCESS
+
+
+def _reduce_args(ctx, a):
+    sbuf, rbuf, count, dth = a[0], a[1], a[2], a[3]
+    dt = _dt(ctx, dth)
+    if int(sbuf) == C_IN_PLACE:
+        arr = _arr_in(rbuf, count, dt)
+    else:
+        arr = _arr_in(sbuf, count, dt)
+    return arr, rbuf, int(count), dt
+
+
+def _h_reduce(ctx, a):
+    comm = _comm_of(ctx, a[6])
+    if comm is None:
+        return MPI_ERR_COMM
+    arr, rbuf, count, dt = _reduce_args(ctx, a)
+    op = _op_of(ctx, a[4], dt, dt_handle=a[3], count=count)
+    root = int(a[5])
+    res = comm.reduce(arr, op, root)
+    if comm.rank() == root:
+        _arr_out(rbuf, np.asarray(res).astype(arr.dtype, copy=False),
+                 count * dt.size_)
+    return MPI_SUCCESS
+
+
+def _h_allreduce(ctx, a):
+    comm = _comm_of(ctx, a[5])
+    if comm is None:
+        return MPI_ERR_COMM
+    arr, rbuf, count, dt = _reduce_args(ctx, a)
+    op = _op_of(ctx, a[4], dt, dt_handle=a[3], count=count)
+    res = comm.allreduce(arr, op)
+    _arr_out(rbuf, np.asarray(res).astype(arr.dtype, copy=False),
+             count * dt.size_)
+    return MPI_SUCCESS
+
+
+def _h_gather(ctx, a):
+    sbuf, scount, stype, rbuf, rcount, rtype, root, ch = a[:8]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    me, root = comm.rank(), int(root)
+    rdt = _dt(ctx, rtype) if me == root else None
+    if int(sbuf) == C_IN_PLACE and me == root:
+        slice_addr = int(rbuf) + me * int(rcount) * rdt.extent_
+        arr = _arr_in(slice_addr, rcount, rdt)
+    else:
+        arr = _arr_in(sbuf, scount, _dt(ctx, stype))
+    res = comm.gather(arr, root)
+    if me == root:
+        stride = int(rcount) * rdt.extent_
+        for i, obj in enumerate(res):
+            _arr_out(int(rbuf) + i * stride, obj, int(rcount) * rdt.size_)
+    return MPI_SUCCESS
+
+
+def _h_gatherv(ctx, a):
+    sbuf, scount, stype, rbuf, rcounts, displs, rtype, root, ch = a[:9]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    me, root, n = comm.rank(), int(root), comm.size()
+    if int(sbuf) == C_IN_PLACE and me == root:
+        # MPI-2: root's contribution already sits at rbuf + displs[me]
+        rdt = _dt(ctx, rtype)
+        my_count = _read_i32s(rcounts, n)[me]
+        my_off = _read_i32s(displs, n)[me]
+        arr = _arr_in(int(rbuf) + my_off * rdt.extent_, my_count, rdt)
+    else:
+        arr = _arr_in(sbuf, scount, _dt(ctx, stype))
+    res = comm.gatherv(arr, root)
+    if me == root:
+        rdt = _dt(ctx, rtype)
+        counts = _read_i32s(rcounts, n)
+        offs = _read_i32s(displs, n)
+        for i, obj in enumerate(res):
+            _arr_out(int(rbuf) + offs[i] * rdt.extent_, obj,
+                     counts[i] * rdt.size_)
+    return MPI_SUCCESS
+
+
+def _h_allgather(ctx, a):
+    sbuf, scount, stype, rbuf, rcount, rtype, ch = a[:7]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    rdt = _dt(ctx, rtype)
+    me = comm.rank()
+    if int(sbuf) == C_IN_PLACE:
+        slice_addr = int(rbuf) + me * int(rcount) * rdt.extent_
+        arr = _arr_in(slice_addr, rcount, rdt)
+    else:
+        arr = _arr_in(sbuf, scount, _dt(ctx, stype))
+    res = comm.allgather(arr)
+    stride = int(rcount) * rdt.extent_
+    for i, obj in enumerate(res):
+        _arr_out(int(rbuf) + i * stride, obj, int(rcount) * rdt.size_)
+    return MPI_SUCCESS
+
+
+def _h_allgatherv(ctx, a):
+    sbuf, scount, stype, rbuf, rcounts, displs, rtype, ch = a[:8]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    n = comm.size()
+    rdt = _dt(ctx, rtype)
+    counts = _read_i32s(rcounts, n)
+    offs = _read_i32s(displs, n)
+    me = comm.rank()
+    if int(sbuf) == C_IN_PLACE:
+        slice_addr = int(rbuf) + offs[me] * rdt.extent_
+        arr = _arr_in(slice_addr, counts[me], rdt)
+    else:
+        arr = _arr_in(sbuf, scount, _dt(ctx, stype))
+    res = comm.allgatherv(arr)
+    for i, obj in enumerate(res):
+        _arr_out(int(rbuf) + offs[i] * rdt.extent_, obj,
+                 counts[i] * rdt.size_)
+    return MPI_SUCCESS
+
+
+def _h_scatter(ctx, a):
+    sbuf, scount, stype, rbuf, rcount, rtype, root, ch = a[:8]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    me, root, n = comm.rank(), int(root), comm.size()
+    sendobjs = None
+    if me == root:
+        sdt = _dt(ctx, stype)
+        stride = int(scount) * sdt.extent_
+        sendobjs = [_arr_in(int(sbuf) + i * stride, scount, sdt)
+                    for i in range(n)]
+    res = comm.scatter(sendobjs, root)
+    if not (me == root and int(rbuf) == C_IN_PLACE):
+        rdt = _dt(ctx, rtype)
+        _arr_out(rbuf, res, int(rcount) * rdt.size_)
+    return MPI_SUCCESS
+
+
+def _h_scatterv(ctx, a):
+    sbuf, scounts, displs, stype, rbuf, rcount, rtype, root, ch = a[:9]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    me, root, n = comm.rank(), int(root), comm.size()
+    sendobjs = None
+    if me == root:
+        sdt = _dt(ctx, stype)
+        counts = _read_i32s(scounts, n)
+        offs = _read_i32s(displs, n)
+        sendobjs = [_arr_in(int(sbuf) + offs[i] * sdt.extent_, counts[i],
+                            sdt) for i in range(n)]
+    res = comm.scatterv(sendobjs, root)
+    if not (me == root and int(rbuf) == C_IN_PLACE):
+        rdt = _dt(ctx, rtype)
+        _arr_out(rbuf, res, int(rcount) * rdt.size_)
+    return MPI_SUCCESS
+
+
+def _h_alltoall(ctx, a):
+    sbuf, scount, stype, rbuf, rcount, rtype, ch = a[:7]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    n = comm.size()
+    rdt = _dt(ctx, rtype)
+    if int(sbuf) == C_IN_PLACE:
+        # MPI-2.2: outgoing data is taken from recvbuf
+        rstride_in = int(rcount) * rdt.extent_
+        sendobjs = [_arr_in(int(rbuf) + i * rstride_in, rcount, rdt)
+                    for i in range(n)]
+    else:
+        sdt = _dt(ctx, stype)
+        sstride = int(scount) * sdt.extent_
+        sendobjs = [_arr_in(int(sbuf) + i * sstride, scount, sdt)
+                    for i in range(n)]
+    res = comm.alltoall(sendobjs)
+    rstride = int(rcount) * rdt.extent_
+    for i, obj in enumerate(res):
+        _arr_out(int(rbuf) + i * rstride, obj, int(rcount) * rdt.size_)
+    return MPI_SUCCESS
+
+
+def _h_alltoallv(ctx, a):
+    sbuf, scounts, sdispls, stype, rbuf, rcounts, rdispls, rtype, ch = a[:9]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    n = comm.size()
+    rdt = _dt(ctx, rtype)
+    rc = _read_i32s(rcounts, n)
+    ro = _read_i32s(rdispls, n)
+    if int(sbuf) == C_IN_PLACE:
+        # MPI-2.2: outgoing data is taken from recvbuf (rcounts/rdispls)
+        sendobjs = [_arr_in(int(rbuf) + ro[i] * rdt.extent_, rc[i], rdt)
+                    for i in range(n)]
+    else:
+        sdt = _dt(ctx, stype)
+        sc = _read_i32s(scounts, n)
+        so = _read_i32s(sdispls, n)
+        sendobjs = [_arr_in(int(sbuf) + so[i] * sdt.extent_, sc[i], sdt)
+                    for i in range(n)]
+    res = comm.alltoallv(sendobjs)
+    for i, obj in enumerate(res):
+        _arr_out(int(rbuf) + ro[i] * rdt.extent_, obj, rc[i] * rdt.size_)
+    return MPI_SUCCESS
+
+
+def _h_scan(ctx, a, exclusive=False):
+    comm = _comm_of(ctx, a[5])
+    if comm is None:
+        return MPI_ERR_COMM
+    arr, rbuf, count, dt = _reduce_args(ctx, a)
+    op = _op_of(ctx, a[4], dt, dt_handle=a[3], count=count)
+    if exclusive:
+        res = comm.exscan(arr, op)
+        if res is None:       # rank 0: result buffer is undefined
+            return MPI_SUCCESS
+    else:
+        res = comm.scan(arr, op)
+    _arr_out(rbuf, np.asarray(res).astype(arr.dtype, copy=False),
+             count * dt.size_)
+    return MPI_SUCCESS
+
+
+def _h_reduce_scatter(ctx, a):
+    sbuf, rbuf, rcounts, dth, oph, ch = a[:6]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    n = comm.size()
+    dt = _dt(ctx, dth)
+    counts = _read_i32s(rcounts, n)
+    op = _op_of(ctx, oph, dt, dt_handle=dth)
+    me = comm.rank()
+    if int(sbuf) == C_IN_PLACE:
+        total = sum(counts)
+        full = _arr_in(rbuf, total, dt)
+    else:
+        full = _arr_in(sbuf, sum(counts), dt)
+    sendobjs, off = [], 0
+    for c in counts:
+        sendobjs.append(full[off:off + c])
+        off += c
+    res = comm.reduce_scatter(sendobjs, op)
+    _arr_out(rbuf, np.asarray(res).astype(full.dtype, copy=False),
+             counts[me] * dt.size_)
+    return MPI_SUCCESS
+
+
+def _h_reduce_scatter_block(ctx, a):
+    sbuf, rbuf, rcount, dth, oph, ch = a[:6]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    n = comm.size()
+    counts_arr = (ctypes.c_int * n)(*([int(rcount)] * n))
+    return _h_reduce_scatter(
+        ctx, [sbuf, rbuf, ctypes.addressof(counts_arr), dth, oph, ch])
+
+
+# -- datatypes --------------------------------------------------------------
+
+def _h_type_size(ctx, a):
+    _write_i32(a[1], _dt(ctx, a[0]).size_)
+    return MPI_SUCCESS
+
+
+def _h_type_get_extent(ctx, a):
+    dt = _dt(ctx, a[0])
+    _write_i64(a[1], 0)
+    _write_i64(a[2], dt.extent_)
+    return MPI_SUCCESS
+
+
+def _new_dtype_handle(ctx, dt) -> int:
+    h = ctx.next_dtype
+    ctx.next_dtype += 1
+    ctx.dtypes[h] = dt
+    return h
+
+
+def _h_type_contiguous(ctx, a):
+    count, old = int(a[0]), _dt(ctx, a[1])
+    dt = Datatype.create_contiguous(count, old)
+    _write_i32(a[2], _new_dtype_handle(ctx, dt))
+    return MPI_SUCCESS
+
+
+def _h_type_vector(ctx, a):
+    count, blocklen, stride, old = (int(a[0]), int(a[1]), int(a[2]),
+                                    _dt(ctx, a[3]))
+    dt = Datatype.create_vector(count, blocklen, stride, old)
+    # C buffers really are strided: record the block layout so
+    # _arr_in/_arr_out gather/scatter the blocks, and drop the numpy
+    # element view (payloads travel packed)
+    dt.np_dtype = None
+    dt.c_layout = (count, blocklen, stride, old.size_)
+    _write_i32(a[4], _new_dtype_handle(ctx, dt))
+    return MPI_SUCCESS
+
+
+def _h_type_commit(ctx, a):
+    h = ctypes.cast(int(a[0]), _pi32)[0]
+    _dt(ctx, h).commit()
+    return MPI_SUCCESS
+
+
+def _h_type_free(ctx, a):
+    h = ctypes.cast(int(a[0]), _pi32)[0]
+    ctx.dtypes.pop(int(h), None)
+    _write_i32(a[0], 0)
+    return MPI_SUCCESS
+
+
+def _h_op_create(ctx, a):
+    fn_addr, commute, op_addr = int(a[0]), int(a[1]), a[2]
+    h = ctx.next_op
+    ctx.next_op += 1
+    hint: Dict = {}
+    ctx.ops[h] = _user_op(fn_addr, bool(commute), hint)
+    _write_i32(op_addr, h)
+    return MPI_SUCCESS
+
+
+def _h_op_free(ctx, a):
+    h = ctypes.cast(int(a[0]), _pi32)[0]
+    ctx.ops.pop(int(h), None)
+    _write_i32(a[0], 0)
+    return MPI_SUCCESS
+
+
+_HANDLERS = {
+    1: _h_init, 2: _h_finalize, 3: _h_initialized, 4: _h_finalized,
+    5: _h_abort, 6: _h_comm_rank, 7: _h_comm_size, 8: _h_comm_dup,
+    9: _h_comm_split, 10: _h_comm_free, 11: _h_send,
+    12: lambda c, a: _h_send(c, a, ssend=True), 13: _h_recv, 14: _h_isend,
+    15: _h_irecv, 16: _h_wait, 17: _h_test, 18: _h_waitall, 19: _h_waitany,
+    20: _h_testall, 21: _h_probe, 22: _h_iprobe, 23: _h_sendrecv,
+    24: _h_get_count, 25: _h_barrier, 26: _h_bcast, 27: _h_reduce,
+    28: _h_allreduce, 29: _h_gather, 30: _h_gatherv, 31: _h_allgather,
+    32: _h_allgatherv, 33: _h_scatter, 34: _h_scatterv, 35: _h_alltoall,
+    36: _h_alltoallv, 37: _h_scan,
+    38: lambda c, a: _h_scan(c, a, exclusive=True), 39: _h_reduce_scatter,
+    40: _h_reduce_scatter_block, 41: _h_type_size, 42: _h_type_get_extent,
+    43: _h_type_contiguous, 44: _h_type_vector, 45: _h_type_commit,
+    46: _h_type_free, 47: _h_op_create, 48: _h_op_free, 49: _h_comm_group,
+    50: _h_group_size, 51: _h_group_rank, 52: _h_get_processor_name,
+}
+
+#: ops that are pure local queries — no bench end/begin cycle needed
+_LOCAL_OPS = {3, 4, 24, 41, 42, 45, 46, 48, 50, 51}
+
+
+def _dispatch_py(opcode: int, args) -> int:
+    try:
+        ctx = _ctx()
+    except Exception:
+        sys.stderr.write("smpi.c_api: MPI call outside a rank actor\n")
+        return MPI_ERR_INTERN
+    if ctx.dead:
+        return MPI_ERR_OTHER
+    local = opcode in _LOCAL_OPS
+    try:
+        if not local:
+            _bench_end(ctx)
+        handler = _HANDLERS.get(opcode)
+        if handler is None:
+            return MPI_ERR_INTERN
+        return handler(ctx, args)
+    except Exception as exc:
+        from ..exceptions import ForcefulKillException
+        if isinstance(exc, ForcefulKillException):
+            # the actor was killed while blocked inside this MPI call;
+            # we cannot unwind the C frames below us — mark the rank
+            # dead so every later call returns an error fast
+            ctx.dead = True
+            return MPI_ERR_OTHER
+        import traceback
+        traceback.print_exc()
+        return MPI_ERR_INTERN
+    finally:
+        if not local and not ctx.dead:
+            _bench_begin(ctx)
+
+
+def _wtime_py() -> float:
+    from ..s4u import Engine
+    try:
+        ctx = _ctx()
+        _bench_end(ctx)
+        now = Engine.get_clock()
+        _bench_begin(ctx)
+        return now
+    except Exception:
+        return 0.0
+
+
+_DISPATCH_CFUNC = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int, _pi64)
+_WTIME_CFUNC = ctypes.CFUNCTYPE(ctypes.c_double)
+
+_dispatch_cb = _DISPATCH_CFUNC(_dispatch_py)
+_wtime_cb = _WTIME_CFUNC(_wtime_py)
+
+
+# ---------------------------------------------------------------------------
+# Compilation (tools/smpicc calls this too)
+# ---------------------------------------------------------------------------
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def compile_program(sources: Sequence[str], output: str,
+                    extra_flags: Sequence[str] = ()) -> str:
+    """smpicc: compile MPI C/C++ sources into a simulator-loadable .so
+    (reference src/smpi/smpicc.in — same trick: ``-Dmain=...`` renames
+    the user's main so every rank can call it)."""
+    root = _repo_root()
+    cxx = any(str(s).endswith((".cc", ".cpp", ".cxx")) for s in sources)
+    cc = os.environ.get("SMPI_CC", "g++" if cxx else "gcc")
+    cmd = [cc, "-shared", "-fPIC", "-O2",
+           "-I" + os.path.join(root, "include", "smpi"),
+           "-Dmain=smpi_c_main",
+           *[str(s) for s in sources],
+           os.path.join(root, "native", "smpi_shim.c"),
+           "-o", output, "-lm", *extra_flags]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"smpicc failed ({' '.join(cmd)}):\n"
+                           f"{proc.stderr}")
+    return output
+
+
+# ---------------------------------------------------------------------------
+# Execution
+# ---------------------------------------------------------------------------
+
+def run_c_program(program_so: str, np_ranks: Optional[int] = None,
+                  platform: Optional[str] = None,
+                  hosts: Optional[Sequence[str]] = None,
+                  hostfile: Optional[str] = None,
+                  configs: Sequence[str] = (),
+                  app_args: Sequence[str] = ()):
+    """smpirun for compiled C programs: deploy np ranks, each dlopening
+    a private copy of `program_so` (per-rank globals) and running its
+    renamed main. Returns (engine, exit_codes)."""
+    tmpdir = tempfile.mkdtemp(prefix="smpi-priv-")
+    exit_codes: Dict[int, int] = {}
+    _ctxs.clear()
+
+    def rank_main():
+        rank = runtime.this_rank()
+        path = os.path.join(tmpdir, f"rank{rank}.so")
+        shutil.copy(program_so, path)
+        lib = ctypes.CDLL(path, mode=ctypes.RTLD_LOCAL)
+        lib.smpi_set_callbacks(_dispatch_cb, _wtime_cb)
+        lib.smpi_c_main.restype = ctypes.c_int
+        argv_bytes = [os.fsencode(program_so)] + \
+            [a.encode() if isinstance(a, str) else a for a in app_args]
+        argc = len(argv_bytes)
+        argv = (ctypes.c_char_p * (argc + 1))(*argv_bytes, None)
+        ctx = _ctx()
+        _bench_begin(ctx)
+        rc = lib.smpi_c_main(_i32(argc), argv)
+        ctx.bench_t0 = None
+        exit_codes[rank] = (ctx.exit_code if ctx.exit_code is not None
+                            else int(rc))
+
+    try:
+        engine = runtime.smpirun(rank_main, platform=platform, np=np_ranks,
+                                 hosts=hosts, hostfile=hostfile,
+                                 configs=configs)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return engine, exit_codes

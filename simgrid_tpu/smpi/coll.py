@@ -529,6 +529,22 @@ def scan_linear(comm, sendobj, op: Op):
     return result
 
 
+@register("exscan", "default")
+@register("exscan", "linear")
+def exscan_linear(comm, sendobj, op: Op):
+    """Exclusive prefix reduction (MPI_Exscan): rank r receives the
+    reduction of ranks 0..r-1, forwards 0..r to r+1; rank 0's result is
+    undefined (returned as None)."""
+    rank, size = comm.rank(), comm.size()
+    below = None
+    if rank > 0:
+        below = comm.recv(rank - 1, TAG_SCAN)
+    if rank < size - 1:
+        inclusive = sendobj if below is None else op(below, sendobj)
+        comm.send(inclusive, rank + 1, TAG_SCAN)
+    return below
+
+
 # Extra algorithms + the mpich/ompi selector decision trees register
 # themselves into _ALGOS on import (kept in separate modules to keep
 # this one at the reference's default-selector scope).

@@ -93,10 +93,11 @@ class Comm:
 
     def isend(self, buf, dest: int, tag: int = 0,
               count: Optional[int] = None,
-              datatype: Optional[Datatype] = None) -> Request:
+              datatype: Optional[Datatype] = None,
+              ssend: bool = False) -> Request:
         from . import instr_hooks as tr
         req = Request("send", buf, 1 if count is None else count, datatype, dest, tag, self,
-                      is_isend=True)
+                      is_isend=True, ssend=ssend)
         with tr.p2p_span("isend", dest, tag, req) as visible:
             if visible:
                 tr.send_arrow(self, dest, tag, req.size)
@@ -133,13 +134,19 @@ class Comm:
         return data
 
     def iprobe(self, source: int = MPI_ANY_SOURCE,
-               tag: int = MPI_ANY_TAG) -> bool:
+               tag: int = MPI_ANY_TAG,
+               status: Optional[Status] = None) -> bool:
         from . import runtime
         from .request import match_recv
         probe = Request("recv", None, 1, None, source, tag, self)
         me = runtime.this_rank_state()
-        return (me.mailbox_small.iprobe(False, match_recv, probe) is not None
-                or me.mailbox.iprobe(False, match_recv, probe) is not None)
+        hit = (me.mailbox_small.iprobe(False, match_recv, probe) is not None
+               or me.mailbox.iprobe(False, match_recv, probe) is not None)
+        if hit and status is not None:
+            status.source = probe.real_src
+            status.tag = probe.real_tag
+            status.count = probe.real_size
+        return hit
 
     # -- collectives (dispatch through the selector) -----------------------
     def barrier(self) -> None:
@@ -203,6 +210,12 @@ class Comm:
         from . import coll, instr_hooks as tr
         with tr.noop_span("scan"):
             return coll.dispatch("scan")(self, sendobj, op)
+
+    def exscan(self, sendobj, op: Op = MPI_SUM):
+        """Exclusive prefix reduction; rank 0's result is None."""
+        from . import coll, instr_hooks as tr
+        with tr.noop_span("exscan"):
+            return coll.dispatch("exscan")(self, sendobj, op)
 
     # -- v-variants: per-peer payloads naturally carry their own sizes
     # in the object model, so the same algorithms serve (the reference

@@ -41,6 +41,8 @@ MPI_LAND = Op(_pairwise(np.logical_and, lambda x, y: bool(x) and bool(y)),
               "MPI_LAND")
 MPI_LOR = Op(_pairwise(np.logical_or, lambda x, y: bool(x) or bool(y)),
              "MPI_LOR")
+MPI_LXOR = Op(_pairwise(np.logical_xor,
+                        lambda x, y: bool(x) != bool(y)), "MPI_LXOR")
 MPI_BAND = Op(_pairwise(np.bitwise_and, lambda x, y: x & y), "MPI_BAND")
 MPI_BOR = Op(_pairwise(np.bitwise_or, lambda x, y: x | y), "MPI_BOR")
 MPI_BXOR = Op(_pairwise(np.bitwise_xor, lambda x, y: x ^ y), "MPI_BXOR")

@@ -38,7 +38,7 @@ declare_flag("smpi/coll-selector", "Which collective selector to use",
              "default")
 for _op in ("bcast", "barrier", "reduce", "allreduce", "alltoall",
             "allgather", "allgatherv", "gather", "scatter",
-            "reduce_scatter", "scan"):
+            "reduce_scatter", "scan", "exscan"):
     declare_flag(f"smpi/{_op}",
                  f"Which collective algorithm to use for {_op}", "default")
 

@@ -1,0 +1,403 @@
+"""PMPI C bindings: compile unmodified MPI C programs with smpicc and
+run them on the simulator (reference capability: smpicc + smpirun over
+the mpich3-test conformance suite, teshsuite/smpi/mpich3-test)."""
+
+import os
+import subprocess
+import textwrap
+
+import pytest
+
+from simgrid_tpu.smpi.c_api import compile_program, run_c_program
+
+pytestmark = pytest.mark.skipif(
+    subprocess.run(["which", "gcc"], capture_output=True).returncode != 0,
+    reason="no C compiler")
+
+PLATFORM = "/root/reference/examples/platforms/small_platform.xml"
+if not os.path.exists(PLATFORM):
+    PLATFORM = None      # fall back to the fabricated smpirun fabric
+
+# Deterministic timings: don't inject measured host compute.
+NO_BENCH = ("smpi/simulate-computation:false",)
+
+
+def _build(tmp_path, name, source):
+    src = tmp_path / f"{name}.c"
+    src.write_text(textwrap.dedent(source))
+    out = tmp_path / f"{name}.so"
+    compile_program([str(src)], str(out))
+    return str(out)
+
+
+def test_pingpong_c(tmp_path):
+    """Unmodified C ping-pong: globals privatized per rank, blocking
+    send/recv, statuses, wtime."""
+    prog = _build(tmp_path, "pingpong", r"""
+        #include <mpi.h>
+        #include <string.h>
+
+        int global_counter = 0;   /* privatization check: per-rank copy */
+
+        int main(int argc, char** argv) {
+            int rank, size, i;
+            double buf[128];
+            MPI_Status st;
+            MPI_Init(&argc, &argv);
+            MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+            MPI_Comm_size(MPI_COMM_WORLD, &size);
+            if (size < 2) { MPI_Finalize(); return 3; }
+            for (i = 0; i < 128; i++) buf[i] = rank * 1000.0 + i;
+            global_counter = rank + 7;
+            if (rank == 0) {
+                MPI_Send(buf, 128, MPI_DOUBLE, 1, 42, MPI_COMM_WORLD);
+                MPI_Recv(buf, 128, MPI_DOUBLE, 1, 43, MPI_COMM_WORLD, &st);
+                if (st.MPI_SOURCE != 1 || st.MPI_TAG != 43) return 10;
+                if (buf[5] != 1005.0) return 11;
+            } else if (rank == 1) {
+                MPI_Recv(buf, 128, MPI_DOUBLE, 0, 42, MPI_COMM_WORLD, &st);
+                int count;
+                MPI_Get_count(&st, MPI_DOUBLE, &count);
+                if (count != 128) return 12;
+                if (buf[5] != 5.0) return 13;
+                for (i = 0; i < 128; i++) buf[i] = 1000.0 + i;
+                MPI_Send(buf, 128, MPI_DOUBLE, 0, 43, MPI_COMM_WORLD);
+            }
+            if (global_counter != rank + 7) return 14;
+            MPI_Finalize();
+            return 0;
+        }
+    """)
+    engine, codes = run_c_program(
+        prog, np_ranks=2, platform=PLATFORM,
+        hosts=["Tremblay", "Jupiter"] if PLATFORM else None,
+        configs=NO_BENCH)
+    assert codes == {0: 0, 1: 0}
+    assert engine.clock > 0.0
+
+
+def test_collectives_c(tmp_path):
+    """Allreduce/bcast/gather/alltoall/scan/reduce_scatter with real
+    data through the selector-driven algorithms."""
+    prog = _build(tmp_path, "colls", r"""
+        #include <mpi.h>
+
+        int main(int argc, char** argv) {
+            int rank, size, i;
+            MPI_Init(&argc, &argv);
+            MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+            MPI_Comm_size(MPI_COMM_WORLD, &size);
+
+            /* allreduce */
+            long val = rank + 1, sum = 0;
+            MPI_Allreduce(&val, &sum, 1, MPI_LONG, MPI_SUM, MPI_COMM_WORLD);
+            if (sum != (long)size * (size + 1) / 2) return 20;
+
+            /* bcast */
+            int word[4] = {0, 0, 0, 0};
+            if (rank == 0) { word[0] = 11; word[1] = 22; word[2] = 33; word[3] = 44; }
+            MPI_Bcast(word, 4, MPI_INT, 0, MPI_COMM_WORLD);
+            if (word[2] != 33) return 21;
+
+            /* gather at root 1 */
+            int mine = 100 + rank;
+            int got[64];
+            MPI_Gather(&mine, 1, MPI_INT, got, 1, MPI_INT, 1, MPI_COMM_WORLD);
+            if (rank == 1)
+                for (i = 0; i < size; i++)
+                    if (got[i] != 100 + i) return 22;
+
+            /* alltoall */
+            int sendv[64], recvv[64];
+            for (i = 0; i < size; i++) sendv[i] = rank * 100 + i;
+            MPI_Alltoall(sendv, 1, MPI_INT, recvv, 1, MPI_INT, MPI_COMM_WORLD);
+            for (i = 0; i < size; i++)
+                if (recvv[i] != i * 100 + rank) return 23;
+
+            /* inclusive scan */
+            int pre = 0, one = 1;
+            MPI_Scan(&one, &pre, 1, MPI_INT, MPI_SUM, MPI_COMM_WORLD);
+            if (pre != rank + 1) return 24;
+
+            /* exscan */
+            int epre = -1;
+            MPI_Exscan(&one, &epre, 1, MPI_INT, MPI_SUM, MPI_COMM_WORLD);
+            if (rank == 0 && epre != -1) return 25;       /* undefined, untouched */
+            if (rank > 0 && epre != rank) return 26;
+
+            /* reduce_scatter_block */
+            int contrib[64], part = 0;
+            for (i = 0; i < size; i++) contrib[i] = rank;
+            MPI_Reduce_scatter_block(contrib, &part, 1, MPI_INT, MPI_SUM,
+                                     MPI_COMM_WORLD);
+            if (part != size * (size - 1) / 2) return 27;
+
+            /* allreduce IN_PLACE */
+            int acc = rank;
+            MPI_Allreduce(MPI_IN_PLACE, &acc, 1, MPI_INT, MPI_MAX,
+                          MPI_COMM_WORLD);
+            if (acc != size - 1) return 28;
+
+            /* maxloc */
+            struct { double v; int i; } in, out;
+            in.v = (rank == 2) ? 99.5 : 1.0 * rank;
+            in.i = rank;
+            MPI_Allreduce(&in, &out, 1, MPI_DOUBLE_INT, MPI_MAXLOC,
+                          MPI_COMM_WORLD);
+            if (size > 2 && out.i != 2) return 29;
+
+            MPI_Finalize();
+            return 0;
+        }
+    """)
+    engine, codes = run_c_program(prog, np_ranks=4, configs=NO_BENCH)
+    assert codes == {r: 0 for r in range(4)}
+
+
+def test_nonblocking_and_waitany_c(tmp_path):
+    prog = _build(tmp_path, "nbc", r"""
+        #include <mpi.h>
+
+        int main(int argc, char** argv) {
+            int rank, size, i;
+            MPI_Init(&argc, &argv);
+            MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+            MPI_Comm_size(MPI_COMM_WORLD, &size);
+            if (rank == 0) {
+                MPI_Request reqs[8];
+                int bufs[8];
+                MPI_Status sts[8];
+                for (i = 1; i < size; i++)
+                    MPI_Irecv(&bufs[i], 1, MPI_INT, i, 5, MPI_COMM_WORLD,
+                              &reqs[i - 1]);
+                MPI_Waitall(size - 1, reqs, sts);
+                for (i = 1; i < size; i++) {
+                    if (bufs[i] != i * i) return 30;
+                    if (reqs[i - 1] != MPI_REQUEST_NULL) return 31;
+                }
+                /* waitany path */
+                int b2 = -1;
+                MPI_Request r2;
+                MPI_Irecv(&b2, 1, MPI_INT, MPI_ANY_SOURCE, 6,
+                          MPI_COMM_WORLD, &r2);
+                MPI_Request arr[1]; arr[0] = r2;
+                int idx; MPI_Status st;
+                MPI_Waitany(1, arr, &idx, &st);
+                if (idx != 0 || b2 != 777 || st.MPI_TAG != 6) return 32;
+            } else {
+                int v = rank * rank;
+                MPI_Send(&v, 1, MPI_INT, 0, 5, MPI_COMM_WORLD);
+                if (rank == 1) { int w = 777;
+                    MPI_Send(&w, 1, MPI_INT, 0, 6, MPI_COMM_WORLD); }
+            }
+            MPI_Finalize();
+            return 0;
+        }
+    """)
+    engine, codes = run_c_program(prog, np_ranks=4, configs=NO_BENCH)
+    assert codes == {r: 0 for r in range(4)}
+
+
+def test_comm_split_and_user_op_c(tmp_path):
+    prog = _build(tmp_path, "splituop", r"""
+        #include <mpi.h>
+
+        static void myprod(void* in, void* inout, int* len,
+                           MPI_Datatype* dt) {
+            int i;
+            (void)dt;
+            for (i = 0; i < *len; i++)
+                ((int*)inout)[i] = ((int*)in)[i] * ((int*)inout)[i];
+        }
+
+        int main(int argc, char** argv) {
+            int rank, size;
+            MPI_Init(&argc, &argv);
+            MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+            MPI_Comm_size(MPI_COMM_WORLD, &size);
+
+            /* split into even/odd sub-communicators */
+            MPI_Comm sub;
+            MPI_Comm_split(MPI_COMM_WORLD, rank % 2, rank, &sub);
+            int subrank, subsize;
+            MPI_Comm_rank(sub, &subrank);
+            MPI_Comm_size(sub, &subsize);
+            if (subrank != rank / 2) return 40;
+
+            /* user-defined op across the sub-communicator */
+            MPI_Op prod;
+            MPI_Op_create(myprod, 1, &prod);
+            int v = rank + 2, out = 0;
+            MPI_Allreduce(&v, &out, 1, MPI_INT, prod, sub);
+            /* even comm ranks: 2*4*... ; odd: 3*5*... */
+            int expect = 1, r;
+            for (r = rank % 2; r < size; r += 2) expect *= r + 2;
+            if (out != expect) return 41;
+            MPI_Op_free(&prod);
+            MPI_Comm_free(&sub);
+
+            /* self communicator */
+            int me2 = -1;
+            MPI_Comm_rank(MPI_COMM_SELF, &me2);
+            if (me2 != 0) return 42;
+
+            MPI_Finalize();
+            return 0;
+        }
+    """)
+    engine, codes = run_c_program(prog, np_ranks=4, configs=NO_BENCH)
+    assert codes == {r: 0 for r in range(4)}
+
+
+def test_sendrecv_probe_types_c(tmp_path):
+    prog = _build(tmp_path, "srpt", r"""
+        #include <mpi.h>
+
+        int main(int argc, char** argv) {
+            int rank, size;
+            MPI_Init(&argc, &argv);
+            MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+            MPI_Comm_size(MPI_COMM_WORLD, &size);
+
+            /* ring sendrecv */
+            int right = (rank + 1) % size, left = (rank + size - 1) % size;
+            int out = rank, in = -1;
+            MPI_Status st;
+            MPI_Sendrecv(&out, 1, MPI_INT, right, 9, &in, 1, MPI_INT,
+                         left, 9, MPI_COMM_WORLD, &st);
+            if (in != left) return 50;
+
+            /* probe + typed recv */
+            if (rank == 0) {
+                float fv[3] = {1.5f, 2.5f, 3.5f};
+                MPI_Send(fv, 3, MPI_FLOAT, 1, 77, MPI_COMM_WORLD);
+            } else if (rank == 1) {
+                MPI_Status pst;
+                MPI_Probe(0, 77, MPI_COMM_WORLD, &pst);
+                int n;
+                MPI_Get_count(&pst, MPI_FLOAT, &n);
+                if (n != 3) return 51;
+                float got[3];
+                MPI_Recv(got, 3, MPI_FLOAT, 0, 77, MPI_COMM_WORLD, &pst);
+                if (got[1] != 2.5f) return 52;
+            }
+
+            /* contiguous derived type */
+            MPI_Datatype pair;
+            MPI_Type_contiguous(2, MPI_INT, &pair);
+            MPI_Type_commit(&pair);
+            int sz;
+            MPI_Type_size(pair, &sz);
+            if (sz != 8) return 53;
+            if (rank == 0) {
+                int data[4] = {7, 8, 9, 10};
+                MPI_Send(data, 2, pair, 1, 78, MPI_COMM_WORLD);
+            } else if (rank == 1) {
+                int data[4] = {0, 0, 0, 0};
+                MPI_Recv(data, 2, pair, 0, 78, MPI_COMM_WORLD, &st);
+                if (data[3] != 10) return 54;
+            }
+            MPI_Type_free(&pair);
+
+            MPI_Finalize();
+            return 0;
+        }
+    """)
+    engine, codes = run_c_program(prog, np_ranks=2, configs=NO_BENCH)
+    assert codes == {0: 0, 1: 0}
+
+
+def test_vector_type_strided_c(tmp_path):
+    """MPI_Type_vector sends must gather strided blocks (a matrix
+    column) and receives must scatter them back."""
+    prog = _build(tmp_path, "vec", r"""
+        #include <mpi.h>
+
+        int main(int argc, char** argv) {
+            int rank, i, j;
+            MPI_Status st;
+            MPI_Init(&argc, &argv);
+            MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+
+            /* one 4x5 row-major matrix; send column 2 */
+            MPI_Datatype col;
+            MPI_Type_vector(4, 1, 5, MPI_INT, &col);
+            MPI_Type_commit(&col);
+            int sz; MPI_Type_size(col, &sz);
+            if (sz != 16) return 70;
+
+            if (rank == 0) {
+                int m[4][5];
+                for (i = 0; i < 4; i++)
+                    for (j = 0; j < 5; j++) m[i][j] = 10 * i + j;
+                MPI_Send(&m[0][2], 1, col, 1, 3, MPI_COMM_WORLD);
+            } else if (rank == 1) {
+                int m[4][5];
+                for (i = 0; i < 4; i++)
+                    for (j = 0; j < 5; j++) m[i][j] = -1;
+                MPI_Recv(&m[0][2], 1, col, 0, 3, MPI_COMM_WORLD, &st);
+                /* column 2 filled with 2, 12, 22, 32; rest untouched */
+                for (i = 0; i < 4; i++) {
+                    if (m[i][2] != 10 * i + 2) return 71;
+                    if (m[i][1] != -1 || m[i][3] != -1) return 72;
+                }
+            }
+            MPI_Type_free(&col);
+            MPI_Finalize();
+            return 0;
+        }
+    """)
+    engine, codes = run_c_program(prog, np_ranks=2, configs=NO_BENCH)
+    assert codes == {0: 0, 1: 0}
+
+
+def test_wtime_and_bench_injection(tmp_path):
+    """With simulate-computation ON, host compute between MPI calls
+    advances the simulated clock (smpi_bench.cpp behavior)."""
+    prog = _build(tmp_path, "bench", r"""
+        #include <mpi.h>
+
+        int main(int argc, char** argv) {
+            MPI_Init(&argc, &argv);
+            double t0 = MPI_Wtime();
+            /* measurable host compute */
+            volatile double x = 1.0;
+            for (long i = 0; i < 30 * 1000 * 1000; i++) x = x * 1.0000001;
+            MPI_Barrier(MPI_COMM_WORLD);
+            double t1 = MPI_Wtime();
+            MPI_Finalize();
+            return (t1 > t0) ? 0 : 60;
+        }
+    """)
+    engine, codes = run_c_program(
+        prog, np_ranks=2,
+        configs=("smpi/simulate-computation:true",
+                 "smpi/host-speed:1000000000.0"))
+    assert codes == {0: 0, 1: 0}
+    # tens of ms of real compute at 1 Gflop/s on 100-flop/s fabric hosts
+    # would take ages; host-speed scales it: clock must have advanced
+    assert engine.clock > 0.0
+
+
+def test_deterministic_end_time(tmp_path):
+    """Same program, two runs -> identical simulated end time when
+    computation injection is off."""
+    prog = _build(tmp_path, "det", r"""
+        #include <mpi.h>
+        int main(int argc, char** argv) {
+            int rank, size, i;
+            double buf[1024];
+            MPI_Init(&argc, &argv);
+            MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+            MPI_Comm_size(MPI_COMM_WORLD, &size);
+            for (i = 0; i < 20; i++)
+                MPI_Allreduce(MPI_IN_PLACE, buf, 1024, MPI_DOUBLE,
+                              MPI_SUM, MPI_COMM_WORLD);
+            MPI_Finalize();
+            return 0;
+        }
+    """)
+    e1, c1 = run_c_program(prog, np_ranks=4, configs=NO_BENCH)
+    e2, c2 = run_c_program(prog, np_ranks=4, configs=NO_BENCH)
+    assert c1 == c2 == {r: 0 for r in range(4)}
+    assert e1.clock == e2.clock > 0.0
