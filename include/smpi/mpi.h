@@ -131,6 +131,50 @@ typedef struct MPI_Status {
 typedef void MPI_User_function(void* invec, void* inoutvec, int* len,
                                MPI_Datatype* datatype);
 
+/* -- MPI-IO ------------------------------------------------------------- */
+typedef int MPI_File;
+typedef int MPI_Info;
+#define MPI_FILE_NULL 0
+#define MPI_INFO_NULL 0
+#define MPI_MODE_CREATE 1
+#define MPI_MODE_RDONLY 2
+#define MPI_MODE_WRONLY 4
+#define MPI_MODE_RDWR 8
+#define MPI_MODE_DELETE_ON_CLOSE 16
+#define MPI_MODE_UNIQUE_OPEN 32
+#define MPI_MODE_EXCL 64
+#define MPI_MODE_APPEND 128
+#define MPI_MODE_SEQUENTIAL 256
+#define MPI_SEEK_SET 0
+#define MPI_SEEK_CUR 1
+#define MPI_SEEK_END 2
+
+int MPI_File_open(MPI_Comm comm, const char* filename, int amode,
+                  MPI_Info info, MPI_File* fh);
+int MPI_File_close(MPI_File* fh);
+int MPI_File_delete(const char* filename, MPI_Info info);
+int MPI_File_seek(MPI_File fh, MPI_Offset offset, int whence);
+int MPI_File_seek_shared(MPI_File fh, MPI_Offset offset, int whence);
+int MPI_File_get_position(MPI_File fh, MPI_Offset* offset);
+int MPI_File_get_size(MPI_File fh, MPI_Offset* size);
+int MPI_File_read(MPI_File fh, void* buf, int count, MPI_Datatype datatype,
+                  MPI_Status* status);
+int MPI_File_write(MPI_File fh, const void* buf, int count,
+                   MPI_Datatype datatype, MPI_Status* status);
+int MPI_File_read_at(MPI_File fh, MPI_Offset offset, void* buf, int count,
+                     MPI_Datatype datatype, MPI_Status* status);
+int MPI_File_write_at(MPI_File fh, MPI_Offset offset, const void* buf,
+                      int count, MPI_Datatype datatype, MPI_Status* status);
+int MPI_File_read_all(MPI_File fh, void* buf, int count,
+                      MPI_Datatype datatype, MPI_Status* status);
+int MPI_File_write_all(MPI_File fh, const void* buf, int count,
+                       MPI_Datatype datatype, MPI_Status* status);
+int MPI_File_read_shared(MPI_File fh, void* buf, int count,
+                         MPI_Datatype datatype, MPI_Status* status);
+int MPI_File_write_shared(MPI_File fh, const void* buf, int count,
+                          MPI_Datatype datatype, MPI_Status* status);
+int MPI_File_sync(MPI_File fh);
+
 /* -- environment -------------------------------------------------------- */
 int MPI_Init(int* argc, char*** argv);
 int MPI_Finalize(void);

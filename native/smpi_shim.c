@@ -83,7 +83,21 @@ enum {
   SMPI_OP_GROUP_SIZE,
   SMPI_OP_GROUP_RANK,
   SMPI_OP_GET_PROCESSOR_NAME,
+  SMPI_OP_FILE_OPEN,          /* 53 */
+  SMPI_OP_FILE_CLOSE,
+  SMPI_OP_FILE_DELETE,
+  SMPI_OP_FILE_SEEK,
+  SMPI_OP_FILE_SEEK_SHARED,
+  SMPI_OP_FILE_GET_POSITION,
+  SMPI_OP_FILE_GET_SIZE,
+  SMPI_OP_FILE_READ,          /* also at/all/shared via the mode arg */
+  SMPI_OP_FILE_WRITE,
+  SMPI_OP_FILE_SYNC,
 };
+
+/* sub-modes for FILE_READ / FILE_WRITE */
+enum { SMPI_IO_PLAIN = 0, SMPI_IO_AT = 1, SMPI_IO_ALL = 2,
+       SMPI_IO_SHARED = 3 };
 
 #define A(x) ((smpi_arg_t)(x))
 #define CALL(op, ...)                                  \
@@ -334,3 +348,68 @@ int MPI_Op_create(MPI_User_function* fn, int commute, MPI_Op* op) {
   CALL(SMPI_OP_OP_CREATE, A(fn), A(commute), A(op));
 }
 int MPI_Op_free(MPI_Op* op) { CALL(SMPI_OP_OP_FREE, A(op)); }
+
+/* -- MPI-IO ------------------------------------------------------------------ */
+int MPI_File_open(MPI_Comm comm, const char* filename, int amode,
+                  MPI_Info info, MPI_File* fh) {
+  (void)info;
+  CALL(SMPI_OP_FILE_OPEN, A(comm), A(filename), A(amode), A(fh));
+}
+int MPI_File_close(MPI_File* fh) { CALL(SMPI_OP_FILE_CLOSE, A(fh)); }
+int MPI_File_delete(const char* filename, MPI_Info info) {
+  (void)info;
+  CALL(SMPI_OP_FILE_DELETE, A(filename));
+}
+int MPI_File_seek(MPI_File fh, MPI_Offset offset, int whence) {
+  CALL(SMPI_OP_FILE_SEEK, A(fh), A(offset), A(whence));
+}
+int MPI_File_seek_shared(MPI_File fh, MPI_Offset offset, int whence) {
+  CALL(SMPI_OP_FILE_SEEK_SHARED, A(fh), A(offset), A(whence));
+}
+int MPI_File_get_position(MPI_File fh, MPI_Offset* offset) {
+  CALL(SMPI_OP_FILE_GET_POSITION, A(fh), A(offset));
+}
+int MPI_File_get_size(MPI_File fh, MPI_Offset* size) {
+  CALL(SMPI_OP_FILE_GET_SIZE, A(fh), A(size));
+}
+int MPI_File_read(MPI_File fh, void* buf, int count, MPI_Datatype datatype,
+                  MPI_Status* status) {
+  CALL(SMPI_OP_FILE_READ, A(fh), A(buf), A(count), A(datatype), A(status),
+       SMPI_IO_PLAIN, 0);
+}
+int MPI_File_write(MPI_File fh, const void* buf, int count,
+                   MPI_Datatype datatype, MPI_Status* status) {
+  CALL(SMPI_OP_FILE_WRITE, A(fh), A(buf), A(count), A(datatype), A(status),
+       SMPI_IO_PLAIN, 0);
+}
+int MPI_File_read_at(MPI_File fh, MPI_Offset offset, void* buf, int count,
+                     MPI_Datatype datatype, MPI_Status* status) {
+  CALL(SMPI_OP_FILE_READ, A(fh), A(buf), A(count), A(datatype), A(status),
+       SMPI_IO_AT, A(offset));
+}
+int MPI_File_write_at(MPI_File fh, MPI_Offset offset, const void* buf,
+                      int count, MPI_Datatype datatype, MPI_Status* status) {
+  CALL(SMPI_OP_FILE_WRITE, A(fh), A(buf), A(count), A(datatype), A(status),
+       SMPI_IO_AT, A(offset));
+}
+int MPI_File_read_all(MPI_File fh, void* buf, int count,
+                      MPI_Datatype datatype, MPI_Status* status) {
+  CALL(SMPI_OP_FILE_READ, A(fh), A(buf), A(count), A(datatype), A(status),
+       SMPI_IO_ALL, 0);
+}
+int MPI_File_write_all(MPI_File fh, const void* buf, int count,
+                       MPI_Datatype datatype, MPI_Status* status) {
+  CALL(SMPI_OP_FILE_WRITE, A(fh), A(buf), A(count), A(datatype), A(status),
+       SMPI_IO_ALL, 0);
+}
+int MPI_File_read_shared(MPI_File fh, void* buf, int count,
+                         MPI_Datatype datatype, MPI_Status* status) {
+  CALL(SMPI_OP_FILE_READ, A(fh), A(buf), A(count), A(datatype), A(status),
+       SMPI_IO_SHARED, 0);
+}
+int MPI_File_write_shared(MPI_File fh, const void* buf, int count,
+                          MPI_Datatype datatype, MPI_Status* status) {
+  CALL(SMPI_OP_FILE_WRITE, A(fh), A(buf), A(count), A(datatype), A(status),
+       SMPI_IO_SHARED, 0);
+}
+int MPI_File_sync(MPI_File fh) { CALL(SMPI_OP_FILE_SYNC, A(fh)); }

@@ -169,6 +169,8 @@ class _CRankCtx:
         self.next_req = 1
         self.groups: Dict[int, Group] = {}
         self.next_group = 10
+        self.files: Dict[int, object] = {}
+        self.next_file = 1
         self.bench_t0: Optional[float] = None
         self.initialized = False
         self.finalized = False
@@ -1148,6 +1150,116 @@ def _h_op_free(ctx, a):
     return MPI_SUCCESS
 
 
+# -- MPI-IO (file content is size-only in simulation, so the handlers
+# charge I/O time and fill statuses without moving buffer bytes) -------------
+
+_IO_PLAIN, _IO_AT, _IO_ALL, _IO_SHARED = 0, 1, 2, 3
+
+
+def _h_file_open(ctx, a):
+    from .file import MpiFileError, file_open
+    ch, name_addr, amode, fh_addr = a[:4]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    name = ctypes.string_at(int(name_addr)).decode()
+    try:
+        mf = file_open(comm, name, int(amode))
+    except MpiFileError:
+        _write_i32(fh_addr, 0)
+        return MPI_ERR_OTHER
+    h = ctx.next_file
+    ctx.next_file += 1
+    ctx.files[h] = mf
+    _write_i32(fh_addr, h)
+    return MPI_SUCCESS
+
+
+def _file_of(ctx, handle):
+    return ctx.files.get(int(handle))
+
+
+def _h_file_close(ctx, a):
+    h = ctypes.cast(int(a[0]), _pi32)[0] if a[0] else 0
+    mf = _file_of(ctx, h)
+    if mf is not None:
+        mf.close()
+        ctx.files.pop(int(h), None)
+    _write_i32(a[0], 0)
+    return MPI_SUCCESS
+
+
+def _h_file_delete(ctx, a):
+    from .file import file_delete
+    name = ctypes.string_at(int(a[0])).decode()
+    try:
+        file_delete(name)
+    except Exception:
+        return MPI_ERR_OTHER
+    return MPI_SUCCESS
+
+
+def _h_file_seek(ctx, a, shared=False):
+    mf = _file_of(ctx, a[0])
+    if mf is None:
+        return MPI_ERR_ARG
+    if shared:
+        mf.seek_shared(int(a[1]), int(a[2]))
+    else:
+        mf.seek(int(a[1]), int(a[2]))
+    return MPI_SUCCESS
+
+
+def _h_file_get_position(ctx, a):
+    mf = _file_of(ctx, a[0])
+    if mf is None:
+        return MPI_ERR_ARG
+    _write_i64(a[1], mf.get_position())
+    return MPI_SUCCESS
+
+
+def _h_file_get_size(ctx, a):
+    mf = _file_of(ctx, a[0])
+    if mf is None:
+        return MPI_ERR_ARG
+    _write_i64(a[1], mf.get_size())
+    return MPI_SUCCESS
+
+
+def _h_file_io(ctx, a, write: bool):
+    fh, _buf, count, dth, st_addr, mode, offset = a[:7]
+    mf = _file_of(ctx, fh)
+    if mf is None:
+        return MPI_ERR_ARG
+    from .file import MpiFileError
+    dt = _dt(ctx, dth)
+    size = int(count) * dt.size_
+    try:
+        mode = int(mode)
+        if mode == _IO_AT:
+            moved = (mf.write_at(int(offset), size) if write
+                     else mf.read_at(int(offset), size))
+        elif mode == _IO_ALL:
+            moved = mf.write_all(size) if write else mf.read_all(size)
+        elif mode == _IO_SHARED:
+            moved = (mf.write_shared(size) if write
+                     else mf.read_shared(size))
+        else:
+            moved = mf.write(size) if write else mf.read(size)
+    except MpiFileError:
+        return MPI_ERR_OTHER
+    _set_status(st_addr, 0, 0, MPI_SUCCESS, moved)
+    return MPI_SUCCESS
+
+
+def _h_file_sync(ctx, a):
+    mf = _file_of(ctx, a[0])
+    if mf is None:
+        return MPI_ERR_ARG
+    mf.sync()
+    return MPI_SUCCESS
+
+
 _HANDLERS = {
     1: _h_init, 2: _h_finalize, 3: _h_initialized, 4: _h_finalized,
     5: _h_abort, 6: _h_comm_rank, 7: _h_comm_size, 8: _h_comm_dup,
@@ -1164,6 +1276,11 @@ _HANDLERS = {
     43: _h_type_contiguous, 44: _h_type_vector, 45: _h_type_commit,
     46: _h_type_free, 47: _h_op_create, 48: _h_op_free, 49: _h_comm_group,
     50: _h_group_size, 51: _h_group_rank, 52: _h_get_processor_name,
+    53: _h_file_open, 54: _h_file_close, 55: _h_file_delete,
+    56: _h_file_seek, 57: lambda c, a: _h_file_seek(c, a, shared=True),
+    58: _h_file_get_position, 59: _h_file_get_size,
+    60: lambda c, a: _h_file_io(c, a, write=False),
+    61: lambda c, a: _h_file_io(c, a, write=True), 62: _h_file_sync,
 }
 
 #: ops that are pure local queries — no bench end/begin cycle needed

@@ -1,0 +1,205 @@
+"""MPI-IO: MPI_File over the file_system plugin's simulated storage.
+
+Reference: src/smpi/mpi/smpi_file.cpp — each rank holds a plugin File
+handle resolved on its own host's mounted storage; a per-path shared
+file pointer + mutex serves the *_shared operations; the *_ordered
+operations compute each rank's slot with a prefix scan of the sizes and
+then behave like read_at/write_at (the reference's File::op_all /
+seek_shared machinery, redesigned on the existing collectives).
+
+Sizes are byte counts (callers multiply count * datatype.size(), which
+is what the PMPI layer charges too).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from ..s4u.synchro import Mutex
+from .op import MPI_SUM
+
+# amode flags (values are this ABI's own; the MPI standard leaves them
+# implementation-defined)
+MPI_MODE_RDONLY = 2
+MPI_MODE_RDWR = 8
+MPI_MODE_WRONLY = 4
+MPI_MODE_CREATE = 1
+MPI_MODE_EXCL = 64
+MPI_MODE_DELETE_ON_CLOSE = 16
+MPI_MODE_UNIQUE_OPEN = 32
+MPI_MODE_APPEND = 128
+MPI_MODE_SEQUENTIAL = 256
+
+MPI_SEEK_SET = 0
+MPI_SEEK_CUR = 1
+MPI_SEEK_END = 2
+
+
+class MpiFileError(Exception):
+    pass
+
+
+class _SharedState:
+    """Per-(comm, path) shared file pointer + mutex
+    (smpi_file.cpp:40-60 shared_file_pointer_/shared_mutex_)."""
+
+    def __init__(self):
+        self.pointer = 0
+        self.mutex = Mutex()
+
+
+_shared: Dict[Tuple, _SharedState] = {}
+
+
+class MpiFile:
+    """An MPI file handle: per-rank plugin File + collective ops."""
+
+    def __init__(self, comm, filename: str, amode: int):
+        from ..plugins.file_system import File
+        self.comm = comm
+        self.filename = filename
+        self.amode = amode
+        self.closed = False
+        self._file = File(filename)       # resolved on this rank's host
+        if (amode & MPI_MODE_EXCL) and self._file.get_size() > 0:
+            raise MpiFileError(
+                f"MPI_MODE_EXCL: {filename} already exists")
+        if amode & MPI_MODE_APPEND:
+            self._file.seek(0, MPI_SEEK_END)
+        key = (comm.id, filename)
+        state = _shared.get(key)
+        if state is None:
+            state = _shared[key] = _SharedState()
+        self._state = state
+        # open is collective (smpi_file.cpp File constructor ends with
+        # a barrier over the communicator)
+        comm.barrier()
+
+    # -- individual file pointer ----------------------------------------
+    def seek(self, offset: int, whence: int = MPI_SEEK_SET) -> None:
+        self._file.seek(int(offset), whence)
+
+    def get_position(self) -> int:
+        return self._file.tell()
+
+    def get_size(self) -> int:
+        return self._file.get_size()
+
+    def read(self, size: int) -> int:
+        """Read `size` bytes at the individual pointer; returns bytes
+        actually moved (clamped at EOF like the reference)."""
+        self._check(MPI_MODE_WRONLY, "read")
+        return self._file.read(int(size))
+
+    def write(self, size: int) -> int:
+        self._check(MPI_MODE_RDONLY, "write")
+        return self._file.write(int(size))
+
+    def read_at(self, offset: int, size: int) -> int:
+        """Explicit-offset read; does not move the individual pointer."""
+        pos = self._file.tell()
+        self._file.seek(int(offset))
+        moved = self.read(size)
+        self._file.seek(pos)
+        return moved
+
+    def write_at(self, offset: int, size: int) -> int:
+        pos = self._file.tell()
+        self._file.seek(int(offset))
+        moved = self.write(size)
+        self._file.seek(pos)
+        return moved
+
+    # -- shared file pointer --------------------------------------------
+    def read_shared(self, size: int) -> int:
+        with self._state.mutex:
+            moved = self.read_at(self._state.pointer, size)
+            self._state.pointer += moved
+        return moved
+
+    def write_shared(self, size: int) -> int:
+        with self._state.mutex:
+            moved = self.write_at(self._state.pointer, size)
+            self._state.pointer += moved
+        return moved
+
+    def seek_shared(self, offset: int, whence: int = MPI_SEEK_SET) -> None:
+        with self._state.mutex:
+            if whence == MPI_SEEK_SET:
+                self._state.pointer = int(offset)
+            elif whence == MPI_SEEK_CUR:
+                self._state.pointer += int(offset)
+            else:
+                self._state.pointer = self.get_size() + int(offset)
+
+    def get_position_shared(self) -> int:
+        return self._state.pointer
+
+    # -- collective ops --------------------------------------------------
+    def read_all(self, size: int) -> int:
+        """Every rank reads at its individual pointer; completion is
+        collective (smpi_file.cpp File::op_all)."""
+        moved = self.read(size)
+        self.comm.barrier()
+        return moved
+
+    def write_all(self, size: int) -> int:
+        moved = self.write(size)
+        self.comm.barrier()
+        return moved
+
+    def read_ordered(self, size: int) -> int:
+        """Rank-ordered shared read: an exclusive prefix scan of the
+        sizes assigns each rank its slot after the shared pointer
+        (reference implements ordered ops with MPI_Scan the same way)."""
+        return self._ordered(size, write=False)
+
+    def write_ordered(self, size: int) -> int:
+        return self._ordered(size, write=True)
+
+    def _ordered(self, size: int, write: bool) -> int:
+        size = int(size)
+        before = self.comm.exscan(size, MPI_SUM)
+        offset = self._state.pointer + (0 if before is None else int(before))
+        if write:
+            moved = self.write_at(offset, size)
+        else:
+            moved = self.read_at(offset, size)
+        total = self.comm.allreduce(size, MPI_SUM)
+        if self.comm.rank() == 0:
+            self._state.pointer += int(total)
+        self.comm.barrier()
+        return moved
+
+    # -- lifecycle --------------------------------------------------------
+    def sync(self) -> None:
+        """No caching layer is simulated: sync is a barrier."""
+        self.comm.barrier()
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        if self.amode & MPI_MODE_DELETE_ON_CLOSE:
+            # one deleter is enough; rank 0 of the communicator does it
+            if self.comm.rank() == 0:
+                self._file.unlink()
+        _shared.pop((self.comm.id, self.filename), None)
+
+    def _check(self, forbidden: int, what: str) -> None:
+        if self.closed:
+            raise MpiFileError(f"{what} on closed file {self.filename}")
+        if self.amode & forbidden:
+            raise MpiFileError(
+                f"{what} not permitted by amode on {self.filename}")
+
+
+def file_open(comm, filename: str, amode: int) -> MpiFile:
+    """MPI_File_open (collective over comm)."""
+    return MpiFile(comm, filename, amode)
+
+
+def file_delete(filename: str, host=None) -> None:
+    """MPI_File_delete (not collective)."""
+    from ..plugins.file_system import File
+    File(filename, host).unlink()

@@ -266,6 +266,8 @@ def clear_process_data() -> None:
     """Reset cross-run module state (new smpirun)."""
     _samples.clear()
     _shared_blocks.clear()
+    from . import file as smpi_file
+    smpi_file._shared.clear()
 
 
 def smpi_instance_register(engine, fn, hosts: Sequence,

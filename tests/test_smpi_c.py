@@ -379,6 +379,71 @@ def test_wtime_and_bench_injection(tmp_path):
     assert engine.clock > 0.0
 
 
+IO_PLATFORM = """<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <storage_type id="t" size="500GiB">
+      <model_prop id="Bwrite" value="60MBps"/>
+      <model_prop id="Bread" value="200MBps"/>
+    </storage_type>
+    <host id="h0" speed="100Mf"/>
+    <host id="h1" speed="100Mf"/>
+    <storage id="d0" typeId="t" attach="h0"/>
+    <storage id="d1" typeId="t" attach="h1"/>
+    <link id="l" bandwidth="100MBps" latency="10us"/>
+    <route src="h0" dst="h1"><link_ctn id="l"/></route>
+  </zone>
+</platform>
+"""
+
+
+def test_mpi_io_c(tmp_path):
+    """MPI_File_* from an unmodified C program: open/write/seek/read/
+    get_size with simulated disk timing."""
+    plat = tmp_path / "io.xml"
+    plat.write_text(IO_PLATFORM)
+    prog = _build(tmp_path, "io", r"""
+        #include <mpi.h>
+
+        int main(int argc, char** argv) {
+            int rank;
+            double data[1000];
+            MPI_Status st;
+            MPI_File fh;
+            MPI_Init(&argc, &argv);
+            MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+
+            double t0 = MPI_Wtime();
+            MPI_File_open(MPI_COMM_WORLD, "/scratch/data.bin",
+                          MPI_MODE_RDWR | MPI_MODE_CREATE,
+                          MPI_INFO_NULL, &fh);
+            /* 6 MB write -> 0.1 s at 60 MBps */
+            MPI_File_write(fh, data, 750000, MPI_DOUBLE, &st);
+            double t1 = MPI_Wtime();
+            if (t1 - t0 < 0.09) return 80;
+
+            MPI_Offset sz, pos;
+            MPI_File_get_size(fh, &sz);
+            if (sz != 6000000) return 81;
+            MPI_File_seek(fh, 0, MPI_SEEK_SET);
+            MPI_File_get_position(fh, &pos);
+            if (pos != 0) return 82;
+            MPI_File_read(fh, data, 750000, MPI_DOUBLE, &st);
+            int n;
+            MPI_Get_count(&st, MPI_DOUBLE, &n);
+            if (n != 750000) return 83;
+            MPI_File_close(&fh);
+            if (fh != MPI_FILE_NULL) return 84;
+            MPI_Finalize();
+            return 0;
+        }
+    """)
+    engine, codes = run_c_program(prog, np_ranks=2, platform=str(plat),
+                                  hosts=["h0", "h1"], configs=NO_BENCH)
+    assert codes == {0: 0, 1: 0}
+    assert engine.clock > 0.1
+
+
 def test_deterministic_end_time(tmp_path):
     """Same program, two runs -> identical simulated end time when
     computation injection is off."""

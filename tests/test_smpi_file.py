@@ -1,0 +1,189 @@
+"""MPI-IO (smpi/file.py) over the file_system plugin.
+
+Reference: src/smpi/mpi/smpi_file.cpp + teshsuite/smpi/io-* tests."""
+
+import os
+
+import pytest
+
+from simgrid_tpu import s4u
+from simgrid_tpu.smpi import COMM_WORLD, runtime
+from simgrid_tpu.smpi.file import (MPI_MODE_CREATE, MPI_MODE_DELETE_ON_CLOSE,
+                                   MPI_MODE_RDONLY, MPI_MODE_RDWR,
+                                   MPI_SEEK_END, MPI_SEEK_SET, MpiFileError,
+                                   file_open)
+from simgrid_tpu.plugins import file_system
+
+# every host gets its own 60/200 MBps disk (same shape as the plugin
+# test's storage platform, one disk per rank host)
+IO_XML = """<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <storage_type id="crucial" size="500GiB">
+      <model_prop id="Bwrite" value="60MBps"/>
+      <model_prop id="Bread" value="200MBps"/>
+    </storage_type>
+{hosts}
+{storages}
+    <link id="l" bandwidth="100MBps" latency="10us"/>
+{routes}
+  </zone>
+</platform>
+"""
+
+
+def _platform(tmp_path, n):
+    hosts = "\n".join(f'    <host id="h{i}" speed="100Mf"/>'
+                      for i in range(n))
+    storages = "\n".join(
+        f'    <storage id="d{i}" typeId="crucial" attach="h{i}"/>'
+        for i in range(n))
+    routes = "\n".join(
+        f'    <route src="h{i}" dst="h{j}"><link_ctn id="l"/></route>'
+        for i in range(n) for j in range(i + 1, n))
+    path = os.path.join(tmp_path, "io.xml")
+    with open(path, "w") as f:
+        f.write(IO_XML.format(hosts=hosts, storages=storages,
+                              routes=routes))
+    return path
+
+
+def _run(tmp_path, n, fn):
+    plat = _platform(tmp_path, n)
+    out = {}
+    engine = runtime.smpirun(lambda: fn(out), platform=plat, np=n,
+                             hosts=[f"h{i}" for i in range(n)])
+    for host in engine.get_all_hosts():
+        file_system  # plugin content maps are per-storage, already live
+    return engine, out
+
+
+def test_individual_read_write(tmp_path):
+    def body(out):
+        me = COMM_WORLD.rank()
+        f = file_open(COMM_WORLD, "/scratch/out.bin",
+                      MPI_MODE_RDWR | MPI_MODE_CREATE)
+        written = f.write(60_000_000)            # 1s at 60MBps
+        out.setdefault("written", {})[me] = written
+        out.setdefault("t_write", {})[me] = s4u.Engine.get_clock()
+        f.seek(0, MPI_SEEK_SET)
+        got = f.read(60_000_000)                 # 0.3s at 200MBps
+        out.setdefault("read", {})[me] = got
+        assert f.get_position() == 60_000_000
+        assert f.get_size() == 60_000_000
+        f.close()
+
+    engine, out = _run(tmp_path, 2, body)
+    assert out["written"] == {0: 60_000_000, 1: 60_000_000}
+    assert out["read"] == {0: 60_000_000, 1: 60_000_000}
+    # each rank writes to its OWN host's disk: no contention, 1s each
+    # (plus the collective open's barrier, ~1e-4 of network time)
+    assert out["t_write"][0] == pytest.approx(1.0, abs=1e-3)
+    assert engine.clock == pytest.approx(1.3, abs=1e-3)
+
+
+def test_read_clamps_at_eof_and_amode(tmp_path):
+    def body(out):
+        f = file_open(COMM_WORLD, "/scratch/small.bin",
+                      MPI_MODE_RDWR | MPI_MODE_CREATE)
+        f.write(1000)
+        f.seek(0)
+        out["got"] = f.read(5000)                # only 1000 there
+        with pytest.raises(MpiFileError):
+            ro = file_open(COMM_WORLD, "/scratch/small.bin",
+                           MPI_MODE_RDONLY)
+            ro.write(10)
+        f.close()
+
+    _, out = _run(tmp_path, 1, body)
+    assert out["got"] == 1000
+
+
+def test_read_at_keeps_pointer(tmp_path):
+    def body(out):
+        f = file_open(COMM_WORLD, "/x", MPI_MODE_RDWR | MPI_MODE_CREATE)
+        f.write(10_000)
+        f.seek(100)
+        f.read_at(0, 5_000)
+        out["pos"] = f.get_position()
+        f.write_at(2_000, 1_000)
+        out["pos2"] = f.get_position()
+        out["size"] = f.get_size()
+        f.close()
+
+    _, out = _run(tmp_path, 1, body)
+    assert out["pos"] == 100
+    assert out["pos2"] == 100
+    assert out["size"] == 10_000
+
+
+def test_shared_pointer(tmp_path):
+    """Both ranks read through the shared pointer: slots never overlap
+    and the pointer ends at the sum."""
+    def body(out):
+        me = COMM_WORLD.rank()
+        f = file_open(COMM_WORLD, "/scratch/shared.bin",
+                      MPI_MODE_RDWR | MPI_MODE_CREATE)
+        # the file lives on each rank's own disk (per-host content
+        # maps, like the reference): populate both copies
+        f.write(8_000_000)
+        f.seek(0, MPI_SEEK_SET)
+        COMM_WORLD.barrier()
+        moved = f.read_shared(3_000_000)
+        out.setdefault("moved", {})[me] = moved
+        COMM_WORLD.barrier()
+        out["final_ptr"] = f.get_position_shared()
+        f.close()
+
+    _, out = _run(tmp_path, 2, body)
+    assert out["moved"] == {0: 3_000_000, 1: 3_000_000}
+    assert out["final_ptr"] == 6_000_000
+
+
+def test_ordered_write(tmp_path):
+    """write_ordered assigns rank-ordered, non-overlapping slots and
+    advances the shared pointer by the total."""
+    def body(out):
+        me = COMM_WORLD.rank()
+        f = file_open(COMM_WORLD, "/scratch/ordered.bin",
+                      MPI_MODE_RDWR | MPI_MODE_CREATE)
+        f.write_ordered(1_000_000 * (me + 1))    # sizes 1MB,2MB,3MB
+        out["ptr"] = f.get_position_shared()
+        out.setdefault("size", {})[me] = f.get_size()
+        f.close()
+
+    _, out = _run(tmp_path, 3, body)
+    assert out["ptr"] == 6_000_000
+    # rank 2 wrote [3MB, 6MB): its host's copy of the file is 6MB
+    assert out["size"][2] == 6_000_000
+
+
+def test_delete_on_close_and_collective_all(tmp_path):
+    def body(out):
+        me = COMM_WORLD.rank()
+        f = file_open(COMM_WORLD, "/scratch/tmp.bin",
+                      MPI_MODE_RDWR | MPI_MODE_CREATE
+                      | MPI_MODE_DELETE_ON_CLOSE)
+        f.write_all(2_000_000)
+        out.setdefault("t", {})[me] = s4u.Engine.get_clock()
+        f.seek(0, MPI_SEEK_SET)
+        f.read_all(2_000_000)
+        f.close()
+
+    engine, out = _run(tmp_path, 2, body)
+    # write_all is collective: no rank leaves before the slowest one
+    # finished its write (barrier exit skew is network-latency sized)
+    assert out["t"][0] == pytest.approx(out["t"][1], abs=1e-3)
+    assert min(out["t"].values()) > 0.03       # both paid the 2MB write
+
+
+def test_seek_end_and_append(tmp_path):
+    def body(out):
+        f = file_open(COMM_WORLD, "/y", MPI_MODE_RDWR | MPI_MODE_CREATE)
+        f.write(500)
+        f.seek(-100, MPI_SEEK_END)
+        out["pos"] = f.get_position()
+        f.close()
+
+    _, out = _run(tmp_path, 1, body)
+    assert out["pos"] == 400
