@@ -34,7 +34,8 @@ TAG_SCAN = -19
 _ALGOS: Dict[str, Dict[str, Callable]] = {}
 
 
-_SELECTORS = ("default", "mpich", "ompi")
+_SELECTORS = ("default", "mpich", "ompi", "mvapich2", "impi",
+              "automatic")
 
 
 def register(op: str, name: str):
@@ -550,3 +551,4 @@ def exscan_linear(comm, sendobj, op: Op):
 # this one at the reference's default-selector scope).
 from . import coll_extra  # noqa: E402,F401  (registration side effects)
 from . import coll_selectors  # noqa: E402,F401
+from . import coll_selectors_extra  # noqa: E402,F401

@@ -26,10 +26,10 @@ from .coll import (TAG_ALLGATHER, TAG_ALLREDUCE, TAG_ALLTOALL, TAG_BARRIER,
                    TAG_BCAST,
                    TAG_GATHER, TAG_REDUCE, TAG_REDUCE_SCATTER, TAG_SCATTER,
                    allgather_rdb, allgather_ring, allreduce_lr,
-                   allreduce_rdb, alltoall_basic_linear, alltoall_pairwise,
-                   barrier_bruck, bcast_binomial_tree, dispatch,
-                   gather_linear, reduce_binomial, reduce_linear, register,
-                   scatter_linear)
+                   allreduce_rdb, alltoall_basic_linear, alltoall_bruck,
+                   alltoall_pairwise, barrier_bruck, bcast_binomial_tree,
+                   dispatch, dispatch_name, gather_linear, reduce_binomial,
+                   reduce_linear, register, scatter_linear)
 from .datatype import MPI_BYTE
 from .op import Op
 
@@ -746,3 +746,43 @@ def scatter_ompi_binomial(comm, sendobjs, root: int = 0):
                       datatype=MPI_BYTE)
         mask <<= 1
     return mine[rank]
+
+
+@register("alltoall", "rdb")
+def alltoall_rdb(comm, sendobjs):
+    """Recursive-doubling alltoall (alltoall-rdb.cpp, the
+    MPIR_Alltoall_RD_MV2 of the mvapich2 tables): log2(p) rounds, each
+    shipping the half of the working set whose destination bit is set;
+    non-power-of-two communicators fall back to bruck like the
+    reference's guard."""
+    rank, size = comm.rank(), comm.size()
+    if size & (size - 1):
+        return alltoall_bruck(comm, sendobjs)
+    # working set: src -> {dst -> payload}; starts with my column
+    working = {rank: dict(enumerate(sendobjs))}
+    mask = 1
+    while mask < size:
+        peer = rank ^ mask
+        ship = {}
+        for src in list(working):
+            row = working[src]
+            give = {dst: row.pop(dst) for dst in list(row)
+                    if (dst & mask) != (rank & mask)}
+            if give:
+                ship[src] = give
+        nbytes = sum(_payload_bytes(v) for row in ship.values()
+                     for v in row.values())
+        got = comm.sendrecv(ship, peer, peer, TAG_ALLTOALL, TAG_ALLTOALL)
+        for src, row in got.items():
+            working.setdefault(src, {}).update(row)
+        mask <<= 1
+    return [working[src][rank] for src in range(size)]
+
+
+@register("allgather", "GB")
+def allgather_gb(comm, sendobj):
+    """Gather-then-broadcast allgather (allgather-GB.cpp, the intel
+    tables' fourth allgather entry): default gather to root 0, then
+    default bcast of the assembled vector."""
+    gathered = dispatch_name("gather", "default")(comm, sendobj, 0)
+    return dispatch_name("bcast", "default")(comm, gathered, 0)

@@ -1,0 +1,353 @@
+"""mvapich2, Intel-MPI (impi) and automatic collective selectors.
+
+Completes the selector family of smpi_coll.cpp:33-118:
+
+* ``mvapich2`` — table-driven decisions re-derived from the stampede
+  1-ppn tuning tables (smpi_mvapich2_selector.cpp +
+  smpi_mvapich2_selector_stampede.hpp).  Simulated deployments place
+  one rank per host, so the SMP two-level / shmem / zero-copy variants
+  degenerate to their flat equivalents, which is what the maps below
+  encode (each non-obvious mapping is commented).
+* ``impi`` — the I_MPI_ADJUST decision procedure of
+  smpi_intel_mpi_selector.cpp: pick the numproc row, then the first
+  size regime with block < max_size, then the 1-based algorithm index
+  (ppn=1 tables, extracted to coll_intel_tables.py by
+  tools/extract_intel_tables.py).
+* ``automatic`` — runs every concrete algorithm for the requested
+  collective, timing each between default barriers and reporting the
+  per-rank and global quickest (smpi_automatic_selector.cpp
+  AUTOMATIC_COLL_BENCH), then leaves the last result standing.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..utils.log import get_category
+from .coll import _ALGOS, dispatch_name, register
+from .coll_intel_tables import INTEL_TABLES
+from .coll_selectors import _require_symmetric
+from .datatype import payload_size
+from .op import MPI_MAX, Op
+
+log = get_category("smpi_coll")
+
+
+def _is_pof2(n: int) -> bool:
+    return n & (n - 1) == 0
+
+
+# ---------------------------------------------------------------------------
+# mvapich2 (stampede 1-ppn tables; two-level variants degenerate)
+# ---------------------------------------------------------------------------
+
+def _mv2_pick(table, comm_size: int, nbytes: float) -> str:
+    """The selector's double range walk (smpi_mvapich2_selector.cpp:
+    40-52): numproc row, then first size band with nbytes <= max
+    (max = -1 is the open-ended band)."""
+    i = 0
+    while i < len(table) - 1 and comm_size > table[i][0]:
+        i += 1
+    bands = table[i][1]
+    k = 0
+    while (k < len(bands) - 1 and bands[k][1] != -1
+           and nbytes > bands[k][1]):
+        k += 1
+    return bands[k][2]
+
+
+# RD -> rdb; Scatter_dest / bruck / pairwise are direct equivalents
+_MV2_ALLTOALL = [
+    (2, [(0, -1, "pair")]),
+    (4, [(0, 262144, "mvapich2_scatter_dest"), (262144, -1, "pair")]),
+    (8, [(0, 8, "rdb"), (8, -1, "mvapich2_scatter_dest")]),
+    (16, [(0, 64, "rdb"), (64, 512, "bruck"),
+          (512, -1, "mvapich2_scatter_dest")]),
+    (32, [(0, 32, "rdb"), (32, 2048, "bruck"),
+          (2048, -1, "mvapich2_scatter_dest")]),
+    (64, [(0, 8, "rdb"), (8, 1024, "bruck"),
+          (1024, -1, "mvapich2_scatter_dest")]),
+]
+
+_MV2_ALLGATHER = [
+    (2, [(0, -1, "ring")]),
+    (4, [(0, 262144, "rdb"), (262144, -1, "ring")]),
+    (8, [(0, 131072, "rdb"), (131072, -1, "ring")]),
+    (16, [(0, 131072, "rdb"), (131072, -1, "ring")]),
+    (32, [(0, 65536, "rdb"), (65536, -1, "ring")]),
+    (64, [(0, 32768, "rdb"), (32768, -1, "ring")]),
+]
+
+# pt2pt_rd -> rdb; pt2pt_rs (reduce-scatter + allgather) -> rab_rdb,
+# the closest registered Rabenseifner shape
+_MV2_ALLREDUCE = [
+    (16, [(0, 1024, "rdb"), (1024, -1, "rab_rdb")]),
+    (32, [(0, 16384, "rdb"), (16384, -1, "rab_rdb")]),
+    (64, [(0, 16384, "rdb"), (16384, -1, "rab_rdb")]),
+    (128, [(0, 16384, "rdb"), (16384, -1, "rab_rdb")]),
+    (256, [(0, 16384, "rdb"), (16384, -1, "rab_rdb")]),
+    (512, [(0, 16384, "rdb"), (16384, -1, "rab_rdb")]),
+    (1024, [(0, 8192, "rdb"), (8192, -1, "rab_rdb")]),
+    (2048, [(0, 8192, "rdb"), (8192, -1, "rab_rdb")]),
+]
+
+# Zcpy-pipelined and Shmem variants degenerate to the mpich chooser
+# (their own SimGrid mapping, stampede hpp:958-964); the scatter-
+# allgather composites are direct equivalents
+_MV2_BCAST = [
+    (16, [(0, 8192, "mpich"), (8192, 16384, "binomial_tree"),
+          (16384, 65536, "mpich"), (65536, 262144, "scatter_LR_allgather"),
+          (262144, 524288, "scatter_rdb_allgather"),
+          (524288, -1, "scatter_LR_allgather")]),
+    (2048, [(0, -1, "mpich")]),    # rows >16: pipelined-zcpy everywhere
+]
+
+# knomial(k=4) degenerates to the binomial tree; redscat_gather is the
+# registered scatter_gather (Rabenseifner) reduce
+_MV2_REDUCE = [
+    (16, [(0, 1048576, "binomial"), (1048576, -1, "scatter_gather")]),
+    (32, [(0, 1048576, "binomial"), (1048576, -1, "scatter_gather")]),
+    (64, [(0, 262144, "binomial"), (262144, -1, "scatter_gather")]),
+    (2048, [(0, 1048576, "binomial"), (1048576, -1, "scatter_gather")]),
+]
+
+_MV2_SCATTER = [
+    (2, [(0, -1, "ompi_binomial")]),
+    (32, [(0, -1, "ompi_basic_linear")]),
+    (64, [(0, 32, "ompi_binomial"), (32, -1, "ompi_basic_linear")]),
+]
+
+# two_level_Direct degenerates to Direct (= ompi_basic_linear);
+# MPIR_Gather_intra is the mpich chooser
+_MV2_GATHER = [
+    (16, [(0, 524288, "ompi_basic_linear"), (524288, -1, "mpich")]),
+    (32, [(0, 16384, "ompi_basic_linear"), (16384, 131072, "mpich"),
+          (131072, -1, "ompi_basic_linear")]),
+    (2048, [(0, -1, "ompi_basic_linear")]),
+]
+
+
+@register("alltoall", "mvapich2")
+def alltoall_mvapich2(comm, sendobjs: List):
+    nbytes = payload_size(sendobjs[0], None) if sendobjs else 0
+    name = _mv2_pick(_MV2_ALLTOALL, comm.size(), nbytes)
+    return dispatch_name("alltoall", name)(comm, sendobjs)
+
+
+@register("allgather", "mvapich2")
+def allgather_mvapich2(comm, sendobj):
+    _require_symmetric(sendobj, "allgather")
+    nbytes = payload_size(sendobj, None)
+    name = _mv2_pick(_MV2_ALLGATHER, comm.size(), nbytes)
+    return dispatch_name("allgather", name)(comm, sendobj)
+
+
+@register("allreduce", "mvapich2")
+def allreduce_mvapich2(comm, sendobj, op: Op):
+    nbytes = payload_size(sendobj, None)
+    name = _mv2_pick(_MV2_ALLREDUCE, comm.size(), nbytes)
+    return dispatch_name("allreduce", name)(comm, sendobj, op)
+
+
+@register("bcast", "mvapich2")
+def bcast_mvapich2(comm, obj, root: int = 0):
+    _require_symmetric(obj, "bcast")
+    nbytes = payload_size(obj, None)
+    name = _mv2_pick(_MV2_BCAST, comm.size(), nbytes)
+    return dispatch_name("bcast", name)(comm, obj, root)
+
+
+@register("reduce", "mvapich2")
+def reduce_mvapich2(comm, sendobj, op: Op, root: int = 0):
+    _require_symmetric(sendobj, "reduce")
+    nbytes = payload_size(sendobj, None)
+    name = _mv2_pick(_MV2_REDUCE, comm.size(), nbytes)
+    return dispatch_name("reduce", name)(comm, sendobj, op, root)
+
+
+@register("scatter", "mvapich2")
+def scatter_mvapich2(comm, sendobjs, root: int = 0):
+    nbytes = payload_size(sendobjs[0], None) if sendobjs else 0
+    name = _mv2_pick(_MV2_SCATTER, comm.size(), nbytes)
+    return dispatch_name("scatter", name)(comm, sendobjs, root)
+
+
+@register("gather", "mvapich2")
+def gather_mvapich2(comm, sendobj, root: int = 0):
+    _require_symmetric(sendobj, "gather")
+    nbytes = payload_size(sendobj, None)
+    name = _mv2_pick(_MV2_GATHER, comm.size(), nbytes)
+    return dispatch_name("gather", name)(comm, sendobj, root)
+
+
+@register("barrier", "mvapich2")
+def barrier_mvapich2(comm):
+    """mvapich2_pair = pairwise-exchange barrier = the registered
+    recursive-doubling barrier (smpi_mvapich2_selector.cpp:456)."""
+    return dispatch_name("barrier", "ompi_recursivedoubling")(comm)
+
+
+@register("reduce_scatter", "mvapich2")
+def reduce_scatter_mvapich2(comm, sendobjs: List, op: Op):
+    """mvapich2 has no reduce_scatter table; its fallback is the mpich
+    chooser (smpi_coll.cpp default wiring)."""
+    return dispatch_name("reduce_scatter", "mpich")(comm, sendobjs, op)
+
+
+# ---------------------------------------------------------------------------
+# Intel MPI (impi)
+# ---------------------------------------------------------------------------
+
+#: 1-based algorithm index -> registered algorithm, one list per op
+#: (the intel_*_functions_table arrays; SMP/two-level and the unknown
+#: proprietary entries map to their flat SimGrid substitutes exactly as
+#: the reference's own tables do)
+_INTEL_FUNCS = {
+    "allreduce": ["rdb", "rab_rdb", "redbcast", "rdb", "redbcast",
+                  "rdb", "ompi_ring_segmented", "ompi_ring_segmented"],
+    "alltoall": ["bruck", "mvapich2_scatter_dest", "pair", "mvapich2"],
+    "barrier": ["ompi_basic_linear", "ompi_recursivedoubling",
+                "ompi_basic_linear", "ompi_recursivedoubling",
+                # gather+scatter through root ~ centralized linear
+                "ompi_basic_linear", "ompi_basic_linear"],
+    "bcast": ["binomial_tree", "ompi_pipeline", "ompi_pipeline",
+              "binomial_tree", "ompi_pipeline", "flat_tree", "mvapich2"],
+    "reduce": ["mvapich2", "binomial", "mvapich2", "binomial",
+               "scatter_gather", "scatter_gather"],
+    "reduce_scatter": ["ompi_basic_recursivehalving", "mpich_pair",
+                       "mpich_rdb", "default", "default"],
+    "allgather": ["rdb", "bruck", "ring", "GB"],
+    "allgatherv": ["rdb", "bruck", "ring", "GB"],
+    "gather": ["ompi_binomial", "ompi_binomial", "mvapich2"],
+    "scatter": ["ompi_binomial", "ompi_binomial", "mvapich2"],
+    "alltoallv": ["basic_linear", "bruck"],
+}
+
+
+def _intel_pick(op: str, comm_size: int, block_dsize: float) -> str:
+    """IMPI_COLL_SELECT: numproc row (first max_num_proc >= size),
+    then first size regime with block < max_size (strict, the C loop
+    advances while block >= max), then the 1-based index."""
+    table = INTEL_TABLES[op]
+    j = 0
+    while j < len(table) - 1 and comm_size > table[j][0]:
+        j += 1
+    regimes = table[j][1]
+    k = 0
+    while k < len(regimes) - 1 and block_dsize >= regimes[k][0]:
+        k += 1
+    return _INTEL_FUNCS[op][regimes[k][1] - 1]
+
+
+@register("allreduce", "impi")
+def allreduce_impi(comm, sendobj, op: Op):
+    name = _intel_pick("allreduce", comm.size(),
+                       payload_size(sendobj, None))
+    return dispatch_name("allreduce", name)(comm, sendobj, op)
+
+
+@register("alltoall", "impi")
+def alltoall_impi(comm, sendobjs: List):
+    block = payload_size(sendobjs[0], None) if sendobjs else 0
+    name = _intel_pick("alltoall", comm.size(), block)
+    return dispatch_name("alltoall", name)(comm, sendobjs)
+
+
+@register("barrier", "impi")
+def barrier_impi(comm):
+    name = _intel_pick("barrier", comm.size(), 1)
+    return dispatch_name("barrier", name)(comm)
+
+
+@register("bcast", "impi")
+def bcast_impi(comm, obj, root: int = 0):
+    _require_symmetric(obj, "bcast")
+    name = _intel_pick("bcast", comm.size(), payload_size(obj, None))
+    return dispatch_name("bcast", name)(comm, obj, root)
+
+
+@register("reduce", "impi")
+def reduce_impi(comm, sendobj, op: Op, root: int = 0):
+    _require_symmetric(sendobj, "reduce")
+    name = _intel_pick("reduce", comm.size(), payload_size(sendobj, None))
+    return dispatch_name("reduce", name)(comm, sendobj, op, root)
+
+
+@register("reduce_scatter", "impi")
+def reduce_scatter_impi(comm, sendobjs: List, op: Op):
+    total = sum(payload_size(o, None) for o in (sendobjs or []))
+    name = _intel_pick("reduce_scatter", comm.size(), total)
+    return dispatch_name("reduce_scatter", name)(comm, sendobjs, op)
+
+
+@register("allgather", "impi")
+def allgather_impi(comm, sendobj):
+    _require_symmetric(sendobj, "allgather")
+    name = _intel_pick("allgather", comm.size(),
+                       payload_size(sendobj, None))
+    return dispatch_name("allgather", name)(comm, sendobj)
+
+
+@register("gather", "impi")
+def gather_impi(comm, sendobj, root: int = 0):
+    _require_symmetric(sendobj, "gather")
+    name = _intel_pick("gather", comm.size(), payload_size(sendobj, None))
+    return dispatch_name("gather", name)(comm, sendobj, root)
+
+
+@register("scatter", "impi")
+def scatter_impi(comm, sendobjs, root: int = 0):
+    block = payload_size(sendobjs[0], None) if sendobjs else 0
+    name = _intel_pick("scatter", comm.size(), block)
+    return dispatch_name("scatter", name)(comm, sendobjs, root)
+
+
+# ---------------------------------------------------------------------------
+# automatic (run them all, report the quickest)
+# ---------------------------------------------------------------------------
+
+_SELECTOR_NAMES = frozenset(
+    ["default", "automatic", "mpich", "ompi", "mvapich2", "impi"])
+
+
+def _automatic(op: str):
+    def auto(comm, *args):
+        from ..s4u import Engine
+        result = None
+        best_name, best_t = None, float("inf")
+        gbest_name, gbest_t = None, float("inf")
+        me = comm.rank()
+        for name in sorted(_ALGOS[op]):
+            if name in _SELECTOR_NAMES:
+                continue
+            fn = _ALGOS[op][name]
+            dispatch_name("barrier", "default")(comm)
+            t0 = Engine.get_clock()
+            try:
+                result = fn(comm, *args)
+            except Exception:
+                continue
+            dt = Engine.get_clock() - t0
+            # slowest rank defines the collective's cost (the
+            # reference reduces MPI_MAX to rank 0 the same way)
+            worst = dispatch_name("reduce", "default")(
+                comm, dt, MPI_MAX, 0)
+            if dt < best_t:
+                best_name, best_t = name, dt
+            if me == 0 and worst is not None and worst < gbest_t:
+                gbest_name, gbest_t = name, float(worst)
+        if me == 0:
+            log.warning(
+                f"For rank 0, the quickest {op} was {best_name}: "
+                f"{best_t:f}, but global was {gbest_name}: {gbest_t:f} "
+                f"at max")
+        else:
+            log.warning(f"The quickest {op} was {best_name} on rank "
+                        f"{me} and took {best_t:f}")
+        return result
+    return auto
+
+
+for _op in ("allreduce", "alltoall", "barrier", "bcast", "reduce",
+            "reduce_scatter", "allgather", "gather", "scatter"):
+    register(_op, "automatic")(_automatic(_op))

@@ -273,3 +273,101 @@ def test_selector_changes_timing(cluster):
     # three must differ (they are genuinely different algorithms).
     assert len({round(t_default, 9), round(t_mpich, 9),
                 round(t_ompi, 9)}) == 3
+
+
+# ---------------------------------------------------------------------------
+# mvapich2 / impi / automatic selectors (coll_selectors_extra.py)
+# ---------------------------------------------------------------------------
+
+from simgrid_tpu.smpi import coll_selectors_extra
+
+
+class _RecorderExtra:
+    def __init__(self, monkeypatch):
+        self.choices = []
+        real = coll.dispatch_name
+
+        def spy(op, name):
+            self.choices.append((op, name))
+            return real(op, name)
+        monkeypatch.setattr(coll_selectors_extra, "dispatch_name", spy)
+
+
+def _extra_choice(monkeypatch, cluster, n, fn):
+    rec = _RecorderExtra(monkeypatch)
+    run(cluster, n, fn)
+    assert rec.choices, "selector made no dispatch"
+    return rec.choices[0]
+
+
+@pytest.mark.parametrize("nbytes,n,expected", [
+    (1000, 4, "mvapich2_scatter_dest"),  # 1ppn row np=4: <=256KB
+    (4, 8, "rdb"),                       # np=8: <=8B -> recursive doubling
+    (256, 16, "bruck"),                  # np=16: 64<s<=512 -> bruck
+])
+def test_mvapich2_alltoall_decision(monkeypatch, cluster, nbytes, n,
+                                    expected):
+    def f(comm, out):
+        objs = [np.zeros(nbytes, np.uint8) for _ in range(comm.size())]
+        coll_selectors_extra.alltoall_mvapich2(comm, objs)
+    assert _extra_choice(monkeypatch, cluster, n, f) == \
+        ("alltoall", expected)
+
+
+@pytest.mark.parametrize("nbytes,n,expected", [
+    (100, 16, "rdb"),                    # <=1KB -> pt2pt recursive doubling
+    (5000, 16, "rab_rdb"),               # >1KB -> reduce-scatter shape
+])
+def test_mvapich2_allreduce_decision(monkeypatch, cluster, nbytes, n,
+                                     expected):
+    def f(comm, out):
+        coll_selectors_extra.allreduce_mvapich2(
+            comm, np.zeros(nbytes, np.uint8), smpi.MPI_SUM)
+    assert _extra_choice(monkeypatch, cluster, n, f) == \
+        ("allreduce", expected)
+
+
+@pytest.mark.parametrize("nbytes,n,expected", [
+    (50, 2, "rdb"),                      # I_MPI row np=2: 6<=s<85 -> algo 1
+    (100, 2, "ompi_ring_segmented"),     # 85<=s<192 -> algo 7 (ring)
+    (100000, 4, "redbcast"),             # 70732<=s<1300705 -> algo 3
+])
+def test_impi_allreduce_decision(monkeypatch, cluster, nbytes, n,
+                                 expected):
+    def f(comm, out):
+        coll_selectors_extra.allreduce_impi(
+            comm, np.zeros(nbytes, np.uint8), smpi.MPI_SUM)
+    assert _extra_choice(monkeypatch, cluster, n, f) == \
+        ("allreduce", expected)
+
+
+def test_automatic_selector_runs_all_and_is_correct(cluster):
+    """automatic times every concrete allreduce and leaves a correct
+    result in place (smpi_automatic_selector.cpp semantics)."""
+    res = {}
+
+    def main():
+        comm = smpi.COMM_WORLD
+        res[comm.rank()] = coll.dispatch_name("allreduce", "automatic")(
+            comm, np.arange(8.0), smpi.MPI_SUM)
+
+    smpirun(main, cluster, np=4, configs=["tracing:no"])
+    for r in range(4):
+        np.testing.assert_allclose(res[r], np.arange(8.0) * 4)
+
+
+def test_selector_flags_route_all_five(cluster):
+    """Every named selector routes plain comm.allreduce correctly."""
+    for sel in ("mpich", "ompi", "mvapich2", "impi"):
+        s4u.Engine._reset()
+        res = {}
+
+        def main():
+            comm = smpi.COMM_WORLD
+            res[comm.rank()] = comm.allreduce(np.arange(6.0))
+
+        smpirun(main, cluster, np=4,
+                configs=["tracing:no", f"smpi/coll-selector:{sel}"])
+        for r in range(4):
+            np.testing.assert_allclose(res[r], np.arange(6.0) * 4,
+                                       err_msg=sel)

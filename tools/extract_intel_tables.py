@@ -1,0 +1,58 @@
+"""Extract the ppn=1 tuning tables from smpi_intel_mpi_selector.cpp
+into a compact Python data module."""
+import re
+
+src = open("/root/reference/src/smpi/colls/smpi_intel_mpi_selector.cpp").read()
+
+ops = ["allreduce", "alltoall", "barrier", "bcast", "reduce",
+       "reduce_scatter", "allgather", "allgatherv", "gather", "scatter",
+       "alltoallv"]
+
+def extract_table(op):
+    m = re.search(rf"intel_tuning_table_element intel_{op}_table\[\]\s*=\s*", src)
+    if not m:
+        return None
+    i = src.index("{", m.end())
+    # scan matching braces
+    depth = 0
+    start = i
+    while True:
+        if src[i] == "{": depth += 1
+        elif src[i] == "}":
+            depth -= 1
+            if depth == 0: break
+        i += 1
+    body = src[start:i+1]
+    # strip comments
+    body = re.sub(r"/\*.*?\*/", "", body, flags=re.S)
+    body = re.sub(r"//[^\n]*", "", body)
+    # tokenize nested braces into python lists
+    py = body.replace("{", "[").replace("}", "]")
+    data = eval(py)
+    out = []
+    for elem in data:                     # top level: ppn entries
+        ppn = elem[0]
+        if ppn != 1:
+            continue
+        for np_elem in elem[1:]:          # numproc entries
+            for e in np_elem:
+                max_np, n, entries = e[0], e[1], e[2:]
+                pairs = [(s, a) for s, a in entries[0][:n]]
+                out.append((max_np, pairs))
+    return out
+
+print("# Intel-MPI ppn=1 tuning tables, extracted from the reference's")
+print("# smpi_intel_mpi_selector.cpp (I_MPI_ADJUST_* regime data) by")
+print("# tools/extract_intel_tables.py. Each op: [(max_num_proc,")
+print("# [(max_size, algo_index_1based), ...]), ...].")
+print()
+print("INTEL_TABLES = {")
+for op in ops:
+    t = extract_table(op)
+    if t is None:
+        continue
+    print(f"    {op!r}: [")
+    for max_np, pairs in t:
+        print(f"        ({max_np}, {pairs}),")
+    print("    ],")
+print("}")
