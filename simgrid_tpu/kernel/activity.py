@@ -702,7 +702,7 @@ class MutexImpl:
         # Replay-stable identity for the model checker's
         # dependence test (objects are rebuilt on each MC
         # re-execution; the creation sequence is deterministic).
-        self.mc_key = (type(self).__name__, engine.next_mc_seq())
+        self.mc_key = engine.register_mc_object(self)
         self.locked = False
         self.owner = None
         self.sleeping: deque = deque()
@@ -759,7 +759,7 @@ class CondVarImpl:
         # Replay-stable identity for the model checker's
         # dependence test (objects are rebuilt on each MC
         # re-execution; the creation sequence is deterministic).
-        self.mc_key = (type(self).__name__, engine.next_mc_seq())
+        self.mc_key = engine.register_mc_object(self)
         self.sleeping: deque = deque()
 
     def wait(self, mutex: Optional[MutexImpl], timeout: float, simcall) -> None:
@@ -806,7 +806,7 @@ class SemImpl:
         # Replay-stable identity for the model checker's
         # dependence test (objects are rebuilt on each MC
         # re-execution; the creation sequence is deterministic).
-        self.mc_key = (type(self).__name__, engine.next_mc_seq())
+        self.mc_key = engine.register_mc_object(self)
         self.value = value
         self.sleeping: deque = deque()
 

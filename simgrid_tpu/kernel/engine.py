@@ -12,6 +12,7 @@ states and wake finished/failed activities.
 from __future__ import annotations
 
 import heapq
+import weakref as _weakref
 from typing import Callable, Dict, List, Optional
 
 from ..exceptions import SimgridException
@@ -65,6 +66,10 @@ class EngineImpl:
         self.context_factory = ContextFactory()
         self._pid = 1
         self._mc_seq = 0
+        #: weakrefs to mutex/semaphore/condvar impls, for MC snapshots
+        self.mc_sync_objects: list = []
+        #: actor-noted MC-relevant state, (pid, key) -> value
+        self.mc_notes: dict = {}
         self.maestro = ActorImpl(self, "maestro", None)
         self.maestro.pid = 0
         self.actors_to_run: List[ActorImpl] = []
@@ -115,6 +120,30 @@ class EngineImpl:
         the model checker (stable across MC re-executions)."""
         self._mc_seq += 1
         return self._mc_seq
+
+    def shutdown_contexts(self) -> None:
+        """Kill every live actor thread (engine teardown): without
+        this, each discarded engine leaks its parked context threads
+        and replay-heavy users (the model checker re-executes the
+        program hundreds of times) exhaust the OS thread limit."""
+        actors = list(self.process_list.values()) + list(self.actors_to_run)
+        for actor in actors:
+            ctx = getattr(actor, "context", None)
+            if ctx is None or ctx._thread is None:
+                continue
+            if ctx._thread.is_alive():
+                ctx.iwannadie = True
+                ctx._sem.release()
+                ctx._thread.join(timeout=5)
+
+    def register_mc_object(self, obj) -> tuple:
+        """Assign a replay-stable mc_key AND remember the object so
+        the state-signature walk (mc/state.py) can serialize every
+        live sync object — the role of the reference's snapshot region
+        enumeration (sosp/Region), minus the page store."""
+        key = (type(obj).__name__, self.next_mc_seq())
+        self.mc_sync_objects.append(_weakref.ref(obj))
+        return key
 
     def add_model(self, model) -> None:
         self.models.append(model)

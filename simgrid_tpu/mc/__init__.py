@@ -20,3 +20,12 @@ from .comm_determinism import (CommunicationDeterminismChecker,  # noqa: E402
                                NonDeterminismError)
 
 __all__ += ["CommunicationDeterminismChecker", "NonDeterminismError"]
+
+from .liveness import (BuchiAutomaton, LivenessChecker,  # noqa: E402
+                       LivenessError)
+from .record import record_of, parse_record, replay  # noqa: E402
+from .state import note, state_signature  # noqa: E402
+
+__all__ += ["BuchiAutomaton", "LivenessChecker", "LivenessError",
+            "record_of", "parse_record", "replay", "state_signature",
+            "note"]

@@ -22,6 +22,7 @@ durations (same scope as the reference's safety mode).
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..exceptions import SimgridException
@@ -32,6 +33,10 @@ _logger = _log.get_category("mc")
 
 declare_flag("model-check/max-visited-states",
              "Maximum number of visited states (0 = unlimited)", 0)
+declare_flag("model-check/visited",
+             "Prune states whose signature was already explored; the "
+             "value bounds the retained set (0 = pruning disabled, the "
+             "reference's model-check/visited semantics)", 0)
 
 
 class PropertyError(SimgridException):
@@ -231,6 +236,11 @@ class SafetyChecker:
         self.visited_states = 0
         self.executed_transitions = 0
         self.expanded_states = 0
+        #: visited-state pruning (VisitedState.cpp): signatures of
+        #: fully-seen states; bounded FIFO per model-check/visited
+        self.visited_cap = int(config["model-check/visited"])
+        self._seen_signatures: "OrderedDict" = OrderedDict()
+        self.pruned_states = 0
 
     # -- subclass hooks ----------------------------------------------------
     def _make_session(self) -> Session:
@@ -283,15 +293,32 @@ class SafetyChecker:
             path.append(pid)
 
             if session.violation is not None:
-                raise PropertyError(session.violation, self._trace(stack))
+                raise self._with_record(
+                    PropertyError(session.violation, self._trace(stack)),
+                    path)
 
             nxt = _State(session.pending_pids())
             if not nxt.enabled:
                 if session.alive():
-                    raise DeadlockError(
+                    raise self._with_record(DeadlockError(
                         "Deadlock: actors remain but no transition is "
-                        "enabled", self._trace(stack))
+                        "enabled", self._trace(stack)), path)
                 self._on_path_complete(session)
+            if self.visited_cap > 0 and nxt.enabled:
+                # visited-state pruning (VisitedState.cpp): an already
+                # fully-seen signature is not re-expanded.  Like the
+                # reference, combining this with DPOR trades exhaustive
+                # coverage for speed; use reduction:none for the sound
+                # stateful mode.
+                from .state import state_signature
+                sig = state_signature(session.engine)
+                if sig in self._seen_signatures:
+                    self.pruned_states += 1
+                    stack.append(nxt)     # empty todo: backtracks next
+                    continue
+                self._seen_signatures[sig] = True
+                while len(self._seen_signatures) > self.visited_cap:
+                    self._seen_signatures.popitem(last=False)
             self._seed_todo(nxt)
             self.expanded_states += 1
             stack.append(nxt)
@@ -303,7 +330,8 @@ class SafetyChecker:
                      self.executed_transitions)
         return {"expanded_states": self.expanded_states,
                 "visited_states": self.visited_states,
-                "executed_transitions": self.executed_transitions}
+                "executed_transitions": self.executed_transitions,
+                "pruned_states": self.pruned_states}
 
     def _seed_todo(self, state: _State) -> None:
         """With DPOR, start from the first enabled transition only; the
@@ -353,6 +381,15 @@ class SafetyChecker:
                 return self._replay(path)
             stack.pop()
         return None
+
+    @staticmethod
+    def _with_record(err, path: List[int]):
+        """Stamp the mc_record-style path ("Path = 1;2;...") on a
+        counterexample, replayable via mc.record.replay()."""
+        from .record import record_of
+        err.record = record_of(path)
+        _logger.info("Path = %s", err.record)
+        return err
 
     def _trace(self, stack: List[_State]) -> List[str]:
         return [repr(state.executed) for state in stack

@@ -1,0 +1,143 @@
+"""Liveness checking: accepting-cycle search over the product of the
+program and a never-claim Büchi automaton.
+
+Reference: mc/checker/LivenessChecker.cpp — the property (the negation
+of the desired LTL formula, a "never claim") is a Büchi automaton whose
+atomic propositions the verified program exposes; the checker explores
+the synchronous product and reports a violation when an exploration
+cycle passes through an accepting automaton state (detected there by
+comparing snapshot pairs on the exploration stack,
+LivenessChecker.cpp:close-pair logic).  Here cycle detection compares
+kernel state *signatures* (mc/state.py) instead of memory snapshots.
+
+API:
+    aut = BuchiAutomaton(
+        states=["s0", "s1"], initial="s0", accepting={"s1"},
+        transitions=[("s0", "s0", lambda p: True),
+                     ("s0", "s1", lambda p: not p["done"]),
+                     ("s1", "s1", lambda p: not p["done"])])
+    LivenessChecker(program, aut, {"done": lambda engine: ...}).run()
+
+raises LivenessError with the lasso (prefix + cycle) when the program
+has an infinite run accepted by the claim.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..exceptions import SimgridException
+from ..utils import log as _log
+from ..utils.config import config
+from .explorer import Session, Transition
+
+_logger = _log.get_category("mc")
+
+
+class LivenessError(SimgridException):
+    def __init__(self, message, prefix, cycle):
+        super().__init__(message)
+        self.prefix = prefix      # transitions reaching the cycle
+        self.cycle = cycle        # transitions closing the lasso
+
+
+class BuchiAutomaton:
+    """A never claim: states, one initial, accepting set, transitions
+    guarded by predicates over the proposition valuation (the xbt
+    automaton of the reference, minus the LTL-to-Büchi translator —
+    claims are given directly)."""
+
+    def __init__(self, states: List[str], initial: str,
+                 accepting: Set[str],
+                 transitions: List[Tuple[str, str, Callable]]):
+        assert initial in states
+        assert set(accepting) <= set(states)
+        self.states = list(states)
+        self.initial = initial
+        self.accepting = set(accepting)
+        self.transitions = list(transitions)
+
+    def successors(self, state: str, valuation: Dict[str, bool]):
+        return [dst for src, dst, guard in self.transitions
+                if src == state and guard(valuation)]
+
+
+class LivenessChecker:
+    """DFS over (program state, claim state) pairs; a pair revisited on
+    the exploration stack with an accepting claim state inside the loop
+    is an accepted infinite run (LivenessChecker.cpp:80-150)."""
+
+    def __init__(self, program: Callable, automaton: BuchiAutomaton,
+                 propositions: Dict[str, Callable]):
+        self.program = program
+        self.automaton = automaton
+        self.propositions = propositions
+        self.max_depth = int(config["model-check/max-depth"])
+        self.visited_pairs = 0
+        self.expanded_pairs = 0
+
+    def _valuation(self, session: Session) -> Dict[str, bool]:
+        return {name: bool(fn(session.engine))
+                for name, fn in self.propositions.items()}
+
+    def run(self) -> Dict[str, int]:
+        from .state import state_signature
+        session = Session(self.program)
+        # untimed comparison: loop iterations advance the clock, which
+        # must not prevent closing the lasso (reference: timing data is
+        # MC_ignore'd out of liveness snapshots)
+        init_sig = state_signature(session.engine, include_clock=False)
+        valuation = self._valuation(session)
+        for aut0 in self.automaton.successors(self.automaton.initial,
+                                              valuation) or \
+                [self.automaton.initial]:
+            self._dfs(session, [], init_sig, aut0, [])
+        _logger.info("No liveness violation found.")
+        _logger.info("Visited pairs = %d", self.visited_pairs)
+        return {"visited_pairs": self.visited_pairs,
+                "expanded_pairs": self.expanded_pairs}
+
+    # -- recursive DFS with replay-based backtracking ----------------------
+    def _dfs(self, session: Session, path: List[int], sig, aut_state: str,
+             stack: List[Tuple]):
+        """`stack` holds (signature, automaton state, accepting?) of the
+        current exploration branch; session IS at `path`."""
+        self.visited_pairs += 1
+        pair = (sig, aut_state)
+        for i, (s, a, _) in enumerate(stack):
+            if (s, a) == pair:
+                # a cycle through stack[i:]; accepted if any pair inside
+                # it (or this one) is accepting
+                if any(acc for _, _, acc in stack[i:]) or \
+                        aut_state in self.automaton.accepting:
+                    raise LivenessError(
+                        "Liveness property violated: accepting cycle "
+                        f"found (claim state {aut_state})",
+                        path[:i], path[i:])
+                return                      # non-accepting cycle: prune
+        if len(stack) >= self.max_depth:
+            _logger.warning("/!\\ Liveness max depth reached /!\\")
+            return
+        pids = session.pending_pids()
+        if not pids:
+            return                          # finite run: no infinite word
+        stack.append((sig, aut_state,
+                      aut_state in self.automaton.accepting))
+        try:
+            from .state import state_signature
+            for pid in pids:
+                child = self._replay(path + [pid])
+                self.expanded_pairs += 1
+                child_sig = state_signature(child.engine,
+                                            include_clock=False)
+                valuation = self._valuation(child)
+                for nxt in self.automaton.successors(aut_state, valuation):
+                    self._dfs(child, path + [pid], child_sig, nxt, stack)
+        finally:
+            stack.pop()
+
+    def _replay(self, path: List[int]) -> Session:
+        session = Session(self.program)
+        for pid in path:
+            session.execute(pid)
+        return session

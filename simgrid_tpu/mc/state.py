@@ -1,0 +1,100 @@
+"""Hashable kernel-state signatures: the snapshot layer of the checker.
+
+The reference backtracks and compares states through byte-level page
+snapshots (mc/sosp/PageStore.hpp:62-97, Snapshot.cpp) because it
+checks arbitrary C programs.  This kernel is deterministic Python, so a
+state is fully characterized by a structural serialization of the
+scheduler-visible objects — the "snapshots = hashable state dicts"
+redesign (SURVEY §2.6 note 5).  Signatures power:
+
+* visited-state pruning in the safety checker (VisitedState.cpp role);
+* cycle detection for the liveness checker (LivenessChecker.cpp pairs).
+
+Scope (mirrors the reference's MC_ignore design): the signature covers
+*scheduler-visible* state — actors + pending simcalls + activity
+queues + sync objects + clock (the reference's snapshots ignore timing
+data via MC_ignore; pass include_clock=False for the same untimed
+comparison, which the liveness checker needs to close loops whose
+iterations advance simulated time).  Actor-local Python state
+(counters, flags inside the actor function) is NOT visible — where it
+affects future behavior, the actor must surface it with mc.note(key,
+value), the explicit-state analog of the reference snapshotting the
+application heap.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+
+def _actor_sig(actor) -> Tuple:
+    sc = actor.simcall_
+    objs = sc.payload.get("mc_object") if sc.payload else None
+    from .explorer import _obj_keys
+    waiting = actor.waiting_synchro
+    return (
+        actor.pid,
+        actor.name,
+        bool(actor.suspended),
+        sc.call,
+        tuple(sorted(_obj_keys(objs))),
+        sc.handler is not None,
+        type(waiting).__name__ if waiting is not None else None,
+    )
+
+
+def _comm_sig(comm) -> Tuple:
+    return (
+        comm.type.name if hasattr(comm.type, "name") else str(comm.type),
+        comm.src_actor.pid if comm.src_actor is not None else None,
+        comm.dst_actor.pid if comm.dst_actor is not None else None,
+        float(comm.size),
+        bool(comm.detached),
+        comm.state.name if hasattr(comm.state, "name") else str(comm.state),
+    )
+
+
+def _sync_sig(obj) -> Tuple:
+    kind = type(obj).__name__
+    if kind == "MutexImpl":
+        return (obj.mc_key, bool(obj.locked),
+                obj.owner.pid if obj.owner is not None else None,
+                tuple(sc.issuer.pid for sc in obj.sleeping))
+    if kind == "SemImpl":
+        return (obj.mc_key, int(obj.value),
+                tuple(sc.issuer.pid for sc in obj.sleeping))
+    # ConditionVariableImpl
+    return (obj.mc_key, tuple(sc.issuer.pid for sc in obj.sleeping))
+
+
+def note(key, value) -> None:
+    """Record actor-local state the model checker must distinguish
+    (loop counters, mode flags): included in every signature under the
+    calling actor's pid.  The explicit-state substitute for the
+    reference's application-heap snapshot."""
+    from ..s4u.actor import _current_impl
+    impl = _current_impl()
+    impl.engine.mc_notes[(impl.pid, key)] = value
+
+
+def state_signature(engine, include_clock: bool = True) -> Tuple:
+    """Deterministic, hashable signature of the kernel state."""
+    actors = tuple(_actor_sig(a)
+                   for _, a in sorted(engine.process_list.items()))
+    mailboxes = []
+    for name in sorted(engine.mailboxes):
+        mbox = engine.mailboxes[name]
+        if not mbox.comm_queue and not mbox.done_comm_queue:
+            continue
+        mailboxes.append((name,
+                          tuple(_comm_sig(c) for c in mbox.comm_queue),
+                          tuple(_comm_sig(c)
+                                for c in mbox.done_comm_queue)))
+    syncs = []
+    for ref in engine.mc_sync_objects:
+        obj = ref()
+        if obj is not None:
+            syncs.append(_sync_sig(obj))
+    notes = tuple(sorted(engine.mc_notes.items()))
+    return (round(engine.now, 9) if include_clock else None,
+            actors, tuple(mailboxes), tuple(syncs), notes)

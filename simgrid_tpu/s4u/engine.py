@@ -59,6 +59,7 @@ class Engine:
         instr.stop()
         if cls._instance is not None:
             cls._instance.pimpl.disconnect_signals()
+            cls._instance.pimpl.shutdown_contexts()
         cls._instance = None
         EngineImpl.instance = None
         Mailbox._instances.clear()

@@ -227,3 +227,132 @@ def test_comm_determinism_detects_any_source_race(platform):
     assert exc.value.kind == "recv"
     assert exc.value.reference != exc.value.observed
     assert all(p[0] == "m" for p in exc.value.reference)
+
+
+# ---------------------------------------------------------------------------
+# Visited-state pruning, record/replay, liveness (round-2 additions)
+# ---------------------------------------------------------------------------
+
+def test_visited_state_pruning_reduces_exploration(platform):
+    """Stateful exploration (model-check/visited) converges on the same
+    clean verdict while expanding fewer states than pure stateless DFS
+    (VisitedState.cpp role)."""
+    config["model-check/reduction"] = "none"
+    baseline = mc.SafetyChecker(
+        two_senders_program(platform, False)).run()
+    config["model-check/visited"] = 10_000
+    try:
+        pruned = mc.SafetyChecker(
+            two_senders_program(platform, False)).run()
+    finally:
+        config["model-check/visited"] = 0
+        config["model-check/reduction"] = "dpor"
+    assert pruned["pruned_states"] > 0
+    assert pruned["expanded_states"] < baseline["expanded_states"]
+
+
+def test_visited_pruning_still_finds_bug(platform):
+    config["model-check/reduction"] = "none"
+    config["model-check/visited"] = 10_000
+    try:
+        with pytest.raises(mc.PropertyError):
+            mc.SafetyChecker(two_senders_program(platform, True)).run()
+    finally:
+        config["model-check/visited"] = 0
+        config["model-check/reduction"] = "dpor"
+
+
+def test_counterexample_record_replays(platform):
+    """The Path= record attached to a violation replays to the same
+    violation outside the checker (mc_record.cpp semantics)."""
+    program = two_senders_program(platform, True)
+    with pytest.raises(mc.PropertyError) as exc:
+        mc.SafetyChecker(program).run()
+    record = exc.value.record
+    assert record and ";" in record
+    session = mc.replay(program, record)
+    assert session.violation is not None
+    assert "violated its assertion" in session.violation
+
+
+def liveness_loop_program(platform, with_progress):
+    """Two actors ping-pong forever; the with_progress variant stops
+    after two rounds (using mc.note to surface the loop counter)."""
+    def program():
+        e = s4u.Engine(["mc"])
+        e.load_platform(platform)
+
+        def ping():
+            n = 0
+            while True:
+                s4u.Mailbox.by_name("ping").put(n, 8)
+                s4u.Mailbox.by_name("pong").get()
+                n += 1
+                if with_progress:
+                    mc.note("rounds", n)
+                    if n >= 2:
+                        return
+
+        def pong():
+            while True:
+                got = s4u.Mailbox.by_name("ping").get()
+                if with_progress:
+                    # every loop-variant local must be noted, or state
+                    # signatures alias distinct iterations (mc.note
+                    # contract)
+                    mc.note("got", got)
+                s4u.Mailbox.by_name("pong").put(got, 8)
+                if with_progress and got >= 1:
+                    return
+
+        s4u.Actor.create("ping", e.host_by_name("h0"), ping)
+        s4u.Actor.create("pong", e.host_by_name("h1"), pong)
+        return e
+    return program
+
+
+def _fg_not_done_claim():
+    """Never claim for the complaint "eventually done never happens":
+    accepting cycle while !done holds forever (FG !done)."""
+    return mc.BuchiAutomaton(
+        states=["s0", "s1"], initial="s0", accepting={"s1"},
+        transitions=[("s0", "s0", lambda p: True),
+                     ("s0", "s1", lambda p: not p["done"]),
+                     ("s1", "s1", lambda p: not p["done"])])
+
+
+def test_liveness_finds_nonprogress_cycle(platform):
+    """The endless loop never sets done: the FG-!done claim accepts."""
+    prop = {"done": lambda engine: False}
+    checker = mc.LivenessChecker(
+        liveness_loop_program(platform, False), _fg_not_done_claim(),
+        prop)
+    with pytest.raises(mc.LivenessError) as exc:
+        checker.run()
+    assert exc.value.cycle, "lasso must have a cycle part"
+
+
+def test_liveness_clean_when_program_terminates(platform):
+    """The progressing variant terminates: no infinite accepted word."""
+    prop = {"done": lambda engine: False}
+    stats = mc.LivenessChecker(
+        liveness_loop_program(platform, True), _fg_not_done_claim(),
+        prop).run()
+    assert stats["visited_pairs"] > 0
+
+
+def test_state_signature_distinguishes_and_matches(platform):
+    """Same prefix -> same signature; different prefix -> different."""
+    program = two_senders_program(platform, False)
+    s1 = mc.Session(program)
+    pids = s1.pending_pids()
+    s1.execute(pids[0])
+    sig_a = mc.state_signature(s1.engine)
+
+    s2 = mc.Session(program)
+    s2.execute(pids[0])
+    assert mc.state_signature(s2.engine) == sig_a
+
+    s3 = mc.Session(program)
+    s3.execute(s3.pending_pids()[1])
+    assert mc.state_signature(s3.engine) != sig_a
