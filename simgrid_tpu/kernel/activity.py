@@ -55,6 +55,25 @@ class ActivityImpl:
         self.simcalls.append(simcall)
         simcall.issuer.waiting_synchro = self
 
+    def waitany_cleanup(self, simcall) -> None:
+        """Mixed-kind waitany (s4u Activity.wait_any_of over
+        Comm/Exec/Io together): detach the simcall from every other
+        registered activity and set its result to this one's index.
+        Called by each kind's finish()."""
+        if simcall.call != "activity_waitany":
+            return
+        activities = simcall.payload["activities"]
+        for act in activities:
+            try:
+                act.simcalls.remove(simcall)
+            except ValueError:
+                pass
+        if simcall.timeout_cb is not None:
+            simcall.timeout_cb.remove()
+            simcall.timeout_cb = None
+        simcall.result = (activities.index(self)
+                          if self in activities else -1)
+
     def is_pending(self) -> bool:
         return self.state in (State.WAITING, State.RUNNING, State.READY)
 
@@ -199,6 +218,7 @@ class CommImpl(ActivityImpl):
             simcall = self.simcalls.popleft()
             if simcall.call is None:
                 continue  # issuer got killed
+            self.waitany_cleanup(simcall)
             if simcall.call == "comm_waitany":
                 comms = simcall.payload["comms"]
                 for comm in comms:
@@ -251,7 +271,8 @@ class CommImpl(ActivityImpl):
                 issuer.simcall_answer()
 
             if (issuer.exception is not None
-                    and simcall.call in ("comm_waitany", "comm_testany")):
+                    and simcall.call in ("comm_waitany", "comm_testany",
+                                         "activity_waitany")):
                 comms = simcall.payload["comms"]
                 issuer.exception.value = comms.index(self) if self in comms else -1
 
@@ -400,6 +421,7 @@ class ExecImpl(ActivityImpl):
             simcall = self.simcalls.popleft()
             if simcall.call is None:
                 continue
+            self.waitany_cleanup(simcall)
             if simcall.call == "execution_waitany":
                 execs = simcall.payload["execs"]
                 for ex in execs:
@@ -497,6 +519,7 @@ class IoImpl(ActivityImpl):
             simcall = self.simcalls.popleft()
             if simcall.call is None:
                 continue
+            self.waitany_cleanup(simcall)
             issuer = simcall.issuer
             if self.state == State.FAILED:
                 issuer.exception = StorageFailureException("Storage failed")
@@ -669,6 +692,31 @@ def comm_testany(simcall, comms: List[CommImpl]) -> None:
             comm.finish()
             return
     simcall.issuer.simcall_answer()
+
+
+def activity_waitany(simcall, activities: List[ActivityImpl],
+                     timeout: float) -> None:
+    """Kind-agnostic waitany (Comm/Exec/Io mixed): every finish()
+    recognizes the 'activity_waitany' simcall via waitany_cleanup."""
+    simcall.payload["activities"] = activities
+    if timeout < 0.0:
+        simcall.timeout_cb = None
+    else:
+        def on_timeout():
+            for act in activities:
+                try:
+                    act.simcalls.remove(simcall)
+                except ValueError:
+                    pass
+            simcall.result = -1
+            simcall.issuer.simcall_answer()
+        simcall.timeout_cb = simcall.issuer.engine.timer_set(
+            simcall.issuer.engine.now + timeout, on_timeout)
+    for act in activities:
+        act.simcalls.append(simcall)
+        if act.state not in (State.WAITING, State.RUNNING):
+            act.finish()
+            break
 
 
 def comm_waitany(simcall, comms: List[CommImpl], timeout: float) -> None:

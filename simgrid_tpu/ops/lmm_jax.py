@@ -504,15 +504,43 @@ def fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
     return v_value, remaining, usage, rounds
 
 
-@functools.partial(jax.jit, static_argnames=("parallel_rounds", "chunk"))
+@functools.partial(jax.jit,
+                   static_argnames=("eps", "parallel_rounds", "chunk"))
 def _solve_ell_chunk(cv_var, cv_w, cv_valid, vc_cnst, vc_valid, c_bound,
-                     c_fatpipe, v_penalty, v_bound, eps, carry,
-                     parallel_rounds: bool, chunk: int):
+                     c_fatpipe, v_penalty, v_bound, carry,
+                     eps: float, parallel_rounds: bool, chunk: int):
+    """eps is static: it is fixed per run (maxmin/precision), and a
+    traced scalar would be one more host->device transfer per chunk —
+    each costing hundreds of ms of latency on a tunneled accelerator."""
     ell = LmmEllArrays(cv_var, cv_w, cv_valid, vc_cnst, vc_valid, c_bound,
                        c_fatpipe, v_penalty, v_bound, 0, 0)
-    return fixpoint_ell(ell, eps, carry=carry,
+    return fixpoint_ell(ell, jnp.asarray(eps, cv_w.dtype), carry=carry,
                         parallel_rounds=parallel_rounds, max_rounds=chunk,
                         return_carry=True)
+
+
+#: Device-resident copies of solver inputs, keyed by (kind, ids,
+#: device). The flagship accelerator sits behind a high-latency tunnel
+#: where EVERY host->device transfer costs 150-500 ms regardless of
+#: size; re-shipping ~11 arrays per solve dominated the round-1 solve
+#: time (7 of 9.5 s at 100k flows). Values keep the host arrays alive
+#: and identity-checked, like _ELL_CACHE.
+_DEVICE_ARGS_CACHE: dict = {}
+
+
+def _device_args(kind: str, host_args, device):
+    key = (kind, tuple(id(a) for a in host_args),
+           None if device is None else str(device))
+    hit = _DEVICE_ARGS_CACHE.get(key)
+    if hit is not None:
+        src, dev_args = hit
+        if all(a is b for a, b in zip(src, host_args)):
+            return dev_args
+    dev_args = [jax.device_put(a, device) for a in host_args]
+    if len(_DEVICE_ARGS_CACHE) >= 8:
+        _DEVICE_ARGS_CACHE.clear()
+    _DEVICE_ARGS_CACHE[key] = (list(host_args), dev_args)
+    return dev_args
 
 
 #: Tiny memo for COO->ELL conversions so repeated solves of the same
@@ -538,16 +566,18 @@ def _ell_cached(arrays: LmmArrays) -> Optional[LmmEllArrays]:
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("n_c", "n_v", "parallel_rounds", "chunk"))
+                   static_argnames=("eps", "n_c", "n_v",
+                                    "parallel_rounds", "chunk"))
 def _solve_kernel_chunk(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty,
-                        v_bound, eps, carry, n_c: int, n_v: int,
+                        v_bound, carry, eps: float, n_c: int, n_v: int,
                         parallel_rounds: bool, chunk: int):
     """Run at most `chunk` more saturation rounds from `carry` (None =
-    fresh start) and return (values, remaining, usage, rounds, carry)."""
+    fresh start) and return (values, remaining, usage, rounds, carry).
+    eps is static for the same reason as _solve_ell_chunk's."""
     return fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty,
-                    v_bound, eps, n_c, n_v, axis=None,
-                    parallel_rounds=parallel_rounds, carry=carry,
-                    max_rounds=chunk, return_carry=True)
+                    v_bound, jnp.asarray(eps, e_w.dtype), n_c, n_v,
+                    axis=None, parallel_rounds=parallel_rounds,
+                    carry=carry, max_rounds=chunk, return_carry=True)
 
 
 def flatten(cnst_list: List[Constraint], dtype=np.float64
@@ -659,39 +689,42 @@ def solve_arrays(arrays: LmmArrays, eps: float, device=None,
     if layout == "ell" or (layout == "auto" and _default_platform() != "cpu"):
         ell = _ell_cached(arrays)
 
-    eps_arr = np.asarray(eps, arrays.e_w.dtype)
+    eps_f = float(eps)
     if ell is not None:
-        args = [ell.cv_var, ell.cv_w, ell.cv_valid, ell.vc_cnst,
-                ell.vc_valid, ell.c_bound, ell.c_fatpipe, ell.v_penalty,
-                ell.v_bound, eps_arr]
-        if device is not None:
-            args = [jax.device_put(a, device) for a in args]
+        args = _device_args(
+            "ell",
+            [ell.cv_var, ell.cv_w, ell.cv_valid, ell.vc_cnst,
+             ell.vc_valid, ell.c_bound, ell.c_fatpipe, ell.v_penalty,
+             ell.v_bound], device)
 
         def run_chunk(carry):
-            return _solve_ell_chunk(*args, carry=carry,
+            return _solve_ell_chunk(*args, carry, eps=eps_f,
                                     parallel_rounds=parallel_rounds,
                                     chunk=chunk)
     else:
-        args = [arrays.e_var, arrays.e_cnst, arrays.e_w, arrays.c_bound,
-                arrays.c_fatpipe, arrays.v_penalty, arrays.v_bound,
-                eps_arr]
-        if device is not None:
-            args = [jax.device_put(a, device) for a in args]
+        args = _device_args(
+            "coo",
+            [arrays.e_var, arrays.e_cnst, arrays.e_w, arrays.c_bound,
+             arrays.c_fatpipe, arrays.v_penalty, arrays.v_bound], device)
         n_c, n_v = len(arrays.c_bound), len(arrays.v_penalty)
 
         def run_chunk(carry):
             return _solve_kernel_chunk(
-                *args, carry=carry, n_c=n_c, n_v=n_v,
+                *args, carry, eps=eps_f, n_c=n_c, n_v=n_v,
                 parallel_rounds=parallel_rounds, chunk=chunk)
 
     carry = None
     prev_progress = None
     while True:
         values, remaining, usage, rounds, carry = run_chunk(carry)
-        # One host sync per chunk: rounds + light count + fixed count.
-        light = carry[4]
-        n_light = int(jnp.count_nonzero(light))
-        rounds = int(rounds)
+        # One host sync per chunk: [rounds, light count, fixed count]
+        # in a single device->host transfer (per-transfer latency is
+        # the cost driver on a tunneled accelerator).
+        stats = np.asarray(jnp.stack(
+            [rounds, jnp.count_nonzero(carry[4]).astype(jnp.int32),
+             jnp.count_nonzero(carry[1]).astype(jnp.int32)]))
+        rounds, n_light, n_fixed = (int(stats[0]), int(stats[1]),
+                                    int(stats[2]))
         if n_light == 0:
             break
         if rounds >= _MAX_ROUNDS:
@@ -700,7 +733,6 @@ def solve_arrays(arrays: LmmArrays, eps: float, device=None,
                 f"saturation rounds ({arrays.n_cnst} constraints, "
                 f"{arrays.n_var} variables, {n_light} still active); "
                 f"check maxmin/precision vs the system's magnitudes")
-        n_fixed = int(jnp.count_nonzero(carry[1]))
         progress = (n_light, n_fixed)
         if progress == prev_progress:
             raise RuntimeError(
@@ -711,7 +743,14 @@ def solve_arrays(arrays: LmmArrays, eps: float, device=None,
                 f"the system does not converge at eps={eps} in "
                 f"{arrays.e_w.dtype} precision")
         prev_progress = progress
-    return np.asarray(values), np.asarray(remaining), np.asarray(usage), rounds
+    # One transfer for all three result vectors.
+    flat = np.asarray(jnp.concatenate(
+        [values.astype(arrays.e_w.dtype),
+         remaining.astype(arrays.e_w.dtype),
+         usage.astype(arrays.e_w.dtype)]))
+    n_vb, n_cb = len(arrays.v_penalty), len(arrays.c_bound)
+    return (flat[:n_vb], flat[n_vb:n_vb + n_cb],
+            flat[n_vb + n_cb:n_vb + 2 * n_cb], rounds)
 
 
 def check_convergence(rounds: int, n_cnst, n_var) -> None:

@@ -15,7 +15,7 @@ Usage:
 
 from ..models.host import Host
 from ..models.network import LinkImpl as Link
-from .activity import Activity, Comm, Exec, Io
+from .activity import Activity, ActivitySet, Comm, Exec, Io
 from .actor import Actor, this_actor
 from .engine import Engine, get_clock
 from .mailbox import Mailbox
@@ -24,5 +24,6 @@ from .synchro import Barrier, ConditionVariable, Mutex, Semaphore
 from ..plugins.vm import VirtualMachine  # noqa: E402  (s4u::VirtualMachine)
 
 __all__ = ["Engine", "Actor", "this_actor", "Host", "Link", "Mailbox",
-           "Comm", "Exec", "Io", "Activity", "Mutex", "ConditionVariable",
-           "Semaphore", "Barrier", "get_clock", "VirtualMachine"]
+           "Comm", "Exec", "Io", "Activity", "ActivitySet", "Mutex",
+           "ConditionVariable", "Semaphore", "Barrier", "get_clock",
+           "VirtualMachine"]

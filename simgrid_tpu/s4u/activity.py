@@ -33,6 +33,61 @@ class Activity:
     def is_finished(self) -> bool:
         return self.state == ActivityState.FINISHED
 
+    @staticmethod
+    def wait_any_of(activities: List["Activity"],
+                    timeout: float = -1.0) -> int:
+        """Wait for the first of a MIXED set of started activities
+        (Comm/Exec/Io together) — s4u::Activity::wait_any; the kernel
+        waitany machinery is kind-agnostic (register_simcall/finish on
+        ActivityImpl). Returns the finished index, or -1 on timeout."""
+        from .actor import _current_impl
+        issuer = _current_impl()
+        impls = [a.pimpl for a in activities]
+
+        def handler(sc):
+            kact.activity_waitany(sc, impls, timeout)
+        idx = issuer.simcall("activity_waitany", handler)
+        if idx is not None and idx >= 0:
+            act = activities[idx]
+            act.state = ActivityState.FINISHED
+            on_completion = getattr(type(act), "on_completion", None)
+            if on_completion is not None:
+                on_completion(act)
+            return idx
+        return -1
+
+
+class ActivitySet:
+    """A bag of heterogeneous activities to wait on (the reference's
+    s4u::ActivitySet)."""
+
+    def __init__(self, activities: Optional[List[Activity]] = None):
+        self._activities: List[Activity] = list(activities or [])
+
+    def push(self, activity: Activity) -> None:
+        self._activities.append(activity)
+
+    def erase(self, activity: Activity) -> None:
+        self._activities.remove(activity)
+
+    def empty(self) -> bool:
+        return not self._activities
+
+    def size(self) -> int:
+        return len(self._activities)
+
+    def wait_any(self, timeout: float = -1.0) -> Optional[Activity]:
+        """Wait for one activity to finish, remove and return it
+        (None on timeout)."""
+        idx = Activity.wait_any_of(self._activities, timeout)
+        if idx < 0:
+            return None
+        return self._activities.pop(idx)
+
+    def wait_all(self) -> None:
+        while self._activities:
+            self.wait_any()
+
 
 class Comm(Activity):
     """One communication, sender or receiver side (s4u_Comm.cpp)."""

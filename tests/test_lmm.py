@@ -372,22 +372,27 @@ def test_round_modes_match_oracle(seed, rounds_mode):
     (13, 150, 400, 0.5, 0.5),    # heavy mix of both
     (14, 60, 600, 0.3, 0.2),     # many variables per constraint
 ])
+@pytest.mark.parametrize("layout", ["coo", "ell"])
 def test_round_modes_match_oracle_large(seed, n_c, n_v, p_bound, p_fat,
-                                        rounds_mode):
+                                        rounds_mode, layout):
     """Larger randomized systems with heavy bound/FATPIPE mixes: both round
-    strategies must still agree with the exact list solver (validates the
-    local-minimum mode's tie-breaking corners beyond the 20x60 smoke
-    matrix)."""
+    strategies must still agree with the exact list solver, on BOTH
+    element layouts (the accelerator default is ELL; CPU's is COO —
+    forcing each makes the matrix cover what the TPU actually runs)."""
     from simgrid_tpu.utils.config import config
     config["lmm/rounds"] = rounds_mode
-    rng = np.random.default_rng(seed)
-    s_exact, v_exact = _random_system(rng, n_c, n_v, backend="list",
+    config["lmm/layout"] = layout
+    try:
+        rng = np.random.default_rng(seed)
+        s_exact, v_exact = _random_system(rng, n_c, n_v, backend="list",
+                                          p_bound=p_bound, p_fat=p_fat)
+        rng = np.random.default_rng(seed)
+        s_jax, v_jax = _random_system(rng, n_c, n_v, backend="jax",
                                       p_bound=p_bound, p_fat=p_fat)
-    rng = np.random.default_rng(seed)
-    s_jax, v_jax = _random_system(rng, n_c, n_v, backend="jax",
-                                  p_bound=p_bound, p_fat=p_fat)
-    s_exact.solve()
-    s_jax.solve()
+        s_exact.solve()
+        s_jax.solve()
+    finally:
+        config["lmm/layout"] = "auto"
     exact = np.array([v.value for v in v_exact])
     vect = np.array([v.value for v in v_jax])
     np.testing.assert_allclose(vect, exact, rtol=1e-9, atol=1e-9)

@@ -361,3 +361,87 @@ class TestBasics:
         lat = 13.01 * 10e-6
         expected = lat + 1e6 / (0.97 * 100e6)
         assert times["recv"] == pytest.approx(expected, rel=1e-6)
+
+
+STORAGE_MIX_XML = """<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <storage_type id="t" size="500GiB">
+      <model_prop id="Bwrite" value="60MBps"/>
+      <model_prop id="Bread" value="200MBps"/>
+    </storage_type>
+    <host id="hA" speed="100Mf"/>
+    <host id="hB" speed="100Mf"/>
+    <storage id="dA" typeId="t" attach="hA"/>
+    <link id="l" bandwidth="10MBps" latency="1ms"/>
+    <route src="hA" dst="hB"><link_ctn id="l"/></route>
+  </zone>
+</platform>
+"""
+
+
+class TestMixedWaitAny:
+    """s4u::Activity::wait_any / ActivitySet over a MIXED set of
+    Comm + Exec + Io (the kernel waitany machinery is kind-agnostic)."""
+
+    def _run(self, body):
+        import os
+        import tempfile
+        s4u.Engine._reset()
+        fd, path = tempfile.mkstemp(suffix=".xml")
+        os.write(fd, STORAGE_MIX_XML.encode())
+        os.close(fd)
+        try:
+            e = s4u.Engine(["t"])
+            e.load_platform(path)
+            out = {}
+            s4u.Actor.create("main", e.host_by_name("hA"),
+                             lambda: body(e, out))
+            s4u.Actor.create("peer", e.host_by_name("hB"),
+                             lambda: s4u.Mailbox.by_name("mix").put(
+                                 "hello", 2_000_000))   # ~0.2s on l
+            e.run()
+            return e, out
+        finally:
+            os.unlink(path)
+
+    def test_wait_any_of_orders_by_completion(self):
+        def body(e, out):
+            storage = e.pimpl.storages["dA"]
+            io = s4u.Io(storage, 6_000_000, s4u.Io.OpType.WRITE).start()
+            ex = s4u.this_actor.exec_async(1_000_000)     # 0.01s
+            comm = s4u.Mailbox.by_name("mix").get_async()
+            acts = [io, ex, comm]
+            order = []
+            times = []
+            while acts:
+                idx = s4u.Activity.wait_any_of(acts)
+                order.append(type(acts[idx]).__name__)
+                times.append(s4u.Engine.get_clock())
+                acts.pop(idx)
+            out["order"] = order
+            out["times"] = times
+
+        e, out = self._run(body)
+        # exec 0.01s < io 0.1s (6MB at 60MBps) < comm ~0.2s
+        assert out["order"] == ["Exec", "Io", "Comm"]
+        assert out["times"] == sorted(out["times"])
+
+    def test_activity_set(self):
+        def body(e, out):
+            storage = e.pimpl.storages["dA"]
+            bag = s4u.ActivitySet()
+            bag.push(s4u.Io(storage, 6_000_000,
+                            s4u.Io.OpType.WRITE).start())
+            bag.push(s4u.this_actor.exec_async(1_000_000))
+            bag.push(s4u.Mailbox.by_name("mix").get_async())
+            first = bag.wait_any()
+            out["first"] = type(first).__name__
+            out["left"] = bag.size()
+            bag.wait_all()
+            out["empty"] = bag.empty()
+
+        e, out = self._run(body)
+        assert out["first"] == "Exec"
+        assert out["left"] == 2
+        assert out["empty"] is True
