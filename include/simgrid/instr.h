@@ -1,0 +1,37 @@
+/* Compatibility header for MPI codes that use the reference's tracing
+ * category API (simgrid/instr.h): the NAS benchmarks call
+ * TRACE_smpi_set_category() around their phases.  Categories are a
+ * tracing concern the Python instr layer handles; from C they are
+ * accepted and ignored (same observable behavior as running the
+ * reference without --cfg=tracing:yes).
+ */
+#ifndef SIMGRID_TPU_COMPAT_INSTR_H
+#define SIMGRID_TPU_COMPAT_INSTR_H
+
+#ifndef XBT_ATTRIB_UNUSED
+#define XBT_ATTRIB_UNUSED __attribute__((unused))
+#endif
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+static XBT_ATTRIB_UNUSED void TRACE_smpi_set_category(const char* category) {
+  (void)category;
+}
+
+static XBT_ATTRIB_UNUSED void TRACE_category(const char* category) {
+  (void)category;
+}
+
+static XBT_ATTRIB_UNUSED void TRACE_category_with_color(const char* category,
+                                                        const char* color) {
+  (void)category;
+  (void)color;
+}
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* SIMGRID_TPU_COMPAT_INSTR_H */

@@ -289,6 +289,51 @@ int MPI_Type_free(MPI_Datatype* datatype);
 int MPI_Op_create(MPI_User_function* fn, int commute, MPI_Op* op);
 int MPI_Op_free(MPI_Op* op);
 
+/* -- SMPI extensions (reference include/smpi/smpi.h:988-1034): shared
+ * allocations aliased across ranks and benchmark-sampling loops.  The
+ * macro shapes are the reference's public interface, reproduced for
+ * source compatibility of unmodified SMPI codes (NAS benchmarks). */
+void* smpi_shared_malloc(size_t size, const char* file, int line);
+void smpi_shared_free(void* data);
+#define SMPI_SHARED_MALLOC(size) smpi_shared_malloc(size, __FILE__, __LINE__)
+#define SMPI_SHARED_FREE(data) smpi_shared_free(data)
+
+void smpi_execute(double duration);
+void smpi_execute_flops(double flops);
+
+void smpi_sample_1(int global, const char* file, int line, int iters,
+                   double threshold);
+int smpi_sample_2(int global, const char* file, int line, int iter_count);
+void smpi_sample_3(int global, const char* file, int line);
+int smpi_sample_exit(int global, const char* file, int line, int iter_count);
+
+#define SMPI_ITER_NAME1(line) iter_count##line
+#define SMPI_ITER_NAME(line) SMPI_ITER_NAME1(line)
+#define SMPI_SAMPLE_LOOP(loop_init, loop_end, loop_iter, global, iters,      \
+                         thres)                                              \
+  int SMPI_ITER_NAME(__LINE__) = 0;                                          \
+  {                                                                          \
+    loop_init;                                                               \
+    while (loop_end) {                                                       \
+      SMPI_ITER_NAME(__LINE__)++;                                            \
+      loop_iter;                                                             \
+    }                                                                        \
+  }                                                                          \
+  for (loop_init;                                                            \
+       loop_end                                                              \
+           ? (smpi_sample_1(global, __FILE__, __LINE__, iters, thres),       \
+              (smpi_sample_2(global, __FILE__, __LINE__,                     \
+                             SMPI_ITER_NAME(__LINE__))))                     \
+           : smpi_sample_exit(global, __FILE__, __LINE__,                    \
+                              SMPI_ITER_NAME(__LINE__));                     \
+       smpi_sample_3(global, __FILE__, __LINE__), loop_iter)
+#define SMPI_SAMPLE_LOCAL(loop_init, loop_end, loop_iter, iters, thres)      \
+  SMPI_SAMPLE_LOOP(loop_init, loop_end, loop_iter, 0, iters, thres)
+#define SMPI_SAMPLE_GLOBAL(loop_init, loop_end, loop_iter, iters, thres)     \
+  SMPI_SAMPLE_LOOP(loop_init, loop_end, loop_iter, 1, iters, thres)
+#define SMPI_SAMPLE_DELAY(duration) for (smpi_execute(duration); 0;)
+#define SMPI_SAMPLE_FLOPS(flops) for (smpi_execute_flops(flops); 0;)
+
 #ifdef __cplusplus
 }
 #endif

@@ -93,6 +93,13 @@ enum {
   SMPI_OP_FILE_READ,          /* also at/all/shared via the mode arg */
   SMPI_OP_FILE_WRITE,
   SMPI_OP_FILE_SYNC,
+  SMPI_OP_SHARED_MALLOC,      /* 63 */
+  SMPI_OP_SHARED_FREE,
+  SMPI_OP_EXECUTE,
+  SMPI_OP_SAMPLE_1,
+  SMPI_OP_SAMPLE_2,
+  SMPI_OP_SAMPLE_3,
+  SMPI_OP_SAMPLE_EXIT,
 };
 
 /* sub-modes for FILE_READ / FILE_WRITE */
@@ -413,3 +420,59 @@ int MPI_File_write_shared(MPI_File fh, const void* buf, int count,
        SMPI_IO_SHARED, 0);
 }
 int MPI_File_sync(MPI_File fh) { CALL(SMPI_OP_FILE_SYNC, A(fh)); }
+
+/* -- SMPI extensions ---------------------------------------------------------- */
+static smpi_arg_t smpi_pack_double(double v) {
+  smpi_arg_t r = 0;
+  __builtin_memcpy(&r, &v, sizeof(double));
+  return r;
+}
+
+void* smpi_shared_malloc(size_t size, const char* file, int line) {
+  smpi_arg_t out = 0;
+  smpi_arg_t args_[] = {A(size), A(file), A(line), A(&out)};
+  if (smpi_dispatch) smpi_dispatch(SMPI_OP_SHARED_MALLOC, args_);
+  return (void*)out;
+}
+
+void smpi_shared_free(void* data) {
+  smpi_arg_t args_[] = {A(data)};
+  if (smpi_dispatch) smpi_dispatch(SMPI_OP_SHARED_FREE, args_);
+}
+
+void smpi_execute(double duration) {
+  smpi_arg_t args_[] = {smpi_pack_double(duration), 0};
+  if (smpi_dispatch) smpi_dispatch(SMPI_OP_EXECUTE, args_);
+}
+
+void smpi_execute_flops(double flops) {
+  smpi_arg_t args_[] = {smpi_pack_double(flops), 1};
+  if (smpi_dispatch) smpi_dispatch(SMPI_OP_EXECUTE, args_);
+}
+
+void smpi_sample_1(int global, const char* file, int line, int iters,
+                   double threshold) {
+  smpi_arg_t args_[] = {A(global), A(file), A(line), A(iters),
+                        smpi_pack_double(threshold)};
+  if (smpi_dispatch) smpi_dispatch(SMPI_OP_SAMPLE_1, args_);
+}
+
+int smpi_sample_2(int global, const char* file, int line, int iter_count) {
+  smpi_arg_t out = 0;
+  smpi_arg_t args_[] = {A(global), A(file), A(line), A(iter_count),
+                        A(&out)};
+  if (smpi_dispatch) smpi_dispatch(SMPI_OP_SAMPLE_2, args_);
+  return (int)out;
+}
+
+void smpi_sample_3(int global, const char* file, int line) {
+  smpi_arg_t args_[] = {A(global), A(file), A(line)};
+  if (smpi_dispatch) smpi_dispatch(SMPI_OP_SAMPLE_3, args_);
+}
+
+int smpi_sample_exit(int global, const char* file, int line,
+                     int iter_count) {
+  smpi_arg_t args_[] = {A(global), A(file), A(line), A(iter_count)};
+  if (smpi_dispatch) smpi_dispatch(SMPI_OP_SAMPLE_EXIT, args_);
+  return 0;
+}

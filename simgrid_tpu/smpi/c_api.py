@@ -1260,6 +1260,131 @@ def _h_file_sync(ctx, a):
     return MPI_SUCCESS
 
 
+# -- SMPI extensions (SHARED_MALLOC / SAMPLE loops / smpi_execute) ----------
+
+#: (file, line) -> ctypes buffer shared by ALL ranks (the aliasing is
+#: the point, smpi_shared.cpp:6-60); address -> key for free
+_c_shared_blocks: Dict = {}
+_c_shared_by_addr: Dict[int, tuple] = {}
+#: sample state per (file, line[, rank])
+_c_samples: Dict = {}
+
+
+def _unpack_double(bits: int) -> float:
+    import struct
+    return struct.unpack("<d", struct.pack("<q", int(bits)))[0]
+
+
+def _h_shared_malloc(ctx, a):
+    size, file_addr, line, out_addr = a[:4]
+    key = (ctypes.string_at(int(file_addr)), int(line))
+    buf = _c_shared_blocks.get(key)
+    if buf is None or len(buf) < int(size):
+        buf = ctypes.create_string_buffer(max(int(size), 1))
+        _c_shared_blocks[key] = buf
+        _c_shared_by_addr[ctypes.addressof(buf)] = key
+    _write_i64(out_addr, ctypes.addressof(buf))
+    return MPI_SUCCESS
+
+
+def _h_shared_free(ctx, a):
+    # blocks are shared across ranks: keep them until the run ends
+    # (the reference refcounts; a rank's free must not yank the block
+    # from under its peers)
+    return MPI_SUCCESS
+
+
+def _h_execute(ctx, a):
+    amount = _unpack_double(a[0])
+    if int(a[1]):
+        runtime.smpi_execute_flops(amount)
+    else:
+        runtime.smpi_execute(amount)
+    return MPI_SUCCESS
+
+
+class _CSample:
+    __slots__ = ("iters", "threshold", "count", "total", "sumsq", "t0",
+                 "injected")
+
+    def __init__(self, iters, threshold):
+        self.iters = iters
+        self.threshold = threshold
+        self.count = 0
+        self.total = 0.0
+        self.sumsq = 0.0
+        self.t0 = None
+        self.injected = False
+
+    def need_more(self) -> bool:
+        """smpi_bench.cpp sample_enough_benchs: bench until the
+        requested iteration budget is consumed AND the relative
+        standard error falls under the threshold."""
+        if self.count < max(self.iters, 2):
+            return True
+        if self.threshold <= 0.0:
+            return False
+        mean = self.total / self.count
+        if mean == 0.0:
+            return False
+        var = self.sumsq / self.count - mean * mean
+        stderr = (max(var, 0.0) ** 0.5) / (self.count ** 0.5)
+        return stderr / abs(mean) > self.threshold
+
+
+def _sample_key(ctx, a):
+    is_global = bool(int(a[0]))
+    key = (ctypes.string_at(int(a[1])), int(a[2]))
+    if not is_global:
+        key = key + (runtime.this_rank(),)
+    return key
+
+
+def _h_sample_1(ctx, a):
+    key = _sample_key(ctx, a)
+    if key not in _c_samples:
+        _c_samples[key] = _CSample(int(a[3]), _unpack_double(a[4]))
+    return MPI_SUCCESS
+
+
+def _h_sample_2(ctx, a):
+    from ..s4u import Engine, this_actor
+    st = _c_samples.get(_sample_key(ctx, a))
+    out_addr = a[4]
+    if st is None or st.injected:
+        _write_i64(out_addr, 0)
+        return MPI_SUCCESS
+    if st.need_more():
+        st.t0 = Engine.get_clock()
+        _write_i64(out_addr, 1)
+        return MPI_SUCCESS
+    # done benching: charge the mean simulated duration for every
+    # remaining iteration in one go and stop the loop
+    remaining = int(a[3]) - st.count
+    mean = st.total / st.count if st.count else 0.0
+    if remaining > 0 and mean > 0:
+        this_actor.sleep_for(mean * remaining)
+    st.injected = True
+    _write_i64(out_addr, 0)
+    return MPI_SUCCESS
+
+
+def _h_sample_3(ctx, a):
+    from ..s4u import Engine
+    st = _c_samples.get(_sample_key(ctx, a))
+    if st is not None and st.t0 is not None:
+        dt = Engine.get_clock() - st.t0
+        st.count += 1
+        st.total += dt
+        st.sumsq += dt * dt
+        st.t0 = None
+    return MPI_SUCCESS
+
+
+def _h_sample_exit(ctx, a):
+    return MPI_SUCCESS
+
+
 _HANDLERS = {
     1: _h_init, 2: _h_finalize, 3: _h_initialized, 4: _h_finalized,
     5: _h_abort, 6: _h_comm_rank, 7: _h_comm_size, 8: _h_comm_dup,
@@ -1281,10 +1406,15 @@ _HANDLERS = {
     58: _h_file_get_position, 59: _h_file_get_size,
     60: lambda c, a: _h_file_io(c, a, write=False),
     61: lambda c, a: _h_file_io(c, a, write=True), 62: _h_file_sync,
+    63: _h_shared_malloc, 64: _h_shared_free, 65: _h_execute,
+    66: _h_sample_1, 67: _h_sample_2, 68: _h_sample_3,
+    69: _h_sample_exit,
 }
 
 #: ops that are pure local queries — no bench end/begin cycle needed
-_LOCAL_OPS = {3, 4, 24, 41, 42, 45, 46, 48, 50, 51}
+#: (sample_2/3 stay non-local: the bench injection right before their
+#: handlers is what prices the sampled loop body)
+_LOCAL_OPS = {3, 4, 24, 41, 42, 45, 46, 48, 50, 51, 63, 64, 66, 69}
 
 
 def _dispatch_py(opcode: int, args) -> int:
@@ -1357,6 +1487,7 @@ def compile_program(sources: Sequence[str], output: str,
     cc = os.environ.get("SMPI_CC", "g++" if cxx else "gcc")
     cmd = [cc, "-shared", "-fPIC", "-O2",
            "-I" + os.path.join(root, "include", "smpi"),
+           "-I" + os.path.join(root, "include"),   # smpi/mpi.h, simgrid/*
            "-Dmain=smpi_c_main",
            *[str(s) for s in sources],
            os.path.join(root, "native", "smpi_shim.c"),
@@ -1384,6 +1515,9 @@ def run_c_program(program_so: str, np_ranks: Optional[int] = None,
     tmpdir = tempfile.mkdtemp(prefix="smpi-priv-")
     exit_codes: Dict[int, int] = {}
     _ctxs.clear()
+    _c_shared_blocks.clear()
+    _c_shared_by_addr.clear()
+    _c_samples.clear()
 
     def rank_main():
         rank = runtime.this_rank()
