@@ -92,7 +92,13 @@ def stage_device(n_c: int, n_v: int, deg: int, seed: int,
     arrays = build_arrays(np.random.default_rng(seed), n_c, n_v, deg, dtype)
 
     out = {"platform": dev.platform, "dtype": np.dtype(dtype).name}
-    for name, parallel in (("local", True), ("global", False)):
+    modes = [("local", True), ("global", False)]
+    if on_tpu and n_v > 50_000:
+        # global mode needs ~10k sequential rounds here (~8 min of
+        # accelerator time for a number nobody uses — local is the
+        # accelerator mode); measure it on the small classes only
+        modes = [("local", True)]
+    for name, parallel in modes:
         _, _, _, rounds = solve_arrays(arrays, eps, parallel_rounds=parallel)
         times = []
         for _ in range(reps):
@@ -250,7 +256,7 @@ def main() -> None:
                         "native_ms": native["ms"] if native else "failed",
                         "dev": dev if dev else "failed"}
         if dev:
-            dev_ms = min(dev["ms_local"], dev["ms_global"])
+            dev_ms = min(v for k, v in dev.items() if k.startswith("ms_"))
             speedup = round(host["ms"] / dev_ms, 2) if dev_ms > 0 else None
             speedup_class = name
         if host["ms"] > 6_000:
@@ -258,7 +264,7 @@ def main() -> None:
 
     value = None
     if dev100k:
-        value = min(dev100k["ms_local"], dev100k["ms_global"])
+        value = min(v for k, v in dev100k.items() if k.startswith("ms_"))
 
     result = {
         "metric": (f"LMM solve latency @{big100k['n_v']} flows on "

@@ -1,0 +1,203 @@
+"""Packet-level network model: the ns-3 co-simulation role, natively.
+
+The reference can hand its flows to an embedded ns-3 simulation for
+packet-accurate timing (src/surf/network_ns3.cpp), coupling the two
+event loops through ``next_occurring_event_is_idempotent() == false``
+(surf_c_bindings.cpp:58-77).  This model fills that role without an
+external simulator: flows are segmented into MTU packets that traverse
+their route store-and-forward, with per-link FIFO serialization — a
+discrete-event packet simulation embedded in the model, driving the
+same co-simulation hook in kernel/engine.py:surf_solve.
+
+What it captures that the fluid models cannot: per-packet
+serialization delay, pipeline fill across multi-hop routes, and
+head-of-line blocking between flows sharing a link.  What it ignores
+(like the reference's default ns-3 CSMA mapping): protocol dynamics
+(no TCP windows, no drops — links are lossless FIFO queues).
+
+Select with --cfg=network/model:Packet; MTU via --cfg=network/mtu.
+"""
+
+from __future__ import annotations
+
+import heapq
+import math
+from typing import Dict, List, Optional
+
+from ..kernel.resource import ActionState, UpdateAlgo
+from ..ops.lmm_host import System
+from ..utils.config import config
+from .network import LinkImpl, NetworkAction, NetworkModel, SharingPolicy
+
+
+class PacketFlow(NetworkAction):
+    """One flow = a train of packets (the role of an ns-3 socket)."""
+
+    def __init__(self, model, size: float, failed: bool, route, latency):
+        super().__init__(model, size, failed)
+        self.route: List[LinkImpl] = route
+        self.latency = latency
+        mtu = float(config["network/mtu"])
+        self.n_packets = max(1, int(math.ceil(size / mtu))) if size > 0 \
+            else 1
+        self.packet_bytes = size / self.n_packets if size > 0 else 0.0
+        self.packets_arrived = 0
+
+    def update_remains_lazy(self, now: float) -> None:
+        pass  # event-driven: remains is maintained on packet arrival
+
+
+class PacketLink(LinkImpl):
+    """A link with a FIFO transmit queue (lossless CSMA-like)."""
+
+    def __init__(self, model, name: str, constraint):
+        super().__init__(model, name, constraint)
+        self.queue: List = []          # packets awaiting transmission
+        self.busy = False
+
+    def is_used(self) -> bool:
+        return self.busy or bool(self.queue)
+
+
+class _Packet:
+    __slots__ = ("flow", "hop", "index")
+
+    def __init__(self, flow: PacketFlow, index: int):
+        self.flow = flow
+        self.hop = 0               # position in flow.route
+        self.index = index
+
+
+class NetworkPacketModel(NetworkModel):
+    """Store-and-forward packet simulation behind the Model interface."""
+
+    def __init__(self, engine):
+        super().__init__(engine, UpdateAlgo.FULL)
+        # LinkImpl wants a constraint; the system is never solved —
+        # constraints only carry identity/bound for the s4u surface
+        self.set_maxmin_system(System(selective_update=False))
+        self._events: List = []    # heap of (time, seq, fn)
+        self._seq = 0
+        self.loopback = self.create_link(
+            "__loopback__", config["network/loopback-bw"],
+            config["network/loopback-lat"], SharingPolicy.FATPIPE)
+
+    # -- event machinery ---------------------------------------------------
+    def _at(self, time: float, fn) -> None:
+        heapq.heappush(self._events, (time, self._seq, fn))
+        self._seq += 1
+
+    def next_occurring_event_is_idempotent(self) -> bool:
+        return False
+
+    def next_occurring_event(self, bound: float) -> float:
+        """Co-simulation contract (hook in engine.surf_solve): `bound`
+        is the candidate time_delta; return the delta to this model's
+        next packet event if it is sooner (never later than a
+        non-negative bound), or a negative value to keep the bound."""
+        if not self._events:
+            return -1.0
+        delta = max(self._events[0][0] - self.engine.now, 0.0)
+        if bound >= 0.0 and delta > bound:
+            return -1.0
+        return delta
+
+    def update_actions_state(self, now: float, delta: float) -> None:
+        eps = config["surf/precision"]
+        while self._events and self._events[0][0] <= now + eps:
+            _, _, fn = heapq.heappop(self._events)
+            fn()
+
+    # -- packet progression ------------------------------------------------
+    def _enqueue(self, link: PacketLink, packet: _Packet,
+                 time: float) -> None:
+        link.queue.append(packet)
+        if not link.busy:
+            self._start_tx(link, time)
+
+    def _start_tx(self, link: PacketLink, time: float) -> None:
+        if not link.queue:
+            link.busy = False
+            return
+        link.busy = True
+        packet = link.queue.pop(0)
+        bw = link.get_bandwidth()
+        tx = packet.flow.packet_bytes / bw if bw > 0 else 0.0
+        done = time + tx
+
+        def on_tx_done():
+            self._start_tx(link, done)
+            arrival = done + link.get_latency()
+            self._at(arrival, lambda: self._arrive(packet, arrival))
+        self._at(done, on_tx_done)
+
+    def _arrive(self, packet: _Packet, time: float) -> None:
+        flow = packet.flow
+        packet.hop += 1
+        if packet.hop < len(flow.route):
+            nxt = flow.route[packet.hop]
+            self._at(time, lambda: self._enqueue(nxt, packet, time))
+            return
+        # reached the destination host (finish_time = engine.now, which
+        # the event scheduler has advanced to exactly this event)
+        flow.packets_arrived += 1
+        flow.update_remains(flow.packet_bytes)
+        if flow.packets_arrived >= flow.n_packets:
+            flow.finish(ActionState.FINISHED)
+
+    # -- Model interface ---------------------------------------------------
+    def create_link(self, name: str, bandwidth: float, latency: float,
+                    policy: SharingPolicy = SharingPolicy.SHARED
+                    ) -> PacketLink:
+        constraint = self.system.constraint_new(None, bandwidth)
+        if policy == SharingPolicy.FATPIPE:
+            constraint.sharing_policy = SharingPolicy.FATPIPE
+        link = PacketLink(self, name, constraint)
+        link.bandwidth_peak = bandwidth
+        link.latency_peak = latency
+        LinkImpl.on_creation(link)
+        return link
+
+    def communicate(self, src, dst, size: float,
+                    rate: float) -> PacketFlow:
+        route: List[LinkImpl] = []
+        if src is dst:
+            try:
+                latency = src.route_to(dst, route)
+            except AssertionError:
+                route, latency = [], 0.0
+            if not route and latency <= 0:
+                route = [self.loopback]
+                latency = self.loopback.get_latency()
+        else:
+            latency = src.route_to(dst, route)
+        assert route or latency > 0, \
+            f"No route between '{src.name}' and '{dst.name}'"
+
+        failed = any(not link.is_on() for link in route)
+        flow = PacketFlow(self, size, failed, route, latency)
+        flow.rate = rate
+        if not failed:
+            now = self.engine.now
+            # per-hop propagation is charged at each arrival; any extra
+            # route latency beyond the links' own (zone gateways) is
+            # charged once up front
+            extra = max(latency - sum(l.get_latency() for l in route),
+                        0.0)
+            t0 = now + extra
+            if route:
+                for i in range(flow.n_packets):
+                    packet = _Packet(flow, i)
+                    first = route[0]
+                    self._at(t0, (lambda p=packet, l=first, t=t0:
+                                  self._enqueue(l, p, t)))
+            else:
+                # latency-only route (vivaldi-style)
+                self._at(t0, lambda: self._complete_nolink(flow))
+        LinkImpl.on_communicate(flow, src, dst)
+        return flow
+
+    def _complete_nolink(self, flow: PacketFlow) -> None:
+        flow.packets_arrived = flow.n_packets
+        flow.update_remains(flow.get_remains_no_update())
+        flow.finish(ActionState.FINISHED)

@@ -45,12 +45,18 @@ def _register_defaults() -> None:
         from .network_ib import NetworkIBModel
         return NetworkIBModel(engine)
 
+    def init_packet(engine):
+        from .network_packet import NetworkPacketModel
+        return NetworkPacketModel(engine)
+
     network_models.update({
         "LV08": init_lv08,
         "CM02": init_cm02,
         "SMPI": init_smpi,
         "IB": init_ib,
         "Constant": NetworkConstantModel,
+        # the ns-3 role: packet-level co-simulation, embedded natively
+        "Packet": init_packet,
     })
 
     def init_cas01(engine):

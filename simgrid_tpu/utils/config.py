@@ -197,6 +197,9 @@ declare_flag("network/bandwidth-factor",
 declare_flag("network/weight-S",
              "RTT cost correction added per link (LV08: 20537)", 20537.0)
 declare_flag("network/loopback-bw", "Default loopback bandwidth", 498000000.0)
+declare_flag("network/mtu",
+             "Packet size (bytes) for the packet-level network model",
+             1500.0)
 declare_flag("network/loopback-lat", "Default loopback latency", 0.000015)
 declare_flag("lmm/backend",
              "Max-min solver backend: list (exact host, Python), native "

@@ -1,0 +1,139 @@
+"""Packet-level network model (network/model:Packet) — the ns-3
+co-simulation role done natively. Timing oracles are hand-computed
+store-and-forward arithmetic."""
+
+import os
+
+import pytest
+
+from simgrid_tpu import s4u
+
+XML = """<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <host id="hA" speed="100Mf"/>
+    <host id="hB" speed="100Mf"/>
+    <host id="hC" speed="100Mf"/>
+    <link id="l1" bandwidth="1MBps" latency="10ms"/>
+    <link id="l2" bandwidth="1MBps" latency="5ms"/>
+    <route src="hA" dst="hB"><link_ctn id="l1"/></route>
+    <route src="hB" dst="hC"><link_ctn id="l2"/></route>
+    <route src="hA" dst="hC">
+      <link_ctn id="l1"/><link_ctn id="l2"/>
+    </route>
+  </zone>
+</platform>
+"""
+
+MTU = 1500.0
+BW = 1e6
+
+
+@pytest.fixture(autouse=True)
+def fresh(tmp_path):
+    s4u.Engine._reset()
+    yield
+    s4u.Engine._reset()
+
+
+def run_packet(tmp_path, body, mtu=MTU):
+    path = os.path.join(tmp_path, "p.xml")
+    with open(path, "w") as f:
+        f.write(XML)
+    e = s4u.Engine(["t", "--cfg=network/model:Packet",
+                    f"--cfg=network/mtu:{mtu}"])
+    e.load_platform(path)
+    out = {}
+    body(e, out)
+    e.run()
+    return e, out
+
+
+def test_single_flow_one_hop_matches_fluid(tmp_path):
+    """One flow, one link: P packets pipeline into size/bw + latency —
+    identical to the fluid model for an uncontended flow."""
+    size = 6 * MTU
+
+    def body(e, out):
+        def sender():
+            s4u.Mailbox.by_name("m").put("x", size)
+
+        def receiver():
+            s4u.Mailbox.by_name("m").get()
+            out["t"] = s4u.Engine.get_clock()
+
+        s4u.Actor.create("snd", e.host_by_name("hA"), sender)
+        s4u.Actor.create("rcv", e.host_by_name("hB"), receiver)
+
+    e, out = run_packet(tmp_path, body)
+    assert out["t"] == pytest.approx(size / BW + 0.010, rel=1e-9)
+
+
+def test_two_hop_pipeline_fill(tmp_path):
+    """Two-hop store-and-forward: (P+1) serializations + both
+    latencies — one extra MTU of pipeline fill versus the fluid
+    model's size/bw + latency."""
+    P = 6
+    size = P * MTU
+
+    def body(e, out):
+        def sender():
+            s4u.Mailbox.by_name("m").put("x", size)
+
+        def receiver():
+            s4u.Mailbox.by_name("m").get()
+            out["t"] = s4u.Engine.get_clock()
+
+        s4u.Actor.create("snd", e.host_by_name("hA"), sender)
+        s4u.Actor.create("rcv", e.host_by_name("hC"), receiver)
+
+    e, out = run_packet(tmp_path, body)
+    expected = (P + 1) * MTU / BW + 0.010 + 0.005
+    assert out["t"] == pytest.approx(expected, rel=1e-9)
+
+
+def test_fifo_head_of_line_blocking(tmp_path):
+    """Two flows share l1: the second flow's packets queue behind the
+    first's train (FIFO), unlike the fluid model's fair sharing."""
+    def body(e, out):
+        def sender(mbox, size):
+            s4u.Mailbox.by_name(mbox).put("x", size)
+
+        def receiver(mbox, key):
+            s4u.Mailbox.by_name(mbox).get()
+            out[key] = s4u.Engine.get_clock()
+
+        # flow 1: long train; flow 2: single packet, starts at the
+        # same instant — its packet serializes after flow 1's first
+        # packet at best (FIFO order by enqueue sequence)
+        s4u.Actor.create("s1", e.host_by_name("hA"),
+                         lambda: sender("m1", 10 * MTU))
+        s4u.Actor.create("r1", e.host_by_name("hB"),
+                         lambda: receiver("m1", "t1"))
+        s4u.Actor.create("s2", e.host_by_name("hA"),
+                         lambda: sender("m2", MTU))
+        s4u.Actor.create("r2", e.host_by_name("hB"),
+                         lambda: receiver("m2", "t2"))
+
+    e, out = run_packet(tmp_path, body)
+    # flow 1 enqueued its whole train first: flow 2's packet transmits
+    # 11th -> t2 = 11 * mtu/bw + lat; flow 1 done after 10 packets
+    assert out["t1"] == pytest.approx(10 * MTU / BW + 0.010, rel=1e-9)
+    assert out["t2"] == pytest.approx(11 * MTU / BW + 0.010, rel=1e-9)
+
+
+def test_small_message_latency_bound(tmp_path):
+    """A sub-MTU message is one packet: latency + one serialization."""
+    def body(e, out):
+        def sender():
+            s4u.Mailbox.by_name("m").put("x", 100.0)
+
+        def receiver():
+            s4u.Mailbox.by_name("m").get()
+            out["t"] = s4u.Engine.get_clock()
+
+        s4u.Actor.create("snd", e.host_by_name("hA"), sender)
+        s4u.Actor.create("rcv", e.host_by_name("hB"), receiver)
+
+    e, out = run_packet(tmp_path, body)
+    assert out["t"] == pytest.approx(100.0 / BW + 0.010, rel=1e-9)
