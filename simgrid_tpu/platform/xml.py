@@ -67,9 +67,8 @@ class PlatformLoader:
         except ET.ParseError as e:
             raise ParseError(f"{path}: {e}") from None
         root = tree.getroot()
-        if root.tag != "platform":
-            raise ParseError(f"{path}: root element must be <platform>, "
-                             f"got <{root.tag}>")
+        from .dtd import validate
+        validate(root, path)
         for child in root:
             self._dispatch_toplevel(child, None)
         if self.engine.netzone_root is not None:

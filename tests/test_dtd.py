@@ -1,0 +1,88 @@
+"""Platform XML structural validation (simgrid.dtd contract): typos
+must fail loudly, and the reference's own platform corpus must pass."""
+
+import glob
+import os
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from simgrid_tpu import s4u
+from simgrid_tpu.exceptions import ParseError
+from simgrid_tpu.platform.dtd import validate
+
+REF_PLATFORMS = "/root/reference/examples/platforms"
+
+
+@pytest.fixture(autouse=True)
+def fresh():
+    s4u.Engine._reset()
+    yield
+    s4u.Engine._reset()
+
+
+def _load(tmp_path, body):
+    path = os.path.join(tmp_path, "p.xml")
+    with open(path, "w") as f:
+        f.write(f"<?xml version='1.0'?>\n<platform version=\"4.1\">\n"
+                f"{body}\n</platform>\n")
+    e = s4u.Engine(["t"])
+    e.load_platform(path)
+    return e
+
+
+BASE = """<zone id="z" routing="Full">
+  <host id="h" speed="1Gf"/>
+</zone>"""
+
+
+def test_valid_platform_loads(tmp_path):
+    _load(tmp_path, BASE)
+
+
+@pytest.mark.parametrize("body,fragment", [
+    # typo'd tag (caught by the parent's content model)
+    ('<zone id="z" routing="Full"><hosst id="h" speed="1Gf"/></zone>',
+     "not allowed inside"),
+    # typo'd attribute (the required one is then missing)
+    ('<zone id="z" routing="Full"><host id="h" sped="1Gf"/></zone>',
+     "required attribute"),
+    # unknown extra attribute
+    ('<zone id="z" routing="Full">'
+     '<host id="h" speed="1Gf" sped="1Gf"/></zone>',
+     "unknown attribute"),
+    # missing required attribute
+    ('<zone id="z" routing="Full"><host id="h"/></zone>',
+     "required attribute"),
+    # out-of-enum value
+    ('<zone id="z" routing="Fulll"><host id="h" speed="1Gf"/></zone>',
+     "not one of"),
+    # wrong nesting: link_ctn outside a route
+    ('<zone id="z" routing="Full"><link_ctn id="l"/></zone>',
+     "not allowed inside"),
+])
+def test_dtd_violations_rejected(tmp_path, body, fragment):
+    with pytest.raises(ParseError) as exc:
+        _load(tmp_path, body)
+    assert fragment in str(exc.value)
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_PLATFORMS),
+                    reason="reference platforms unavailable")
+def test_reference_platform_corpus_validates():
+    """Every v4.x platform of the reference's examples must pass the
+    structural validator (the corpus the reference's own FleXML parser
+    accepts)."""
+    checked = 0
+    for path in sorted(glob.glob(f"{REF_PLATFORMS}/*.xml")):
+        try:
+            root = ET.parse(path).getroot()
+        except ET.ParseError:
+            continue                     # non-platform xml (deployments)
+        if root.tag != "platform":
+            continue
+        if not str(root.get("version", "")).startswith("4"):
+            continue                     # v3 platforms are pre-DTD-v4
+        validate(root, path)
+        checked += 1
+    assert checked > 30
