@@ -83,6 +83,16 @@ typedef struct MPI_Status {
 #define MPI_OFFSET 31
 #define MPI_COUNT 32
 #define MPI_PACKED 33
+#define MPI_DOUBLE_COMPLEX 34
+#define MPI_COMPLEX 35
+#define MPI_C_FLOAT_COMPLEX 36
+#define MPI_C_COMPLEX MPI_C_FLOAT_COMPLEX
+#define MPI_C_DOUBLE_COMPLEX 37
+#define MPI_C_LONG_DOUBLE_COMPLEX 38
+#define MPI_SHORT_INT 39
+#define MPI_LONG_DOUBLE_INT 40
+#define MPI_UB 41
+#define MPI_LB 42
 
 /* -- predefined reduction ops ------------------------------------------ */
 #define MPI_OP_NULL 0
@@ -274,6 +284,32 @@ int MPI_Reduce_scatter_block(const void* sendbuf, void* recvbuf,
                              int recvcount, MPI_Datatype datatype,
                              MPI_Op op, MPI_Comm comm);
 
+/* xbt concatenation helpers: the reference's smpi.h include chain
+ * provides them (xbt/base.h) and its patched mpich3 tests use them */
+#ifndef _XBT_CONCAT
+#define _XBT_CONCAT(a, b) a##b
+#define _XBT_CONCAT3(a, b, c) a##b##c
+#define _XBT_CONCAT4(a, b, c, d) a##b##c##d
+#endif
+
+/* -- error handlers (errors always return in this implementation) -------- */
+typedef int MPI_Errhandler;
+#define MPI_ERRHANDLER_NULL 0
+#define MPI_ERRORS_RETURN 1
+#define MPI_ERRORS_ARE_FATAL 2
+static __attribute__((unused)) int
+MPI_Comm_set_errhandler(MPI_Comm comm, MPI_Errhandler errhandler) {
+  (void)comm;
+  (void)errhandler;
+  return MPI_SUCCESS;
+}
+static __attribute__((unused)) int
+MPI_Errhandler_set(MPI_Comm comm, MPI_Errhandler errhandler) {
+  (void)comm;
+  (void)errhandler;
+  return MPI_SUCCESS;
+}
+
 /* -- datatypes ----------------------------------------------------------- */
 int MPI_Type_size(MPI_Datatype datatype, int* size);
 int MPI_Type_get_extent(MPI_Datatype datatype, MPI_Aint* lb,
@@ -284,10 +320,139 @@ int MPI_Type_vector(int count, int blocklength, int stride,
                     MPI_Datatype oldtype, MPI_Datatype* newtype);
 int MPI_Type_commit(MPI_Datatype* datatype);
 int MPI_Type_free(MPI_Datatype* datatype);
+int MPI_Type_create_struct(int count, const int* blocklengths,
+                           const MPI_Aint* displacements,
+                           const MPI_Datatype* types,
+                           MPI_Datatype* newtype);
+int MPI_Type_struct(int count, int* blocklengths, MPI_Aint* displacements,
+                    MPI_Datatype* types, MPI_Datatype* newtype);
+int MPI_Type_extent(MPI_Datatype datatype, MPI_Aint* extent);
+
+int MPI_Type_get_name(MPI_Datatype datatype, char* name, int* resultlen);
+int MPI_Type_set_name(MPI_Datatype datatype, const char* name);
+
+/* -- cartesian topologies ------------------------------------------------- */
+#define MPI_CART 1
+#define MPI_GRAPH 2
+int MPI_Cart_create(MPI_Comm comm, int ndims, const int* dims,
+                    const int* periods, int reorder, MPI_Comm* newcomm);
+int MPI_Cart_get(MPI_Comm comm, int maxdims, int* dims, int* periods,
+                 int* coords);
+int MPI_Cart_rank(MPI_Comm comm, const int* coords, int* rank);
+int MPI_Cart_coords(MPI_Comm comm, int rank, int maxdims, int* coords);
+int MPI_Cart_shift(MPI_Comm comm, int direction, int disp,
+                   int* rank_source, int* rank_dest);
+int MPI_Cart_sub(MPI_Comm comm, const int* remain_dims, MPI_Comm* newcomm);
+int MPI_Cartdim_get(MPI_Comm comm, int* ndims);
+int MPI_Dims_create(int nnodes, int ndims, int* dims);
+int MPI_Topo_test(MPI_Comm comm, int* status);
+
+/* -- non-blocking collectives -------------------------------------------- */
+int MPI_Ibarrier(MPI_Comm comm, MPI_Request* request);
+int MPI_Ibcast(void* buf, int count, MPI_Datatype datatype, int root,
+               MPI_Comm comm, MPI_Request* request);
+int MPI_Ireduce(const void* sendbuf, void* recvbuf, int count,
+                MPI_Datatype datatype, MPI_Op op, int root, MPI_Comm comm,
+                MPI_Request* request);
+int MPI_Iallreduce(const void* sendbuf, void* recvbuf, int count,
+                   MPI_Datatype datatype, MPI_Op op, MPI_Comm comm,
+                   MPI_Request* request);
+int MPI_Igather(const void* sendbuf, int sendcount, MPI_Datatype sendtype,
+                void* recvbuf, int recvcount, MPI_Datatype recvtype,
+                int root, MPI_Comm comm, MPI_Request* request);
+int MPI_Iscatter(const void* sendbuf, int sendcount, MPI_Datatype sendtype,
+                 void* recvbuf, int recvcount, MPI_Datatype recvtype,
+                 int root, MPI_Comm comm, MPI_Request* request);
+int MPI_Iallgather(const void* sendbuf, int sendcount,
+                   MPI_Datatype sendtype, void* recvbuf, int recvcount,
+                   MPI_Datatype recvtype, MPI_Comm comm,
+                   MPI_Request* request);
+int MPI_Ialltoall(const void* sendbuf, int sendcount,
+                  MPI_Datatype sendtype, void* recvbuf, int recvcount,
+                  MPI_Datatype recvtype, MPI_Comm comm,
+                  MPI_Request* request);
 
 /* -- reduction ops ------------------------------------------------------- */
 int MPI_Op_create(MPI_User_function* fn, int commute, MPI_Op* op);
 int MPI_Op_free(MPI_Op* op);
+
+/* -- memory / info / naming / groups / windows --------------------------- */
+int MPI_Alloc_mem(MPI_Aint size, MPI_Info info, void* baseptr);
+int MPI_Free_mem(void* base);
+int MPI_Error_class(int errorcode, int* errorclass);
+int MPI_Comm_get_name(MPI_Comm comm, char* name, int* resultlen);
+int MPI_Comm_set_name(MPI_Comm comm, const char* name);
+int MPI_Comm_test_inter(MPI_Comm comm, int* flag);
+int MPI_Comm_remote_size(MPI_Comm comm, int* size);
+int MPI_Intercomm_create(MPI_Comm local_comm, int local_leader,
+                         MPI_Comm peer_comm, int remote_leader, int tag,
+                         MPI_Comm* newintercomm);
+int MPI_Comm_create(MPI_Comm comm, MPI_Group group, MPI_Comm* newcomm);
+int MPI_Group_incl(MPI_Group group, int n, const int* ranks,
+                   MPI_Group* newgroup);
+int MPI_Group_excl(MPI_Group group, int n, const int* ranks,
+                   MPI_Group* newgroup);
+int MPI_Group_range_incl(MPI_Group group, int n, int ranges[][3],
+                         MPI_Group* newgroup);
+int MPI_Info_create(MPI_Info* info);
+int MPI_Info_set(MPI_Info info, const char* key, const char* value);
+int MPI_Info_free(MPI_Info* info);
+int MPI_Win_create(void* base, MPI_Aint size, int disp_unit,
+                   MPI_Info info, MPI_Comm comm, MPI_Win* win);
+int MPI_Win_free(MPI_Win* win);
+int MPI_Win_fence(int assertion, MPI_Win win);
+
+/* -- attributes / keyvals ------------------------------------------------ */
+#define MPI_KEYVAL_INVALID -1
+/* predefined COMM_WORLD attributes (values mirrored in c_api.py) */
+#define MPI_TAG_UB 1
+#define MPI_HOST 2
+#define MPI_IO 3
+#define MPI_WTIME_IS_GLOBAL 4
+#define MPI_UNIVERSE_SIZE 5
+#define MPI_APPNUM 6
+#define MPI_LASTUSEDCODE 7
+/* predefined window attributes */
+#define MPI_WIN_BASE 16
+#define MPI_WIN_SIZE 17
+#define MPI_WIN_DISP_UNIT 18
+
+typedef int MPI_Comm_copy_attr_function(MPI_Comm, int, void*, void*, void*,
+                                        int*);
+typedef int MPI_Comm_delete_attr_function(MPI_Comm, int, void*, void*);
+typedef MPI_Comm_copy_attr_function MPI_Copy_function;
+typedef MPI_Comm_delete_attr_function MPI_Delete_function;
+typedef int MPI_Win_copy_attr_function(MPI_Win, int, void*, void*, void*,
+                                       int*);
+typedef int MPI_Win_delete_attr_function(MPI_Win, int, void*, void*);
+#define MPI_NULL_COPY_FN ((MPI_Copy_function*)0)
+#define MPI_NULL_DELETE_FN ((MPI_Delete_function*)0)
+#define MPI_COMM_NULL_COPY_FN ((MPI_Comm_copy_attr_function*)0)
+#define MPI_COMM_NULL_DELETE_FN ((MPI_Comm_delete_attr_function*)0)
+#define MPI_WIN_NULL_COPY_FN ((MPI_Win_copy_attr_function*)0)
+#define MPI_WIN_NULL_DELETE_FN ((MPI_Win_delete_attr_function*)0)
+
+int MPI_Comm_create_keyval(MPI_Comm_copy_attr_function* copy_fn,
+                           MPI_Comm_delete_attr_function* delete_fn,
+                           int* keyval, void* extra_state);
+int MPI_Comm_free_keyval(int* keyval);
+int MPI_Comm_set_attr(MPI_Comm comm, int keyval, void* value);
+int MPI_Comm_get_attr(MPI_Comm comm, int keyval, void* value, int* flag);
+int MPI_Comm_delete_attr(MPI_Comm comm, int keyval);
+/* MPI-1 names */
+int MPI_Keyval_create(MPI_Copy_function* copy_fn,
+                      MPI_Delete_function* delete_fn, int* keyval,
+                      void* extra_state);
+int MPI_Keyval_free(int* keyval);
+int MPI_Attr_put(MPI_Comm comm, int keyval, void* value);
+int MPI_Attr_get(MPI_Comm comm, int keyval, void* value, int* flag);
+int MPI_Attr_delete(MPI_Comm comm, int keyval);
+int MPI_Win_create_keyval(MPI_Win_copy_attr_function* copy_fn,
+                          MPI_Win_delete_attr_function* delete_fn,
+                          int* keyval, void* extra_state);
+int MPI_Win_free_keyval(int* keyval);
+int MPI_Win_set_attr(MPI_Win win, int keyval, void* value);
+int MPI_Win_get_attr(MPI_Win win, int keyval, void* value, int* flag);
 
 /* -- SMPI extensions (reference include/smpi/smpi.h:988-1034): shared
  * allocations aliased across ranks and benchmark-sampling loops.  The

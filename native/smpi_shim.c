@@ -100,6 +100,40 @@ enum {
   SMPI_OP_SAMPLE_2,
   SMPI_OP_SAMPLE_3,
   SMPI_OP_SAMPLE_EXIT,
+  SMPI_OP_COMM_GET_NAME,      /* 70 */
+  SMPI_OP_COMM_CREATE,
+  SMPI_OP_GROUP_INCL,
+  SMPI_OP_GROUP_EXCL,
+  SMPI_OP_GROUP_RANGE_INCL,
+  SMPI_OP_KEYVAL_CREATE,
+  SMPI_OP_KEYVAL_FREE,
+  SMPI_OP_ATTR_PUT,
+  SMPI_OP_ATTR_GET,
+  SMPI_OP_ATTR_DELETE,
+  SMPI_OP_WIN_CREATE,         /* 80 */
+  SMPI_OP_WIN_FREE,
+  SMPI_OP_WIN_FENCE,
+  SMPI_OP_WIN_GET_ATTR,
+  SMPI_OP_WIN_SET_ATTR,
+  SMPI_OP_TYPE_STRUCT,        /* 85 */
+  SMPI_OP_IBARRIER,
+  SMPI_OP_IBCAST,
+  SMPI_OP_IREDUCE,
+  SMPI_OP_IALLREDUCE,
+  SMPI_OP_IGATHER,            /* 90 */
+  SMPI_OP_ISCATTER,
+  SMPI_OP_IALLGATHER,
+  SMPI_OP_IALLTOALL,
+  SMPI_OP_TYPE_GET_NAME,
+  SMPI_OP_CART_CREATE,        /* 95 */
+  SMPI_OP_CART_GET,
+  SMPI_OP_CART_RANK,
+  SMPI_OP_CART_COORDS,
+  SMPI_OP_CART_SHIFT,
+  SMPI_OP_CART_SUB,           /* 100 */
+  SMPI_OP_CARTDIM_GET,
+  SMPI_OP_DIMS_CREATE,
+  SMPI_OP_TOPO_TEST,
 };
 
 /* sub-modes for FILE_READ / FILE_WRITE */
@@ -475,4 +509,245 @@ int smpi_sample_exit(int global, const char* file, int line,
   smpi_arg_t args_[] = {A(global), A(file), A(line), A(iter_count)};
   if (smpi_dispatch) smpi_dispatch(SMPI_OP_SAMPLE_EXIT, args_);
   return 0;
+}
+
+/* -- memory / info / naming: host-local, no simulation involvement -------- */
+#include <stdlib.h>
+
+int MPI_Alloc_mem(MPI_Aint size, MPI_Info info, void* baseptr) {
+  (void)info;
+  *(void**)baseptr = malloc((size_t)size);
+  return *(void**)baseptr || size == 0 ? MPI_SUCCESS : MPI_ERR_INTERN;
+}
+int MPI_Free_mem(void* base) {
+  free(base);
+  return MPI_SUCCESS;
+}
+int MPI_Error_class(int errorcode, int* errorclass) {
+  *errorclass = errorcode;
+  return MPI_SUCCESS;
+}
+int MPI_Comm_test_inter(MPI_Comm comm, int* flag) {
+  (void)comm;
+  *flag = 0;    /* intercommunicators are not implemented */
+  return MPI_SUCCESS;
+}
+int MPI_Comm_remote_size(MPI_Comm comm, int* size) {
+  (void)comm;
+  (void)size;
+  return MPI_ERR_COMM;   /* no intercommunicators */
+}
+int MPI_Intercomm_create(MPI_Comm local_comm, int local_leader,
+                         MPI_Comm peer_comm, int remote_leader, int tag,
+                         MPI_Comm* newintercomm) {
+  (void)local_comm; (void)local_leader; (void)peer_comm;
+  (void)remote_leader; (void)tag;
+  *newintercomm = MPI_COMM_NULL;
+  return MPI_ERR_INTERN; /* not implemented */
+}
+int MPI_Comm_set_name(MPI_Comm comm, const char* name) {
+  (void)comm; (void)name;
+  return MPI_SUCCESS;
+}
+static int smpi_info_counter = 1;
+int MPI_Info_create(MPI_Info* info) {
+  *info = smpi_info_counter++;
+  return MPI_SUCCESS;
+}
+int MPI_Info_set(MPI_Info info, const char* key, const char* value) {
+  (void)info; (void)key; (void)value;
+  return MPI_SUCCESS;
+}
+int MPI_Info_free(MPI_Info* info) {
+  *info = MPI_INFO_NULL;
+  return MPI_SUCCESS;
+}
+
+/* -- dispatch-backed group/comm/attr/window calls -------------------------- */
+int MPI_Comm_get_name(MPI_Comm comm, char* name, int* resultlen) {
+  CALL(SMPI_OP_COMM_GET_NAME, A(comm), A(name), A(resultlen));
+}
+int MPI_Comm_create(MPI_Comm comm, MPI_Group group, MPI_Comm* newcomm) {
+  CALL(SMPI_OP_COMM_CREATE, A(comm), A(group), A(newcomm));
+}
+int MPI_Group_incl(MPI_Group group, int n, const int* ranks,
+                   MPI_Group* newgroup) {
+  CALL(SMPI_OP_GROUP_INCL, A(group), A(n), A(ranks), A(newgroup));
+}
+int MPI_Group_excl(MPI_Group group, int n, const int* ranks,
+                   MPI_Group* newgroup) {
+  CALL(SMPI_OP_GROUP_EXCL, A(group), A(n), A(ranks), A(newgroup));
+}
+int MPI_Group_range_incl(MPI_Group group, int n, int ranges[][3],
+                         MPI_Group* newgroup) {
+  CALL(SMPI_OP_GROUP_RANGE_INCL, A(group), A(n), A(ranges), A(newgroup));
+}
+int MPI_Comm_create_keyval(MPI_Comm_copy_attr_function* copy_fn,
+                           MPI_Comm_delete_attr_function* delete_fn,
+                           int* keyval, void* extra_state) {
+  (void)copy_fn; (void)delete_fn; (void)extra_state;
+  CALL(SMPI_OP_KEYVAL_CREATE, A(keyval));
+}
+int MPI_Comm_free_keyval(int* keyval) {
+  CALL(SMPI_OP_KEYVAL_FREE, A(keyval));
+}
+int MPI_Comm_set_attr(MPI_Comm comm, int keyval, void* value) {
+  CALL(SMPI_OP_ATTR_PUT, A(comm), A(keyval), A(value));
+}
+int MPI_Comm_get_attr(MPI_Comm comm, int keyval, void* value, int* flag) {
+  CALL(SMPI_OP_ATTR_GET, A(comm), A(keyval), A(value), A(flag));
+}
+int MPI_Comm_delete_attr(MPI_Comm comm, int keyval) {
+  CALL(SMPI_OP_ATTR_DELETE, A(comm), A(keyval));
+}
+int MPI_Keyval_create(MPI_Copy_function* copy_fn,
+                      MPI_Delete_function* delete_fn, int* keyval,
+                      void* extra_state) {
+  return MPI_Comm_create_keyval(copy_fn, delete_fn, keyval, extra_state);
+}
+int MPI_Keyval_free(int* keyval) { return MPI_Comm_free_keyval(keyval); }
+int MPI_Attr_put(MPI_Comm comm, int keyval, void* value) {
+  return MPI_Comm_set_attr(comm, keyval, value);
+}
+int MPI_Attr_get(MPI_Comm comm, int keyval, void* value, int* flag) {
+  return MPI_Comm_get_attr(comm, keyval, value, flag);
+}
+int MPI_Attr_delete(MPI_Comm comm, int keyval) {
+  return MPI_Comm_delete_attr(comm, keyval);
+}
+int MPI_Win_create_keyval(MPI_Win_copy_attr_function* copy_fn,
+                          MPI_Win_delete_attr_function* delete_fn,
+                          int* keyval, void* extra_state) {
+  (void)copy_fn; (void)delete_fn; (void)extra_state;
+  CALL(SMPI_OP_KEYVAL_CREATE, A(keyval));
+}
+int MPI_Win_free_keyval(int* keyval) {
+  CALL(SMPI_OP_KEYVAL_FREE, A(keyval));
+}
+int MPI_Win_create(void* base, MPI_Aint size, int disp_unit,
+                   MPI_Info info, MPI_Comm comm, MPI_Win* win) {
+  (void)info;
+  CALL(SMPI_OP_WIN_CREATE, A(base), A(size), A(disp_unit), A(comm),
+       A(win));
+}
+int MPI_Win_free(MPI_Win* win) { CALL(SMPI_OP_WIN_FREE, A(win)); }
+int MPI_Win_fence(int assertion, MPI_Win win) {
+  CALL(SMPI_OP_WIN_FENCE, A(assertion), A(win));
+}
+int MPI_Win_get_attr(MPI_Win win, int keyval, void* value, int* flag) {
+  CALL(SMPI_OP_WIN_GET_ATTR, A(win), A(keyval), A(value), A(flag));
+}
+int MPI_Win_set_attr(MPI_Win win, int keyval, void* value) {
+  CALL(SMPI_OP_WIN_SET_ATTR, A(win), A(keyval), A(value));
+}
+
+/* -- struct datatypes -------------------------------------------------------- */
+int MPI_Type_create_struct(int count, const int* blocklengths,
+                           const MPI_Aint* displacements,
+                           const MPI_Datatype* types,
+                           MPI_Datatype* newtype) {
+  CALL(SMPI_OP_TYPE_STRUCT, A(count), A(blocklengths), A(displacements),
+       A(types), A(newtype));
+}
+int MPI_Type_struct(int count, int* blocklengths, MPI_Aint* displacements,
+                    MPI_Datatype* types, MPI_Datatype* newtype) {
+  return MPI_Type_create_struct(count, blocklengths, displacements, types,
+                                newtype);
+}
+int MPI_Type_extent(MPI_Datatype datatype, MPI_Aint* extent) {
+  MPI_Aint lb;
+  return MPI_Type_get_extent(datatype, &lb, extent);
+}
+
+int MPI_Type_get_name(MPI_Datatype datatype, char* name, int* resultlen) {
+  CALL(SMPI_OP_TYPE_GET_NAME, A(datatype), A(name), A(resultlen));
+}
+int MPI_Type_set_name(MPI_Datatype datatype, const char* name) {
+  (void)datatype;
+  (void)name;
+  return MPI_SUCCESS;
+}
+
+/* -- cartesian topologies ------------------------------------------------------ */
+int MPI_Cart_create(MPI_Comm comm, int ndims, const int* dims,
+                    const int* periods, int reorder, MPI_Comm* newcomm) {
+  CALL(SMPI_OP_CART_CREATE, A(comm), A(ndims), A(dims), A(periods),
+       A(reorder), A(newcomm));
+}
+int MPI_Cart_get(MPI_Comm comm, int maxdims, int* dims, int* periods,
+                 int* coords) {
+  CALL(SMPI_OP_CART_GET, A(comm), A(maxdims), A(dims), A(periods),
+       A(coords));
+}
+int MPI_Cart_rank(MPI_Comm comm, const int* coords, int* rank) {
+  CALL(SMPI_OP_CART_RANK, A(comm), A(coords), A(rank));
+}
+int MPI_Cart_coords(MPI_Comm comm, int rank, int maxdims, int* coords) {
+  CALL(SMPI_OP_CART_COORDS, A(comm), A(rank), A(maxdims), A(coords));
+}
+int MPI_Cart_shift(MPI_Comm comm, int direction, int disp,
+                   int* rank_source, int* rank_dest) {
+  CALL(SMPI_OP_CART_SHIFT, A(comm), A(direction), A(disp), A(rank_source),
+       A(rank_dest));
+}
+int MPI_Cart_sub(MPI_Comm comm, const int* remain_dims,
+                 MPI_Comm* newcomm) {
+  CALL(SMPI_OP_CART_SUB, A(comm), A(remain_dims), A(newcomm));
+}
+int MPI_Cartdim_get(MPI_Comm comm, int* ndims) {
+  CALL(SMPI_OP_CARTDIM_GET, A(comm), A(ndims));
+}
+int MPI_Dims_create(int nnodes, int ndims, int* dims) {
+  CALL(SMPI_OP_DIMS_CREATE, A(nnodes), A(ndims), A(dims));
+}
+int MPI_Topo_test(MPI_Comm comm, int* status) {
+  CALL(SMPI_OP_TOPO_TEST, A(comm), A(status));
+}
+
+/* -- non-blocking collectives -------------------------------------------------- */
+int MPI_Ibarrier(MPI_Comm comm, MPI_Request* request) {
+  CALL(SMPI_OP_IBARRIER, A(comm), A(request));
+}
+int MPI_Ibcast(void* buf, int count, MPI_Datatype datatype, int root,
+               MPI_Comm comm, MPI_Request* request) {
+  CALL(SMPI_OP_IBCAST, A(buf), A(count), A(datatype), A(root), A(comm),
+       A(request));
+}
+int MPI_Ireduce(const void* sendbuf, void* recvbuf, int count,
+                MPI_Datatype datatype, MPI_Op op, int root, MPI_Comm comm,
+                MPI_Request* request) {
+  CALL(SMPI_OP_IREDUCE, A(sendbuf), A(recvbuf), A(count), A(datatype),
+       A(op), A(root), A(comm), A(request));
+}
+int MPI_Iallreduce(const void* sendbuf, void* recvbuf, int count,
+                   MPI_Datatype datatype, MPI_Op op, MPI_Comm comm,
+                   MPI_Request* request) {
+  CALL(SMPI_OP_IALLREDUCE, A(sendbuf), A(recvbuf), A(count), A(datatype),
+       A(op), A(comm), A(request));
+}
+int MPI_Igather(const void* sendbuf, int sendcount, MPI_Datatype sendtype,
+                void* recvbuf, int recvcount, MPI_Datatype recvtype,
+                int root, MPI_Comm comm, MPI_Request* request) {
+  CALL(SMPI_OP_IGATHER, A(sendbuf), A(sendcount), A(sendtype), A(recvbuf),
+       A(recvcount), A(recvtype), A(root), A(comm), A(request));
+}
+int MPI_Iscatter(const void* sendbuf, int sendcount, MPI_Datatype sendtype,
+                 void* recvbuf, int recvcount, MPI_Datatype recvtype,
+                 int root, MPI_Comm comm, MPI_Request* request) {
+  CALL(SMPI_OP_ISCATTER, A(sendbuf), A(sendcount), A(sendtype), A(recvbuf),
+       A(recvcount), A(recvtype), A(root), A(comm), A(request));
+}
+int MPI_Iallgather(const void* sendbuf, int sendcount,
+                   MPI_Datatype sendtype, void* recvbuf, int recvcount,
+                   MPI_Datatype recvtype, MPI_Comm comm,
+                   MPI_Request* request) {
+  CALL(SMPI_OP_IALLGATHER, A(sendbuf), A(sendcount), A(sendtype),
+       A(recvbuf), A(recvcount), A(recvtype), A(comm), A(request));
+}
+int MPI_Ialltoall(const void* sendbuf, int sendcount,
+                  MPI_Datatype sendtype, void* recvbuf, int recvcount,
+                  MPI_Datatype recvtype, MPI_Comm comm,
+                  MPI_Request* request) {
+  CALL(SMPI_OP_IALLTOALL, A(sendbuf), A(sendcount), A(sendtype),
+       A(recvbuf), A(recvcount), A(recvtype), A(comm), A(request));
 }

@@ -125,6 +125,19 @@ _predef(30, 8, np.int64, "MPI_AINT")
 _predef(31, 8, np.int64, "MPI_OFFSET")
 _predef(32, 8, np.int64, "MPI_COUNT")
 _predef(33, 1, np.uint8, "MPI_PACKED")
+_predef(34, 16, np.complex128, "MPI_DOUBLE_COMPLEX")
+_predef(35, 8, np.complex64, "MPI_COMPLEX")
+_predef(36, 8, np.complex64, "MPI_C_FLOAT_COMPLEX")
+_predef(37, 16, np.complex128, "MPI_C_DOUBLE_COMPLEX")
+_predef(38, np.dtype(np.clongdouble).itemsize * 2
+        if np.dtype(np.clongdouble).itemsize < 32 else 32,
+        np.clongdouble, "MPI_C_LONG_DOUBLE_COMPLEX")
+_si = _dt_struct([("v", "<i2"), ("i", "<i4")])
+_predef(39, _si.itemsize, _si, "MPI_SHORT_INT")
+_ldi = _dt_struct([("v", np.longdouble), ("i", "<i4")])
+_predef(40, _ldi.itemsize, _ldi, "MPI_LONG_DOUBLE_INT")
+_predef(41, 0, None, "MPI_UB")      # legacy extent markers
+_predef(42, 0, None, "MPI_LB")
 
 #: predefined op handles -> Op ("loc" ops resolved separately)
 _PREDEF_OPS: Dict[int, Op] = {
@@ -171,6 +184,11 @@ class _CRankCtx:
         self.next_group = 10
         self.files: Dict[int, object] = {}
         self.next_file = 1
+        self.comm_attrs: Dict = {}
+        self.next_keyval = 64
+        self.wins: Dict[int, dict] = {}
+        self.next_win = 1
+        self.cart_topos: Dict[int, object] = {}
         self.bench_t0: Optional[float] = None
         self.initialized = False
         self.finalized = False
@@ -179,15 +197,16 @@ class _CRankCtx:
 
 
 class _CReq:
-    __slots__ = ("req", "c_addr", "arr", "kind", "dt")
+    __slots__ = ("req", "c_addr", "arr", "kind", "dt", "post")
 
-    def __init__(self, req: Request, c_addr: int, arr, kind: str,
-                 dt: Optional[Datatype] = None):
+    def __init__(self, req, c_addr: int, arr, kind: str,
+                 dt: Optional[Datatype] = None, post=None):
         self.req = req
         self.c_addr = c_addr
         self.arr = arr
-        self.kind = kind
+        self.kind = kind          # "send" | "recv" | "nbc"
         self.dt = dt
+        self.post = post          # nbc: result -> C buffers copier
 
 
 _ctxs: Dict[int, _CRankCtx] = {}
@@ -413,12 +432,26 @@ def _new_req_handle(ctx: _CRankCtx, creq: _CReq) -> int:
     return h
 
 
+def _req_wait(creq: _CReq, status: Status):
+    if creq.kind == "nbc":
+        return creq.req.wait()      # NbcRequest: no status argument
+    return creq.req.wait(status)
+
+
+def _req_test(creq: _CReq, status: Status) -> bool:
+    if creq.kind == "nbc":
+        return creq.req.test()
+    return creq.req.test(status)
+
+
 def _complete_creq(ctx: _CRankCtx, handle: int) -> None:
     creq = ctx.reqs.pop(int(handle), None)
     if creq is None:
         return
     if creq.kind == "recv":
         _arr_out(creq.c_addr, creq.arr, dt=creq.dt)
+    elif creq.kind == "nbc" and creq.post is not None:
+        creq.post(creq.req.wait())
 
 
 def _translate_src(src: int) -> int:
@@ -620,7 +653,7 @@ def _h_wait(ctx, a):
     if creq is None:
         return MPI_ERR_REQUEST
     status = Status()
-    creq.req.wait(status)
+    _req_wait(creq, status)
     _complete_creq(ctx, h)
     _status_from(st_addr, status)
     _write_i32(req_addr, 0)
@@ -637,7 +670,7 @@ def _h_test(ctx, a):
     if creq is None:
         return MPI_ERR_REQUEST
     status = Status()
-    done = creq.req.test(status)
+    done = _req_test(creq, status)
     _write_i32(flag_addr, 1 if done else 0)
     if done:
         _complete_creq(ctx, h)
@@ -656,7 +689,7 @@ def _h_waitall(ctx, a):
         if creq is None:
             continue
         status = Status()
-        creq.req.wait(status)
+        _req_wait(creq, status)
         _complete_creq(ctx, h)
         if sts_addr:
             _status_from(int(sts_addr) + 16 * i, status)
@@ -673,11 +706,22 @@ def _h_waitany(ctx, a):
         _write_i32(idx_addr, C_UNDEFINED)
         return MPI_SUCCESS
     status = Status()
-    k = Request.waitany([c.req for _, _, c in live], status)
-    if k < 0:
-        _write_i32(idx_addr, C_UNDEFINED)
-        return MPI_SUCCESS
-    i, h, _creq = live[k]
+    nbc = [(i, h, c) for i, h, c in live if c.kind == "nbc"]
+    plain = [(i, h, c) for i, h, c in live if c.kind != "nbc"]
+    done = next(((i, h, c) for i, h, c in nbc if c.req.test()), None)
+    if done is not None:
+        i, h, _creq = done
+    elif plain:
+        k = Request.waitany([c.req for _, _, c in plain], status)
+        if k < 0:
+            _write_i32(idx_addr, C_UNDEFINED)
+            return MPI_SUCCESS
+        i, h, _creq = plain[k]
+    else:
+        # only unfinished I-collectives: block on the first (waitany
+        # over mixed nbc sets degrades to that, documented divergence)
+        i, h, creq = nbc[0]
+        creq.req.wait()
     _complete_creq(ctx, h)
     _status_from(st_addr, status)
     ctypes.cast(int(reqs_addr), _pi32)[i] = 0
@@ -690,12 +734,12 @@ def _h_testall(ctx, a):
     handles = _read_i32s(reqs_addr, n) if reqs_addr else []
     live = [(i, h, ctx.reqs[h]) for i, h in enumerate(handles)
             if h != 0 and h in ctx.reqs]
-    all_done = all(c.req.test() for _, _, c in live)
+    all_done = all(_req_test(c, Status()) for _, _, c in live)
     _write_i32(flag_addr, 1 if all_done else 0)
     if all_done:
         for i, h, c in live:
             status = Status()
-            c.req.wait(status)      # already finished; fills status
+            _req_wait(c, status)    # already finished; fills status
             _complete_creq(ctx, h)
             if sts_addr:
                 _status_from(int(sts_addr) + 16 * i, status)
@@ -720,16 +764,12 @@ def _h_probe(ctx, a):
     comm = _comm_of(ctx, ch)
     if comm is None:
         return MPI_ERR_COMM
-    from ..s4u import this_actor
-    nsleeps = 1
     while True:
+        # comm.iprobe itself injects the smpi/iprobe sleep on a miss,
+        # so this poll loop advances simulated time
         hit = _probe_once(comm, src, tag)
         if hit is not None:
             break
-        # the reference's probe sleeps between polls so simulated time
-        # advances (smpi_request.cpp iprobe nsleeps escalation)
-        this_actor.sleep_for(1e-4 * nsleeps)
-        nsleeps = min(nsleeps + 1, 10)
     _set_status(st_addr, hit[0], hit[1], MPI_SUCCESS, hit[2])
     return MPI_SUCCESS
 
@@ -812,7 +852,7 @@ def _h_bcast(ctx, a):
     obj = _arr_in(buf, count, dt) if me == root else None
     out = comm.bcast(obj, root)
     if me != root:
-        _arr_out(buf, out, int(count) * dt.size_)
+        _arr_out(buf, out, int(count) * dt.size_, dt=dt)
     return MPI_SUCCESS
 
 
@@ -836,7 +876,7 @@ def _h_reduce(ctx, a):
     res = comm.reduce(arr, op, root)
     if comm.rank() == root:
         _arr_out(rbuf, np.asarray(res).astype(arr.dtype, copy=False),
-                 count * dt.size_)
+                 count * dt.size_, dt=dt)
     return MPI_SUCCESS
 
 
@@ -848,7 +888,7 @@ def _h_allreduce(ctx, a):
     op = _op_of(ctx, a[4], dt, dt_handle=a[3], count=count)
     res = comm.allreduce(arr, op)
     _arr_out(rbuf, np.asarray(res).astype(arr.dtype, copy=False),
-             count * dt.size_)
+             count * dt.size_, dt=dt)
     return MPI_SUCCESS
 
 
@@ -868,7 +908,8 @@ def _h_gather(ctx, a):
     if me == root:
         stride = int(rcount) * rdt.extent_
         for i, obj in enumerate(res):
-            _arr_out(int(rbuf) + i * stride, obj, int(rcount) * rdt.size_)
+            _arr_out(int(rbuf) + i * stride, obj,
+                     int(rcount) * rdt.size_, dt=rdt)
     return MPI_SUCCESS
 
 
@@ -893,7 +934,7 @@ def _h_gatherv(ctx, a):
         offs = _read_i32s(displs, n)
         for i, obj in enumerate(res):
             _arr_out(int(rbuf) + offs[i] * rdt.extent_, obj,
-                     counts[i] * rdt.size_)
+                     counts[i] * rdt.size_, dt=rdt)
     return MPI_SUCCESS
 
 
@@ -912,7 +953,8 @@ def _h_allgather(ctx, a):
     res = comm.allgather(arr)
     stride = int(rcount) * rdt.extent_
     for i, obj in enumerate(res):
-        _arr_out(int(rbuf) + i * stride, obj, int(rcount) * rdt.size_)
+        _arr_out(int(rbuf) + i * stride, obj,
+                 int(rcount) * rdt.size_, dt=rdt)
     return MPI_SUCCESS
 
 
@@ -934,7 +976,7 @@ def _h_allgatherv(ctx, a):
     res = comm.allgatherv(arr)
     for i, obj in enumerate(res):
         _arr_out(int(rbuf) + offs[i] * rdt.extent_, obj,
-                 counts[i] * rdt.size_)
+                 counts[i] * rdt.size_, dt=rdt)
     return MPI_SUCCESS
 
 
@@ -953,7 +995,7 @@ def _h_scatter(ctx, a):
     res = comm.scatter(sendobjs, root)
     if not (me == root and int(rbuf) == C_IN_PLACE):
         rdt = _dt(ctx, rtype)
-        _arr_out(rbuf, res, int(rcount) * rdt.size_)
+        _arr_out(rbuf, res, int(rcount) * rdt.size_, dt=rdt)
     return MPI_SUCCESS
 
 
@@ -973,7 +1015,7 @@ def _h_scatterv(ctx, a):
     res = comm.scatterv(sendobjs, root)
     if not (me == root and int(rbuf) == C_IN_PLACE):
         rdt = _dt(ctx, rtype)
-        _arr_out(rbuf, res, int(rcount) * rdt.size_)
+        _arr_out(rbuf, res, int(rcount) * rdt.size_, dt=rdt)
     return MPI_SUCCESS
 
 
@@ -997,7 +1039,8 @@ def _h_alltoall(ctx, a):
     res = comm.alltoall(sendobjs)
     rstride = int(rcount) * rdt.extent_
     for i, obj in enumerate(res):
-        _arr_out(int(rbuf) + i * rstride, obj, int(rcount) * rdt.size_)
+        _arr_out(int(rbuf) + i * rstride, obj,
+                 int(rcount) * rdt.size_, dt=rdt)
     return MPI_SUCCESS
 
 
@@ -1022,7 +1065,8 @@ def _h_alltoallv(ctx, a):
                     for i in range(n)]
     res = comm.alltoallv(sendobjs)
     for i, obj in enumerate(res):
-        _arr_out(int(rbuf) + ro[i] * rdt.extent_, obj, rc[i] * rdt.size_)
+        _arr_out(int(rbuf) + ro[i] * rdt.extent_, obj,
+                 rc[i] * rdt.size_, dt=rdt)
     return MPI_SUCCESS
 
 
@@ -1039,7 +1083,7 @@ def _h_scan(ctx, a, exclusive=False):
     else:
         res = comm.scan(arr, op)
     _arr_out(rbuf, np.asarray(res).astype(arr.dtype, copy=False),
-             count * dt.size_)
+             count * dt.size_, dt=dt)
     return MPI_SUCCESS
 
 
@@ -1064,7 +1108,7 @@ def _h_reduce_scatter(ctx, a):
         off += c
     res = comm.reduce_scatter(sendobjs, op)
     _arr_out(rbuf, np.asarray(res).astype(full.dtype, copy=False),
-             counts[me] * dt.size_)
+             counts[me] * dt.size_, dt=dt)
     return MPI_SUCCESS
 
 
@@ -1385,6 +1429,467 @@ def _h_sample_exit(ctx, a):
     return MPI_SUCCESS
 
 
+# -- naming / comm-from-group / attributes / windows ------------------------
+
+def _h_comm_get_name(ctx, a):
+    comm = _comm_of(ctx, a[0])
+    if comm is None:
+        return MPI_ERR_COMM
+    h = int(a[0])
+    name = ("MPI_COMM_WORLD" if h == COMM_WORLD
+            else "MPI_COMM_SELF" if h == COMM_SELF
+            else f"MPI_COMM_{h}").encode()
+    ctypes.memmove(int(a[1]), name + b"\0", len(name) + 1)
+    _write_i32(a[2], len(name))
+    return MPI_SUCCESS
+
+
+def _h_comm_create(ctx, a):
+    comm = _comm_of(ctx, a[0])
+    group = ctx.groups.get(int(a[1]))
+    if comm is None or group is None:
+        return MPI_ERR_COMM
+    _write_i32(a[2], _new_comm_handle(ctx, comm.create(group)))
+    return MPI_SUCCESS
+
+
+def _new_group_handle(ctx, group) -> int:
+    h = ctx.next_group
+    ctx.next_group += 1
+    ctx.groups[h] = group
+    return h
+
+
+def _h_group_incl(ctx, a, mode="incl"):
+    group = ctx.groups.get(int(a[0]))
+    if group is None:
+        return MPI_ERR_ARG
+    n = int(a[1])
+    if mode == "range":
+        flat = _read_i32s(a[2], 3 * n)
+        ranges = [tuple(flat[3 * i:3 * i + 3]) for i in range(n)]
+        new = group.range_incl(ranges)
+    else:
+        ranks = _read_i32s(a[2], n)
+        new = group.incl(ranks) if mode == "incl" else group.excl(ranks)
+    _write_i32(a[3], _new_group_handle(ctx, new))
+    return MPI_SUCCESS
+
+
+#: predefined COMM_WORLD attribute keyvals (mpi.h)
+_ATTR_TAG_UB, _ATTR_WTIME_GLOBAL = 1, 4
+_ATTR_UNIVERSE, _ATTR_APPNUM = 5, 6
+_WIN_BASE, _WIN_SIZE, _WIN_DISP = 16, 17, 18
+
+#: persistent storage the attribute pointers point into
+_attr_cells: Dict[int, ctypes.c_int] = {}
+
+
+def _attr_cell(keyval: int, value: int) -> int:
+    cell = _attr_cells.get(keyval)
+    if cell is None:
+        cell = _attr_cells[keyval] = ctypes.c_int(value)
+    cell.value = value
+    return ctypes.addressof(cell)
+
+
+def _h_keyval_create(ctx, a):
+    h = ctx.next_keyval
+    ctx.next_keyval += 1
+    _write_i32(a[0], h)
+    return MPI_SUCCESS
+
+
+def _h_keyval_free(ctx, a):
+    _write_i32(a[0], -1)      # MPI_KEYVAL_INVALID
+    return MPI_SUCCESS
+
+
+def _h_attr_put(ctx, a):
+    ctx.comm_attrs[(int(a[0]), int(a[1]))] = int(a[2])
+    return MPI_SUCCESS
+
+
+def _h_attr_get(ctx, a):
+    ch, kv, val_addr, flag_addr = int(a[0]), int(a[1]), a[2], a[3]
+    predefined = {
+        _ATTR_TAG_UB: 2**30 - 1,
+        _ATTR_WTIME_GLOBAL: 1,          # one simulated clock: global
+        _ATTR_UNIVERSE: runtime.world().size(),
+        _ATTR_APPNUM: 0,
+    }
+    if kv in predefined:
+        # MPI contract: *(void**)val receives a pointer to the value
+        ctypes.cast(int(val_addr), _pi64)[0] = _attr_cell(
+            kv, predefined[kv])
+        _write_i32(flag_addr, 1)
+        return MPI_SUCCESS
+    stored = ctx.comm_attrs.get((ch, kv))
+    if stored is None:
+        _write_i32(flag_addr, 0)
+    else:
+        ctypes.cast(int(val_addr), _pi64)[0] = stored
+        _write_i32(flag_addr, 1)
+    return MPI_SUCCESS
+
+
+def _h_attr_delete(ctx, a):
+    ctx.comm_attrs.pop((int(a[0]), int(a[1])), None)
+    return MPI_SUCCESS
+
+
+def _h_win_create(ctx, a):
+    from .win import Win
+    base, size, disp, ch, win_addr = (int(a[0]), int(a[1]), int(a[2]),
+                                      a[3], a[4])
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    data = np.zeros(max(size, 1), np.uint8)
+    win = Win(comm, data, size_bytes=size)
+    h = ctx.next_win
+    ctx.next_win += 1
+    # the size/disp cells live as long as the win entry (attr gets
+    # return POINTERS to them)
+    ctx.wins[h] = {"win": win, "base": base,
+                   "size_cell": ctypes.c_longlong(size),
+                   "disp_cell": ctypes.c_int(disp), "attrs": {}}
+    _write_i32(win_addr, h)
+    return MPI_SUCCESS
+
+
+def _h_win_free(ctx, a):
+    h = ctypes.cast(int(a[0]), _pi32)[0] if a[0] else 0
+    entry = ctx.wins.pop(int(h), None)
+    if entry is not None:
+        entry["win"].free()
+    _write_i32(a[0], 0)
+    return MPI_SUCCESS
+
+
+def _h_win_fence(ctx, a):
+    entry = ctx.wins.get(int(a[1]))
+    if entry is None:
+        return MPI_ERR_ARG
+    entry["win"].fence()
+    return MPI_SUCCESS
+
+
+def _h_win_get_attr(ctx, a):
+    wh, kv, val_addr, flag_addr = int(a[0]), int(a[1]), a[2], a[3]
+    entry = ctx.wins.get(wh)
+    if entry is None:
+        return MPI_ERR_ARG
+    p64 = ctypes.cast(int(val_addr), _pi64)
+    if kv == _WIN_BASE:
+        p64[0] = entry["base"]
+    elif kv == _WIN_SIZE:
+        p64[0] = ctypes.addressof(entry["size_cell"])
+    elif kv == _WIN_DISP:
+        p64[0] = ctypes.addressof(entry["disp_cell"])
+    else:
+        stored = entry["attrs"].get(kv)
+        if stored is None:
+            _write_i32(flag_addr, 0)
+            return MPI_SUCCESS
+        p64[0] = stored
+    _write_i32(flag_addr, 1)
+    return MPI_SUCCESS
+
+
+def _h_win_set_attr(ctx, a):
+    entry = ctx.wins.get(int(a[0]))
+    if entry is None:
+        return MPI_ERR_ARG
+    entry["attrs"][int(a[1])] = int(a[2])
+    return MPI_SUCCESS
+
+
+def _h_type_struct(ctx, a):
+    count, bl_addr, disp_addr, types_addr, out_addr = a[:5]
+    n = int(count)
+    blocklens = _read_i32s(bl_addr, n)
+    disp_p = ctypes.cast(int(disp_addr), _pi64)
+    displs = [disp_p[i] for i in range(n)]
+    type_handles = _read_i32s(types_addr, n)
+    types = [_dt(ctx, t) for t in type_handles]
+    dt = Datatype.create_struct(blocklens, displs, types)
+    # legacy MPI_UB/MPI_LB markers pin the extent (scatterv.c pattern)
+    for t, d in zip(type_handles, displs):
+        if t == 41:              # MPI_UB
+            dt.extent_ = int(d)
+        elif t == 42:            # MPI_LB: lower bound stays 0 here
+            pass
+    _write_i32(out_addr, _new_dtype_handle(ctx, dt))
+    return MPI_SUCCESS
+
+
+def _h_type_get_name(ctx, a):
+    dt = _dt(ctx, a[0])
+    name = (dt.name or "").encode()[:127]
+    ctypes.memmove(int(a[1]), name + b"\0", len(name) + 1)
+    _write_i32(a[2], len(name))
+    return MPI_SUCCESS
+
+
+# -- cartesian topologies ----------------------------------------------------
+
+def _h_cart_create(ctx, a):
+    from .group import Group as _Group
+    from .topo import CartTopology
+    ch, ndims, dims_addr, per_addr, _reorder, out_addr = a[:6]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    n = int(ndims)
+    dims = _read_i32s(dims_addr, n)
+    periods = _read_i32s(per_addr, n)
+    nnodes = 1
+    for d in dims:
+        nnodes *= d
+    grid = comm.create(_Group(
+        [comm.world_rank_of(r) for r in range(nnodes)]))
+    if grid is None:
+        _write_i32(out_addr, COMM_NULL)
+        return MPI_SUCCESS
+    h = _new_comm_handle(ctx, grid)
+    ctx.cart_topos[h] = CartTopology(grid, dims, periods)
+    _write_i32(out_addr, h)
+    return MPI_SUCCESS
+
+
+def _cart_of(ctx, handle):
+    return ctx.cart_topos.get(int(handle))
+
+
+def _h_cart_get(ctx, a):
+    topo = _cart_of(ctx, a[0])
+    if topo is None:
+        return MPI_ERR_COMM
+    maxdims = int(a[1])
+    dims, periods, coords = topo.get()
+    for i in range(min(maxdims, len(dims))):
+        ctypes.cast(int(a[2]), _pi32)[i] = dims[i]
+        ctypes.cast(int(a[3]), _pi32)[i] = 1 if periods[i] else 0
+        ctypes.cast(int(a[4]), _pi32)[i] = coords[i]
+    return MPI_SUCCESS
+
+
+def _h_cart_rank(ctx, a):
+    topo = _cart_of(ctx, a[0])
+    if topo is None:
+        return MPI_ERR_COMM
+    coords = _read_i32s(a[1], len(topo.dims))
+    _write_i32(a[2], topo.rank(coords))
+    return MPI_SUCCESS
+
+
+def _h_cart_coords(ctx, a):
+    topo = _cart_of(ctx, a[0])
+    if topo is None:
+        return MPI_ERR_COMM
+    coords = topo.coords(int(a[1]))
+    for i in range(min(int(a[2]), len(coords))):
+        ctypes.cast(int(a[3]), _pi32)[i] = coords[i]
+    return MPI_SUCCESS
+
+
+def _h_cart_shift(ctx, a):
+    topo = _cart_of(ctx, a[0])
+    if topo is None:
+        return MPI_ERR_COMM
+    src, dst = topo.shift(int(a[1]), int(a[2]))
+    _write_i32(a[3], C_PROC_NULL if src is None or src < 0 else src)
+    _write_i32(a[4], C_PROC_NULL if dst is None or dst < 0 else dst)
+    return MPI_SUCCESS
+
+
+def _h_cart_sub(ctx, a):
+    from .group import Group as _Group
+    from .topo import CartTopology
+    topo = _cart_of(ctx, a[0])
+    comm = _comm_of(ctx, a[0])
+    if topo is None or comm is None:
+        return MPI_ERR_COMM
+    remain = [bool(v) for v in _read_i32s(a[1], len(topo.dims))]
+    me = topo.coords(comm.rank())
+    members = [r for r in range(topo.nnodes)
+               if all(keep or topo.coords(r)[i] == me[i]
+                      for i, keep in enumerate(remain))]
+    sub = comm.create(_Group([comm.world_rank_of(r) for r in members]))
+    if sub is None:
+        _write_i32(a[2], COMM_NULL)
+        return MPI_SUCCESS
+    h = _new_comm_handle(ctx, sub)
+    sub_dims = [d for d, keep in zip(topo.dims, remain) if keep]
+    sub_per = [p for p, keep in zip(topo.periodic, remain) if keep]
+    if sub_dims:
+        ctx.cart_topos[h] = CartTopology(sub, sub_dims, sub_per)
+    _write_i32(a[2], h)
+    return MPI_SUCCESS
+
+
+def _h_cartdim_get(ctx, a):
+    topo = _cart_of(ctx, a[0])
+    if topo is None:
+        return MPI_ERR_COMM
+    _write_i32(a[1], len(topo.dims))
+    return MPI_SUCCESS
+
+
+def _h_dims_create(ctx, a):
+    from .topo import dims_create
+    nnodes, ndims, dims_addr = int(a[0]), int(a[1]), a[2]
+    dims = _read_i32s(dims_addr, ndims)
+    out = dims_create(nnodes, ndims, dims)
+    for i, d in enumerate(out):
+        ctypes.cast(int(dims_addr), _pi32)[i] = d
+    return MPI_SUCCESS
+
+
+def _h_topo_test(ctx, a):
+    is_cart = _cart_of(ctx, a[0]) is not None
+    _write_i32(a[1], 1 if is_cart else C_UNDEFINED)   # MPI_CART
+    return MPI_SUCCESS
+
+
+# -- non-blocking collectives -----------------------------------------------
+
+def _nbc_handle(ctx, req, req_addr, post=None) -> int:
+    h = _new_req_handle(ctx, _CReq(req, 0, None, "nbc", post=post))
+    _write_i32(req_addr, h)
+    return MPI_SUCCESS
+
+
+def _h_ibarrier(ctx, a):
+    comm = _comm_of(ctx, a[0])
+    if comm is None:
+        return MPI_ERR_COMM
+    return _nbc_handle(ctx, comm.ibarrier(), a[1])
+
+
+def _h_ibcast(ctx, a):
+    buf, count, dth, root, ch, req_addr = a[:6]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    dt = _dt(ctx, dth)
+    me = comm.rank()
+    obj = _arr_in(buf, count, dt) if me == int(root) else None
+    req = comm.ibcast(obj, int(root))
+    post = None
+    if me != int(root):
+        post = lambda res: _arr_out(buf, res, int(count) * dt.size_)
+    return _nbc_handle(ctx, req, req_addr, post)
+
+
+def _h_ireduce(ctx, a):
+    sbuf, rbuf, count, dth, oph, root, ch, req_addr = a[:8]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    dt = _dt(ctx, dth)
+    arr = _arr_in(rbuf if int(sbuf) == C_IN_PLACE else sbuf, count, dt)
+    op = _op_of(ctx, oph, dt, dt_handle=dth, count=int(count))
+    req = comm.ireduce(arr, op, int(root))
+    post = None
+    if comm.rank() == int(root):
+        post = lambda res: _arr_out(
+            rbuf, np.asarray(res).astype(arr.dtype, copy=False),
+            int(count) * dt.size_)
+    return _nbc_handle(ctx, req, req_addr, post)
+
+
+def _h_iallreduce(ctx, a):
+    sbuf, rbuf, count, dth, oph, ch, req_addr = a[:7]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    dt = _dt(ctx, dth)
+    arr = _arr_in(rbuf if int(sbuf) == C_IN_PLACE else sbuf, count, dt)
+    op = _op_of(ctx, oph, dt, dt_handle=dth, count=int(count))
+    req = comm.iallreduce(arr, op)
+    post = lambda res: _arr_out(
+        rbuf, np.asarray(res).astype(arr.dtype, copy=False),
+        int(count) * dt.size_)
+    return _nbc_handle(ctx, req, req_addr, post)
+
+
+def _h_igather(ctx, a):
+    sbuf, scount, stype, rbuf, rcount, rtype, root, ch, req_addr = a[:9]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    me, root = comm.rank(), int(root)
+    arr = _arr_in(sbuf, scount, _dt(ctx, stype))
+    req = comm.igather(arr, root)
+    post = None
+    if me == root:
+        rdt = _dt(ctx, rtype)
+        stride = int(rcount) * rdt.extent_
+
+        def post(res):
+            for i, obj in enumerate(res):
+                _arr_out(int(rbuf) + i * stride, obj,
+                         int(rcount) * rdt.size_)
+    return _nbc_handle(ctx, req, req_addr, post)
+
+
+def _h_iscatter(ctx, a):
+    sbuf, scount, stype, rbuf, rcount, rtype, root, ch, req_addr = a[:9]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    me, root, n = comm.rank(), int(root), comm.size()
+    sendobjs = None
+    if me == root:
+        sdt = _dt(ctx, stype)
+        stride = int(scount) * sdt.extent_
+        sendobjs = [_arr_in(int(sbuf) + i * stride, scount, sdt)
+                    for i in range(n)]
+    req = comm.iscatter(sendobjs, root)
+    rdt = _dt(ctx, rtype)
+    post = lambda res: _arr_out(rbuf, res, int(rcount) * rdt.size_)
+    return _nbc_handle(ctx, req, req_addr, post)
+
+
+def _h_iallgather(ctx, a):
+    sbuf, scount, stype, rbuf, rcount, rtype, ch, req_addr = a[:8]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    arr = _arr_in(sbuf, scount, _dt(ctx, stype))
+    req = comm.iallgather(arr)
+    rdt = _dt(ctx, rtype)
+    stride = int(rcount) * rdt.extent_
+
+    def post(res):
+        for i, obj in enumerate(res):
+            _arr_out(int(rbuf) + i * stride, obj,
+                     int(rcount) * rdt.size_)
+    return _nbc_handle(ctx, req, req_addr, post)
+
+
+def _h_ialltoall(ctx, a):
+    sbuf, scount, stype, rbuf, rcount, rtype, ch, req_addr = a[:8]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    n = comm.size()
+    sdt, rdt = _dt(ctx, stype), _dt(ctx, rtype)
+    sstride = int(scount) * sdt.extent_
+    sendobjs = [_arr_in(int(sbuf) + i * sstride, scount, sdt)
+                for i in range(n)]
+    req = comm.ialltoall(sendobjs)
+    rstride = int(rcount) * rdt.extent_
+
+    def post(res):
+        for i, obj in enumerate(res):
+            _arr_out(int(rbuf) + i * rstride, obj,
+                     int(rcount) * rdt.size_)
+    return _nbc_handle(ctx, req, req_addr, post)
+
+
 _HANDLERS = {
     1: _h_init, 2: _h_finalize, 3: _h_initialized, 4: _h_finalized,
     5: _h_abort, 6: _h_comm_rank, 7: _h_comm_size, 8: _h_comm_dup,
@@ -1408,13 +1913,27 @@ _HANDLERS = {
     61: lambda c, a: _h_file_io(c, a, write=True), 62: _h_file_sync,
     63: _h_shared_malloc, 64: _h_shared_free, 65: _h_execute,
     66: _h_sample_1, 67: _h_sample_2, 68: _h_sample_3,
-    69: _h_sample_exit,
+    69: _h_sample_exit, 70: _h_comm_get_name, 71: _h_comm_create,
+    72: _h_group_incl, 73: lambda c, a: _h_group_incl(c, a, "excl"),
+    74: lambda c, a: _h_group_incl(c, a, "range"),
+    75: _h_keyval_create, 76: _h_keyval_free, 77: _h_attr_put,
+    78: _h_attr_get, 79: _h_attr_delete, 80: _h_win_create,
+    81: _h_win_free, 82: _h_win_fence, 83: _h_win_get_attr,
+    84: _h_win_set_attr, 85: _h_type_struct, 86: _h_ibarrier,
+    87: _h_ibcast, 88: _h_ireduce, 89: _h_iallreduce, 90: _h_igather,
+    91: _h_iscatter, 92: _h_iallgather, 93: _h_ialltoall,
+    94: _h_type_get_name, 95: _h_cart_create, 96: _h_cart_get,
+    97: _h_cart_rank, 98: _h_cart_coords, 99: _h_cart_shift,
+    100: _h_cart_sub, 101: _h_cartdim_get, 102: _h_dims_create,
+    103: _h_topo_test,
 }
 
 #: ops that are pure local queries — no bench end/begin cycle needed
 #: (sample_2/3 stay non-local: the bench injection right before their
 #: handlers is what prices the sampled loop body)
-_LOCAL_OPS = {3, 4, 24, 41, 42, 45, 46, 48, 50, 51, 63, 64, 66, 69}
+_LOCAL_OPS = {3, 4, 24, 41, 42, 45, 46, 48, 50, 51, 63, 64, 66, 69,
+              70, 72, 73, 74, 75, 76, 77, 78, 79, 83, 84, 85, 94, 96,
+              97, 98, 99, 101, 102, 103}
 
 
 def _dispatch_py(opcode: int, args) -> int:

@@ -146,6 +146,14 @@ class Comm:
             status.source = probe.real_src
             status.tag = probe.real_tag
             status.count = probe.real_size
+        if not hit:
+            # busy iprobe loops must advance simulated time
+            # (smpi_request.cpp::iprobe nsleeps, smpi/iprobe)
+            from ..utils.config import config
+            sleep = config["smpi/iprobe"]
+            if sleep > 0:
+                from ..s4u import this_actor
+                this_actor.sleep_for(sleep)
         return hit
 
     # -- collectives (dispatch through the selector) -----------------------

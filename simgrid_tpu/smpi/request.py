@@ -257,6 +257,14 @@ class Request:
             self._finish(status)
             if visible and self.kind == "recv":
                 tr.recv_arrow_once(self)
+        else:
+            # an unsuccessful test advances the clock a little, or a
+            # busy test loop would freeze simulated time forever
+            # (smpi_request.cpp::test nsleeps injection, smpi/test)
+            sleep = config["smpi/test"]
+            if sleep > 0:
+                from ..s4u import this_actor
+                this_actor.sleep_for(sleep)
         return bool(res)
 
     def cancel(self) -> None:

@@ -36,6 +36,13 @@ declare_flag("smpi/or",
              "messages)", "0:0:0:0:0")
 declare_flag("smpi/coll-selector", "Which collective selector to use",
              "default")
+declare_flag("smpi/test",
+             "Minimum time to inject inside an unsuccessful MPI_Test "
+             "(simulated seconds; lets busy test loops advance the "
+             "clock, smpi_request.cpp::test nsleeps)", 1e-4)
+declare_flag("smpi/iprobe",
+             "Minimum time to inject inside an unsuccessful MPI_Iprobe",
+             1e-4)
 for _op in ("bcast", "barrier", "reduce", "allreduce", "alltoall",
             "allgather", "allgatherv", "gather", "scatter",
             "reduce_scatter", "scan", "exscan"):

@@ -1,0 +1,38 @@
+"""Sweep mpich3-test/coll: compile+run each test in a subprocess."""
+import glob, os, subprocess, sys, json
+
+M = "/root/reference/teshsuite/smpi/mpich3-test"
+OUT = {}
+NP = {}
+for line in open(f"{M}/coll/testlist"):
+    parts = line.split()
+    if len(parts) >= 2 and parts[1].isdigit():
+        NP.setdefault(parts[0], int(parts[1]))
+
+for src in sorted(glob.glob(f"{M}/coll/*.c")):
+    name = os.path.basename(src)[:-2]
+    np_ranks = NP.get(name, 4)
+    code = f"""
+import sys; sys.path.insert(0, "/root/repo")
+from simgrid_tpu.smpi.c_api import compile_program, run_c_program
+compile_program(["{src}", "{M}/util/mtest.c"], "/tmp/mpich3/{name}.so",
+                extra_flags=["-I{M}/include"])
+engine, codes = run_c_program("/tmp/mpich3/{name}.so", np_ranks={np_ranks},
+    configs=("smpi/simulate-computation:false",))
+assert all(c == 0 for c in codes.values()), codes
+"""
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=180)
+    except subprocess.TimeoutExpired:
+        OUT[name] = "timeout"
+        print(f"{name:28s} timeout", flush=True)
+        continue
+    ok = r.returncode == 0 and "no errors" in r.stdout.lower()
+    OUT[name] = "PASS" if ok else (
+        "compile-fail" if "smpicc failed" in r.stderr else "fail")
+    print(f"{name:28s} {OUT[name]} (np={np_ranks})", flush=True)
+
+n = sum(1 for v in OUT.values() if v == "PASS")
+print(f"\nPASS {n}/{len(OUT)}")
+json.dump(OUT, open("/tmp/mpich3_coll_results.json", "w"), indent=1)
