@@ -238,6 +238,44 @@ int MPI_Sendrecv(const void* sendbuf, int sendcount, MPI_Datatype sendtype,
 int MPI_Get_count(const MPI_Status* status, MPI_Datatype datatype,
                   int* count);
 
+/* buffered / ready / synchronous modes + persistent requests */
+#define MPI_BSEND_OVERHEAD 0
+int MPI_Buffer_attach(void* buffer, int size);
+int MPI_Buffer_detach(void* buffer_addr, int* size);
+int MPI_Bsend(const void* buf, int count, MPI_Datatype datatype, int dest,
+              int tag, MPI_Comm comm);
+int MPI_Ibsend(const void* buf, int count, MPI_Datatype datatype, int dest,
+               int tag, MPI_Comm comm, MPI_Request* request);
+int MPI_Rsend(const void* buf, int count, MPI_Datatype datatype, int dest,
+              int tag, MPI_Comm comm);
+int MPI_Irsend(const void* buf, int count, MPI_Datatype datatype, int dest,
+               int tag, MPI_Comm comm, MPI_Request* request);
+int MPI_Send_init(const void* buf, int count, MPI_Datatype datatype,
+                  int dest, int tag, MPI_Comm comm, MPI_Request* request);
+int MPI_Bsend_init(const void* buf, int count, MPI_Datatype datatype,
+                   int dest, int tag, MPI_Comm comm,
+                   MPI_Request* request);
+int MPI_Ssend_init(const void* buf, int count, MPI_Datatype datatype,
+                   int dest, int tag, MPI_Comm comm,
+                   MPI_Request* request);
+int MPI_Rsend_init(const void* buf, int count, MPI_Datatype datatype,
+                   int dest, int tag, MPI_Comm comm,
+                   MPI_Request* request);
+int MPI_Recv_init(void* buf, int count, MPI_Datatype datatype, int source,
+                  int tag, MPI_Comm comm, MPI_Request* request);
+int MPI_Start(MPI_Request* request);
+int MPI_Startall(int count, MPI_Request* requests);
+int MPI_Request_free(MPI_Request* request);
+int MPI_Sendrecv_replace(void* buf, int count, MPI_Datatype datatype,
+                         int dest, int sendtag, int source, int recvtag,
+                         MPI_Comm comm, MPI_Status* status);
+int MPI_Testany(int count, MPI_Request* requests, int* index, int* flag,
+                MPI_Status* status);
+int MPI_Waitsome(int incount, MPI_Request* requests, int* outcount,
+                 int* indices, MPI_Status* statuses);
+int MPI_Testsome(int incount, MPI_Request* requests, int* outcount,
+                 int* indices, MPI_Status* statuses);
+
 /* -- collectives --------------------------------------------------------- */
 int MPI_Barrier(MPI_Comm comm);
 int MPI_Bcast(void* buf, int count, MPI_Datatype datatype, int root,
@@ -314,6 +352,39 @@ MPI_Errhandler_set(MPI_Comm comm, MPI_Errhandler errhandler) {
 int MPI_Type_size(MPI_Datatype datatype, int* size);
 int MPI_Type_get_extent(MPI_Datatype datatype, MPI_Aint* lb,
                         MPI_Aint* extent);
+int MPI_Type_get_true_extent(MPI_Datatype datatype, MPI_Aint* true_lb,
+                             MPI_Aint* true_extent);
+int MPI_Type_create_resized(MPI_Datatype oldtype, MPI_Aint lb,
+                            MPI_Aint extent, MPI_Datatype* newtype);
+#define MPI_ORDER_C 56
+#define MPI_ORDER_FORTRAN 57
+int MPI_Type_create_subarray(int ndims, const int* array_of_sizes,
+                             const int* array_of_subsizes,
+                             const int* array_of_starts, int order,
+                             MPI_Datatype oldtype, MPI_Datatype* newtype);
+int MPI_Type_indexed(int count, const int* blocklengths,
+                     const int* displacements, MPI_Datatype oldtype,
+                     MPI_Datatype* newtype);
+int MPI_Type_create_hindexed(int count, const int* blocklengths,
+                             const MPI_Aint* displacements,
+                             MPI_Datatype oldtype, MPI_Datatype* newtype);
+int MPI_Type_hindexed(int count, int* blocklengths,
+                      MPI_Aint* displacements, MPI_Datatype oldtype,
+                      MPI_Datatype* newtype);
+int MPI_Type_create_hvector(int count, int blocklength, MPI_Aint stride,
+                            MPI_Datatype oldtype, MPI_Datatype* newtype);
+int MPI_Type_hvector(int count, int blocklength, MPI_Aint stride,
+                     MPI_Datatype oldtype, MPI_Datatype* newtype);
+int MPI_Type_create_indexed_block(int count, int blocklength,
+                                  const int* displacements,
+                                  MPI_Datatype oldtype,
+                                  MPI_Datatype* newtype);
+int MPI_Type_dup(MPI_Datatype oldtype, MPI_Datatype* newtype);
+int MPI_Type_create_hindexed_block(int count, int blocklength,
+                                   const MPI_Aint* displacements,
+                                   MPI_Datatype oldtype,
+                                   MPI_Datatype* newtype);
+int MPI_Type_size_x(MPI_Datatype datatype, MPI_Count* size);
 int MPI_Type_contiguous(int count, MPI_Datatype oldtype,
                         MPI_Datatype* newtype);
 int MPI_Type_vector(int count, int blocklength, int stride,
@@ -371,6 +442,46 @@ int MPI_Ialltoall(const void* sendbuf, int sendcount,
                   MPI_Datatype sendtype, void* recvbuf, int recvcount,
                   MPI_Datatype recvtype, MPI_Comm comm,
                   MPI_Request* request);
+int MPI_Alltoallw(const void* sendbuf, const int* sendcounts,
+                  const int* sdispls, const MPI_Datatype* sendtypes,
+                  void* recvbuf, const int* recvcounts, const int* rdispls,
+                  const MPI_Datatype* recvtypes, MPI_Comm comm);
+int MPI_Ialltoallw(const void* sendbuf, const int* sendcounts,
+                   const int* sdispls, const MPI_Datatype* sendtypes,
+                   void* recvbuf, const int* recvcounts,
+                   const int* rdispls, const MPI_Datatype* recvtypes,
+                   MPI_Comm comm, MPI_Request* request);
+int MPI_Iscatterv(const void* sendbuf, const int* sendcounts,
+                  const int* displs, MPI_Datatype sendtype, void* recvbuf,
+                  int recvcount, MPI_Datatype recvtype, int root,
+                  MPI_Comm comm, MPI_Request* request);
+int MPI_Igatherv(const void* sendbuf, int sendcount, MPI_Datatype sendtype,
+                 void* recvbuf, const int* recvcounts, const int* displs,
+                 MPI_Datatype recvtype, int root, MPI_Comm comm,
+                 MPI_Request* request);
+int MPI_Iallgatherv(const void* sendbuf, int sendcount,
+                    MPI_Datatype sendtype, void* recvbuf,
+                    const int* recvcounts, const int* displs,
+                    MPI_Datatype recvtype, MPI_Comm comm,
+                    MPI_Request* request);
+int MPI_Ialltoallv(const void* sendbuf, const int* sendcounts,
+                   const int* sdispls, MPI_Datatype sendtype,
+                   void* recvbuf, const int* recvcounts,
+                   const int* rdispls, MPI_Datatype recvtype,
+                   MPI_Comm comm, MPI_Request* request);
+int MPI_Ireduce_scatter(const void* sendbuf, void* recvbuf,
+                        const int* recvcounts, MPI_Datatype datatype,
+                        MPI_Op op, MPI_Comm comm, MPI_Request* request);
+int MPI_Ireduce_scatter_block(const void* sendbuf, void* recvbuf,
+                              int recvcount, MPI_Datatype datatype,
+                              MPI_Op op, MPI_Comm comm,
+                              MPI_Request* request);
+int MPI_Iscan(const void* sendbuf, void* recvbuf, int count,
+              MPI_Datatype datatype, MPI_Op op, MPI_Comm comm,
+              MPI_Request* request);
+int MPI_Iexscan(const void* sendbuf, void* recvbuf, int count,
+                MPI_Datatype datatype, MPI_Op op, MPI_Comm comm,
+                MPI_Request* request);
 
 /* -- reduction ops ------------------------------------------------------- */
 int MPI_Op_create(MPI_User_function* fn, int commute, MPI_Op* op);

@@ -134,6 +134,31 @@ enum {
   SMPI_OP_CARTDIM_GET,
   SMPI_OP_DIMS_CREATE,
   SMPI_OP_TOPO_TEST,
+  SMPI_OP_ALLTOALLW,          /* 104 */
+  SMPI_OP_IALLTOALLW,
+  SMPI_OP_ISCATTERV,
+  SMPI_OP_IGATHERV,
+  SMPI_OP_IALLGATHERV,
+  SMPI_OP_IALLTOALLV,
+  SMPI_OP_IREDUCE_SCATTER,    /* 110 */
+  SMPI_OP_ISCAN,
+  SMPI_OP_IEXSCAN,
+  SMPI_OP_TYPE_RESIZED,
+  SMPI_OP_BSEND,
+  SMPI_OP_IBSEND,             /* 115 */
+  SMPI_OP_SEND_INIT,          /* mode arg: 0 send, 1 bsend, 2 ssend */
+  SMPI_OP_RECV_INIT,
+  SMPI_OP_START,
+  SMPI_OP_STARTALL,
+  SMPI_OP_REQUEST_FREE,       /* 120 */
+  SMPI_OP_SENDRECV_REPLACE,
+  SMPI_OP_TESTANY,
+  SMPI_OP_WAITSOME,           /* also testsome via the blocking arg */
+  SMPI_OP_TYPE_INDEXED,       /* flag arg: displs in elements(0)/bytes(1) */
+  SMPI_OP_TYPE_HVECTOR,       /* 125 */
+  SMPI_OP_TYPE_INDEXED_BLOCK, /* flag arg as TYPE_INDEXED */
+  SMPI_OP_TYPE_DUP,
+  SMPI_OP_TYPE_SUBARRAY,
 };
 
 /* sub-modes for FILE_READ / FILE_WRITE */
@@ -268,6 +293,98 @@ int MPI_Sendrecv(const void* sendbuf, int sendcount, MPI_Datatype sendtype,
 int MPI_Get_count(const MPI_Status* status, MPI_Datatype datatype,
                   int* count) {
   CALL(SMPI_OP_GET_COUNT, A(status), A(datatype), A(count));
+}
+
+/* -- buffered / ready modes, persistent requests ---------------------------- */
+static void* smpi_bsend_buffer = 0;
+static int smpi_bsend_buffer_size = 0;
+int MPI_Buffer_attach(void* buffer, int size) {
+  smpi_bsend_buffer = buffer;
+  smpi_bsend_buffer_size = size;
+  return MPI_SUCCESS;
+}
+int MPI_Buffer_detach(void* buffer_addr, int* size) {
+  *(void**)buffer_addr = smpi_bsend_buffer;
+  *size = smpi_bsend_buffer_size;
+  smpi_bsend_buffer = 0;
+  smpi_bsend_buffer_size = 0;
+  return MPI_SUCCESS;
+}
+int MPI_Bsend(const void* buf, int count, MPI_Datatype datatype, int dest,
+              int tag, MPI_Comm comm) {
+  CALL(SMPI_OP_BSEND, A(buf), A(count), A(datatype), A(dest), A(tag),
+       A(comm));
+}
+int MPI_Ibsend(const void* buf, int count, MPI_Datatype datatype, int dest,
+               int tag, MPI_Comm comm, MPI_Request* request) {
+  CALL(SMPI_OP_IBSEND, A(buf), A(count), A(datatype), A(dest), A(tag),
+       A(comm), A(request));
+}
+int MPI_Rsend(const void* buf, int count, MPI_Datatype datatype, int dest,
+              int tag, MPI_Comm comm) {
+  /* ready mode: the receive is already posted, a plain send matches */
+  return MPI_Send(buf, count, datatype, dest, tag, comm);
+}
+int MPI_Irsend(const void* buf, int count, MPI_Datatype datatype, int dest,
+               int tag, MPI_Comm comm, MPI_Request* request) {
+  return MPI_Isend(buf, count, datatype, dest, tag, comm, request);
+}
+int MPI_Send_init(const void* buf, int count, MPI_Datatype datatype,
+                  int dest, int tag, MPI_Comm comm,
+                  MPI_Request* request) {
+  CALL(SMPI_OP_SEND_INIT, A(buf), A(count), A(datatype), A(dest), A(tag),
+       A(comm), A(request), 0);
+}
+int MPI_Bsend_init(const void* buf, int count, MPI_Datatype datatype,
+                   int dest, int tag, MPI_Comm comm,
+                   MPI_Request* request) {
+  CALL(SMPI_OP_SEND_INIT, A(buf), A(count), A(datatype), A(dest), A(tag),
+       A(comm), A(request), 1);
+}
+int MPI_Ssend_init(const void* buf, int count, MPI_Datatype datatype,
+                   int dest, int tag, MPI_Comm comm,
+                   MPI_Request* request) {
+  CALL(SMPI_OP_SEND_INIT, A(buf), A(count), A(datatype), A(dest), A(tag),
+       A(comm), A(request), 2);
+}
+int MPI_Rsend_init(const void* buf, int count, MPI_Datatype datatype,
+                   int dest, int tag, MPI_Comm comm,
+                   MPI_Request* request) {
+  CALL(SMPI_OP_SEND_INIT, A(buf), A(count), A(datatype), A(dest), A(tag),
+       A(comm), A(request), 0);
+}
+int MPI_Recv_init(void* buf, int count, MPI_Datatype datatype, int source,
+                  int tag, MPI_Comm comm, MPI_Request* request) {
+  CALL(SMPI_OP_RECV_INIT, A(buf), A(count), A(datatype), A(source),
+       A(tag), A(comm), A(request));
+}
+int MPI_Start(MPI_Request* request) { CALL(SMPI_OP_START, A(request)); }
+int MPI_Startall(int count, MPI_Request* requests) {
+  CALL(SMPI_OP_STARTALL, A(count), A(requests));
+}
+int MPI_Request_free(MPI_Request* request) {
+  CALL(SMPI_OP_REQUEST_FREE, A(request));
+}
+int MPI_Sendrecv_replace(void* buf, int count, MPI_Datatype datatype,
+                         int dest, int sendtag, int source, int recvtag,
+                         MPI_Comm comm, MPI_Status* status) {
+  CALL(SMPI_OP_SENDRECV_REPLACE, A(buf), A(count), A(datatype), A(dest),
+       A(sendtag), A(source), A(recvtag), A(comm), A(status));
+}
+int MPI_Testany(int count, MPI_Request* requests, int* index, int* flag,
+                MPI_Status* status) {
+  CALL(SMPI_OP_TESTANY, A(count), A(requests), A(index), A(flag),
+       A(status));
+}
+int MPI_Waitsome(int incount, MPI_Request* requests, int* outcount,
+                 int* indices, MPI_Status* statuses) {
+  CALL(SMPI_OP_WAITSOME, A(incount), A(requests), A(outcount), A(indices),
+       A(statuses), 1);
+}
+int MPI_Testsome(int incount, MPI_Request* requests, int* outcount,
+                 int* indices, MPI_Status* statuses) {
+  CALL(SMPI_OP_WAITSOME, A(incount), A(requests), A(outcount), A(indices),
+       A(statuses), 0);
 }
 
 /* -- collectives ---------------------------------------------------------- */
@@ -658,6 +775,75 @@ int MPI_Type_extent(MPI_Datatype datatype, MPI_Aint* extent) {
   MPI_Aint lb;
   return MPI_Type_get_extent(datatype, &lb, extent);
 }
+int MPI_Type_create_resized(MPI_Datatype oldtype, MPI_Aint lb,
+                            MPI_Aint extent, MPI_Datatype* newtype) {
+  CALL(SMPI_OP_TYPE_RESIZED, A(oldtype), A(lb), A(extent), A(newtype));
+}
+int MPI_Type_indexed(int count, const int* blocklengths,
+                     const int* displacements, MPI_Datatype oldtype,
+                     MPI_Datatype* newtype) {
+  CALL(SMPI_OP_TYPE_INDEXED, A(count), A(blocklengths), A(displacements),
+       A(oldtype), A(newtype), 0);
+}
+int MPI_Type_create_hindexed(int count, const int* blocklengths,
+                             const MPI_Aint* displacements,
+                             MPI_Datatype oldtype, MPI_Datatype* newtype) {
+  CALL(SMPI_OP_TYPE_INDEXED, A(count), A(blocklengths), A(displacements),
+       A(oldtype), A(newtype), 1);
+}
+int MPI_Type_hindexed(int count, int* blocklengths,
+                      MPI_Aint* displacements, MPI_Datatype oldtype,
+                      MPI_Datatype* newtype) {
+  return MPI_Type_create_hindexed(count, blocklengths, displacements,
+                                  oldtype, newtype);
+}
+int MPI_Type_create_hvector(int count, int blocklength, MPI_Aint stride,
+                            MPI_Datatype oldtype, MPI_Datatype* newtype) {
+  CALL(SMPI_OP_TYPE_HVECTOR, A(count), A(blocklength), A(stride),
+       A(oldtype), A(newtype));
+}
+int MPI_Type_hvector(int count, int blocklength, MPI_Aint stride,
+                     MPI_Datatype oldtype, MPI_Datatype* newtype) {
+  return MPI_Type_create_hvector(count, blocklength, stride, oldtype,
+                                 newtype);
+}
+int MPI_Type_create_indexed_block(int count, int blocklength,
+                                  const int* displacements,
+                                  MPI_Datatype oldtype,
+                                  MPI_Datatype* newtype) {
+  CALL(SMPI_OP_TYPE_INDEXED_BLOCK, A(count), A(blocklength),
+       A(displacements), A(oldtype), A(newtype), 0);
+}
+int MPI_Type_create_hindexed_block(int count, int blocklength,
+                                   const MPI_Aint* displacements,
+                                   MPI_Datatype oldtype,
+                                   MPI_Datatype* newtype) {
+  CALL(SMPI_OP_TYPE_INDEXED_BLOCK, A(count), A(blocklength),
+       A(displacements), A(oldtype), A(newtype), 1);
+}
+int MPI_Type_dup(MPI_Datatype oldtype, MPI_Datatype* newtype) {
+  CALL(SMPI_OP_TYPE_DUP, A(oldtype), A(newtype));
+}
+int MPI_Type_create_subarray(int ndims, const int* array_of_sizes,
+                             const int* array_of_subsizes,
+                             const int* array_of_starts, int order,
+                             MPI_Datatype oldtype, MPI_Datatype* newtype) {
+  CALL(SMPI_OP_TYPE_SUBARRAY, A(ndims), A(array_of_sizes),
+       A(array_of_subsizes), A(array_of_starts), A(order), A(oldtype),
+       A(newtype));
+}
+int MPI_Type_size_x(MPI_Datatype datatype, MPI_Count* size) {
+  int s = 0;
+  int rc = MPI_Type_size(datatype, &s);
+  *size = s;
+  return rc;
+}
+int MPI_Type_get_true_extent(MPI_Datatype datatype, MPI_Aint* true_lb,
+                             MPI_Aint* true_extent) {
+  /* data travels packed here: the true extent never exceeds the
+   * declared extent, which is all callers rely on for sizing */
+  return MPI_Type_get_extent(datatype, true_lb, true_extent);
+}
 
 int MPI_Type_get_name(MPI_Datatype datatype, char* name, int* resultlen) {
   CALL(SMPI_OP_TYPE_GET_NAME, A(datatype), A(name), A(resultlen));
@@ -750,4 +936,80 @@ int MPI_Ialltoall(const void* sendbuf, int sendcount,
                   MPI_Request* request) {
   CALL(SMPI_OP_IALLTOALL, A(sendbuf), A(sendcount), A(sendtype),
        A(recvbuf), A(recvcount), A(recvtype), A(comm), A(request));
+}
+int MPI_Alltoallw(const void* sendbuf, const int* sendcounts,
+                  const int* sdispls, const MPI_Datatype* sendtypes,
+                  void* recvbuf, const int* recvcounts, const int* rdispls,
+                  const MPI_Datatype* recvtypes, MPI_Comm comm) {
+  CALL(SMPI_OP_ALLTOALLW, A(sendbuf), A(sendcounts), A(sdispls),
+       A(sendtypes), A(recvbuf), A(recvcounts), A(rdispls), A(recvtypes),
+       A(comm));
+}
+int MPI_Ialltoallw(const void* sendbuf, const int* sendcounts,
+                   const int* sdispls, const MPI_Datatype* sendtypes,
+                   void* recvbuf, const int* recvcounts,
+                   const int* rdispls, const MPI_Datatype* recvtypes,
+                   MPI_Comm comm, MPI_Request* request) {
+  CALL(SMPI_OP_IALLTOALLW, A(sendbuf), A(sendcounts), A(sdispls),
+       A(sendtypes), A(recvbuf), A(recvcounts), A(rdispls), A(recvtypes),
+       A(comm), A(request));
+}
+int MPI_Iscatterv(const void* sendbuf, const int* sendcounts,
+                  const int* displs, MPI_Datatype sendtype, void* recvbuf,
+                  int recvcount, MPI_Datatype recvtype, int root,
+                  MPI_Comm comm, MPI_Request* request) {
+  CALL(SMPI_OP_ISCATTERV, A(sendbuf), A(sendcounts), A(displs),
+       A(sendtype), A(recvbuf), A(recvcount), A(recvtype), A(root),
+       A(comm), A(request));
+}
+int MPI_Igatherv(const void* sendbuf, int sendcount, MPI_Datatype sendtype,
+                 void* recvbuf, const int* recvcounts, const int* displs,
+                 MPI_Datatype recvtype, int root, MPI_Comm comm,
+                 MPI_Request* request) {
+  CALL(SMPI_OP_IGATHERV, A(sendbuf), A(sendcount), A(sendtype), A(recvbuf),
+       A(recvcounts), A(displs), A(recvtype), A(root), A(comm),
+       A(request));
+}
+int MPI_Iallgatherv(const void* sendbuf, int sendcount,
+                    MPI_Datatype sendtype, void* recvbuf,
+                    const int* recvcounts, const int* displs,
+                    MPI_Datatype recvtype, MPI_Comm comm,
+                    MPI_Request* request) {
+  CALL(SMPI_OP_IALLGATHERV, A(sendbuf), A(sendcount), A(sendtype),
+       A(recvbuf), A(recvcounts), A(displs), A(recvtype), A(comm),
+       A(request));
+}
+int MPI_Ialltoallv(const void* sendbuf, const int* sendcounts,
+                   const int* sdispls, MPI_Datatype sendtype,
+                   void* recvbuf, const int* recvcounts,
+                   const int* rdispls, MPI_Datatype recvtype,
+                   MPI_Comm comm, MPI_Request* request) {
+  CALL(SMPI_OP_IALLTOALLV, A(sendbuf), A(sendcounts), A(sdispls),
+       A(sendtype), A(recvbuf), A(recvcounts), A(rdispls), A(recvtype),
+       A(comm), A(request));
+}
+int MPI_Ireduce_scatter(const void* sendbuf, void* recvbuf,
+                        const int* recvcounts, MPI_Datatype datatype,
+                        MPI_Op op, MPI_Comm comm, MPI_Request* request) {
+  CALL(SMPI_OP_IREDUCE_SCATTER, A(sendbuf), A(recvbuf), A(recvcounts),
+       A(datatype), A(op), A(comm), A(request), 0);
+}
+int MPI_Ireduce_scatter_block(const void* sendbuf, void* recvbuf,
+                              int recvcount, MPI_Datatype datatype,
+                              MPI_Op op, MPI_Comm comm,
+                              MPI_Request* request) {
+  CALL(SMPI_OP_IREDUCE_SCATTER, A(sendbuf), A(recvbuf), A(recvcount),
+       A(datatype), A(op), A(comm), A(request), 1);
+}
+int MPI_Iscan(const void* sendbuf, void* recvbuf, int count,
+              MPI_Datatype datatype, MPI_Op op, MPI_Comm comm,
+              MPI_Request* request) {
+  CALL(SMPI_OP_ISCAN, A(sendbuf), A(recvbuf), A(count), A(datatype),
+       A(op), A(comm), A(request));
+}
+int MPI_Iexscan(const void* sendbuf, void* recvbuf, int count,
+                MPI_Datatype datatype, MPI_Op op, MPI_Comm comm,
+                MPI_Request* request) {
+  CALL(SMPI_OP_IEXSCAN, A(sendbuf), A(recvbuf), A(count), A(datatype),
+       A(op), A(comm), A(request));
 }

@@ -129,9 +129,9 @@ _predef(34, 16, np.complex128, "MPI_DOUBLE_COMPLEX")
 _predef(35, 8, np.complex64, "MPI_COMPLEX")
 _predef(36, 8, np.complex64, "MPI_C_FLOAT_COMPLEX")
 _predef(37, 16, np.complex128, "MPI_C_DOUBLE_COMPLEX")
-_predef(38, np.dtype(np.clongdouble).itemsize * 2
-        if np.dtype(np.clongdouble).itemsize < 32 else 32,
-        np.clongdouble, "MPI_C_LONG_DOUBLE_COMPLEX")
+# np.clongdouble is already the full complex type
+_predef(38, np.dtype(np.clongdouble).itemsize, np.clongdouble,
+        "MPI_C_LONG_DOUBLE_COMPLEX")
 _si = _dt_struct([("v", "<i2"), ("i", "<i4")])
 _predef(39, _si.itemsize, _si, "MPI_SHORT_INT")
 _ldi = _dt_struct([("v", np.longdouble), ("i", "<i4")])
@@ -252,36 +252,54 @@ def _dt(ctx: _CRankCtx, handle: int) -> Datatype:
     return ctx.dtypes[int(handle)]
 
 
-def _vector_block_offsets(dt: Datatype, count: int):
-    """Byte offsets + block length for a strided (vector) datatype:
-    `count` datatype elements, each spanning extent_ bytes with
-    nblocks blocks of blocklen*base_size bytes at stride intervals."""
-    nblocks, blocklen, stride, base_size = dt.c_layout
-    blk = blocklen * base_size
-    offsets = []
-    for e in range(int(count)):
-        base = e * dt.extent_
-        for b in range(nblocks):
-            offsets.append(base + b * stride * base_size)
-    return offsets, blk
+def _coalesce(segs):
+    """Merge adjacent (offset, nbytes) segments."""
+    out = []
+    for off, n in segs:
+        if n <= 0:
+            continue
+        if out and out[-1][0] + out[-1][1] == off:
+            out[-1] = (out[-1][0], out[-1][1] + n)
+        else:
+            out.append((off, n))
+    return out
+
+
+def _segments_of(dt: Datatype):
+    """The datatype's TYPE MAP as contiguous byte segments within one
+    extent (the MPI standard's (type, disp) map, compressed to bytes —
+    smpi_datatype derived serialization role).  Derived constructors
+    attach c_segments; anything without one is contiguous."""
+    segs = getattr(dt, "c_segments", None)
+    if segs is None:
+        segs = [(0, dt.size_)] if dt.size_ else []
+    return segs
+
+
+def _is_contiguous(dt: Datatype) -> bool:
+    segs = _segments_of(dt)
+    return (dt.extent_ == dt.size_
+            and (not segs or segs == [(0, dt.size_)]))
 
 
 def _arr_in(addr: int, count: int, dt: Datatype):
-    """Copy `count` elements out of the C buffer into a fresh numpy
-    array (typed when the datatype maps to a numpy dtype).  Strided
-    vector datatypes gather their blocks from the C layout."""
+    """Copy `count` elements out of the C buffer into a fresh PACKED
+    numpy array, gathering through the datatype's type map (strided
+    vectors, UB-padded structs, nested constructions)."""
     count = int(count)
     nbytes = count * dt.size_
     if addr == 0 or nbytes <= 0:
         return np.zeros(0, dt.np_dtype if dt.np_dtype is not None
                         else np.uint8)
-    if getattr(dt, "c_layout", None) is not None:
-        offsets, blk = _vector_block_offsets(dt, count)
-        raw = bytearray()
-        for off in offsets:
-            raw += ctypes.string_at(int(addr) + off, blk)
-    else:
+    if _is_contiguous(dt):
         raw = bytearray(ctypes.string_at(addr, int(nbytes)))
+    else:
+        segs = _segments_of(dt)
+        raw = bytearray()
+        for e in range(count):
+            base = int(addr) + e * dt.extent_
+            for off, n in segs:
+                raw += ctypes.string_at(base + off, n)
     if dt.np_dtype is not None and len(raw) % np.dtype(dt.np_dtype).itemsize == 0:
         return np.frombuffer(raw, dtype=dt.np_dtype)
     return np.frombuffer(raw, dtype=np.uint8)
@@ -289,22 +307,21 @@ def _arr_in(addr: int, count: int, dt: Datatype):
 
 def _arr_out(addr: int, arr, max_bytes: Optional[int] = None,
              dt: Optional[Datatype] = None) -> None:
-    """Copy a numpy payload into the C buffer at `addr`; strided
-    vector datatypes scatter their blocks back into the C layout."""
+    """Copy a packed numpy payload into the C buffer at `addr`,
+    scattering through the datatype's type map."""
     if addr == 0 or arr is None:
         return
     a = np.ascontiguousarray(arr)
     data = a.tobytes()
-    if dt is not None and getattr(dt, "c_layout", None) is not None:
-        count = len(data) // dt.size_ if dt.size_ else 0
-        offsets, blk = _vector_block_offsets(dt, count)
+    if dt is not None and dt.size_ and not _is_contiguous(dt):
+        count = len(data) // dt.size_
+        segs = _segments_of(dt)
         pos = 0
-        for off in offsets:
-            chunk = data[pos:pos + blk]
-            if not chunk:
-                break
-            ctypes.memmove(int(addr) + off, chunk, len(chunk))
-            pos += blk
+        for e in range(count):
+            base = int(addr) + e * dt.extent_
+            for off, n in segs:
+                ctypes.memmove(base + off, data[pos:pos + n], n)
+                pos += n
         return
     n = len(data) if max_bytes is None else min(len(data), int(max_bytes))
     if n:
@@ -430,6 +447,39 @@ def _new_req_handle(ctx: _CRankCtx, creq: _CReq) -> int:
     ctx.next_req += 1
     ctx.reqs[h] = creq
     return h
+
+
+class _CPersist:
+    """A persistent request (MPI_Send_init/Recv_init): an inactive
+    spec plus, while started, the live _CReq (smpi_request.cpp
+    persistent flag)."""
+
+    __slots__ = ("kind", "spec", "inner")
+
+    def __init__(self, kind: str, spec: dict):
+        self.kind = kind          # "send" | "recv"
+        self.spec = spec
+        self.inner: Optional[_CReq] = None
+
+    def start(self, ctx) -> None:
+        s = self.spec
+        comm, dt = s["comm"], s["dt"]
+        if self.kind == "recv":
+            arr = _recv_buf(s["count"], dt)
+            req = comm.irecv(s["peer"], s["tag"], buf=arr,
+                             count=s["count"], datatype=dt)
+            self.inner = _CReq(req, s["buf"], arr, "recv", dt)
+        else:
+            arr = _arr_in(s["buf"], s["count"], dt)   # data read at Start
+            if s["mode"] == 1:      # buffered: detached fire-and-forget
+                req = Request("send", arr, s["count"], dt, s["peer"],
+                              s["tag"], comm, detached=True,
+                              is_isend=True).start()
+            else:
+                req = comm.isend(arr, s["peer"], s["tag"],
+                                 count=s["count"], datatype=dt,
+                                 ssend=(s["mode"] == 2))
+            self.inner = _CReq(req, 0, arr, "send")
 
 
 def _req_wait(creq: _CReq, status: Status):
@@ -643,17 +693,32 @@ def _h_irecv(ctx, a):
     return MPI_SUCCESS
 
 
+def _finish_persist(persist: _CPersist) -> None:
+    inner = persist.inner
+    if inner is not None and inner.kind == "recv":
+        _arr_out(inner.c_addr, inner.arr, dt=inner.dt)
+    persist.inner = None
+
+
 def _h_wait(ctx, a):
     req_addr, st_addr = a[0], a[1]
     h = ctypes.cast(int(req_addr), _pi32)[0] if req_addr else 0
     if h == 0:
         _set_status(st_addr, C_ANY_SOURCE, C_ANY_TAG, MPI_SUCCESS, 0)
         return MPI_SUCCESS
-    creq = ctx.reqs.get(int(h))
-    if creq is None:
+    entry = ctx.reqs.get(int(h))
+    if entry is None:
         return MPI_ERR_REQUEST
     status = Status()
-    _req_wait(creq, status)
+    if isinstance(entry, _CPersist):
+        # waiting an inactive persistent request returns immediately;
+        # the handle survives either way
+        if entry.inner is not None:
+            _req_wait(entry.inner, status)
+            _finish_persist(entry)
+        _status_from(st_addr, status)
+        return MPI_SUCCESS
+    _req_wait(entry, status)
     _complete_creq(ctx, h)
     _status_from(st_addr, status)
     _write_i32(req_addr, 0)
@@ -666,11 +731,21 @@ def _h_test(ctx, a):
     if h == 0:
         _write_i32(flag_addr, 1)
         return MPI_SUCCESS
-    creq = ctx.reqs.get(int(h))
-    if creq is None:
+    entry = ctx.reqs.get(int(h))
+    if entry is None:
         return MPI_ERR_REQUEST
     status = Status()
-    done = _req_test(creq, status)
+    if isinstance(entry, _CPersist):
+        if entry.inner is None:
+            _write_i32(flag_addr, 1)
+            return MPI_SUCCESS
+        done = _req_test(entry.inner, status)
+        _write_i32(flag_addr, 1 if done else 0)
+        if done:
+            _finish_persist(entry)
+            _status_from(st_addr, status)
+        return MPI_SUCCESS
+    done = _req_test(entry, status)
     _write_i32(flag_addr, 1 if done else 0)
     if done:
         _complete_creq(ctx, h)
@@ -685,11 +760,18 @@ def _h_waitall(ctx, a):
     for i, h in enumerate(handles):
         if h == 0:
             continue
-        creq = ctx.reqs.get(h)
-        if creq is None:
+        entry = ctx.reqs.get(h)
+        if entry is None:
             continue
         status = Status()
-        _req_wait(creq, status)
+        if isinstance(entry, _CPersist):
+            if entry.inner is not None:
+                _req_wait(entry.inner, status)
+                _finish_persist(entry)
+            if sts_addr:
+                _status_from(int(sts_addr) + 16 * i, status)
+            continue             # persistent handles survive waitall
+        _req_wait(entry, status)
         _complete_creq(ctx, h)
         if sts_addr:
             _status_from(int(sts_addr) + 16 * i, status)
@@ -697,34 +779,60 @@ def _h_waitall(ctx, a):
     return MPI_SUCCESS
 
 
+def _live_entries(ctx, handles):
+    """(index, handle, creq, persist-or-None) for every ACTIVE entry
+    (null handles and inactive persistent requests excluded)."""
+    out = []
+    for i, h in enumerate(handles):
+        if h == 0:
+            continue
+        entry = ctx.reqs.get(h)
+        if entry is None:
+            continue
+        if isinstance(entry, _CPersist):
+            if entry.inner is not None:
+                out.append((i, h, entry.inner, entry))
+        else:
+            out.append((i, h, entry, None))
+    return out
+
+
+def _retire(ctx, h, creq, persist, status, reqs_addr, i) -> None:
+    """Complete one finished entry: copy out, null the C slot for
+    plain requests, flip persistents to inactive."""
+    if persist is not None:
+        _finish_persist(persist)
+    else:
+        _complete_creq(ctx, h)
+        ctypes.cast(int(reqs_addr), _pi32)[i] = 0
+
+
 def _h_waitany(ctx, a):
     n, reqs_addr, idx_addr, st_addr = int(a[0]), a[1], a[2], a[3]
     handles = _read_i32s(reqs_addr, n) if reqs_addr else []
-    live = [(i, h, ctx.reqs[h]) for i, h in enumerate(handles)
-            if h != 0 and h in ctx.reqs]
+    live = _live_entries(ctx, handles)
     if not live:
         _write_i32(idx_addr, C_UNDEFINED)
         return MPI_SUCCESS
     status = Status()
-    nbc = [(i, h, c) for i, h, c in live if c.kind == "nbc"]
-    plain = [(i, h, c) for i, h, c in live if c.kind != "nbc"]
-    done = next(((i, h, c) for i, h, c in nbc if c.req.test()), None)
+    nbc = [e for e in live if e[2].kind == "nbc"]
+    plain = [e for e in live if e[2].kind != "nbc"]
+    done = next((e for e in nbc if e[2].req.test()), None)
     if done is not None:
-        i, h, _creq = done
+        i, h, creq, persist = done
     elif plain:
-        k = Request.waitany([c.req for _, _, c in plain], status)
+        k = Request.waitany([e[2].req for e in plain], status)
         if k < 0:
             _write_i32(idx_addr, C_UNDEFINED)
             return MPI_SUCCESS
-        i, h, _creq = plain[k]
+        i, h, creq, persist = plain[k]
     else:
         # only unfinished I-collectives: block on the first (waitany
         # over mixed nbc sets degrades to that, documented divergence)
-        i, h, creq = nbc[0]
+        i, h, creq, persist = nbc[0]
         creq.req.wait()
-    _complete_creq(ctx, h)
+    _retire(ctx, h, creq, persist, status, reqs_addr, i)
     _status_from(st_addr, status)
-    ctypes.cast(int(reqs_addr), _pi32)[i] = 0
     _write_i32(idx_addr, i)
     return MPI_SUCCESS
 
@@ -732,18 +840,76 @@ def _h_waitany(ctx, a):
 def _h_testall(ctx, a):
     n, reqs_addr, flag_addr, sts_addr = int(a[0]), a[1], a[2], a[3]
     handles = _read_i32s(reqs_addr, n) if reqs_addr else []
-    live = [(i, h, ctx.reqs[h]) for i, h in enumerate(handles)
-            if h != 0 and h in ctx.reqs]
-    all_done = all(_req_test(c, Status()) for _, _, c in live)
+    live = _live_entries(ctx, handles)
+    all_done = all(_req_test(c, Status()) for _, _, c, _ in live)
     _write_i32(flag_addr, 1 if all_done else 0)
     if all_done:
-        for i, h, c in live:
+        for i, h, c, persist in live:
             status = Status()
             _req_wait(c, status)    # already finished; fills status
-            _complete_creq(ctx, h)
+            _retire(ctx, h, c, persist, status, reqs_addr, i)
             if sts_addr:
                 _status_from(int(sts_addr) + 16 * i, status)
-            ctypes.cast(int(reqs_addr), _pi32)[i] = 0
+    return MPI_SUCCESS
+
+
+def _h_testany(ctx, a):
+    n, reqs_addr, idx_addr, flag_addr, st_addr = (int(a[0]), a[1], a[2],
+                                                  a[3], a[4])
+    handles = _read_i32s(reqs_addr, n) if reqs_addr else []
+    live = _live_entries(ctx, handles)
+    if not live:
+        _write_i32(idx_addr, C_UNDEFINED)
+        _write_i32(flag_addr, 1)
+        return MPI_SUCCESS
+    for i, h, c, persist in live:
+        status = Status()
+        if _req_test(c, status):
+            _retire(ctx, h, c, persist, status, reqs_addr, i)
+            _status_from(st_addr, status)
+            _write_i32(idx_addr, i)
+            _write_i32(flag_addr, 1)
+            return MPI_SUCCESS
+    _write_i32(flag_addr, 0)
+    return MPI_SUCCESS
+
+
+def _h_waitsome(ctx, a):
+    (n, reqs_addr, outcount_addr, indices_addr, sts_addr,
+     blocking) = (int(a[0]), a[1], a[2], a[3], a[4], int(a[5]))
+    handles = _read_i32s(reqs_addr, n) if reqs_addr else []
+    live = _live_entries(ctx, handles)
+    if not live:
+        _write_i32(outcount_addr, C_UNDEFINED)
+        return MPI_SUCCESS
+
+    def completed():
+        out = []
+        for i, h, c, persist in live:
+            status = Status()
+            if _req_test(c, status):
+                out.append((i, h, c, persist, status))
+        return out
+
+    done = completed()
+    if not done and blocking:
+        status = Status()
+        plain = [e for e in live if e[2].kind != "nbc"]
+        if plain:
+            k = Request.waitany([e[2].req for e in plain], status)
+            if k >= 0:
+                i, h, c, persist = plain[k]
+                done = [(i, h, c, persist, status)]
+        else:
+            i, h, c, persist = live[0]
+            c.req.wait()
+            done = [(i, h, c, persist, status)]
+    for j, (i, h, c, persist, status) in enumerate(done):
+        _retire(ctx, h, c, persist, status, reqs_addr, i)
+        ctypes.cast(int(indices_addr), _pi32)[j] = i
+        if sts_addr:
+            _status_from(int(sts_addr) + 16 * j, status)
+    _write_i32(outcount_addr, len(done))
     return MPI_SUCCESS
 
 
@@ -765,11 +931,15 @@ def _h_probe(ctx, a):
     if comm is None:
         return MPI_ERR_COMM
     while True:
-        # comm.iprobe itself injects the smpi/iprobe sleep on a miss,
-        # so this poll loop advances simulated time
+        # comm.iprobe itself injects the smpi/iprobe sleep on a miss;
+        # when that flag is zeroed, sleep here anyway — a blocking
+        # probe must never freeze simulated time
         hit = _probe_once(comm, src, tag)
         if hit is not None:
             break
+        if config["smpi/iprobe"] <= 0:
+            from ..s4u import this_actor
+            this_actor.sleep_for(1e-4)
     _set_status(st_addr, hit[0], hit[1], MPI_SUCCESS, hit[2])
     return MPI_SUCCESS
 
@@ -813,6 +983,110 @@ def _h_sendrecv(ctx, a):
     if rreq is not None:
         rreq.wait(status)
         _arr_out(rbuf, rarr, dt=rdt)
+    else:
+        status.source, status.tag, status.count = C_PROC_NULL, C_ANY_TAG, 0
+    if sreq is not None:
+        sreq.wait()
+    _status_from(st_addr, status)
+    return MPI_SUCCESS
+
+
+def _h_bsend(ctx, a, is_ibsend=False):
+    buf, count, dth, dest, tag, ch = (a[0], a[1], a[2], int(a[3]),
+                                      int(a[4]), a[5])
+    if dest == C_PROC_NULL:
+        if is_ibsend:
+            _write_i32(a[6], 0)
+        return MPI_SUCCESS
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    dt = _dt(ctx, dth)
+    arr = _arr_in(buf, count, dt)
+    # buffered mode: the payload is copied and the sender never blocks
+    # (detached kernel send = the attached-buffer semantics)
+    req = Request("send", arr, int(count), dt, dest, int(tag), comm,
+                  detached=True, is_isend=True).start()
+    if is_ibsend:
+        _write_i32(a[6], _new_req_handle(ctx, _CReq(req, 0, arr,
+                                                    "send")))
+    return MPI_SUCCESS
+
+
+def _h_send_init(ctx, a):
+    buf, count, dth, dest, tag, ch, req_addr, mode = a[:8]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    spec = {"buf": int(buf), "count": int(count), "dt": _dt(ctx, dth),
+            "peer": int(dest), "tag": int(tag), "comm": comm,
+            "mode": int(mode)}
+    h = _new_req_handle(ctx, _CPersist("send", spec))
+    _write_i32(req_addr, h)
+    return MPI_SUCCESS
+
+
+def _h_recv_init(ctx, a):
+    buf, count, dth, src, tag, ch, req_addr = a[:7]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    spec = {"buf": int(buf), "count": int(count), "dt": _dt(ctx, dth),
+            "peer": _translate_src(int(src)),
+            "tag": _translate_tag(int(tag)), "comm": comm}
+    h = _new_req_handle(ctx, _CPersist("recv", spec))
+    _write_i32(req_addr, h)
+    return MPI_SUCCESS
+
+
+def _h_start(ctx, a):
+    h = ctypes.cast(int(a[0]), _pi32)[0] if a[0] else 0
+    entry = ctx.reqs.get(int(h))
+    if not isinstance(entry, _CPersist):
+        return MPI_ERR_REQUEST
+    if entry.inner is None:
+        entry.start(ctx)
+    return MPI_SUCCESS
+
+
+def _h_startall(ctx, a):
+    n, reqs_addr = int(a[0]), a[1]
+    for h in _read_i32s(reqs_addr, n):
+        entry = ctx.reqs.get(h)
+        if isinstance(entry, _CPersist) and entry.inner is None:
+            entry.start(ctx)
+    return MPI_SUCCESS
+
+
+def _h_request_free(ctx, a):
+    h = ctypes.cast(int(a[0]), _pi32)[0] if a[0] else 0
+    ctx.reqs.pop(int(h), None)
+    _write_i32(a[0], 0)
+    return MPI_SUCCESS
+
+
+def _h_sendrecv_replace(ctx, a):
+    buf, count, dth, dest, stag, src, rtag, ch, st_addr = a[:9]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    dt = _dt(ctx, dth)
+    status = Status()
+    rreq = None
+    rarr = None
+    if int(src) != C_PROC_NULL:
+        rarr = _recv_buf(count, dt)
+        rreq = comm.irecv(_translate_src(int(src)),
+                          _translate_tag(int(rtag)), buf=rarr,
+                          count=int(count), datatype=dt)
+    sreq = None
+    if int(dest) != C_PROC_NULL:
+        sarr = _arr_in(buf, count, dt)     # snapshot before overwrite
+        sreq = comm.isend(sarr, int(dest), int(stag), count=int(count),
+                          datatype=dt)
+    if rreq is not None:
+        rreq.wait(status)
+        _arr_out(buf, rarr, dt=dt)
     else:
         status.source, status.tag, status.count = C_PROC_NULL, C_ANY_TAG, 0
     if sreq is not None:
@@ -1144,9 +1418,17 @@ def _new_dtype_handle(ctx, dt) -> int:
     return h
 
 
+def _replicate(base: Datatype, times: int, step: int):
+    """base's segments repeated `times` at `step`-byte intervals."""
+    base_segs = _segments_of(base)
+    return _coalesce([(k * step + off, n)
+                      for k in range(times) for off, n in base_segs])
+
+
 def _h_type_contiguous(ctx, a):
     count, old = int(a[0]), _dt(ctx, a[1])
     dt = Datatype.create_contiguous(count, old)
+    dt.c_segments = _replicate(old, count, old.extent_)
     _write_i32(a[2], _new_dtype_handle(ctx, dt))
     return MPI_SUCCESS
 
@@ -1155,11 +1437,14 @@ def _h_type_vector(ctx, a):
     count, blocklen, stride, old = (int(a[0]), int(a[1]), int(a[2]),
                                     _dt(ctx, a[3]))
     dt = Datatype.create_vector(count, blocklen, stride, old)
-    # C buffers really are strided: record the block layout so
-    # _arr_in/_arr_out gather/scatter the blocks, and drop the numpy
-    # element view (payloads travel packed)
+    # C buffers really are strided: record the type map so
+    # _arr_in/_arr_out gather/scatter through it; payloads travel
+    # packed so the numpy element view no longer applies
     dt.np_dtype = None
-    dt.c_layout = (count, blocklen, stride, old.size_)
+    block = _replicate(old, blocklen, old.extent_)
+    dt.c_segments = _coalesce(
+        [(b * stride * old.extent_ + off, n)
+         for b in range(count) for off, n in block])
     _write_i32(a[4], _new_dtype_handle(ctx, dt))
     return MPI_SUCCESS
 
@@ -1614,12 +1899,139 @@ def _h_type_struct(ctx, a):
     type_handles = _read_i32s(types_addr, n)
     types = [_dt(ctx, t) for t in type_handles]
     dt = Datatype.create_struct(blocklens, displs, types)
+    dt.np_dtype = None
+    segs = []
+    for bl, d, child in zip(blocklens, displs, types):
+        if child.size_ == 0:
+            continue             # UB/LB markers carry no data
+        segs.extend((int(d) + off, n)
+                    for off, n in _replicate(child, bl, child.extent_))
+    dt.c_segments = _coalesce(sorted(segs))
     # legacy MPI_UB/MPI_LB markers pin the extent (scatterv.c pattern)
     for t, d in zip(type_handles, displs):
         if t == 41:              # MPI_UB
             dt.extent_ = int(d)
         elif t == 42:            # MPI_LB: lower bound stays 0 here
             pass
+    _write_i32(out_addr, _new_dtype_handle(ctx, dt))
+    return MPI_SUCCESS
+
+
+def _read_i64s(addr: int, n: int) -> List[int]:
+    p = ctypes.cast(int(addr), _pi64)
+    return [p[i] for i in range(n)]
+
+
+def _derived(ctx, out_addr, old, size, extent, segs, name) -> int:
+    dt = Datatype(size, None, name, extent)
+    dt.c_segments = _coalesce(sorted(segs))
+    if dt.c_segments == [(0, size)] and extent == size:
+        dt.np_dtype = old.np_dtype       # degenerate-contiguous
+    _write_i32(out_addr, _new_dtype_handle(ctx, dt))
+    return MPI_SUCCESS
+
+
+def _h_type_indexed(ctx, a):
+    count, bl_addr, disp_addr, oldh, out_addr, in_bytes = (
+        int(a[0]), a[1], a[2], a[3], a[4], int(a[5]))
+    old = _dt(ctx, oldh)
+    bls = _read_i32s(bl_addr, count)
+    displs = (_read_i64s(disp_addr, count) if in_bytes
+              else _read_i32s(disp_addr, count))
+    unit = 1 if in_bytes else old.extent_
+    segs = []
+    ext = 0
+    for bl, d in zip(bls, displs):
+        base = int(d) * unit
+        segs.extend((base + off, n)
+                    for off, n in _replicate(old, bl, old.extent_))
+        ext = max(ext, base + bl * old.extent_)
+    return _derived(ctx, out_addr, old, sum(bls) * old.size_, ext, segs,
+                    "hindexed" if in_bytes else "indexed")
+
+
+def _h_type_hvector(ctx, a):
+    count, blocklen, stride, oldh, out_addr = (int(a[0]), int(a[1]),
+                                               int(a[2]), a[3], a[4])
+    old = _dt(ctx, oldh)
+    block = _replicate(old, blocklen, old.extent_)
+    segs = [(b * stride + off, n)
+            for b in range(count) for off, n in block]
+    ext = (count - 1) * stride + blocklen * old.extent_ if count else 0
+    return _derived(ctx, out_addr, old,
+                    count * blocklen * old.size_, max(ext, 0), segs,
+                    "hvector")
+
+
+def _h_type_indexed_block(ctx, a):
+    count, blocklen, disp_addr, oldh, out_addr, in_bytes = (
+        int(a[0]), int(a[1]), a[2], a[3], a[4], int(a[5]))
+    old = _dt(ctx, oldh)
+    displs = (_read_i64s(disp_addr, count) if in_bytes
+              else _read_i32s(disp_addr, count))
+    unit = 1 if in_bytes else old.extent_
+    block = _replicate(old, blocklen, old.extent_)
+    segs = []
+    ext = 0
+    for d in displs:
+        base = int(d) * unit
+        segs.extend((base + off, n) for off, n in block)
+        ext = max(ext, base + blocklen * old.extent_)
+    return _derived(ctx, out_addr, old,
+                    count * blocklen * old.size_, ext, segs,
+                    "indexed_block")
+
+
+def _h_type_dup(ctx, a):
+    old = _dt(ctx, a[0])
+    dt = Datatype(old.size_, old.np_dtype, old.name, old.extent_)
+    dt.c_segments = list(_segments_of(old))
+    _write_i32(a[1], _new_dtype_handle(ctx, dt))
+    return MPI_SUCCESS
+
+
+def _h_type_subarray(ctx, a):
+    ndims, sizes_a, subs_a, starts_a, order, oldh, out_addr = (
+        int(a[0]), a[1], a[2], a[3], int(a[4]), a[5], a[6])
+    old = _dt(ctx, oldh)
+    sizes = _read_i32s(sizes_a, ndims)
+    subs = _read_i32s(subs_a, ndims)
+    starts = _read_i32s(starts_a, ndims)
+    if order == 57:          # MPI_ORDER_FORTRAN: mirror to C order
+        sizes, subs, starts = sizes[::-1], subs[::-1], starts[::-1]
+    # C order: last dim contiguous; element strides per dim
+    strides = [1] * ndims
+    for d in range(ndims - 2, -1, -1):
+        strides[d] = strides[d + 1] * sizes[d + 1]
+
+    segs = []
+
+    def walk(d, elem_off):
+        if d == ndims - 1:
+            base = (elem_off + starts[d]) * old.extent_
+            segs.extend((base + off, n)
+                        for off, n in _replicate(old, subs[d],
+                                                 old.extent_))
+            return
+        for i in range(subs[d]):
+            walk(d + 1, elem_off + (starts[d] + i) * strides[d])
+
+    walk(0, 0)
+    total = 1
+    nsub = 1
+    for s in sizes:
+        total *= s
+    for s in subs:
+        nsub *= s
+    return _derived(ctx, out_addr, old, nsub * old.size_,
+                    total * old.extent_, segs, "subarray")
+
+
+def _h_type_resized(ctx, a):
+    old, lb, extent, out_addr = _dt(ctx, a[0]), int(a[1]), int(a[2]), a[3]
+    dt = Datatype(old.size_, old.np_dtype, f"resized({old.name})",
+                  extent)
+    dt.c_segments = list(_segments_of(old))
     _write_i32(out_addr, _new_dtype_handle(ctx, dt))
     return MPI_SUCCESS
 
@@ -1779,7 +2191,8 @@ def _h_ibcast(ctx, a):
     req = comm.ibcast(obj, int(root))
     post = None
     if me != int(root):
-        post = lambda res: _arr_out(buf, res, int(count) * dt.size_)
+        post = lambda res: _arr_out(buf, res, int(count) * dt.size_,
+                                    dt=dt)
     return _nbc_handle(ctx, req, req_addr, post)
 
 
@@ -1796,7 +2209,7 @@ def _h_ireduce(ctx, a):
     if comm.rank() == int(root):
         post = lambda res: _arr_out(
             rbuf, np.asarray(res).astype(arr.dtype, copy=False),
-            int(count) * dt.size_)
+            int(count) * dt.size_, dt=dt)
     return _nbc_handle(ctx, req, req_addr, post)
 
 
@@ -1811,7 +2224,7 @@ def _h_iallreduce(ctx, a):
     req = comm.iallreduce(arr, op)
     post = lambda res: _arr_out(
         rbuf, np.asarray(res).astype(arr.dtype, copy=False),
-        int(count) * dt.size_)
+        int(count) * dt.size_, dt=dt)
     return _nbc_handle(ctx, req, req_addr, post)
 
 
@@ -1821,7 +2234,12 @@ def _h_igather(ctx, a):
     if comm is None:
         return MPI_ERR_COMM
     me, root = comm.rank(), int(root)
-    arr = _arr_in(sbuf, scount, _dt(ctx, stype))
+    if int(sbuf) == C_IN_PLACE and me == root:
+        rdt0 = _dt(ctx, rtype)
+        arr = _arr_in(int(rbuf) + me * int(rcount) * rdt0.extent_,
+                      rcount, rdt0)
+    else:
+        arr = _arr_in(sbuf, scount, _dt(ctx, stype))
     req = comm.igather(arr, root)
     post = None
     if me == root:
@@ -1831,7 +2249,7 @@ def _h_igather(ctx, a):
         def post(res):
             for i, obj in enumerate(res):
                 _arr_out(int(rbuf) + i * stride, obj,
-                         int(rcount) * rdt.size_)
+                         int(rcount) * rdt.size_, dt=rdt)
     return _nbc_handle(ctx, req, req_addr, post)
 
 
@@ -1858,15 +2276,18 @@ def _h_iallgather(ctx, a):
     comm = _comm_of(ctx, ch)
     if comm is None:
         return MPI_ERR_COMM
-    arr = _arr_in(sbuf, scount, _dt(ctx, stype))
-    req = comm.iallgather(arr)
     rdt = _dt(ctx, rtype)
     stride = int(rcount) * rdt.extent_
+    if int(sbuf) == C_IN_PLACE:
+        arr = _arr_in(int(rbuf) + comm.rank() * stride, rcount, rdt)
+    else:
+        arr = _arr_in(sbuf, scount, _dt(ctx, stype))
+    req = comm.iallgather(arr)
 
     def post(res):
         for i, obj in enumerate(res):
             _arr_out(int(rbuf) + i * stride, obj,
-                     int(rcount) * rdt.size_)
+                     int(rcount) * rdt.size_, dt=rdt)
     return _nbc_handle(ctx, req, req_addr, post)
 
 
@@ -1876,17 +2297,230 @@ def _h_ialltoall(ctx, a):
     if comm is None:
         return MPI_ERR_COMM
     n = comm.size()
-    sdt, rdt = _dt(ctx, stype), _dt(ctx, rtype)
-    sstride = int(scount) * sdt.extent_
-    sendobjs = [_arr_in(int(sbuf) + i * sstride, scount, sdt)
-                for i in range(n)]
-    req = comm.ialltoall(sendobjs)
+    rdt = _dt(ctx, rtype)
     rstride = int(rcount) * rdt.extent_
+    if int(sbuf) == C_IN_PLACE:
+        sendobjs = [_arr_in(int(rbuf) + i * rstride, rcount, rdt)
+                    for i in range(n)]
+    else:
+        sdt = _dt(ctx, stype)
+        sstride = int(scount) * sdt.extent_
+        sendobjs = [_arr_in(int(sbuf) + i * sstride, scount, sdt)
+                    for i in range(n)]
+    req = comm.ialltoall(sendobjs)
 
     def post(res):
         for i, obj in enumerate(res):
             _arr_out(int(rbuf) + i * rstride, obj,
-                     int(rcount) * rdt.size_)
+                     int(rcount) * rdt.size_, dt=rdt)
+    return _nbc_handle(ctx, req, req_addr, post)
+
+
+def _h_alltoallw(ctx, a):
+    """Per-peer counts/byte-displacements/TYPES (the most general
+    alltoall); payloads already carry their own sizes, so the v
+    machinery serves (smpi equivalent of Coll_alltoallw)."""
+    sbuf, scounts, sdispls, stypes, rbuf, rcounts, rdispls, rtypes, ch = \
+        a[:9]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    n = comm.size()
+    sc = _read_i32s(scounts, n)
+    so = _read_i32s(sdispls, n)       # BYTE displacements in alltoallw
+    st = _read_i32s(stypes, n)
+    rc = _read_i32s(rcounts, n)
+    ro = _read_i32s(rdispls, n)
+    rt = _read_i32s(rtypes, n)
+    if int(sbuf) == C_IN_PLACE:
+        sendobjs = [_arr_in(int(rbuf) + ro[i], rc[i], _dt(ctx, rt[i]))
+                    for i in range(n)]
+    else:
+        sendobjs = [_arr_in(int(sbuf) + so[i], sc[i], _dt(ctx, st[i]))
+                    for i in range(n)]
+    res = comm.alltoallv(sendobjs)
+    for i, obj in enumerate(res):
+        rdt = _dt(ctx, rt[i])
+        _arr_out(int(rbuf) + ro[i], obj, rc[i] * rdt.size_, dt=rdt)
+    return MPI_SUCCESS
+
+
+def _h_ialltoallw(ctx, a):
+    sbuf, scounts, sdispls, stypes, rbuf, rcounts, rdispls, rtypes, ch, \
+        req_addr = a[:10]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    n = comm.size()
+    sc = _read_i32s(scounts, n)
+    so = _read_i32s(sdispls, n)
+    st = _read_i32s(stypes, n)
+    rc = _read_i32s(rcounts, n)
+    ro = _read_i32s(rdispls, n)
+    rt = _read_i32s(rtypes, n)
+    if int(sbuf) == C_IN_PLACE:
+        sendobjs = [_arr_in(int(rbuf) + ro[i], rc[i], _dt(ctx, rt[i]))
+                    for i in range(n)]
+    else:
+        sendobjs = [_arr_in(int(sbuf) + so[i], sc[i], _dt(ctx, st[i]))
+                    for i in range(n)]
+    req = comm.ialltoall(sendobjs)
+
+    def post(res):
+        for i, obj in enumerate(res):
+            rdt = _dt(ctx, rt[i])
+            _arr_out(int(rbuf) + ro[i], obj, rc[i] * rdt.size_, dt=rdt)
+    return _nbc_handle(ctx, req, req_addr, post)
+
+
+def _h_iscatterv(ctx, a):
+    sbuf, scounts, displs, stype, rbuf, rcount, rtype, root, ch, \
+        req_addr = a[:10]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    me, root, n = comm.rank(), int(root), comm.size()
+    sendobjs = None
+    if me == root:
+        sdt = _dt(ctx, stype)
+        counts = _read_i32s(scounts, n)
+        offs = _read_i32s(displs, n)
+        sendobjs = [_arr_in(int(sbuf) + offs[i] * sdt.extent_, counts[i],
+                            sdt) for i in range(n)]
+    req = comm.iscatter(sendobjs, root)
+    rdt = _dt(ctx, rtype)
+    if me == root and int(rbuf) == C_IN_PLACE:
+        post = None
+    else:
+        post = lambda res: _arr_out(rbuf, res, int(rcount) * rdt.size_,
+                                    dt=rdt)
+    return _nbc_handle(ctx, req, req_addr, post)
+
+
+def _h_igatherv(ctx, a):
+    sbuf, scount, stype, rbuf, rcounts, displs, rtype, root, ch, \
+        req_addr = a[:10]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    me, root, n = comm.rank(), int(root), comm.size()
+    if int(sbuf) == C_IN_PLACE and me == root:
+        rdt0 = _dt(ctx, rtype)
+        arr = _arr_in(
+            int(rbuf) + _read_i32s(displs, n)[me] * rdt0.extent_,
+            _read_i32s(rcounts, n)[me], rdt0)
+    else:
+        arr = _arr_in(sbuf, scount, _dt(ctx, stype))
+    req = comm.igather(arr, root)
+    post = None
+    if me == root:
+        rdt = _dt(ctx, rtype)
+        counts = _read_i32s(rcounts, n)
+        offs = _read_i32s(displs, n)
+
+        def post(res):
+            for i, obj in enumerate(res):
+                _arr_out(int(rbuf) + offs[i] * rdt.extent_, obj,
+                         counts[i] * rdt.size_, dt=rdt)
+    return _nbc_handle(ctx, req, req_addr, post)
+
+
+def _h_iallgatherv(ctx, a):
+    sbuf, scount, stype, rbuf, rcounts, displs, rtype, ch, req_addr = \
+        a[:9]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    n = comm.size()
+    rdt = _dt(ctx, rtype)
+    counts = _read_i32s(rcounts, n)
+    offs = _read_i32s(displs, n)
+    if int(sbuf) == C_IN_PLACE:
+        me = comm.rank()
+        arr = _arr_in(int(rbuf) + offs[me] * rdt.extent_, counts[me],
+                      rdt)
+    else:
+        arr = _arr_in(sbuf, scount, _dt(ctx, stype))
+    req = comm.iallgather(arr)
+
+    def post(res):
+        for i, obj in enumerate(res):
+            _arr_out(int(rbuf) + offs[i] * rdt.extent_, obj,
+                     counts[i] * rdt.size_, dt=rdt)
+    return _nbc_handle(ctx, req, req_addr, post)
+
+
+def _h_ialltoallv(ctx, a):
+    sbuf, scounts, sdispls, stype, rbuf, rcounts, rdispls, rtype, ch, \
+        req_addr = a[:10]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    n = comm.size()
+    rdt = _dt(ctx, rtype)
+    rc = _read_i32s(rcounts, n)
+    ro = _read_i32s(rdispls, n)
+    if int(sbuf) == C_IN_PLACE:
+        sendobjs = [_arr_in(int(rbuf) + ro[i] * rdt.extent_, rc[i], rdt)
+                    for i in range(n)]
+    else:
+        sdt = _dt(ctx, stype)
+        sc = _read_i32s(scounts, n)
+        so = _read_i32s(sdispls, n)
+        sendobjs = [_arr_in(int(sbuf) + so[i] * sdt.extent_, sc[i], sdt)
+                    for i in range(n)]
+    req = comm.ialltoall(sendobjs)
+
+    def post(res):
+        for i, obj in enumerate(res):
+            _arr_out(int(rbuf) + ro[i] * rdt.extent_, obj,
+                     rc[i] * rdt.size_, dt=rdt)
+    return _nbc_handle(ctx, req, req_addr, post)
+
+
+def _h_ireduce_scatter(ctx, a):
+    sbuf, rbuf, counts_or_count, dth, oph, ch, req_addr, block = a[:8]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    n = comm.size()
+    dt = _dt(ctx, dth)
+    if int(block):
+        counts = [int(counts_or_count)] * n
+    else:
+        counts = _read_i32s(counts_or_count, n)
+    me = comm.rank()
+    if int(sbuf) == C_IN_PLACE:
+        full = _arr_in(rbuf, sum(counts), dt)
+    else:
+        full = _arr_in(sbuf, sum(counts), dt)
+    sendobjs, off = [], 0
+    for c in counts:
+        sendobjs.append(full[off:off + c])
+        off += c
+    op = _op_of(ctx, oph, dt, dt_handle=dth)
+    req = comm.ireduce_scatter(sendobjs, op)
+    post = lambda res: _arr_out(
+        rbuf, np.asarray(res).astype(full.dtype, copy=False),
+        counts[me] * dt.size_, dt=dt)
+    return _nbc_handle(ctx, req, req_addr, post)
+
+
+def _h_iscan(ctx, a, exclusive=False):
+    sbuf, rbuf, count, dth, oph, ch, req_addr = a[:7]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    dt = _dt(ctx, dth)
+    arr = _arr_in(rbuf if int(sbuf) == C_IN_PLACE else sbuf, count, dt)
+    op = _op_of(ctx, oph, dt, dt_handle=dth, count=int(count))
+    req = comm.iexscan(arr, op) if exclusive else comm.iscan(arr, op)
+
+    def post(res):
+        if res is None:        # exscan rank 0: undefined
+            return
+        _arr_out(rbuf, np.asarray(res).astype(arr.dtype, copy=False),
+                 int(count) * dt.size_, dt=dt)
     return _nbc_handle(ctx, req, req_addr, post)
 
 
@@ -1925,7 +2559,17 @@ _HANDLERS = {
     94: _h_type_get_name, 95: _h_cart_create, 96: _h_cart_get,
     97: _h_cart_rank, 98: _h_cart_coords, 99: _h_cart_shift,
     100: _h_cart_sub, 101: _h_cartdim_get, 102: _h_dims_create,
-    103: _h_topo_test,
+    103: _h_topo_test, 104: _h_alltoallw, 105: _h_ialltoallw,
+    106: _h_iscatterv, 107: _h_igatherv, 108: _h_iallgatherv,
+    109: _h_ialltoallv, 110: _h_ireduce_scatter, 111: _h_iscan,
+    112: lambda c, a: _h_iscan(c, a, exclusive=True),
+    113: _h_type_resized, 114: _h_bsend,
+    115: lambda c, a: _h_bsend(c, a, is_ibsend=True),
+    116: _h_send_init, 117: _h_recv_init, 118: _h_start,
+    119: _h_startall, 120: _h_request_free, 121: _h_sendrecv_replace,
+    122: _h_testany, 123: _h_waitsome, 124: _h_type_indexed,
+    125: _h_type_hvector, 126: _h_type_indexed_block, 127: _h_type_dup,
+    128: _h_type_subarray,
 }
 
 #: ops that are pure local queries — no bench end/begin cycle needed

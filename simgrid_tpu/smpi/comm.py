@@ -295,6 +295,18 @@ class Comm:
         from . import nbc
         return nbc.ialltoall(self, sendobjs)
 
+    def ireduce_scatter(self, sendobjs, op: Op = MPI_SUM):
+        from . import nbc
+        return nbc.ireduce_scatter(self, sendobjs, op)
+
+    def iscan(self, sendobj, op: Op = MPI_SUM):
+        from . import nbc
+        return nbc.iscan(self, sendobj, op)
+
+    def iexscan(self, sendobj, op: Op = MPI_SUM):
+        from . import nbc
+        return nbc.iexscan(self, sendobj, op)
+
     # -- topologies (smpi_topo.cpp) ----------------------------------------
     def cart_create(self, dims, periodic, reorder: bool = False):
         """Returns None (MPI_COMM_NULL) for ranks beyond the grid."""

@@ -191,3 +191,58 @@ def ialltoall(comm, sendobjs) -> NbcRequest:
             parts[src] = d
         return parts
     return NbcRequest(sends, recvs, finish)
+
+
+TAG_IREDUCE_SCATTER = -118
+TAG_ISCAN = -119
+
+
+def ireduce_scatter(comm, sendobjs, op: Op = MPI_SUM) -> NbcRequest:
+    """Pairwise ireduce_scatter: ship the j-th segment to j, fold the
+    n received contributions to my segment at completion."""
+    rank, size = comm.rank(), comm.size()
+    others = [r for r in range(size) if r != rank]
+    sends = [comm.isend(sendobjs[dst], dst, TAG_IREDUCE_SCATTER)
+             for dst in others]
+    recvs = [comm.irecv(src, TAG_IREDUCE_SCATTER) for src in others]
+
+    def finish(data):
+        parts = [None] * size
+        parts[rank] = sendobjs[rank]
+        for src, d in zip(others, data):
+            parts[src] = d
+        result = parts[size - 1]
+        for i in range(size - 2, -1, -1):
+            result = op(parts[i], result)
+        return result
+    return NbcRequest(sends, recvs, finish)
+
+
+def _iscan_impl(comm, sendobj, op: Op, exclusive: bool) -> NbcRequest:
+    """Flat i(ex)scan: send to every higher rank, receive from every
+    lower one, fold in rank order at completion.  O(n^2) messages but
+    every request posts up front — the NBC contract (the reference's
+    nbc scans use chained patterns; the flat shape is this rebuild's
+    postable equivalent)."""
+    rank, size = comm.rank(), comm.size()
+    sends = [comm.isend(sendobj, dst, TAG_ISCAN)
+             for dst in range(rank + 1, size)]
+    lowers = list(range(rank))
+    recvs = [comm.irecv(src, TAG_ISCAN) for src in lowers]
+
+    def finish(data):
+        acc = None
+        for d in data:                 # ranks 0..rank-1, in order
+            acc = d if acc is None else op(acc, d)
+        if exclusive:
+            return acc                 # rank 0: undefined (None)
+        return sendobj if acc is None else op(acc, sendobj)
+    return NbcRequest(sends, recvs, finish)
+
+
+def iscan(comm, sendobj, op: Op = MPI_SUM) -> NbcRequest:
+    return _iscan_impl(comm, sendobj, op, exclusive=False)
+
+
+def iexscan(comm, sendobj, op: Op = MPI_SUM) -> NbcRequest:
+    return _iscan_impl(comm, sendobj, op, exclusive=True)

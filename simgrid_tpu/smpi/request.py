@@ -200,8 +200,18 @@ class Request:
         if self.kind == "recv":
             data = self._dst_slot[0] if self._dst_slot else None
             if isinstance(self.buf, np.ndarray) and isinstance(data, np.ndarray):
-                flat = data.reshape(-1)[:self.buf.size]
-                np.copyto(self.buf.reshape(-1)[:flat.size], flat)
+                if self.buf.dtype == data.dtype:
+                    flat = data.reshape(-1)[:self.buf.size]
+                    np.copyto(self.buf.reshape(-1)[:flat.size], flat)
+                else:
+                    # MPI moves BYTES: mismatched container dtypes
+                    # (sender basic vs receiver derived-as-uint8) must
+                    # not value-cast
+                    src = np.ascontiguousarray(data).reshape(-1)
+                    src = src.view(np.uint8)
+                    dst = self.buf.reshape(-1).view(np.uint8)
+                    n = min(dst.size, src.size)
+                    np.copyto(dst[:n], src[:n])
             elif self.buf is None:
                 self.buf = data
             if status is not None:

@@ -2,22 +2,24 @@
 import glob, os, subprocess, sys, json
 
 M = "/root/reference/teshsuite/smpi/mpich3-test"
+DIR = sys.argv[1] if len(sys.argv) > 1 else "coll"
 OUT = {}
+os.makedirs("/tmp/mpich3", exist_ok=True)
 NP = {}
-for line in open(f"{M}/coll/testlist"):
+for line in open(f"{M}/{DIR}/testlist"):
     parts = line.split()
     if len(parts) >= 2 and parts[1].isdigit():
         NP.setdefault(parts[0], int(parts[1]))
 
-for src in sorted(glob.glob(f"{M}/coll/*.c")):
+for src in sorted(glob.glob(f"{M}/{DIR}/*.c")):
     name = os.path.basename(src)[:-2]
     np_ranks = NP.get(name, 4)
     code = f"""
 import sys; sys.path.insert(0, "/root/repo")
 from simgrid_tpu.smpi.c_api import compile_program, run_c_program
-compile_program(["{src}", "{M}/util/mtest.c"], "/tmp/mpich3/{name}.so",
+compile_program(["{src}", "{M}/util/mtest.c", "{M}/util/mtest_datatype.c", "{M}/util/mtest_datatype_gen.c"], "/tmp/mpich3/{DIR}-{name}.so",
                 extra_flags=["-I{M}/include"])
-engine, codes = run_c_program("/tmp/mpich3/{name}.so", np_ranks={np_ranks},
+engine, codes = run_c_program("/tmp/mpich3/{DIR}-{name}.so", np_ranks={np_ranks},
     configs=("smpi/simulate-computation:false",))
 assert all(c == 0 for c in codes.values()), codes
 """
@@ -35,4 +37,4 @@ assert all(c == 0 for c in codes.values()), codes
 
 n = sum(1 for v in OUT.values() if v == "PASS")
 print(f"\nPASS {n}/{len(OUT)}")
-json.dump(OUT, open("/tmp/mpich3_coll_results.json", "w"), indent=1)
+json.dump(OUT, open(f"/tmp/mpich3_{DIR}_results.json", "w"), indent=1)
