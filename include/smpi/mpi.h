@@ -418,6 +418,21 @@ int MPI_Cartdim_get(MPI_Comm comm, int* ndims);
 int MPI_Dims_create(int nnodes, int ndims, int* dims);
 int MPI_Topo_test(MPI_Comm comm, int* status);
 
+int MPI_Pack(const void* inbuf, int incount, MPI_Datatype datatype,
+             void* outbuf, int outsize, int* position, MPI_Comm comm);
+int MPI_Unpack(const void* inbuf, int insize, int* position, void* outbuf,
+               int outcount, MPI_Datatype datatype, MPI_Comm comm);
+int MPI_Pack_size(int incount, MPI_Datatype datatype, MPI_Comm comm,
+                  int* size);
+int MPI_Graph_create(MPI_Comm comm, int nnodes, const int* index,
+                     const int* edges, int reorder, MPI_Comm* newcomm);
+int MPI_Graph_neighbors(MPI_Comm comm, int rank, int maxneighbors,
+                        int* neighbors);
+int MPI_Graph_neighbors_count(MPI_Comm comm, int rank, int* nneighbors);
+int MPI_Graphdims_get(MPI_Comm comm, int* nnodes, int* nedges);
+int MPI_Graph_get(MPI_Comm comm, int maxindex, int maxedges, int* index,
+                  int* edges);
+
 /* -- non-blocking collectives -------------------------------------------- */
 int MPI_Ibarrier(MPI_Comm comm, MPI_Request* request);
 int MPI_Ibcast(void* buf, int count, MPI_Datatype datatype, int root,

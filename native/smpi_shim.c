@@ -159,6 +159,11 @@ enum {
   SMPI_OP_TYPE_INDEXED_BLOCK, /* flag arg as TYPE_INDEXED */
   SMPI_OP_TYPE_DUP,
   SMPI_OP_TYPE_SUBARRAY,
+  SMPI_OP_PACK,               /* unpack via the direction arg */
+  SMPI_OP_GRAPH_CREATE,       /* 130 */
+  SMPI_OP_GRAPH_NEIGHBORS,
+  SMPI_OP_GRAPHDIMS_GET,
+  SMPI_OP_GRAPH_GET,
 };
 
 /* sub-modes for FILE_READ / FILE_WRITE */
@@ -888,6 +893,46 @@ int MPI_Dims_create(int nnodes, int ndims, int* dims) {
 }
 int MPI_Topo_test(MPI_Comm comm, int* status) {
   CALL(SMPI_OP_TOPO_TEST, A(comm), A(status));
+}
+
+int MPI_Pack(const void* inbuf, int incount, MPI_Datatype datatype,
+             void* outbuf, int outsize, int* position, MPI_Comm comm) {
+  CALL(SMPI_OP_PACK, A(inbuf), A(incount), A(datatype), A(outbuf),
+       A(outsize), A(position), A(comm), 0);
+}
+int MPI_Unpack(const void* inbuf, int insize, int* position, void* outbuf,
+               int outcount, MPI_Datatype datatype, MPI_Comm comm) {
+  CALL(SMPI_OP_PACK, A(outbuf), A(outcount), A(datatype), A(inbuf),
+       A(insize), A(position), A(comm), 1);
+}
+int MPI_Pack_size(int incount, MPI_Datatype datatype, MPI_Comm comm,
+                  int* size) {
+  int one = 0;
+  int rc = MPI_Type_size(datatype, &one);
+  (void)comm;
+  *size = incount * one;
+  return rc;
+}
+int MPI_Graph_create(MPI_Comm comm, int nnodes, const int* index,
+                     const int* edges, int reorder, MPI_Comm* newcomm) {
+  CALL(SMPI_OP_GRAPH_CREATE, A(comm), A(nnodes), A(index), A(edges),
+       A(reorder), A(newcomm));
+}
+int MPI_Graph_neighbors(MPI_Comm comm, int rank, int maxneighbors,
+                        int* neighbors) {
+  CALL(SMPI_OP_GRAPH_NEIGHBORS, A(comm), A(rank), A(maxneighbors),
+       A(neighbors), 0);
+}
+int MPI_Graph_neighbors_count(MPI_Comm comm, int rank, int* nneighbors) {
+  CALL(SMPI_OP_GRAPH_NEIGHBORS, A(comm), A(rank), 0, A(nneighbors), 1);
+}
+int MPI_Graphdims_get(MPI_Comm comm, int* nnodes, int* nedges) {
+  CALL(SMPI_OP_GRAPHDIMS_GET, A(comm), A(nnodes), A(nedges));
+}
+int MPI_Graph_get(MPI_Comm comm, int maxindex, int maxedges, int* index,
+                  int* edges) {
+  CALL(SMPI_OP_GRAPH_GET, A(comm), A(maxindex), A(maxedges), A(index),
+       A(edges));
 }
 
 /* -- non-blocking collectives -------------------------------------------------- */

@@ -189,6 +189,7 @@ class _CRankCtx:
         self.wins: Dict[int, dict] = {}
         self.next_win = 1
         self.cart_topos: Dict[int, object] = {}
+        self.graph_topos: Dict[int, object] = {}
         self.bench_t0: Optional[float] = None
         self.initialized = False
         self.finalized = False
@@ -2165,6 +2166,83 @@ def _h_topo_test(ctx, a):
     return MPI_SUCCESS
 
 
+def _h_pack(ctx, a):
+    """Pack (direction 0): typed buffer -> contiguous bytes at
+    *position; Unpack (1): the reverse. The shim swapped args so both
+    directions share (typed_buf, count, dt, packed_buf, size, pos)."""
+    typed_buf, count, dth, packed_buf, packed_size, pos_addr, _ch, \
+        direction = a[:8]
+    dt = _dt(ctx, dth)
+    pos = ctypes.cast(int(pos_addr), _pi32)[0]
+    nbytes = int(count) * dt.size_
+    if pos + nbytes > int(packed_size):
+        return MPI_ERR_OTHER
+    if int(direction) == 0:
+        arr = _arr_in(typed_buf, count, dt)     # gather through typemap
+        data = np.ascontiguousarray(arr).tobytes()
+        ctypes.memmove(int(packed_buf) + pos, data, nbytes)
+    else:
+        raw = ctypes.string_at(int(packed_buf) + pos, nbytes)
+        arr = np.frombuffer(bytearray(raw), np.uint8)
+        _arr_out(typed_buf, arr, dt=dt)         # scatter through typemap
+    ctypes.cast(int(pos_addr), _pi32)[0] = pos + nbytes
+    return MPI_SUCCESS
+
+
+def _h_graph_create(ctx, a):
+    from .topo import GraphTopology
+    ch, nnodes, index_a, edges_a, _reorder, out_addr = a[:6]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    index = _read_i32s(index_a, int(nnodes))
+    nedges = index[-1] if index else 0
+    edges = _read_i32s(edges_a, nedges)
+    grid = comm.dup()
+    h = _new_comm_handle(ctx, grid)
+    ctx.graph_topos[h] = GraphTopology(grid, index, edges)
+    _write_i32(out_addr, h)
+    return MPI_SUCCESS
+
+
+def _h_graph_neighbors(ctx, a):
+    ch, rank, maxn, out_addr, count_only = (a[0], int(a[1]), int(a[2]),
+                                            a[3], int(a[4]))
+    topo = ctx.graph_topos.get(int(ch))
+    if topo is None:
+        return MPI_ERR_COMM
+    nbrs = topo.neighbors(rank)
+    if count_only:
+        _write_i32(out_addr, len(nbrs))
+        return MPI_SUCCESS
+    for i, nb in enumerate(nbrs[:maxn]):
+        ctypes.cast(int(out_addr), _pi32)[i] = nb
+    return MPI_SUCCESS
+
+
+def _h_graphdims_get(ctx, a):
+    topo = ctx.graph_topos.get(int(a[0]))
+    if topo is None:
+        return MPI_ERR_COMM
+    _write_i32(a[1], len(topo.index))
+    _write_i32(a[2], len(topo.edges))
+    return MPI_SUCCESS
+
+
+def _h_graph_get(ctx, a):
+    ch, maxindex, maxedges, index_addr, edges_addr = (a[0], int(a[1]),
+                                                      int(a[2]), a[3],
+                                                      a[4])
+    topo = ctx.graph_topos.get(int(ch))
+    if topo is None:
+        return MPI_ERR_COMM
+    for i, v in enumerate(topo.index[:maxindex]):
+        ctypes.cast(int(index_addr), _pi32)[i] = v
+    for i, v in enumerate(topo.edges[:maxedges]):
+        ctypes.cast(int(edges_addr), _pi32)[i] = v
+    return MPI_SUCCESS
+
+
 # -- non-blocking collectives -----------------------------------------------
 
 def _nbc_handle(ctx, req, req_addr, post=None) -> int:
@@ -2569,7 +2647,8 @@ _HANDLERS = {
     119: _h_startall, 120: _h_request_free, 121: _h_sendrecv_replace,
     122: _h_testany, 123: _h_waitsome, 124: _h_type_indexed,
     125: _h_type_hvector, 126: _h_type_indexed_block, 127: _h_type_dup,
-    128: _h_type_subarray,
+    128: _h_type_subarray, 129: _h_pack, 130: _h_graph_create,
+    131: _h_graph_neighbors, 132: _h_graphdims_get, 133: _h_graph_get,
 }
 
 #: ops that are pure local queries — no bench end/begin cycle needed
@@ -2577,7 +2656,7 @@ _HANDLERS = {
 #: handlers is what prices the sampled loop body)
 _LOCAL_OPS = {3, 4, 24, 41, 42, 45, 46, 48, 50, 51, 63, 64, 66, 69,
               70, 72, 73, 74, 75, 76, 77, 78, 79, 83, 84, 85, 94, 96,
-              97, 98, 99, 101, 102, 103}
+              97, 98, 99, 101, 102, 103, 129, 130, 131, 132, 133}
 
 
 def _dispatch_py(opcode: int, args) -> int:
