@@ -196,6 +196,10 @@ double MPI_Wtick(void);
 int MPI_Get_processor_name(char* name, int* resultlen);
 int MPI_Error_string(int errorcode, char* string, int* resultlen);
 int MPI_Get_version(int* version, int* subversion);
+int MPI_Get_address(const void* location, MPI_Aint* address);
+int MPI_Address(void* location, MPI_Aint* address);
+int MPI_Request_get_status(MPI_Request request, int* flag,
+                           MPI_Status* status);
 
 /* -- communicators ------------------------------------------------------ */
 int MPI_Comm_rank(MPI_Comm comm, int* rank);

@@ -164,6 +164,7 @@ enum {
   SMPI_OP_GRAPH_NEIGHBORS,
   SMPI_OP_GRAPHDIMS_GET,
   SMPI_OP_GRAPH_GET,
+  SMPI_OP_REQUEST_GET_STATUS,
 };
 
 /* sub-modes for FILE_READ / FILE_WRITE */
@@ -199,6 +200,17 @@ int MPI_Error_string(int errorcode, char* string, int* resultlen) {
   string[i] = 0;
   *resultlen = i;
   return MPI_SUCCESS;
+}
+int MPI_Get_address(const void* location, MPI_Aint* address) {
+  *address = (MPI_Aint)location;
+  return MPI_SUCCESS;
+}
+int MPI_Address(void* location, MPI_Aint* address) {
+  return MPI_Get_address(location, address);
+}
+int MPI_Request_get_status(MPI_Request request, int* flag,
+                           MPI_Status* status) {
+  CALL(SMPI_OP_REQUEST_GET_STATUS, A(request), A(flag), A(status));
 }
 int MPI_Get_version(int* version, int* subversion) {
   *version = 2;

@@ -2602,6 +2602,25 @@ def _h_iscan(ctx, a, exclusive=False):
     return _nbc_handle(ctx, req, req_addr, post)
 
 
+def _h_request_get_status(ctx, a):
+    """Non-destructive completion query: tests the request but leaves
+    the handle live (MPI_Request_get_status)."""
+    h, flag_addr, st_addr = int(a[0]), a[1], a[2]
+    entry = ctx.reqs.get(h)
+    if h == 0 or entry is None:
+        _write_i32(flag_addr, 1)
+        return MPI_SUCCESS
+    status = Status()
+    if isinstance(entry, _CPersist):
+        done = entry.inner is None or _req_test(entry.inner, status)
+    else:
+        done = _req_test(entry, status)
+    _write_i32(flag_addr, 1 if done else 0)
+    if done:
+        _status_from(st_addr, status)
+    return MPI_SUCCESS
+
+
 _HANDLERS = {
     1: _h_init, 2: _h_finalize, 3: _h_initialized, 4: _h_finalized,
     5: _h_abort, 6: _h_comm_rank, 7: _h_comm_size, 8: _h_comm_dup,
@@ -2649,6 +2668,7 @@ _HANDLERS = {
     125: _h_type_hvector, 126: _h_type_indexed_block, 127: _h_type_dup,
     128: _h_type_subarray, 129: _h_pack, 130: _h_graph_create,
     131: _h_graph_neighbors, 132: _h_graphdims_get, 133: _h_graph_get,
+    134: _h_request_get_status,
 }
 
 #: ops that are pure local queries — no bench end/begin cycle needed

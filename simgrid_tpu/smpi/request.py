@@ -47,9 +47,13 @@ def _match_common(ref: "Request", req: "Request") -> bool:
         return False
     if ref.src != MPI_ANY_SOURCE and ref.src != req.src:
         return False
-    if ref.tag != MPI_ANY_TAG and ref.tag != req.tag:
-        return False
-    return True
+    if ref.tag == MPI_ANY_TAG:
+        # the wildcard only matches USER tags: internal collective/NBC
+        # traffic rides negative tags and must never be stolen by a
+        # posted MPI_ANY_TAG receive (smpi_request.cpp match_common's
+        # `tag >= 0` guard)
+        return req.tag >= 0
+    return ref.tag == req.tag
 
 
 def match_recv(ref: "Request", req: "Request", _comm) -> bool:
