@@ -1,0 +1,241 @@
+"""Chord DHT over s4u — BASELINE config #5 (reference
+examples/s4u/dht-chord/s4u-dht-chord.cpp).
+
+Every node owns one mailbox; find-successor queries are FORWARDED
+node-to-node and answered directly to the asker's reply mailbox (the
+reference's non-blocking design — no nested RPC, so no request
+deadlocks).  Nodes periodically stabilize, fix a random finger, and
+issue random lookups until the deadline, then notify their successor
+and leave.
+
+Run directly for a small demo, or through tools/chord_scale.py for the
+10k-peer churn configuration.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List, Optional
+
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))
+from simgrid_tpu import s4u
+
+NB_BITS = 24
+NB_KEYS = 1 << NB_BITS
+
+#: message sizes (bytes), matching the reference's constants
+COMM_SIZE = 10.0
+
+
+def _in_range(value: int, start: int, end: int) -> bool:
+    """value in (start, end] on the ring; (a, a] is the FULL circle
+    (the single-node ring owns every key)."""
+    value = (value - start) % NB_KEYS
+    end = (end - start) % NB_KEYS
+    if end == 0:
+        return True
+    return 0 < value <= end
+
+
+class ChordNode:
+    """One DHT node actor."""
+
+    def __init__(self, node_id: int, deadline: float,
+                 known_id: Optional[int], stats: dict,
+                 lookup_period: float = 10.0, rng_seed: int = 0):
+        self.id = node_id
+        self.known_id = known_id
+        self.deadline = deadline
+        self.stats = stats
+        self.lookup_period = lookup_period
+        self.rng = random.Random((rng_seed << 32) | node_id)
+        self.fingers: List[int] = [node_id] * NB_BITS
+        self.pred_id: Optional[int] = None
+        self.mailbox = s4u.Mailbox.by_name(f"chord-{node_id}")
+        self._comm = None          # the ONE outstanding receive
+        self._pending_answer = None
+
+    # -- ring arithmetic ---------------------------------------------------
+    def successor(self) -> int:
+        return self.fingers[0]
+
+    def closest_preceding(self, key: int) -> int:
+        for finger in reversed(self.fingers):
+            if _in_range(finger, self.id, (key - 1) % NB_KEYS):
+                return finger
+        return self.id
+
+    # -- messaging ---------------------------------------------------------
+    def _send(self, dst_id: int, msg: dict) -> None:
+        s4u.Mailbox.by_name(f"chord-{dst_id}").put_init(
+            msg, COMM_SIZE).detach().start()
+
+    def _handle(self, msg: dict) -> None:
+        kind = msg["type"]
+        if kind == "find_successor":
+            key = msg["key"]
+            if _in_range(key, self.id, self.successor()):
+                self._send(msg["answer_to"],
+                           {"type": "found", "key": key,
+                            "answer": self.successor()})
+            else:
+                # forward along the finger table (the reference's
+                # remote_find_successor relay)
+                self._send(self.closest_preceding(key), msg)
+        elif kind == "found":
+            self.stats["resolved"] = self.stats.get("resolved", 0) + 1
+            self._pending_answer = msg
+        elif kind == "get_predecessor":
+            self._send(msg["answer_to"],
+                       {"type": "predecessor", "answer": self.pred_id})
+        elif kind == "predecessor":
+            self._pending_answer = msg
+        elif kind == "notify":
+            candidate = msg["id"]
+            if self.pred_id is None or _in_range(
+                    candidate, self.pred_id, (self.id - 1) % NB_KEYS):
+                self.pred_id = candidate
+        elif kind == "predecessor_leaving":
+            self.pred_id = msg["pred"]
+        elif kind == "successor_leaving":
+            self.fingers[0] = msg["succ"]
+
+    #: polling quantum (simulated s) — the reference chord's pattern:
+    #: test() the one posted receive, sleep when idle
+    POLL = 0.05
+
+    def _recv_until(self, want: str, timeout: float) -> Optional[dict]:
+        """Pump messages until one of type `want` arrives (answering
+        every request meanwhile) or the timeout elapses.  Exactly ONE
+        receive stays posted; it is polled with test() + sleep, never
+        abandoned (a dangling posted receive would steal messages, and
+        a timed-out wait leaves the comm unusable)."""
+        end = s4u.Engine.get_clock() + timeout
+        self._pending_answer = None
+        while s4u.Engine.get_clock() < end:
+            if self._comm is None:
+                self._comm = self.mailbox.get_async()
+            if self._comm.test():
+                payload = self._comm.get_payload()
+                self._comm = None
+                self._handle(payload)
+                if (self._pending_answer is not None
+                        and self._pending_answer["type"] == want):
+                    return self._pending_answer
+            else:
+                s4u.this_actor.sleep_for(
+                    min(self.POLL, end - s4u.Engine.get_clock()))
+        return None
+
+    # -- chord protocol ----------------------------------------------------
+    def find_successor(self, key: int) -> Optional[int]:
+        if _in_range(key, self.id, self.successor()):
+            return self.successor()
+        self.stats["lookups"] = self.stats.get("lookups", 0) + 1
+        self._send(self.closest_preceding(key),
+                   {"type": "find_successor", "key": key,
+                    "answer_to": self.id})
+        answer = self._recv_until("found", 50.0)
+        return answer["answer"] if answer else None
+
+    def join(self) -> bool:
+        self._send(self.known_id,
+                   {"type": "find_successor", "key": self.id,
+                    "answer_to": self.id})
+        answer = self._recv_until("found", 200.0)
+        if answer is None:
+            self.stats["join_failures"] = \
+                self.stats.get("join_failures", 0) + 1
+            return False
+        self.fingers[0] = answer["answer"]
+        return True
+
+    def stabilize(self) -> None:
+        self._send(self.successor(),
+                   {"type": "get_predecessor", "answer_to": self.id})
+        answer = self._recv_until("predecessor", 20.0)
+        if answer and answer["answer"] is not None:
+            candidate = answer["answer"]
+            if _in_range(candidate, self.id,
+                         (self.successor() - 1) % NB_KEYS):
+                self.fingers[0] = candidate
+        if self.successor() != self.id:
+            self._send(self.successor(), {"type": "notify", "id": self.id})
+
+    def fix_fingers(self) -> None:
+        i = self.rng.randrange(NB_BITS)
+        succ = self.find_successor((self.id + (1 << i)) % NB_KEYS)
+        if succ is not None:
+            self.fingers[i] = succ
+
+    def leave(self) -> None:
+        if self.pred_id is not None:
+            self._send(self.successor(),
+                       {"type": "predecessor_leaving",
+                        "pred": self.pred_id})
+            self._send(self.pred_id,
+                       {"type": "successor_leaving",
+                        "succ": self.successor()})
+
+    # -- actor body --------------------------------------------------------
+    def __call__(self) -> None:
+        if self.known_id is not None:
+            s4u.this_actor.sleep_for(self.rng.uniform(0.0, 2.0))
+            if not self.join():
+                return
+        next_action = s4u.Engine.get_clock() + self.lookup_period
+        while s4u.Engine.get_clock() < self.deadline:
+            budget = min(self.deadline,
+                         next_action) - s4u.Engine.get_clock()
+            if budget > 0:
+                self._recv_until("__none__", budget)   # serve requests
+            if s4u.Engine.get_clock() >= self.deadline:
+                break
+            self.stabilize()
+            self.fix_fingers()
+            self.find_successor(self.rng.randrange(NB_KEYS))
+            next_action = s4u.Engine.get_clock() + self.lookup_period
+        self.leave()
+
+
+def deploy(engine, n_nodes: int, deadline: float = 400.0,
+           seed: int = 42, lookup_period: float = 10.0) -> dict:
+    """Create n_nodes Chord actors round-robin over the platform's
+    hosts; returns the shared stats dict filled during run()."""
+    rng = random.Random(seed)
+    ids = sorted(rng.sample(range(NB_KEYS), n_nodes))
+    hosts = engine.get_all_hosts()
+    stats: dict = {"ids": ids}
+    # the first node bootstraps the ring; the others join via a random
+    # already-placed node (the reference joins via a fixed known host)
+    for i, node_id in enumerate(ids):
+        known = None if i == 0 else ids[rng.randrange(i)]
+        node = ChordNode(node_id, deadline, known, stats,
+                         lookup_period=lookup_period, rng_seed=seed)
+        s4u.Actor.create(f"node-{node_id}", hosts[i % len(hosts)], node)
+    return stats
+
+
+def main():
+    import sys
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+    e = s4u.Engine(["chord"])
+    from simgrid_tpu.smpi.runtime import fabricate_platform
+    import tempfile, os
+    fd, plat = tempfile.mkstemp(suffix=".xml")
+    os.close(fd)
+    fabricate_platform(min(n, 64), plat)
+    e.load_platform(plat)
+    stats = deploy(e, n)
+    e.run()
+    os.unlink(plat)
+    print(f"chord: {n} nodes, clock={e.clock:.3f}, "
+          f"lookups={stats.get('lookups', 0)}, "
+          f"resolved={stats.get('resolved', 0)}")
+
+
+if __name__ == "__main__":
+    main()

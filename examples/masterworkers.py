@@ -1,0 +1,66 @@
+"""Master/workers over s4u — BASELINE config #2 (reference
+examples/s4u/app-masterworkers/s4u-app-masterworkers.cpp): one master
+scatters compute tasks round-robin to workers over mailboxes, then
+ships one finalize token per worker."""
+
+from __future__ import annotations
+
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))
+from simgrid_tpu import s4u
+
+
+def master(n_tasks: int, comp_size: float, comm_size: float,
+           worker_names, stats: dict):
+    mailboxes = [s4u.Mailbox.by_name(name) for name in worker_names]
+    for i in range(n_tasks):
+        mailboxes[i % len(mailboxes)].put(("task", comp_size), comm_size)
+    for mbox in mailboxes:
+        mbox.put(("finalize", 0.0), 0.0)
+    stats["master_done"] = s4u.Engine.get_clock()
+
+
+def worker(name: str, stats: dict):
+    mbox = s4u.Mailbox.by_name(name)
+    done = 0
+    while True:
+        kind, flops = mbox.get()
+        if kind == "finalize":
+            break
+        s4u.this_actor.execute(flops)
+        done += 1
+    stats[name] = done
+
+
+def deploy(engine, n_workers: int, n_tasks: int = 1000,
+           comp_size: float = 50e6, comm_size: float = 1e6) -> dict:
+    hosts = engine.get_all_hosts()
+    assert len(hosts) >= 2, "need at least a master and one worker"
+    names = [f"worker-{i}" for i in range(n_workers)]
+    stats: dict = {}
+    s4u.Actor.create("master", hosts[0], master, n_tasks, comp_size,
+                     comm_size, names, stats)
+    for i, name in enumerate(names):
+        s4u.Actor.create(name, hosts[1 + i % (len(hosts) - 1)], worker,
+                         name, stats)
+    return stats
+
+
+def main():
+    import sys
+    platform = sys.argv[1] if len(sys.argv) > 1 else \
+        "/root/reference/examples/platforms/cluster_fat_tree.xml"
+    n_workers = int(sys.argv[2]) if len(sys.argv) > 2 else 12
+    e = s4u.Engine(["masterworkers"])
+    e.load_platform(platform)
+    stats = deploy(e, n_workers)
+    e.run()
+    total = sum(v for k, v in stats.items() if k.startswith("worker-"))
+    print(f"masterworkers: {n_workers} workers processed {total} tasks, "
+          f"clock={e.clock:.6f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""Example applications (BASELINE configs #2 and #5): master/workers
+on the reference's fat-tree cluster, and the Chord DHT with churn."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from examples import chord, masterworkers  # noqa: E402
+from simgrid_tpu import s4u  # noqa: E402
+from simgrid_tpu.smpi.runtime import fabricate_platform  # noqa: E402
+
+FAT_TREE = "/root/reference/examples/platforms/cluster_fat_tree.xml"
+
+
+@pytest.fixture(autouse=True)
+def fresh():
+    s4u.Engine._reset()
+    yield
+    s4u.Engine._reset()
+
+
+@pytest.mark.skipif(not os.path.exists(FAT_TREE),
+                    reason="reference platforms unavailable")
+def test_masterworkers_on_fat_tree():
+    """BASELINE config #2: all tasks processed; end time deterministic
+    across two runs."""
+    def run():
+        s4u.Engine._reset()
+        e = s4u.Engine(["mw"])
+        e.load_platform(FAT_TREE)
+        stats = masterworkers.deploy(e, n_workers=8, n_tasks=200)
+        e.run()
+        return e.clock, sum(v for k, v in stats.items()
+                            if k.startswith("worker-"))
+
+    t1, done1 = run()
+    t2, done2 = run()
+    assert done1 == done2 == 200
+    assert t1 == t2 > 0.0
+
+
+def _run_chord(tmp_path, n, deadline=150.0, seed=7):
+    plat = os.path.join(tmp_path, "p.xml")
+    fabricate_platform(min(n, 32), plat)
+    e = s4u.Engine(["chord"])
+    e.load_platform(plat)
+    stats = chord.deploy(e, n, deadline=deadline, seed=seed)
+    e.run()
+    return e, stats
+
+
+def test_chord_lookups_resolve(tmp_path):
+    """BASELINE config #5 shape: the ring converges enough that
+    lookups resolve, and the run is deterministic."""
+    e1, s1 = _run_chord(tmp_path, 16)
+    resolved1, lookups1 = s1.get("resolved", 0), s1.get("lookups", 0)
+    assert resolved1 > 0
+    assert lookups1 > 0
+    assert s1.get("join_failures", 0) == 0
+    t1 = e1.clock
+
+    s4u.Engine._reset()
+    e2, s2 = _run_chord(tmp_path, 16)
+    assert (e2.clock, s2.get("resolved")) == (t1, resolved1)
+
+
+def test_chord_interval_semantics():
+    assert chord._in_range(5, 3, 10)
+    assert not chord._in_range(3, 3, 10)          # exclusive start
+    assert chord._in_range(10, 3, 10)             # inclusive end
+    assert chord._in_range(1, 10, 3)              # wraparound
+    assert chord._in_range(42, 7, 7)              # (a, a] = full circle
+    assert chord._in_range(7, 7, 7)

@@ -1,0 +1,52 @@
+#!/usr/bin/env python3
+"""BASELINE config #5 at scale: Chord with N peers (default 10,000)
+and churn-heavy message traffic on the smpirun default fabric.
+
+Usage: python tools/chord_scale.py [n_peers] [deadline]
+Prints one summary line with wall time, simulated clock, lookup and
+resolution counts, and peak RSS."""
+
+import os
+import resource
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from examples import chord
+from simgrid_tpu import s4u
+from simgrid_tpu.smpi.runtime import fabricate_platform
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
+    deadline = float(sys.argv[2]) if len(sys.argv) > 2 else 60.0
+    chord.ChordNode.POLL = 0.25        # coarser pump at scale
+    fd, plat = tempfile.mkstemp(suffix=".xml")
+    os.close(fd)
+    fabricate_platform(min(n, 256), plat)
+
+    t0 = time.perf_counter()
+    e = s4u.Engine(["chord-scale"])
+    e.load_platform(plat)
+    stats = chord.deploy(e, n, deadline=deadline, lookup_period=20.0)
+    built = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    e.run()
+    ran = time.perf_counter() - t0
+    os.unlink(plat)
+
+    rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+    print(f"chord-scale: {n} peers, clock={e.clock:.1f}s, "
+          f"build {built:.1f}s + run {ran:.1f}s wall, "
+          f"lookups={stats.get('lookups', 0)}, "
+          f"resolved={stats.get('resolved', 0)}, "
+          f"join_failures={stats.get('join_failures', 0)}, "
+          f"peak RSS {rss:.2f} GB")
+
+
+if __name__ == "__main__":
+    main()
