@@ -1,0 +1,70 @@
+#!/usr/bin/env python3
+"""BASELINE config #3 analog: NAS benchmark at rank-count on a torus
+cluster platform (the reference ships EP/IS/DT; LU is not in its NAS
+port, so IS — the communication-heavy kernel — is the headline).
+
+Usage: python tools/nas_scale.py [is|ep|dt] [np] [CLASS]
+Prints simulated-sec and wall-sec (the BASELINE.json metric shape)."""
+
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from simgrid_tpu.smpi.c_api import compile_program, run_c_program
+
+NAS = "/root/reference/examples/smpi/NAS"
+
+TORUS = """<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <cluster id="torus" prefix="node-" radical="0-{last}" suffix=""
+             speed="1Gf" bw="10Gbps" lat="10us" topology="TORUS"
+             topo_parameters="{topo}"/>
+  </zone>
+</platform>
+"""
+
+SRCS = {"ep": ["ep.c", "nas_common.c"],
+        "is": ["is.c", "nas_common.c"],
+        "dt": ["dt.c", "nas_common.c", "DGraph.c"]}
+
+
+def main():
+    bench = sys.argv[1] if len(sys.argv) > 1 else "is"
+    np_ranks = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    klass = sys.argv[3] if len(sys.argv) > 3 else "S"
+
+    # cube-ish torus covering np_ranks
+    side = 2
+    while side ** 3 < np_ranks:
+        side += 1
+    topo = f"{side},{side},{side}"
+    fd, plat = tempfile.mkstemp(suffix=".xml")
+    os.close(fd)
+    with open(plat, "w") as f:
+        f.write(TORUS.format(last=side ** 3 - 1, topo=topo))
+
+    with tempfile.TemporaryDirectory() as d:
+        so = os.path.join(d, f"{bench}.so")
+        compile_program([os.path.join(NAS, s) for s in SRCS[bench]], so)
+        args = [str(np_ranks), klass] + (["BH"] if bench == "dt" else [])
+        t0 = time.perf_counter()
+        engine, codes = run_c_program(
+            so, np_ranks=np_ranks, platform=plat,
+            hosts=[f"node-{i}" for i in range(np_ranks)],
+            app_args=args)
+        wall = time.perf_counter() - t0
+    os.unlink(plat)
+    bad = {r: c for r, c in codes.items() if c not in (0, 1)}
+    print(f"nas-{bench}.{klass} np={np_ranks} on {topo} torus: "
+          f"simulated {engine.clock:.3f}s, wall {wall:.1f}s "
+          f"(sim/wall {engine.clock / wall:.3f}), "
+          f"bad_exits={bad or 'none'}")
+
+
+if __name__ == "__main__":
+    main()
