@@ -133,8 +133,14 @@ class EngineImpl:
                 continue
             if ctx._thread.is_alive():
                 ctx.iwannadie = True
-                ctx._sem.release()
+                try:
+                    ctx._lock.release()
+                except RuntimeError:
+                    pass     # already released (racing normal handoff)
                 ctx._thread.join(timeout=5)
+                # the dying actor's stop() released maestro_lock; put it
+                # back into the held-by-maestro state
+                self.context_factory.maestro_lock.acquire(False)
 
     def register_mc_object(self, obj) -> tuple:
         """Assign a replay-stable mc_key AND remember the object so
