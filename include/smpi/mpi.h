@@ -524,6 +524,33 @@ int MPI_Group_excl(MPI_Group group, int n, const int* ranks,
                    MPI_Group* newgroup);
 int MPI_Group_range_incl(MPI_Group group, int n, int ranges[][3],
                          MPI_Group* newgroup);
+int MPI_Group_range_excl(MPI_Group group, int n, int ranges[][3],
+                         MPI_Group* newgroup);
+int MPI_Group_union(MPI_Group group1, MPI_Group group2,
+                    MPI_Group* newgroup);
+int MPI_Group_intersection(MPI_Group group1, MPI_Group group2,
+                           MPI_Group* newgroup);
+int MPI_Group_difference(MPI_Group group1, MPI_Group group2,
+                         MPI_Group* newgroup);
+int MPI_Group_translate_ranks(MPI_Group group1, int n, const int* ranks1,
+                              MPI_Group group2, int* ranks2);
+int MPI_Group_compare(MPI_Group group1, MPI_Group group2, int* result);
+#define MPI_IDENT 0
+#define MPI_CONGRUENT 1
+#define MPI_SIMILAR 2
+#define MPI_UNEQUAL 3
+#define MPI_COMM_TYPE_SHARED 1
+int MPI_Comm_create_group(MPI_Comm comm, MPI_Group group, int tag,
+                          MPI_Comm* newcomm);
+int MPI_Comm_idup(MPI_Comm comm, MPI_Comm* newcomm,
+                  MPI_Request* request);
+int MPI_Comm_dup_with_info(MPI_Comm comm, MPI_Info info,
+                           MPI_Comm* newcomm);
+int MPI_Comm_set_info(MPI_Comm comm, MPI_Info info);
+int MPI_Comm_get_info(MPI_Comm comm, MPI_Info* info);
+int MPI_Comm_split_type(MPI_Comm comm, int split_type, int key,
+                        MPI_Info info, MPI_Comm* newcomm);
+int MPI_Comm_compare(MPI_Comm comm1, MPI_Comm comm2, int* result);
 int MPI_Info_create(MPI_Info* info);
 int MPI_Info_set(MPI_Info info, const char* key, const char* value);
 int MPI_Info_free(MPI_Info* info);

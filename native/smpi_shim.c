@@ -165,6 +165,14 @@ enum {
   SMPI_OP_GRAPHDIMS_GET,
   SMPI_OP_GRAPH_GET,
   SMPI_OP_REQUEST_GET_STATUS,
+  SMPI_OP_COMM_CREATE_GROUP,  /* 135 */
+  SMPI_OP_COMM_IDUP,
+  SMPI_OP_COMM_SET_NAME,
+  SMPI_OP_COMM_SPLIT_TYPE,
+  SMPI_OP_GROUP_SETOP,        /* mode: 0 union 1 inter 2 diff 3 range_excl */
+  SMPI_OP_GROUP_TRANSLATE,    /* 140 */
+  SMPI_OP_GROUP_COMPARE,
+  SMPI_OP_COMM_COMPARE,
 };
 
 /* sub-modes for FILE_READ / FILE_WRITE */
@@ -680,8 +688,64 @@ int MPI_Intercomm_create(MPI_Comm local_comm, int local_leader,
   return MPI_ERR_INTERN; /* not implemented */
 }
 int MPI_Comm_set_name(MPI_Comm comm, const char* name) {
-  (void)comm; (void)name;
+  CALL(SMPI_OP_COMM_SET_NAME, A(comm), A(name));
+}
+int MPI_Comm_create_group(MPI_Comm comm, MPI_Group group, int tag,
+                          MPI_Comm* newcomm) {
+  (void)tag;
+  CALL(SMPI_OP_COMM_CREATE_GROUP, A(comm), A(group), A(newcomm));
+}
+int MPI_Comm_idup(MPI_Comm comm, MPI_Comm* newcomm,
+                  MPI_Request* request) {
+  CALL(SMPI_OP_COMM_IDUP, A(comm), A(newcomm), A(request));
+}
+int MPI_Comm_dup_with_info(MPI_Comm comm, MPI_Info info,
+                           MPI_Comm* newcomm) {
+  (void)info;
+  return MPI_Comm_dup(comm, newcomm);
+}
+int MPI_Comm_set_info(MPI_Comm comm, MPI_Info info) {
+  (void)comm; (void)info;
   return MPI_SUCCESS;
+}
+int MPI_Comm_get_info(MPI_Comm comm, MPI_Info* info) {
+  (void)comm;
+  *info = MPI_INFO_NULL;
+  return MPI_SUCCESS;
+}
+int MPI_Comm_split_type(MPI_Comm comm, int split_type, int key,
+                        MPI_Info info, MPI_Comm* newcomm) {
+  (void)info;
+  CALL(SMPI_OP_COMM_SPLIT_TYPE, A(comm), A(split_type), A(key),
+       A(newcomm));
+}
+int MPI_Comm_compare(MPI_Comm comm1, MPI_Comm comm2, int* result) {
+  CALL(SMPI_OP_COMM_COMPARE, A(comm1), A(comm2), A(result));
+}
+int MPI_Group_union(MPI_Group group1, MPI_Group group2,
+                    MPI_Group* newgroup) {
+  CALL(SMPI_OP_GROUP_SETOP, A(group1), A(group2), A(newgroup), 0, 0, 0);
+}
+int MPI_Group_intersection(MPI_Group group1, MPI_Group group2,
+                           MPI_Group* newgroup) {
+  CALL(SMPI_OP_GROUP_SETOP, A(group1), A(group2), A(newgroup), 1, 0, 0);
+}
+int MPI_Group_difference(MPI_Group group1, MPI_Group group2,
+                         MPI_Group* newgroup) {
+  CALL(SMPI_OP_GROUP_SETOP, A(group1), A(group2), A(newgroup), 2, 0, 0);
+}
+int MPI_Group_range_excl(MPI_Group group, int n, int ranges[][3],
+                         MPI_Group* newgroup) {
+  CALL(SMPI_OP_GROUP_SETOP, A(group), 0, A(newgroup), 3, A(n),
+       A(ranges));
+}
+int MPI_Group_translate_ranks(MPI_Group group1, int n, const int* ranks1,
+                              MPI_Group group2, int* ranks2) {
+  CALL(SMPI_OP_GROUP_TRANSLATE, A(group1), A(n), A(ranks1), A(group2),
+       A(ranks2));
+}
+int MPI_Group_compare(MPI_Group group1, MPI_Group group2, int* result) {
+  CALL(SMPI_OP_GROUP_COMPARE, A(group1), A(group2), A(result));
 }
 static int smpi_info_counter = 1;
 int MPI_Info_create(MPI_Info* info) {

@@ -190,6 +190,7 @@ class _CRankCtx:
         self.next_win = 1
         self.cart_topos: Dict[int, object] = {}
         self.graph_topos: Dict[int, object] = {}
+        self.comm_names: Dict[int, str] = {}
         self.bench_t0: Optional[float] = None
         self.initialized = False
         self.finalized = False
@@ -1722,9 +1723,12 @@ def _h_comm_get_name(ctx, a):
     if comm is None:
         return MPI_ERR_COMM
     h = int(a[0])
-    name = ("MPI_COMM_WORLD" if h == COMM_WORLD
-            else "MPI_COMM_SELF" if h == COMM_SELF
-            else f"MPI_COMM_{h}").encode()
+    name = ctx.comm_names.get(h)
+    if name is None:
+        name = ("MPI_COMM_WORLD" if h == COMM_WORLD
+                else "MPI_COMM_SELF" if h == COMM_SELF
+                else f"MPI_COMM_{h}")
+    name = name.encode()
     ctypes.memmove(int(a[1]), name + b"\0", len(name) + 1)
     _write_i32(a[2], len(name))
     return MPI_SUCCESS
@@ -2602,6 +2606,121 @@ def _h_iscan(ctx, a, exclusive=False):
     return _nbc_handle(ctx, req, req_addr, post)
 
 
+def _h_comm_create_group(ctx, a):
+    """Collective only over the GROUP's members (MPI-3
+    MPI_Comm_create_group): our comm ids are deterministic, so plain
+    create serves."""
+    return _h_comm_create(ctx, a)
+
+
+def _h_comm_idup(ctx, a):
+    from .nbc import NbcRequest
+    comm = _comm_of(ctx, a[0])
+    if comm is None:
+        return MPI_ERR_COMM
+    _write_i32(a[1], _new_comm_handle(ctx, comm.dup()))
+    # the dup is immediate here; hand back an already-complete request
+    h = _new_req_handle(ctx, _CReq(NbcRequest([], [], lambda _: None),
+                                   0, None, "nbc"))
+    _write_i32(a[2], h)
+    return MPI_SUCCESS
+
+
+def _h_comm_set_name(ctx, a):
+    comm = _comm_of(ctx, a[0])
+    if comm is None:
+        return MPI_ERR_COMM
+    ctx.comm_names[int(a[0])] = ctypes.string_at(int(a[1])).decode()
+    return MPI_SUCCESS
+
+
+def _h_comm_split_type(ctx, a):
+    ch, split_type, key, out_addr = a[:4]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    if int(split_type) == C_UNDEFINED:
+        new = comm.split(-1, int(key))
+    else:
+        # MPI_COMM_TYPE_SHARED: ranks sharing a host
+        me_host = runtime.this_rank_state().host
+        hosts = comm.allgather(me_host.name)
+        color = sorted(set(hosts)).index(me_host.name)
+        new = comm.split(color, int(key))
+    _write_i32(out_addr, _new_comm_handle(ctx, new))
+    return MPI_SUCCESS
+
+
+def _h_comm_compare(ctx, a):
+    c1, c2 = _comm_of(ctx, a[0]), _comm_of(ctx, a[1])
+    if c1 is None or c2 is None:
+        return MPI_ERR_COMM
+    if int(a[0]) == int(a[1]):
+        result = 0                      # MPI_IDENT
+    elif c1.group.world_ranks == c2.group.world_ranks:
+        result = 1                      # MPI_CONGRUENT
+    elif set(c1.group.world_ranks) == set(c2.group.world_ranks):
+        result = 2                      # MPI_SIMILAR
+    else:
+        result = 3                      # MPI_UNEQUAL
+    _write_i32(a[2], result)
+    return MPI_SUCCESS
+
+
+def _h_group_setop(ctx, a):
+    g1 = ctx.groups.get(int(a[0]))
+    mode = int(a[3])
+    if g1 is None:
+        return MPI_ERR_ARG
+    if mode == 3:                       # range_excl
+        n = int(a[4])
+        flat = _read_i32s(a[5], 3 * n)
+        ranges = [tuple(flat[3 * i:3 * i + 3]) for i in range(n)]
+        keep = set()
+        for first, last, stride in ranges:
+            step = stride if stride else 1
+            keep.update(range(first, last + (1 if step > 0 else -1),
+                              step))
+        new = g1.excl(sorted(keep))
+    else:
+        g2 = ctx.groups.get(int(a[1]))
+        if g2 is None:
+            return MPI_ERR_ARG
+        new = (g1.union(g2) if mode == 0
+               else g1.intersection(g2) if mode == 1
+               else g1.difference(g2))
+    _write_i32(a[2], _new_group_handle(ctx, new))
+    return MPI_SUCCESS
+
+
+def _h_group_translate(ctx, a):
+    g1 = ctx.groups.get(int(a[0]))
+    g2 = ctx.groups.get(int(a[3]))
+    if g1 is None or g2 is None:
+        return MPI_ERR_ARG
+    n = int(a[1])
+    ranks = _read_i32s(a[2], n)
+    out = g1.translate_ranks(ranks, g2)
+    for i, r in enumerate(out):
+        ctypes.cast(int(a[4]), _pi32)[i] = r
+    return MPI_SUCCESS
+
+
+def _h_group_compare(ctx, a):
+    g1 = ctx.groups.get(int(a[0]))
+    g2 = ctx.groups.get(int(a[1]))
+    if g1 is None or g2 is None:
+        return MPI_ERR_ARG
+    if g1.world_ranks == g2.world_ranks:
+        result = 0                      # MPI_IDENT
+    elif set(g1.world_ranks) == set(g2.world_ranks):
+        result = 2                      # MPI_SIMILAR
+    else:
+        result = 3                      # MPI_UNEQUAL
+    _write_i32(a[2], result)
+    return MPI_SUCCESS
+
+
 def _h_request_get_status(ctx, a):
     """Non-destructive completion query: tests the request but leaves
     the handle live (MPI_Request_get_status)."""
@@ -2668,7 +2787,10 @@ _HANDLERS = {
     125: _h_type_hvector, 126: _h_type_indexed_block, 127: _h_type_dup,
     128: _h_type_subarray, 129: _h_pack, 130: _h_graph_create,
     131: _h_graph_neighbors, 132: _h_graphdims_get, 133: _h_graph_get,
-    134: _h_request_get_status,
+    134: _h_request_get_status, 135: _h_comm_create_group,
+    136: _h_comm_idup, 137: _h_comm_set_name, 138: _h_comm_split_type,
+    139: _h_group_setop, 140: _h_group_translate,
+    141: _h_group_compare, 142: _h_comm_compare,
 }
 
 #: ops that are pure local queries — no bench end/begin cycle needed
@@ -2676,7 +2798,8 @@ _HANDLERS = {
 #: handlers is what prices the sampled loop body)
 _LOCAL_OPS = {3, 4, 24, 41, 42, 45, 46, 48, 50, 51, 63, 64, 66, 69,
               70, 72, 73, 74, 75, 76, 77, 78, 79, 83, 84, 85, 94, 96,
-              97, 98, 99, 101, 102, 103, 129, 130, 131, 132, 133}
+              97, 98, 99, 101, 102, 103, 129, 130, 131, 132, 133,
+              134, 135, 136, 137, 139, 140, 141, 142}
 
 
 def _dispatch_py(opcode: int, args) -> int:
