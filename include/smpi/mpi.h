@@ -518,6 +518,8 @@ int MPI_Intercomm_create(MPI_Comm local_comm, int local_leader,
                          MPI_Comm peer_comm, int remote_leader, int tag,
                          MPI_Comm* newintercomm);
 int MPI_Comm_create(MPI_Comm comm, MPI_Group group, MPI_Comm* newcomm);
+int MPI_Intercomm_merge(MPI_Comm intercomm, int high,
+                        MPI_Comm* newintracomm);
 int MPI_Group_incl(MPI_Group group, int n, const int* ranks,
                    MPI_Group* newgroup);
 int MPI_Group_excl(MPI_Group group, int n, const int* ranks,

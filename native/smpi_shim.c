@@ -173,6 +173,10 @@ enum {
   SMPI_OP_GROUP_TRANSLATE,    /* 140 */
   SMPI_OP_GROUP_COMPARE,
   SMPI_OP_COMM_COMPARE,
+  SMPI_OP_INTERCOMM_CREATE,
+  SMPI_OP_INTERCOMM_MERGE,
+  SMPI_OP_COMM_REMOTE_SIZE,   /* 145 */
+  SMPI_OP_COMM_TEST_INTER,
 };
 
 /* sub-modes for FILE_READ / FILE_WRITE */
@@ -670,22 +674,21 @@ int MPI_Error_class(int errorcode, int* errorclass) {
   return MPI_SUCCESS;
 }
 int MPI_Comm_test_inter(MPI_Comm comm, int* flag) {
-  (void)comm;
-  *flag = 0;    /* intercommunicators are not implemented */
-  return MPI_SUCCESS;
+  CALL(SMPI_OP_COMM_TEST_INTER, A(comm), A(flag));
 }
 int MPI_Comm_remote_size(MPI_Comm comm, int* size) {
-  (void)comm;
-  (void)size;
-  return MPI_ERR_COMM;   /* no intercommunicators */
+  CALL(SMPI_OP_COMM_REMOTE_SIZE, A(comm), A(size));
 }
 int MPI_Intercomm_create(MPI_Comm local_comm, int local_leader,
                          MPI_Comm peer_comm, int remote_leader, int tag,
                          MPI_Comm* newintercomm) {
-  (void)local_comm; (void)local_leader; (void)peer_comm;
-  (void)remote_leader; (void)tag;
-  *newintercomm = MPI_COMM_NULL;
-  return MPI_ERR_INTERN; /* not implemented */
+  CALL(SMPI_OP_INTERCOMM_CREATE, A(local_comm), A(local_leader),
+       A(peer_comm), A(remote_leader), A(tag), A(newintercomm));
+}
+int MPI_Intercomm_merge(MPI_Comm intercomm, int high,
+                        MPI_Comm* newintracomm) {
+  CALL(SMPI_OP_INTERCOMM_MERGE, A(intercomm), A(high),
+       A(newintracomm));
 }
 int MPI_Comm_set_name(MPI_Comm comm, const char* name) {
   CALL(SMPI_OP_COMM_SET_NAME, A(comm), A(name));

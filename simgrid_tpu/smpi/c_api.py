@@ -1124,6 +1124,14 @@ def _h_bcast(ctx, a):
     if comm is None:
         return MPI_ERR_COMM
     dt = _dt(ctx, dth)
+    if _is_inter(comm):
+        # MPI_ROOT on the origin side; remote rank on the leaf side
+        if root == C_ROOT:
+            comm.bcast(_arr_in(buf, count, dt), root)
+        elif root != C_PROC_NULL:
+            out = comm.bcast(None, root)
+            _arr_out(buf, out, int(count) * dt.size_, dt=dt)
+        return MPI_SUCCESS
     me = comm.rank()
     obj = _arr_in(buf, count, dt) if me == root else None
     out = comm.bcast(obj, root)
@@ -1149,6 +1157,13 @@ def _h_reduce(ctx, a):
     arr, rbuf, count, dt = _reduce_args(ctx, a)
     op = _op_of(ctx, a[4], dt, dt_handle=a[3], count=count)
     root = int(a[5])
+    if _is_inter(comm):
+        if root == C_ROOT:
+            res = comm.reduce(None, op, root)
+            _arr_out(rbuf, np.asarray(res), count * dt.size_, dt=dt)
+        elif root != C_PROC_NULL:
+            comm.reduce(arr, op, root)
+        return MPI_SUCCESS
     res = comm.reduce(arr, op, root)
     if comm.rank() == root:
         _arr_out(rbuf, np.asarray(res).astype(arr.dtype, copy=False),
@@ -1174,6 +1189,17 @@ def _h_gather(ctx, a):
     if comm is None:
         return MPI_ERR_COMM
     me, root = comm.rank(), int(root)
+    if _is_inter(comm):
+        if root == C_ROOT:
+            rdt = _dt(ctx, rtype)
+            res = comm.gather(None, root)
+            stride = int(rcount) * rdt.extent_
+            for i, obj in enumerate(res):
+                _arr_out(int(rbuf) + i * stride, obj,
+                         int(rcount) * rdt.size_, dt=rdt)
+        elif root != C_PROC_NULL:
+            comm.gather(_arr_in(sbuf, scount, _dt(ctx, stype)), root)
+        return MPI_SUCCESS
     rdt = _dt(ctx, rtype) if me == root else None
     if int(sbuf) == C_IN_PLACE and me == root:
         slice_addr = int(rbuf) + me * int(rcount) * rdt.extent_
@@ -1195,6 +1221,19 @@ def _h_gatherv(ctx, a):
     if comm is None:
         return MPI_ERR_COMM
     me, root, n = comm.rank(), int(root), comm.size()
+    if _is_inter(comm):
+        if root == C_ROOT:
+            rdt = _dt(ctx, rtype)
+            n = comm.remote_size()
+            counts = _read_i32s(rcounts, n)
+            offs = _read_i32s(displs, n)
+            res = comm.gatherv(None, root)
+            for i, obj in enumerate(res):
+                _arr_out(int(rbuf) + offs[i] * rdt.extent_, obj,
+                         counts[i] * rdt.size_, dt=rdt)
+        elif root != C_PROC_NULL:
+            comm.gatherv(_arr_in(sbuf, scount, _dt(ctx, stype)), root)
+        return MPI_SUCCESS
     if int(sbuf) == C_IN_PLACE and me == root:
         # MPI-2: root's contribution already sits at rbuf + displs[me]
         rdt = _dt(ctx, rtype)
@@ -1239,7 +1278,7 @@ def _h_allgatherv(ctx, a):
     comm = _comm_of(ctx, ch)
     if comm is None:
         return MPI_ERR_COMM
-    n = comm.size()
+    n = comm.remote_size() if _is_inter(comm) else comm.size()
     rdt = _dt(ctx, rtype)
     counts = _read_i32s(rcounts, n)
     offs = _read_i32s(displs, n)
@@ -1262,6 +1301,18 @@ def _h_scatter(ctx, a):
     if comm is None:
         return MPI_ERR_COMM
     me, root, n = comm.rank(), int(root), comm.size()
+    if _is_inter(comm):
+        if root == C_ROOT:
+            sdt = _dt(ctx, stype)
+            stride = int(scount) * sdt.extent_
+            sendobjs = [_arr_in(int(sbuf) + i * stride, scount, sdt)
+                        for i in range(comm.remote_size())]
+            comm.scatter(sendobjs, root)
+        elif root != C_PROC_NULL:
+            res = comm.scatter(None, root)
+            rdt = _dt(ctx, rtype)
+            _arr_out(rbuf, res, int(rcount) * rdt.size_, dt=rdt)
+        return MPI_SUCCESS
     sendobjs = None
     if me == root:
         sdt = _dt(ctx, stype)
@@ -1281,6 +1332,20 @@ def _h_scatterv(ctx, a):
     if comm is None:
         return MPI_ERR_COMM
     me, root, n = comm.rank(), int(root), comm.size()
+    if _is_inter(comm):
+        if root == C_ROOT:
+            sdt = _dt(ctx, stype)
+            n = comm.remote_size()
+            counts = _read_i32s(scounts, n)
+            offs = _read_i32s(displs, n)
+            sendobjs = [_arr_in(int(sbuf) + offs[i] * sdt.extent_,
+                                counts[i], sdt) for i in range(n)]
+            comm.scatterv(sendobjs, root)
+        elif root != C_PROC_NULL:
+            res = comm.scatterv(None, root)
+            rdt = _dt(ctx, rtype)
+            _arr_out(rbuf, res, int(rcount) * rdt.size_, dt=rdt)
+        return MPI_SUCCESS
     sendobjs = None
     if me == root:
         sdt = _dt(ctx, stype)
@@ -1300,7 +1365,7 @@ def _h_alltoall(ctx, a):
     comm = _comm_of(ctx, ch)
     if comm is None:
         return MPI_ERR_COMM
-    n = comm.size()
+    n = comm.remote_size() if _is_inter(comm) else comm.size()
     rdt = _dt(ctx, rtype)
     if int(sbuf) == C_IN_PLACE:
         # MPI-2.2: outgoing data is taken from recvbuf
@@ -1325,7 +1390,7 @@ def _h_alltoallv(ctx, a):
     comm = _comm_of(ctx, ch)
     if comm is None:
         return MPI_ERR_COMM
-    n = comm.size()
+    n = comm.remote_size() if _is_inter(comm) else comm.size()
     rdt = _dt(ctx, rtype)
     rc = _read_i32s(rcounts, n)
     ro = _read_i32s(rdispls, n)
@@ -2407,7 +2472,7 @@ def _h_alltoallw(ctx, a):
     comm = _comm_of(ctx, ch)
     if comm is None:
         return MPI_ERR_COMM
-    n = comm.size()
+    n = comm.remote_size() if _is_inter(comm) else comm.size()
     sc = _read_i32s(scounts, n)
     so = _read_i32s(sdispls, n)       # BYTE displacements in alltoallw
     st = _read_i32s(stypes, n)
@@ -2721,6 +2786,48 @@ def _h_group_compare(ctx, a):
     return MPI_SUCCESS
 
 
+def _is_inter(comm) -> bool:
+    return getattr(comm, "remote_group", None) is not None
+
+
+C_ROOT = -3
+
+
+def _h_intercomm_create(ctx, a):
+    from .intercomm import intercomm_create
+    local = _comm_of(ctx, a[0])
+    peer = _comm_of(ctx, a[2])
+    if local is None:
+        return MPI_ERR_COMM
+    ic = intercomm_create(local, int(a[1]), peer, int(a[3]), int(a[4]))
+    _write_i32(a[5], _new_comm_handle(ctx, ic))
+    return MPI_SUCCESS
+
+
+def _h_intercomm_merge(ctx, a):
+    comm = _comm_of(ctx, a[0])
+    if comm is None or not _is_inter(comm):
+        return MPI_ERR_COMM
+    _write_i32(a[2], _new_comm_handle(ctx, comm.merge(bool(int(a[1])))))
+    return MPI_SUCCESS
+
+
+def _h_comm_remote_size(ctx, a):
+    comm = _comm_of(ctx, a[0])
+    if comm is None or not _is_inter(comm):
+        return MPI_ERR_COMM
+    _write_i32(a[1], comm.remote_size())
+    return MPI_SUCCESS
+
+
+def _h_comm_test_inter(ctx, a):
+    comm = _comm_of(ctx, a[0])
+    if comm is None:
+        return MPI_ERR_COMM
+    _write_i32(a[1], 1 if _is_inter(comm) else 0)
+    return MPI_SUCCESS
+
+
 def _h_request_get_status(ctx, a):
     """Non-destructive completion query: tests the request but leaves
     the handle live (MPI_Request_get_status)."""
@@ -2791,6 +2898,8 @@ _HANDLERS = {
     136: _h_comm_idup, 137: _h_comm_set_name, 138: _h_comm_split_type,
     139: _h_group_setop, 140: _h_group_translate,
     141: _h_group_compare, 142: _h_comm_compare,
+    143: _h_intercomm_create, 144: _h_intercomm_merge,
+    145: _h_comm_remote_size, 146: _h_comm_test_inter,
 }
 
 #: ops that are pure local queries — no bench end/begin cycle needed

@@ -42,6 +42,13 @@ class Comm:
         return self.group.size()
 
     def world_rank_of(self, group_rank: int) -> int:
+        """P2P PEER resolution (InterComm points this at the remote
+        group)."""
+        return self.group.actor(group_rank)
+
+    def recv_world_rank_of(self, group_rank: int) -> int:
+        """SELF resolution — always the local group: a receive posts
+        into the receiver's own mailbox even on an intercommunicator."""
         return self.group.actor(group_rank)
 
     def get_group(self) -> Group:

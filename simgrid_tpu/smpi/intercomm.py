@@ -1,0 +1,216 @@
+"""Intercommunicators (reference src/smpi/mpi/smpi_comm.cpp intercomm
+paths + smpi_intercomm coll semantics).
+
+An InterComm pairs a LOCAL group with a REMOTE group: point-to-point
+ranks address the remote group, and rooted collectives move data
+between the two sides (MPI_ROOT / MPI_PROC_NULL on the origin side).
+Communicator ids are canonical functions of both groups + the creation
+tag, so the two sides build matching ids without extra traffic.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .comm import Comm
+from .group import Group
+from .op import MPI_SUM, Op
+
+MPI_ROOT = -3
+MPI_PROC_NULL = -2
+
+TAG_IC_CREATE = -130
+TAG_IC_COLL = -131
+
+
+def _canon(a: List[int], b: List[int]):
+    """Order the two groups canonically so both sides derive the SAME
+    communicator id."""
+    return (tuple(a), tuple(b)) if min(a) <= min(b) else (tuple(b),
+                                                          tuple(a))
+
+
+class InterComm(Comm):
+    """A communicator whose peers live in the remote group."""
+
+    def __init__(self, local_group: Group, remote_group: Group, id):
+        super().__init__(local_group, id)
+        self.remote_group = remote_group
+        #: intra-communicator over the local side, for the local phases
+        #: of intercomm collectives (disjoint member sets cannot
+        #: cross-match even with related ids — matching is per-rank
+        #: mailbox + comm id)
+        self.local_intra = Comm(
+            local_group,
+            id=("icl", tuple(local_group.world_ranks), id))
+
+    def is_inter(self) -> bool:
+        return True
+
+    def remote_size(self) -> int:
+        return self.remote_group.size()
+
+    def world_rank_of(self, group_rank: int) -> int:
+        """P2P targets address the REMOTE group."""
+        return self.remote_group.actor(group_rank)
+
+    # -- intercommunicator collectives ---------------------------------
+    # (leader = local rank 0 on each side; data crosses between the
+    # leaders, local phases ride the local intracomm)
+
+    def barrier(self) -> None:
+        me = self.rank()
+        self.local_intra.barrier()
+        if me == 0:
+            sreq = self.isend(0, 0, TAG_IC_COLL)   # token exchange
+            self.recv(0, TAG_IC_COLL)
+            sreq.wait()
+        self.local_intra.barrier()
+
+    def bcast(self, obj, root: int = 0):
+        me = self.rank()
+        if root == MPI_PROC_NULL:
+            return None
+        if root == MPI_ROOT:
+            self.send(obj, 0, TAG_IC_COLL)      # to remote leader
+            return obj
+        # leaf side: local leader receives from the remote root rank
+        if me == 0:
+            obj = self.recv(root, TAG_IC_COLL)
+        return self.local_intra.bcast(obj, 0)
+
+    def reduce(self, sendobj, op: Op = MPI_SUM, root: int = 0):
+        me = self.rank()
+        if root == MPI_PROC_NULL:
+            return None
+        if root == MPI_ROOT:
+            return self.recv(0, TAG_IC_COLL)    # combined remote data
+        combined = self.local_intra.reduce(sendobj, op, 0)
+        if me == 0:
+            self.send(combined, root, TAG_IC_COLL)
+        return None
+
+    def allreduce(self, sendobj, op: Op = MPI_SUM):
+        """Each side receives the reduction of the OTHER side's data
+        (MPI-2 intercomm allreduce semantics)."""
+        me = self.rank()
+        combined = self.local_intra.reduce(sendobj, op, 0)
+        if me == 0:
+            # isend+recv: two leaders exchanging large payloads must
+            # not both block in rendezvous sends
+            sreq = self.isend(combined, 0, TAG_IC_COLL)
+            remote = self.recv(0, TAG_IC_COLL)
+            sreq.wait()
+        else:
+            remote = None
+        return self.local_intra.bcast(remote, 0)
+
+    def gather(self, sendobj, root: int = 0):
+        me = self.rank()
+        if root == MPI_PROC_NULL:
+            return None
+        if root == MPI_ROOT:
+            return self.recv(0, TAG_IC_COLL)    # remote side's vector
+        parts = self.local_intra.gather(sendobj, 0)
+        if me == 0:
+            self.send(parts, root, TAG_IC_COLL)
+        return None
+
+    def scatter(self, sendobjs, root: int = 0):
+        me = self.rank()
+        if root == MPI_PROC_NULL:
+            return None
+        if root == MPI_ROOT:
+            self.send(list(sendobjs), 0, TAG_IC_COLL)
+            return None
+        if me == 0:
+            sendobjs = self.recv(root, TAG_IC_COLL)
+        else:
+            sendobjs = None
+        return self.local_intra.scatter(sendobjs, 0)
+
+    def allgather(self, sendobj):
+        me = self.rank()
+        mine = self.local_intra.gather(sendobj, 0)
+        if me == 0:
+            sreq = self.isend(mine, 0, TAG_IC_COLL)
+            remote = self.recv(0, TAG_IC_COLL)
+            sreq.wait()
+        else:
+            remote = None
+        return self.local_intra.bcast(remote, 0)
+
+    def alltoall(self, sendobjs):
+        """Rank i sends sendobjs[j] to remote rank j; receives one
+        payload from every remote rank."""
+        reqs = [self.isend(sendobjs[j], j, TAG_IC_COLL)
+                for j in range(self.remote_size())]
+        out = [self.recv(src, TAG_IC_COLL)
+               for src in range(self.remote_size())]
+        for r in reqs:
+            r.wait()
+        return out
+
+    def merge(self, high: bool) -> Comm:
+        """MPI_Intercomm_merge: one intracomm over both groups; the
+        low side orders first (ties broken by smaller leader world
+        rank, like the reference). Intercomm allreduce returns the
+        OTHER side's reduction, which is exactly the remote high
+        count."""
+        remote_highs = self.allreduce(1 if high else 0, MPI_SUM)
+        my_high, remote_high = bool(high), int(remote_highs) > 0
+        local = list(self.group.world_ranks)
+        remote = list(self.remote_group.world_ranks)
+        if my_high == remote_high:
+            first = local if min(local) < min(remote) else remote
+        else:
+            first = remote if my_high else local
+        second = remote if first is local else local
+        cid = ("merged",) + _canon(local, remote)
+        return Comm(Group(first + second), id=cid)
+
+
+def intercomm_create(local_comm: Comm, local_leader: int,
+                     peer_comm: Optional[Comm], remote_leader: int,
+                     tag: int) -> InterComm:
+    """MPI_Intercomm_create: the two leaders exchange their group
+    lists over peer_comm, then broadcast them within their local
+    communicators (smpi_comm.cpp / standard algorithm)."""
+    me = local_comm.rank()
+    local_ranks = list(local_comm.group.world_ranks)
+    if me == local_leader:
+        assert peer_comm is not None, \
+            "the leaders must share the peer communicator"
+        rreq = peer_comm.irecv(remote_leader, tag)
+        peer_comm.isend(local_ranks, remote_leader, tag).wait()
+        remote_ranks = rreq.wait()
+        local_comm.bcast(remote_ranks, local_leader)
+    else:
+        remote_ranks = local_comm.bcast(None, local_leader)
+    cid = ("inter",) + _canon(local_ranks, list(remote_ranks)) + (tag,)
+    return InterComm(local_comm.group, Group(list(remote_ranks)), cid)
+
+
+# -- v-variants: payloads carry their own sizes in this object model,
+# so the base intercomm patterns serve directly
+def _alias_v(cls):
+    cls.allgatherv = cls.allgather
+    cls.alltoallv = cls.alltoall
+    cls.gatherv = cls.gather
+    cls.scatterv = cls.scatter
+    return cls
+
+
+_alias_v(InterComm)
+
+
+def _ic_reduce_scatter(self, sendobjs, op: Op = MPI_SUM):
+    """Intercomm reduce_scatter: every rank gets its segment of the
+    reduction of the REMOTE side's data = intercomm allreduce of the
+    full vector + local segmentation."""
+    full = list(sendobjs)
+    remote_combined = InterComm.allreduce(self, full, op)
+    return remote_combined[self.rank()]
+
+
+InterComm.reduce_scatter = _ic_reduce_scatter

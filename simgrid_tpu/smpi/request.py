@@ -131,7 +131,7 @@ class Request:
 
         if self.kind == "recv":
             peer_state = runtime.state_of_world_rank(
-                self.comm.world_rank_of(self.dst))
+                self.comm.recv_world_rank_of(self.dst))
             if thresh == 0:
                 mbox = peer_state.mailbox
             elif self.size < thresh:
