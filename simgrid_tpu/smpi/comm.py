@@ -27,10 +27,17 @@ class Comm:
         self._cc_seq: Dict[int, int] = {}
 
     def _next_cc_id(self, discriminator):
+        """Sequence PER (rank, discriminator): creations that only a
+        subset of ranks participates in (MPI_Comm_create_group, splits
+        by color) must not desynchronize the ids of later unrelated
+        creations on the other ranks (found by mpich3
+        comm_idup_comm, which interleaves create_group on the even
+        ranks with collective dups)."""
         from . import runtime
         me = runtime.this_rank()
-        seq = self._cc_seq.get(me, 0)
-        self._cc_seq[me] = seq + 1
+        key = (me, discriminator)
+        seq = self._cc_seq.get(key, 0)
+        self._cc_seq[key] = seq + 1
         return (self.id, seq, discriminator)
 
     # -- introspection -----------------------------------------------------
