@@ -245,22 +245,29 @@ def main() -> None:
                                          seed=2))]
     speedup = None
     speedup_class = None
+    host_slow = False
     for name, params in classes:
-        host = run_stage("host", timeout=600, errors=errors, **params)
-        if host is None:
-            break
+        # Baseline = the native C++ solver (the honest stand-in for the
+        # reference's maxmin.cpp); the Python host solver is measured as
+        # a secondary column and is only the fallback denominator.
         native = run_stage("native", timeout=600, errors=errors, **params)
+        host = None
+        if not host_slow:
+            host = run_stage("host", timeout=600, errors=errors, **params)
+            if host is None or host["ms"] > 6_000:
+                host_slow = True  # next class is ~100x: skip its host stage
+        if native is None and host is None:
+            break
         dev = run_stage("dev", timeout=900, errors=errors,
                         cpu=cpu_fallback, reps=5, **params)
-        detail[name] = {"host_ms": host["ms"],
+        detail[name] = {"host_ms": host["ms"] if host else "skipped",
                         "native_ms": native["ms"] if native else "failed",
                         "dev": dev if dev else "failed"}
         if dev:
             dev_ms = min(v for k, v in dev.items() if k.startswith("ms_"))
-            speedup = round(host["ms"] / dev_ms, 2) if dev_ms > 0 else None
-            speedup_class = name
-        if host["ms"] > 6_000:
-            break  # huge projects ~100x big: would exceed the 600s stage
+            base_ms = native["ms"] if native else host["ms"]
+            speedup = round(base_ms / dev_ms, 2) if dev_ms > 0 else None
+            speedup_class = name + ("" if native else " (vs host python)")
 
     value = None
     if dev100k:
@@ -268,8 +275,8 @@ def main() -> None:
 
     result = {
         "metric": (f"LMM solve latency @{big100k['n_v']} flows on "
-                   f"{detail['platform']} (vs_baseline: speedup over exact "
-                   f"host list solver, {speedup_class or 'n/a'} class)"),
+                   f"{detail['platform']} (vs_baseline: speedup over native "
+                   f"C++ maxmin solver, {speedup_class or 'n/a'} class)"),
         "value": value,
         "unit": "ms",
         "vs_baseline": speedup,

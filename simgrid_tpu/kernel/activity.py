@@ -218,6 +218,9 @@ class CommImpl(ActivityImpl):
             simcall = self.simcalls.popleft()
             if simcall.call is None:
                 continue  # issuer got killed
+            # simcall_answer() resets simcall.call; keep the original
+            # call name for the exception-index bookkeeping below.
+            call = simcall.call
             self.waitany_cleanup(simcall)
             if simcall.call == "comm_waitany":
                 comms = simcall.payload["comms"]
@@ -271,9 +274,11 @@ class CommImpl(ActivityImpl):
                 issuer.simcall_answer()
 
             if (issuer.exception is not None
-                    and simcall.call in ("comm_waitany", "comm_testany",
-                                         "activity_waitany")):
-                comms = simcall.payload["comms"]
+                    and call in ("comm_waitany", "comm_testany",
+                                 "activity_waitany")):
+                comms = (simcall.payload["activities"]
+                         if call == "activity_waitany"
+                         else simcall.payload["comms"])
                 issuer.exception.value = comms.index(self) if self in comms else -1
 
             issuer.waiting_synchro = None
@@ -421,6 +426,7 @@ class ExecImpl(ActivityImpl):
             simcall = self.simcalls.popleft()
             if simcall.call is None:
                 continue
+            call = simcall.call
             self.waitany_cleanup(simcall)
             if simcall.call == "execution_waitany":
                 execs = simcall.payload["execs"]
@@ -450,6 +456,13 @@ class ExecImpl(ActivityImpl):
                 issuer.exception = TimeoutException("Timeouted")
             else:
                 raise AssertionError(f"Unexpected exec state {self.state}")
+            if (issuer.exception is not None
+                    and call in ("execution_waitany", "activity_waitany")):
+                acts = (simcall.payload["activities"]
+                        if call == "activity_waitany"
+                        else simcall.payload["execs"])
+                issuer.exception.value = (acts.index(self)
+                                          if self in acts else -1)
             issuer.waiting_synchro = None
             issuer.simcall_answer()
 
@@ -519,10 +532,15 @@ class IoImpl(ActivityImpl):
             simcall = self.simcalls.popleft()
             if simcall.call is None:
                 continue
+            call = simcall.call
             self.waitany_cleanup(simcall)
             issuer = simcall.issuer
             if self.state == State.FAILED:
                 issuer.exception = StorageFailureException("Storage failed")
+                if call == "activity_waitany":
+                    acts = simcall.payload["activities"]
+                    issuer.exception.value = (acts.index(self)
+                                              if self in acts else -1)
             issuer.waiting_synchro = None
             issuer.simcall_answer()
 

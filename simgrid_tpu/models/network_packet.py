@@ -116,6 +116,10 @@ class NetworkPacketModel(NetworkModel):
             self._start_tx(link, time)
 
     def _start_tx(self, link: PacketLink, time: float) -> None:
+        # drop queued packets of canceled/failed flows before grabbing one
+        while (link.queue and
+               link.queue[0].flow.get_state() is not ActionState.STARTED):
+            link.queue.pop(0)
         if not link.queue:
             link.busy = False
             return
@@ -133,6 +137,8 @@ class NetworkPacketModel(NetworkModel):
 
     def _arrive(self, packet: _Packet, time: float) -> None:
         flow = packet.flow
+        if flow.get_state() is not ActionState.STARTED:
+            return  # flow canceled/failed mid-transfer: drop its packets
         packet.hop += 1
         if packet.hop < len(flow.route):
             nxt = flow.route[packet.hop]

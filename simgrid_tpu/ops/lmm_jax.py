@@ -529,16 +529,25 @@ _DEVICE_ARGS_CACHE: dict = {}
 
 
 def _device_args(kind: str, host_args, device):
+    # CONTRACT: callers must never mutate a host array in place after
+    # passing it here — the identity check below cannot see mutation.
+    # Safe today because flatten()/to_ell() always build fresh arrays.
     key = (kind, tuple(id(a) for a in host_args),
            None if device is None else str(device))
     hit = _DEVICE_ARGS_CACHE.get(key)
     if hit is not None:
         src, dev_args = hit
         if all(a is b for a, b in zip(src, host_args)):
+            # refresh LRU position so a steady hot entry survives
+            # transient keys (eviction below pops oldest-first)
+            _DEVICE_ARGS_CACHE.pop(key)
+            _DEVICE_ARGS_CACHE[key] = hit
             return dev_args
     dev_args = [jax.device_put(a, device) for a in host_args]
     if len(_DEVICE_ARGS_CACHE) >= 8:
-        _DEVICE_ARGS_CACHE.clear()
+        # evict oldest-first (dict preserves insertion order) instead of
+        # dropping the whole cache — the hot entry is usually the newest
+        _DEVICE_ARGS_CACHE.pop(next(iter(_DEVICE_ARGS_CACHE)))
     _DEVICE_ARGS_CACHE[key] = (list(host_args), dev_args)
     return dev_args
 

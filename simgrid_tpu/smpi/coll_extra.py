@@ -770,8 +770,6 @@ def alltoall_rdb(comm, sendobjs):
                     if (dst & mask) != (rank & mask)}
             if give:
                 ship[src] = give
-        nbytes = sum(_payload_bytes(v) for row in ship.values()
-                     for v in row.values())
         got = comm.sendrecv(ship, peer, peer, TAG_ALLTOALL, TAG_ALLTOALL)
         for src, row in got.items():
             working.setdefault(src, {}).update(row)

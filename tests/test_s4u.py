@@ -445,3 +445,46 @@ class TestMixedWaitAny:
         assert out["first"] == "Exec"
         assert out["left"] == 2
         assert out["empty"] is True
+
+    def test_wait_any_of_delivers_failure_with_index(self):
+        # A comm canceled by its sender while the receiver sits in a
+        # MIXED wait_any_of must deliver the failure exception carrying
+        # the comm's index — regression for the exception path reading
+        # payload["comms"] (KeyError in maestro) on activity_waitany.
+        # Canceling a RUNNING comm fails its surf action, which maps to
+        # LINK_FAILURE → NetworkFailureException (reference
+        # CommImpl::post semantics), not CancelException.
+        import os
+        import tempfile
+        s4u.Engine._reset()
+        fd, path = tempfile.mkstemp(suffix=".xml")
+        os.write(fd, STORAGE_MIX_XML.encode())
+        os.close(fd)
+        out = {}
+
+        def body():
+            ex = s4u.this_actor.exec_async(500_000_000)   # 5s, outlives comm
+            comm = s4u.Mailbox.by_name("mixfail").get_async()
+            try:
+                s4u.Activity.wait_any_of([ex, comm])
+                out["exc"] = None
+            except NetworkFailureException as exc:
+                # canceling a RUNNING comm fails its surf action →
+                # LINK_FAILURE, same as reference CommImpl::post
+                out["exc"] = ("NetworkFailureException", exc.value)
+            ex.cancel()
+
+        def peer():
+            comm = s4u.Mailbox.by_name("mixfail").put_async("x", 8_000_000)
+            s4u.this_actor.sleep_for(0.05)
+            comm.cancel()
+
+        try:
+            e = s4u.Engine(["t"])
+            e.load_platform(path)
+            s4u.Actor.create("main", e.host_by_name("hA"), body)
+            s4u.Actor.create("peer", e.host_by_name("hB"), peer)
+            e.run()
+        finally:
+            os.unlink(path)
+        assert out["exc"] == ("NetworkFailureException", 1)
