@@ -695,8 +695,7 @@ int MPI_Comm_set_name(MPI_Comm comm, const char* name) {
 }
 int MPI_Comm_create_group(MPI_Comm comm, MPI_Group group, int tag,
                           MPI_Comm* newcomm) {
-  (void)tag;
-  CALL(SMPI_OP_COMM_CREATE_GROUP, A(comm), A(group), A(newcomm));
+  CALL(SMPI_OP_COMM_CREATE_GROUP, A(comm), A(group), A(tag), A(newcomm));
 }
 int MPI_Comm_idup(MPI_Comm comm, MPI_Comm* newcomm,
                   MPI_Request* request) {

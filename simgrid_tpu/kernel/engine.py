@@ -390,6 +390,13 @@ class EngineImpl:
         for actor in self.process_list.values():
             synchro = actor.waiting_synchro
             what = type(synchro).__name__ if synchro is not None else "nothing"
-            _logger.info("Actor %d (%s@%s): waiting for %s", actor.pid,
+            detail = ""
+            mailbox = getattr(synchro, "mailbox", None)
+            if mailbox is None:
+                mailbox = getattr(synchro, "mailbox_cpy", None)
+            if mailbox is not None:
+                detail = f" on mailbox '{mailbox.name}'"
+            _logger.info("Actor %d (%s@%s): waiting for %s%s", actor.pid,
                          actor.name,
-                         actor.host.name if actor.host else "?", what)
+                         actor.host.name if actor.host else "?", what,
+                         detail)

@@ -288,7 +288,13 @@ class Model:
         engine.add_model(self)
 
     def set_maxmin_system(self, system: System) -> None:
-        self.system = system
+        # Wire the configured solver backend (lmm/backend: auto routes
+        # small live sets to the exact native C++ solver and large ones
+        # to the JAX/TPU kernel) into every kernel system.  Standalone
+        # Systems built via make_new_maxmin_system stay on the exact
+        # list solver unless the caller installs a backend explicitly.
+        from ..ops import lmm_jax
+        self.system = lmm_jax.install(system)
 
     def is_lazy(self) -> bool:
         return self.update_algorithm == UpdateAlgo.LAZY

@@ -63,7 +63,8 @@ class Element:
     """One (variable, constraint) incidence with its consumption weight."""
 
     __slots__ = ("consumption_weight", "constraint", "variable",
-                 "_enabled_hook", "_disabled_hook", "_active_hook")
+                 "_enabled_hook", "_disabled_hook", "_active_hook",
+                 "_view_eslot")
 
     def __init__(self, constraint: "Constraint", variable: "Variable",
                  consumption_weight: float):
@@ -102,12 +103,13 @@ class Constraint:
 
     __slots__ = ("bound", "id", "rank", "remaining", "usage",
                  "concurrency_limit", "concurrency_current",
-                 "concurrency_maximum", "sharing_policy",
+                 "concurrency_maximum", "_sharing_policy",
                  "enabled_element_set", "disabled_element_set",
                  "active_element_set", "_cs_hook", "_acs_hook", "_mcs_hook",
-                 "_light_idx", "jax_slot")
+                 "_light_idx", "jax_slot", "_view_slot", "_system")
 
     def __init__(self, system: "System", id_obj, bound: float):
+        self._system = system
         self.bound = bound
         self.id = id_obj
         self.rank = system._next_cnst_rank
@@ -117,7 +119,7 @@ class Constraint:
         self.concurrency_limit = config["maxmin/concurrency-limit"]
         self.concurrency_current = 0
         self.concurrency_maximum = 0
-        self.sharing_policy = SharingPolicy.SHARED
+        self._sharing_policy = SharingPolicy.SHARED
         self.enabled_element_set = IntrusiveList("_enabled_hook")
         self.disabled_element_set = IntrusiveList("_disabled_hook")
         self.active_element_set = IntrusiveList("_active_hook")
@@ -126,6 +128,20 @@ class Constraint:
         self._mcs_hook = None
         self._light_idx = -1
         self.jax_slot = -1  # stable slot in the flattened device arrays
+
+    @property
+    def sharing_policy(self) -> "SharingPolicy":
+        return self._sharing_policy
+
+    @sharing_policy.setter
+    def sharing_policy(self, policy: "SharingPolicy") -> None:
+        # models assign the policy directly after constraint_new; route
+        # the write through the ArrayView so a FATPIPE link created
+        # after the view exists is solved with max-sharing, not sum
+        self._sharing_policy = policy
+        view = self._system.array_view
+        if view is not None:
+            view.on_policy(self)
 
     # concurrency ---------------------------------------------------------
     def get_concurrency_limit(self) -> int:
@@ -169,7 +185,7 @@ class Variable:
 
     __slots__ = ("id", "rank", "cnsts", "sharing_penalty", "staged_penalty",
                  "bound", "concurrency_share", "value", "visited", "mu",
-                 "_vs_hook", "_svs_hook", "jax_slot")
+                 "_vs_hook", "_svs_hook", "jax_slot", "_view_slot")
 
     def __init__(self, system: "System", id_obj, sharing_penalty: float,
                  bound: float):
@@ -262,6 +278,10 @@ class System:
         self.modified_actions: Optional[List[Any]] = [] if selective_update else None
         self.solve_fn: Optional[Callable[["System"], None]] = None
         self.solve_count = 0
+        #: incrementally-maintained flat arrays (ops.lmm_view.ArrayView),
+        #: created lazily by the device backend; hooks below keep it in
+        #: sync with every graph mutation
+        self.array_view = None
 
     def drain_modified_actions(self) -> List[Any]:
         """Pop the actions whose rate changed in the last solve (the
@@ -277,6 +297,8 @@ class System:
     def constraint_new(self, id_obj, bound: float) -> Constraint:
         cnst = Constraint(self, id_obj, bound)
         self.constraint_set.push_back(cnst)
+        if self.array_view is not None:
+            self.array_view.on_new_cnst(cnst)
         return cnst
 
     def variable_new(self, id_obj, sharing_penalty: float,
@@ -287,6 +309,8 @@ class System:
             self.variable_set.push_front(var)
         else:
             self.variable_set.push_back(var)
+        if self.array_view is not None:
+            self.array_view.on_new_var(var)
         return var
 
     def variable_free(self, var: Variable) -> None:
@@ -301,6 +325,8 @@ class System:
 
     def _var_free(self, var: Variable) -> None:
         self.modified = True
+        if self.array_view is not None:
+            self.array_view.on_var_free(var)
         if var.cnsts:
             self.update_modified_set(var.cnsts[0].constraint)
         for elem in var.cnsts:
@@ -323,6 +349,8 @@ class System:
     def cnst_free(self, cnst: Constraint) -> None:
         self.make_constraint_inactive(cnst)
         self.constraint_set.remove(cnst)
+        if self.array_view is not None:
+            self.array_view.on_cnst_free(cnst)
 
     def expand(self, cnst: Constraint, var: Variable,
                consumption_weight: float) -> None:
@@ -353,6 +381,8 @@ class System:
             elem.increase_concurrency()
         else:
             cnst.disabled_element_set.push_back(elem)
+        if self.array_view is not None:
+            self.array_view.on_expand(elem)
 
         if not self.selective_update_active:
             self.make_constraint_active(cnst)
@@ -373,6 +403,8 @@ class System:
                 elem.consumption_weight += value
             else:
                 elem.consumption_weight = max(elem.consumption_weight, value)
+            if self.array_view is not None:
+                self.array_view.on_weight(elem)
             if var.sharing_penalty:
                 if cnst.get_concurrency_slack() < elem.get_concurrency():
                     penalty = var.sharing_penalty
@@ -439,6 +471,8 @@ class System:
     def enable_var(self, var: Variable) -> None:
         var.sharing_penalty = var.staged_penalty
         var.staged_penalty = 0
+        if self.array_view is not None:
+            self.array_view.on_penalty(var)
         self.variable_set.remove(var)
         self.variable_set.push_front(var)
         for elem in var.cnsts:
@@ -463,6 +497,8 @@ class System:
         var.sharing_penalty = 0.0
         var.staged_penalty = 0.0
         var.value = 0.0
+        if self.array_view is not None:
+            self.array_view.on_penalty(var)
 
     def on_disabled_var(self, cnst: Constraint) -> None:
         if cnst.get_concurrency_limit() < 0:
@@ -501,10 +537,14 @@ class System:
             self.disable_var(var)
         else:
             var.sharing_penalty = penalty
+            if self.array_view is not None:
+                self.array_view.on_penalty(var)
 
     def update_variable_bound(self, var: Variable, bound: float) -> None:
         self.modified = True
         var.bound = bound
+        if self.array_view is not None:
+            self.array_view.on_vbound(var)
         if var.cnsts:
             self.update_modified_set(var.cnsts[0].constraint)
 
@@ -512,6 +552,8 @@ class System:
         self.modified = True
         self.update_modified_set(cnst)
         cnst.bound = bound
+        if self.array_view is not None:
+            self.array_view.on_cbound(cnst)
 
     # -- solve -------------------------------------------------------------
     def solve(self) -> None:

@@ -139,10 +139,36 @@ def ell_from_arrays(arrays: LmmArrays) -> Optional[LmmEllArrays]:
                         arrays.n_cnst, arrays.n_var)
 
 
+def _run_rounds(cond, body, carry, max_rounds: int, unroll: bool):
+    """Dispatch the round loop either as lax.while_loop or fully
+    unrolled straight-line XLA.  Unrolling exists for backends that
+    lower gathers inside while_loop/scan to serialized dynamic-slice
+    loops (the axon TPU pathology: ~137 ms/round and 10-minute
+    compiles, while the same gathers in straight-line code compile in
+    seconds and run vectorized).  Each unrolled iteration is masked to
+    a no-op once `cond` goes false, so the result is bit-identical to
+    the while_loop truncated at max_rounds."""
+    if not unroll:
+        return lax.while_loop(cond, body, carry)
+    if max_rounds > 4096:
+        raise ValueError(
+            f"unroll=True requires a bounded max_rounds (got {max_rounds}); "
+            "compile time scales with the unroll factor — dispatch in "
+            "chunks (see solve_arrays) instead")
+    state = carry
+    for _ in range(max_rounds):
+        alive = cond(state)
+        new_state = body(state)
+        state = tuple(jnp.where(alive, n, o)
+                      for n, o in zip(new_state, state))
+    return state
+
+
 def fixpoint_ell(ell: LmmEllArrays, eps, carry=None,
                  parallel_rounds: bool = False,
                  max_rounds: Optional[int] = None,
-                 return_carry: bool = False):
+                 return_carry: bool = False,
+                 unroll: bool = False):
     """The saturate-bottleneck fixpoint on the ELL layout: identical
     round structure and epsilon semantics to `fixpoint` (see there for
     the algorithm), with every segment reduction expressed as a masked
@@ -285,8 +311,8 @@ def fixpoint_ell(ell: LmmEllArrays, eps, carry=None,
                                                    1.0))
         return apply_fixes(state, fix_now, new_value)
 
-    out = lax.while_loop(
-        cond, body_local if parallel_rounds else body_global, carry)
+    out = _run_rounds(cond, body_local if parallel_rounds else body_global,
+                      carry, max_rounds, unroll)
     v_value, v_fixed, remaining, usage, light, rounds = out
     if return_carry:
         return v_value, remaining, usage, rounds, out
@@ -296,7 +322,8 @@ def fixpoint_ell(ell: LmmEllArrays, eps, carry=None,
 def fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
              eps, n_c: int, n_v: int, axis: Optional[str] = None,
              parallel_rounds: bool = False, carry=None,
-             max_rounds: Optional[int] = None, return_carry: bool = False):
+             max_rounds: Optional[int] = None, return_carry: bool = False,
+             unroll: bool = False):
     """The saturate-bottleneck fixpoint over padded COO arrays.
 
     The single implementation behind every solve path: single-device
@@ -496,8 +523,8 @@ def fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
                               level2_v / jnp.where(v_enabled, v_penalty, 1.0))
         return apply_fixes(state, fix_now, new_value)
 
-    out = lax.while_loop(
-        cond, body_local if parallel_rounds else body_global, carry)
+    out = _run_rounds(cond, body_local if parallel_rounds else body_global,
+                      carry, max_rounds, unroll)
     v_value, v_fixed, remaining, usage, light, rounds = out
     if return_carry:
         return v_value, remaining, usage, rounds, out
@@ -505,10 +532,12 @@ def fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("eps", "parallel_rounds", "chunk"))
+                   static_argnames=("eps", "parallel_rounds", "chunk",
+                                    "unroll"))
 def _solve_ell_chunk(cv_var, cv_w, cv_valid, vc_cnst, vc_valid, c_bound,
                      c_fatpipe, v_penalty, v_bound, carry,
-                     eps: float, parallel_rounds: bool, chunk: int):
+                     eps: float, parallel_rounds: bool, chunk: int,
+                     unroll: bool = False):
     """eps is static: it is fixed per run (maxmin/precision), and a
     traced scalar would be one more host->device transfer per chunk —
     each costing hundreds of ms of latency on a tunneled accelerator."""
@@ -516,7 +545,7 @@ def _solve_ell_chunk(cv_var, cv_w, cv_valid, vc_cnst, vc_valid, c_bound,
                        c_fatpipe, v_penalty, v_bound, 0, 0)
     return fixpoint_ell(ell, jnp.asarray(eps, cv_w.dtype), carry=carry,
                         parallel_rounds=parallel_rounds, max_rounds=chunk,
-                        return_carry=True)
+                        return_carry=True, unroll=unroll)
 
 
 #: Device-resident copies of solver inputs, keyed by (kind, ids,
@@ -576,17 +605,19 @@ def _ell_cached(arrays: LmmArrays) -> Optional[LmmEllArrays]:
 
 @functools.partial(jax.jit,
                    static_argnames=("eps", "n_c", "n_v",
-                                    "parallel_rounds", "chunk"))
+                                    "parallel_rounds", "chunk", "unroll"))
 def _solve_kernel_chunk(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty,
                         v_bound, carry, eps: float, n_c: int, n_v: int,
-                        parallel_rounds: bool, chunk: int):
+                        parallel_rounds: bool, chunk: int,
+                        unroll: bool = False):
     """Run at most `chunk` more saturation rounds from `carry` (None =
     fresh start) and return (values, remaining, usage, rounds, carry).
     eps is static for the same reason as _solve_ell_chunk's."""
     return fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty,
                     v_bound, jnp.asarray(eps, e_w.dtype), n_c, n_v,
                     axis=None, parallel_rounds=parallel_rounds,
-                    carry=carry, max_rounds=chunk, return_carry=True)
+                    carry=carry, max_rounds=chunk, return_carry=True,
+                    unroll=unroll)
 
 
 def flatten(cnst_list: List[Constraint], dtype=np.float64
@@ -665,6 +696,10 @@ def use_local_rounds() -> bool:
 # finish in one.
 _CHUNK_ROUNDS = 4096
 _CHUNK_ROUNDS_ACCEL = 64
+#: Rounds per dispatch in unrolled mode: compile time scales linearly
+#: with the unroll factor, so keep chunks small — local-rounds solves
+#: typically converge in O(10) rounds anyway.
+_CHUNK_ROUNDS_UNROLL = 16
 
 
 def _default_platform() -> str:
@@ -681,14 +716,22 @@ def _default_chunk() -> int:
 
 def solve_arrays(arrays: LmmArrays, eps: float, device=None,
                  parallel_rounds: Optional[bool] = None,
-                 chunk: Optional[int] = None):
+                 chunk: Optional[int] = None,
+                 unroll: Optional[bool] = None):
     """Run the jit'd fixpoint in bounded-round chunks with host-side
     convergence checks between dispatches; returns
     (values, remaining, usage, rounds)."""
     if parallel_rounds is None:
         parallel_rounds = use_local_rounds()
+    if unroll is None:
+        mode = config["lmm/unroll"]
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(f"Unknown lmm/unroll {mode!r} "
+                             "(expected auto, on or off)")
+        unroll = (mode == "on"
+                  or (mode == "auto" and _default_platform() != "cpu"))
     if chunk is None:
-        chunk = _default_chunk()
+        chunk = _CHUNK_ROUNDS_UNROLL if unroll else _default_chunk()
 
     # Layout: ELL (dense padded rows, no scatters) on accelerators when
     # the graph is not too skewed; COO everywhere else. lmm/layout
@@ -709,7 +752,7 @@ def solve_arrays(arrays: LmmArrays, eps: float, device=None,
         def run_chunk(carry):
             return _solve_ell_chunk(*args, carry, eps=eps_f,
                                     parallel_rounds=parallel_rounds,
-                                    chunk=chunk)
+                                    chunk=chunk, unroll=unroll)
     else:
         args = _device_args(
             "coo",
@@ -720,7 +763,8 @@ def solve_arrays(arrays: LmmArrays, eps: float, device=None,
         def run_chunk(carry):
             return _solve_kernel_chunk(
                 *args, carry, eps=eps_f, n_c=n_c, n_v=n_v,
-                parallel_rounds=parallel_rounds, chunk=chunk)
+                parallel_rounds=parallel_rounds, chunk=chunk,
+                unroll=unroll)
 
     carry = None
     prev_progress = None
@@ -780,13 +824,37 @@ def solve_flattened(system: System, dtype, solve_flat) -> None:
     updates, constraint usage left consistent, modified flags cleared.
     ``solve_flat(arrays, eps) -> (values, remaining, usage)`` is the
     actual solver (device fixpoint or native C++).
-    """
-    if system.selective_update_active:
-        cnst_list = list(system.modified_constraint_set)
-    else:
-        cnst_list = list(system.active_constraint_set)
 
+    Full-update systems run through the incrementally-maintained
+    ArrayView (ops.lmm_view): no per-solve graph walk at all — the
+    arrays were kept in sync by the mutation hooks, so a solve is
+    snapshot + device dispatch + scatter-back.  Selective-update
+    systems keep the walk (they solve varying subsets).
+    """
     eps = config["maxmin/precision"]
+
+    if not system.selective_update_active:
+        view = system.array_view
+        if view is None:
+            from .lmm_view import ArrayView
+            view = ArrayView(system)
+        arrays = view.snapshot(dtype)
+        if arrays.n_cnst:
+            values, remaining, usage = solve_flat(arrays, eps)
+            vals = np.asarray(values).tolist()
+            for slot, var in enumerate(view.slot_var):
+                if var is not None:
+                    var.value = vals[slot]
+            rem = np.asarray(remaining).tolist()
+            use = np.asarray(usage).tolist()
+            for slot, cnst in enumerate(view.slot_cnst):
+                if cnst is not None:
+                    cnst.remaining = rem[slot]
+                    cnst.usage = use[slot]
+        system.modified = False
+        return
+
+    cnst_list = list(system.modified_constraint_set)
 
     # Reset + collect modified actions exactly like the init pass of the
     # list solver (maxmin.cpp:509-539).

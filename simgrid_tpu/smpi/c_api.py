@@ -180,7 +180,8 @@ class _CRankCtx:
         self.next_op = 32
         self.reqs: Dict[int, "_CReq"] = {}
         self.next_req = 1
-        self.groups: Dict[int, Group] = {}
+        # handle 1 = MPI_GROUP_EMPTY (mpi.h:45), predefined
+        self.groups: Dict[int, Group] = {1: Group([])}
         self.next_group = 10
         self.files: Dict[int, object] = {}
         self.next_file = 1
@@ -2673,9 +2674,15 @@ def _h_iscan(ctx, a, exclusive=False):
 
 def _h_comm_create_group(ctx, a):
     """Collective only over the GROUP's members (MPI-3
-    MPI_Comm_create_group): our comm ids are deterministic, so plain
-    create serves."""
-    return _h_comm_create(ctx, a)
+    MPI_Comm_create_group): id allocation must not touch the
+    parent-collective counter (see Comm.create_group)."""
+    comm = _comm_of(ctx, a[0])
+    group = ctx.groups.get(int(a[1]))
+    if comm is None or group is None:
+        return MPI_ERR_COMM
+    _write_i32(a[3], _new_comm_handle(ctx, comm.create_group(group,
+                                                             int(a[2]))))
+    return MPI_SUCCESS
 
 
 def _h_comm_idup(ctx, a):

@@ -26,16 +26,26 @@ class Comm:
         self.id = id if id is not None else "world"
         self._cc_seq: Dict[int, int] = {}
 
-    def _next_cc_id(self, discriminator):
-        """Sequence PER (rank, discriminator): creations that only a
-        subset of ranks participates in (MPI_Comm_create_group, splits
-        by color) must not desynchronize the ids of later unrelated
-        creations on the other ranks (found by mpich3
-        comm_idup_comm, which interleaves create_group on the even
-        ranks with collective dups)."""
+    def _next_cc_id(self, discriminator, collective: bool = True):
+        """Deterministic communicator-id allocation (the role of the
+        reference's collective context-id agreement).
+
+        ``collective=True`` (dup/idup/split/create — collective over
+        the WHOLE parent): one shared per-rank call counter.  Every
+        parent rank issues these calls in the same order (MPI
+        requirement), so the counters advance in lockstep and agree
+        even when per-call arguments differ across ranks (two splits
+        with different color patterns desynchronized the old
+        per-discriminator counters — found by mpich3 comm_idup_comm).
+
+        ``collective=False`` (MPI_Comm_create_group — collective only
+        over the GROUP): sequence per (rank, discriminator) so
+        non-participating ranks do not desynchronize; the group members
+        all issue matching calls in the same order by the same MPI
+        rule, scoped to the (group, tag) discriminator."""
         from . import runtime
         me = runtime.this_rank()
-        key = (me, discriminator)
+        key = (me, "coll") if collective else (me, discriminator)
         seq = self._cc_seq.get(key, 0)
         self._cc_seq[key] = seq + 1
         return (self.id, seq, discriminator)
@@ -68,6 +78,14 @@ class Comm:
 
     def create(self, group: Group) -> Optional["Comm"]:
         new = Comm(group, self._next_cc_id(tuple(group.world_ranks)))
+        return new if group.rank(self.group.actor(self.rank())) >= 0 else None
+
+    def create_group(self, group: Group, tag: int = 0) -> Optional["Comm"]:
+        """MPI-3 MPI_Comm_create_group: collective only over `group`'s
+        members — must not advance the parent-collective id counter
+        (non-members never make this call)."""
+        disc = ("cgrp", tuple(group.world_ranks), tag)
+        new = Comm(group, self._next_cc_id(disc, collective=False))
         return new if group.rank(self.group.actor(self.rank())) >= 0 else None
 
     def split(self, color: int, key: int) -> Optional["Comm"]:

@@ -21,6 +21,7 @@ MPI_PROC_NULL = -2
 
 TAG_IC_CREATE = -130
 TAG_IC_COLL = -131
+TAG_IC_MGMT = -132
 
 
 def _canon(a: List[int], b: List[int]):
@@ -49,6 +50,68 @@ class InterComm(Comm):
 
     def remote_size(self) -> int:
         return self.remote_group.size()
+
+    # -- communicator management over an intercomm ----------------------
+    def _exchange_with_remote(self, payload):
+        """Leaders swap `payload` across the bridge, then broadcast the
+        remote value locally (the standard intercomm-collective
+        exchange pattern, used by dup/create/split id agreement)."""
+        if self.rank() == 0:
+            rreq = self.irecv(0, TAG_IC_MGMT)
+            self.isend(payload, 0, TAG_IC_MGMT).wait()
+            remote = rreq.wait()
+            self.local_intra.bcast(remote, 0)
+        else:
+            remote = self.local_intra.bcast(None, 0)
+        return remote
+
+    def dup(self) -> "InterComm":
+        """MPI_Comm_dup of an intercommunicator yields an
+        intercommunicator (mtest case 'splitting then dup'ing')."""
+        return InterComm(Group(list(self.group.world_ranks)),
+                         Group(list(self.remote_group.world_ranks)),
+                         self._next_cc_id("dup"))
+
+    def create(self, group: Group) -> Optional["InterComm"]:
+        """MPI_Comm_create on an intercomm: each side passes a subset
+        of its LOCAL group; the result pairs the two subsets.  The
+        subsets are exchanged through the leaders (standard
+        algorithm)."""
+        cid_seq = self._next_cc_id("create")
+        local_subset = list(group.world_ranks) if group is not None else []
+        remote_subset = self._exchange_with_remote(local_subset)
+        my_world = self.group.actor(self.rank())
+        if group is None or group.rank(my_world) < 0:
+            return None
+        if not remote_subset:
+            return None
+        cid = (("interc",) + _canon(local_subset, list(remote_subset))
+               + (cid_seq[1],))
+        return InterComm(Group(local_subset), Group(list(remote_subset)),
+                         cid)
+
+    def split(self, color: int, key: int) -> Optional["InterComm"]:
+        """MPI_Comm_split on an intercomm: same-color groups pair up
+        across the two sides; an empty remote color group yields
+        MPI_COMM_NULL (icsplit.c:94-105 semantics)."""
+        cid_seq = self._next_cc_id(("split", color))
+        me = self.rank()
+        all_local = self.local_intra.allgather((color, key, me))
+        remote_triples = self._exchange_with_remote(all_local)
+        if color < 0:
+            return None
+        local_members = sorted((k, r) for c, k, r in all_local
+                               if c == color)
+        remote_members = sorted((k, r) for c, k, r in remote_triples
+                                if c == color)
+        if not remote_members:
+            return None
+        lg = Group([self.group.actor(r) for _, r in local_members])
+        rg = Group([self.remote_group.actor(r) for _, r in remote_members])
+        cid = (("inters",) + _canon(list(lg.world_ranks),
+                                    list(rg.world_ranks))
+               + (cid_seq[1], color))
+        return InterComm(lg, rg, cid)
 
     def world_rank_of(self, group_rank: int) -> int:
         """P2P targets address the REMOTE group."""

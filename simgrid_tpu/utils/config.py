@@ -219,7 +219,13 @@ declare_flag("lmm/rounds",
              "level per round, the reference's sequential order) or local "
              "(fix every local-minimum constraint per round; exact because "
              "rou levels only increase, and far fewer device rounds)",
-             "global")
+             "local")
+declare_flag("lmm/unroll",
+             "Unroll the device fixpoint into straight-line XLA instead "
+             "of lax.while_loop: on, off, or auto (on for accelerators — "
+             "some backends lower gathers inside while_loop to serialized "
+             "dynamic-slice loops; unrolled code keeps them vectorized)",
+             "auto")
 declare_flag("contexts/stack-size", "Actor stack size (bytes)", 131072)
 declare_flag("contexts/factory", "Actor context factory (thread)", "thread")
 declare_flag("tracing", "Enable tracing", False)

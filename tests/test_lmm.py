@@ -535,3 +535,116 @@ def test_ell_conversion_refuses_skew():
         v_penalty=np.ones(n_v), v_bound=np.full(n_v, -1.0),
         n_elem=n_v, n_cnst=1, n_var=n_v)
     assert lj.ell_from_arrays(arrays) is None
+
+
+@pytest.mark.parametrize("rounds_mode", ["global", "local"])
+@pytest.mark.parametrize("layout", ["coo", "ell"])
+def test_unrolled_matches_while_loop(rounds_mode, layout):
+    """The unrolled straight-line round loop (the accelerator mode that
+    dodges gather-in-while_loop lowering pathologies) must reproduce
+    the lax.while_loop solve exactly: same values, same round counts,
+    including chunk-boundary carry continuation."""
+    from simgrid_tpu.ops import lmm_jax as lj
+
+    parallel = rounds_mode == "local"
+    arrays = _bench_arrays(np.random.default_rng(11), 60, 250, 3,
+                           np.float64)
+    try:
+        config["lmm/layout"] = layout
+        v1, r1, u1, rounds1 = lj.solve_arrays(
+            arrays, 1e-9, parallel_rounds=parallel, unroll=False)
+        # chunk smaller than the round count to exercise the carry path
+        v2, r2, u2, rounds2 = lj.solve_arrays(
+            arrays, 1e-9, parallel_rounds=parallel, unroll=True, chunk=4)
+    finally:
+        config["lmm/layout"] = "auto"
+    assert rounds1 == rounds2
+    np.testing.assert_array_equal(v1, v2)
+    np.testing.assert_array_equal(r1, r2)
+    np.testing.assert_array_equal(u1, u2)
+
+
+def test_array_view_tracks_structural_churn():
+    """Property test for the incremental ArrayView: a full-update
+    system driven through random structural churn (new flows, frees,
+    enable/disable via penalty, bound updates) must keep producing the
+    exact list-solver's solution on every re-solve."""
+    from simgrid_tpu.ops import lmm_jax as lj
+    from simgrid_tpu.ops.lmm_host import System
+
+    rng = np.random.default_rng(3)
+    s = System(selective_update=False)
+    lj.install(s, "jax")
+    cnsts = [s.constraint_new(None, float(rng.uniform(1, 10)))
+             for _ in range(25)]
+    live = []
+
+    def add_flow():
+        deg = int(rng.integers(1, 4))
+        var = s.variable_new(None, float(rng.uniform(0.5, 2.0)), -1.0, deg)
+        for ci in rng.choice(len(cnsts), size=deg, replace=False):
+            s.expand(cnsts[ci], var, float(rng.uniform(0.5, 1.5)))
+        live.append(var)
+
+    def check():
+        s.solve()
+        got = [(v.value) for v in live]
+        # re-solve the same state on a fresh exact system
+        s2 = System(selective_update=False)
+        c2 = [s2.constraint_new(None, c.bound) for c in cnsts]
+        idx = {id(c): i for i, c in enumerate(cnsts)}
+        v2 = []
+        for v in live:
+            nv = s2.variable_new(None, v.sharing_penalty or v.staged_penalty,
+                                 v.bound, len(v.cnsts))
+            for elem in v.cnsts:
+                s2.expand(c2[idx[id(elem.constraint)]], nv,
+                          elem.consumption_weight)
+            v2.append(nv)
+        s2.solve_exact()
+        np.testing.assert_allclose(got, [v.value for v in v2],
+                                   rtol=1e-9, atol=1e-9)
+
+    for _ in range(8):
+        add_flow()
+    check()
+    for round_ in range(12):
+        op = rng.integers(0, 4)
+        if op == 0 or len(live) < 4:
+            add_flow()
+        elif op == 1:
+            victim = live.pop(int(rng.integers(len(live))))
+            s.variable_free(victim)
+        elif op == 2:
+            v = live[int(rng.integers(len(live)))]
+            s.update_variable_bound(v, float(rng.uniform(0.5, 5)))
+        else:
+            s.update_constraint_bound(
+                cnsts[int(rng.integers(len(cnsts)))],
+                float(rng.uniform(1, 10)))
+        check()
+
+
+def test_array_view_sees_post_solve_fatpipe():
+    """A constraint whose sharing_policy is set to FATPIPE after the
+    view already exists must be solved with max-sharing (regression:
+    the view cached c_fatpipe at creation only)."""
+    from simgrid_tpu.ops import lmm_jax as lj
+    from simgrid_tpu.ops.lmm_host import SharingPolicy, System
+
+    s = System(selective_update=False)
+    lj.install(s, "jax")
+    c = s.constraint_new(None, 10.0)
+    v1 = s.variable_new(None, 1.0)
+    s.expand(c, v1, 1.0)
+    s.solve()          # view created now, c is SHARED
+    c2 = s.constraint_new(None, 6.0)
+    c2.sharing_policy = SharingPolicy.FATPIPE   # post-view mutation
+    v2 = s.variable_new(None, 1.0)
+    v3 = s.variable_new(None, 1.0)
+    s.expand(c2, v2, 1.0)
+    s.expand(c2, v3, 1.0)
+    s.solve()
+    # FATPIPE: both variables get the full bound, not bound/2
+    assert v2.value == pytest.approx(6.0, rel=1e-9)
+    assert v3.value == pytest.approx(6.0, rel=1e-9)
