@@ -25,6 +25,7 @@
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -80,6 +81,9 @@ struct Constraint {
   double usage = 0.0;
   int32_t light_idx = -1;
   bool active = false;
+  // staged variables whose cached blocker is this constraint
+  // (registration order; see System::on_disabled_var)
+  std::vector<int32_t> waiters;
 };
 
 struct Variable {
@@ -89,6 +93,16 @@ struct Variable {
   double value = 0.0;
   int32_t concurrency_share = 1;
   std::vector<int32_t> elems;  // element indices, creation order
+  // constraint -> element indices on it (creation order): O(1) lookup
+  // for expand's current-share scan and expand_add's edge search; a
+  // linear elems walk made huge-class construction (384 elems/var)
+  // quadratic per variable
+  std::unordered_map<int32_t, std::vector<int32_t>> by_cnst;
+  // the constraint whose slack last blocked can_enable (-1 = none):
+  // wake-up probes are O(1) until that constraint frees capacity, and
+  // on_disabled_var probes only its registered waiters
+  int32_t blocker = -1;
+  int32_t waiter_pos = -1;  // index in blocker's waiters vector
   bool saturated = false;
 };
 
@@ -173,6 +187,13 @@ class System {
 
   void constraint_set_limit(int32_t c, int32_t limit) {
     cnsts_[c].concurrency_limit = limit;
+    // A raised limit frees slack without an on_disabled_var event:
+    // probe registered waiters now (failed probes re-register on
+    // their real blocker) — mirrors lmm_host.set_concurrency_limit.
+    std::vector<int32_t> probe = cnsts_[c].waiters;
+    for (int32_t vi : probe)
+      if (vars_[vi].staged_penalty > 0 && can_enable(vi))
+        enable_var(vi);
   }
 
   void constraint_set_fatpipe(int32_t c, bool fat) {
@@ -195,18 +216,47 @@ class System {
     return minslack;
   }
 
-  bool can_enable(const Variable& v) const {
-    // Early-exit slack scan: on dense systems most constraints are at
-    // their concurrency limit, so the first saturated constraint
-    // already answers 'no' — without this, bench-protocol construction
-    // on the huge class (20k vars x 384 elems) is quadratic in the
-    // staged-variable population (the reference scans fully,
-    // maxmin.hpp get_min_concurrency_slack; result is identical).
+  void set_blocker(int32_t v, int32_t c) {
+    Variable& var = vars_[v];
+    if (var.blocker == c)
+      return;
+    if (var.blocker >= 0) {
+      // O(1) swap-remove (probe order is already a documented
+      // divergence, so order preservation is not required)
+      auto& w = cnsts_[var.blocker].waiters;
+      int32_t last = w.back();
+      w[var.waiter_pos] = last;
+      vars_[last].waiter_pos = var.waiter_pos;
+      w.pop_back();
+    }
+    var.blocker = c;
+    if (c >= 0) {
+      var.waiter_pos = static_cast<int32_t>(cnsts_[c].waiters.size());
+      cnsts_[c].waiters.push_back(v);
+    } else {
+      var.waiter_pos = -1;
+    }
+  }
+
+  bool can_enable(int32_t vi) {
+    // Early-exit slack scan with a cached blocking constraint: while
+    // the blocker's slack stays below our share, the probe is O(1),
+    // and on_disabled_var only probes its own registered waiters —
+    // without this, bench-protocol construction on the huge class
+    // (20k vars x 384 elems) is quadratic in the staged-variable
+    // population (the reference rescans fully every time).
+    Variable& v = vars_[vi];
     if (v.staged_penalty <= 0)
       return false;
+    if (v.blocker >= 0 &&
+        concurrency_slack(cnsts_[v.blocker]) < v.concurrency_share)
+      return false;
     for (int32_t ei : v.elems)
-      if (concurrency_slack(cnsts_[elems_[ei].cnst]) < v.concurrency_share)
+      if (concurrency_slack(cnsts_[elems_[ei].cnst]) < v.concurrency_share) {
+        set_blocker(vi, elems_[ei].cnst);
         return false;
+      }
+    set_blocker(vi, -1);
     return true;
   }
 
@@ -230,10 +280,13 @@ class System {
     Constraint& cnst = cnsts_[c];
 
     int32_t current_share = 0;
-    if (var.concurrency_share > 1)
-      for (int32_t ei : var.elems)
-        if (elems_[ei].cnst == c && elems_[ei].list == ListId::kEnabled)
-          current_share += elems_[ei].concurrency();
+    if (var.concurrency_share > 1) {
+      auto it = var.by_cnst.find(c);
+      if (it != var.by_cnst.end())
+        for (int32_t ei : it->second)
+          if (elems_[ei].list == ListId::kEnabled)
+            current_share += elems_[ei].concurrency();
+    }
 
     if (var.sharing_penalty > 0 &&
         var.concurrency_share - current_share > concurrency_slack(cnst)) {
@@ -243,6 +296,8 @@ class System {
         on_disabled_var(elems_[ei].cnst);
       weight = 0.0;
       var.staged_penalty = penalty;
+      if (can_enable(v))          // registers the real blocker on failure
+        set_blocker(v, c);        // conservatively wait on the trigger
     }
 
     Element e;
@@ -252,6 +307,7 @@ class System {
     int32_t ei = static_cast<int32_t>(elems_.size());
     elems_.push_back(e);
     var.elems.push_back(ei);
+    var.by_cnst[c].push_back(ei);
 
     if (var.sharing_penalty > 0) {
       list_push_front(cnst.enabled, ei, ListId::kEnabled);
@@ -270,11 +326,9 @@ class System {
     modified_ = true;
     Variable& var = vars_[v];
     int32_t found = -1;
-    for (int32_t ei : var.elems)
-      if (elems_[ei].cnst == c) {
-        found = ei;
-        break;
-      }
+    auto it = var.by_cnst.find(c);
+    if (it != var.by_cnst.end() && !it->second.empty())
+      found = it->second.front();
     if (found < 0) {
       expand(c, v, weight);
       return;
@@ -293,12 +347,15 @@ class System {
         for (int32_t ei : var.elems)
           on_disabled_var(elems_[ei].cnst);
         var.staged_penalty = penalty;
+        if (can_enable(v))
+          set_blocker(v, c);
       }
       increase_concurrency(found);
     }
   }
 
   void enable_var(int32_t v) {
+    set_blocker(v, -1);
     Variable& var = vars_[v];
     var.sharing_penalty = var.staged_penalty;
     var.staged_penalty = 0.0;
@@ -326,22 +383,20 @@ class System {
   }
 
   void on_disabled_var(int32_t c) {
-    // Wake staged variables now that a slot freed up
-    // (lmm_host.System.on_disabled_var).
+    // Wake staged variables now that a slot freed up, probing only the
+    // variables registered as blocked on THIS constraint (see
+    // lmm_host.System.on_disabled_var for the divergence note).
     Constraint& cnst = cnsts_[c];
     if (cnst.concurrency_limit < 0)
       return;
-    int32_t numelem = cnst.disabled.size;
-    int32_t ei = cnst.disabled.head;
-    while (numelem > 0 && ei >= 0) {
-      --numelem;
-      int32_t next = elems_[ei].next;
-      Variable& var = vars_[elems_[ei].var];
-      if (var.staged_penalty > 0 && can_enable(var))
-        enable_var(elems_[ei].var);  // unlinks ei from this list
+    if (cnst.waiters.empty())
+      return;
+    std::vector<int32_t> probe = cnst.waiters;  // enable mutates it
+    for (int32_t vi : probe) {
       if (cnst.concurrency_current == cnst.concurrency_limit)
         break;
-      ei = next;
+      if (vars_[vi].staged_penalty > 0 && can_enable(vi))
+        enable_var(vi);
     }
   }
 
@@ -357,7 +412,9 @@ class System {
       if (c.enabled.size + c.disabled.size > 0)
         on_disabled_var(elems_[ei].cnst);
     }
+    set_blocker(v, -1);
     var.elems.clear();
+    var.by_cnst.clear();
     var.sharing_penalty = 0.0;
     var.staged_penalty = 0.0;
   }

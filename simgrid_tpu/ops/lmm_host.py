@@ -106,7 +106,8 @@ class Constraint:
                  "concurrency_maximum", "_sharing_policy",
                  "enabled_element_set", "disabled_element_set",
                  "active_element_set", "_cs_hook", "_acs_hook", "_mcs_hook",
-                 "_light_idx", "jax_slot", "_view_slot", "_system")
+                 "_light_idx", "jax_slot", "_view_slot", "_system",
+                 "_waiters")
 
     def __init__(self, system: "System", id_obj, bound: float):
         self._system = system
@@ -128,6 +129,9 @@ class Constraint:
         self._mcs_hook = None
         self._light_idx = -1
         self.jax_slot = -1  # stable slot in the flattened device arrays
+        #: staged variables whose cached blocker is this constraint
+        #: (insertion-ordered dict used as an ordered set)
+        self._waiters: dict = {}
 
     @property
     def sharing_policy(self) -> "SharingPolicy":
@@ -150,6 +154,13 @@ class Constraint:
     def set_concurrency_limit(self, limit: int) -> None:
         assert limit < 0 or self.concurrency_maximum <= limit
         self.concurrency_limit = limit
+        # A raised limit frees slack without an on_disabled_var event:
+        # probe our registered waiters now (failed probes re-register on
+        # their real blocker).  The reference would wake them at the
+        # next disabled-list scan — same outcome, earlier instant.
+        for var in list(self._waiters.values()):
+            if var.staged_penalty > 0 and var.can_enable():
+                self._system.enable_var(var)
 
     def get_concurrency_slack(self) -> int:
         if self.concurrency_limit < 0:
@@ -185,7 +196,8 @@ class Variable:
 
     __slots__ = ("id", "rank", "cnsts", "sharing_penalty", "staged_penalty",
                  "bound", "concurrency_share", "value", "visited", "mu",
-                 "_vs_hook", "_svs_hook", "jax_slot", "_view_slot")
+                 "_vs_hook", "_svs_hook", "jax_slot", "_view_slot",
+                 "_by_cnst", "_blocker")
 
     def __init__(self, system: "System", id_obj, sharing_penalty: float,
                  bound: float):
@@ -193,6 +205,17 @@ class Variable:
         self.rank = system._next_var_rank
         system._next_var_rank += 1
         self.cnsts: List[Element] = []
+        #: constraint-id -> [elements]: O(1) lookup for expand's
+        #: current-share scan and expand_add's edge search — a linear
+        #: var.cnsts walk per element made huge-class bench construction
+        #: (384 elements/var) quadratic per variable
+        self._by_cnst: dict = {}
+        #: the first constraint whose slack blocked can_enable — while
+        #: its slack stays below our share, later wake-up probes answer
+        #: 'no' in O(1) instead of rescanning all 384 bench elements,
+        #: and on_disabled_var probes only its own registered waiters
+        #: (the staged-variable wake-up walk was quadratic without it)
+        self._blocker = None
         self.sharing_penalty = sharing_penalty
         self.staged_penalty = 0.0
         self.bound = bound
@@ -223,17 +246,38 @@ class Variable:
                 minslack = slack
         return minslack
 
+    def set_blocker(self, cnst) -> None:
+        """(Re)register this staged variable as waiting on `cnst`; the
+        wake-up scan (System.on_disabled_var) probes only registered
+        waiters."""
+        old = self._blocker
+        if old is cnst:
+            return
+        if old is not None:
+            old._waiters.pop(id(self), None)
+        self._blocker = cnst
+        if cnst is not None:
+            cnst._waiters[id(self)] = self
+
     def can_enable(self) -> bool:
         # Early-exit slack scan (vs the reference's full
         # get_min_concurrency_slack): the first constraint below the
-        # required share answers 'no' — keeps dense bench-protocol
-        # construction from going quadratic in staged variables.
+        # required share answers 'no', and it is cached as the blocker
+        # so the next probe is O(1) until that constraint frees
+        # capacity — keeps dense bench-protocol construction from
+        # going quadratic in staged variables.
         if self.staged_penalty <= 0:
             return False
         share = self.concurrency_share
+        blocker = self._blocker
+        if (blocker is not None
+                and blocker.get_concurrency_slack() < share):
+            return False
         for elem in self.cnsts:
             if elem.constraint.get_concurrency_slack() < share:
+                self.set_blocker(elem.constraint)
                 return False
+        self.set_blocker(None)
         return True
 
     def get_constraint(self, num: int) -> Optional[Constraint]:
@@ -344,7 +388,9 @@ class System:
                 self.make_constraint_inactive(elem.constraint)
             else:
                 self.on_disabled_var(elem.constraint)
+        var.set_blocker(None)
         var.cnsts.clear()
+        var._by_cnst.clear()
 
     def cnst_free(self, cnst: Constraint) -> None:
         self.make_constraint_inactive(cnst)
@@ -359,8 +405,8 @@ class System:
 
         current_share = 0
         if var.concurrency_share > 1:
-            for elem in var.cnsts:
-                if elem.constraint is cnst and elem._enabled_hook is not None:
+            for elem in var._by_cnst.get(id(cnst), ()):
+                if elem._enabled_hook is not None:
                     current_share += elem.get_concurrency()
 
         if (var.sharing_penalty > 0
@@ -372,9 +418,14 @@ class System:
             consumption_weight = 0
             var.staged_penalty = penalty
             assert not var.sharing_penalty
+            # a failed can_enable registers the real blocker; on the
+            # (rare) success, conservatively wait on the trigger
+            if var.can_enable():
+                var.set_blocker(cnst)
 
         elem = Element(cnst, var, consumption_weight)
         var.cnsts.append(elem)
+        var._by_cnst.setdefault(id(cnst), []).append(elem)
 
         if var.sharing_penalty:
             cnst.enabled_element_set.push_front(elem)
@@ -395,7 +446,8 @@ class System:
     def expand_add(self, cnst: Constraint, var: Variable, value: float) -> None:
         """Add value to an existing edge's weight (max for FATPIPE)."""
         self.modified = True
-        elem = next((e for e in var.cnsts if e.constraint is cnst), None)
+        edge = var._by_cnst.get(id(cnst))
+        elem = edge[0] if edge else None
         if elem is not None:
             if var.sharing_penalty:
                 elem.decrease_concurrency()
@@ -413,6 +465,8 @@ class System:
                         self.on_disabled_var(elem2.constraint)
                     var.staged_penalty = penalty
                     assert not var.sharing_penalty
+                    if var.can_enable():
+                        var.set_blocker(cnst)
                 elem.increase_concurrency()
             self.update_modified_set(cnst)
         else:
@@ -469,6 +523,7 @@ class System:
 
     # -- enable/disable/staging (concurrency limits) ----------------------
     def enable_var(self, var: Variable) -> None:
+        var.set_blocker(None)
         var.sharing_penalty = var.staged_penalty
         var.staged_penalty = 0
         if self.array_view is not None:
@@ -501,23 +556,29 @@ class System:
             self.array_view.on_penalty(var)
 
     def on_disabled_var(self, cnst: Constraint) -> None:
+        """Wake staged variables when `cnst` frees concurrency slack.
+
+        The reference walks the whole disabled element list with a full
+        slack scan per candidate (maxmin.cpp on_disabled_var) — O(list)
+        per wake-up and quadratic over a churny run.  Here every staged
+        variable is registered on ONE currently-blocking constraint
+        (Variable.set_blocker), so the scan probes exactly the
+        candidates this constraint was blocking, in registration order.
+        Candidates blocked elsewhere cannot become enableable from this
+        constraint's slack release, so skipping them is
+        behavior-preserving; the probe order within one scan is
+        registration order rather than the reference's disabled-list
+        order (observable only when several waiters compete for the
+        same freed slack — documented divergence)."""
         if cnst.get_concurrency_limit() < 0:
             return
-        numelem = len(cnst.disabled_element_set)
-        if not numelem:
+        if not cnst._waiters:
             return
-        elem = cnst.disabled_element_set.front()
-        while numelem and elem is not None:
-            numelem -= 1
-            if elem._disabled_hook is not None:
-                nextelem = elem._disabled_hook[1]
-            else:
-                nextelem = None
-            if elem.variable.staged_penalty > 0 and elem.variable.can_enable():
-                self.enable_var(elem.variable)
+        for var in list(cnst._waiters.values()):
             if cnst.concurrency_current == cnst.get_concurrency_limit():
                 break
-            elem = nextelem
+            if var.staged_penalty > 0 and var.can_enable():
+                self.enable_var(var)
 
     # -- runtime updates ---------------------------------------------------
     def update_variable_penalty(self, var: Variable, penalty: float) -> None:
@@ -531,6 +592,9 @@ class System:
             var.staged_penalty = penalty
             minslack = var.get_min_concurrency_slack()
             if minslack < var.concurrency_share:
+                # minslack < share guarantees the scan fails; run it
+                # for its blocker-registration side effect
+                var.can_enable()
                 return
             self.enable_var(var)
         elif disabling_var:

@@ -648,3 +648,25 @@ def test_array_view_sees_post_solve_fatpipe():
     # FATPIPE: both variables get the full bound, not bound/2
     assert v2.value == pytest.approx(6.0, rel=1e-9)
     assert v3.value == pytest.approx(6.0, rel=1e-9)
+
+
+def test_limit_raise_wakes_staged_variable():
+    """Raising a concurrency limit must (eventually) enable a staged
+    variable — the waiter registry must not lose it (regression for
+    the blocker-cache wake-up path)."""
+    from simgrid_tpu.ops.lmm_host import System
+
+    s = System(selective_update=False)
+    c = s.constraint_new(None, 10.0)
+    c.set_concurrency_limit(1)
+    v1 = s.variable_new(None, 1.0, -1.0, 1)
+    s.expand(c, v1, 1.0)          # takes the only slot
+    v2 = s.variable_new(None, 1.0, -1.0, 1)
+    s.expand(c, v2, 1.0)          # staged: no slack
+    assert v2.sharing_penalty == 0 and v2.staged_penalty > 0
+    c.set_concurrency_limit(4)
+    assert v2.sharing_penalty > 0, "staged variable never woke up"
+    # NB: the staged expand zeroed the element weight (reference
+    # maxmin.cpp:255 does the same), so only enablement is asserted.
+    s.solve_exact()
+    assert v1.value > 0
