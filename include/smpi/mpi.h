@@ -34,7 +34,8 @@ typedef struct MPI_Status {
   int MPI_SOURCE;
   int MPI_TAG;
   int MPI_ERROR;
-  int count_; /* received bytes (internal) */
+  int count_;     /* received bytes (internal) */
+  int cancelled_; /* set by a successful MPI_Cancel (internal) */
 } MPI_Status;
 
 #define MPI_COMM_NULL 0
@@ -93,6 +94,58 @@ typedef struct MPI_Status {
 #define MPI_LONG_DOUBLE_INT 40
 #define MPI_UB 41
 #define MPI_LB 42
+/* optional fixed-size / Fortran datatypes */
+#define MPI_REAL4 43
+#define MPI_REAL8 44
+#define MPI_REAL16 45
+#define MPI_COMPLEX8 46
+#define MPI_COMPLEX16 47
+#define MPI_COMPLEX32 48
+#define MPI_INTEGER1 49
+#define MPI_INTEGER2 50
+#define MPI_INTEGER4 51
+#define MPI_INTEGER8 52
+#define MPI_INTEGER16 53
+#define MPI_REAL 54
+#define MPI_INTEGER 55
+#define MPI_LOGICAL 56
+#define MPI_CHARACTER 57
+#define MPI_2REAL 58
+#define MPI_2DOUBLE_PRECISION 59
+#define MPI_2INTEGER 60
+#define MPI_DOUBLE_PRECISION 61
+
+/* datatype constructor combiners (MPI_Type_get_envelope) */
+#define MPI_COMBINER_NAMED 1
+#define MPI_COMBINER_DUP 2
+#define MPI_COMBINER_CONTIGUOUS 3
+#define MPI_COMBINER_VECTOR 4
+#define MPI_COMBINER_HVECTOR 5
+#define MPI_COMBINER_INDEXED 6
+#define MPI_COMBINER_HINDEXED 7
+#define MPI_COMBINER_INDEXED_BLOCK 8
+#define MPI_COMBINER_HINDEXED_BLOCK 9
+#define MPI_COMBINER_STRUCT 10
+#define MPI_COMBINER_SUBARRAY 11
+#define MPI_COMBINER_DARRAY 12
+#define MPI_COMBINER_RESIZED 13
+#define MPI_COMBINER_F90_REAL 14
+#define MPI_COMBINER_F90_COMPLEX 15
+#define MPI_COMBINER_F90_INTEGER 16
+#define MPI_COMBINER_HVECTOR_INTEGER 17
+#define MPI_COMBINER_HINDEXED_INTEGER 18
+#define MPI_COMBINER_STRUCT_INTEGER 19
+
+/* darray distribution kinds */
+#define MPI_DISTRIBUTE_BLOCK 121
+#define MPI_DISTRIBUTE_CYCLIC 122
+#define MPI_DISTRIBUTE_NONE 123
+#define MPI_DISTRIBUTE_DFLT_DARG -49767
+
+/* MPI_Type_match_size type classes */
+#define MPI_TYPECLASS_REAL 1
+#define MPI_TYPECLASS_INTEGER 2
+#define MPI_TYPECLASS_COMPLEX 3
 
 /* -- predefined reduction ops ------------------------------------------ */
 #define MPI_OP_NULL 0
@@ -115,6 +168,10 @@ typedef struct MPI_Status {
 #define MPI_PROC_NULL -2
 #define MPI_ROOT -3
 #define MPI_UNDEFINED -32766
+#define MPI_THREAD_SINGLE 0
+#define MPI_THREAD_FUNNELED 1
+#define MPI_THREAD_SERIALIZED 2
+#define MPI_THREAD_MULTIPLE 3
 #define MPI_IN_PLACE ((void*)-222)
 #define MPI_BOTTOM ((void*)0)
 #define MPI_STATUS_IGNORE ((MPI_Status*)0)
@@ -187,6 +244,8 @@ int MPI_File_sync(MPI_File fh);
 
 /* -- environment -------------------------------------------------------- */
 int MPI_Init(int* argc, char*** argv);
+int MPI_Init_thread(int* argc, char*** argv, int required, int* provided);
+int MPI_Query_thread(int* provided);
 int MPI_Finalize(void);
 int MPI_Initialized(int* flag);
 int MPI_Finalized(int* flag);
@@ -513,6 +572,45 @@ int MPI_Error_class(int errorcode, int* errorclass);
 int MPI_Comm_get_name(MPI_Comm comm, char* name, int* resultlen);
 int MPI_Comm_set_name(MPI_Comm comm, const char* name);
 int MPI_Comm_test_inter(MPI_Comm comm, int* flag);
+int MPI_Cancel(MPI_Request* request);
+int MPI_Test_cancelled(const MPI_Status* status, int* flag);
+int MPI_Type_get_envelope(MPI_Datatype datatype, int* num_integers,
+                          int* num_addresses, int* num_datatypes,
+                          int* combiner);
+int MPI_Type_get_contents(MPI_Datatype datatype, int max_integers,
+                          int max_addresses, int max_datatypes,
+                          int array_of_integers[],
+                          MPI_Aint array_of_addresses[],
+                          MPI_Datatype array_of_datatypes[]);
+int MPI_Get_elements(const MPI_Status* status, MPI_Datatype datatype,
+                     int* count);
+int MPI_Type_lb(MPI_Datatype datatype, MPI_Aint* displacement);
+int MPI_Type_ub(MPI_Datatype datatype, MPI_Aint* displacement);
+int MPI_Type_create_darray(int size, int rank, int ndims,
+                           const int array_of_gsizes[],
+                           const int array_of_distribs[],
+                           const int array_of_dargs[],
+                           const int array_of_psizes[], int order,
+                           MPI_Datatype oldtype, MPI_Datatype* newtype);
+int MPI_Pack_external(const char datarep[], const void* inbuf, int incount,
+                      MPI_Datatype datatype, void* outbuf,
+                      MPI_Aint outsize, MPI_Aint* position);
+int MPI_Unpack_external(const char datarep[], const void* inbuf,
+                        MPI_Aint insize, MPI_Aint* position, void* outbuf,
+                        int outcount, MPI_Datatype datatype);
+int MPI_Pack_external_size(const char datarep[], int incount,
+                           MPI_Datatype datatype, MPI_Aint* size);
+int MPI_Type_match_size(int typeclass, int size, MPI_Datatype* datatype);
+int MPI_Type_get_extent_x(MPI_Datatype datatype, MPI_Count* lb,
+                          MPI_Count* extent);
+int MPI_Type_get_true_extent_x(MPI_Datatype datatype, MPI_Count* true_lb,
+                               MPI_Count* true_extent);
+int MPI_Get_elements_x(const MPI_Status* status, MPI_Datatype datatype,
+                       MPI_Count* count);
+int MPI_Status_set_elements(MPI_Status* status, MPI_Datatype datatype,
+                            int count);
+int MPI_Status_set_elements_x(MPI_Status* status, MPI_Datatype datatype,
+                              MPI_Count* count);
 int MPI_Comm_remote_size(MPI_Comm comm, int* size);
 int MPI_Intercomm_create(MPI_Comm local_comm, int local_leader,
                          MPI_Comm peer_comm, int remote_leader, int tag,

@@ -177,6 +177,14 @@ enum {
   SMPI_OP_INTERCOMM_MERGE,
   SMPI_OP_COMM_REMOTE_SIZE,   /* 145 */
   SMPI_OP_COMM_TEST_INTER,
+  SMPI_OP_CANCEL,             /* 147 */
+  SMPI_OP_TYPE_GET_ENVELOPE,
+  SMPI_OP_TYPE_GET_CONTENTS,
+  SMPI_OP_GET_ELEMENTS,       /* 150 */
+  SMPI_OP_TYPE_LBUB,          /* mode: 0 lb, 1 ub, 2 extent */
+  SMPI_OP_TYPE_DARRAY,
+  SMPI_OP_PACK_EXTERNAL,      /* mode: 0 pack, 1 unpack, 2 size */
+  SMPI_OP_TYPE_MATCH_SIZE,
 };
 
 /* sub-modes for FILE_READ / FILE_WRITE */
@@ -193,6 +201,14 @@ enum { SMPI_IO_PLAIN = 0, SMPI_IO_AT = 1, SMPI_IO_ALL = 2,
 
 /* -- environment -------------------------------------------------------- */
 int MPI_Init(int* argc, char*** argv) { CALL(SMPI_OP_INIT, A(argc), A(argv)); }
+int MPI_Init_thread(int* argc, char*** argv, int required, int* provided) {
+  if (provided) *provided = required < 2 ? required : 2; /* SERIALIZED */
+  return MPI_Init(argc, argv);
+}
+int MPI_Query_thread(int* provided) {
+  if (provided) *provided = 2;
+  return MPI_SUCCESS;
+}
 int MPI_Finalize(void) { CALL(SMPI_OP_FINALIZE, 0); }
 int MPI_Initialized(int* flag) { CALL(SMPI_OP_INITIALIZED, A(flag)); }
 int MPI_Finalized(int* flag) { CALL(SMPI_OP_FINALIZED, A(flag)); }
@@ -508,11 +524,11 @@ int MPI_Reduce_scatter_block(const void* sendbuf, void* recvbuf,
 
 /* -- datatypes ------------------------------------------------------------- */
 int MPI_Type_size(MPI_Datatype datatype, int* size) {
-  CALL(SMPI_OP_TYPE_SIZE, A(datatype), A(size));
+  CALL(SMPI_OP_TYPE_SIZE, A(datatype), A(size), A(0));
 }
 int MPI_Type_get_extent(MPI_Datatype datatype, MPI_Aint* lb,
                         MPI_Aint* extent) {
-  CALL(SMPI_OP_TYPE_GET_EXTENT, A(datatype), A(lb), A(extent));
+  CALL(SMPI_OP_TYPE_GET_EXTENT, A(datatype), A(lb), A(extent), A(0));
 }
 int MPI_Type_contiguous(int count, MPI_Datatype oldtype,
                         MPI_Datatype* newtype) {
@@ -671,6 +687,97 @@ int MPI_Free_mem(void* base) {
 }
 int MPI_Error_class(int errorcode, int* errorclass) {
   *errorclass = errorcode;
+  return MPI_SUCCESS;
+}
+int MPI_Type_size_x(MPI_Datatype datatype, MPI_Count* size) {
+  CALL(SMPI_OP_TYPE_SIZE, A(datatype), A(size), A(1));
+}
+int MPI_Type_get_extent_x(MPI_Datatype datatype, MPI_Count* lb,
+                          MPI_Count* extent) {
+  CALL(SMPI_OP_TYPE_GET_EXTENT, A(datatype), A(lb), A(extent), A(0));
+}
+int MPI_Type_get_true_extent_x(MPI_Datatype datatype, MPI_Count* true_lb,
+                               MPI_Count* true_extent) {
+  CALL(SMPI_OP_TYPE_GET_EXTENT, A(datatype), A(true_lb), A(true_extent),
+       A(1));
+}
+int MPI_Get_elements_x(const MPI_Status* status, MPI_Datatype datatype,
+                       MPI_Count* count) {
+  CALL(SMPI_OP_GET_ELEMENTS, A(status), A(datatype), A(count), A(1));
+}
+int MPI_Status_set_elements(MPI_Status* status, MPI_Datatype datatype,
+                            int count) {
+  MPI_Count c = count;
+  return MPI_Status_set_elements_x(status, datatype, &c);
+}
+int MPI_Status_set_elements_x(MPI_Status* status, MPI_Datatype datatype,
+                              MPI_Count* count) {
+  CALL(SMPI_OP_GET_ELEMENTS, A(status), A(datatype), A(count), A(2));
+}
+int MPI_Type_get_envelope(MPI_Datatype datatype, int* num_integers,
+                          int* num_addresses, int* num_datatypes,
+                          int* combiner) {
+  CALL(SMPI_OP_TYPE_GET_ENVELOPE, A(datatype), A(num_integers),
+       A(num_addresses), A(num_datatypes), A(combiner));
+}
+int MPI_Type_get_contents(MPI_Datatype datatype, int max_integers,
+                          int max_addresses, int max_datatypes,
+                          int array_of_integers[],
+                          MPI_Aint array_of_addresses[],
+                          MPI_Datatype array_of_datatypes[]) {
+  CALL(SMPI_OP_TYPE_GET_CONTENTS, A(datatype), A(max_integers),
+       A(max_addresses), A(max_datatypes), A(array_of_integers),
+       A(array_of_addresses), A(array_of_datatypes));
+}
+int MPI_Get_elements(const MPI_Status* status, MPI_Datatype datatype,
+                     int* count) {
+  CALL(SMPI_OP_GET_ELEMENTS, A(status), A(datatype), A(count), A(0));
+}
+int MPI_Type_lb(MPI_Datatype datatype, MPI_Aint* displacement) {
+  CALL(SMPI_OP_TYPE_LBUB, A(datatype), A(displacement), A(0));
+}
+int MPI_Type_ub(MPI_Datatype datatype, MPI_Aint* displacement) {
+  CALL(SMPI_OP_TYPE_LBUB, A(datatype), A(displacement), A(1));
+}
+int MPI_Type_create_darray(int size, int rank, int ndims,
+                           const int array_of_gsizes[],
+                           const int array_of_distribs[],
+                           const int array_of_dargs[],
+                           const int array_of_psizes[], int order,
+                           MPI_Datatype oldtype, MPI_Datatype* newtype) {
+  CALL(SMPI_OP_TYPE_DARRAY, A(size), A(rank), A(ndims), A(array_of_gsizes),
+       A(array_of_distribs), A(array_of_dargs), A(array_of_psizes),
+       A(order), A(oldtype), A(newtype));
+}
+int MPI_Pack_external(const char datarep[], const void* inbuf, int incount,
+                      MPI_Datatype datatype, void* outbuf,
+                      MPI_Aint outsize, MPI_Aint* position) {
+  (void)datarep;
+  CALL(SMPI_OP_PACK_EXTERNAL, A(inbuf), A(incount), A(datatype), A(outbuf),
+       A(outsize), A(position), A(0));
+}
+int MPI_Unpack_external(const char datarep[], const void* inbuf,
+                        MPI_Aint insize, MPI_Aint* position, void* outbuf,
+                        int outcount, MPI_Datatype datatype) {
+  (void)datarep;
+  CALL(SMPI_OP_PACK_EXTERNAL, A(outbuf), A(outcount), A(datatype), A(inbuf),
+       A(insize), A(position), A(1));
+}
+int MPI_Pack_external_size(const char datarep[], int incount,
+                           MPI_Datatype datatype, MPI_Aint* size) {
+  (void)datarep;
+  CALL(SMPI_OP_PACK_EXTERNAL, A(0), A(incount), A(datatype), A(0), A(0),
+       A(size), A(2));
+}
+int MPI_Type_match_size(int typeclass, int size, MPI_Datatype* datatype) {
+  CALL(SMPI_OP_TYPE_MATCH_SIZE, A(typeclass), A(size), A(datatype));
+}
+int MPI_Cancel(MPI_Request* request) {
+  CALL(SMPI_OP_CANCEL, A(request));
+}
+int MPI_Test_cancelled(const MPI_Status* status, int* flag) {
+  /* purely local: the cancelled flag lives in the status struct */
+  *flag = status ? status->cancelled_ : 0;
   return MPI_SUCCESS;
 }
 int MPI_Comm_test_inter(MPI_Comm comm, int* flag) {
@@ -915,26 +1022,19 @@ int MPI_Type_create_subarray(int ndims, const int* array_of_sizes,
        A(array_of_subsizes), A(array_of_starts), A(order), A(oldtype),
        A(newtype));
 }
-int MPI_Type_size_x(MPI_Datatype datatype, MPI_Count* size) {
-  int s = 0;
-  int rc = MPI_Type_size(datatype, &s);
-  *size = s;
-  return rc;
-}
 int MPI_Type_get_true_extent(MPI_Datatype datatype, MPI_Aint* true_lb,
                              MPI_Aint* true_extent) {
   /* data travels packed here: the true extent never exceeds the
    * declared extent, which is all callers rely on for sizing */
-  return MPI_Type_get_extent(datatype, true_lb, true_extent);
+  CALL(SMPI_OP_TYPE_GET_EXTENT, A(datatype), A(true_lb), A(true_extent),
+       A(1));
 }
 
 int MPI_Type_get_name(MPI_Datatype datatype, char* name, int* resultlen) {
-  CALL(SMPI_OP_TYPE_GET_NAME, A(datatype), A(name), A(resultlen));
+  CALL(SMPI_OP_TYPE_GET_NAME, A(datatype), A(name), A(resultlen), A(0));
 }
 int MPI_Type_set_name(MPI_Datatype datatype, const char* name) {
-  (void)datatype;
-  (void)name;
-  return MPI_SUCCESS;
+  CALL(SMPI_OP_TYPE_GET_NAME, A(datatype), A(name), A(0), A(1));
 }
 
 /* -- cartesian topologies ------------------------------------------------------ */

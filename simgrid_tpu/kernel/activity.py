@@ -171,10 +171,13 @@ class CommImpl(ActivityImpl):
 
     def cancel(self) -> None:
         if self.state == State.WAITING:
-            if not self.detached:
-                if self.mailbox is not None:
-                    self.mailbox.remove(self)
-                self.state = State.CANCELED
+            # Unmatched comms are cancellable even when detached (the
+            # reference kernel skips detached ones, CommImpl.cpp, but an
+            # unmatched eager send is observably cancellable per MPI —
+            # MPICH pt2pt/scancel expects success for eager sizes).
+            if self.mailbox is not None:
+                self.mailbox.remove(self)
+            self.state = State.CANCELED
         elif self.state in (State.READY, State.RUNNING):
             if self.surf_action is not None:
                 self.surf_action.cancel()
@@ -630,7 +633,10 @@ def comm_isend(engine, src_actor, mbox: "MailboxImpl", task_size: float,
     other_comm.match_fun = match_fun
     other_comm.copy_data_fun = copy_data_fun
     other_comm.start()
-    return None if detached else other_comm
+    # the comm is returned even when detached (callers must not wait on
+    # a detached comm, but MPI_Cancel needs the handle to unqueue an
+    # unmatched eager send)
+    return other_comm
 
 
 def comm_irecv(engine, receiver, mbox: "MailboxImpl", dst_buff, match_fun,

@@ -30,6 +30,7 @@ Known divergences (documented, by design):
 
 from __future__ import annotations
 
+import copy as _copy
 import ctypes
 import os
 import shutil
@@ -138,6 +139,73 @@ _ldi = _dt_struct([("v", np.longdouble), ("i", "<i4")])
 _predef(40, _ldi.itemsize, _ldi, "MPI_LONG_DOUBLE_INT")
 _predef(41, 0, None, "MPI_UB")      # legacy extent markers
 _predef(42, 0, None, "MPI_LB")
+# optional fixed-size / Fortran datatypes (mpi.h 43-61)
+_predef(43, 4, np.float32, "MPI_REAL4")
+_predef(44, 8, np.float64, "MPI_REAL8")
+_predef(45, 16, np.longdouble, "MPI_REAL16")
+_predef(46, 8, np.complex64, "MPI_COMPLEX8")
+_predef(47, 16, np.complex128, "MPI_COMPLEX16")
+_predef(48, 32, None, "MPI_COMPLEX32")
+_predef(49, 1, np.int8, "MPI_INTEGER1")
+_predef(50, 2, np.int16, "MPI_INTEGER2")
+_predef(51, 4, np.int32, "MPI_INTEGER4")
+_predef(52, 8, np.int64, "MPI_INTEGER8")
+_predef(53, 16, None, "MPI_INTEGER16")
+_predef(54, 4, np.float32, "MPI_REAL")
+_predef(55, 4, np.int32, "MPI_INTEGER")
+_predef(56, 4, np.int32, "MPI_LOGICAL")
+_predef(57, 1, np.int8, "MPI_CHARACTER")
+_r2 = _dt_struct([("a", "<f4"), ("b", "<f4")])
+_predef(58, _r2.itemsize, _r2, "MPI_2REAL")
+_d2 = _dt_struct([("a", "<f8"), ("b", "<f8")])
+_predef(59, _d2.itemsize, _d2, "MPI_2DOUBLE_PRECISION")
+_i2p = _dt_struct([("a", "<i4"), ("b", "<i4")])
+_predef(60, _i2p.itemsize, _i2p, "MPI_2INTEGER")
+_predef(61, 8, np.float64, "MPI_DOUBLE_PRECISION")
+
+#: basic-element byte sizes within one extent, for the pair/composite
+#: named types (MPI_Get_elements + external32 byte order need basic
+#: granularity; plain named types are a single basic element)
+_PREDEF_BASICS = {26: [8, 4], 27: [4, 4], 28: [8, 4], 29: [4, 4],
+                  39: [2, 4], 40: [16, 4], 58: [4, 4], 59: [8, 8],
+                  60: [4, 4], 34: [8, 8], 35: [4, 4], 36: [4, 4],
+                  37: [8, 8], 38: [16, 16], 46: [4, 4], 47: [8, 8],
+                  48: [16, 16]}
+for _h, _b in _PREDEF_BASICS.items():
+    _PREDEF_DTYPES[_h].c_basics = _b
+# the value+index pair types are stored padded (C struct ABI) but their
+# MPI size is the sum of the components (pairtype-size-extent)
+for _h in (26, 27, 28, 29, 39, 40, 58, 59, 60):
+    _PREDEF_DTYPES[_h].c_mpi_size = sum(_PREDEF_BASICS[_h])
+
+# constructor combiners (mpi.h values)
+(C_COMBINER_NAMED, C_COMBINER_DUP, C_COMBINER_CONTIGUOUS,
+ C_COMBINER_VECTOR, C_COMBINER_HVECTOR, C_COMBINER_INDEXED,
+ C_COMBINER_HINDEXED, C_COMBINER_INDEXED_BLOCK,
+ C_COMBINER_HINDEXED_BLOCK, C_COMBINER_STRUCT, C_COMBINER_SUBARRAY,
+ C_COMBINER_DARRAY, C_COMBINER_RESIZED) = range(1, 14)
+C_DISTRIBUTE_BLOCK, C_DISTRIBUTE_CYCLIC, C_DISTRIBUTE_NONE = 121, 122, 123
+C_DISTRIBUTE_DFLT_DARG = -49767
+
+
+def _basics_of(dt: Datatype):
+    """REPEATING PATTERN of basic-element byte sizes in typemap order
+    (consumers cycle it, so homogeneous replication keeps the pattern
+    compact — a 2^31-element type must not expand a per-element
+    list)."""
+    b = getattr(dt, "c_basics", None)
+    if b is None:
+        b = [dt.size_] if dt.size_ else []
+    return b
+
+
+def _align_of(dt: Datatype) -> int:
+    """C alignment requirement (for the struct-extent epsilon)."""
+    a = getattr(dt, "c_align", None)
+    if a:
+        return a
+    b = _basics_of(dt)
+    return min(max(b), 16) if b else 1
 
 #: predefined op handles -> Op ("loc" ops resolved separately)
 _PREDEF_OPS: Dict[int, Op] = {
@@ -174,7 +242,10 @@ class _CRankCtx:
     def __init__(self):
         self.comms: Dict[int, Comm] = {}
         self.next_comm = 10
-        self.dtypes: Dict[int, Datatype] = dict(_PREDEF_DTYPES)
+        # per-rank copies: MPI_Type_set_name on a predefined type must
+        # not leak across ranks or later programs in this process
+        self.dtypes: Dict[int, Datatype] = {
+            h: _copy.copy(d) for h, d in _PREDEF_DTYPES.items()}
         self.next_dtype = 100
         self.ops: Dict[int, Op] = dict(_PREDEF_OPS)
         self.next_op = 32
@@ -255,6 +326,43 @@ def _dt(ctx: _CRankCtx, handle: int) -> Datatype:
     return ctx.dtypes[int(handle)]
 
 
+class _StridedSegs:
+    """Lazy (count x step)-strided repetition of an inner segment map.
+    MPI_Count-scale types (datatype/large-count builds a vector of
+    2^30 strided blocks) cannot afford the dense per-block list; this
+    iterates on demand and answers bounds in closed form."""
+    __slots__ = ("count", "step", "inner")
+
+    def __init__(self, count, step, inner):
+        self.count = count
+        self.step = step
+        self.inner = inner
+
+    def __iter__(self):
+        for b in range(self.count):
+            base = b * self.step
+            for off, n in self.inner:
+                yield (base + off, n)
+
+    def __len__(self):
+        return self.count * len(self.inner)
+
+
+def _seg_bounds(segs):
+    """(min offset, max offset+len) without materializing a lazy map."""
+    if isinstance(segs, _StridedSegs):
+        ilo, ihi = _seg_bounds(segs.inner)
+        span = (segs.count - 1) * segs.step if segs.count else 0
+        return min(0, span) + ilo, max(0, span) + ihi
+    if not segs:
+        return 0, 0
+    return (min(o for o, _ in segs), max(o + n for o, n in segs))
+
+
+#: dense segment lists beyond this length switch to _StridedSegs
+_SEG_CAP = 65536
+
+
 def _coalesce(segs):
     """Merge adjacent (offset, nbytes) segments."""
     out = []
@@ -282,6 +390,7 @@ def _segments_of(dt: Datatype):
 def _is_contiguous(dt: Datatype) -> bool:
     segs = _segments_of(dt)
     return (dt.extent_ == dt.size_
+            and not getattr(dt, "c_lb", 0)
             and (not segs or segs == [(0, dt.size_)]))
 
 
@@ -325,6 +434,17 @@ def _arr_out(addr: int, arr, max_bytes: Optional[int] = None,
             for off, n in segs:
                 ctypes.memmove(base + off, data[pos:pos + n], n)
                 pos += n
+        rem = len(data) - pos
+        if rem > 0:
+            # partial trailing element: fill the typemap prefix
+            base = int(addr) + count * dt.extent_
+            for off, n in segs:
+                take = min(n, rem)
+                ctypes.memmove(base + off, data[pos:pos + take], take)
+                pos += take
+                rem -= take
+                if rem <= 0:
+                    break
         return
     n = len(data) if max_bytes is None else min(len(data), int(max_bytes))
     if n:
@@ -340,7 +460,13 @@ def _recv_buf(count: int, dt: Datatype):
     return np.zeros(nbytes, np.uint8)
 
 
-def _set_status(addr: int, src: int, tag: int, err: int, nbytes) -> None:
+#: sizeof(MPI_Status) in mpi.h (5 ints: SOURCE, TAG, ERROR, count_,
+#: cancelled_) — array handlers MUST step by this
+_STATUS_BYTES = 20
+
+
+def _set_status(addr: int, src: int, tag: int, err: int, nbytes,
+                cancelled: bool = False) -> None:
     if addr == 0:
         return
     p = ctypes.cast(int(addr), _pi32)
@@ -351,12 +477,13 @@ def _set_status(addr: int, src: int, tag: int, err: int, nbytes) -> None:
         p[3] = int(min(nbytes, 2**31 - 1))
     except (OverflowError, ValueError):
         p[3] = 0
+    p[4] = 1 if cancelled else 0
 
 
 def _status_from(addr: int, st: Status) -> None:
     src = st.source if st.source != PY_ANY_SOURCE else C_ANY_SOURCE
     tag = st.tag if st.tag != PY_ANY_TAG else C_ANY_TAG
-    _set_status(addr, src, tag, MPI_SUCCESS, st.count)
+    _set_status(addr, src, tag, MPI_SUCCESS, st.count, st.cancelled)
 
 
 def _write_i32(addr: int, value: int) -> None:
@@ -502,7 +629,20 @@ def _complete_creq(ctx: _CRankCtx, handle: int) -> None:
     if creq is None:
         return
     if creq.kind == "recv":
-        _arr_out(creq.c_addr, creq.arr, dt=creq.dt)
+        if getattr(creq.req, "cancelled", False):
+            return               # nothing was received
+        arr = creq.arr
+        # Scatter only the bytes that actually arrived: a short message
+        # into a large derived-type recv must not write the posted
+        # buffer's full extent (stack smash past the caller's array —
+        # datatype/lots-of-types receives 16 B into an 8 KB type).
+        got = getattr(creq.req, "real_size", None)
+        if got is not None and np.isfinite(got):
+            nb = int(got)
+            raw = np.ascontiguousarray(arr).reshape(-1).view(np.uint8)
+            if nb < raw.size:
+                arr = raw[:nb]
+        _arr_out(creq.c_addr, arr, dt=creq.dt)
     elif creq.kind == "nbc" and creq.post is not None:
         creq.post(creq.req.wait())
 
@@ -772,12 +912,12 @@ def _h_waitall(ctx, a):
                 _req_wait(entry.inner, status)
                 _finish_persist(entry)
             if sts_addr:
-                _status_from(int(sts_addr) + 16 * i, status)
+                _status_from(int(sts_addr) + _STATUS_BYTES * i, status)
             continue             # persistent handles survive waitall
         _req_wait(entry, status)
         _complete_creq(ctx, h)
         if sts_addr:
-            _status_from(int(sts_addr) + 16 * i, status)
+            _status_from(int(sts_addr) + _STATUS_BYTES * i, status)
         ctypes.cast(int(reqs_addr), _pi32)[i] = 0
     return MPI_SUCCESS
 
@@ -852,7 +992,7 @@ def _h_testall(ctx, a):
             _req_wait(c, status)    # already finished; fills status
             _retire(ctx, h, c, persist, status, reqs_addr, i)
             if sts_addr:
-                _status_from(int(sts_addr) + 16 * i, status)
+                _status_from(int(sts_addr) + _STATUS_BYTES * i, status)
     return MPI_SUCCESS
 
 
@@ -911,7 +1051,7 @@ def _h_waitsome(ctx, a):
         _retire(ctx, h, c, persist, status, reqs_addr, i)
         ctypes.cast(int(indices_addr), _pi32)[j] = i
         if sts_addr:
-            _status_from(int(sts_addr) + 16 * j, status)
+            _status_from(int(sts_addr) + _STATUS_BYTES * j, status)
     _write_i32(outcount_addr, len(done))
     return MPI_SUCCESS
 
@@ -1105,7 +1245,12 @@ def _h_get_count(ctx, a):
         return MPI_SUCCESS
     nbytes = ctypes.cast(int(st_addr), _pi32)[3]
     dt = _dt(ctx, dth)
-    _write_i32(count_addr, nbytes // dt.size_ if dt.size_ else 0)
+    if not dt.size_:
+        _write_i32(count_addr, 0 if nbytes == 0 else C_UNDEFINED)
+    elif nbytes % dt.size_:
+        _write_i32(count_addr, C_UNDEFINED)   # partial element received
+    else:
+        _write_i32(count_addr, nbytes // dt.size_)
     return MPI_SUCCESS
 
 
@@ -1468,20 +1613,61 @@ def _h_reduce_scatter_block(ctx, a):
 # -- datatypes --------------------------------------------------------------
 
 def _h_type_size(ctx, a):
-    _write_i32(a[1], _dt(ctx, a[0]).size_)
+    dt = _dt(ctx, a[0])
+    size = int(getattr(dt, "c_mpi_size", dt.size_))
+    if int(a[2]):                # MPI_Type_size_x: MPI_Count output
+        _write_i64(a[1], size)
+    else:
+        _write_i32(a[1], size if size <= 2**31 - 1 else C_UNDEFINED)
     return MPI_SUCCESS
+
+
+def _lbub_of(dt: Datatype):
+    lb = int(getattr(dt, "c_lb", 0))
+    return lb, lb + dt.extent_
+
+
+def _set_bounds(dt: Datatype, placements, old: Datatype,
+                lb_mark=None, ub_mark=None) -> None:
+    """Derive the new type's lb/ub from where child instances were
+    placed (MPI-3 §4.1.7): lb = min placement + child lb, ub = max
+    placement + child ub; explicit MPI_LB/MPI_UB markers override."""
+    if placements:
+        lb_old, ub_old = _lbub_of(old)
+        lb = min(placements) + lb_old
+        ub = max(placements) + ub_old
+    else:
+        lb = ub = 0
+    if lb_mark is not None:
+        lb = lb_mark
+    if ub_mark is not None:
+        ub = ub_mark
+    dt.c_lb = lb
+    dt.extent_ = ub - lb
 
 
 def _h_type_get_extent(ctx, a):
     dt = _dt(ctx, a[0])
-    _write_i64(a[1], 0)
+    if int(a[3]):                # true extent: span of the actual data
+        true_lb, true_ub = _seg_bounds(_segments_of(dt))
+        _write_i64(a[1], true_lb)
+        _write_i64(a[2], true_ub - true_lb)
+        return MPI_SUCCESS
+    _write_i64(a[1], int(getattr(dt, "c_lb", 0)))
     _write_i64(a[2], dt.extent_)
     return MPI_SUCCESS
 
 
 def _new_dtype_handle(ctx, dt) -> int:
-    h = ctx.next_dtype
-    ctx.next_dtype += 1
+    # LIFO reuse of freed handle slots, like MPICH's handle pools: the
+    # mpich3 suite (datatype/indexed-misc.c:457) deliberately reuses a
+    # stale handle variable that aliases the most recently created type
+    free = getattr(ctx, "free_dtype_handles", None)
+    if free:
+        h = free.pop()
+    else:
+        h = ctx.next_dtype
+        ctx.next_dtype += 1
     ctx.dtypes[h] = dt
     return h
 
@@ -1489,6 +1675,15 @@ def _new_dtype_handle(ctx, dt) -> int:
 def _replicate(base: Datatype, times: int, step: int):
     """base's segments repeated `times` at `step`-byte intervals."""
     base_segs = _segments_of(base)
+    if times <= 0:
+        return []
+    if base_segs == [(0, step)]:
+        # gap-free repetition collapses to one run — essential for the
+        # MPI_Count-scale types (datatype/large-count builds >2^31-byte
+        # types; a per-element segment list would be gigabytes)
+        return [(0, times * step)]
+    if times * max(len(base_segs), 1) > _SEG_CAP:
+        return _StridedSegs(times, step, base_segs)
     return _coalesce([(k * step + off, n)
                       for k in range(times) for off, n in base_segs])
 
@@ -1497,6 +1692,11 @@ def _h_type_contiguous(ctx, a):
     count, old = int(a[0]), _dt(ctx, a[1])
     dt = Datatype.create_contiguous(count, old)
     dt.c_segments = _replicate(old, count, old.extent_)
+    if count > 0:
+        _set_bounds(dt, [0, (count - 1) * old.extent_], old)
+    dt.c_basics = _basics_of(old)
+    dt.c_env = (C_COMBINER_CONTIGUOUS, [count], [], [int(a[1])])
+    dt.c_env_types = [old]
     _write_i32(a[2], _new_dtype_handle(ctx, dt))
     return MPI_SUCCESS
 
@@ -1510,9 +1710,23 @@ def _h_type_vector(ctx, a):
     # packed so the numpy element view no longer applies
     dt.np_dtype = None
     block = _replicate(old, blocklen, old.extent_)
-    dt.c_segments = _coalesce(
-        [(b * stride * old.extent_ + off, n)
-         for b in range(count) for off, n in block])
+    if block == [(0, stride * old.extent_)]:
+        dt.c_segments = [(0, count * stride * old.extent_)] if count \
+            else []
+    elif count * max(len(block), 1) > _SEG_CAP:
+        dt.c_segments = _StridedSegs(count, stride * old.extent_, block)
+    else:
+        dt.c_segments = _coalesce(
+            [(b * stride * old.extent_ + off, n)
+             for b in range(count) for off, n in block])
+    dt.c_basics = _basics_of(old)
+    if count > 0 and blocklen > 0:
+        _set_bounds(dt, [(b * stride + i) * old.extent_
+                         for b in (0, count - 1)
+                         for i in (0, blocklen - 1)], old)
+    dt.c_env = (C_COMBINER_VECTOR, [count, blocklen, stride], [],
+                [int(a[3])])
+    dt.c_env_types = [old]
     _write_i32(a[4], _new_dtype_handle(ctx, dt))
     return MPI_SUCCESS
 
@@ -1524,8 +1738,13 @@ def _h_type_commit(ctx, a):
 
 
 def _h_type_free(ctx, a):
-    h = ctypes.cast(int(a[0]), _pi32)[0]
-    ctx.dtypes.pop(int(h), None)
+    h = int(ctypes.cast(int(a[0]), _pi32)[0])
+    if h in _PREDEF_DTYPES:
+        return MPI_ERR_ARG       # freeing a predefined type is erroneous
+    if ctx.dtypes.pop(h, None) is not None:
+        if not hasattr(ctx, "free_dtype_handles"):
+            ctx.free_dtype_handles = []
+        ctx.free_dtype_handles.append(h)
     _write_i32(a[0], 0)
     return MPI_SUCCESS
 
@@ -1977,13 +2196,52 @@ def _h_type_struct(ctx, a):
             continue             # UB/LB markers carry no data
         segs.extend((int(d) + off, n)
                     for off, n in _replicate(child, bl, child.extent_))
-    dt.c_segments = _coalesce(sorted(segs))
-    # legacy MPI_UB/MPI_LB markers pin the extent (scatterv.c pattern)
-    for t, d in zip(type_handles, displs):
-        if t == 41:              # MPI_UB
-            dt.extent_ = int(d)
-        elif t == 42:            # MPI_LB: lower bound stays 0 here
-            pass
+    dt.c_segments = _coalesce(segs)
+    # lb/ub per MPI-3 §4.1.7: min/max over placed children, overridden
+    # by legacy MPI_LB/MPI_UB markers; without a UB marker the extent is
+    # padded to the most-aligned member (the standard's epsilon)
+    lb = ub = None
+    lb_mark = ub_mark = None
+    align = 1
+    for bl, d, th, child in zip(blocklens, displs, type_handles, types):
+        if th == 42:             # MPI_LB
+            lb_mark = int(d) if lb_mark is None else min(lb_mark, int(d))
+            continue
+        if th == 41:             # MPI_UB
+            ub_mark = int(d) if ub_mark is None else max(ub_mark, int(d))
+            continue
+        if bl <= 0:
+            continue
+        align = max(align, _align_of(child))
+        clb, cub = _lbub_of(child)
+        for i in (0, bl - 1):
+            base = int(d) + i * child.extent_
+            lb = base + clb if lb is None else min(lb, base + clb)
+            ub = base + cub if ub is None else max(ub, base + cub)
+    if lb is None:
+        lb = 0
+    if ub is None:
+        ub = lb
+    if lb_mark is not None:
+        lb = lb_mark
+    if ub_mark is not None:
+        ub = ub_mark
+    elif align > 1:
+        ub += (align - (ub - lb) % align) % align
+    dt.c_lb = lb
+    dt.extent_ = ub - lb
+    dt.c_align = align
+    basics = []
+    for bl, child in zip(blocklens, types):
+        cb = _basics_of(child)
+        if len(basics) + bl * len(cb) > 4096:
+            basics = None             # degrade precision for huge maps
+            break
+        basics.extend(cb * bl)
+    dt.c_basics = basics if basics is not None else [dt.size_ or 1]
+    dt.c_env = (C_COMBINER_STRUCT, [n] + list(blocklens),
+                [int(d) for d in displs], list(type_handles))
+    dt.c_env_types = list(types)
     _write_i32(out_addr, _new_dtype_handle(ctx, dt))
     return MPI_SUCCESS
 
@@ -1993,13 +2251,20 @@ def _read_i64s(addr: int, n: int) -> List[int]:
     return [p[i] for i in range(n)]
 
 
-def _derived(ctx, out_addr, old, size, extent, segs, name) -> int:
+def _derived(ctx, out_addr, old, size, extent, segs, name) -> Datatype:
+    """Register a derived type and return it (handlers attach their
+    envelope/basics afterwards)."""
     dt = Datatype(size, None, name, extent)
-    dt.c_segments = _coalesce(sorted(segs))
+    dt.c_align = _align_of(old)
+    # typemap ORDER is definitional (MPI_Pack serializes in map order —
+    # a transpose type packs columns, not ascending addresses), so
+    # segments are kept in construction order, never sorted
+    dt.c_segments = segs if isinstance(segs, _StridedSegs) \
+        else _coalesce(segs)
     if dt.c_segments == [(0, size)] and extent == size:
         dt.np_dtype = old.np_dtype       # degenerate-contiguous
     _write_i32(out_addr, _new_dtype_handle(ctx, dt))
-    return MPI_SUCCESS
+    return dt
 
 
 def _h_type_indexed(ctx, a):
@@ -2013,12 +2278,27 @@ def _h_type_indexed(ctx, a):
     segs = []
     ext = 0
     for bl, d in zip(bls, displs):
+        if bl <= 0:
+            continue   # zero blocks carry no data and no bounds
         base = int(d) * unit
         segs.extend((base + off, n)
                     for off, n in _replicate(old, bl, old.extent_))
         ext = max(ext, base + bl * old.extent_)
-    return _derived(ctx, out_addr, old, sum(bls) * old.size_, ext, segs,
-                    "hindexed" if in_bytes else "indexed")
+    dt = _derived(ctx, out_addr, old, sum(bls) * old.size_, ext, segs,
+                  "hindexed" if in_bytes else "indexed")
+    dt.c_basics = _basics_of(old)
+    _set_bounds(dt, [int(d) * unit + i * old.extent_
+                     for bl, d in zip(bls, displs) if bl > 0
+                     for i in (0, bl - 1)], old)
+    if in_bytes:
+        dt.c_env = (C_COMBINER_HINDEXED, [count] + list(bls),
+                    [int(d) for d in displs], [int(oldh)])
+    else:
+        dt.c_env = (C_COMBINER_INDEXED,
+                    [count] + list(bls) + [int(d) for d in displs], [],
+                    [int(oldh)])
+    dt.c_env_types = [old]
+    return MPI_SUCCESS
 
 
 def _h_type_hvector(ctx, a):
@@ -2026,12 +2306,26 @@ def _h_type_hvector(ctx, a):
                                                int(a[2]), a[3], a[4])
     old = _dt(ctx, oldh)
     block = _replicate(old, blocklen, old.extent_)
-    segs = [(b * stride + off, n)
-            for b in range(count) for off, n in block]
+    if block == [(0, stride)]:
+        segs = [(0, count * stride)] if count else []
+    elif count * max(len(block), 1) > _SEG_CAP:
+        segs = _StridedSegs(count, stride, block)
+    else:
+        segs = [(b * stride + off, n)
+                for b in range(count) for off, n in block]
     ext = (count - 1) * stride + blocklen * old.extent_ if count else 0
-    return _derived(ctx, out_addr, old,
-                    count * blocklen * old.size_, max(ext, 0), segs,
-                    "hvector")
+    dt = _derived(ctx, out_addr, old,
+                  count * blocklen * old.size_, max(ext, 0), segs,
+                  "hvector")
+    dt.c_basics = _basics_of(old)
+    if count > 0 and blocklen > 0:
+        _set_bounds(dt, [b * stride + i * old.extent_
+                         for b in (0, count - 1)
+                         for i in (0, blocklen - 1)], old)
+    dt.c_env = (C_COMBINER_HVECTOR, [count, blocklen], [stride],
+                [int(a[3])])
+    dt.c_env_types = [old]
+    return MPI_SUCCESS
 
 
 def _h_type_indexed_block(ctx, a):
@@ -2048,15 +2342,32 @@ def _h_type_indexed_block(ctx, a):
         base = int(d) * unit
         segs.extend((base + off, n) for off, n in block)
         ext = max(ext, base + blocklen * old.extent_)
-    return _derived(ctx, out_addr, old,
-                    count * blocklen * old.size_, ext, segs,
-                    "indexed_block")
+    dt = _derived(ctx, out_addr, old,
+                  count * blocklen * old.size_, ext, segs,
+                  "indexed_block")
+    dt.c_basics = _basics_of(old)
+    if blocklen > 0:
+        _set_bounds(dt, [int(d) * unit + i * old.extent_
+                         for d in displs for i in (0, blocklen - 1)], old)
+    if in_bytes:
+        dt.c_env = (C_COMBINER_HINDEXED_BLOCK, [count, blocklen],
+                    [int(d) for d in displs], [int(oldh)])
+    else:
+        dt.c_env = (C_COMBINER_INDEXED_BLOCK,
+                    [count, blocklen] + [int(d) for d in displs], [],
+                    [int(oldh)])
+    dt.c_env_types = [old]
+    return MPI_SUCCESS
 
 
 def _h_type_dup(ctx, a):
     old = _dt(ctx, a[0])
     dt = Datatype(old.size_, old.np_dtype, old.name, old.extent_)
-    dt.c_segments = list(_segments_of(old))
+    dt.c_segments = _segments_of(old)
+    dt.c_basics = list(_basics_of(old))
+    dt.c_lb = int(getattr(old, "c_lb", 0))
+    dt.c_env = (C_COMBINER_DUP, [], [], [int(a[0])])
+    dt.c_env_types = [old]
     _write_i32(a[1], _new_dtype_handle(ctx, dt))
     return MPI_SUCCESS
 
@@ -2094,21 +2405,38 @@ def _h_type_subarray(ctx, a):
         total *= s
     for s in subs:
         nsub *= s
-    return _derived(ctx, out_addr, old, nsub * old.size_,
-                    total * old.extent_, segs, "subarray")
+    dt = _derived(ctx, out_addr, old, nsub * old.size_,
+                  total * old.extent_, segs, "subarray")
+    dt.c_basics = _basics_of(old)
+    osizes = _read_i32s(sizes_a, ndims)
+    osubs = _read_i32s(subs_a, ndims)
+    ostarts = _read_i32s(starts_a, ndims)
+    dt.c_env = (C_COMBINER_SUBARRAY,
+                [ndims] + osizes + osubs + ostarts + [int(order)], [],
+                [int(oldh)])
+    dt.c_env_types = [old]
+    return MPI_SUCCESS
 
 
 def _h_type_resized(ctx, a):
     old, lb, extent, out_addr = _dt(ctx, a[0]), int(a[1]), int(a[2]), a[3]
     dt = Datatype(old.size_, old.np_dtype, f"resized({old.name})",
                   extent)
-    dt.c_segments = list(_segments_of(old))
+    dt.c_segments = _segments_of(old)
+    dt.c_basics = list(_basics_of(old))
+    dt.c_lb = lb
+    dt.c_env = (C_COMBINER_RESIZED, [], [lb, extent], [int(a[0])])
+    dt.c_env_types = [old]
     _write_i32(out_addr, _new_dtype_handle(ctx, dt))
     return MPI_SUCCESS
 
 
 def _h_type_get_name(ctx, a):
     dt = _dt(ctx, a[0])
+    if int(a[3]):                # set mode
+        raw = ctypes.string_at(int(a[1]), 128).split(b"\0")[0]
+        dt.name = raw.decode(errors="replace")
+        return MPI_SUCCESS
     name = (dt.name or "").encode()[:127]
     ctypes.memmove(int(a[1]), name + b"\0", len(name) + 1)
     _write_i32(a[2], len(name))
@@ -2819,6 +3147,23 @@ def _h_intercomm_merge(ctx, a):
     return MPI_SUCCESS
 
 
+def _h_cancel(ctx, a):
+    """MPI_Cancel: succeeds only while the message/recv is unmatched
+    (the kernel comm still WAITING in the mailbox); a matched operation
+    completes normally and MPI_Test_cancelled reports false."""
+    req_addr = a[0]
+    h = ctypes.cast(int(req_addr), _pi32)[0] if req_addr else 0
+    if h == 0:
+        return MPI_SUCCESS
+    entry = ctx.reqs.get(int(h))
+    if entry is None:
+        return MPI_ERR_REQUEST
+    req = entry.inner if isinstance(entry, _CPersist) else entry.req
+    if req is not None and hasattr(req, "cancel"):
+        req.cancel()
+    return MPI_SUCCESS
+
+
 def _h_comm_remote_size(ctx, a):
     comm = _comm_of(ctx, a[0])
     if comm is None or not _is_inter(comm):
@@ -2851,6 +3196,237 @@ def _h_request_get_status(ctx, a):
     _write_i32(flag_addr, 1 if done else 0)
     if done:
         _status_from(st_addr, status)
+    return MPI_SUCCESS
+
+
+def _h_type_get_envelope(ctx, a):
+    dt = _dt(ctx, a[0])
+    env = getattr(dt, "c_env", None)
+    if env is None:
+        _write_i32(a[1], 0)
+        _write_i32(a[2], 0)
+        _write_i32(a[3], 0)
+        _write_i32(a[4], C_COMBINER_NAMED)
+        return MPI_SUCCESS
+    comb, ints, aints, dts = env
+    _write_i32(a[1], len(ints))
+    _write_i32(a[2], len(aints))
+    _write_i32(a[3], len(dts))
+    _write_i32(a[4], comb)
+    return MPI_SUCCESS
+
+
+def _h_type_get_contents(ctx, a):
+    dth, max_i, max_a, max_d = int(a[0]), int(a[1]), int(a[2]), int(a[3])
+    ints_a, aints_a, dts_a = a[4], a[5], a[6]
+    dt = _dt(ctx, dth)
+    env = getattr(dt, "c_env", None)
+    if env is None:
+        return MPI_ERR_ARG    # erroneous on a NAMED type (MPI-3 §4.1.13)
+    comb, ints, aints, handles = env
+    objs = getattr(dt, "c_env_types", None)
+    if ints_a:
+        pi = ctypes.cast(int(ints_a), _pi32)
+        for i, v in enumerate(ints[:max_i]):
+            pi[i] = int(v)
+    if aints_a:
+        pa = ctypes.cast(int(aints_a), _pi64)
+        for i, v in enumerate(aints[:max_a]):
+            pa[i] = int(v)
+    if dts_a:
+        pd = ctypes.cast(int(dts_a), _pi32)
+        for i, h in enumerate(handles[:max_d]):
+            h = int(h)
+            # predefined handles are returned as-is; a derived child
+            # gets a FRESH handle (the standard returns new references
+            # that survive the original being freed)
+            if h in _PREDEF_DTYPES:
+                pd[i] = h
+            else:
+                obj = (ctx.dtypes.get(h) if objs is None
+                       else objs[min(i, len(objs) - 1)])
+                pd[i] = _new_dtype_handle(ctx, obj) if obj is not None \
+                    else 0
+    return MPI_SUCCESS
+
+
+def _h_get_elements(ctx, a):
+    st_addr, dth, count_addr, mode = a[0], a[1], a[2], int(a[3])
+
+    def put(v):
+        if mode == 1:
+            _write_i64(count_addr, v)
+        else:
+            _write_i32(count_addr, v)
+
+    if mode == 2:                # MPI_Status_set_elements(_x)
+        dt = _dt(ctx, dth)
+        n = ctypes.cast(int(count_addr), _pi64)[0]
+        if st_addr:
+            ctypes.cast(int(st_addr), _pi32)[3] = \
+                int(min(n * dt.size_, 2**31 - 1))
+        return MPI_SUCCESS
+    if st_addr == 0:
+        put(0)
+        return MPI_SUCCESS
+    nbytes = ctypes.cast(int(st_addr), _pi32)[3]
+    dt = _dt(ctx, dth)
+    basics = _basics_of(dt)
+    if not basics or nbytes <= 0:
+        put(0)
+        return MPI_SUCCESS
+    per_full = sum(basics)
+    full = nbytes // per_full
+    rem = nbytes - full * per_full
+    n = full * len(basics)
+    for b in basics:
+        if rem >= b:
+            n += 1
+            rem -= b
+        else:
+            break
+    put(n)
+    return MPI_SUCCESS
+
+
+def _h_type_lbub(ctx, a):
+    dt = _dt(ctx, a[0])
+    mode = int(a[2])
+    lb = int(getattr(dt, "c_lb", 0))
+    if mode == 0:
+        val = lb
+    elif mode == 1:
+        val = lb + dt.extent_
+    else:
+        val = dt.extent_
+    _write_i64(a[1], val)
+    return MPI_SUCCESS
+
+
+def _h_type_darray(ctx, a):
+    size, rank, ndims = int(a[0]), int(a[1]), int(a[2])
+    gsizes = _read_i32s(a[3], ndims)
+    distribs = _read_i32s(a[4], ndims)
+    dargs = _read_i32s(a[5], ndims)
+    psizes = _read_i32s(a[6], ndims)
+    order = int(a[7])
+    oldh = a[8]
+    old = _dt(ctx, oldh)
+    out_addr = a[9]
+    # rank -> process coords in the psizes grid (C row-major)
+    coords = []
+    for d in range(ndims):
+        block = 1
+        for dd in range(d + 1, ndims):
+            block *= psizes[dd]
+        coords.append((rank // block) % psizes[d])
+    gs, ds, da, ps, co = gsizes, distribs, dargs, psizes, coords
+    if order == 57:              # MPI_ORDER_FORTRAN: mirror to C order
+        gs, ds, da, ps, co = (gs[::-1], ds[::-1], da[::-1], ps[::-1],
+                              co[::-1])
+    # per-dimension owned global indices (block / cyclic(b) / none)
+    idx = []
+    for g, dist, darg, p, c in zip(gs, ds, da, ps, co):
+        if dist == C_DISTRIBUTE_NONE:
+            own = list(range(g))
+        elif dist == C_DISTRIBUTE_CYCLIC:
+            b = 1 if darg == C_DISTRIBUTE_DFLT_DARG else darg
+            own = [i for start in range(c * b, g, p * b)
+                   for i in range(start, min(start + b, g))]
+        else:                    # MPI_DISTRIBUTE_BLOCK
+            b = ((g + p - 1) // p if darg == C_DISTRIBUTE_DFLT_DARG
+                 else darg)
+            own = list(range(c * b, min(c * b + b, g)))
+        idx.append(own)
+    strides = [1] * ndims
+    for d in range(ndims - 2, -1, -1):
+        strides[d] = strides[d + 1] * gs[d + 1]
+    segs = []
+    old_segs = _segments_of(old)
+
+    def walk(d, off):
+        if d == ndims:
+            base = off * old.extent_
+            segs.extend((base + o, n) for o, n in old_segs)
+            return
+        for i in idx[d]:
+            walk(d + 1, off + i * strides[d])
+
+    walk(0, 0)
+    nloc = 1
+    for own in idx:
+        nloc *= len(own)
+    total = 1
+    for g in gs:
+        total *= g
+    dt = _derived(ctx, out_addr, old, nloc * old.size_,
+                  total * old.extent_, segs, "darray")
+    dt.c_basics = _basics_of(old)
+    dt.c_env = (C_COMBINER_DARRAY,
+                [size, rank, ndims] + gsizes + distribs + dargs + psizes
+                + [order], [], [int(oldh)])
+    dt.c_env_types = [old]
+    return MPI_SUCCESS
+
+
+def _h_pack_external(ctx, a):
+    """external32 pack/unpack: identical layout to the native pack but
+    every basic element is byte-swapped to big-endian."""
+    typed_buf, count, dth, packed_buf, packed_size, pos_addr, mode = a[:7]
+    dt = _dt(ctx, dth)
+    mode = int(mode)
+    nbytes = int(count) * dt.size_
+    if mode == 2:                # MPI_Pack_external_size
+        _write_i64(pos_addr, nbytes)
+        return MPI_SUCCESS
+    basics = _basics_of(dt) or [1]
+    per = sum(basics)
+    # packed elements may carry trailing ABI padding (the pair types
+    # ship their padded C struct: size_ 16 vs MPI size 12 for
+    # MPI_DOUBLE_INT): swap the basic elements, pass padding through
+    pad = dt.size_ - per \
+        if per and int(getattr(dt, "c_mpi_size", dt.size_)) != dt.size_ \
+        else 0
+
+    def swap(data):
+        out = bytearray(data)
+        i = 0
+        while i < len(out):
+            for b in basics:
+                if i + b > len(out):
+                    return bytes(out[:len(data)])
+                out[i:i + b] = data[i:i + b][::-1]
+                i += b
+            i += pad             # padding bytes stay as-is
+        return bytes(out)
+
+    pos = ctypes.cast(int(pos_addr), _pi64)[0]
+    if mode == 0:                # pack
+        if pos + nbytes > int(packed_size):
+            return MPI_ERR_OTHER
+        arr = _arr_in(typed_buf, count, dt)
+        data = swap(np.ascontiguousarray(arr).tobytes())
+        if nbytes:
+            ctypes.memmove(int(packed_buf) + pos, data, nbytes)
+    else:                        # unpack
+        raw = ctypes.string_at(int(packed_buf) + pos, nbytes) if nbytes \
+            else b""
+        arr = np.frombuffer(bytearray(swap(raw)), np.uint8)
+        _arr_out(typed_buf, arr, dt=dt)
+    ctypes.cast(int(pos_addr), _pi64)[0] = pos + nbytes
+    return MPI_SUCCESS
+
+
+_MATCH_SIZE = {(1, 4): 43, (1, 8): 44, (1, 16): 45,
+               (2, 1): 49, (2, 2): 50, (2, 4): 51, (2, 8): 52,
+               (3, 8): 46, (3, 16): 47, (3, 32): 48}
+
+
+def _h_type_match_size(ctx, a):
+    h = _MATCH_SIZE.get((int(a[0]), int(a[1])))
+    if h is None:
+        return MPI_ERR_ARG
+    _write_i32(a[2], h)
     return MPI_SUCCESS
 
 
@@ -2906,7 +3482,10 @@ _HANDLERS = {
     139: _h_group_setop, 140: _h_group_translate,
     141: _h_group_compare, 142: _h_comm_compare,
     143: _h_intercomm_create, 144: _h_intercomm_merge,
-    145: _h_comm_remote_size, 146: _h_comm_test_inter,
+    145: _h_comm_remote_size, 146: _h_comm_test_inter, 147: _h_cancel,
+    148: _h_type_get_envelope, 149: _h_type_get_contents,
+    150: _h_get_elements, 151: _h_type_lbub, 152: _h_type_darray,
+    153: _h_pack_external, 154: _h_type_match_size,
 }
 
 #: ops that are pure local queries — no bench end/begin cycle needed

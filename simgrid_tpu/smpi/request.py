@@ -120,6 +120,7 @@ class Request:
         self._dst_slot: Optional[list] = None
         self._me = me
         self.finished = False
+        self.cancelled = False
 
     # ------------------------------------------------------------------
     def start(self) -> "Request":
@@ -238,7 +239,18 @@ class Request:
             return result
 
     def _wait_inner(self, status: Optional[Status] = None):
+        if self.cancelled:
+            if status is not None:
+                status.cancelled = True
+            return None
         if self.finished:
+            # a prior test/get_status already completed the op; replay
+            # the reception status (MPI_Request_get_status then
+            # MPI_Wait must both see source/tag/count — pt2pt/rqstatus)
+            if status is not None and self.kind == "recv":
+                status.source = self.real_src
+                status.tag = self.real_tag
+                status.count = self.real_size
             return self._result()
         if self.kind == "send" and self.detached:
             self._finish(status)
@@ -258,7 +270,15 @@ class Request:
             return self._test_inner(status, visible, tr)
 
     def _test_inner(self, status, visible=False, tr=None) -> bool:
+        if self.cancelled:
+            if status is not None:
+                status.cancelled = True
+            return True
         if self.finished:
+            if status is not None and self.kind == "recv":
+                status.source = self.real_src
+                status.tag = self.real_tag
+                status.count = self.real_size
             return True
         if self.kind == "send" and self.detached:
             self._finish(status)
@@ -282,14 +302,22 @@ class Request:
         return bool(res)
 
     def cancel(self) -> None:
-        if self.pimpl is not None and not self.finished:
-            issuer = self._me.actor_impl
-            comm_impl = self.pimpl
+        """MPI_Cancel: succeeds only while the operation is unmatched —
+        the kernel comm still WAITING in its mailbox (MPI-3.0 §3.8.4);
+        a matched operation completes normally and Test_cancelled
+        reports False."""
+        if self.finished or self.cancelled or self.pimpl is None:
+            return
+        issuer = self._me.actor_impl
+        comm_impl = self.pimpl
 
-            def handler(sc):
+        def handler(sc):
+            if comm_impl.state == kact.State.WAITING:
                 comm_impl.cancel()
-                sc.issuer.simcall_answer()
-            issuer.simcall("comm_cancel", handler)
+            sc.issuer.simcall_answer()
+        issuer.simcall("comm_cancel", handler)
+        if comm_impl.state == kact.State.CANCELED:
+            self.cancelled = True
             self.finished = True
 
     def _result(self):

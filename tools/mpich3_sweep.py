@@ -1,5 +1,5 @@
 """Sweep mpich3-test/coll: compile+run each test in a subprocess."""
-import glob, os, subprocess, sys, json
+import glob, os, re as _re, subprocess, sys, json
 
 M = "/root/reference/teshsuite/smpi/mpich3-test"
 DIR = sys.argv[1] if len(sys.argv) > 1 else "coll"
@@ -25,12 +25,20 @@ assert all(c == 0 for c in codes.values()), codes
 """
     try:
         r = subprocess.run([sys.executable, "-c", code],
-                           capture_output=True, text=True, timeout=180)
+                           capture_output=True, text=True, timeout=330)
     except subprocess.TimeoutExpired:
         OUT[name] = "timeout"
         print(f"{name:28s} timeout", flush=True)
         continue
-    ok = r.returncode == 0 and "no errors" in r.stdout.lower()
+    out_l = r.stdout.lower()
+    # a few tests are output-only and never print the mtest "No Errors"
+    # banner; for those alone a clean exit with no error markers passes
+    OUTPUT_ONLY = {"zero-blklen-vector", "zeroblks"}
+    ok = r.returncode == 0 and (
+        "no errors" in out_l
+        or (name in OUTPUT_ONLY
+            and not _re.search(r"\berrors?\b|\bfail|abort|deadlock",
+                               out_l)))
     OUT[name] = "PASS" if ok else (
         "compile-fail" if "smpicc failed" in r.stderr else "fail")
     print(f"{name:28s} {OUT[name]} (np={np_ranks})", flush=True)
