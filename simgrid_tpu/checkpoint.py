@@ -1,0 +1,92 @@
+"""Simulation checkpoint/resume via deterministic re-execution.
+
+The reference checkpoints by copying dirty memory pages of the whole
+simulated process (src/mc/sosp/PageStore.hpp:62-97) — a design forced
+by C actor stacks that cannot be rebuilt any other way.  This kernel
+is deterministic by construction (serial scheduling rounds, FIFO
+simcall answering, deterministic solver), so a checkpoint does not
+need the memory image at all: it is the pair
+
+    (how to rebuild the simulation, the simulated date reached)
+
+and resuming is rebuilding + fast-forwarding with Engine.run_until —
+bit-identical state by determinism, the same argument that lets the
+model checker re-execute instead of snapshotting (mc/explorer.py).
+Tokens pickle to a few hundred bytes and survive process restarts,
+which page-store snapshots cannot.
+
+Contract: `setup` must be an importable module-level callable that
+builds the engine (platform + actors) from its arguments and returns
+the s4u Engine, without consuming wall-clock entropy (no real RNG /
+time dependence — the usual determinism requirement).
+"""
+
+from __future__ import annotations
+
+import importlib
+import pickle
+from typing import Any, Optional, Tuple
+
+
+class Checkpoint:
+    """A resumable point of a deterministic simulation."""
+
+    def __init__(self, setup, args: Tuple = (), at: float = 0.0):
+        if not callable(setup):
+            raise TypeError("setup must be a callable building the engine")
+        self._module = setup.__module__
+        self._qualname = setup.__qualname__
+        if "<" in self._qualname:    # <lambda>, <locals> — not importable
+            raise TypeError(
+                "setup must be an importable module-level callable "
+                f"(got {self._qualname!r}); lambdas and closures cannot "
+                "be resolved when the checkpoint is loaded later")
+        self.args = tuple(args)
+        self.at = float(at)
+
+    # -- capture -------------------------------------------------------
+    @classmethod
+    def capture(cls, setup, args: Tuple = (), at: float = 0.0):
+        """Build the simulation, advance it to `at`, and return
+        (engine paused at `at`, checkpoint token).  The caller may keep
+        running the engine; the token is independent of it."""
+        token = cls(setup, args, at)
+        engine = token._rebuild()
+        engine.run_until(at)
+        return engine, token
+
+    # -- resume --------------------------------------------------------
+    def _rebuild(self):
+        from .s4u import Engine
+        Engine._reset()
+        fn = importlib.import_module(self._module)
+        for part in self._qualname.split("."):
+            fn = getattr(fn, part)
+        engine = fn(*self.args)
+        if engine is None or not hasattr(engine, "run_until"):
+            raise TypeError("setup must return the s4u Engine it built")
+        return engine
+
+    def resume(self):
+        """Rebuild the simulation and fast-forward to the checkpointed
+        date; returns the engine paused there, ready for run()."""
+        engine = self._rebuild()
+        engine.run_until(self.at)
+        return engine
+
+    # -- persistence ---------------------------------------------------
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            pickle.dump({"module": self._module, "qualname": self._qualname,
+                         "args": self.args, "at": self.at}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "Checkpoint":
+        with open(path, "rb") as f:
+            d = pickle.load(f)
+        token = cls.__new__(cls)
+        token._module = d["module"]
+        token._qualname = d["qualname"]
+        token.args = tuple(d["args"])
+        token.at = float(d["at"])
+        return token

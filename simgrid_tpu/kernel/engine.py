@@ -234,7 +234,9 @@ class EngineImpl:
     # ------------------------------------------------------------------
     def surf_solve(self, max_date: float) -> float:
         time_delta = -1.0
-        if max_date > 0.0:
+        # >= 0: a bound AT the current date (run_until(now), timers at
+        # t=0) means a zero-length advance, not an unbounded one
+        if max_date >= 0.0:
             assert max_date >= self.now, \
                 f"You asked to simulate up to {max_date} but that's in the past"
             time_delta = max_date - self.now
@@ -331,7 +333,10 @@ class EngineImpl:
     # ------------------------------------------------------------------
     # The main loop (SIMIX_run, smx_global.cpp:377-529)
     # ------------------------------------------------------------------
-    def run(self) -> None:
+    def run(self, until: float = -1.0) -> None:
+        """Run the simulation; with `until` >= 0, pause once the clock
+        reaches that date (reference Engine::run_until) leaving the
+        kernel state intact so run() can be called again."""
         time = 0.0
         while True:
             self._execute_tasks()
@@ -355,7 +360,11 @@ class EngineImpl:
                     for dmon in list(self.daemons):
                         self.maestro.kill(dmon)
 
+            if until >= 0.0 and self.now >= until:
+                return               # already at/past the pause date
             time = self.next_timer_date()
+            if until >= 0.0 and (time < 0.0 or time > until):
+                time = until
             if time > -1.0 or self.process_list:
                 time = self.surf_solve(time)
 
@@ -367,6 +376,9 @@ class EngineImpl:
                 self._wake_processes()
 
             self._empty_trash()
+
+            if until >= 0.0 and self.now >= until and not self.actors_to_run:
+                return               # paused at the requested date
 
             if not (time > -1.0 or self.actors_to_run):
                 break

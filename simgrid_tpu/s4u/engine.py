@@ -179,6 +179,14 @@ class Engine:
         return list(self.pimpl.netpoints.values())
 
     # -- run ---------------------------------------------------------------
+    def run_until(self, date: float) -> None:
+        """Advance the simulation up to `date` and pause (the kernel
+        state stays live; call run()/run_until() again to continue)."""
+        if config["tracing"]:
+            from .. import instr
+            instr.start(self.pimpl)
+        self.pimpl.run(until=date)
+
     def run(self) -> None:
         if config["tracing"]:
             from .. import instr

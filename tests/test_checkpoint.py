@@ -1,0 +1,85 @@
+"""Checkpoint/resume via deterministic re-execution
+(simgrid_tpu/checkpoint.py; the reference's page-store snapshot role,
+src/mc/sosp/PageStore.hpp:62-97, redesigned for a deterministic
+kernel)."""
+
+import os
+
+import pytest
+
+from simgrid_tpu import s4u
+from simgrid_tpu.checkpoint import Checkpoint
+
+PLATFORM = "/root/reference/examples/platforms/cluster_fat_tree.xml"
+
+pytestmark = pytest.mark.skipif(not os.path.exists(PLATFORM),
+                                reason="reference platforms unavailable")
+
+
+def build_masterworkers(n_workers=4, n_tasks=60):
+    """Module-level setup (importable => picklable by reference)."""
+    from examples import masterworkers
+    e = s4u.Engine(["ckpt"])
+    e.load_platform(PLATFORM)
+    masterworkers.deploy(e, n_workers, n_tasks=n_tasks)
+    return e
+
+
+@pytest.fixture(autouse=True)
+def fresh_engine():
+    s4u.Engine._reset()
+    yield
+    s4u.Engine._reset()
+
+
+def _full_run_clock():
+    e = build_masterworkers()
+    e.run()
+    return e.clock
+
+
+def test_run_until_pauses_and_continues():
+    ref_clock = _full_run_clock()
+    s4u.Engine._reset()
+    e = build_masterworkers()
+    e.run_until(ref_clock / 3)
+    assert abs(e.clock - ref_clock / 3) < 1e-9
+    assert e.pimpl.process_list, "actors must still be alive mid-run"
+    e.run()
+    assert e.clock == ref_clock          # bit-identical completion
+
+
+def test_checkpoint_resume_bit_identical(tmp_path):
+    ref_clock = _full_run_clock()
+    s4u.Engine._reset()
+
+    # capture mid-run, keep running the captured engine to completion
+    engine, token = Checkpoint.capture(build_masterworkers,
+                                       at=ref_clock / 2)
+    assert abs(engine.clock - ref_clock / 2) < 1e-9
+    engine.run()
+    assert engine.clock == ref_clock
+
+    # persist the token, reload in a "new session", resume, finish
+    path = str(tmp_path / "mw.ckpt")
+    token.save(path)
+    s4u.Engine._reset()
+    token2 = Checkpoint.load(path)
+    assert token2.at == token.at
+    resumed = token2.resume()
+    assert abs(resumed.clock - token.at) < 1e-9
+    resumed.run()
+    assert resumed.clock == ref_clock    # bit-identical final timestamp
+
+
+def test_checkpoint_mid_run_state_is_live(tmp_path):
+    """The resumed engine is a live simulation: actors are blocked on
+    real activities and the mailbox state matches a fresh run."""
+    ref_clock = _full_run_clock()
+    s4u.Engine._reset()
+    token = Checkpoint(build_masterworkers, at=ref_clock / 4)
+    resumed = token.resume()
+    assert resumed.pimpl.process_list
+    resumed.run_until(ref_clock / 2)
+    resumed.run()
+    assert resumed.clock == ref_clock
