@@ -1,0 +1,61 @@
+"""s4u-actor-join replica (reference
+examples/s4u/actor-join/s4u-actor-join.cpp): joins with timeouts, join
+after termination."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_test")
+
+
+def sleeper():
+    LOG.info("Sleeper started")
+    s4u.this_actor.sleep_for(3)
+    LOG.info("I'm done. See you!")
+
+
+def master():
+    host = s4u.this_actor.get_host()
+    LOG.info("Start sleeper")
+    actor = s4u.Actor.create("sleeper from master", host, sleeper)
+    LOG.info("Join the sleeper (timeout 2)")
+    actor.join(2)
+
+    LOG.info("Start sleeper")
+    actor = s4u.Actor.create("sleeper from master", host, sleeper)
+    LOG.info("Join the sleeper (timeout 4)")
+    actor.join(4)
+
+    LOG.info("Start sleeper")
+    actor = s4u.Actor.create("sleeper from master", host, sleeper)
+    LOG.info("Join the sleeper (timeout 2)")
+    actor.join(2)
+
+    LOG.info("Start sleeper")
+    actor = s4u.Actor.create("sleeper from master", host, sleeper)
+    LOG.info("Waiting 4")
+    s4u.this_actor.sleep_for(4)
+    LOG.info("Join the sleeper after its end (timeout 1)")
+    actor.join(1)
+
+    LOG.info("Goodbye now!")
+    s4u.this_actor.sleep_for(1)
+    LOG.info("Goodbye now!")
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    s4u.Actor.create("master", e.host_by_name("Tremblay"), master)
+    e.run()
+    LOG.info("Simulation time %g" % e.clock)
+
+
+if __name__ == "__main__":
+    main()

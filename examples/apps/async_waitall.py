@@ -1,0 +1,57 @@
+"""s4u-async-waitall replica (reference
+examples/s4u/async-waitall/s4u-async-waitall.cpp): the sender launches
+every put_async up front and waits for all of them in one call; the
+reference tesh pins the arrival interleaving."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_async_waitall")
+
+
+def sender(*args):
+    messages_count, msg_size, receivers_count = \
+        int(args[0]), float(args[1]), int(args[2])
+    mboxes = [s4u.Mailbox.by_name(f"receiver-{i}")
+              for i in range(receivers_count)]
+    pending = []
+    for i in range(messages_count):
+        content = f"Message {i}"
+        LOG.info(f"Send '{content}' to '{mboxes[i % receivers_count].name}'")
+        pending.append(mboxes[i % receivers_count].put_async(content,
+                                                             msg_size))
+    for i in range(receivers_count):
+        LOG.info(f"Send 'finalize' to 'receiver-{i}'")
+        pending.append(mboxes[i].put_async("finalize", 0))
+    LOG.info("Done dispatching all messages")
+    s4u.Comm.wait_all(pending)
+    LOG.info("Goodbye now!")
+
+
+def receiver(*args):
+    mbox = s4u.Mailbox.by_name(f"receiver-{args[0]}")
+    LOG.info("Wait for my first message")
+    while True:
+        received = mbox.get()
+        LOG.info(f"I got a '{received}'.")
+        if received == "finalize":
+            break
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.register_function("sender", sender)
+    e.register_function("receiver", receiver)
+    e.load_platform(sys.argv[1])
+    e.load_deployment(sys.argv[2])
+    e.run()
+
+
+if __name__ == "__main__":
+    main()

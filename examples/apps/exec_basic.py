@@ -1,0 +1,37 @@
+"""s4u-exec-basic replica (reference
+examples/s4u/exec-basic/s4u-exec-basic.cpp): two executions sharing a
+host, one with priority 2 (1/3 vs 2/3 sharing until the privileged one
+ends)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_test")
+
+
+def executor():
+    s4u.this_actor.execute(98095)
+    LOG.info("Done.")
+
+
+def privileged():
+    s4u.this_actor.execute(98095, priority=2)
+    LOG.info("Done.")
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    s4u.Actor.create("executor", e.host_by_name("Tremblay"), executor)
+    s4u.Actor.create("privileged", e.host_by_name("Tremblay"), privileged)
+    e.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""s4u-app-pingpong replica (reference
+examples/s4u/app-pingpong/s4u-app-pingpong.cpp): latency-bound ping,
+bandwidth-bound pong, identical log lines so the reference tesh oracle
+(s4u-app-pingpong.tesh) pins this program's output verbatim."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("pingpong")
+
+
+def pinger(mailbox_in, mailbox_out):
+    LOG.info("Ping from mailbox %s to mailbox %s"
+             % (mailbox_in.name, mailbox_out.name))
+    mailbox_out.put(s4u.Engine.get_clock(), 1)
+    sender_time = mailbox_in.get()
+    communication_time = s4u.Engine.get_clock() - sender_time
+    LOG.info("Task received : large communication (bandwidth bound)")
+    LOG.info("Pong time (bandwidth bound): %.3f" % communication_time)
+
+
+def ponger(mailbox_in, mailbox_out):
+    LOG.info("Pong from mailbox %s to mailbox %s"
+             % (mailbox_in.name, mailbox_out.name))
+    sender_time = mailbox_in.get()
+    communication_time = s4u.Engine.get_clock() - sender_time
+    LOG.info("Task received : small communication (latency bound)")
+    LOG.info(" Ping time (latency bound) %f" % communication_time)
+    payload = s4u.Engine.get_clock()
+    LOG.info("task_bw->data = %.3f" % payload)
+    mailbox_out.put(payload, 1e9)
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    mb1 = s4u.Mailbox.by_name("Mailbox 1")
+    mb2 = s4u.Mailbox.by_name("Mailbox 2")
+    s4u.Actor.create("pinger", e.host_by_name("Tremblay"), pinger, mb1, mb2)
+    s4u.Actor.create("ponger", e.host_by_name("Jupiter"), ponger, mb2, mb1)
+    e.run()
+    LOG.info("Total simulation time: %.3f" % e.clock)
+
+
+if __name__ == "__main__":
+    main()

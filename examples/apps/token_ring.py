@@ -1,0 +1,49 @@
+"""s4u-app-token-ring replica (reference
+examples/s4u/app-token-ring/s4u-app-token-ring.cpp): a 1MB token
+travels the ring of all hosts; the reference tesh pins every hop's
+timestamp."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_app_token_ring")
+TOKEN_SIZE = 1_000_000
+
+
+def relay(n_hosts):
+    rank = int(s4u.this_actor.get_name())
+    my_mailbox = s4u.Mailbox.by_name(str(rank))
+    neighbor = s4u.Mailbox.by_name(
+        "0" if rank + 1 == n_hosts else str(rank + 1))
+    if rank == 0:
+        LOG.info('Host "%u" send \'Token\' to Host "%s"'
+                 .replace("%u", str(rank)).replace("%s", neighbor.name))
+        neighbor.put("Token", TOKEN_SIZE)
+        res = my_mailbox.get()
+        LOG.info(f'Host "{rank}" received "{res}"')
+    else:
+        res = my_mailbox.get()
+        LOG.info(f'Host "{rank}" received "{res}"')
+        LOG.info(f'Host "{rank}" send \'Token\' to Host "{neighbor.name}"')
+        neighbor.put(res, TOKEN_SIZE)
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    hosts = e.get_all_hosts()
+    LOG.info("Number of hosts '%d'" % len(hosts))
+    for i, host in enumerate(hosts):
+        s4u.Actor.create(str(i), host, relay, len(hosts))
+    e.run()
+    LOG.info("Simulation time %g" % e.clock)
+
+
+if __name__ == "__main__":
+    main()

@@ -64,7 +64,7 @@ class EngineImpl:
         self.watched_hosts: set = set()
 
         self.context_factory = ContextFactory()
-        self._pid = 1
+        self._pid = 1        # maestro takes pid 0 below; users start at 1
         self._mc_seq = 0
         #: weakrefs to mutex/semaphore/condvar impls, for MC snapshots
         self.mc_sync_objects: list = []
@@ -72,6 +72,7 @@ class EngineImpl:
         self.mc_notes: dict = {}
         self.maestro = ActorImpl(self, "maestro", None)
         self.maestro.pid = 0
+        self._pid = 1        # maestro consumed pid 1; reclaim it
         self.actors_to_run: List[ActorImpl] = []
         self.actors_that_ran: List[ActorImpl] = []
         self.process_list: Dict[int, ActorImpl] = {}
@@ -94,6 +95,14 @@ class EngineImpl:
         # support many engines per process for tests/MC branches).
         self._signal_connections: List = []
         _log.clock_getter = lambda: self.now
+
+        def actor_info():
+            actor = self.context_factory.current_actor
+            if actor is None:
+                return (0, "maestro", "")
+            return (actor.pid, actor.name,
+                    actor.host.name if actor.host else "")
+        _log.actor_info_getter = actor_info
 
     # -- engine-scoped signal subscriptions ------------------------------
     def connect_signal(self, signal, fn) -> None:

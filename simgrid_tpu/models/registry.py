@@ -69,6 +69,10 @@ def _register_defaults() -> None:
 
     cpu_models["Cas01"] = init_cas01
     host_models["default"] = HostCLM03Model
+    # 'compound' = separate cpu+network models composed by the host
+    # model — exactly what HostCLM03Model does (sg_config.cpp treats
+    # default as compound when cpu/network are set explicitly)
+    host_models["compound"] = HostCLM03Model
     storage_models["default"] = StorageN11Model
 
 

@@ -48,6 +48,9 @@ def _make_zone(engine, father, name: str, routing: str) -> NetZoneImpl:
         return EmptyZone(engine, father, name)
     if routing == "Vivaldi":
         return VivaldiZone(engine, father, name)
+    if routing == "Cluster":
+        from ..routing.cluster import ClusterZone
+        return ClusterZone(engine, father, name)
     raise ParseError(f"Unknown zone routing '{routing}'")
 
 
@@ -127,6 +130,8 @@ class PlatformLoader:
                 self.trace_connect_list.append(dict(child.attrib))
             elif tag == "backbone":
                 self._parse_backbone(child, zone)
+            elif tag == "host_link":
+                self._parse_host_link(child, zone)
             elif tag in ("storage_type", "storage", "mount", "disk"):
                 self._parse_storage(child, zone)
             else:
@@ -244,6 +249,35 @@ class PlatformLoader:
     def _parse_peer(self, elem, zone) -> None:
         from ..routing.cluster import parse_peer_tag
         parse_peer_tag(self, elem, zone)
+
+    def _parse_host_link(self, elem, zone) -> None:
+        """<host_link id=... up=... down=...> inside a manual
+        routing="Cluster" zone: attach the host's private link pair
+        (sg_platf_new_hostlink, sg_platf.cpp)."""
+        host_name = elem.get("id")
+        host = self.engine.hosts.get(host_name)
+        if host is None:
+            raise ParseError(f"<host_link> references unknown host "
+                             f"'{host_name}'")
+        if host.netpoint.englobing_zone is not zone:
+            raise ParseError(f"<host_link> host '{host_name}' does not "
+                             f"belong to cluster zone '{zone.name}'")
+
+        def link_of(attr):
+            name = elem.get(attr)
+            link = self.engine.links.get(name)
+            if link is None:
+                raise ParseError(f"<host_link> references unknown link "
+                                 f"'{name}'")
+            return getattr(link, "pimpl", link)
+
+        netpoint = host.netpoint
+        if netpoint.id in zone.node_rank:
+            raise ParseError(f"Duplicate <host_link> for '{host_name}'")
+        rank = len(zone.node_rank)
+        zone.node_rank[netpoint.id] = rank
+        zone.add_private_link(zone.node_pos(rank), link_of("up"),
+                              link_of("down"))
 
     def _parse_backbone(self, elem, zone) -> None:
         name = elem.get("id")

@@ -235,7 +235,30 @@ def parse_cluster_tag(loader, elem, father) -> None:
 
 
 def parse_cabinet_tag(loader, elem, father) -> None:
-    raise ParseError("<cabinet> is not supported yet")
+    """<cabinet>: per-host SPLITDUPLEX private links inside a Cluster
+    zone (reference sg_platf_new_cabinet, sg_platf.cpp:307-332: one
+    host + one link_<host>_UP/_DOWN pair per radical entry)."""
+    from ..models.host import Host
+    from ..platform.units import parse_bandwidth, parse_speeds, parse_time
+    from .zone import NetPoint, NetPointType
+    prefix = elem.get("prefix", "")
+    suffix = elem.get("suffix", "")
+    speeds = parse_speeds(elem.get("speed"))
+    bw = parse_bandwidth(elem.get("bw"))
+    lat = parse_time(elem.get("lat"))
+    engine = loader.engine
+    for radical in parse_radical(elem.get("radical")):
+        hostname = f"{prefix}{radical}{suffix}"
+        host = Host(engine, hostname)
+        host.netpoint = NetPoint(engine, hostname, NetPointType.HOST,
+                                 father)
+        engine.cpu_model.create_cpu(host, speeds, 1)
+        Host.on_creation(host)     # plugins key off this signal
+        up, down = make_duplex_link(engine, f"link_{hostname}", bw, lat,
+                                    "SPLITDUPLEX")
+        rank = len(father.node_rank)
+        father.node_rank[host.netpoint.id] = rank
+        father.add_private_link(father.node_pos(rank), up, down)
 
 
 def parse_peer_tag(loader, elem, father) -> None:

@@ -37,8 +37,19 @@ class Actor:
     @staticmethod
     def create(name: str, host, code: Callable, *args, **kwargs) -> "Actor":
         engine = Engine.get_instance().pimpl
-        pimpl = engine.create_actor(name, host,
-                                    lambda: code(*args, **kwargs))
+        current = engine.context_factory.current_actor
+        fn = lambda: code(*args, **kwargs)
+        if current is None:
+            pimpl = engine.create_actor(name, host, fn)
+        else:
+            # in-simulation creation is a simcall (reference
+            # simcall_process_create): the parent yields, so the child
+            # runs before the parent's next statement — the actor-join
+            # tesh oracle pins that interleaving
+            def handler(sc):
+                sc.result = engine.create_actor(name, host, fn)
+                sc.issuer.simcall_answer()
+            pimpl = current.simcall("actor_create", handler)
         return Actor(pimpl)
 
     @staticmethod

@@ -54,9 +54,14 @@ class Engine:
         be created (mainly for test harnesses; one engine per process in
         normal use, like the reference)."""
         from ..kernel import profile as profile_mod
+        from ..utils import log as _xlog
         from .mailbox import Mailbox
         from .. import instr
         instr.stop()
+        # drop the dead engine's log context closures (they pin the
+        # whole platform in memory and would render stale actor info)
+        _xlog.clock_getter = None
+        _xlog.actor_info_getter = None
         if cls._instance is not None:
             cls._instance.pimpl.disconnect_signals()
             cls._instance.pimpl.shutdown_contexts()
@@ -156,7 +161,10 @@ class Engine:
         return self.pimpl.hosts.get(name)
 
     def get_all_hosts(self) -> List:
-        return list(self.pimpl.hosts.values())
+        # name-sorted like the reference (its host registry is a
+        # std::map, Engine::get_all_hosts iterates in name order — the
+        # token-ring tesh oracle pins the resulting actor placement)
+        return [h for _, h in sorted(self.pimpl.hosts.items())]
 
     def get_host_count(self) -> int:
         return len(self.pimpl.hosts)

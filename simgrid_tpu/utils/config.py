@@ -123,21 +123,45 @@ class Config:
 
     # -- command line -----------------------------------------------------
     def set_from_string(self, opt: str) -> None:
-        """Parse one ``key:value`` option (the --cfg= payload)."""
-        if ":" not in opt:
-            raise ConfigError(f"Invalid --cfg option '{opt}', expected key:value")
-        key, value = opt.split(":", 1)
-        self.set(key.strip(), value.strip())
+        """Parse a --cfg= payload: one ``key:value``, or several
+        space-separated ones (the reference accepts
+        --cfg='a:x b:y c:z')."""
+        from . import log as _log
+        # A payload with spaces is a multi-option list ONLY if every
+        # token looks like key:value — otherwise the whole payload is
+        # one value that happens to contain spaces.
+        tokens = [opt]
+        if " " in opt:
+            parts = opt.split()
+            if all(":" in t for t in parts):
+                tokens = parts
+        for token in tokens:
+            if ":" not in token:
+                raise ConfigError(
+                    f"Invalid --cfg option '{token}', expected key:value")
+            key, value = token.split(":", 1)
+            self.set(key.strip(), value.strip())
+            # reference simgrid::config logs every CLI change (the tesh
+            # oracles pin these lines)
+            _log.get_category("xbt_cfg").info(
+                "Configuration change: Set '%s' to '%s'"
+                % (key.strip(), value.strip()))
 
     def parse_argv(self, argv: List[str]) -> List[str]:
-        """Consume --cfg=... / --log=... / --help-cfg from argv, returning the rest."""
+        """Consume --cfg=... / --log=... / --help-cfg from argv,
+        returning the rest.  Log controls apply FIRST (like the
+        reference's early log_init) so the configuration-change lines
+        already use the requested layout."""
         from . import log as _log
+        for arg in argv:
+            if arg.startswith("--log="):
+                _log.apply_control(arg[len("--log="):])
         remaining: List[str] = []
         for arg in argv:
             if arg.startswith("--cfg="):
                 self.set_from_string(arg[len("--cfg="):])
             elif arg.startswith("--log="):
-                _log.apply_control(arg[len("--log="):])
+                pass
             elif arg == "--help-cfg":
                 self.dump(sys.stdout)
             else:

@@ -35,6 +35,8 @@ _LEVEL_NAMES = {TRACE: "trace", DEBUG: "debug", VERBOSE: "verbose",
 clock_getter: Optional[Callable[[], float]] = None
 #: hook returning "host:actor:(pid)" for the current context.
 context_getter: Optional[Callable[[], str]] = None
+#: () -> (pid, actor_name, host_name) for the %i/%P/%h layout codes
+actor_info_getter: Optional[Callable[[], tuple]] = None
 
 _categories: Dict[str, "Category"] = {}
 
@@ -78,7 +80,8 @@ def render_layout(fmt: str, category: str, level_name: str,
     """The %-pattern layout language (xbt_log_layout_format.cpp):
     %r simulated clock (width.precision honored), %c category,
     %p priority, %m message, %n newline, %e space, %a actor context,
-    %% literal percent. Unknown specifiers render verbatim."""
+    %i actor pid, %P actor name, %h host name, %% literal percent.
+    Unknown specifiers render verbatim."""
     out = []
     i = 0
     while i < len(fmt):
@@ -113,6 +116,11 @@ def render_layout(fmt: str, category: str, level_name: str,
             out.append(" ")
         elif code == "a":
             out.append(context_getter() if context_getter else "")
+        elif code in "iPh":
+            pid, aname, hname = (actor_info_getter()
+                                 if actor_info_getter else (0, "", ""))
+            out.append(str(pid) if code == "i"
+                       else aname if code == "P" else hname)
         elif code == "%":
             out.append("%")
         else:
@@ -158,8 +166,15 @@ class Category:
             if not line.endswith("\n"):
                 line += "\n"
         else:
+            # default layout = the reference's xbt_log_layout_simple:
+            # "[host:actor:(pid) clock] [cat/level] msg" with the
+            # actor part dropped for maestro (tesh oracles pin it)
             parts = []
-            if context_getter is not None:
+            if actor_info_getter is not None:
+                pid, aname, hname = actor_info_getter()
+                if pid:
+                    parts.append(f"{hname}:{aname}:({pid})")
+            elif context_getter is not None:
                 parts.append(context_getter())
             if clock_getter is not None:
                 parts.append(f"{clock_getter():.6f}")

@@ -1,0 +1,26 @@
+"""Golden-output corpus: every examples/tesh/*.tesh file reproduces a
+reference tesh oracle's pinned timestamps (reference model:
+examples/s4u/*/*.tesh, run by tools/tesh.py)."""
+
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORPUS = sorted(glob.glob(os.path.join(ROOT, "examples", "tesh", "*.tesh")))
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir("/root/reference/examples/platforms"),
+    reason="reference platforms unavailable")
+
+
+@pytest.mark.parametrize("path", CORPUS,
+                         ids=[os.path.basename(p) for p in CORPUS])
+def test_tesh(path):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "tesh.py"), path],
+        capture_output=True, text=True, cwd=ROOT, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-3000:]

@@ -54,8 +54,11 @@ def run_cmd(cmd: Cmd, env: dict, verbose: bool) -> bool:
     if verbose:
         print(f"[tesh] $ {args}", file=sys.stderr)
     try:
+        # the reference tesh merges stdout+stderr (log appenders write
+        # to stderr; the oracles pin those lines)
         proc = subprocess.run(
-            args, shell=True, text=True, capture_output=True,
+            args, shell=True, text=True, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
             input="\n".join(cmd.input) + ("\n" if cmd.input else ""),
             timeout=cmd.timeout, env={**os.environ, **env})
     except subprocess.TimeoutExpired:
@@ -64,7 +67,7 @@ def run_cmd(cmd: Cmd, env: dict, verbose: bool) -> bool:
     if proc.returncode != cmd.expect_return:
         print(f"Command returned {proc.returncode}, expected "
               f"{cmd.expect_return}: {args}", file=sys.stderr)
-        sys.stderr.write(proc.stdout + proc.stderr)
+        sys.stderr.write(proc.stdout)
         return False
     if cmd.ignore_output:
         return True
