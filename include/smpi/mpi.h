@@ -468,6 +468,9 @@ int MPI_Type_set_name(MPI_Datatype datatype, const char* name);
 /* -- cartesian topologies ------------------------------------------------- */
 #define MPI_CART 1
 #define MPI_GRAPH 2
+#define MPI_DIST_GRAPH 3
+#define MPI_UNWEIGHTED ((int*)1)
+#define MPI_WEIGHTS_EMPTY ((int*)2)
 int MPI_Cart_create(MPI_Comm comm, int ndims, const int* dims,
                     const int* periods, int reorder, MPI_Comm* newcomm);
 int MPI_Cart_get(MPI_Comm comm, int maxdims, int* dims, int* periods,
@@ -480,6 +483,25 @@ int MPI_Cart_sub(MPI_Comm comm, const int* remain_dims, MPI_Comm* newcomm);
 int MPI_Cartdim_get(MPI_Comm comm, int* ndims);
 int MPI_Dims_create(int nnodes, int ndims, int* dims);
 int MPI_Topo_test(MPI_Comm comm, int* status);
+int MPI_Cart_map(MPI_Comm comm, int ndims, const int* dims,
+                 const int* periods, int* newrank);
+int MPI_Graph_map(MPI_Comm comm, int nnodes, const int* index,
+                  const int* edges, int* newrank);
+int MPI_Dist_graph_create(MPI_Comm comm, int n, const int sources[],
+                          const int degrees[], const int destinations[],
+                          const int weights[], MPI_Info info, int reorder,
+                          MPI_Comm* newcomm);
+int MPI_Dist_graph_create_adjacent(MPI_Comm comm, int indegree,
+                                   const int sources[],
+                                   const int sourceweights[], int outdegree,
+                                   const int destinations[],
+                                   const int destweights[], MPI_Info info,
+                                   int reorder, MPI_Comm* newcomm);
+int MPI_Dist_graph_neighbors_count(MPI_Comm comm, int* indegree,
+                                   int* outdegree, int* weighted);
+int MPI_Dist_graph_neighbors(MPI_Comm comm, int maxindegree, int sources[],
+                             int sourceweights[], int maxoutdegree,
+                             int destinations[], int destweights[]);
 
 int MPI_Pack(const void* inbuf, int incount, MPI_Datatype datatype,
              void* outbuf, int outsize, int* position, MPI_Comm comm);

@@ -185,6 +185,9 @@ enum {
   SMPI_OP_TYPE_DARRAY,
   SMPI_OP_PACK_EXTERNAL,      /* mode: 0 pack, 1 unpack, 2 size */
   SMPI_OP_TYPE_MATCH_SIZE,
+  SMPI_OP_TOPO_MAP,           /* 155; mode: 0 cart, 1 graph */
+  SMPI_OP_DIST_GRAPH_CREATE,  /* mode: 0 general, 1 adjacent */
+  SMPI_OP_DIST_GRAPH_NEIGHBORS, /* mode: 0 counts, 1 lists */
 };
 
 /* sub-modes for FILE_READ / FILE_WRITE */
@@ -771,6 +774,50 @@ int MPI_Pack_external_size(const char datarep[], int incount,
 }
 int MPI_Type_match_size(int typeclass, int size, MPI_Datatype* datatype) {
   CALL(SMPI_OP_TYPE_MATCH_SIZE, A(typeclass), A(size), A(datatype));
+}
+int MPI_Cart_map(MPI_Comm comm, int ndims, const int* dims,
+                 const int* periods, int* newrank) {
+  (void)periods;
+  CALL(SMPI_OP_TOPO_MAP, A(comm), A(ndims), A(dims), A(newrank), A(0));
+}
+int MPI_Graph_map(MPI_Comm comm, int nnodes, const int* index,
+                  const int* edges, int* newrank) {
+  (void)index;
+  (void)edges;
+  CALL(SMPI_OP_TOPO_MAP, A(comm), A(1), A(nnodes), A(newrank), A(1));
+}
+int MPI_Dist_graph_create(MPI_Comm comm, int n, const int sources[],
+                          const int degrees[], const int destinations[],
+                          const int weights[], MPI_Info info, int reorder,
+                          MPI_Comm* newcomm) {
+  (void)info;
+  (void)reorder;
+  CALL(SMPI_OP_DIST_GRAPH_CREATE, A(comm), A(n), A(sources), A(degrees),
+       A(destinations), A(weights), A(newcomm), A(0), A(0));
+}
+int MPI_Dist_graph_create_adjacent(MPI_Comm comm, int indegree,
+                                   const int sources[],
+                                   const int sourceweights[], int outdegree,
+                                   const int destinations[],
+                                   const int destweights[], MPI_Info info,
+                                   int reorder, MPI_Comm* newcomm) {
+  (void)info;
+  (void)reorder;
+  CALL(SMPI_OP_DIST_GRAPH_CREATE, A(comm), A(indegree), A(sources),
+       A(outdegree), A(destinations), A(sourceweights), A(newcomm), A(1),
+       A(destweights));
+}
+int MPI_Dist_graph_neighbors_count(MPI_Comm comm, int* indegree,
+                                   int* outdegree, int* weighted) {
+  CALL(SMPI_OP_DIST_GRAPH_NEIGHBORS, A(comm), A(indegree), A(outdegree),
+       A(weighted), A(0), A(0), A(0), A(0));
+}
+int MPI_Dist_graph_neighbors(MPI_Comm comm, int maxindegree, int sources[],
+                             int sourceweights[], int maxoutdegree,
+                             int destinations[], int destweights[]) {
+  CALL(SMPI_OP_DIST_GRAPH_NEIGHBORS, A(comm), A(maxindegree), A(sources),
+       A(sourceweights), A(maxoutdegree), A(destinations), A(destweights),
+       A(1));
 }
 int MPI_Cancel(MPI_Request* request) {
   CALL(SMPI_OP_CANCEL, A(request));

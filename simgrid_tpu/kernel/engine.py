@@ -346,6 +346,20 @@ class EngineImpl:
         """Run the simulation; with `until` >= 0, pause once the clock
         reaches that date (reference Engine::run_until) leaving the
         kernel state intact so run() can be called again."""
+        import sys as _sys
+        # Strict lock-pair handoff means at most one simulator thread is
+        # ever runnable; a long GIL switch interval removes pointless
+        # preemption checks during the ~1M handoffs of a big run
+        # (chord-10k: the handoff path was 36% of wall time).  Restored
+        # on exit so embedding processes keep the default.
+        _prev_interval = _sys.getswitchinterval()
+        _sys.setswitchinterval(5.0)
+        try:
+            self._run_loop(until)
+        finally:
+            _sys.setswitchinterval(_prev_interval)
+
+    def _run_loop(self, until: float) -> None:
         time = 0.0
         while True:
             self._execute_tasks()

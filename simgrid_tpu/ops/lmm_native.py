@@ -49,19 +49,15 @@ def load_library():
         _lib_error = str(exc)
         return None
     lib.lmm_solve_coo.restype = ctypes.c_int32
+    # raw pointers, not np.ctypeslib.ndpointer: the per-call from_param
+    # validation machinery cost ~18s of a 175s Chord run (the solver
+    # itself was 10s); callers guarantee dtype/contiguity
     lib.lmm_solve_coo.argtypes = [
         ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
-        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
-        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
-        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
-        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
-        np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
-        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
-        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
-        ctypes.c_double,
-        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
-        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
-        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_double,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
     ]
     _lib = lib
     return _lib
@@ -78,19 +74,22 @@ def solve_coo(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
     lib = load_library()
     if lib is None:
         raise RuntimeError(f"native LMM solver unavailable: {_lib_error}")
-    values = np.zeros(n_v, np.float64)
-    remaining = np.zeros(n_c, np.float64)
-    usage = np.zeros(n_c, np.float64)
+    values = np.empty(n_v, np.float64)
+    remaining = np.empty(n_c, np.float64)
+    usage = np.empty(n_c, np.float64)
+    a = (np.ascontiguousarray(e_var[:n_e], np.int32),
+         np.ascontiguousarray(e_cnst[:n_e], np.int32),
+         np.ascontiguousarray(e_w[:n_e], np.float64),
+         np.ascontiguousarray(c_bound[:n_c], np.float64),
+         np.ascontiguousarray(c_fatpipe[:n_c], np.uint8),
+         np.ascontiguousarray(v_penalty[:n_v], np.float64),
+         np.ascontiguousarray(v_bound[:n_v], np.float64))
     lib.lmm_solve_coo(
         n_c, n_v, n_e,
-        np.ascontiguousarray(e_var[:n_e], np.int32),
-        np.ascontiguousarray(e_cnst[:n_e], np.int32),
-        np.ascontiguousarray(e_w[:n_e], np.float64),
-        np.ascontiguousarray(c_bound[:n_c], np.float64),
-        np.ascontiguousarray(c_fatpipe[:n_c], np.uint8),
-        np.ascontiguousarray(v_penalty[:n_v], np.float64),
-        np.ascontiguousarray(v_bound[:n_v], np.float64),
-        float(eps), values, remaining, usage)
+        a[0].ctypes.data, a[1].ctypes.data, a[2].ctypes.data,
+        a[3].ctypes.data, a[4].ctypes.data, a[5].ctypes.data,
+        a[6].ctypes.data, float(eps),
+        values.ctypes.data, remaining.ctypes.data, usage.ctypes.data)
     return values, remaining, usage
 
 

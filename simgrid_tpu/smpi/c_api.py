@@ -2524,9 +2524,15 @@ def _h_cart_sub(ctx, a):
         return MPI_ERR_COMM
     remain = [bool(v) for v in _read_i32s(a[1], len(topo.dims))]
     me = topo.coords(comm.rank())
-    members = [r for r in range(topo.nnodes)
-               if all(keep or topo.coords(r)[i] == me[i]
-                      for i, keep in enumerate(remain))]
+    if not any(remain):
+        # dropping every dimension behaves like Cart_create(ndims=0):
+        # only rank 0 gets the zero-dim communicator (MPICH semantics,
+        # topo/cartsuball)
+        members = [0]
+    else:
+        members = [r for r in range(topo.nnodes)
+                   if all(keep or topo.coords(r)[i] == me[i]
+                          for i, keep in enumerate(remain))]
     sub = comm.create(_Group([comm.world_rank_of(r) for r in members]))
     if sub is None:
         _write_i32(a[2], COMM_NULL)
@@ -2534,8 +2540,9 @@ def _h_cart_sub(ctx, a):
     h = _new_comm_handle(ctx, sub)
     sub_dims = [d for d, keep in zip(topo.dims, remain) if keep]
     sub_per = [p for p, keep in zip(topo.periodic, remain) if keep]
-    if sub_dims:
-        ctx.cart_topos[h] = CartTopology(sub, sub_dims, sub_per)
+    # a zero-dimensional result is still a cartesian communicator
+    # (topo/cartzero expects Cartdim_get == 0 on it)
+    ctx.cart_topos[h] = CartTopology(sub, sub_dims, sub_per)
     _write_i32(a[2], h)
     return MPI_SUCCESS
 
@@ -2559,8 +2566,115 @@ def _h_dims_create(ctx, a):
 
 
 def _h_topo_test(ctx, a):
-    is_cart = _cart_of(ctx, a[0]) is not None
-    _write_i32(a[1], 1 if is_cart else C_UNDEFINED)   # MPI_CART
+    h = int(a[0])
+    if _cart_of(ctx, h) is not None:
+        _write_i32(a[1], 1)                    # MPI_CART
+    elif h in ctx.graph_topos:
+        topo = ctx.graph_topos[h]
+        from .topo import DistGraphTopology
+        _write_i32(a[1], 3 if isinstance(topo, DistGraphTopology) else 2)
+    else:
+        _write_i32(a[1], C_UNDEFINED)
+    return MPI_SUCCESS
+
+
+def _h_topo_map(ctx, a):
+    """MPI_Cart_map / MPI_Graph_map without reordering (like the
+    reference smpi): ranks below the topology size keep their rank,
+    the rest get MPI_UNDEFINED."""
+    comm = _comm_of(ctx, a[0])
+    if comm is None:
+        return MPI_ERR_COMM
+    mode = int(a[4])
+    if mode == 0:                 # cart: size = prod(dims)
+        ndims = int(a[1])
+        dims = _read_i32s(a[2], ndims)
+        size = 1
+        for d in dims:
+            size *= d
+    else:                         # graph: nnodes, by value
+        size = int(a[2])
+    rank = comm.rank()
+    _write_i32(a[3], rank if rank < size else C_UNDEFINED)
+    return MPI_SUCCESS
+
+
+def _weights_ptr(addr):
+    """None for MPI_UNWEIGHTED(1)/MPI_WEIGHTS_EMPTY(2)/NULL."""
+    return None if int(addr) in (0, 1, 2) else int(addr)
+
+
+def _h_dist_graph_create(ctx, a):
+    from .topo import DistGraphTopology
+    comm = _comm_of(ctx, a[0])
+    if comm is None:
+        return MPI_ERR_COMM
+    me = comm.rank()
+    if int(a[7]):                 # adjacent: my lists are explicit
+        indeg, outdeg = int(a[1]), int(a[3])
+        sources = _read_i32s(a[2], indeg)
+        dests = _read_i32s(a[4], outdeg)
+        swp, dwp = _weights_ptr(a[5]), _weights_ptr(a[8])
+        sweights = _read_i32s(swp, indeg) if swp else None
+        dweights = _read_i32s(dwp, outdeg) if dwp else None
+    else:
+        # general form: every rank contributes (source, deg, dests[,w])
+        # triples naming arbitrary edges; allgather and filter mine
+        n = int(a[1])
+        srcs = _read_i32s(a[2], n)
+        degs = _read_i32s(a[3], n)
+        total = sum(degs)
+        dests_flat = _read_i32s(a[4], total)
+        wp = _weights_ptr(a[5])
+        w_flat = _read_i32s(wp, total) if wp else [None] * total
+        edges = []
+        pos = 0
+        for src, deg in zip(srcs, degs):
+            for k in range(deg):
+                edges.append((src, dests_flat[pos + k], w_flat[pos + k]))
+            pos += deg
+        all_edges = [e for part in comm.allgather(edges) for e in part]
+        sources = [s for s, d, w in all_edges if d == me]
+        dests = [d for s, d, w in all_edges if s == me]
+        weighted = wp is not None
+        sweights = [w for s, d, w in all_edges if d == me] \
+            if weighted else None
+        dweights = [w for s, d, w in all_edges if s == me] \
+            if weighted else None
+    grid = comm.dup()
+    h = _new_comm_handle(ctx, grid)
+    ctx.graph_topos[h] = DistGraphTopology(grid, sources, dests,
+                                           sweights, dweights)
+    _write_i32(a[6], h)
+    return MPI_SUCCESS
+
+
+def _h_dist_graph_neighbors(ctx, a):
+    from .topo import DistGraphTopology
+    topo = ctx.graph_topos.get(int(a[0]))
+    if not isinstance(topo, DistGraphTopology):
+        return MPI_ERR_COMM
+    if int(a[7]) == 0:            # counts
+        _write_i32(a[1], len(topo.sources))
+        _write_i32(a[2], len(topo.destinations))
+        _write_i32(a[3], 1 if topo.weighted else 0)
+        return MPI_SUCCESS
+    maxin, maxout = int(a[1]), int(a[4])
+    pi = ctypes.cast(int(a[2]), _pi32) if a[2] else None
+    po = ctypes.cast(int(a[5]), _pi32) if a[5] else None
+    for i, v in enumerate(topo.sources[:maxin]):
+        pi[i] = v
+    for i, v in enumerate(topo.destinations[:maxout]):
+        po[i] = v
+    swp, dwp = _weights_ptr(a[3]), _weights_ptr(a[6])
+    if topo.weighted and swp:
+        pw = ctypes.cast(swp, _pi32)
+        for i, v in enumerate(topo.source_weights[:maxin]):
+            pw[i] = v
+    if topo.weighted and dwp:
+        pw = ctypes.cast(dwp, _pi32)
+        for i, v in enumerate(topo.dest_weights[:maxout]):
+            pw[i] = v
     return MPI_SUCCESS
 
 
@@ -2593,20 +2707,39 @@ def _h_graph_create(ctx, a):
     comm = _comm_of(ctx, ch)
     if comm is None:
         return MPI_ERR_COMM
-    index = _read_i32s(index_a, int(nnodes))
+    nnodes = int(nnodes)
+    index = _read_i32s(index_a, nnodes)
     nedges = index[-1] if index else 0
     edges = _read_i32s(edges_a, nedges)
-    grid = comm.dup()
+    if nnodes < comm.size():
+        # MPI-3 §7.5.3: ranks beyond nnodes (everyone, for an empty
+        # graph) get MPI_COMM_NULL; the creation stays collective
+        from .group import Group
+        members = [comm.group.actor(r) for r in range(nnodes)]
+        grid = comm.create(Group(members))
+        if grid is None:
+            _write_i32(out_addr, 0)
+            return MPI_SUCCESS
+    else:
+        grid = comm.dup()
     h = _new_comm_handle(ctx, grid)
     ctx.graph_topos[h] = GraphTopology(grid, index, edges)
     _write_i32(out_addr, h)
     return MPI_SUCCESS
 
 
+def _graph_topo_of(ctx, handle):
+    """Legacy-graph topology lookup; dist-graph comms do not answer
+    the MPI-1 graph queries (MPI_ERR_TOPOLOGY analog)."""
+    from .topo import GraphTopology
+    topo = ctx.graph_topos.get(int(handle))
+    return topo if isinstance(topo, GraphTopology) else None
+
+
 def _h_graph_neighbors(ctx, a):
     ch, rank, maxn, out_addr, count_only = (a[0], int(a[1]), int(a[2]),
                                             a[3], int(a[4]))
-    topo = ctx.graph_topos.get(int(ch))
+    topo = _graph_topo_of(ctx, ch)
     if topo is None:
         return MPI_ERR_COMM
     nbrs = topo.neighbors(rank)
@@ -2619,7 +2752,7 @@ def _h_graph_neighbors(ctx, a):
 
 
 def _h_graphdims_get(ctx, a):
-    topo = ctx.graph_topos.get(int(a[0]))
+    topo = _graph_topo_of(ctx, a[0])
     if topo is None:
         return MPI_ERR_COMM
     _write_i32(a[1], len(topo.index))
@@ -2631,7 +2764,7 @@ def _h_graph_get(ctx, a):
     ch, maxindex, maxedges, index_addr, edges_addr = (a[0], int(a[1]),
                                                       int(a[2]), a[3],
                                                       a[4])
-    topo = ctx.graph_topos.get(int(ch))
+    topo = _graph_topo_of(ctx, ch)
     if topo is None:
         return MPI_ERR_COMM
     for i, v in enumerate(topo.index[:maxindex]):
@@ -3485,7 +3618,8 @@ _HANDLERS = {
     145: _h_comm_remote_size, 146: _h_comm_test_inter, 147: _h_cancel,
     148: _h_type_get_envelope, 149: _h_type_get_contents,
     150: _h_get_elements, 151: _h_type_lbub, 152: _h_type_darray,
-    153: _h_pack_external, 154: _h_type_match_size,
+    153: _h_pack_external, 154: _h_type_match_size, 155: _h_topo_map,
+    156: _h_dist_graph_create, 157: _h_dist_graph_neighbors,
 }
 
 #: ops that are pure local queries — no bench end/begin cycle needed

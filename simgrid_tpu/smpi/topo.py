@@ -182,3 +182,18 @@ class GraphTopology:
 
     def neighbors_count(self, rank: int) -> int:
         return len(self.neighbors(rank))
+
+
+class DistGraphTopology:
+    """MPI-2.2 distributed graph topology (MPI_Dist_graph_create*):
+    per-rank in/out neighbor lists, assembled collectively for the
+    general constructor."""
+
+    def __init__(self, comm, sources, destinations,
+                 source_weights=None, dest_weights=None):
+        self.comm = comm
+        self.sources = list(sources)            # my in-neighbors
+        self.destinations = list(destinations)  # my out-neighbors
+        self.source_weights = source_weights    # None = unweighted
+        self.dest_weights = dest_weights
+        self.weighted = source_weights is not None
