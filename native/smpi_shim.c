@@ -1283,3 +1283,164 @@ int MPI_Iexscan(const void* sendbuf, void* recvbuf, int count,
   CALL(SMPI_OP_IEXSCAN, A(sendbuf), A(recvbuf), A(count), A(datatype),
        A(op), A(comm), A(request));
 }
+
+/* ======================================================================
+ * Fortran 77/90 bindings (reference src/smpi/bindings/smpi_f77*.cpp).
+ *
+ * The gfortran/flang ABI: every argument passed by reference, handles
+ * are MPI_Fint (int — our C handles are ints already, so translation
+ * is the identity), status is an int[MPI_F_STATUS_SIZE] laid out like
+ * our MPI_Status, and symbols are lowercase with a trailing
+ * underscore.  This image ships no Fortran compiler, so conformance is
+ * exercised by calling these exact symbols by reference from C
+ * (tests/test_smpi_fortran.py), which is ABI-equivalent to what
+ * compiled F77 object code does.
+ * ==================================================================== */
+
+typedef int MPI_Fint;
+#define SMPI_F2C_COMM(c) ((MPI_Comm)(*(c)))
+#define SMPI_F2C_TYPE(t) ((MPI_Datatype)(*(t)))
+#define SMPI_F2C_OP(o) ((MPI_Op)(*(o)))
+
+void mpi_init_(MPI_Fint* ierr) { *ierr = MPI_Init(0, 0); }
+void mpi_finalize_(MPI_Fint* ierr) { *ierr = MPI_Finalize(); }
+void mpi_initialized_(MPI_Fint* flag, MPI_Fint* ierr) {
+  int f; *ierr = MPI_Initialized(&f); *flag = f;
+}
+void mpi_abort_(MPI_Fint* comm, MPI_Fint* errorcode, MPI_Fint* ierr) {
+  *ierr = MPI_Abort(SMPI_F2C_COMM(comm), *errorcode);
+}
+double mpi_wtime_(void) { return MPI_Wtime(); }
+double mpi_wtick_(void) { return MPI_Wtick(); }
+
+void mpi_comm_rank_(MPI_Fint* comm, MPI_Fint* rank, MPI_Fint* ierr) {
+  *ierr = MPI_Comm_rank(SMPI_F2C_COMM(comm), rank);
+}
+void mpi_comm_size_(MPI_Fint* comm, MPI_Fint* size, MPI_Fint* ierr) {
+  *ierr = MPI_Comm_size(SMPI_F2C_COMM(comm), size);
+}
+void mpi_comm_dup_(MPI_Fint* comm, MPI_Fint* newcomm, MPI_Fint* ierr) {
+  MPI_Comm out;
+  *ierr = MPI_Comm_dup(SMPI_F2C_COMM(comm), &out);
+  *newcomm = (MPI_Fint)out;
+}
+void mpi_comm_split_(MPI_Fint* comm, MPI_Fint* color, MPI_Fint* key,
+                     MPI_Fint* newcomm, MPI_Fint* ierr) {
+  MPI_Comm out;
+  *ierr = MPI_Comm_split(SMPI_F2C_COMM(comm), *color, *key, &out);
+  *newcomm = (MPI_Fint)out;
+}
+void mpi_comm_free_(MPI_Fint* comm, MPI_Fint* ierr) {
+  MPI_Comm c = SMPI_F2C_COMM(comm);
+  *ierr = MPI_Comm_free(&c);
+  *comm = (MPI_Fint)c;
+}
+
+void mpi_send_(void* buf, MPI_Fint* count, MPI_Fint* datatype,
+               MPI_Fint* dest, MPI_Fint* tag, MPI_Fint* comm,
+               MPI_Fint* ierr) {
+  *ierr = MPI_Send(buf, *count, SMPI_F2C_TYPE(datatype), *dest, *tag,
+                   SMPI_F2C_COMM(comm));
+}
+void mpi_recv_(void* buf, MPI_Fint* count, MPI_Fint* datatype,
+               MPI_Fint* source, MPI_Fint* tag, MPI_Fint* comm,
+               MPI_Fint* status, MPI_Fint* ierr) {
+  *ierr = MPI_Recv(buf, *count, SMPI_F2C_TYPE(datatype), *source, *tag,
+                   SMPI_F2C_COMM(comm), (MPI_Status*)status);
+}
+void mpi_isend_(void* buf, MPI_Fint* count, MPI_Fint* datatype,
+                MPI_Fint* dest, MPI_Fint* tag, MPI_Fint* comm,
+                MPI_Fint* request, MPI_Fint* ierr) {
+  MPI_Request req;
+  *ierr = MPI_Isend(buf, *count, SMPI_F2C_TYPE(datatype), *dest, *tag,
+                    SMPI_F2C_COMM(comm), &req);
+  *request = (MPI_Fint)req;
+}
+void mpi_irecv_(void* buf, MPI_Fint* count, MPI_Fint* datatype,
+                MPI_Fint* source, MPI_Fint* tag, MPI_Fint* comm,
+                MPI_Fint* request, MPI_Fint* ierr) {
+  MPI_Request req;
+  *ierr = MPI_Irecv(buf, *count, SMPI_F2C_TYPE(datatype), *source, *tag,
+                    SMPI_F2C_COMM(comm), &req);
+  *request = (MPI_Fint)req;
+}
+void mpi_wait_(MPI_Fint* request, MPI_Fint* status, MPI_Fint* ierr) {
+  MPI_Request req = (MPI_Request)(*request);
+  *ierr = MPI_Wait(&req, (MPI_Status*)status);
+  *request = (MPI_Fint)req;
+}
+void mpi_waitall_(MPI_Fint* count, MPI_Fint* requests, MPI_Fint* statuses,
+                  MPI_Fint* ierr) {
+  int i, rc, n = *count;
+  *ierr = MPI_SUCCESS;
+  for (i = 0; i < n; i++) {   /* complete every request, keep 1st error */
+    MPI_Request req = (MPI_Request)requests[i];
+    rc = MPI_Wait(&req, statuses == (MPI_Fint*)0
+                            ? MPI_STATUS_IGNORE
+                            : (MPI_Status*)(statuses + 5 * i));
+    requests[i] = (MPI_Fint)req;
+    if (rc != MPI_SUCCESS && *ierr == MPI_SUCCESS) *ierr = rc;
+  }
+}
+void mpi_test_(MPI_Fint* request, MPI_Fint* flag, MPI_Fint* status,
+               MPI_Fint* ierr) {
+  MPI_Request req = (MPI_Request)(*request);
+  int f;
+  *ierr = MPI_Test(&req, &f, (MPI_Status*)status);
+  *flag = f;
+  *request = (MPI_Fint)req;
+}
+void mpi_get_count_(MPI_Fint* status, MPI_Fint* datatype, MPI_Fint* count,
+                    MPI_Fint* ierr) {
+  *ierr = MPI_Get_count((MPI_Status*)status, SMPI_F2C_TYPE(datatype),
+                        count);
+}
+
+void mpi_barrier_(MPI_Fint* comm, MPI_Fint* ierr) {
+  *ierr = MPI_Barrier(SMPI_F2C_COMM(comm));
+}
+void mpi_bcast_(void* buf, MPI_Fint* count, MPI_Fint* datatype,
+                MPI_Fint* root, MPI_Fint* comm, MPI_Fint* ierr) {
+  *ierr = MPI_Bcast(buf, *count, SMPI_F2C_TYPE(datatype), *root,
+                    SMPI_F2C_COMM(comm));
+}
+void mpi_reduce_(void* sendbuf, void* recvbuf, MPI_Fint* count,
+                 MPI_Fint* datatype, MPI_Fint* op, MPI_Fint* root,
+                 MPI_Fint* comm, MPI_Fint* ierr) {
+  *ierr = MPI_Reduce(sendbuf, recvbuf, *count, SMPI_F2C_TYPE(datatype),
+                     SMPI_F2C_OP(op), *root, SMPI_F2C_COMM(comm));
+}
+void mpi_allreduce_(void* sendbuf, void* recvbuf, MPI_Fint* count,
+                    MPI_Fint* datatype, MPI_Fint* op, MPI_Fint* comm,
+                    MPI_Fint* ierr) {
+  *ierr = MPI_Allreduce(sendbuf, recvbuf, *count, SMPI_F2C_TYPE(datatype),
+                        SMPI_F2C_OP(op), SMPI_F2C_COMM(comm));
+}
+void mpi_gather_(void* sendbuf, MPI_Fint* sendcount, MPI_Fint* sendtype,
+                 void* recvbuf, MPI_Fint* recvcount, MPI_Fint* recvtype,
+                 MPI_Fint* root, MPI_Fint* comm, MPI_Fint* ierr) {
+  *ierr = MPI_Gather(sendbuf, *sendcount, SMPI_F2C_TYPE(sendtype), recvbuf,
+                     *recvcount, SMPI_F2C_TYPE(recvtype), *root,
+                     SMPI_F2C_COMM(comm));
+}
+void mpi_scatter_(void* sendbuf, MPI_Fint* sendcount, MPI_Fint* sendtype,
+                  void* recvbuf, MPI_Fint* recvcount, MPI_Fint* recvtype,
+                  MPI_Fint* root, MPI_Fint* comm, MPI_Fint* ierr) {
+  *ierr = MPI_Scatter(sendbuf, *sendcount, SMPI_F2C_TYPE(sendtype), recvbuf,
+                      *recvcount, SMPI_F2C_TYPE(recvtype), *root,
+                      SMPI_F2C_COMM(comm));
+}
+void mpi_allgather_(void* sendbuf, MPI_Fint* sendcount, MPI_Fint* sendtype,
+                    void* recvbuf, MPI_Fint* recvcount, MPI_Fint* recvtype,
+                    MPI_Fint* comm, MPI_Fint* ierr) {
+  *ierr = MPI_Allgather(sendbuf, *sendcount, SMPI_F2C_TYPE(sendtype),
+                        recvbuf, *recvcount, SMPI_F2C_TYPE(recvtype),
+                        SMPI_F2C_COMM(comm));
+}
+void mpi_alltoall_(void* sendbuf, MPI_Fint* sendcount, MPI_Fint* sendtype,
+                   void* recvbuf, MPI_Fint* recvcount, MPI_Fint* recvtype,
+                   MPI_Fint* comm, MPI_Fint* ierr) {
+  *ierr = MPI_Alltoall(sendbuf, *sendcount, SMPI_F2C_TYPE(sendtype),
+                       recvbuf, *recvcount, SMPI_F2C_TYPE(recvtype),
+                       SMPI_F2C_COMM(comm));
+}

@@ -13,7 +13,6 @@ kept so a C fiber extension can slot in later without touching the kernel.
 from __future__ import annotations
 
 import _thread
-import sys
 import threading
 from typing import Callable, Optional
 

@@ -709,7 +709,14 @@ def _h_comm_dup(ctx, a):
     comm = _comm_of(ctx, a[0])
     if comm is None:
         return MPI_ERR_COMM
-    _write_i32(a[1], _new_comm_handle(ctx, comm.dup()))
+    h = _new_comm_handle(ctx, comm.dup())
+    # MPI_Comm_dup propagates the topology (MPI-3 §6.4.2; topo/topodup)
+    old = int(a[0])
+    if old in ctx.cart_topos:
+        ctx.cart_topos[h] = ctx.cart_topos[old]
+    if old in ctx.graph_topos:
+        ctx.graph_topos[h] = ctx.graph_topos[old]
+    _write_i32(a[1], h)
     return MPI_SUCCESS
 
 
@@ -2600,8 +2607,15 @@ def _h_topo_map(ctx, a):
 
 
 def _weights_ptr(addr):
-    """None for MPI_UNWEIGHTED(1)/MPI_WEIGHTS_EMPTY(2)/NULL."""
+    """Readable address, or None for the MPI_UNWEIGHTED(1) /
+    MPI_WEIGHTS_EMPTY(2) / NULL sentinels.  Note only MPI_UNWEIGHTED
+    makes the GRAPH unweighted — WEIGHTS_EMPTY just means this rank
+    contributes zero edges to a weighted graph."""
     return None if int(addr) in (0, 1, 2) else int(addr)
+
+
+def _is_unweighted(addr) -> bool:
+    return int(addr) == 1          # MPI_UNWEIGHTED
 
 
 def _h_dist_graph_create(ctx, a):
@@ -2614,9 +2628,12 @@ def _h_dist_graph_create(ctx, a):
         indeg, outdeg = int(a[1]), int(a[3])
         sources = _read_i32s(a[2], indeg)
         dests = _read_i32s(a[4], outdeg)
+        weighted = not (_is_unweighted(a[5]) and _is_unweighted(a[8]))
         swp, dwp = _weights_ptr(a[5]), _weights_ptr(a[8])
-        sweights = _read_i32s(swp, indeg) if swp else None
-        dweights = _read_i32s(dwp, outdeg) if dwp else None
+        sweights = (_read_i32s(swp, indeg) if swp else []) \
+            if weighted else None
+        dweights = (_read_i32s(dwp, outdeg) if dwp else []) \
+            if weighted else None
     else:
         # general form: every rank contributes (source, deg, dests[,w])
         # triples naming arbitrary edges; allgather and filter mine
@@ -2625,8 +2642,9 @@ def _h_dist_graph_create(ctx, a):
         degs = _read_i32s(a[3], n)
         total = sum(degs)
         dests_flat = _read_i32s(a[4], total)
+        weighted = not _is_unweighted(a[5])
         wp = _weights_ptr(a[5])
-        w_flat = _read_i32s(wp, total) if wp else [None] * total
+        w_flat = _read_i32s(wp, total) if wp else [0] * total
         edges = []
         pos = 0
         for src, deg in zip(srcs, degs):
@@ -2636,7 +2654,9 @@ def _h_dist_graph_create(ctx, a):
         all_edges = [e for part in comm.allgather(edges) for e in part]
         sources = [s for s, d, w in all_edges if d == me]
         dests = [d for s, d, w in all_edges if s == me]
-        weighted = wp is not None
+        # weighted-ness is collective: any contributor with real
+        # weights makes the graph weighted
+        weighted = any(comm.allgather(weighted))
         sweights = [w for s, d, w in all_edges if d == me] \
             if weighted else None
         dweights = [w for s, d, w in all_edges if s == me] \
@@ -2714,7 +2734,6 @@ def _h_graph_create(ctx, a):
     if nnodes < comm.size():
         # MPI-3 §7.5.3: ranks beyond nnodes (everyone, for an empty
         # graph) get MPI_COMM_NULL; the creation stays collective
-        from .group import Group
         members = [comm.group.actor(r) for r in range(nnodes)]
         grid = comm.create(Group(members))
         if grid is None:
@@ -3151,7 +3170,13 @@ def _h_comm_idup(ctx, a):
     comm = _comm_of(ctx, a[0])
     if comm is None:
         return MPI_ERR_COMM
-    _write_i32(a[1], _new_comm_handle(ctx, comm.dup()))
+    h = _new_comm_handle(ctx, comm.dup())
+    old = int(a[0])
+    if old in ctx.cart_topos:         # same copy semantics as Comm_dup
+        ctx.cart_topos[h] = ctx.cart_topos[old]
+    if old in ctx.graph_topos:
+        ctx.graph_topos[h] = ctx.graph_topos[old]
+    _write_i32(a[1], h)
     # the dup is immediate here; hand back an already-complete request
     h = _new_req_handle(ctx, _CReq(NbcRequest([], [], lambda _: None),
                                    0, None, "nbc"))

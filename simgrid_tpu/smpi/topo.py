@@ -194,6 +194,9 @@ class DistGraphTopology:
         self.comm = comm
         self.sources = list(sources)            # my in-neighbors
         self.destinations = list(destinations)  # my out-neighbors
-        self.source_weights = source_weights    # None = unweighted
+        self.source_weights = source_weights
         self.dest_weights = dest_weights
-        self.weighted = source_weights is not None
+        # weighted iff either side carries weights (a rank may have
+        # indegree 0 in a weighted graph)
+        self.weighted = (source_weights is not None
+                         or dest_weights is not None)
