@@ -161,6 +161,8 @@ typedef struct MPI_Status {
 #define MPI_BXOR 10
 #define MPI_MAXLOC 11
 #define MPI_MINLOC 12
+#define MPI_REPLACE 13
+#define MPI_NO_OP 14
 
 /* -- wildcards & sentinels --------------------------------------------- */
 #define MPI_ANY_SOURCE -1
@@ -193,6 +195,46 @@ typedef struct MPI_Status {
 #define MPI_ERR_TRUNCATE 9
 #define MPI_ERR_OP 10
 #define MPI_ERR_OTHER 16
+#define MPI_ERR_WIN 17
+#define MPI_ERR_BASE 18
+#define MPI_ERR_DISP 19
+#define MPI_ERR_LOCKTYPE 20
+#define MPI_ERR_ASSERT 21
+#define MPI_ERR_RMA_CONFLICT 22
+#define MPI_ERR_RMA_SYNC 23
+#define MPI_ERR_RMA_RANGE 24
+#define MPI_ERR_RMA_ATTACH 25
+#define MPI_ERR_RMA_SHARED 26
+#define MPI_ERR_RMA_FLAVOR 27
+#define MPI_ERR_SIZE 28
+#define MPI_ERR_INFO 29
+#define MPI_ERR_GROUP 30
+#define MPI_ERR_BUFFER 31
+#define MPI_ERR_ROOT 32
+#define MPI_ERR_PENDING 33
+#define MPI_ERR_IN_STATUS 34
+#define MPI_ERR_KEYVAL 35
+#define MPI_ERR_NO_MEM 36
+#define MPI_ERR_SPAWN 37
+#define MPI_ERR_PORT 38
+#define MPI_ERR_SERVICE 39
+#define MPI_ERR_NAME 40
+#define MPI_ERR_FILE 41
+#define MPI_ERR_NOT_SAME 42
+#define MPI_ERR_AMODE 43
+#define MPI_ERR_UNSUPPORTED_DATAREP 44
+#define MPI_ERR_UNSUPPORTED_OPERATION 45
+#define MPI_ERR_NO_SUCH_FILE 46
+#define MPI_ERR_FILE_EXISTS 47
+#define MPI_ERR_BAD_FILE 48
+#define MPI_ERR_ACCESS 49
+#define MPI_ERR_NO_SPACE 50
+#define MPI_ERR_QUOTA 51
+#define MPI_ERR_READ_ONLY 52
+#define MPI_ERR_FILE_IN_USE 53
+#define MPI_ERR_DUP_DATAREP 54
+#define MPI_ERR_CONVERSION 55
+#define MPI_ERR_IO 56
 #define MPI_ERR_LASTCODE 74
 
 typedef void MPI_User_function(void* invec, void* inoutvec, int* len,
@@ -676,10 +718,125 @@ int MPI_Comm_compare(MPI_Comm comm1, MPI_Comm comm2, int* result);
 int MPI_Info_create(MPI_Info* info);
 int MPI_Info_set(MPI_Info info, const char* key, const char* value);
 int MPI_Info_free(MPI_Info* info);
+int MPI_Info_get(MPI_Info info, const char* key, int valuelen, char* value,
+                 int* flag);
+int MPI_Info_get_nkeys(MPI_Info info, int* nkeys);
+int MPI_Info_get_nthkey(MPI_Info info, int n, char* key);
+int MPI_Info_get_valuelen(MPI_Info info, const char* key, int* valuelen,
+                          int* flag);
+int MPI_Info_dup(MPI_Info info, MPI_Info* newinfo);
+int MPI_Info_delete(MPI_Info info, const char* key);
+#define MPI_MAX_INFO_KEY 255
+#define MPI_MAX_INFO_VAL 1024
+
+/* -- one-sided communication (MPI-3 RMA) --------------------------------- */
+#define MPI_WIN_NULL 0
+#define MPI_LOCK_EXCLUSIVE 234
+#define MPI_LOCK_SHARED 235
+#define MPI_MODE_NOCHECK 1024
+#define MPI_MODE_NOSTORE 2048
+#define MPI_MODE_NOPUT 4096
+#define MPI_MODE_NOPRECEDE 8192
+#define MPI_MODE_NOSUCCEED 16384
+#define MPI_WIN_FLAVOR_CREATE 1
+#define MPI_WIN_FLAVOR_ALLOCATE 2
+#define MPI_WIN_FLAVOR_DYNAMIC 3
+#define MPI_WIN_FLAVOR_SHARED 4
+#define MPI_WIN_SEPARATE 1
+#define MPI_WIN_UNIFIED 2
+
+static inline MPI_Aint MPI_Aint_add(MPI_Aint base, MPI_Aint disp) {
+  return base + disp;
+}
+static inline MPI_Aint MPI_Aint_diff(MPI_Aint addr1, MPI_Aint addr2) {
+  return addr1 - addr2;
+}
+
 int MPI_Win_create(void* base, MPI_Aint size, int disp_unit,
                    MPI_Info info, MPI_Comm comm, MPI_Win* win);
+int MPI_Win_allocate(MPI_Aint size, int disp_unit, MPI_Info info,
+                     MPI_Comm comm, void* baseptr, MPI_Win* win);
+int MPI_Win_allocate_shared(MPI_Aint size, int disp_unit, MPI_Info info,
+                            MPI_Comm comm, void* baseptr, MPI_Win* win);
+int MPI_Win_create_dynamic(MPI_Info info, MPI_Comm comm, MPI_Win* win);
+int MPI_Win_attach(MPI_Win win, void* base, MPI_Aint size);
+int MPI_Win_detach(MPI_Win win, const void* base);
+int MPI_Win_shared_query(MPI_Win win, int rank, MPI_Aint* size,
+                         int* disp_unit, void* baseptr);
 int MPI_Win_free(MPI_Win* win);
 int MPI_Win_fence(int assertion, MPI_Win win);
+int MPI_Put(const void* origin_addr, int origin_count,
+            MPI_Datatype origin_datatype, int target_rank,
+            MPI_Aint target_disp, int target_count,
+            MPI_Datatype target_datatype, MPI_Win win);
+int MPI_Get(void* origin_addr, int origin_count,
+            MPI_Datatype origin_datatype, int target_rank,
+            MPI_Aint target_disp, int target_count,
+            MPI_Datatype target_datatype, MPI_Win win);
+int MPI_Accumulate(const void* origin_addr, int origin_count,
+                   MPI_Datatype origin_datatype, int target_rank,
+                   MPI_Aint target_disp, int target_count,
+                   MPI_Datatype target_datatype, MPI_Op op, MPI_Win win);
+int MPI_Get_accumulate(const void* origin_addr, int origin_count,
+                       MPI_Datatype origin_datatype, void* result_addr,
+                       int result_count, MPI_Datatype result_datatype,
+                       int target_rank, MPI_Aint target_disp,
+                       int target_count, MPI_Datatype target_datatype,
+                       MPI_Op op, MPI_Win win);
+int MPI_Fetch_and_op(const void* origin_addr, void* result_addr,
+                     MPI_Datatype datatype, int target_rank,
+                     MPI_Aint target_disp, MPI_Op op, MPI_Win win);
+int MPI_Compare_and_swap(const void* origin_addr, const void* compare_addr,
+                         void* result_addr, MPI_Datatype datatype,
+                         int target_rank, MPI_Aint target_disp, MPI_Win win);
+int MPI_Rput(const void* origin_addr, int origin_count,
+             MPI_Datatype origin_datatype, int target_rank,
+             MPI_Aint target_disp, int target_count,
+             MPI_Datatype target_datatype, MPI_Win win,
+             MPI_Request* request);
+int MPI_Rget(void* origin_addr, int origin_count,
+             MPI_Datatype origin_datatype, int target_rank,
+             MPI_Aint target_disp, int target_count,
+             MPI_Datatype target_datatype, MPI_Win win,
+             MPI_Request* request);
+int MPI_Raccumulate(const void* origin_addr, int origin_count,
+                    MPI_Datatype origin_datatype, int target_rank,
+                    MPI_Aint target_disp, int target_count,
+                    MPI_Datatype target_datatype, MPI_Op op, MPI_Win win,
+                    MPI_Request* request);
+int MPI_Rget_accumulate(const void* origin_addr, int origin_count,
+                        MPI_Datatype origin_datatype, void* result_addr,
+                        int result_count, MPI_Datatype result_datatype,
+                        int target_rank, MPI_Aint target_disp,
+                        int target_count, MPI_Datatype target_datatype,
+                        MPI_Op op, MPI_Win win, MPI_Request* request);
+int MPI_Win_start(MPI_Group group, int assertion, MPI_Win win);
+int MPI_Win_complete(MPI_Win win);
+int MPI_Win_post(MPI_Group group, int assertion, MPI_Win win);
+int MPI_Win_wait(MPI_Win win);
+int MPI_Win_test(MPI_Win win, int* flag);
+int MPI_Win_lock(int lock_type, int rank, int assertion, MPI_Win win);
+int MPI_Win_unlock(int rank, MPI_Win win);
+int MPI_Win_lock_all(int assertion, MPI_Win win);
+int MPI_Win_unlock_all(MPI_Win win);
+int MPI_Win_flush(int rank, MPI_Win win);
+int MPI_Win_flush_local(int rank, MPI_Win win);
+int MPI_Win_flush_all(MPI_Win win);
+int MPI_Win_flush_local_all(MPI_Win win);
+int MPI_Win_sync(MPI_Win win);
+int MPI_Win_get_group(MPI_Win win, MPI_Group* group);
+int MPI_Win_set_name(MPI_Win win, const char* name);
+int MPI_Win_get_name(MPI_Win win, char* name, int* resultlen);
+int MPI_Win_delete_attr(MPI_Win win, int keyval);
+typedef void MPI_Win_errhandler_function(MPI_Win*, int*, ...);
+typedef MPI_Win_errhandler_function MPI_Win_errhandler_fn;
+int MPI_Win_create_errhandler(MPI_Win_errhandler_function* fn,
+                              MPI_Errhandler* errhandler);
+int MPI_Win_set_errhandler(MPI_Win win, MPI_Errhandler errhandler);
+int MPI_Win_get_errhandler(MPI_Win win, MPI_Errhandler* errhandler);
+int MPI_Win_call_errhandler(MPI_Win win, int errorcode);
+int MPI_Win_get_info(MPI_Win win, MPI_Info* info);
+int MPI_Win_set_info(MPI_Win win, MPI_Info info);
 
 /* -- attributes / keyvals ------------------------------------------------ */
 #define MPI_KEYVAL_INVALID -1
@@ -695,6 +852,8 @@ int MPI_Win_fence(int assertion, MPI_Win win);
 #define MPI_WIN_BASE 16
 #define MPI_WIN_SIZE 17
 #define MPI_WIN_DISP_UNIT 18
+#define MPI_WIN_CREATE_FLAVOR 19
+#define MPI_WIN_MODEL 20
 
 typedef int MPI_Comm_copy_attr_function(MPI_Comm, int, void*, void*, void*,
                                         int*);

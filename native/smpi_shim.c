@@ -188,6 +188,46 @@ enum {
   SMPI_OP_TOPO_MAP,           /* 155; mode: 0 cart, 1 graph */
   SMPI_OP_DIST_GRAPH_CREATE,  /* mode: 0 general, 1 adjacent */
   SMPI_OP_DIST_GRAPH_NEIGHBORS, /* mode: 0 counts, 1 lists */
+  /* -- one-sided (MPI-3 RMA) -- */
+  SMPI_OP_PUT,                /* 158 */
+  SMPI_OP_GET,
+  SMPI_OP_ACCUMULATE,         /* 160 */
+  SMPI_OP_GET_ACCUMULATE,
+  SMPI_OP_FETCH_AND_OP,
+  SMPI_OP_COMPARE_AND_SWAP,
+  SMPI_OP_RPUT,
+  SMPI_OP_RGET,               /* 165 */
+  SMPI_OP_RACCUMULATE,
+  SMPI_OP_RGET_ACCUMULATE,
+  SMPI_OP_WIN_ALLOCATE,
+  SMPI_OP_WIN_ALLOCATE_SHARED,
+  SMPI_OP_WIN_CREATE_DYNAMIC, /* 170 */
+  SMPI_OP_WIN_ATTACH,
+  SMPI_OP_WIN_DETACH,
+  SMPI_OP_WIN_SHARED_QUERY,
+  SMPI_OP_WIN_LOCK,
+  SMPI_OP_WIN_UNLOCK,         /* 175 */
+  SMPI_OP_WIN_LOCK_ALL,
+  SMPI_OP_WIN_UNLOCK_ALL,
+  SMPI_OP_WIN_FLUSH,
+  SMPI_OP_WIN_FLUSH_LOCAL,
+  SMPI_OP_WIN_FLUSH_ALL,      /* 180 */
+  SMPI_OP_WIN_FLUSH_LOCAL_ALL,
+  SMPI_OP_WIN_SYNC,
+  SMPI_OP_WIN_START,
+  SMPI_OP_WIN_COMPLETE,
+  SMPI_OP_WIN_POST,           /* 185 */
+  SMPI_OP_WIN_WAIT,
+  SMPI_OP_WIN_TEST,
+  SMPI_OP_WIN_GET_GROUP,
+  SMPI_OP_WIN_SET_NAME,
+  SMPI_OP_WIN_GET_NAME,       /* 190 */
+  SMPI_OP_WIN_KEYVAL_CREATE,
+  SMPI_OP_WIN_KEYVAL_FREE,
+  SMPI_OP_WIN_DELETE_ATTR,
+  SMPI_OP_WIN_SET_ERRHANDLER,
+  SMPI_OP_WIN_GET_ERRHANDLER, /* 195 */
+  SMPI_OP_WIN_CALL_ERRHANDLER,
 };
 
 /* sub-modes for FILE_READ / FILE_WRITE */
@@ -904,15 +944,124 @@ int MPI_Group_compare(MPI_Group group1, MPI_Group group2, int* result) {
   CALL(SMPI_OP_GROUP_COMPARE, A(group1), A(group2), A(result));
 }
 static int smpi_info_counter = 1;
+/* Info objects are a pure C-side key/value store: the simulation kernel
+ * treats hints as opaque, so no dispatch round-trip is needed (the
+ * reference's smpi_info.cpp is likewise a plain std::map). */
+typedef struct smpi_info_kv {
+  char key[MPI_MAX_INFO_KEY + 1];
+  char val[MPI_MAX_INFO_VAL + 1];
+  struct smpi_info_kv* next;
+} smpi_info_kv;
+#define SMPI_INFO_CAP 1024
+static smpi_info_kv* smpi_info_store[SMPI_INFO_CAP];
+
 int MPI_Info_create(MPI_Info* info) {
   *info = smpi_info_counter++;
+  if (*info < SMPI_INFO_CAP) smpi_info_store[*info] = 0;
   return MPI_SUCCESS;
 }
+static int smpi_strcpy_n(char* dst, const char* src, int cap) {
+  int i = 0;
+  for (; src[i] && i < cap; i++) dst[i] = src[i];
+  dst[i] = 0;
+  return i;
+}
+static int smpi_streq(const char* a, const char* b) {
+  while (*a && *a == *b) { a++; b++; }
+  return *a == *b;
+}
 int MPI_Info_set(MPI_Info info, const char* key, const char* value) {
-  (void)info; (void)key; (void)value;
+  smpi_info_kv* kv;
+  if (info <= 0 || info >= SMPI_INFO_CAP) return MPI_ERR_INFO;
+  for (kv = smpi_info_store[info]; kv; kv = kv->next)
+    if (smpi_streq(kv->key, key)) {
+      smpi_strcpy_n(kv->val, value, MPI_MAX_INFO_VAL);
+      return MPI_SUCCESS;
+    }
+  kv = (smpi_info_kv*)malloc(sizeof(smpi_info_kv));
+  smpi_strcpy_n(kv->key, key, MPI_MAX_INFO_KEY);
+  smpi_strcpy_n(kv->val, value, MPI_MAX_INFO_VAL);
+  kv->next = 0;
+  /* append (MPI_Info_get_nthkey exposes insertion order) */
+  if (!smpi_info_store[info]) smpi_info_store[info] = kv;
+  else {
+    smpi_info_kv* tail = smpi_info_store[info];
+    while (tail->next) tail = tail->next;
+    tail->next = kv;
+  }
+  return MPI_SUCCESS;
+}
+static smpi_info_kv* smpi_info_find(MPI_Info info, const char* key) {
+  smpi_info_kv* kv;
+  if (info <= 0 || info >= SMPI_INFO_CAP) return 0;
+  for (kv = smpi_info_store[info]; kv; kv = kv->next)
+    if (smpi_streq(kv->key, key)) return kv;
+  return 0;
+}
+int MPI_Info_get(MPI_Info info, const char* key, int valuelen, char* value,
+                 int* flag) {
+  smpi_info_kv* kv = smpi_info_find(info, key);
+  if (flag) *flag = kv != 0;
+  if (kv && value) smpi_strcpy_n(value, kv->val, valuelen);
+  return MPI_SUCCESS;
+}
+int MPI_Info_get_valuelen(MPI_Info info, const char* key, int* valuelen,
+                          int* flag) {
+  smpi_info_kv* kv = smpi_info_find(info, key);
+  if (flag) *flag = kv != 0;
+  if (kv && valuelen) {
+    int n = 0;
+    while (kv->val[n]) n++;
+    *valuelen = n;
+  }
+  return MPI_SUCCESS;
+}
+int MPI_Info_get_nkeys(MPI_Info info, int* nkeys) {
+  int n = 0;
+  smpi_info_kv* kv;
+  if (info <= 0 || info >= SMPI_INFO_CAP) return MPI_ERR_INFO;
+  for (kv = smpi_info_store[info]; kv; kv = kv->next) n++;
+  *nkeys = n;
+  return MPI_SUCCESS;
+}
+int MPI_Info_get_nthkey(MPI_Info info, int n, char* key) {
+  smpi_info_kv* kv;
+  if (info <= 0 || info >= SMPI_INFO_CAP) return MPI_ERR_INFO;
+  kv = smpi_info_store[info];
+  while (n-- > 0 && kv) kv = kv->next;
+  if (!kv) return MPI_ERR_ARG;
+  smpi_strcpy_n(key, kv->key, MPI_MAX_INFO_KEY);
+  return MPI_SUCCESS;
+}
+int MPI_Info_delete(MPI_Info info, const char* key) {
+  smpi_info_kv **p, *kv;
+  if (info <= 0 || info >= SMPI_INFO_CAP) return MPI_ERR_INFO;
+  for (p = &smpi_info_store[info]; (kv = *p); p = &kv->next)
+    if (smpi_streq(kv->key, key)) {
+      *p = kv->next;
+      free(kv);
+      return MPI_SUCCESS;
+    }
+  return MPI_ERR_INFO;
+}
+int MPI_Info_dup(MPI_Info info, MPI_Info* newinfo) {
+  smpi_info_kv* kv;
+  MPI_Info_create(newinfo);
+  if (info > 0 && info < SMPI_INFO_CAP)
+    for (kv = smpi_info_store[info]; kv; kv = kv->next)
+      MPI_Info_set(*newinfo, kv->key, kv->val);
   return MPI_SUCCESS;
 }
 int MPI_Info_free(MPI_Info* info) {
+  if (*info > 0 && *info < SMPI_INFO_CAP) {
+    smpi_info_kv* kv = smpi_info_store[*info];
+    while (kv) {
+      smpi_info_kv* next = kv->next;
+      free(kv);
+      kv = next;
+    }
+    smpi_info_store[*info] = 0;
+  }
   *info = MPI_INFO_NULL;
   return MPI_SUCCESS;
 }
@@ -969,15 +1118,6 @@ int MPI_Attr_get(MPI_Comm comm, int keyval, void* value, int* flag) {
 int MPI_Attr_delete(MPI_Comm comm, int keyval) {
   return MPI_Comm_delete_attr(comm, keyval);
 }
-int MPI_Win_create_keyval(MPI_Win_copy_attr_function* copy_fn,
-                          MPI_Win_delete_attr_function* delete_fn,
-                          int* keyval, void* extra_state) {
-  (void)copy_fn; (void)delete_fn; (void)extra_state;
-  CALL(SMPI_OP_KEYVAL_CREATE, A(keyval));
-}
-int MPI_Win_free_keyval(int* keyval) {
-  CALL(SMPI_OP_KEYVAL_FREE, A(keyval));
-}
 int MPI_Win_create(void* base, MPI_Aint size, int disp_unit,
                    MPI_Info info, MPI_Comm comm, MPI_Win* win) {
   (void)info;
@@ -993,6 +1133,204 @@ int MPI_Win_get_attr(MPI_Win win, int keyval, void* value, int* flag) {
 }
 int MPI_Win_set_attr(MPI_Win win, int keyval, void* value) {
   CALL(SMPI_OP_WIN_SET_ATTR, A(win), A(keyval), A(value));
+}
+
+/* -- one-sided communication (MPI-3 RMA) ---------------------------------- */
+int MPI_Put(const void* origin_addr, int origin_count,
+            MPI_Datatype origin_datatype, int target_rank,
+            MPI_Aint target_disp, int target_count,
+            MPI_Datatype target_datatype, MPI_Win win) {
+  CALL(SMPI_OP_PUT, A(origin_addr), A(origin_count), A(origin_datatype),
+       A(target_rank), A(target_disp), A(target_count), A(target_datatype),
+       A(win));
+}
+int MPI_Get(void* origin_addr, int origin_count,
+            MPI_Datatype origin_datatype, int target_rank,
+            MPI_Aint target_disp, int target_count,
+            MPI_Datatype target_datatype, MPI_Win win) {
+  CALL(SMPI_OP_GET, A(origin_addr), A(origin_count), A(origin_datatype),
+       A(target_rank), A(target_disp), A(target_count), A(target_datatype),
+       A(win));
+}
+int MPI_Accumulate(const void* origin_addr, int origin_count,
+                   MPI_Datatype origin_datatype, int target_rank,
+                   MPI_Aint target_disp, int target_count,
+                   MPI_Datatype target_datatype, MPI_Op op, MPI_Win win) {
+  CALL(SMPI_OP_ACCUMULATE, A(origin_addr), A(origin_count),
+       A(origin_datatype), A(target_rank), A(target_disp), A(target_count),
+       A(target_datatype), A(op), A(win));
+}
+int MPI_Get_accumulate(const void* origin_addr, int origin_count,
+                       MPI_Datatype origin_datatype, void* result_addr,
+                       int result_count, MPI_Datatype result_datatype,
+                       int target_rank, MPI_Aint target_disp,
+                       int target_count, MPI_Datatype target_datatype,
+                       MPI_Op op, MPI_Win win) {
+  CALL(SMPI_OP_GET_ACCUMULATE, A(origin_addr), A(origin_count),
+       A(origin_datatype), A(result_addr), A(result_count),
+       A(result_datatype), A(target_rank), A(target_disp), A(target_count),
+       A(target_datatype), A(op), A(win));
+}
+int MPI_Fetch_and_op(const void* origin_addr, void* result_addr,
+                     MPI_Datatype datatype, int target_rank,
+                     MPI_Aint target_disp, MPI_Op op, MPI_Win win) {
+  CALL(SMPI_OP_FETCH_AND_OP, A(origin_addr), A(result_addr), A(datatype),
+       A(target_rank), A(target_disp), A(op), A(win));
+}
+int MPI_Compare_and_swap(const void* origin_addr, const void* compare_addr,
+                         void* result_addr, MPI_Datatype datatype,
+                         int target_rank, MPI_Aint target_disp,
+                         MPI_Win win) {
+  CALL(SMPI_OP_COMPARE_AND_SWAP, A(origin_addr), A(compare_addr),
+       A(result_addr), A(datatype), A(target_rank), A(target_disp), A(win));
+}
+int MPI_Rput(const void* origin_addr, int origin_count,
+             MPI_Datatype origin_datatype, int target_rank,
+             MPI_Aint target_disp, int target_count,
+             MPI_Datatype target_datatype, MPI_Win win,
+             MPI_Request* request) {
+  CALL(SMPI_OP_RPUT, A(origin_addr), A(origin_count), A(origin_datatype),
+       A(target_rank), A(target_disp), A(target_count), A(target_datatype),
+       A(win), A(request));
+}
+int MPI_Rget(void* origin_addr, int origin_count,
+             MPI_Datatype origin_datatype, int target_rank,
+             MPI_Aint target_disp, int target_count,
+             MPI_Datatype target_datatype, MPI_Win win,
+             MPI_Request* request) {
+  CALL(SMPI_OP_RGET, A(origin_addr), A(origin_count), A(origin_datatype),
+       A(target_rank), A(target_disp), A(target_count), A(target_datatype),
+       A(win), A(request));
+}
+int MPI_Raccumulate(const void* origin_addr, int origin_count,
+                    MPI_Datatype origin_datatype, int target_rank,
+                    MPI_Aint target_disp, int target_count,
+                    MPI_Datatype target_datatype, MPI_Op op, MPI_Win win,
+                    MPI_Request* request) {
+  CALL(SMPI_OP_RACCUMULATE, A(origin_addr), A(origin_count),
+       A(origin_datatype), A(target_rank), A(target_disp), A(target_count),
+       A(target_datatype), A(op), A(win), A(request));
+}
+int MPI_Rget_accumulate(const void* origin_addr, int origin_count,
+                        MPI_Datatype origin_datatype, void* result_addr,
+                        int result_count, MPI_Datatype result_datatype,
+                        int target_rank, MPI_Aint target_disp,
+                        int target_count, MPI_Datatype target_datatype,
+                        MPI_Op op, MPI_Win win, MPI_Request* request) {
+  CALL(SMPI_OP_RGET_ACCUMULATE, A(origin_addr), A(origin_count),
+       A(origin_datatype), A(result_addr), A(result_count),
+       A(result_datatype), A(target_rank), A(target_disp), A(target_count),
+       A(target_datatype), A(op), A(win), A(request));
+}
+int MPI_Win_allocate(MPI_Aint size, int disp_unit, MPI_Info info,
+                     MPI_Comm comm, void* baseptr, MPI_Win* win) {
+  CALL(SMPI_OP_WIN_ALLOCATE, A(size), A(disp_unit), A(info), A(comm),
+       A(baseptr), A(win));
+}
+int MPI_Win_allocate_shared(MPI_Aint size, int disp_unit, MPI_Info info,
+                            MPI_Comm comm, void* baseptr, MPI_Win* win) {
+  CALL(SMPI_OP_WIN_ALLOCATE_SHARED, A(size), A(disp_unit), A(info), A(comm),
+       A(baseptr), A(win));
+}
+int MPI_Win_create_dynamic(MPI_Info info, MPI_Comm comm, MPI_Win* win) {
+  CALL(SMPI_OP_WIN_CREATE_DYNAMIC, A(info), A(comm), A(win));
+}
+int MPI_Win_attach(MPI_Win win, void* base, MPI_Aint size) {
+  CALL(SMPI_OP_WIN_ATTACH, A(win), A(base), A(size));
+}
+int MPI_Win_detach(MPI_Win win, const void* base) {
+  CALL(SMPI_OP_WIN_DETACH, A(win), A(base));
+}
+int MPI_Win_shared_query(MPI_Win win, int rank, MPI_Aint* size,
+                         int* disp_unit, void* baseptr) {
+  CALL(SMPI_OP_WIN_SHARED_QUERY, A(win), A(rank), A(size), A(disp_unit),
+       A(baseptr));
+}
+int MPI_Win_lock(int lock_type, int rank, int assertion, MPI_Win win) {
+  CALL(SMPI_OP_WIN_LOCK, A(lock_type), A(rank), A(assertion), A(win));
+}
+int MPI_Win_unlock(int rank, MPI_Win win) {
+  CALL(SMPI_OP_WIN_UNLOCK, A(rank), A(win));
+}
+int MPI_Win_lock_all(int assertion, MPI_Win win) {
+  CALL(SMPI_OP_WIN_LOCK_ALL, A(assertion), A(win));
+}
+int MPI_Win_unlock_all(MPI_Win win) {
+  CALL(SMPI_OP_WIN_UNLOCK_ALL, A(win));
+}
+int MPI_Win_flush(int rank, MPI_Win win) {
+  CALL(SMPI_OP_WIN_FLUSH, A(rank), A(win));
+}
+int MPI_Win_flush_local(int rank, MPI_Win win) {
+  CALL(SMPI_OP_WIN_FLUSH_LOCAL, A(rank), A(win));
+}
+int MPI_Win_flush_all(MPI_Win win) {
+  CALL(SMPI_OP_WIN_FLUSH_ALL, A(win));
+}
+int MPI_Win_flush_local_all(MPI_Win win) {
+  CALL(SMPI_OP_WIN_FLUSH_LOCAL_ALL, A(win));
+}
+int MPI_Win_sync(MPI_Win win) {
+  CALL(SMPI_OP_WIN_SYNC, A(win));
+}
+int MPI_Win_start(MPI_Group group, int assertion, MPI_Win win) {
+  CALL(SMPI_OP_WIN_START, A(group), A(assertion), A(win));
+}
+int MPI_Win_complete(MPI_Win win) {
+  CALL(SMPI_OP_WIN_COMPLETE, A(win));
+}
+int MPI_Win_post(MPI_Group group, int assertion, MPI_Win win) {
+  CALL(SMPI_OP_WIN_POST, A(group), A(assertion), A(win));
+}
+int MPI_Win_wait(MPI_Win win) {
+  CALL(SMPI_OP_WIN_WAIT, A(win));
+}
+int MPI_Win_test(MPI_Win win, int* flag) {
+  CALL(SMPI_OP_WIN_TEST, A(win), A(flag));
+}
+int MPI_Win_get_group(MPI_Win win, MPI_Group* group) {
+  CALL(SMPI_OP_WIN_GET_GROUP, A(win), A(group));
+}
+int MPI_Win_set_name(MPI_Win win, const char* name) {
+  CALL(SMPI_OP_WIN_SET_NAME, A(win), A(name));
+}
+int MPI_Win_get_name(MPI_Win win, char* name, int* resultlen) {
+  CALL(SMPI_OP_WIN_GET_NAME, A(win), A(name), A(resultlen));
+}
+int MPI_Win_create_keyval(MPI_Win_copy_attr_function* copy_fn,
+                          MPI_Win_delete_attr_function* delete_fn,
+                          int* keyval, void* extra_state) {
+  CALL(SMPI_OP_WIN_KEYVAL_CREATE, A(copy_fn), A(delete_fn), A(keyval),
+       A(extra_state));
+}
+int MPI_Win_free_keyval(int* keyval) {
+  CALL(SMPI_OP_WIN_KEYVAL_FREE, A(keyval));
+}
+int MPI_Win_delete_attr(MPI_Win win, int keyval) {
+  CALL(SMPI_OP_WIN_DELETE_ATTR, A(win), A(keyval));
+}
+int MPI_Win_set_errhandler(MPI_Win win, MPI_Errhandler errhandler) {
+  CALL(SMPI_OP_WIN_SET_ERRHANDLER, A(win), A(errhandler));
+}
+int MPI_Win_get_errhandler(MPI_Win win, MPI_Errhandler* errhandler) {
+  CALL(SMPI_OP_WIN_GET_ERRHANDLER, A(win), A(errhandler));
+}
+int MPI_Win_create_errhandler(MPI_Win_errhandler_function* fn,
+                              MPI_Errhandler* errhandler) {
+  (void)fn;
+  if (errhandler) *errhandler = 3; /* user win errhandler (opaque) */
+  return MPI_SUCCESS;
+}
+int MPI_Win_call_errhandler(MPI_Win win, int errorcode) {
+  CALL(SMPI_OP_WIN_CALL_ERRHANDLER, A(win), A(errorcode));
+}
+int MPI_Win_get_info(MPI_Win win, MPI_Info* info) {
+  (void)win;
+  return MPI_Info_create(info);
+}
+int MPI_Win_set_info(MPI_Win win, MPI_Info info) {
+  (void)win; (void)info;
+  return MPI_SUCCESS;
 }
 
 /* -- struct datatypes -------------------------------------------------------- */

@@ -12,8 +12,13 @@ need the memory image at all: it is the pair
 and resuming is rebuilding + fast-forwarding with Engine.run_until —
 bit-identical state by determinism, the same argument that lets the
 model checker re-execute instead of snapshotting (mc/explorer.py).
-Tokens pickle to a few hundred bytes and survive process restarts,
-which page-store snapshots cannot.
+Tokens serialize to a few hundred bytes of JSON and survive process
+restarts, which page-store snapshots cannot.
+
+SECURITY: ``resume()`` imports and CALLS the module-level callable
+named in the token, so only load checkpoint files you trust — the
+token format is plain JSON (no pickle), so loading alone executes
+nothing, but resuming executes the named setup function.
 
 Contract: `setup` must be an importable module-level callable that
 builds the engine (platform + actors) from its arguments and returns
@@ -24,7 +29,7 @@ time dependence — the usual determinism requirement).
 from __future__ import annotations
 
 import importlib
-import pickle
+import json
 from typing import Any, Optional, Tuple
 
 
@@ -76,17 +81,27 @@ class Checkpoint:
 
     # -- persistence ---------------------------------------------------
     def save(self, path: str) -> None:
-        with open(path, "wb") as f:
-            pickle.dump({"module": self._module, "qualname": self._qualname,
-                         "args": self.args, "at": self.at}, f)
+        """JSON on purpose: a checkpoint file must be data, not code
+        (pickle.load would execute arbitrary payloads).  Args are
+        therefore restricted to JSON-representable plain data."""
+        try:
+            blob = json.dumps({"module": self._module,
+                               "qualname": self._qualname,
+                               "args": list(self.args), "at": self.at})
+        except TypeError as exc:
+            raise TypeError(
+                "checkpoint args must be JSON-serializable plain data "
+                f"(module={self._module}, args={self.args!r}): {exc}")
+        with open(path, "w") as f:
+            f.write(blob)
 
     @classmethod
     def load(cls, path: str) -> "Checkpoint":
-        with open(path, "rb") as f:
-            d = pickle.load(f)
+        with open(path) as f:
+            d = json.load(f)
         token = cls.__new__(cls)
-        token._module = d["module"]
-        token._qualname = d["qualname"]
+        token._module = str(d["module"])
+        token._qualname = str(d["qualname"])
         token.args = tuple(d["args"])
         token.at = float(d["at"])
         return token

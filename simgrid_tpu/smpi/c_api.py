@@ -260,6 +260,7 @@ class _CRankCtx:
         self.next_keyval = 64
         self.wins: Dict[int, dict] = {}
         self.next_win = 1
+        self.win_keyvals: Dict[int, tuple] = {}
         self.cart_topos: Dict[int, object] = {}
         self.graph_topos: Dict[int, object] = {}
         self.comm_names: Dict[int, str] = {}
@@ -2120,23 +2121,169 @@ def _h_attr_delete(ctx, a):
     return MPI_SUCCESS
 
 
+# -- one-sided communication (MPI-3 RMA) ------------------------------------
+# Role of reference src/smpi/bindings/smpi_pmpi_win.cpp + smpi_win.cpp:
+# handle translation + datatype-mapped marshalling here, epoch state
+# machine and simulated transfers in win.py.
+
+MPI_ERR_WIN = 17
+MPI_ERR_RANK = 7
+OP_REPLACE, OP_NO_OP = 13, 14
+_WIN_FLAVOR_KV, _WIN_MODEL_KV = 19, 20
+C_WIN_UNIFIED = 2
+_WIN_ERRORS_RETURN = 1
+
+_WIN_DELETE_CFUNC = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int,
+                                     ctypes.c_int, ctypes.c_void_p,
+                                     ctypes.c_void_p)
+
+
+class _RmaReq:
+    """Request adapter for MPI_Rget/Rget_accumulate (reply in flight)
+    and the already-locally-complete Rput/Raccumulate (comm=None)."""
+
+    __slots__ = ("_comm", "_payload", "finished")
+
+    def __init__(self, comm=None):
+        self._comm = comm
+        self._payload = None
+        self.finished = comm is None
+
+    def wait(self):
+        if not self.finished:
+            self._comm.wait()
+            self._payload = self._comm.get_payload()[0]
+            self.finished = True
+        return self._payload
+
+    def test(self) -> bool:
+        if self.finished:
+            return True
+        if self._comm.test():
+            self._payload = self._comm.get_payload()[0]
+            self.finished = True
+            return True
+        return False
+
+
+def _win_entry(ctx, handle):
+    return ctx.wins.get(int(handle))
+
+
+def _new_win_handle(ctx, win, base, size, disp_unit, flavor,
+                    keep=None) -> int:
+    h = ctx.next_win
+    ctx.next_win += 1
+    # attr cells live as long as the win entry (get_attr returns
+    # POINTERS to them)
+    ctx.wins[h] = {"win": win, "base": int(base),
+                   "size_cell": ctypes.c_longlong(int(size)),
+                   "disp_cell": ctypes.c_int(int(disp_unit)),
+                   "flavor_cell": ctypes.c_int(int(flavor)),
+                   "model_cell": ctypes.c_int(C_WIN_UNIFIED),
+                   "attrs": {}, "name": "", "errh": 0,
+                   "keep": keep, "attached": []}
+    return h
+
+
 def _h_win_create(ctx, a):
-    from .win import Win
+    from .win import CMemory, Win
     base, size, disp, ch, win_addr = (int(a[0]), int(a[1]), int(a[2]),
                                       a[3], a[4])
     comm = _comm_of(ctx, ch)
     if comm is None:
         return MPI_ERR_COMM
-    data = np.zeros(max(size, 1), np.uint8)
-    win = Win(comm, data, size_bytes=size)
-    h = ctx.next_win
-    ctx.next_win += 1
-    # the size/disp cells live as long as the win entry (attr gets
-    # return POINTERS to them)
-    ctx.wins[h] = {"win": win, "base": base,
-                   "size_cell": ctypes.c_longlong(size),
-                   "disp_cell": ctypes.c_int(disp), "attrs": {}}
+    win = Win(comm, memory=CMemory(base, max(disp, 1), size))
+    _write_i32(win_addr, _new_win_handle(ctx, win, base, size, disp, 1))
+    return MPI_SUCCESS
+
+
+def _h_win_allocate(ctx, a, shared=False):
+    from .win import CMemory, Win
+    size, disp, ch, base_addr, win_addr = (int(a[0]), int(a[1]), a[3],
+                                           a[4], a[5])
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    if shared:
+        # one contiguous allocation spanning all ranks (every rank of
+        # this simulated node shares the process address space, so
+        # direct load/store into a peer's segment works natively,
+        # matching MPI_WIN_UNIFIED); rank 0 owns the buffer object.
+        sizes = comm.allgather(int(size))
+        units = comm.allgather(int(disp))
+        # exact sizes, NOT padded: MPI-3 §11.2.3 guarantees the default
+        # (contiguous) layout puts rank i+1's segment at rank i's base
+        # + size, and programs legally address neighbors that way
+        aligned = list(sizes)
+        r = comm.rank()
+        shared_blob = None
+        if r == 0:
+            buf = (ctypes.c_char * max(sum(aligned), 1))()
+            shared_blob = {"buf": buf, "base0": ctypes.addressof(buf)}
+        shared_blob = comm.bcast(shared_blob, 0)
+        offs = [sum(aligned[:i]) for i in range(len(sizes))]
+        base = shared_blob["base0"] + offs[r]
+        win = Win(comm, memory=CMemory(base, max(int(disp), 1), size))
+        h = _new_win_handle(ctx, win, base, size, disp, 4,
+                            keep=shared_blob["buf"])
+        ctx.wins[h]["shared"] = {
+            "bases": [shared_blob["base0"] + o for o in offs],
+            "sizes": sizes, "units": units}
+    else:
+        buf = (ctypes.c_char * max(int(size), 1))()
+        base = ctypes.addressof(buf)
+        win = Win(comm, memory=CMemory(base, max(int(disp), 1), size))
+        h = _new_win_handle(ctx, win, base, size, disp, 2, keep=buf)
+    _write_i64(base_addr, ctx.wins[h]["base"])
     _write_i32(win_addr, h)
+    return MPI_SUCCESS
+
+
+def _h_win_create_dynamic(ctx, a):
+    from .win import CMemory, Win
+    ch, win_addr = a[1], a[2]
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    # dynamic windows address by absolute MPI_Get_address values:
+    # base 0, disp_unit 1 (MPI-3 §11.2.4)
+    win = Win(comm, memory=CMemory(0, 1, 0))
+    _write_i32(win_addr, _new_win_handle(ctx, win, 0, 0, 1, 3))
+    return MPI_SUCCESS
+
+
+def _h_win_attach(ctx, a):
+    entry = _win_entry(ctx, a[0])
+    if entry is None:
+        return MPI_ERR_WIN
+    entry["attached"].append((int(a[1]), int(a[2])))
+    return MPI_SUCCESS
+
+
+def _h_win_detach(ctx, a):
+    entry = _win_entry(ctx, a[0])
+    if entry is None:
+        return MPI_ERR_WIN
+    entry["attached"] = [(b, s) for b, s in entry["attached"]
+                         if b != int(a[1])]
+    return MPI_SUCCESS
+
+
+def _h_win_shared_query(ctx, a):
+    entry = _win_entry(ctx, a[0])
+    if entry is None:
+        return MPI_ERR_WIN
+    shared = entry.get("shared")
+    if shared is None:
+        return MPI_ERR_WIN
+    rank = int(a[1])
+    if rank == C_PROC_NULL:
+        # first rank with a non-empty segment (MPI-3 §11.2.3)
+        rank = next((i for i, s in enumerate(shared["sizes"]) if s), 0)
+    _write_i64(a[2], shared["sizes"][rank])
+    _write_i32(a[3], shared["units"][rank])
+    _write_i64(a[4], shared["bases"][rank])
     return MPI_SUCCESS
 
 
@@ -2144,16 +2291,19 @@ def _h_win_free(ctx, a):
     h = ctypes.cast(int(a[0]), _pi32)[0] if a[0] else 0
     entry = ctx.wins.pop(int(h), None)
     if entry is not None:
+        # delete-attr callbacks fire on free (MPI-3 §6.7.2)
+        for kv in list(entry["attrs"]):
+            _win_attr_delete(ctx, entry, int(h), kv)
         entry["win"].free()
     _write_i32(a[0], 0)
     return MPI_SUCCESS
 
 
 def _h_win_fence(ctx, a):
-    entry = ctx.wins.get(int(a[1]))
+    entry = _win_entry(ctx, a[1])
     if entry is None:
-        return MPI_ERR_ARG
-    entry["win"].fence()
+        return MPI_ERR_WIN
+    entry["win"].fence(int(a[0]))
     return MPI_SUCCESS
 
 
@@ -2161,7 +2311,7 @@ def _h_win_get_attr(ctx, a):
     wh, kv, val_addr, flag_addr = int(a[0]), int(a[1]), a[2], a[3]
     entry = ctx.wins.get(wh)
     if entry is None:
-        return MPI_ERR_ARG
+        return MPI_ERR_WIN
     p64 = ctypes.cast(int(val_addr), _pi64)
     if kv == _WIN_BASE:
         p64[0] = entry["base"]
@@ -2169,6 +2319,10 @@ def _h_win_get_attr(ctx, a):
         p64[0] = ctypes.addressof(entry["size_cell"])
     elif kv == _WIN_DISP:
         p64[0] = ctypes.addressof(entry["disp_cell"])
+    elif kv == _WIN_FLAVOR_KV:
+        p64[0] = ctypes.addressof(entry["flavor_cell"])
+    elif kv == _WIN_MODEL_KV:
+        p64[0] = ctypes.addressof(entry["model_cell"])
     else:
         stored = entry["attrs"].get(kv)
         if stored is None:
@@ -2179,11 +2333,400 @@ def _h_win_get_attr(ctx, a):
     return MPI_SUCCESS
 
 
+def _win_attr_delete(ctx, entry, wh: int, kv: int) -> None:
+    value = entry["attrs"].pop(kv, None)
+    fns = ctx.win_keyvals.get(kv)
+    if value is None or fns is None:
+        return
+    _copy_fn, delete_fn, extra = fns
+    if delete_fn:
+        _WIN_DELETE_CFUNC(delete_fn)(wh, kv, value, extra)
+
+
 def _h_win_set_attr(ctx, a):
     entry = ctx.wins.get(int(a[0]))
     if entry is None:
-        return MPI_ERR_ARG
-    entry["attrs"][int(a[1])] = int(a[2])
+        return MPI_ERR_WIN
+    kv = int(a[1])
+    if kv in entry["attrs"]:
+        _win_attr_delete(ctx, entry, int(a[0]), kv)
+    entry["attrs"][kv] = int(a[2])
+    return MPI_SUCCESS
+
+
+def _h_win_delete_attr(ctx, a):
+    entry = ctx.wins.get(int(a[0]))
+    if entry is None:
+        return MPI_ERR_WIN
+    _win_attr_delete(ctx, entry, int(a[0]), int(a[1]))
+    return MPI_SUCCESS
+
+
+def _h_win_keyval_create(ctx, a):
+    h = ctx.next_keyval
+    ctx.next_keyval += 1
+    ctx.win_keyvals[h] = (int(a[0]), int(a[1]), int(a[3]))
+    _write_i32(a[2], h)
+    return MPI_SUCCESS
+
+
+def _h_win_keyval_free(ctx, a):
+    h = ctypes.cast(int(a[0]), _pi32)[0] if a[0] else 0
+    ctx.win_keyvals.pop(int(h), None)
+    _write_i32(a[0], -1)      # MPI_KEYVAL_INVALID
+    return MPI_SUCCESS
+
+
+def _h_win_set_name(ctx, a):
+    entry = _win_entry(ctx, a[0])
+    if entry is None:
+        return MPI_ERR_WIN
+    entry["name"] = ctypes.string_at(int(a[1])).decode(errors="replace")[:127]
+    return MPI_SUCCESS
+
+
+def _h_win_get_name(ctx, a):
+    entry = _win_entry(ctx, a[0])
+    if entry is None:
+        return MPI_ERR_WIN
+    name = entry["name"].encode()
+    ctypes.memmove(int(a[1]), name + b"\0", len(name) + 1)
+    _write_i32(a[2], len(name))
+    return MPI_SUCCESS
+
+
+def _h_win_get_group(ctx, a):
+    entry = _win_entry(ctx, a[0])
+    if entry is None:
+        return MPI_ERR_WIN
+    group = entry["win"].comm.get_group()
+    _write_i32(a[1], _new_group_handle(ctx, Group(group.world_ranks)))
+    return MPI_SUCCESS
+
+
+def _h_win_set_errhandler(ctx, a):
+    entry = _win_entry(ctx, a[0])
+    if entry is None:
+        return MPI_ERR_WIN
+    entry["errh"] = int(a[1])
+    return MPI_SUCCESS
+
+
+def _h_win_get_errhandler(ctx, a):
+    entry = _win_entry(ctx, a[0])
+    if entry is None:
+        return MPI_ERR_WIN
+    _write_i32(a[1], entry["errh"])
+    return MPI_SUCCESS
+
+
+def _h_win_call_errhandler(ctx, a):
+    entry = _win_entry(ctx, a[0])
+    if entry is None:
+        return MPI_ERR_WIN
+    return MPI_SUCCESS        # ERRORS_RETURN semantics: report, continue
+
+
+def _rma_op_of(ctx, oph, dt):
+    oph = int(oph)
+    if oph == OP_REPLACE:
+        return "replace"
+    if oph == OP_NO_OP:
+        return None
+    return _op_of(ctx, oph, dt)
+
+
+def _leaf_dt(dt: Datatype) -> Datatype:
+    """The predefined leaf of a derived type (MPI restricts accumulate
+    to a uniform predefined basic type; C-API derived types clear
+    np_dtype because payloads travel packed)."""
+    depth = 0
+    while getattr(dt, "c_env_types", None) and depth < 64:
+        dt = dt.c_env_types[0]
+        depth += 1
+    return dt
+
+
+def _rma_target_args(entry, tdisp, tcount, tdt):
+    return (int(tdisp), int(tcount), tdt)
+
+
+def _h_rma_put(ctx, a, with_req=False):
+    obuf, ocount, odth, trank, tdisp, tcount, tdth, wh = a[:8]
+    entry = _win_entry(ctx, wh)
+    if entry is None:
+        return MPI_ERR_WIN
+    trank = int(trank)
+    if trank == C_PROC_NULL or int(ocount) == 0 or int(tcount) == 0:
+        if with_req:
+            _write_i32(a[8], _new_req_handle(
+                ctx, _CReq(_RmaReq(None), 0, None, "nbc")))
+        return MPI_SUCCESS
+    if trank < 0 or trank >= entry["win"].comm.size():
+        return MPI_ERR_RANK
+    odt, tdt = _dt(ctx, odth), _dt(ctx, tdth)
+    payload = _arr_in(obuf, ocount, odt)
+    entry["win"].c_put(trank, (int(tdisp), int(tcount), tdt), payload,
+                       int(ocount) * odt.size_)
+    if with_req:
+        _write_i32(a[8], _new_req_handle(
+            ctx, _CReq(_RmaReq(None), 0, None, "nbc")))
+    return MPI_SUCCESS
+
+
+def _h_rma_get(ctx, a, with_req=False):
+    obuf, ocount, odth, trank, tdisp, tcount, tdth, wh = a[:8]
+    entry = _win_entry(ctx, wh)
+    if entry is None:
+        return MPI_ERR_WIN
+    trank = int(trank)
+    if trank == C_PROC_NULL or int(ocount) == 0 or int(tcount) == 0:
+        if with_req:
+            _write_i32(a[8], _new_req_handle(
+                ctx, _CReq(_RmaReq(None), 0, None, "nbc")))
+        return MPI_SUCCESS
+    if trank < 0 or trank >= entry["win"].comm.size():
+        return MPI_ERR_RANK
+    odt, tdt = _dt(ctx, odth), _dt(ctx, tdth)
+    args = (int(tdisp), int(tcount), tdt)
+    nbytes = int(tcount) * tdt.size_
+    if with_req:
+        comm = entry["win"].c_get_async(trank, args, nbytes)
+        creq = _CReq(_RmaReq(comm), 0, None, "nbc",
+                     post=_scatter_closure(int(obuf), odt))
+        _write_i32(a[8], _new_req_handle(ctx, creq))
+        return MPI_SUCCESS
+    payload = entry["win"].c_get(trank, args, nbytes)
+    _arr_out(int(obuf), payload, dt=odt)
+    return MPI_SUCCESS
+
+
+def _scatter_closure(addr: int, dt: Datatype):
+    def post(payload):
+        _arr_out(addr, payload, dt=dt)
+    return post
+
+
+def _h_rma_acc(ctx, a, with_req=False):
+    obuf, ocount, odth, trank, tdisp, tcount, tdth, oph, wh = a[:9]
+    entry = _win_entry(ctx, wh)
+    if entry is None:
+        return MPI_ERR_WIN
+    trank = int(trank)
+    if trank == C_PROC_NULL or int(ocount) == 0 or int(tcount) == 0:
+        if with_req:
+            _write_i32(a[9], _new_req_handle(
+                ctx, _CReq(_RmaReq(None), 0, None, "nbc")))
+        return MPI_SUCCESS
+    if trank < 0 or trank >= entry["win"].comm.size():
+        return MPI_ERR_RANK
+    odt, tdt = _dt(ctx, odth), _dt(ctx, tdth)
+    leaf = _leaf_dt(tdt)
+    op = _rma_op_of(ctx, oph, leaf)
+    payload = _arr_in(obuf, ocount, odt)
+    entry["win"].c_acc(trank, (int(tdisp), int(tcount), tdt,
+                               leaf.np_dtype), payload, op,
+                       int(ocount) * odt.size_)
+    if with_req:
+        _write_i32(a[9], _new_req_handle(
+            ctx, _CReq(_RmaReq(None), 0, None, "nbc")))
+    return MPI_SUCCESS
+
+
+def _h_rma_gacc(ctx, a, with_req=False):
+    (obuf, ocount, odth, rbuf, rcount, rdth, trank, tdisp, tcount, tdth,
+     oph, wh) = a[:12]
+    entry = _win_entry(ctx, wh)
+    if entry is None:
+        return MPI_ERR_WIN
+    trank = int(trank)
+    if trank == C_PROC_NULL or int(tcount) == 0:
+        if with_req:
+            _write_i32(a[12], _new_req_handle(
+                ctx, _CReq(_RmaReq(None), 0, None, "nbc")))
+        return MPI_SUCCESS
+    if trank < 0 or trank >= entry["win"].comm.size():
+        return MPI_ERR_RANK
+    rdt, tdt = _dt(ctx, rdth), _dt(ctx, tdth)
+    leaf = _leaf_dt(tdt)
+    op = _rma_op_of(ctx, oph, leaf)
+    if op is None or int(ocount) == 0:        # MPI_NO_OP: atomic read
+        payload = np.zeros(0, np.uint8)
+        nbytes = 0
+    else:
+        odt = _dt(ctx, odth)
+        payload = _arr_in(obuf, ocount, odt)
+        nbytes = int(ocount) * odt.size_
+    args = (int(tdisp), int(tcount), tdt, leaf.np_dtype)
+    if with_req:
+        comm = entry["win"].c_gacc_async(trank, args, payload, op, nbytes)
+        creq = _CReq(_RmaReq(comm), 0, None, "nbc",
+                     post=_scatter_closure(int(rbuf), rdt))
+        _write_i32(a[12], _new_req_handle(ctx, creq))
+        return MPI_SUCCESS
+    old = entry["win"].c_gacc(trank, args, payload, op, nbytes)
+    _arr_out(int(rbuf), old, dt=rdt)
+    return MPI_SUCCESS
+
+
+def _h_fetch_and_op(ctx, a):
+    obuf, rbuf, dth, trank, tdisp, oph, wh = a[:7]
+    entry = _win_entry(ctx, wh)
+    if entry is None:
+        return MPI_ERR_WIN
+    trank = int(trank)
+    if trank == C_PROC_NULL:
+        return MPI_SUCCESS
+    if trank < 0 or trank >= entry["win"].comm.size():
+        return MPI_ERR_RANK
+    dt = _dt(ctx, dth)
+    op = _rma_op_of(ctx, oph, dt)
+    payload = (np.zeros(0, np.uint8) if op is None
+               else _arr_in(obuf, 1, dt))
+    old = entry["win"].c_gacc(trank, (int(tdisp), 1, dt, dt.np_dtype),
+                              payload, op, dt.size_)
+    _arr_out(int(rbuf), old, dt=dt)
+    return MPI_SUCCESS
+
+
+def _h_compare_and_swap(ctx, a):
+    obuf, cbuf, rbuf, dth, trank, tdisp, wh = a[:7]
+    entry = _win_entry(ctx, wh)
+    if entry is None:
+        return MPI_ERR_WIN
+    trank = int(trank)
+    if trank == C_PROC_NULL:
+        return MPI_SUCCESS
+    if trank < 0 or trank >= entry["win"].comm.size():
+        return MPI_ERR_RANK
+    dt = _dt(ctx, dth)
+    compare = _arr_in(cbuf, 1, dt)
+    new = _arr_in(obuf, 1, dt)
+    old = entry["win"].c_cas(trank, (int(tdisp), 1, dt), compare, new)
+    _arr_out(int(rbuf), old, dt=dt)
+    return MPI_SUCCESS
+
+
+def _h_win_lock(ctx, a):
+    lt, rank, assertion, wh = int(a[0]), int(a[1]), int(a[2]), a[3]
+    entry = _win_entry(ctx, wh)
+    if entry is None:
+        return MPI_ERR_WIN
+    if rank == C_PROC_NULL:
+        return MPI_SUCCESS
+    if rank < 0 or rank >= entry["win"].comm.size():
+        return MPI_ERR_RANK
+    try:
+        entry["win"].lock(lt, rank, assertion)
+    except RuntimeError:
+        return MPI_ERR_OTHER
+    return MPI_SUCCESS
+
+
+def _h_win_unlock(ctx, a):
+    rank, wh = int(a[0]), a[1]
+    entry = _win_entry(ctx, wh)
+    if entry is None:
+        return MPI_ERR_WIN
+    if rank == C_PROC_NULL:
+        return MPI_SUCCESS
+    if rank < 0 or rank >= entry["win"].comm.size():
+        return MPI_ERR_RANK
+    entry["win"].unlock(rank)
+    return MPI_SUCCESS
+
+
+def _h_win_lock_all(ctx, a):
+    entry = _win_entry(ctx, a[1])
+    if entry is None:
+        return MPI_ERR_WIN
+    entry["win"].lock_all(int(a[0]))
+    return MPI_SUCCESS
+
+
+def _h_win_unlock_all(ctx, a):
+    entry = _win_entry(ctx, a[0])
+    if entry is None:
+        return MPI_ERR_WIN
+    entry["win"].unlock_all()
+    return MPI_SUCCESS
+
+
+def _h_win_flush(ctx, a, local=False):
+    rank, wh = int(a[0]), a[1]
+    entry = _win_entry(ctx, wh)
+    if entry is None:
+        return MPI_ERR_WIN
+    if rank == C_PROC_NULL:
+        return MPI_SUCCESS
+    if not local:
+        entry["win"].flush(rank)
+    return MPI_SUCCESS
+
+
+def _h_win_flush_all(ctx, a, local=False):
+    entry = _win_entry(ctx, a[0])
+    if entry is None:
+        return MPI_ERR_WIN
+    if not local:
+        entry["win"].flush_all()
+    return MPI_SUCCESS
+
+
+def _h_win_sync(ctx, a):
+    entry = _win_entry(ctx, a[0])
+    if entry is None:
+        return MPI_ERR_WIN
+    entry["win"].sync()
+    return MPI_SUCCESS
+
+
+def _win_group_ranks(entry, group: Group):
+    cg = entry["win"].comm.get_group()
+    return [cg.rank(w) for w in group.world_ranks]
+
+
+def _h_win_start(ctx, a):
+    gh, assertion, wh = a[0], int(a[1]), a[2]
+    entry = _win_entry(ctx, wh)
+    group = ctx.groups.get(int(gh))
+    if entry is None or group is None:
+        return MPI_ERR_WIN
+    entry["win"].start(_win_group_ranks(entry, group), assertion)
+    return MPI_SUCCESS
+
+
+def _h_win_complete(ctx, a):
+    entry = _win_entry(ctx, a[0])
+    if entry is None:
+        return MPI_ERR_WIN
+    entry["win"].complete()
+    return MPI_SUCCESS
+
+
+def _h_win_post(ctx, a):
+    gh, assertion, wh = a[0], int(a[1]), a[2]
+    entry = _win_entry(ctx, wh)
+    group = ctx.groups.get(int(gh))
+    if entry is None or group is None:
+        return MPI_ERR_WIN
+    entry["win"].post(_win_group_ranks(entry, group), assertion)
+    return MPI_SUCCESS
+
+
+def _h_win_wait(ctx, a):
+    entry = _win_entry(ctx, a[0])
+    if entry is None:
+        return MPI_ERR_WIN
+    entry["win"].wait()
+    return MPI_SUCCESS
+
+
+def _h_win_test(ctx, a):
+    entry = _win_entry(ctx, a[0])
+    if entry is None:
+        return MPI_ERR_WIN
+    _write_i32(a[1], 1 if entry["win"].test() else 0)
     return MPI_SUCCESS
 
 
@@ -2441,7 +2984,9 @@ def _h_type_resized(ctx, a):
 def _h_type_get_name(ctx, a):
     dt = _dt(ctx, a[0])
     if int(a[3]):                # set mode
-        raw = ctypes.string_at(int(a[1]), 128).split(b"\0")[0]
+        # NUL-terminated read (a fixed-width read could walk past the
+        # end of a short caller buffer), truncated per MPI to 127 chars
+        raw = ctypes.string_at(int(a[1]))[:127]
         dt.name = raw.decode(errors="replace")
         return MPI_SUCCESS
     name = (dt.name or "").encode()[:127]
@@ -3541,10 +4086,18 @@ def _h_pack_external(ctx, a):
     per = sum(basics)
     # packed elements may carry trailing ABI padding (the pair types
     # ship their padded C struct: size_ 16 vs MPI size 12 for
-    # MPI_DOUBLE_INT): swap the basic elements, pass padding through
-    pad = dt.size_ - per \
-        if per and int(getattr(dt, "c_mpi_size", dt.size_)) != dt.size_ \
-        else 0
+    # MPI_DOUBLE_INT): swap the basic elements, pass padding through.
+    # Derived types built FROM a padded pair type inherit c_basics but
+    # not c_mpi_size — recover the per-element pad from the structured
+    # np dtype's itemsize (the element stride in the packed stream).
+    pad = 0
+    if per:
+        if int(getattr(dt, "c_mpi_size", dt.size_)) != dt.size_:
+            pad = dt.size_ - per
+        elif dt.np_dtype is not None:
+            isz = np.dtype(dt.np_dtype).itemsize
+            if isz > per and dt.size_ % isz == 0:
+                pad = isz - per
 
     def swap(data):
         out = bytearray(data)
@@ -3645,6 +4198,27 @@ _HANDLERS = {
     150: _h_get_elements, 151: _h_type_lbub, 152: _h_type_darray,
     153: _h_pack_external, 154: _h_type_match_size, 155: _h_topo_map,
     156: _h_dist_graph_create, 157: _h_dist_graph_neighbors,
+    # one-sided (MPI-3 RMA)
+    158: _h_rma_put, 159: _h_rma_get, 160: _h_rma_acc, 161: _h_rma_gacc,
+    162: _h_fetch_and_op, 163: _h_compare_and_swap,
+    164: lambda c, a: _h_rma_put(c, a, with_req=True),
+    165: lambda c, a: _h_rma_get(c, a, with_req=True),
+    166: lambda c, a: _h_rma_acc(c, a, with_req=True),
+    167: lambda c, a: _h_rma_gacc(c, a, with_req=True),
+    168: _h_win_allocate,
+    169: lambda c, a: _h_win_allocate(c, a, shared=True),
+    170: _h_win_create_dynamic, 171: _h_win_attach, 172: _h_win_detach,
+    173: _h_win_shared_query, 174: _h_win_lock, 175: _h_win_unlock,
+    176: _h_win_lock_all, 177: _h_win_unlock_all, 178: _h_win_flush,
+    179: lambda c, a: _h_win_flush(c, a, local=True),
+    180: _h_win_flush_all,
+    181: lambda c, a: _h_win_flush_all(c, a, local=True),
+    182: _h_win_sync, 183: _h_win_start, 184: _h_win_complete,
+    185: _h_win_post, 186: _h_win_wait, 187: _h_win_test,
+    188: _h_win_get_group, 189: _h_win_set_name, 190: _h_win_get_name,
+    191: _h_win_keyval_create, 192: _h_win_keyval_free,
+    193: _h_win_delete_attr, 194: _h_win_set_errhandler,
+    195: _h_win_get_errhandler, 196: _h_win_call_errhandler,
 }
 
 #: ops that are pure local queries — no bench end/begin cycle needed
@@ -3653,7 +4227,9 @@ _HANDLERS = {
 _LOCAL_OPS = {3, 4, 24, 41, 42, 45, 46, 48, 50, 51, 63, 64, 66, 69,
               70, 72, 73, 74, 75, 76, 77, 78, 79, 83, 84, 85, 94, 96,
               97, 98, 99, 101, 102, 103, 129, 130, 131, 132, 133,
-              134, 135, 136, 137, 139, 140, 141, 142}
+              134, 135, 136, 137, 139, 140, 141, 142,
+              171, 172, 173, 188, 189, 190, 191, 192, 193, 194, 195,
+              196}
 
 
 def _dispatch_py(opcode: int, args) -> int:

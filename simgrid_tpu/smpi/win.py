@@ -1,137 +1,615 @@
-"""One-sided communication: MPI_Win put/get/accumulate + fence
-(reference src/smpi/mpi/smpi_win.cpp).
+"""One-sided communication: the MPI-3 RMA window.
 
-The reference issues both sides of each RMA transfer itself (it owns
-every rank's request queues, smpi_win.cpp Win::put posts the send *and*
-the matching receive). Here passive progress is modeled explicitly: Win
+Role of reference src/smpi/mpi/smpi_win.cpp (752 LoC: fence, PSCW
+epochs, passive-target lock/unlock/lock_all, the flush family, and the
+atomic ops) — redesigned for this framework's actor kernel:
+
+The reference issues both sides of each RMA transfer itself (Win::put
+posts the send *and* the matching receive, since it owns every rank's
+request queues).  Here passive progress is modeled explicitly: window
 creation spawns one daemon actor per rank on the window's host that
-serves its mailbox — so an RMA transfer is a real simulated message
-riding the origin->target route, applied by the target-side daemon
-without the target rank's participation. fence() follows the
-reference's semantics: it completes all outstanding accesses (an
-alltoall of op counts tells each daemon how much traffic to expect,
-the daemon signals local completion, then a barrier closes the epoch).
+serves its mailbox — an RMA transfer is a real simulated message riding
+the origin->target route, applied to the target's memory by the
+target-side daemon without the target rank's participation.  Because
+the daemon applies each message in one uninterrupted step, accumulate
+atomicity (MPI-3 §11.7.1) holds by construction, and per-origin
+ordering (rar/war/raw/waw) follows from mailbox FIFO.
+
+Synchronization is counter-based: every origin keeps a monotonic count
+of data ops sent to each target; every daemon keeps a monotonic count
+of ops applied from each origin.  An epoch-closing call tells the
+target how many ops to expect (fence: via alltoall; complete: in the
+epoch-closing token; flush: in the flush request) and the daemon
+answers when its applied counter catches up.  This replaces the
+reference's finish_comms() request-reaping (smpi_win.cpp:450-520).
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from .op import Op
 
-_win_seq = 0
+# lock types (mirror include/smpi/mpi.h)
+LOCK_EXCLUSIVE = 234
+LOCK_SHARED = 235
+
+# assertions (any combination may be passed; they are hints)
+MODE_NOCHECK = 1024
+MODE_NOSTORE = 2048
+MODE_NOPUT = 4096
+MODE_NOPRECEDE = 8192
+MODE_NOSUCCEED = 16384
+
+FLAVOR_CREATE = 1
+FLAVOR_ALLOCATE = 2
+FLAVOR_DYNAMIC = 3
+FLAVOR_SHARED = 4
+
+_CTRL_BYTES = 8          # simulated size of a control token
+
+
+class SlotMemory:
+    """Python-API windows: the rank's window is any indexable object;
+    displacements are slot keys and payloads arbitrary objects."""
+
+    def __init__(self, obj):
+        self.obj = obj
+
+    def put(self, slot, payload) -> None:
+        try:
+            self.obj[slot] = payload
+        except TypeError:
+            setattr(self.obj, slot, payload)
+
+    def get(self, slot):
+        return self.obj[slot] if slot is not None else self.obj
+
+    def acc(self, slot, payload, op: Op):
+        self.obj[slot] = op(self.obj[slot], payload)
+
+    def gacc(self, slot, payload, op: Op):
+        old = self.obj[slot]
+        if op is not None:                    # None = MPI_NO_OP
+            self.obj[slot] = op(old, payload)
+        return old
+
+    def cas(self, slot, compare, new):
+        old = self.obj[slot]
+        if old == compare:
+            self.obj[slot] = new
+        return old
+
+
+class CMemory:
+    """C-API windows: the window is the caller's raw memory.  All ranks
+    live in one address space (per-rank .so copies), so the daemon
+    reads/writes the target buffer with ctypes through the datatype
+    type map.  ``disp`` is scaled by the TARGET's disp_unit here —
+    exactly MPI's addressing rule; dynamic windows use absolute
+    addresses (disp_unit 1, base 0)."""
+
+    def __init__(self, base: int, disp_unit: int = 1, size: int = 0):
+        self.base = int(base)
+        self.disp_unit = int(disp_unit)
+        self.size = int(size)
+
+    def _addr(self, disp: int) -> int:
+        return self.base + int(disp) * self.disp_unit
+
+    @staticmethod
+    def _elems(arr, leaf_np):
+        """View a packed payload as its LEAF element type (derived
+        C-API types travel as packed uint8; accumulate math needs the
+        basic elements — MPI requires a uniform predefined leaf)."""
+        import numpy as np
+        if (leaf_np is None or arr is None
+                or arr.dtype == np.dtype(leaf_np)):
+            return arr
+        itemsize = np.dtype(leaf_np).itemsize
+        if itemsize and arr.nbytes % itemsize == 0:
+            return np.frombuffer(arr.tobytes(), dtype=leaf_np)
+        return arr
+
+    # payloads are packed numpy arrays; dt a c_api Datatype describing
+    # the TARGET-side layout (count elements scattered via its typemap);
+    # leaf_np the basic element dtype for op application
+    def put(self, args, payload) -> None:
+        from .c_api import _arr_out
+        disp, count, dt = args[:3]
+        _arr_out(self._addr(disp), payload, dt=dt)
+
+    def get(self, args):
+        from .c_api import _arr_in
+        disp, count, dt = args[:3]
+        return _arr_in(self._addr(disp), count, dt)
+
+    def acc(self, args, payload, op: Optional[Op]) -> None:
+        from .c_api import _arr_in, _arr_out
+        disp, count, dt = args[:3]
+        leaf_np = args[3] if len(args) > 3 else None
+        if op == "replace":
+            _arr_out(self._addr(disp), payload, dt=dt)
+            return
+        cur = self._elems(_arr_in(self._addr(disp), count, dt), leaf_np)
+        payload = self._elems(payload, leaf_np)
+        n = min(len(cur), len(payload))
+        out = op(cur[:n], payload[:n])
+        _arr_out(self._addr(disp), out, dt=dt)
+
+    def gacc(self, args, payload, op: Optional[Op]):
+        from .c_api import _arr_in
+        disp, count, dt = args[:3]
+        old = _arr_in(self._addr(disp), count, dt).copy()
+        if op is not None:
+            self.acc(args, payload, op)
+        return old
+
+    def cas(self, args, compare, new):
+        from .c_api import _arr_in, _arr_out
+        disp, count, dt = args[:3]
+        old = _arr_in(self._addr(disp), 1, dt).copy()
+        if old.tobytes() == compare.tobytes():
+            _arr_out(self._addr(disp), new, dt=dt)
+        return old
 
 
 class Win:
-    """Collective window object: every rank constructs it with its
-    local data object (an np.ndarray or dict-like)."""
+    """Collective window: every rank of ``comm`` constructs one.
 
-    def __init__(self, comm, local_data, size_bytes: Optional[int] = None):
-        global _win_seq
+    Python surface (slot mode): ``Win(comm, local_data)`` then
+    put/get/accumulate with slot keys — matches the legacy API.
+    C surface: ``Win(comm, memory=CMemory(base, unit))`` driven by
+    smpi/c_api.py with datatype-mapped addressing.
+    """
+
+    def __init__(self, comm, local_data=None, size_bytes: Optional[int] = None,
+                 memory=None, flavor: int = FLAVOR_CREATE,
+                 name: Optional[str] = None):
         from ..s4u import Actor, Mailbox, Semaphore
         from . import runtime
 
         self.comm = comm
+        self.flavor = flavor
+        self.name = name or ""
+        self.mem = memory if memory is not None else SlotMemory(local_data)
         self.local_data = local_data
         rank = comm.rank()
+        self.rank = rank
+        n = comm.size()
         # Deterministic collective id without communication: window
         # creation is collective and ordered, so every rank's per-comm
         # creation sequence agrees (same rule as communicator ids).
         self.win_id = str(comm._next_cc_id("win"))
         self._mbox = Mailbox.by_name(f"__win{self.win_id}-{rank}")
-        self._pending_counts = [0] * comm.size()   # ops sent per target
-        self._sends: List = []
-        self._consumed = 0          # ops my daemon applied this epoch
-        self._expected: Optional[int] = None
-        self._epoch_sem = Semaphore(0)
+        self._pscw_mbox = Mailbox.by_name(f"__win{self.win_id}-pscw-{rank}")
+
+        # -- origin-side state --
+        self._sent_total = [0] * n          # data ops sent per target
+        self._fast_bytes = [0] * n          # coalesced fast-op traffic
+        self._reply_seq = 0
+        self._lock_held: Dict[int, int] = {}    # target -> lock type
+        self._pscw_targets: Optional[List[int]] = None  # access epoch
+        self._post_stash: Dict[int, int] = {}   # unconsumed post tokens
+
+        # -- daemon-side (exposure) state --
+        self._applied_from: Dict[int, int] = {}
+        self._lock_holders: Dict[int, int] = {}  # origin -> type
+        self._lock_queue: List[Tuple[int, int, str]] = []
+        self._pending_flushes: List[Tuple[int, int, str]] = []
+        self._complete_tokens: Dict[int, List[int]] = {}
+        self._pscw_exposed: Optional[List[int]] = None
+        self._trigger = None                # (pred, Semaphore) of main
+        self._free_pending = False
 
         me = runtime.this_rank_state()
-        win = self
-
-        def daemon():
-            while True:
-                msg = win._mbox.get()
-                if msg == "__win_free__":
-                    break
-                kind, payload = msg
-                if kind == "put":
-                    slot, data = payload
-                    win._apply_put(slot, data)
-                elif kind == "acc":
-                    slot, data, op = payload
-                    win._apply_acc(slot, data, op)
-                elif kind == "get":
-                    reply_to, slot, nbytes = payload
-                    data = win._read(slot)
-                    Mailbox.by_name(reply_to).put(data, nbytes)
-                win._consumed += 1
-                if win._expected is not None and \
-                        win._consumed >= win._expected:
-                    win._epoch_sem.release()
-
         self._daemon = Actor.create(f"__win{self.win_id}_rma_{rank}",
-                                    me.host, daemon)
+                                    me.host, self._serve)
         self._daemon.daemonize()
+        self._Semaphore = Semaphore
+        self._Mailbox = Mailbox
+        # Peer registry scoped to the engine object: every rank's Win
+        # is reachable in-process, enabling the fast-atomics path.
+        from ..s4u import Engine
+        eng = Engine.get_instance().pimpl
+        if not hasattr(eng, "_win_registry"):
+            eng._win_registry = {}
+        self._registry = eng._win_registry
+        self._registry[(self.win_id, rank)] = self
         comm.barrier()
 
-    # -- local window application -----------------------------------------
-    def _apply_put(self, slot, data) -> None:
+    def _peer(self, rank: int) -> Optional["Win"]:
+        return self._registry.get((self.win_id, rank))
+
+    def _fast_ready(self, target: int) -> Optional["Win"]:
+        """The immediate-linearization condition: every op I have
+        issued to ``target`` has been applied there, so an atomic read
+        linearized NOW preserves my program order (cross-origin order
+        is unconstrained between synchronizations).  Sound because the
+        cooperative kernel makes the whole apply one atomic step, and
+        immediate visibility is legal under MPI_WIN_UNIFIED."""
+        from ..utils.config import config
+        if not config["smpi/rma-fast-atomics"]:
+            return None
+        peer = self._peer(target)
+        if peer is None:
+            return None
+        if peer._applied_from.get(self.rank, 0) < self._sent_total[target]:
+            return None
+        return peer
+
+    # ------------------------------------------------------------------
+    # daemon (exposure side)
+    # ------------------------------------------------------------------
+    def _serve(self) -> None:
+        from ..exceptions import SimgridException
         try:
-            self.local_data[slot] = data
-        except TypeError:
-            setattr(self.local_data, slot, data)
+            self._serve_loop()
+        except SimgridException:
+            # engine teardown (daemonized actors are killed with their
+            # pending receives): exit quietly
+            return
 
-    def _apply_acc(self, slot, data, op: Op) -> None:
-        self.local_data[slot] = op(self.local_data[slot], data)
+    def _serve_loop(self) -> None:
+        while True:
+            msg = self._mbox.get()
+            kind = msg[0]
+            if kind == "free":
+                break
+            if kind in ("put", "acc", "get", "gacc", "cas",
+                        "sput", "sacc", "sget", "tick"):
+                self._apply_op(msg)
+            elif kind == "lock":
+                _, origin, lt, reply = msg
+                self._lock_queue.append((origin, lt, reply))
+                self._grant_locks()
+            elif kind == "unlock":
+                _, origin = msg
+                self._lock_holders.pop(origin, None)
+                self._grant_locks()
+            elif kind == "flush":
+                _, origin, upto, reply = msg
+                if self._applied_from.get(origin, 0) >= upto:
+                    self._reply(reply, True)
+                else:
+                    self._pending_flushes.append((origin, upto, reply))
+            elif kind == "complete":
+                _, origin, total = msg
+                self._complete_tokens.setdefault(origin, []).append(total)
+            self._poke()
 
-    def _read(self, slot):
-        return self.local_data[slot] if slot is not None else \
-            self.local_data
+    def _apply_op(self, msg) -> None:
+        kind, origin = msg[0], msg[1]
+        if kind == "put":
+            self.mem.put(msg[2], msg[3])
+        elif kind == "acc":
+            self.mem.acc(msg[2], msg[3], msg[4])
+        elif kind == "get":
+            _, _, reply, args = msg
+            self._reply(reply, self.mem.get(args),
+                        nbytes=_payload_bytes(args))
+        elif kind == "gacc":
+            _, _, reply, args, payload, op = msg
+            old = self.mem.gacc(args, payload, op)
+            self._reply(reply, old, nbytes=_payload_bytes(args))
+        elif kind == "cas":
+            _, _, reply, args, compare, new = msg
+            self._reply(reply, self.mem.cas(args, compare, new))
+        elif kind == "sput":
+            self.mem.put(msg[2], msg[3])
+        elif kind == "sacc":
+            self.mem.acc(msg[2], msg[3], msg[4])
+        elif kind == "sget":
+            _, _, reply, slot, nbytes = msg
+            self._reply(reply, self.mem.get(slot), nbytes=nbytes)
+        # "tick": coalesced timing traffic of fast ops already applied
+        # at the origin — counts toward the epoch, moves no memory
+        self._applied_from[origin] = self._applied_from.get(origin, 0) + 1
+        if self._pending_flushes:
+            done = self._applied_from
+            keep = []
+            for origin, upto, reply in self._pending_flushes:
+                if done.get(origin, 0) >= upto:
+                    self._reply(reply, True)
+                else:
+                    keep.append((origin, upto, reply))
+            self._pending_flushes = keep
 
-    # -- RMA calls (smpi_win.cpp put/get/accumulate) ----------------------
+    def _reply(self, mbox_name: str, payload, nbytes: int = _CTRL_BYTES):
+        """Detached reply: the daemon must never block on a consumer
+        (a blocked daemon would deadlock flush-before-request-reap
+        patterns like Rget;unlock;wait)."""
+        self._Mailbox.by_name(mbox_name).put_async(
+            (payload,), max(nbytes, 1))
+
+    def _grant_locks(self) -> None:
+        """FIFO lock admission: grant the queue head while compatible
+        (an exclusive needs an empty table; shareds coalesce)."""
+        while self._lock_queue:
+            origin, lt, reply = self._lock_queue[0]
+            if lt == LOCK_EXCLUSIVE:
+                if self._lock_holders:
+                    return
+            else:
+                if any(t == LOCK_EXCLUSIVE
+                       for t in self._lock_holders.values()):
+                    return
+            self._lock_queue.pop(0)
+            self._lock_holders[origin] = lt
+            self._reply(reply, True)
+
+    def _poke(self) -> None:
+        """Wake the main actor if its wait predicate now holds."""
+        if self._trigger is not None:
+            pred, sem = self._trigger
+            if pred():
+                self._trigger = None
+                sem.release()
+
+    # ------------------------------------------------------------------
+    # origin-side helpers
+    # ------------------------------------------------------------------
+    def _target_mbox(self, rank: int):
+        return self._Mailbox.by_name(f"__win{self.win_id}-{rank}")
+
+    def _new_reply(self) -> str:
+        self._reply_seq += 1
+        return f"__win{self.win_id}-r{self.rank}-{self._reply_seq}"
+
+    def _send(self, target: int, msg, nbytes: float, data_op=True) -> None:
+        self._target_mbox(target).put_async(msg, max(nbytes, 1))
+        if data_op:
+            self._sent_total[target] += 1
+
+    def _await(self, pred) -> None:
+        """Block the main actor until the daemon satisfies ``pred``."""
+        if pred():
+            return
+        sem = self._Semaphore(0)
+        self._trigger = (pred, sem)
+        sem.acquire()
+
+    def _recv_reply(self, reply: str):
+        return self._Mailbox.by_name(reply).get()[0]
+
+    def _fast(self, target: int, nbytes: int) -> Optional["Win"]:
+        """Fast-op admission + traffic coalescing: the op is applied
+        immediately by the CALLER; its bytes join one bulk timing
+        message sent at the next epoch-close (fence/flush/complete)."""
+        peer = self._fast_ready(target)
+        if peer is not None:
+            self._fast_bytes[target] += max(int(nbytes), 1)
+        return peer
+
+    def _flush_fast(self, target: int) -> None:
+        nbytes = self._fast_bytes[target]
+        if nbytes:
+            self._fast_bytes[target] = 0
+            self._send(target, ("tick", self.rank), nbytes)
+
+    # ------------------------------------------------------------------
+    # RMA operations — C mode (args = (disp, count, target_dt[, leaf]))
+    # ------------------------------------------------------------------
+    def c_put(self, target: int, args, payload, nbytes: int) -> None:
+        peer = self._fast(target, nbytes)
+        if peer is not None:
+            peer.mem.put(args, payload)
+            return
+        self._send(target, ("put", self.rank, args, payload), nbytes)
+
+    def c_get(self, target: int, args, nbytes: int):
+        peer = self._fast(target, nbytes)
+        if peer is not None:
+            return peer.mem.get(args)
+        reply = self._new_reply()
+        self._send(target, ("get", self.rank, reply, args), _CTRL_BYTES)
+        return self._recv_reply(reply)
+
+    def c_get_async(self, target: int, args, nbytes: int):
+        """Returns the reply Comm + mailbox for request-based Rget."""
+        reply = self._new_reply()
+        self._send(target, ("get", self.rank, reply, args), _CTRL_BYTES)
+        return self._Mailbox.by_name(reply).get_async()
+
+    def c_acc(self, target: int, args, payload, op, nbytes: int) -> None:
+        peer = self._fast(target, nbytes)
+        if peer is not None:
+            peer.mem.acc(args, payload, op)
+            return
+        self._send(target, ("acc", self.rank, args, payload, op), nbytes)
+
+    def c_gacc(self, target: int, args, payload, op, nbytes: int):
+        peer = self._fast(target, max(nbytes, _CTRL_BYTES))
+        if peer is not None:
+            return peer.mem.gacc(args, payload, op)
+        reply = self._new_reply()
+        self._send(target, ("gacc", self.rank, reply, args, payload, op),
+                   max(nbytes, _CTRL_BYTES))
+        return self._recv_reply(reply)
+
+    def c_gacc_async(self, target: int, args, payload, op, nbytes: int):
+        reply = self._new_reply()
+        self._send(target, ("gacc", self.rank, reply, args, payload, op),
+                   max(nbytes, _CTRL_BYTES))
+        return self._Mailbox.by_name(reply).get_async()
+
+    def c_cas(self, target: int, args, compare, new):
+        peer = self._fast(target, _CTRL_BYTES)
+        if peer is not None:
+            return peer.mem.cas(args, compare, new)
+        reply = self._new_reply()
+        self._send(target, ("cas", self.rank, reply, args, compare, new),
+                   _CTRL_BYTES)
+        return self._recv_reply(reply)
+
+    # ------------------------------------------------------------------
+    # RMA operations — slot mode (legacy Python API)
+    # ------------------------------------------------------------------
     def put(self, target_rank: int, slot, data, nbytes: int) -> None:
-        from ..s4u import Mailbox
-        mbox = Mailbox.by_name(f"__win{self.win_id}-{target_rank}")
-        self._sends.append(mbox.put_async(("put", (slot, data)), nbytes))
-        self._pending_counts[target_rank] += 1
+        peer = self._fast(target_rank, nbytes)
+        if peer is not None:
+            peer.mem.put(slot, data)
+            return
+        self._send(target_rank, ("sput", self.rank, slot, data), nbytes)
 
     def accumulate(self, target_rank: int, slot, data, nbytes: int,
                    op: Op) -> None:
-        from ..s4u import Mailbox
-        mbox = Mailbox.by_name(f"__win{self.win_id}-{target_rank}")
-        self._sends.append(
-            mbox.put_async(("acc", (slot, data, op)), nbytes))
-        self._pending_counts[target_rank] += 1
+        peer = self._fast(target_rank, nbytes)
+        if peer is not None:
+            peer.mem.acc(slot, data, op)
+            return
+        self._send(target_rank, ("sacc", self.rank, slot, data, op), nbytes)
 
     def get(self, target_rank: int, slot, nbytes: int) -> Any:
-        """Synchronous within the access epoch (the reference's get is
-        also a paired transfer): a tiny request message to the target's
-        daemon, the data rides back over the same route."""
-        from ..s4u import Mailbox
-        reply = f"__win{self.win_id}-get-{self.comm.rank()}-{target_rank}"
-        mbox = Mailbox.by_name(f"__win{self.win_id}-{target_rank}")
-        self._pending_counts[target_rank] += 1
-        mbox.put(("get", (reply, slot, nbytes)), 8)
-        return Mailbox.by_name(reply).get()
+        peer = self._fast(target_rank, nbytes)
+        if peer is not None:
+            return peer.mem.get(slot)
+        reply = self._new_reply()
+        self._send(target_rank, ("sget", self.rank, reply, slot, nbytes),
+                   _CTRL_BYTES)
+        return self._recv_reply(reply)
 
-    # -- synchronization ---------------------------------------------------
-    def fence(self) -> None:
-        """Close the access epoch (Win::fence): local sends complete,
-        every daemon has applied the traffic addressed to it, barrier."""
-        for req in self._sends:
-            req.wait()
-        self._sends.clear()
-        incoming = self.comm.alltoall(list(self._pending_counts))
-        self._pending_counts = [0] * self.comm.size()
-        expected = sum(incoming)
-        if expected > self._consumed:
-            self._expected = expected
-            self._epoch_sem.acquire()
-        self._expected = None
-        self._consumed = 0
+    # ------------------------------------------------------------------
+    # active-target synchronization
+    # ------------------------------------------------------------------
+    def fence(self, assertion: int = 0) -> None:
+        """Close the access+exposure epoch (Win::fence): every daemon
+        has applied the traffic addressed to it, then a barrier."""
+        for t in range(self.comm.size()):
+            self._flush_fast(t)
+        expected = self.comm.alltoall(list(self._sent_total))
+
+        def caught_up():
+            return all(self._applied_from.get(o, 0) >= e
+                       for o, e in enumerate(expected) if e)
+        self._await(caught_up)
         self.comm.barrier()
 
+    def start(self, targets: List[int], assertion: int = 0) -> None:
+        """Open an access epoch toward ``targets`` (comm ranks): waits
+        for each target's matching post token (out-of-order tokens from
+        other epochs are stashed, pscw_ordering-safe)."""
+        self._pscw_targets = list(targets)
+        if assertion & MODE_NOCHECK:
+            return
+        need = set(targets)
+        while need:
+            avail = [t for t in need if self._post_stash.get(t, 0) > 0]
+            if avail:
+                for t in avail:
+                    self._post_stash[t] -= 1
+                    need.discard(t)
+                continue
+            tok = self._pscw_mbox.get()
+            self._post_stash[tok[1]] = self._post_stash.get(tok[1], 0) + 1
+
+    def complete(self) -> None:
+        """Close the access epoch: each target learns how many of my
+        ops to expect; its wait() blocks until they are applied."""
+        targets, self._pscw_targets = self._pscw_targets or [], None
+        for t in targets:
+            self._flush_fast(t)
+            self._send(t, ("complete", self.rank, self._sent_total[t]),
+                       _CTRL_BYTES, data_op=False)
+
+    def post(self, origins: List[int], assertion: int = 0) -> None:
+        """Open an exposure epoch for ``origins``."""
+        self._pscw_exposed = list(origins)
+        if assertion & MODE_NOCHECK:
+            return
+        from ..s4u import Mailbox
+        for o in origins:
+            Mailbox.by_name(f"__win{self.win_id}-pscw-{o}").put_async(
+                ("post", self.rank), _CTRL_BYTES)
+
+    def _pscw_done(self) -> bool:
+        return all(self._complete_tokens.get(o) and
+                   self._applied_from.get(o, 0) >= self._complete_tokens[o][0]
+                   for o in (self._pscw_exposed or []))
+
+    def _pscw_consume(self) -> None:
+        for o in (self._pscw_exposed or []):
+            self._complete_tokens[o].pop(0)
+        self._pscw_exposed = None
+
+    def wait(self) -> None:
+        """Close the exposure epoch: every origin in the posted group
+        has completed and all its ops have landed."""
+        self._await(self._pscw_done)
+        self._pscw_consume()
+
+    def test(self) -> bool:
+        if self._pscw_done():
+            self._pscw_consume()
+            return True
+        return False
+
+    # ------------------------------------------------------------------
+    # passive-target synchronization
+    # ------------------------------------------------------------------
+    def lock(self, lock_type: int, target: int, assertion: int = 0) -> None:
+        """Acquires at call time — the MPI standard explicitly permits
+        blocking lock acquisition (MPI-3 §11.5.3); programs holding
+        exclusive locks on multiple targets in crossing order are
+        deadlock-prone under any serializing implementation."""
+        if target in self._lock_held:
+            raise RuntimeError("MPI_Win_lock: already locked")
+        self._lock_held[target] = lock_type
+        if assertion & MODE_NOCHECK:
+            return
+        reply = self._new_reply()
+        self._send(target, ("lock", self.rank, lock_type, reply),
+                   _CTRL_BYTES, data_op=False)
+        self._recv_reply(reply)
+
+    def unlock(self, target: int) -> None:
+        checked = self._lock_held.pop(target, None)
+        self.flush(target)
+        if checked is not None:
+            self._send(target, ("unlock", self.rank), _CTRL_BYTES,
+                       data_op=False)
+
+    def lock_all(self, assertion: int = 0) -> None:
+        for t in range(self.comm.size()):
+            self.lock(LOCK_SHARED, t, assertion)
+
+    def unlock_all(self) -> None:
+        for t in range(self.comm.size()):
+            self.unlock(t)
+
+    def flush(self, target: int) -> None:
+        """Remote completion of all my outstanding ops to ``target``."""
+        self._flush_fast(target)
+        if self._sent_total[target] == 0:
+            return
+        reply = self._new_reply()
+        self._send(target, ("flush", self.rank, self._sent_total[target],
+                            reply), _CTRL_BYTES, data_op=False)
+        self._recv_reply(reply)
+
+    def flush_all(self) -> None:
+        for t in range(self.comm.size()):
+            self.flush(t)
+
+    def flush_local(self, target: int) -> None:
+        """Local completion: payloads are copied at issue time, so the
+        origin buffers are already reusable — nothing to wait for."""
+
+    def flush_local_all(self) -> None:
+        pass
+
+    def sync(self) -> None:
+        """Memory barrier between window copies — a single unified
+        address space here (MPI_WIN_UNIFIED), so a no-op."""
+
+    # ------------------------------------------------------------------
     def free(self) -> None:
-        """Collective destructor: stop the daemons."""
+        """Collective destructor: drain and stop the daemons."""
         self.fence()
-        self._mbox.put("__win_free__", 1)
+        self._registry.pop((self.win_id, self.rank), None)
+        self._mbox.put_async(("free",), 1)
+
+
+def _payload_bytes(args) -> int:
+    disp, count, dt = args[:3]
+    return max(int(count) * dt.size_, 1) if dt is not None else 1

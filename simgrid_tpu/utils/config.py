@@ -128,12 +128,16 @@ class Config:
         --cfg='a:x b:y c:z')."""
         from . import log as _log
         # A payload with spaces is a multi-option list ONLY if every
-        # token looks like key:value — otherwise the whole payload is
-        # one value that happens to contain spaces.
+        # token's text before its first ':' names a DECLARED flag —
+        # otherwise the whole payload is one value that happens to
+        # contain spaces and colons (a path list, a URL).
         tokens = [opt]
         if " " in opt:
             parts = opt.split()
-            if all(":" in t for t in parts):
+            def _known(tok: str) -> bool:
+                key = tok.split(":", 1)[0].strip()
+                return key in self._flags or key in self._alias
+            if all(":" in t and _known(t) for t in parts):
                 tokens = parts
         for token in tokens:
             if ":" not in token:
@@ -250,6 +254,13 @@ declare_flag("lmm/unroll",
              "some backends lower gathers inside while_loop to serialized "
              "dynamic-slice loops; unrolled code keeps them vectorized)",
              "auto")
+declare_flag("smpi/rma-fast-atomics",
+             "Linearize RMA atomic reads (get/fetch_op/get_accumulate/"
+             "cas) immediately at the origin when all its outstanding "
+             "ops to the target have been applied — sound under the "
+             "MPI_WIN_UNIFIED memory model and the kernel's atomic "
+             "scheduling rounds, and removes the simulated round trip "
+             "(set false for strict arrival-time application)", True)
 declare_flag("contexts/stack-size", "Actor stack size (bytes)", 131072)
 declare_flag("contexts/factory", "Actor context factory (thread)", "thread")
 declare_flag("tracing", "Enable tracing", False)

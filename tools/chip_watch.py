@@ -1,0 +1,80 @@
+#!/usr/bin/env python3
+"""TPU chip-watch: probe the axon chip on a timer, log liveness transitions.
+
+The chip behind the axon tunnel can be wedged for hours (it recovers after
+idle time).  This watcher runs ``jax.devices()`` in a throwaway subprocess
+with a hard timeout, appending one JSON line per probe to
+``bench_results/chip_watch.jsonl``.  The moment the chip answers, the
+prepared one-experiment-per-process scripts (tools/tpu_experiments.py) should
+be run and their numbers committed.
+
+Usage:  python tools/chip_watch.py [--interval 300] [--once]
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(ROOT, "bench_results", "chip_watch.jsonl")
+
+PROBE_SRC = r"""
+import json, time
+t0 = time.time()
+import jax
+devs = jax.devices()
+kind = devs[0].device_kind if devs else "none"
+plat = devs[0].platform if devs else "none"
+# A tiny real dispatch proves the chip executes, not just enumerates.
+import jax.numpy as jnp
+x = jnp.ones((128, 128), dtype=jnp.bfloat16)
+y = (x @ x).block_until_ready()
+print(json.dumps({"platform": plat, "kind": kind, "n": len(devs),
+                  "probe_s": round(time.time() - t0, 2)}))
+"""
+
+
+def probe(timeout: float = 120.0) -> dict:
+    t0 = time.time()
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=ROOT, env={**os.environ},
+        )
+        if out.returncode == 0 and out.stdout.strip():
+            info = json.loads(out.stdout.strip().splitlines()[-1])
+            info["alive"] = info.get("platform") not in (None, "none", "cpu")
+            return info
+        return {"alive": False, "error": (out.stderr or "")[-300:],
+                "wall_s": round(time.time() - t0, 2)}
+    except subprocess.TimeoutExpired:
+        return {"alive": False, "error": f"timeout after {timeout:.0f}s",
+                "wall_s": round(time.time() - t0, 2)}
+    except Exception as exc:  # noqa: BLE001
+        return {"alive": False, "error": repr(exc)[:300],
+                "wall_s": round(time.time() - t0, 2)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=300.0)
+    ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument("--once", action="store_true")
+    args = ap.parse_args()
+    os.makedirs(os.path.dirname(LOG), exist_ok=True)
+    while True:
+        rec = probe(args.timeout)
+        rec["ts"] = round(time.time(), 1)
+        with open(LOG, "a") as fh:
+            fh.write(json.dumps(rec) + "\n")
+        print(json.dumps(rec), flush=True)
+        if args.once or rec.get("alive"):
+            return 0 if rec.get("alive") else 1
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,48 +1,113 @@
-"""Sweep mpich3-test/coll: compile+run each test in a subprocess."""
-import glob, os, re as _re, subprocess, sys, json
+"""Sweep a dir of the vendored MPICH3 test suite: compile + run each
+test in its own subprocess, in parallel workers.
+
+Usage: python tools/mpich3_sweep.py [dir] [--jobs N] [--timeout S]
+       [--only name1,name2] [--out results.json]
+
+Results stream to stderr as they land and the JSON summary is written
+incrementally, so a partial sweep is still a committed artifact.
+"""
+import argparse
+import glob
+import json
+import os
+import re as _re
+import subprocess
+import sys
+import threading
 
 M = "/root/reference/teshsuite/smpi/mpich3-test"
-DIR = sys.argv[1] if len(sys.argv) > 1 else "coll"
-OUT = {}
-os.makedirs("/tmp/mpich3", exist_ok=True)
-NP = {}
-for line in open(f"{M}/{DIR}/testlist"):
-    parts = line.split()
-    if len(parts) >= 2 and parts[1].isdigit():
-        NP.setdefault(parts[0], int(parts[1]))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-for src in sorted(glob.glob(f"{M}/{DIR}/*.c")):
-    name = os.path.basename(src)[:-2]
-    np_ranks = NP.get(name, 4)
-    code = f"""
-import sys; sys.path.insert(0, "/root/repo")
+# a few tests are output-only and never print the mtest "No Errors"
+# banner; for those alone a clean exit with no error markers passes
+OUTPUT_ONLY = {"zero-blklen-vector", "zeroblks"}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("dir", nargs="?", default="coll")
+    ap.add_argument("--jobs", type=int, default=6)
+    ap.add_argument("--timeout", type=float, default=330.0)
+    ap.add_argument("--only", default="")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+    d = args.dir
+    out_path = args.out or f"/tmp/mpich3_{d}_results.json"
+    os.makedirs("/tmp/mpich3", exist_ok=True)
+
+    np_of = {}
+    try:
+        for line in open(f"{M}/{d}/testlist"):
+            parts = line.split()
+            if len(parts) >= 2 and parts[1].isdigit():
+                np_of.setdefault(parts[0], int(parts[1]))
+    except FileNotFoundError:
+        pass
+
+    srcs = sorted(glob.glob(f"{M}/{d}/*.c"))
+    if args.only:
+        keep = set(args.only.split(","))
+        srcs = [s for s in srcs if os.path.basename(s)[:-2] in keep]
+    results = {}
+    lock = threading.Lock()
+
+    def run_test(src: str) -> None:
+        name = os.path.basename(src)[:-2]
+        np_ranks = np_of.get(name, 4)
+        code = f"""
+import sys; sys.path.insert(0, {REPO!r})
 from simgrid_tpu.smpi.c_api import compile_program, run_c_program
-compile_program(["{src}", "{M}/util/mtest.c", "{M}/util/mtest_datatype.c", "{M}/util/mtest_datatype_gen.c"], "/tmp/mpich3/{DIR}-{name}.so",
-                extra_flags=["-I{M}/include"])
-engine, codes = run_c_program("/tmp/mpich3/{DIR}-{name}.so", np_ranks={np_ranks},
-    configs=("smpi/simulate-computation:false",))
+compile_program([{src!r}, "{M}/util/mtest.c", "{M}/util/mtest_datatype.c",
+                 "{M}/util/mtest_datatype_gen.c"],
+                "/tmp/mpich3/{d}-{name}.so", extra_flags=["-I{M}/include"])
+engine, codes = run_c_program("/tmp/mpich3/{d}-{name}.so",
+    np_ranks={np_ranks}, configs=("smpi/simulate-computation:false",))
 assert all(c == 0 for c in codes.values()), codes
 """
-    try:
-        r = subprocess.run([sys.executable, "-c", code],
-                           capture_output=True, text=True, timeout=330)
-    except subprocess.TimeoutExpired:
-        OUT[name] = "timeout"
-        print(f"{name:28s} timeout", flush=True)
-        continue
-    out_l = r.stdout.lower()
-    # a few tests are output-only and never print the mtest "No Errors"
-    # banner; for those alone a clean exit with no error markers passes
-    OUTPUT_ONLY = {"zero-blklen-vector", "zeroblks"}
-    ok = r.returncode == 0 and (
-        "no errors" in out_l
-        or (name in OUTPUT_ONLY
-            and not _re.search(r"\berrors?\b|\bfail|abort|deadlock",
-                               out_l)))
-    OUT[name] = "PASS" if ok else (
-        "compile-fail" if "smpicc failed" in r.stderr else "fail")
-    print(f"{name:28s} {OUT[name]} (np={np_ranks})", flush=True)
+        try:
+            r = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True,
+                               timeout=args.timeout)
+        except subprocess.TimeoutExpired:
+            verdict = "timeout"
+        else:
+            out_l = r.stdout.lower()
+            ok = r.returncode == 0 and (
+                "no errors" in out_l
+                or (name in OUTPUT_ONLY
+                    and not _re.search(r"\berrors?\b|\bfail|abort|deadlock",
+                                       out_l)))
+            verdict = "PASS" if ok else (
+                "compile-fail" if "smpicc failed" in r.stderr else "fail")
+        with lock:
+            results[name] = verdict
+            n_done = len(results)
+            print(f"[{n_done}/{len(srcs)}] {name:32s} {verdict} "
+                  f"(np={np_ranks})", file=sys.stderr, flush=True)
+            json.dump(results, open(out_path, "w"), indent=1, sort_keys=True)
 
-n = sum(1 for v in OUT.values() if v == "PASS")
-print(f"\nPASS {n}/{len(OUT)}")
-json.dump(OUT, open(f"/tmp/mpich3_{DIR}_results.json", "w"), indent=1)
+    todo = list(srcs)
+
+    def worker():
+        while True:
+            with lock:
+                if not todo:
+                    return
+                src = todo.pop(0)
+            run_test(src)
+
+    threads = [threading.Thread(target=worker) for _ in range(args.jobs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    n = sum(1 for v in results.values() if v == "PASS")
+    print(f"\nPASS {n}/{len(results)}", flush=True)
+    json.dump(results, open(out_path, "w"), indent=1, sort_keys=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,137 @@
+#!/usr/bin/env python3
+"""Prepared TPU experiment matrix: run the moment the chip answers.
+
+One experiment per subprocess (a wedged chip costs one experiment, and
+killing a process mid-compile wedges the chip — so each child gets a
+timeout ABOVE worst-case compile time and is never killed early unless
+it exceeds it).  Matrix: layout {ell, coo} x unroll {on, off} x class
+{2k, 20k, 100k}, 3 reps each, preceded by a warm-up solve in the same
+process to populate the persistent compile cache.
+
+Each result is appended to bench_results/tpu_experiments.jsonl
+immediately, so partial sweeps survive.
+
+Usage:
+  python tools/tpu_experiments.py            # full matrix (probe first)
+  python tools/tpu_experiments.py --one ell:on:2000   # single experiment
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(ROOT, "bench_results", "tpu_experiments.jsonl")
+
+CLASSES = {
+    2000: dict(n_c=2000, n_v=2000, deg=3, seed=1),
+    20000: dict(n_c=20000, n_v=20000, deg=3, seed=2),
+    100000: dict(n_c=16384, n_v=100_000, deg=4, seed=42),
+}
+
+CHILD_SRC = r"""
+import json, sys, time
+import numpy as np
+sys.path.insert(0, {root!r})
+from simgrid_tpu.utils.config import config
+config["lmm/layout"] = {layout!r}
+config["lmm/unroll"] = {unroll!r}
+import jax
+dev = jax.devices()[0]
+sys.path.insert(0, {root!r})
+from bench import build_arrays
+from simgrid_tpu.ops.lmm_jax import solve_arrays
+on_tpu = dev.platform != "cpu"
+dtype = np.float32 if on_tpu else np.float64
+eps = 1e-5 if on_tpu else 1e-9
+arrays = build_arrays(np.random.default_rng({seed}), {n_c}, {n_v}, {deg},
+                      dtype)
+t0 = time.time()
+_, _, _, rounds = solve_arrays(arrays, eps, parallel_rounds=True)
+compile_s = time.time() - t0
+times = []
+for _ in range(3):
+    t0 = time.perf_counter()
+    solve_arrays(arrays, eps, parallel_rounds=True)
+    times.append(time.perf_counter() - t0)
+print(json.dumps({{"platform": dev.platform,
+                   "ms": round(float(np.median(times)) * 1e3, 2),
+                   "first_s": round(compile_s, 2),
+                   "rounds": int(rounds)}}))
+"""
+
+
+def run_one(layout: str, unroll: str, cls: int, timeout: float) -> dict:
+    p = CLASSES[cls]
+    src = CHILD_SRC.format(root=ROOT, layout=layout, unroll=unroll,
+                           seed=p["seed"], n_c=p["n_c"], n_v=p["n_v"],
+                           deg=p["deg"])
+    rec = {"layout": layout, "unroll": unroll, "cls": cls,
+           "ts": round(time.time(), 1)}
+    t0 = time.time()
+    try:
+        proc = subprocess.run([sys.executable, "-c", src],
+                              capture_output=True, text=True,
+                              timeout=timeout, cwd=ROOT)
+        if proc.returncode == 0 and proc.stdout.strip():
+            rec.update(json.loads(proc.stdout.strip().splitlines()[-1]))
+        else:
+            rec["error"] = (proc.stderr or "")[-400:]
+    except subprocess.TimeoutExpired:
+        rec["error"] = f"timeout after {timeout:.0f}s"
+    rec["wall_s"] = round(time.time() - t0, 1)
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "a") as fh:
+        fh.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def probe_alive(timeout: float = 120.0) -> bool:
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    from chip_watch import probe
+    rec = probe(timeout)
+    print(f"[probe] {rec}", file=sys.stderr, flush=True)
+    return bool(rec.get("alive"))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--one", help="layout:unroll:class, e.g. ell:on:2000")
+    ap.add_argument("--no-probe", action="store_true")
+    args = ap.parse_args()
+    if args.one:
+        layout, unroll, cls = args.one.split(":")
+        run_one(layout, unroll, int(cls), timeout=3600)
+        return 0
+    if not args.no_probe and not probe_alive():
+        print("[tpu_experiments] chip not answering; aborting",
+              file=sys.stderr)
+        return 1
+    # Small classes first (cheap compiles warm the cache), unroll=off
+    # first (unroll compiles scale with the factor).  100k COO is the
+    # known-pathological gather-in-loop case: run it LAST so a wedge
+    # costs nothing else, with the biggest timeout.
+    matrix = [("ell", "off", 2000), ("ell", "on", 2000),
+              ("coo", "off", 2000), ("coo", "on", 2000),
+              ("ell", "off", 20000), ("ell", "on", 20000),
+              ("coo", "off", 20000),
+              ("ell", "off", 100000), ("ell", "on", 100000),
+              ("coo", "off", 100000)]
+    for layout, unroll, cls in matrix:
+        timeout = 900 if cls <= 20000 else 3600
+        rec = run_one(layout, unroll, cls, timeout)
+        if "error" in rec and "timeout" in rec.get("error", ""):
+            # a timeout usually means the chip is wedged: re-probe
+            # before burning the rest of the matrix
+            if not probe_alive():
+                print("[tpu_experiments] chip wedged mid-matrix; stop",
+                      file=sys.stderr)
+                return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
