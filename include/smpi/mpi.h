@@ -34,8 +34,8 @@ typedef struct MPI_Status {
   int MPI_SOURCE;
   int MPI_TAG;
   int MPI_ERROR;
-  int count_;     /* received bytes (internal) */
-  int cancelled_; /* set by a successful MPI_Cancel (internal) */
+  int cancelled_;       /* set by a successful MPI_Cancel (internal) */
+  long long count_;     /* received bytes (internal, >2GB-capable) */
 } MPI_Status;
 
 #define MPI_COMM_NULL 0
@@ -176,8 +176,13 @@ typedef struct MPI_Status {
 #define MPI_THREAD_MULTIPLE 3
 #define MPI_IN_PLACE ((void*)-222)
 #define MPI_BOTTOM ((void*)0)
+#define MPI_STATUS_SIZE 6   /* Fortran: sizeof(MPI_Status)/sizeof(int) */
 #define MPI_STATUS_IGNORE ((MPI_Status*)0)
 #define MPI_STATUSES_IGNORE ((MPI_Status*)0)
+/* matched probe (MPI-3 §3.8.2): a plucked-message handle */
+typedef int MPI_Message;
+#define MPI_MESSAGE_NULL 0
+#define MPI_MESSAGE_NO_PROC -1
 #define MPI_MAX_PROCESSOR_NAME 256
 #define MPI_MAX_ERROR_STRING 256
 #define MPI_MAX_OBJECT_NAME 128
@@ -235,6 +240,8 @@ typedef struct MPI_Status {
 #define MPI_ERR_DUP_DATAREP 54
 #define MPI_ERR_CONVERSION 55
 #define MPI_ERR_IO 56
+#define MPI_ERR_DIMS 57
+#define MPI_ERR_TOPOLOGY 58
 #define MPI_ERR_LASTCODE 74
 
 typedef void MPI_User_function(void* invec, void* inoutvec, int* len,
@@ -334,6 +341,29 @@ int MPI_Waitany(int count, MPI_Request* requests, int* index,
 int MPI_Testall(int count, MPI_Request* requests, int* flag,
                 MPI_Status* statuses);
 int MPI_Probe(int source, int tag, MPI_Comm comm, MPI_Status* status);
+int MPI_Mprobe(int source, int tag, MPI_Comm comm, MPI_Message* message,
+               MPI_Status* status);
+int MPI_Improbe(int source, int tag, MPI_Comm comm, int* flag,
+                MPI_Message* message, MPI_Status* status);
+int MPI_Mrecv(void* buf, int count, MPI_Datatype datatype,
+              MPI_Message* message, MPI_Status* status);
+int MPI_Imrecv(void* buf, int count, MPI_Datatype datatype,
+               MPI_Message* message, MPI_Request* request);
+typedef int MPI_Grequest_query_function(void* extra_state,
+                                        MPI_Status* status);
+typedef int MPI_Grequest_free_function(void* extra_state);
+typedef int MPI_Grequest_cancel_function(void* extra_state, int complete);
+int MPI_Grequest_start(MPI_Grequest_query_function* query_fn,
+                       MPI_Grequest_free_function* free_fn,
+                       MPI_Grequest_cancel_function* cancel_fn,
+                       void* extra_state, MPI_Request* request);
+int MPI_Grequest_complete(MPI_Request request);
+int MPI_Status_set_cancelled(MPI_Status* status, int flag);
+/* handle <-> Fortran conversions are the identity (handles are ints) */
+#define MPI_Message_c2f(m) ((int)(m))
+#define MPI_Message_f2c(m) ((MPI_Message)(m))
+#define PMPI_Message_c2f(m) ((int)(m))
+#define PMPI_Message_f2c(m) ((MPI_Message)(m))
 int MPI_Iprobe(int source, int tag, MPI_Comm comm, int* flag,
                MPI_Status* status);
 int MPI_Sendrecv(const void* sendbuf, int sendcount, MPI_Datatype sendtype,
@@ -674,7 +704,7 @@ int MPI_Get_elements_x(const MPI_Status* status, MPI_Datatype datatype,
 int MPI_Status_set_elements(MPI_Status* status, MPI_Datatype datatype,
                             int count);
 int MPI_Status_set_elements_x(MPI_Status* status, MPI_Datatype datatype,
-                              MPI_Count* count);
+                              MPI_Count count);
 int MPI_Comm_remote_size(MPI_Comm comm, int* size);
 int MPI_Intercomm_create(MPI_Comm local_comm, int local_leader,
                          MPI_Comm peer_comm, int remote_leader, int tag,

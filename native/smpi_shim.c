@@ -228,6 +228,12 @@ enum {
   SMPI_OP_WIN_SET_ERRHANDLER,
   SMPI_OP_WIN_GET_ERRHANDLER, /* 195 */
   SMPI_OP_WIN_CALL_ERRHANDLER,
+  SMPI_OP_MPROBE,             /* 197 */
+  SMPI_OP_IMPROBE,
+  SMPI_OP_MRECV,
+  SMPI_OP_IMRECV,             /* 200 */
+  SMPI_OP_GREQUEST_START,
+  SMPI_OP_GREQUEST_COMPLETE,
 };
 
 /* sub-modes for FILE_READ / FILE_WRITE */
@@ -369,6 +375,38 @@ int MPI_Probe(int source, int tag, MPI_Comm comm, MPI_Status* status) {
 int MPI_Iprobe(int source, int tag, MPI_Comm comm, int* flag,
                MPI_Status* status) {
   CALL(SMPI_OP_IPROBE, A(source), A(tag), A(comm), A(flag), A(status));
+}
+int MPI_Mprobe(int source, int tag, MPI_Comm comm, MPI_Message* message,
+               MPI_Status* status) {
+  CALL(SMPI_OP_MPROBE, A(source), A(tag), A(comm), A(message), A(status));
+}
+int MPI_Improbe(int source, int tag, MPI_Comm comm, int* flag,
+                MPI_Message* message, MPI_Status* status) {
+  CALL(SMPI_OP_IMPROBE, A(source), A(tag), A(comm), A(flag), A(message),
+       A(status));
+}
+int MPI_Mrecv(void* buf, int count, MPI_Datatype datatype,
+              MPI_Message* message, MPI_Status* status) {
+  CALL(SMPI_OP_MRECV, A(buf), A(count), A(datatype), A(message), A(status));
+}
+int MPI_Imrecv(void* buf, int count, MPI_Datatype datatype,
+               MPI_Message* message, MPI_Request* request) {
+  CALL(SMPI_OP_IMRECV, A(buf), A(count), A(datatype), A(message),
+       A(request));
+}
+int MPI_Grequest_start(MPI_Grequest_query_function* query_fn,
+                       MPI_Grequest_free_function* free_fn,
+                       MPI_Grequest_cancel_function* cancel_fn,
+                       void* extra_state, MPI_Request* request) {
+  CALL(SMPI_OP_GREQUEST_START, A(query_fn), A(free_fn), A(cancel_fn),
+       A(extra_state), A(request));
+}
+int MPI_Grequest_complete(MPI_Request request) {
+  CALL(SMPI_OP_GREQUEST_COMPLETE, A(request));
+}
+int MPI_Status_set_cancelled(MPI_Status* status, int flag) {
+  if (status) status->cancelled_ = flag;
+  return MPI_SUCCESS;
 }
 int MPI_Sendrecv(const void* sendbuf, int sendcount, MPI_Datatype sendtype,
                  int dest, int sendtag, void* recvbuf, int recvcount,
@@ -750,12 +788,12 @@ int MPI_Get_elements_x(const MPI_Status* status, MPI_Datatype datatype,
 }
 int MPI_Status_set_elements(MPI_Status* status, MPI_Datatype datatype,
                             int count) {
-  MPI_Count c = count;
-  return MPI_Status_set_elements_x(status, datatype, &c);
+  return MPI_Status_set_elements_x(status, datatype, count);
 }
 int MPI_Status_set_elements_x(MPI_Status* status, MPI_Datatype datatype,
-                              MPI_Count* count) {
-  CALL(SMPI_OP_GET_ELEMENTS, A(status), A(datatype), A(count), A(2));
+                              MPI_Count count) {   /* count BY VALUE */
+  MPI_Count c = count;
+  CALL(SMPI_OP_GET_ELEMENTS, A(status), A(datatype), A(&c), A(2));
 }
 int MPI_Type_get_envelope(MPI_Datatype datatype, int* num_integers,
                           int* num_addresses, int* num_datatypes,
@@ -1627,7 +1665,7 @@ int MPI_Iexscan(const void* sendbuf, void* recvbuf, int count,
  *
  * The gfortran/flang ABI: every argument passed by reference, handles
  * are MPI_Fint (int — our C handles are ints already, so translation
- * is the identity), status is an int[MPI_F_STATUS_SIZE] laid out like
+ * is the identity), status is an int[MPI_STATUS_SIZE=6] laid out like
  * our MPI_Status, and symbols are lowercase with a trailing
  * underscore.  This image ships no Fortran compiler, so conformance is
  * exercised by calling these exact symbols by reference from C
@@ -1715,7 +1753,9 @@ void mpi_waitall_(MPI_Fint* count, MPI_Fint* requests, MPI_Fint* statuses,
     MPI_Request req = (MPI_Request)requests[i];
     rc = MPI_Wait(&req, statuses == (MPI_Fint*)0
                             ? MPI_STATUS_IGNORE
-                            : (MPI_Status*)(statuses + 5 * i));
+                            : (MPI_Status*)(statuses +
+                                  (sizeof(MPI_Status) / sizeof(MPI_Fint))
+                                  * i));
     requests[i] = (MPI_Fint)req;
     if (rc != MPI_SUCCESS && *ierr == MPI_SUCCESS) *ierr = rc;
   }

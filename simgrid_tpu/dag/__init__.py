@@ -13,5 +13,7 @@ parent_file_child, synthetic root/end tasks.
 from .task import Task, TaskKind, TaskState
 from .engine import DagEngine
 from .dax import load_dax
+from .dot import load_dot
 
-__all__ = ["Task", "TaskKind", "TaskState", "DagEngine", "load_dax"]
+__all__ = ["Task", "TaskKind", "TaskState", "DagEngine", "load_dax",
+           "load_dot"]

@@ -1,0 +1,236 @@
+"""GraphViz DOT workflow loader (reference sd_dotloader.cpp).
+
+A node's ``size`` attribute is the flops of a sequential computation
+task (or, with ``sequential=False``, the total work of an Amdahl
+parallel task whose serial fraction is the ``alpha`` attribute); an
+edge's ``size`` is the bytes of an end-to-end transfer task named
+``src->dst`` spliced between the two nodes — a missing or non-positive
+size makes the edge a plain control dependency
+(sd_dotloader.cpp:155-178).  Nodes named ``root``/``end`` are
+synthesized when absent; every source task gains a dependency from
+``root`` and every sink a dependency to ``end`` (:187-199).  With
+``schedule=True`` the ``performer``/``order`` attributes place each
+task on a host, serialising same-performer tasks (:204-229); an
+incomplete schedule is ignored with a warning and the load returns
+None, as does a cyclic graph (:231-236).
+
+The reference parses via libcgraph; this is a self-contained parser of
+the DOT subset those files use (node/edge statements with optional
+``[k="v"]`` attribute lists, ``//``, ``/* */`` and ``#`` comments,
+quoted identifiers, ``a->b->c`` chains).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ..utils import log as _log
+from .task import Task, TaskState
+
+_logger = _log.get_category("sd_dotparse")
+
+_TOKEN = re.compile(
+    r'\s*(?:"((?:[^"\\]|\\.)*)"'
+    r'|((?:[A-Za-z0-9_.+]|-(?!>))+)'   # bare id; "-" only when not "->"
+    r'|(->|[\[\]{};=,]))')
+
+
+def _tokenize(text: str) -> List[str]:
+    # strip comments first (none of the quoted attrs here span lines)
+    text = re.sub(r"/\*.*?\*/", " ", text, flags=re.S)
+    text = re.sub(r"(//|#)[^\n]*", " ", text)
+    out, pos = [], 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if m is None:
+            if text[pos:].strip():
+                raise ValueError(f"DOT parse error at: {text[pos:pos+30]!r}")
+            break
+        if m.group(1) is not None:
+            out.append(m.group(1).replace('\\"', '"'))
+        elif m.group(2) is not None:
+            out.append(m.group(2))
+        else:
+            out.append(m.group(3))
+        pos = m.end()
+    return out
+
+
+def _parse(path: str):
+    """-> (ordered node names, {name: attrs}, [(src, dst, attrs)])."""
+    toks = _tokenize(open(path).read())
+    i = 0
+    # skip to the opening brace: [strict] (di)graph [name] {
+    while i < len(toks) and toks[i] != "{":
+        i += 1
+    i += 1
+    names: List[str] = []
+    node_attrs: Dict[str, dict] = {}
+    edges: List[Tuple[str, str, dict]] = []
+
+    def see(name: str) -> None:
+        if name not in node_attrs:
+            names.append(name)
+            node_attrs[name] = {}
+
+    def attr_list() -> dict:
+        nonlocal i
+        attrs = {}
+        while i < len(toks) and toks[i] == "[":
+            i += 1
+            while toks[i] != "]":
+                k = toks[i]
+                if toks[i + 1] == "=":
+                    attrs[k] = toks[i + 2]
+                    i += 3
+                else:
+                    attrs[k] = ""
+                    i += 1
+                if toks[i] == ",":
+                    i += 1
+            i += 1
+        return attrs
+
+    while i < len(toks) and toks[i] != "}":
+        if toks[i] == ";":
+            i += 1
+            continue
+        head = toks[i]
+        i += 1
+        if head in ("graph", "node", "edge") and i < len(toks) \
+                and toks[i] == "[":
+            attr_list()            # default-attr statements: ignored
+            continue
+        chain = [head]
+        while i < len(toks) and toks[i] == "->":
+            chain.append(toks[i + 1])
+            i += 2
+        attrs = attr_list()
+        for name in chain:
+            see(name)
+        if len(chain) == 1:
+            node_attrs[head].update(attrs)
+        else:
+            for src, dst in zip(chain, chain[1:]):
+                edges.append((src, dst, attrs))
+    return names, node_attrs, edges
+
+
+def _atof(value: Optional[str]) -> float:
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        return 0.0          # C atof on a missing/empty attribute
+
+
+def load_dot(path: str, sequential: bool = True, schedule: bool = False,
+             hosts=None) -> Optional[List[Task]]:
+    """SD_dotload / SD_PTG_dotload(sequential=False) /
+    SD_dotload_with_sched(schedule=True, hosts=engine hosts)."""
+    names, node_attrs, edge_list = _parse(path)
+
+    def make_comp(name: str, attrs: dict) -> Task:
+        amount = _atof(attrs.get("size"))
+        if sequential:
+            return Task.create_comp_seq(name, amount)
+        return Task.create_comp_par_amdahl(name, amount,
+                                           _atof(attrs.get("alpha")))
+
+    jobs: Dict[str, Task] = {}
+    result: List[Task] = []
+    computers: Dict[str, List[Optional[Task]]] = {}
+    schedule_success = True
+    for name in names:
+        attrs = node_attrs[name]
+        task = make_comp(name, attrs)
+        jobs[name] = task
+        if name not in ("root", "end"):
+            result.append(task)
+        if sequential and schedule and schedule_success:
+            performer = int(attrs.get("performer") or -1)
+            order = int(attrs.get("order") or -1)
+            if performer < 0 or order < 0 or (
+                    hosts is not None and performer >= len(hosts)):
+                _logger.verbose(
+                    "The schedule is ignored, task '%s' can not be "
+                    "scheduled on %d hosts", name, performer)
+                schedule_success = False
+                continue
+            slots = computers.setdefault(str(performer), [])
+            if order < len(slots) and slots[order] not in (None, task):
+                _logger.verbose(
+                    "Task '%s' wants to start on performer '%s' at the "
+                    "same position '%s' as task '%s'",
+                    slots[order].name, performer, order, name)
+                schedule_success = False
+                continue
+            slots.extend([None] * (order + 1 - len(slots)))
+            slots[order] = task
+
+    root = jobs.get("root") or make_comp("root", {})
+    root.state = TaskState.SCHEDULABLE
+    result.insert(0, root)
+    end = jobs.get("end") or make_comp("end", {})
+    jobs.setdefault("root", root)
+    jobs.setdefault("end", end)
+
+    for src_name, dst_name, attrs in edge_list:
+        src, dst = jobs[src_name], jobs[dst_name]
+        size = _atof(attrs.get("size"))
+        if size > 0:
+            name = f"{src_name}->{dst_name}"
+            if any(t.name == name for t in result):
+                _logger.warning("Task '%s' is defined more than once", name)
+                continue
+            transfer = Task.create_comm_e2e(name, size)
+            transfer.depends_on(src)
+            dst.depends_on(transfer)
+            result.append(transfer)
+        else:
+            dst.depends_on(src)
+
+    result.append(end)
+
+    # connect entry tasks to root and exit tasks to end (:187-199)
+    for task in result:
+        if not task.predecessors and task is not root:
+            task.depends_on(root)
+        if not task.successors and task is not end:
+            end.depends_on(task)
+
+    if schedule:
+        if not schedule_success:
+            _logger.warning("The scheduling is ignored")
+            return None
+        assert hosts is not None, "schedule=True needs the platform hosts"
+        for performer, slots in computers.items():
+            previous = None
+            for task in slots:
+                if task is None:
+                    continue
+                if previous is not None \
+                        and previous not in task.predecessors:
+                    task.depends_on(previous)
+                task.schedule([hosts[int(performer)]])
+                previous = task
+
+    if not _acyclic(result):
+        _logger.error("The DOT described in %s is not a DAG. It contains "
+                      "a cycle.", path.rsplit("/", 1)[-1])
+        return None
+    return result
+
+
+def _acyclic(tasks: List[Task]) -> bool:
+    indeg = {id(t): len(t.predecessors) for t in tasks}
+    queue = [t for t in tasks if indeg[id(t)] == 0]
+    seen = 0
+    while queue:
+        task = queue.pop()
+        seen += 1
+        for nxt in task.successors:
+            indeg[id(nxt)] -= 1
+            if indeg[id(nxt)] == 0:
+                queue.append(nxt)
+    return seen == len(tasks)

@@ -32,14 +32,17 @@ class Engine:
         # as _reset, for code that constructs engines back-to-back).
         if Engine._instance is not None:
             Engine._instance.pimpl.disconnect_signals()
+        # --cfg must land BEFORE the kernel comes up: EngineImpl's
+        # ContextFactory freezes contexts/stack-size at creation
+        # (reference order too: sg_config runs first, sg_config.cpp)
+        if argv:
+            rest = config.parse_argv(argv[1:])
+            argv[1:] = rest
         self.pimpl = EngineImpl()
         self._registered_functions: Dict[str, Callable] = {}
         self._default_function: Optional[Callable] = None
         self._models_ready = False
         Engine._instance = self
-        if argv:
-            rest = config.parse_argv(argv[1:])
-            argv[1:] = rest
 
     # -- singletons --------------------------------------------------------
     @classmethod

@@ -1,0 +1,231 @@
+"""AMPI migration hooks + greedy load balancer.
+
+Reference: src/smpi/plugins/sampi_loadbalancer.cpp (AMPI_Migrate
+machinery, replay actions, the migration-frequency flag),
+src/smpi/plugins/load_balancer/LoadBalancer.cpp (the greedy balancer),
+src/smpi/plugins/ampi/ampi.cpp (iteration markers, tracked
+allocations feeding the migration payload size).
+
+The balancer observes per-actor computation (recorded from every
+completed single-host exec), normalizes per-host load by the host's
+computed flops (host_load plugin), and greedily reassigns the heaviest
+actors to the least-loaded hosts.  ``AMPI_Migrate`` runs it every
+``smpi/plugin/lb/migration-frequency`` calls, bills a host-to-host
+transfer of the rank's tracked memory, and migrates the calling actor
+to its new host.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Dict, List, Optional
+
+from ..utils.config import config, declare_flag
+from ..utils.signal import Signal
+from ..utils import log as _log
+
+_logger = _log.get_category("plugin_load_balancer")
+
+declare_flag(
+    "smpi/plugin/lb/migration-frequency",
+    "After how many calls to the migration function should the migration "
+    "be actually executed?", 10,
+    aliases=["smpi/plugin/lb/migration_frequency"])
+
+#: AMPI iteration signals (ampi.cpp on_iteration_in/out)
+on_iteration_in = Signal()
+on_iteration_out = Signal()
+
+
+class LoadBalancer:
+    """Greedy balancer (LoadBalancer.cpp:45-135): actors sorted by
+    recorded computation (heaviest first), hosts kept in a least-loaded
+    heap (lazy-deletion entries instead of the reference's mutable
+    fibonacci handles); an actor moves to the least-loaded host when
+    that strictly lowers the load of its current host and doesn't empty
+    it."""
+
+    def __init__(self):
+        self.actor_computation: Dict[int, float] = {}
+        self.new_mapping: Dict[int, object] = {}    # pid -> host
+
+    def record_actor_computation(self, pid: int, load: float) -> None:
+        self.actor_computation[pid] = \
+            self.actor_computation.get(pid, 0.0) + load
+
+    def _computed_flops(self, host) -> float:
+        from ..plugins import host_load
+        try:
+            total = host_load.get_computed_flops(host)
+        except AssertionError:      # plugin not active: no normalization
+            return 1.0
+        return total if total > 0 else 1.0
+
+    def run(self, engine) -> None:
+        hosts = [h for h in engine.get_all_hosts() if h.is_on()]
+        assert hosts, "No hosts available; are they all switched off?"
+        actors = [a for h in hosts for a in h.actor_list
+                  if not a.daemonized]
+        for actor in actors:
+            self.new_mapping[actor.pid] = actor.host
+        comp = self.actor_computation
+        actors.sort(key=lambda a: comp.get(a.pid, 0.0), reverse=True)
+
+        load: Dict[str, float] = {}
+        count: Dict[str, int] = {}
+        heap: List = []
+        seq = 0
+        for host in hosts:
+            total = self._computed_flops(host)
+            load[host.name] = sum(comp.get(a.pid, 0.0) / total
+                                  for a in host.actor_list
+                                  if not a.daemonized)
+            count[host.name] = sum(1 for a in host.actor_list
+                                   if not a.daemonized)
+            heapq.heappush(heap, (load[host.name], seq, host))
+            seq += 1
+            _logger.debug("Host %s initialized to %f", host.name,
+                          load[host.name])
+
+        def push(host):
+            nonlocal seq
+            heapq.heappush(heap, (load[host.name], seq, host))
+            seq += 1
+
+        for actor in actors:
+            # skip stale heap entries (the lazy-deletion analogue of
+            # the reference's in-place fibonacci-heap updates)
+            while heap and heap[0][0] != load[heap[0][2].name]:
+                heapq.heappop(heap)
+            if not heap:
+                break
+            target = heap[0][2]
+            cur = self.new_mapping[actor.pid]
+            acomp = comp.get(actor.pid, 0.0)
+            if (target is not cur
+                    and load[target.name] + acomp < load[cur.name]
+                    and count[cur.name] > 1):
+                heapq.heappop(heap)
+                load[cur.name] = max(0.0, load[cur.name] - acomp)
+                load[target.name] += acomp
+                count[cur.name] -= 1
+                count[target.name] += 1
+                self.new_mapping[actor.pid] = target
+                _logger.debug("Assigning actor %d to host %s", actor.pid,
+                              target.name)
+                push(target)
+                push(cur)
+
+        from ..plugins import host_load
+        for host in hosts:
+            try:
+                host_load.reset(host)   # reset for the next iterations
+            except AssertionError:
+                break
+        self.actor_computation.clear()
+
+    def get_mapping(self, actor) -> Optional[object]:
+        return self.new_mapping.get(actor.pid, actor.host)
+
+
+#: the plugin singleton (sampi_loadbalancer.cpp:30)
+lb = LoadBalancer()
+
+# per-pid AMPI state (ampi.cpp memory_size / migration_call_counter)
+_memory_size: Dict[int, float] = {}
+_migration_calls: Dict[int, int] = {}
+_lb_ran = False
+
+
+def ampi_malloc(pid: int, size: float) -> None:
+    """_sampi_malloc's accounting half: AMPI applications route their
+    allocations here so AMPI_Migrate can bill the rank's live memory as
+    the migration payload."""
+    _memory_size[pid] = _memory_size.get(pid, 0.0) + size
+
+
+def ampi_free(pid: int, size: float) -> None:
+    _memory_size[pid] = max(0.0, _memory_size.get(pid, 0.0) - size)
+
+
+def AMPI_Iteration_in(comm) -> int:
+    from ..s4u import Actor
+    on_iteration_in(Actor.self())
+    return 1
+
+
+def AMPI_Iteration_out(comm) -> int:
+    from ..s4u import Actor
+    on_iteration_out(Actor.self())
+    return 1
+
+
+def AMPI_Migrate(comm, memory_consumption: Optional[float] = None) -> None:
+    """sampi_loadbalancer.cpp:44-105 MigrateAction::kernel."""
+    global _lb_ran
+    from ..s4u import Actor, Engine, this_actor
+
+    me = Actor.self()
+    pid = me.pid
+    _migration_calls[pid] = _migration_calls.get(pid, 0) + 1
+    freq = int(config["smpi/plugin/lb/migration-frequency"])
+    if freq <= 0 or _migration_calls[pid] % freq != 0:
+        return          # freq 0 disables migration entirely
+
+    comm.barrier()
+    if not _lb_ran:
+        _lb_ran = True
+        _logger.debug("Process %d runs the load balancer", pid)
+        lb.run(Engine.get_instance())
+    comm.barrier()
+    _lb_ran = False     # behind the barrier: all ranks passed the if
+
+    cur = me.host
+    target = lb.get_mapping(me)
+    if target is not None and target is not cur:
+        mem = memory_consumption
+        if mem is None:
+            mem = _memory_size.get(pid, 0.0)
+        # the migration traffic: a cur->target transfer of the rank's
+        # memory (parallel_execute with only that one comm amount)
+        this_actor.parallel_execute([cur, target], [0.0, 0.0],
+                                    [0.0, max(mem, 1.0), 0.0, 0.0])
+        _logger.debug("Migrating process %d from %s to %s", pid,
+                      cur.name, target.name)
+        this_actor.set_host(target)
+    comm.barrier()
+
+
+def sg_load_balancer_plugin_init(engine=None) -> None:
+    """sg_load_balancer_plugin_init: record every completed exec's cost
+    against its issuer and register the AMPI replay actions."""
+    from ..s4u import Engine
+    from ..kernel.activity import ExecImpl
+
+    e = engine if engine is not None else Engine.get_instance()
+
+    def on_exec_done(impl):
+        if impl.simcalls and len(impl.hosts) == 1 and impl.flops_amounts:
+            lb.record_actor_computation(impl.simcalls[0].issuer.pid,
+                                        impl.flops_amounts[0])
+
+    e.pimpl.connect_signal(ExecImpl.on_completion, on_exec_done)
+    _register_replay_actions()
+
+
+def _register_replay_actions() -> None:
+    from . import replay, runtime
+
+    @replay.action("migrate")
+    def _migrate(ctx, act):
+        # only parameter: the memory consumption of the current rank
+        mem = float(act[2]) if len(act) > 2 else 0.0
+        AMPI_Migrate(ctx.comm, mem)
+
+    @replay.action("iteration_in")
+    def _iter_in(ctx, act):
+        AMPI_Iteration_in(ctx.comm)
+
+    @replay.action("iteration_out")
+    def _iter_out(ctx, act):
+        AMPI_Iteration_out(ctx.comm)

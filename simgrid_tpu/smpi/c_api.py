@@ -261,6 +261,8 @@ class _CRankCtx:
         self.wins: Dict[int, dict] = {}
         self.next_win = 1
         self.win_keyvals: Dict[int, tuple] = {}
+        self.messages: Dict[int, object] = {}     # MPI_Mprobe plucks
+        self.next_msg = 1
         self.cart_topos: Dict[int, object] = {}
         self.graph_topos: Dict[int, object] = {}
         self.comm_names: Dict[int, str] = {}
@@ -272,16 +274,18 @@ class _CRankCtx:
 
 
 class _CReq:
-    __slots__ = ("req", "c_addr", "arr", "kind", "dt", "post")
+    __slots__ = ("req", "c_addr", "arr", "kind", "dt", "post", "cap")
 
     def __init__(self, req, c_addr: int, arr, kind: str,
-                 dt: Optional[Datatype] = None, post=None):
+                 dt: Optional[Datatype] = None, post=None,
+                 cap: Optional[int] = None):
         self.req = req
         self.c_addr = c_addr
         self.arr = arr
         self.kind = kind          # "send" | "recv" | "nbc"
         self.dt = dt
         self.post = post          # nbc: result -> C buffers copier
+        self.cap = cap            # recv: posted-buffer byte limit
 
 
 _ctxs: Dict[int, _CRankCtx] = {}
@@ -401,11 +405,20 @@ def _arr_in(addr: int, count: int, dt: Datatype):
     vectors, UB-padded structs, nested constructions)."""
     count = int(count)
     nbytes = count * dt.size_
-    if addr == 0 or nbytes <= 0:
+    # addr 0 with a non-contiguous type is MPI_BOTTOM: the datatype's
+    # absolute displacements (MPI_Get_address) are the real addresses
+    if nbytes <= 0 or (addr == 0 and _is_contiguous(dt)):
         return np.zeros(0, dt.np_dtype if dt.np_dtype is not None
                         else np.uint8)
     if _is_contiguous(dt):
-        raw = bytearray(ctypes.string_at(addr, int(nbytes)))
+        # single writable copy (no bytes->bytearray double copy: the
+        # pt2pt/large_message 2.16 GB payload goes through here)
+        out = np.empty(int(nbytes), np.uint8)
+        ctypes.memmove(out.ctypes.data, int(addr), int(nbytes))
+        if (dt.np_dtype is not None
+                and nbytes % np.dtype(dt.np_dtype).itemsize == 0):
+            return out.view(dt.np_dtype)
+        return out
     else:
         segs = _segments_of(dt)
         raw = bytearray()
@@ -421,8 +434,9 @@ def _arr_in(addr: int, count: int, dt: Datatype):
 def _arr_out(addr: int, arr, max_bytes: Optional[int] = None,
              dt: Optional[Datatype] = None) -> None:
     """Copy a packed numpy payload into the C buffer at `addr`,
-    scattering through the datatype's type map."""
-    if addr == 0 or arr is None:
+    scattering through the datatype's type map (addr 0 = MPI_BOTTOM
+    when the type carries absolute displacements)."""
+    if arr is None or (addr == 0 and (dt is None or _is_contiguous(dt))):
         return
     a = np.ascontiguousarray(arr)
     data = a.tobytes()
@@ -461,24 +475,29 @@ def _recv_buf(count: int, dt: Datatype):
     return np.zeros(nbytes, np.uint8)
 
 
-#: sizeof(MPI_Status) in mpi.h (5 ints: SOURCE, TAG, ERROR, count_,
-#: cancelled_) — array handlers MUST step by this
-_STATUS_BYTES = 20
+#: sizeof(MPI_Status) in mpi.h (SOURCE, TAG, ERROR, cancelled_: ints;
+#: count_: long long at offset 16) — array handlers MUST step by this
+_STATUS_BYTES = 24
 
 
 def _set_status(addr: int, src: int, tag: int, err: int, nbytes,
-                cancelled: bool = False) -> None:
+                cancelled: bool = False, keep_error: bool = True) -> None:
+    """keep_error defaults True: the MPI standard (§3.7.3) allows the
+    MPI_ERROR field to be written only by multi-completion calls
+    (WAITALL/WAITSOME/TESTALL/TESTSOME) — mirror of MPICH's
+    MPIR_Status_set_empty, which leaves MPI_ERROR untouched."""
     if addr == 0:
         return
     p = ctypes.cast(int(addr), _pi32)
     p[0] = int(src)
     p[1] = int(tag)
-    p[2] = int(err)
+    if not keep_error:
+        p[2] = int(err)
+    p[3] = 1 if cancelled else 0
     try:
-        p[3] = int(min(nbytes, 2**31 - 1))
+        ctypes.cast(int(addr) + 16, _pi64)[0] = int(nbytes)
     except (OverflowError, ValueError):
-        p[3] = 0
-    p[4] = 1 if cancelled else 0
+        ctypes.cast(int(addr) + 16, _pi64)[0] = 0
 
 
 def _status_from(addr: int, st: Status) -> None:
@@ -613,14 +632,24 @@ class _CPersist:
             self.inner = _CReq(req, 0, arr, "send")
 
 
-def _req_wait(creq: _CReq, status: Status):
-    if creq.kind == "nbc":
+def _req_wait(creq, status: Status):
+    kind = getattr(creq, "kind", None)
+    if kind == "greq":
+        return _greq_block(creq)    # status is filled at retirement
+    if kind == "done":
+        return None
+    if kind == "nbc":
         return creq.req.wait()      # NbcRequest: no status argument
     return creq.req.wait(status)
 
 
-def _req_test(creq: _CReq, status: Status) -> bool:
-    if creq.kind == "nbc":
+def _req_test(creq, status: Status) -> bool:
+    kind = getattr(creq, "kind", None)
+    if kind == "greq":
+        return creq.complete
+    if kind == "done":
+        return True
+    if kind == "nbc":
         return creq.req.test()
     return creq.req.test(status)
 
@@ -638,8 +667,14 @@ def _complete_creq(ctx: _CRankCtx, handle: int) -> None:
         # buffer's full extent (stack smash past the caller's array —
         # datatype/lots-of-types receives 16 B into an 8 KB type).
         got = getattr(creq.req, "real_size", None)
+        nb = None
         if got is not None and np.isfinite(got):
             nb = int(got)
+        if creq.cap is not None:
+            # Mprobe stashes allocate at MESSAGE size; the posted
+            # Imrecv buffer may be smaller — never scatter past it
+            nb = creq.cap if nb is None else min(nb, creq.cap)
+        if nb is not None:
             raw = np.ascontiguousarray(arr).reshape(-1).view(np.uint8)
             if nb < raw.size:
                 arr = raw[:nb]
@@ -861,13 +896,26 @@ def _h_wait(ctx, a):
     if entry is None:
         return MPI_ERR_REQUEST
     status = Status()
+    if isinstance(entry, _CDoneReq):
+        _set_status(st_addr, entry.src, entry.tag, MPI_SUCCESS,
+                    entry.nbytes)
+        ctx.reqs.pop(int(h), None)
+        _write_i32(req_addr, 0)
+        return MPI_SUCCESS
+    if isinstance(entry, _CGreq):
+        _greq_block(entry)
+        rc = _greq_retire(ctx, h, entry, st_addr)
+        _write_i32(req_addr, 0)
+        return rc
     if isinstance(entry, _CPersist):
-        # waiting an inactive persistent request returns immediately;
-        # the handle survives either way
+        # waiting an inactive persistent request returns immediately
+        # with the EMPTY status; the handle survives either way
         if entry.inner is not None:
             _req_wait(entry.inner, status)
             _finish_persist(entry)
-        _status_from(st_addr, status)
+            _status_from(st_addr, status)
+        else:
+            _set_status(st_addr, C_ANY_SOURCE, C_ANY_TAG, MPI_SUCCESS, 0)
         return MPI_SUCCESS
     _req_wait(entry, status)
     _complete_creq(ctx, h)
@@ -881,14 +929,31 @@ def _h_test(ctx, a):
     h = ctypes.cast(int(req_addr), _pi32)[0] if req_addr else 0
     if h == 0:
         _write_i32(flag_addr, 1)
+        _set_status(st_addr, C_ANY_SOURCE, C_ANY_TAG, MPI_SUCCESS, 0)
         return MPI_SUCCESS
     entry = ctx.reqs.get(int(h))
     if entry is None:
         return MPI_ERR_REQUEST
     status = Status()
+    if isinstance(entry, _CDoneReq):
+        _write_i32(flag_addr, 1)
+        _set_status(st_addr, entry.src, entry.tag, MPI_SUCCESS,
+                    entry.nbytes)
+        ctx.reqs.pop(int(h), None)
+        _write_i32(req_addr, 0)
+        return MPI_SUCCESS
+    if isinstance(entry, _CGreq):
+        if not entry.complete:
+            _write_i32(flag_addr, 0)
+            return MPI_SUCCESS
+        _write_i32(flag_addr, 1)
+        rc = _greq_retire(ctx, h, entry, st_addr)
+        _write_i32(req_addr, 0)
+        return rc
     if isinstance(entry, _CPersist):
         if entry.inner is None:
             _write_i32(flag_addr, 1)
+            _set_status(st_addr, C_ANY_SOURCE, C_ANY_TAG, MPI_SUCCESS, 0)
             return MPI_SUCCESS
         done = _req_test(entry.inner, status)
         _write_i32(flag_addr, 1 if done else 0)
@@ -908,6 +973,7 @@ def _h_test(ctx, a):
 def _h_waitall(ctx, a):
     n, reqs_addr, sts_addr = int(a[0]), a[1], a[2]
     handles = _read_i32s(reqs_addr, n) if reqs_addr else []
+    rc = MPI_SUCCESS
     for i, h in enumerate(handles):
         if h == 0:
             continue
@@ -915,19 +981,39 @@ def _h_waitall(ctx, a):
         if entry is None:
             continue
         status = Status()
+        if isinstance(entry, _CDoneReq):
+            if sts_addr:
+                _set_status(int(sts_addr) + _STATUS_BYTES * i,
+                            entry.src, entry.tag, MPI_SUCCESS,
+                            entry.nbytes)
+            ctx.reqs.pop(h, None)
+            ctypes.cast(int(reqs_addr), _pi32)[i] = 0
+            continue
+        if isinstance(entry, _CGreq):
+            _greq_block(entry)
+            r = _greq_retire(ctx, h, entry,
+                             (int(sts_addr) + _STATUS_BYTES * i)
+                             if sts_addr else 0)
+            if rc == MPI_SUCCESS:
+                rc = r
+            ctypes.cast(int(reqs_addr), _pi32)[i] = 0
+            continue
         if isinstance(entry, _CPersist):
             if entry.inner is not None:
                 _req_wait(entry.inner, status)
                 _finish_persist(entry)
-            if sts_addr:
-                _status_from(int(sts_addr) + _STATUS_BYTES * i, status)
+                if sts_addr:
+                    _status_from(int(sts_addr) + _STATUS_BYTES * i, status)
+            elif sts_addr:
+                _set_status(int(sts_addr) + _STATUS_BYTES * i,
+                            C_ANY_SOURCE, C_ANY_TAG, MPI_SUCCESS, 0)
             continue             # persistent handles survive waitall
         _req_wait(entry, status)
         _complete_creq(ctx, h)
         if sts_addr:
             _status_from(int(sts_addr) + _STATUS_BYTES * i, status)
         ctypes.cast(int(reqs_addr), _pi32)[i] = 0
-    return MPI_SUCCESS
+    return rc
 
 
 def _live_entries(ctx, handles):
@@ -948,14 +1034,31 @@ def _live_entries(ctx, handles):
     return out
 
 
-def _retire(ctx, h, creq, persist, status, reqs_addr, i) -> None:
+def _kernel_reqs(live):
+    """The subset backed by kernel Requests (waitany-able); greqs,
+    done-reqs and nbc composites complete through other means."""
+    return [e for e in live if getattr(e[2], "kind", None)
+            in ("send", "recv")]
+
+
+def _retire(ctx, h, creq, persist, status, reqs_addr, i) -> int:
     """Complete one finished entry: copy out, null the C slot for
-    plain requests, flip persistents to inactive."""
+    plain requests, flip persistents to inactive.  Returns the greq
+    query/free error code (MPI_SUCCESS for ordinary requests)."""
+    rc = MPI_SUCCESS
     if persist is not None:
         _finish_persist(persist)
+        return rc
+    if isinstance(creq, _CGreq):
+        rc = _greq_finalize(ctx, h, creq, status)
+    elif isinstance(creq, _CDoneReq):
+        status.source, status.tag = creq.src, creq.tag
+        status.count = creq.nbytes
+        ctx.reqs.pop(h, None)
     else:
         _complete_creq(ctx, h)
-        ctypes.cast(int(reqs_addr), _pi32)[i] = 0
+    ctypes.cast(int(reqs_addr), _pi32)[i] = 0
+    return rc
 
 
 def _h_waitany(ctx, a):
@@ -966,26 +1069,37 @@ def _h_waitany(ctx, a):
         _write_i32(idx_addr, C_UNDEFINED)
         return MPI_SUCCESS
     status = Status()
-    nbc = [e for e in live if e[2].kind == "nbc"]
-    plain = [e for e in live if e[2].kind != "nbc"]
-    done = next((e for e in nbc if e[2].req.test()), None)
-    if done is not None:
-        i, h, creq, persist = done
+    ready = next((e for e in live
+                  if e[2].kind not in ("send", "recv")
+                  and _req_test(e[2], status)), None)
+    plain = _kernel_reqs(live)
+    if ready is not None:
+        i, h, creq, persist = ready
     elif plain:
         k = Request.waitany([e[2].req for e in plain], status)
         if k < 0:
             _write_i32(idx_addr, C_UNDEFINED)
             return MPI_SUCCESS
         i, h, creq, persist = plain[k]
-    else:
+    elif all(e[2].kind == "nbc" for e in live):
         # only unfinished I-collectives: block on the first (waitany
         # over mixed nbc sets degrades to that, documented divergence)
-        i, h, creq, persist = nbc[0]
+        i, h, creq, persist = live[0]
         creq.req.wait()
-    _retire(ctx, h, creq, persist, status, reqs_addr, i)
+    else:
+        # unfinished greqs in the mix: poll until something completes
+        from ..s4u import this_actor
+        while True:
+            ready = next((e for e in live if _req_test(e[2], status)),
+                         None)
+            if ready is not None:
+                break
+            this_actor.sleep_for(1e-4)
+        i, h, creq, persist = ready
+    rc = _retire(ctx, h, creq, persist, status, reqs_addr, i)
     _status_from(st_addr, status)
     _write_i32(idx_addr, i)
-    return MPI_SUCCESS
+    return rc
 
 
 def _h_testall(ctx, a):
@@ -994,14 +1108,17 @@ def _h_testall(ctx, a):
     live = _live_entries(ctx, handles)
     all_done = all(_req_test(c, Status()) for _, _, c, _ in live)
     _write_i32(flag_addr, 1 if all_done else 0)
+    rc = MPI_SUCCESS
     if all_done:
         for i, h, c, persist in live:
             status = Status()
             _req_wait(c, status)    # already finished; fills status
-            _retire(ctx, h, c, persist, status, reqs_addr, i)
+            r = _retire(ctx, h, c, persist, status, reqs_addr, i)
+            if rc == MPI_SUCCESS:
+                rc = r
             if sts_addr:
                 _status_from(int(sts_addr) + _STATUS_BYTES * i, status)
-    return MPI_SUCCESS
+    return rc
 
 
 def _h_testany(ctx, a):
@@ -1016,11 +1133,11 @@ def _h_testany(ctx, a):
     for i, h, c, persist in live:
         status = Status()
         if _req_test(c, status):
-            _retire(ctx, h, c, persist, status, reqs_addr, i)
+            rc = _retire(ctx, h, c, persist, status, reqs_addr, i)
             _status_from(st_addr, status)
             _write_i32(idx_addr, i)
             _write_i32(flag_addr, 1)
-            return MPI_SUCCESS
+            return rc
     _write_i32(flag_addr, 0)
     return MPI_SUCCESS
 
@@ -1045,23 +1162,31 @@ def _h_waitsome(ctx, a):
     done = completed()
     if not done and blocking:
         status = Status()
-        plain = [e for e in live if e[2].kind != "nbc"]
+        plain = _kernel_reqs(live)
         if plain:
             k = Request.waitany([e[2].req for e in plain], status)
             if k >= 0:
                 i, h, c, persist = plain[k]
                 done = [(i, h, c, persist, status)]
-        else:
+        elif all(e[2].kind == "nbc" for e in live):
             i, h, c, persist = live[0]
             c.req.wait()
             done = [(i, h, c, persist, status)]
+        else:
+            from ..s4u import this_actor
+            while not done:
+                this_actor.sleep_for(1e-4)
+                done = completed()
+    rc = MPI_SUCCESS
     for j, (i, h, c, persist, status) in enumerate(done):
-        _retire(ctx, h, c, persist, status, reqs_addr, i)
+        r = _retire(ctx, h, c, persist, status, reqs_addr, i)
+        if rc == MPI_SUCCESS:
+            rc = r
         ctypes.cast(int(indices_addr), _pi32)[j] = i
         if sts_addr:
             _status_from(int(sts_addr) + _STATUS_BYTES * j, status)
     _write_i32(outcount_addr, len(done))
-    return MPI_SUCCESS
+    return rc
 
 
 def _probe_once(comm, src, tag):
@@ -1109,6 +1234,227 @@ def _h_iprobe(ctx, a):
     _write_i32(flag_addr, 0 if hit is None else 1)
     if hit is not None:
         _set_status(st_addr, hit[0], hit[1], MPI_SUCCESS, hit[2])
+    return MPI_SUCCESS
+
+
+C_MESSAGE_NO_PROC = -1
+
+
+class _CMsg:
+    """A message plucked by MPI_Mprobe/Improbe (MPI-3 §3.8.2, reference
+    smpi_pmpi_request.cpp mprobe role): the matching irecv is posted at
+    probe time, which reserves exactly the probed message against any
+    later recv on the same (source, tag); MPI_Mrecv/Imrecv drain it."""
+    __slots__ = ("req", "arr", "src", "tag", "nbytes")
+
+    def __init__(self, req, arr, src, tag, nbytes):
+        self.req = req
+        self.arr = arr
+        self.src = src
+        self.tag = tag
+        self.nbytes = nbytes
+
+
+def _pluck(ctx, comm, hit) -> int:
+    src, tag, nbytes = hit
+    arr = np.zeros(int(nbytes), np.uint8)
+    req = comm.irecv(src, tag, buf=arr, count=int(nbytes),
+                     datatype=_dt(ctx, 1))           # MPI_BYTE
+    h = ctx.next_msg
+    ctx.next_msg += 1
+    ctx.messages[h] = _CMsg(req, arr, src, tag, int(nbytes))
+    return h
+
+
+def _h_mprobe(ctx, a):
+    src, tag, ch, msg_addr, st_addr = (int(a[0]), int(a[1]), a[2], a[3],
+                                       a[4])
+    if src == C_PROC_NULL:
+        _write_i32(msg_addr, C_MESSAGE_NO_PROC)
+        _set_status(st_addr, C_PROC_NULL, C_ANY_TAG, MPI_SUCCESS, 0,
+                    keep_error=True)
+        return MPI_SUCCESS
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    while True:
+        hit = _probe_once(comm, src, tag)
+        if hit is not None:
+            break
+        if config["smpi/iprobe"] <= 0:
+            from ..s4u import this_actor
+            this_actor.sleep_for(1e-4)
+    _write_i32(msg_addr, _pluck(ctx, comm, hit))
+    _set_status(st_addr, hit[0], hit[1], MPI_SUCCESS, hit[2],
+                keep_error=True)
+    return MPI_SUCCESS
+
+
+def _h_improbe(ctx, a):
+    src, tag, ch, flag_addr, msg_addr, st_addr = (int(a[0]), int(a[1]),
+                                                  a[2], a[3], a[4], a[5])
+    if src == C_PROC_NULL:
+        _write_i32(flag_addr, 1)
+        _write_i32(msg_addr, C_MESSAGE_NO_PROC)
+        _set_status(st_addr, C_PROC_NULL, C_ANY_TAG, MPI_SUCCESS, 0,
+                    keep_error=True)
+        return MPI_SUCCESS
+    comm = _comm_of(ctx, ch)
+    if comm is None:
+        return MPI_ERR_COMM
+    hit = _probe_once(comm, src, tag)
+    _write_i32(flag_addr, 0 if hit is None else 1)
+    if hit is not None:
+        _write_i32(msg_addr, _pluck(ctx, comm, hit))
+        _set_status(st_addr, hit[0], hit[1], MPI_SUCCESS, hit[2],
+                    keep_error=True)
+    return MPI_SUCCESS
+
+
+def _h_mrecv(ctx, a):
+    buf, count, dth, msg_addr, st_addr = a[0], a[1], a[2], a[3], a[4]
+    mh = ctypes.cast(int(msg_addr), _pi32)[0] if msg_addr else 0
+    _write_i32(msg_addr, 0)                          # MPI_MESSAGE_NULL
+    if mh == C_MESSAGE_NO_PROC:
+        _set_status(st_addr, C_PROC_NULL, C_ANY_TAG, MPI_SUCCESS, 0,
+                    keep_error=True)
+        return MPI_SUCCESS
+    m = ctx.messages.pop(mh, None)
+    if m is None:
+        return MPI_ERR_REQUEST
+    status = Status()
+    m.req.wait(status)
+    dt = _dt(ctx, dth)
+    arr = m.arr
+    limit = int(count) * dt.size_          # never overrun the recv buf
+    if arr.nbytes > limit:
+        arr = arr.reshape(-1).view(np.uint8)[:limit]
+    _arr_out(buf, arr, dt=dt)
+    _set_status(st_addr, status.source, status.tag, MPI_SUCCESS,
+                status.count, status.cancelled, keep_error=True)
+    return MPI_SUCCESS
+
+
+def _h_imrecv(ctx, a):
+    buf, count, dth, msg_addr, req_addr = a[0], a[1], a[2], a[3], a[4]
+    mh = ctypes.cast(int(msg_addr), _pi32)[0] if msg_addr else 0
+    _write_i32(msg_addr, 0)
+    if mh == C_MESSAGE_NO_PROC:
+        # a real, already-complete request whose wait/test yields the
+        # proc-null status (mprobe.c:268 demands a non-null handle)
+        _write_i32(req_addr, _new_req_handle(ctx, _CDoneReq(
+            C_PROC_NULL, C_ANY_TAG, 0)))
+        return MPI_SUCCESS
+    m = ctx.messages.pop(mh, None)
+    if m is None:
+        return MPI_ERR_REQUEST
+    dt = _dt(ctx, dth)
+    h = _new_req_handle(ctx, _CReq(m.req, int(buf), m.arr, "recv", dt,
+                                   cap=int(count) * dt.size_))
+    _write_i32(req_addr, h)
+    return MPI_SUCCESS
+
+
+class _CDoneReq:
+    """An already-completed request with a canned status (the Imrecv-
+    on-MESSAGE_NO_PROC handle)."""
+    __slots__ = ("src", "tag", "nbytes")
+    kind = "done"
+
+    def __init__(self, src, tag, nbytes):
+        self.src = src
+        self.tag = tag
+        self.nbytes = nbytes
+
+
+# -- generalized requests (MPI-2 §8.2; reference smpi_request.cpp
+#    generalized request support) ------------------------------------------
+
+_GREQ_QUERY = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p,
+                               ctypes.c_void_p)
+_GREQ_FREE = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)
+_GREQ_CANCEL = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p,
+                                ctypes.c_int)
+
+
+class _CGreq:
+    """Completion is driven by the app via MPI_Grequest_complete;
+    wait/test call the C query/free callbacks on retirement."""
+    __slots__ = ("query", "free", "cancel", "extra", "complete")
+    kind = "greq"
+
+    def __init__(self, q, f, c, extra):
+        self.query = _GREQ_QUERY(int(q)) if q else None
+        self.free = _GREQ_FREE(int(f)) if f else None
+        self.cancel = _GREQ_CANCEL(int(c)) if c else None
+        self.extra = int(extra) if extra else None
+        self.complete = False
+
+
+def _greq_retire(ctx, h, g: _CGreq, st_addr) -> int:
+    buf = (ctypes.c_ubyte * _STATUS_BYTES)()
+    _set_status(ctypes.addressof(buf), C_ANY_SOURCE, C_ANY_TAG,
+                MPI_SUCCESS, 0)
+    rc = MPI_SUCCESS
+    if g.query is not None:
+        rc = int(g.query(g.extra, ctypes.addressof(buf)))
+    if st_addr:
+        ctypes.memmove(int(st_addr), buf, _STATUS_BYTES)
+    if g.free is not None:
+        frc = int(g.free(g.extra))
+        if rc == MPI_SUCCESS:
+            rc = frc
+    ctx.reqs.pop(h, None)
+    return rc
+
+
+def _greq_block(g: _CGreq) -> None:
+    from ..s4u import this_actor
+    while not g.complete:
+        this_actor.sleep_for(1e-4)
+
+
+def _greq_query_into(g: _CGreq, status: Status) -> int:
+    """Run the C query callback into a scratch status and lift the
+    result into the Python Status (query may run several times;
+    MPI-2 §8.2 allows it)."""
+    buf = (ctypes.c_ubyte * _STATUS_BYTES)()
+    _set_status(ctypes.addressof(buf), C_ANY_SOURCE, C_ANY_TAG,
+                MPI_SUCCESS, 0, keep_error=False)
+    rc = MPI_SUCCESS
+    if g.query is not None:
+        rc = int(g.query(g.extra, ctypes.addressof(buf)))
+    p = ctypes.cast(ctypes.addressof(buf), _pi32)
+    status.source = p[0]
+    status.tag = p[1]
+    status.cancelled = bool(p[3])
+    status.count = ctypes.cast(ctypes.addressof(buf) + 16, _pi64)[0]
+    return rc
+
+
+def _greq_finalize(ctx, h, g: _CGreq, status: Status) -> int:
+    """Retire a completed greq through the Python-Status paths
+    (waitany/testany/testall/waitsome): query + free exactly once."""
+    rc = _greq_query_into(g, status)
+    if g.free is not None:
+        frc = int(g.free(g.extra))
+        if rc == MPI_SUCCESS:
+            rc = frc
+    ctx.reqs.pop(h, None)
+    return rc
+
+
+def _h_grequest_start(ctx, a):
+    q, f, c, extra, req_addr = a[0], a[1], a[2], a[3], a[4]
+    _write_i32(req_addr, _new_req_handle(ctx, _CGreq(q, f, c, extra)))
+    return MPI_SUCCESS
+
+
+def _h_grequest_complete(ctx, a):
+    g = ctx.reqs.get(int(a[0]))
+    if not isinstance(g, _CGreq):
+        return MPI_ERR_REQUEST
+    g.complete = True
     return MPI_SUCCESS
 
 
@@ -1211,7 +1557,9 @@ def _h_startall(ctx, a):
 
 def _h_request_free(ctx, a):
     h = ctypes.cast(int(a[0]), _pi32)[0] if a[0] else 0
-    ctx.reqs.pop(int(h), None)
+    entry = ctx.reqs.pop(int(h), None)
+    if isinstance(entry, _CGreq) and entry.free is not None:
+        entry.free(entry.extra)
     _write_i32(a[0], 0)
     return MPI_SUCCESS
 
@@ -1251,12 +1599,14 @@ def _h_get_count(ctx, a):
     if st_addr == 0:
         _write_i32(count_addr, 0)
         return MPI_SUCCESS
-    nbytes = ctypes.cast(int(st_addr), _pi32)[3]
+    nbytes = ctypes.cast(int(st_addr) + 16, _pi64)[0]
     dt = _dt(ctx, dth)
     if not dt.size_:
         _write_i32(count_addr, 0 if nbytes == 0 else C_UNDEFINED)
-    elif nbytes % dt.size_:
-        _write_i32(count_addr, C_UNDEFINED)   # partial element received
+    elif nbytes % dt.size_ or nbytes // dt.size_ > 2**31 - 1:
+        # partial element, or a count that does not fit an int
+        # (MPI-3 §3.2.5: MPI_UNDEFINED in both cases)
+        _write_i32(count_addr, C_UNDEFINED)
     else:
         _write_i32(count_addr, nbytes // dt.size_)
     return MPI_SUCCESS
@@ -2445,10 +2795,6 @@ def _leaf_dt(dt: Datatype) -> Datatype:
         dt = dt.c_env_types[0]
         depth += 1
     return dt
-
-
-def _rma_target_args(entry, tdisp, tcount, tdt):
-    return (int(tdisp), int(tcount), tdt)
 
 
 def _h_rma_put(ctx, a, with_req=False):
@@ -3861,7 +4207,12 @@ def _h_cancel(ctx, a):
     entry = ctx.reqs.get(int(h))
     if entry is None:
         return MPI_ERR_REQUEST
-    req = entry.inner if isinstance(entry, _CPersist) else entry.req
+    if isinstance(entry, _CGreq):
+        if entry.cancel is not None:
+            entry.cancel(entry.extra, 1 if entry.complete else 0)
+        return MPI_SUCCESS
+    creq = entry.inner if isinstance(entry, _CPersist) else entry
+    req = getattr(creq, "req", None)
     if req is not None and hasattr(req, "cancel"):
         req.cancel()
     return MPI_SUCCESS
@@ -3960,19 +4311,19 @@ def _h_get_elements(ctx, a):
         if mode == 1:
             _write_i64(count_addr, v)
         else:
-            _write_i32(count_addr, v)
+            # Get_elements returns int: overflow -> MPI_UNDEFINED
+            _write_i32(count_addr, v if v <= 2**31 - 1 else C_UNDEFINED)
 
     if mode == 2:                # MPI_Status_set_elements(_x)
         dt = _dt(ctx, dth)
         n = ctypes.cast(int(count_addr), _pi64)[0]
         if st_addr:
-            ctypes.cast(int(st_addr), _pi32)[3] = \
-                int(min(n * dt.size_, 2**31 - 1))
+            ctypes.cast(int(st_addr) + 16, _pi64)[0] = int(n * dt.size_)
         return MPI_SUCCESS
     if st_addr == 0:
         put(0)
         return MPI_SUCCESS
-    nbytes = ctypes.cast(int(st_addr), _pi32)[3]
+    nbytes = ctypes.cast(int(st_addr) + 16, _pi64)[0]
     dt = _dt(ctx, dth)
     basics = _basics_of(dt)
     if not basics or nbytes <= 0:
@@ -4219,6 +4570,9 @@ _HANDLERS = {
     191: _h_win_keyval_create, 192: _h_win_keyval_free,
     193: _h_win_delete_attr, 194: _h_win_set_errhandler,
     195: _h_win_get_errhandler, 196: _h_win_call_errhandler,
+    # matched probe + generalized requests
+    197: _h_mprobe, 198: _h_improbe, 199: _h_mrecv, 200: _h_imrecv,
+    201: _h_grequest_start, 202: _h_grequest_complete,
 }
 
 #: ops that are pure local queries — no bench end/begin cycle needed
@@ -4229,7 +4583,7 @@ _LOCAL_OPS = {3, 4, 24, 41, 42, 45, 46, 48, 50, 51, 63, 64, 66, 69,
               97, 98, 99, 101, 102, 103, 129, 130, 131, 132, 133,
               134, 135, 136, 137, 139, 140, 141, 142,
               171, 172, 173, 188, 189, 190, 191, 192, 193, 194, 195,
-              196}
+              196, 201, 202}
 
 
 def _dispatch_py(opcode: int, args) -> int:
@@ -4328,6 +4682,11 @@ def run_c_program(program_so: str, np_ranks: Optional[int] = None,
     a private copy of `program_so` (per-rank globals) and running its
     renamed main. Returns (engine, exit_codes)."""
     tmpdir = tempfile.mkdtemp(prefix="smpi-priv-")
+    # C mains put real arrays on the actor stack (mpich3 bsendfrag:
+    # 4 x 68 KB locals); default to the reference's 8 MiB stacks
+    # (sg_config.cpp contexts/stack-size) unless the caller chose one
+    if not any("contexts/stack-size" in c for c in configs):
+        configs = ("contexts/stack-size:8388608", *configs)
     exit_codes: Dict[int, int] = {}
     _ctxs.clear()
     _c_shared_blocks.clear()

@@ -117,8 +117,12 @@ def render_layout(fmt: str, category: str, level_name: str,
         elif code == "a":
             out.append(context_getter() if context_getter else "")
         elif code in "iPh":
+            # before any engine exists, the context IS maestro (the
+            # reference prints "maestro" for --cfg lines emitted during
+            # sg_config parsing, ahead of engine construction)
             pid, aname, hname = (actor_info_getter()
-                                 if actor_info_getter else (0, "", ""))
+                                 if actor_info_getter
+                                 else (0, "maestro", ""))
             out.append(str(pid) if code == "i"
                        else aname if code == "P" else hname)
         elif code == "%":

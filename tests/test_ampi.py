@@ -1,0 +1,127 @@
+"""AMPI load-balancing plugin tests (reference
+src/smpi/plugins/sampi_loadbalancer.cpp + load_balancer/LoadBalancer.cpp):
+the greedy balancer's reassignment decisions on a synthetic imbalance,
+and an end-to-end AMPI_Migrate over smpirun that actually moves ranks
+off an overloaded host."""
+
+import os
+
+import pytest
+
+from simgrid_tpu import s4u
+from simgrid_tpu.smpi import ampi, runtime
+from simgrid_tpu.smpi.ampi import LoadBalancer
+
+
+@pytest.fixture(autouse=True)
+def fresh_engine():
+    s4u.Engine._reset()
+    ampi._memory_size.clear()
+    ampi._migration_calls.clear()
+    ampi.lb.actor_computation.clear()
+    ampi.lb.new_mapping.clear()
+    yield
+    s4u.Engine._reset()
+
+
+class _StubActor:
+    def __init__(self, pid, host):
+        self.pid = pid
+        self.host = host
+        self.daemonized = False
+
+
+class _StubHost:
+    def __init__(self, name):
+        self.name = name
+        self.actor_list = []
+
+    def is_on(self):
+        return True
+
+
+class _StubEngine:
+    def __init__(self, hosts):
+        self._hosts = hosts
+
+    def get_all_hosts(self):
+        return self._hosts
+
+
+def test_greedy_balancer_spreads_heavy_actors():
+    """4 actors (two heavy) on one host + an idle host: the balancer
+    must move load to the idle host but never empty the origin."""
+    h0, h1 = _StubHost("h0"), _StubHost("h1")
+    actors = [_StubActor(pid, h0) for pid in (1, 2, 3, 4)]
+    h0.actor_list = list(actors)
+    lb = LoadBalancer()
+    for pid, load in ((1, 100.0), (2, 90.0), (3, 1.0), (4, 1.0)):
+        lb.record_actor_computation(pid, load)
+    lb.run(_StubEngine([h0, h1]))
+    moved = [a for a in actors if lb.get_mapping(a) is h1]
+    stayed = [a for a in actors if lb.get_mapping(a) is h0]
+    assert moved, "the idle host must receive load"
+    assert stayed, "the origin host must not be emptied"
+    # the heaviest actor moves first to the empty host
+    assert actors[0] in moved
+
+
+def test_balancer_noop_when_balanced():
+    h0, h1 = _StubHost("h0"), _StubHost("h1")
+    a0, a1 = _StubActor(1, h0), _StubActor(2, h1)
+    h0.actor_list, h1.actor_list = [a0], [a1]
+    lb = LoadBalancer()
+    lb.record_actor_computation(1, 50.0)
+    lb.record_actor_computation(2, 50.0)
+    lb.run(_StubEngine([h0, h1]))
+    assert lb.get_mapping(a0) is h0
+    assert lb.get_mapping(a1) is h1
+
+
+PLATFORM = """<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <host id="host1" speed="1Gf"/>
+    <host id="host2" speed="1Gf"/>
+    <host id="host3" speed="1Gf"/>
+    <host id="host4" speed="1Gf"/>
+    <link id="l" bandwidth="1GBps" latency="1ms"/>
+    <route src="host1" dst="host2"><link_ctn id="l"/></route>
+    <route src="host1" dst="host3"><link_ctn id="l"/></route>
+    <route src="host1" dst="host4"><link_ctn id="l"/></route>
+    <route src="host2" dst="host3"><link_ctn id="l"/></route>
+    <route src="host2" dst="host4"><link_ctn id="l"/></route>
+    <route src="host3" dst="host4"><link_ctn id="l"/></route>
+  </zone>
+</platform>"""
+
+_final_hosts = {}
+
+
+def _rank_main():
+    from simgrid_tpu.s4u import this_actor
+
+    comm = runtime.world()
+    rank = comm.rank()
+    if rank == 0:
+        ampi.sg_load_balancer_plugin_init()
+    comm.barrier()
+    ampi.ampi_malloc(this_actor.get_pid(), 4096 * (rank + 1))
+    # skewed computation so the balancer has something to observe
+    this_actor.execute(1e8 * (rank + 1))
+    ampi.AMPI_Migrate(comm)
+    _final_hosts[rank] = this_actor.get_host().name
+
+
+def test_ampi_migrate_moves_ranks(tmp_path):
+    path = os.path.join(tmp_path, "p.xml")
+    with open(path, "w") as f:
+        f.write(PLATFORM)
+    _final_hosts.clear()
+    runtime.smpirun(
+        _rank_main, platform=path, np=4, hosts=["host1"] * 4,
+        configs=("host/model:ptask_L07",
+                 "smpi/plugin/lb/migration-frequency:1"))
+    assert len(_final_hosts) == 4
+    assert len(set(_final_hosts.values())) > 1, \
+        f"migration must spread ranks off host1: {_final_hosts}"

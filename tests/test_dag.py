@@ -177,3 +177,72 @@ def test_dax_end_to_end_schedule_and_run(engine):
                         t.successors[0].hosts[0]])
     sd2.simulate()
     assert sd2.makespan() == makespan
+
+
+# -- DOT loader (sd_dotloader.cpp) -----------------------------------------
+
+DOTDIR = "/root/reference/examples/deprecated/simdag/dag-dotload"
+SCHEDDIR = "/root/reference/examples/deprecated/simdag/schedule-dotload"
+
+
+def _by_name(tasks):
+    return {t.name: t for t in tasks}
+
+
+@needs_reference
+def test_dotload_reference_dag():
+    """Structure pinned by sd_dag-dotload.tesh: root feeds 0 and the
+    root->5 transfer; edges with size<=0 are plain dependencies."""
+    tasks = dag.load_dot(f"{DOTDIR}/dag.dot")
+    t = _by_name(tasks)
+    assert [tasks[0].name, tasks[-1].name] == ["root", "end"]
+    assert tasks[0].state == TaskState.SCHEDULABLE
+    assert {s.name for s in t["root"].successors} == {"0", "root->5"}
+    assert [p.name for p in t["0"].predecessors] == ["root"]
+    assert [s.name for s in t["0"].successors] == ["0->1"]
+    # 3->4 has size="-1", 5->6 size="0.0", 8->9 none: plain dependencies
+    assert t["4"] in t["3"].successors
+    assert t["6"] in t["5"].successors
+    assert t["9"] in t["8"].successors
+    assert t["0->1"].kind == TaskKind.COMM_E2E
+    assert t["0->1"].amount == pytest.approx(10001.389601075407)
+    # declared end node keeps its declared size
+    assert t["end"].amount == pytest.approx(10000000129.452715)
+
+
+@needs_reference
+def test_dotload_cycle_returns_none():
+    assert dag.load_dot(f"{DOTDIR}/dag_with_cycle.dot") is None
+
+
+@needs_reference
+def test_dotload_with_schedule(engine):
+    hosts = engine.get_all_hosts()
+    tasks = dag.load_dot(f"{SCHEDDIR}/dag_with_good_schedule.dot",
+                         schedule=True, hosts=hosts)
+    assert tasks is not None
+    scheduled = [t for t in tasks if t.state == TaskState.SCHEDULED]
+    assert scheduled, "a good schedule must place the tasks"
+    assert all(len(t.hosts) == 1 for t in scheduled)
+    bad = dag.load_dot(f"{SCHEDDIR}/dag_with_bad_schedule.dot",
+                       schedule=True, hosts=hosts)
+    assert bad is None
+
+
+@needs_reference
+def test_dotload_simulates(engine):
+    """The loaded DAG runs end-to-end under the greedy scheduler."""
+    tasks = dag.load_dot(f"{DOTDIR}/dag.dot")
+    hosts = engine.get_all_hosts()
+    de = dag.DagEngine(engine)
+    de.add(*tasks)
+    i = 0
+    for task in tasks:
+        if task.kind == TaskKind.COMP_SEQ and not task.hosts:
+            task.schedule([hosts[i % len(hosts)]])
+            i += 1
+        elif task.kind == TaskKind.COMM_E2E:
+            task.schedule([hosts[0], hosts[1]])
+    done = de.simulate()
+    assert all(t.state == TaskState.DONE for t in done)
+    assert de.makespan() > 0

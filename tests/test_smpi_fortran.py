@@ -38,7 +38,7 @@ extern double mpi_wtime_(void);
 int main(int argc, char** argv) {
     int ierr, rank, size, comm = F_COMM_WORLD;
     int one = 1, tag = 7, dtype = F_INTEGER;
-    int status[5];
+    int status[6];    /* MPI_STATUS_SIZE: 24-byte MPI_Status as ints */
     mpi_init_(&ierr);
     mpi_comm_rank_(&comm, &rank, &ierr);
     mpi_comm_size_(&comm, &size, &ierr);

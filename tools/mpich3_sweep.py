@@ -23,6 +23,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # banner; for those alone a clean exit with no error markers passes
 OUTPUT_ONLY = {"zero-blklen-vector", "zeroblks"}
 
+# per-test config overrides: tests that busy-wait on MPI_Wtime need the
+# bench clock (simulate-computation) to advance simulated time
+TEST_CONFIGS = {
+    "bsendpending": ("smpi/simulate-computation:true",),
+}
+
 
 def main() -> int:
     ap = argparse.ArgumentParser()
@@ -39,7 +45,9 @@ def main() -> int:
     np_of = {}
     try:
         for line in open(f"{M}/{d}/testlist"):
-            parts = line.split()
+            # honour np hints on commented-out entries too
+            # (pt2pt/testlist:47 "#large_message 3")
+            parts = line.lstrip("#").split()
             if len(parts) >= 2 and parts[1].isdigit():
                 np_of.setdefault(parts[0], int(parts[1]))
     except FileNotFoundError:
@@ -54,7 +62,9 @@ def main() -> int:
 
     def run_test(src: str) -> None:
         name = os.path.basename(src)[:-2]
-        np_ranks = np_of.get(name, 4)
+        np_ranks = np_of.get(name, 2)   # MPICH runtests default: 2
+        cfgs = TEST_CONFIGS.get(name,
+                                ("smpi/simulate-computation:false",))
         code = f"""
 import sys; sys.path.insert(0, {REPO!r})
 from simgrid_tpu.smpi.c_api import compile_program, run_c_program
@@ -62,7 +72,7 @@ compile_program([{src!r}, "{M}/util/mtest.c", "{M}/util/mtest_datatype.c",
                  "{M}/util/mtest_datatype_gen.c"],
                 "/tmp/mpich3/{d}-{name}.so", extra_flags=["-I{M}/include"])
 engine, codes = run_c_program("/tmp/mpich3/{d}-{name}.so",
-    np_ranks={np_ranks}, configs=("smpi/simulate-computation:false",))
+    np_ranks={np_ranks}, configs={cfgs!r})
 assert all(c == 0 for c in codes.values()), codes
 """
         try:

