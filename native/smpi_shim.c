@@ -234,6 +234,21 @@ enum {
   SMPI_OP_IMRECV,             /* 200 */
   SMPI_OP_GREQUEST_START,
   SMPI_OP_GREQUEST_COMPLETE,
+  SMPI_OP_TYPE_KEYVAL_CREATE, /* 203 */
+  SMPI_OP_TYPE_SET_ATTR,
+  SMPI_OP_TYPE_GET_ATTR,
+  SMPI_OP_TYPE_DELETE_ATTR,
+  SMPI_OP_ERRHANDLER_CREATE,  /* 207 */
+  SMPI_OP_ERRHANDLER_FREE,
+  SMPI_OP_COMM_SET_ERRHANDLER,
+  SMPI_OP_COMM_GET_ERRHANDLER, /* 210 */
+  SMPI_OP_COMM_CALL_ERRHANDLER,
+  SMPI_OP_ADD_ERROR_CLASS,
+  SMPI_OP_ADD_ERROR_CODE,
+  SMPI_OP_ADD_ERROR_STRING,
+  SMPI_OP_ERROR_STRING,       /* 215 */
+  SMPI_OP_ERROR_CLASS,
+  SMPI_OP_OP_COMMUTATIVE,
 };
 
 /* sub-modes for FILE_READ / FILE_WRITE */
@@ -270,13 +285,19 @@ int MPI_Get_processor_name(char* name, int* resultlen) {
   CALL(SMPI_OP_GET_PROCESSOR_NAME, A(name), A(resultlen));
 }
 int MPI_Error_string(int errorcode, char* string, int* resultlen) {
-  static const char msg[] = "MPI error";
-  int i = 0;
-  (void)errorcode;
-  for (; msg[i]; i++) string[i] = msg[i];
-  string[i] = 0;
-  *resultlen = i;
-  return MPI_SUCCESS;
+  if (smpi_dispatch) {
+    smpi_arg_t args_[] = {A(errorcode), A(string), A(resultlen)};
+    return smpi_dispatch(SMPI_OP_ERROR_STRING, args_);
+  }
+  {
+    static const char msg[] = "MPI error";
+    int i = 0;
+    (void)errorcode;
+    for (; msg[i]; i++) string[i] = msg[i];
+    string[i] = 0;
+    *resultlen = i;
+    return MPI_SUCCESS;
+  }
 }
 int MPI_Get_address(const void* location, MPI_Aint* address) {
   *address = (MPI_Aint)location;
@@ -290,9 +311,58 @@ int MPI_Request_get_status(MPI_Request request, int* flag,
   CALL(SMPI_OP_REQUEST_GET_STATUS, A(request), A(flag), A(status));
 }
 int MPI_Get_version(int* version, int* subversion) {
-  *version = 2;
-  *subversion = 2;
+  *version = MPI_VERSION;
+  *subversion = MPI_SUBVERSION;
   return MPI_SUCCESS;
+}
+int MPI_Get_library_version(char* version, int* resultlen) {
+  static const char msg[] =
+      "simgrid-tpu SMPI (MPI 3.1 subset over a simulated platform)";
+  int i = 0;
+  for (; msg[i]; i++) version[i] = msg[i];
+  version[i] = 0;
+  *resultlen = i;
+  return MPI_SUCCESS;
+}
+int MPI_Is_thread_main(int* flag) {
+  /* every simulated rank is its own main thread */
+  if (flag) *flag = 1;
+  return MPI_SUCCESS;
+}
+int MPI_Comm_create_errhandler(MPI_Comm_errhandler_function* fn,
+                               MPI_Errhandler* errhandler) {
+  CALL(SMPI_OP_ERRHANDLER_CREATE, A(fn), A(errhandler));
+}
+int MPI_Errhandler_create(MPI_Handler_function* fn,
+                          MPI_Errhandler* errhandler) {
+  return MPI_Comm_create_errhandler(fn, errhandler);
+}
+int MPI_Errhandler_free(MPI_Errhandler* errhandler) {
+  CALL(SMPI_OP_ERRHANDLER_FREE, A(errhandler));
+}
+int MPI_Comm_set_errhandler(MPI_Comm comm, MPI_Errhandler errhandler) {
+  CALL(SMPI_OP_COMM_SET_ERRHANDLER, A(comm), A(errhandler));
+}
+int MPI_Errhandler_set(MPI_Comm comm, MPI_Errhandler errhandler) {
+  return MPI_Comm_set_errhandler(comm, errhandler);
+}
+int MPI_Comm_get_errhandler(MPI_Comm comm, MPI_Errhandler* errhandler) {
+  CALL(SMPI_OP_COMM_GET_ERRHANDLER, A(comm), A(errhandler));
+}
+int MPI_Errhandler_get(MPI_Comm comm, MPI_Errhandler* errhandler) {
+  return MPI_Comm_get_errhandler(comm, errhandler);
+}
+int MPI_Comm_call_errhandler(MPI_Comm comm, int errorcode) {
+  CALL(SMPI_OP_COMM_CALL_ERRHANDLER, A(comm), A(errorcode));
+}
+int MPI_Add_error_class(int* errorclass) {
+  CALL(SMPI_OP_ADD_ERROR_CLASS, A(errorclass));
+}
+int MPI_Add_error_code(int errorclass, int* errorcode) {
+  CALL(SMPI_OP_ADD_ERROR_CODE, A(errorclass), A(errorcode));
+}
+int MPI_Add_error_string(int errorcode, const char* string) {
+  CALL(SMPI_OP_ADD_ERROR_STRING, A(errorcode), A(string));
 }
 
 /* -- communicators ------------------------------------------------------- */
@@ -628,6 +698,9 @@ int MPI_Type_free(MPI_Datatype* datatype) {
 }
 
 /* -- reduction ops ---------------------------------------------------------- */
+int MPI_Op_commutative(MPI_Op op, int* commute) {
+  CALL(SMPI_OP_OP_COMMUTATIVE, A(op), A(commute));
+}
 int MPI_Op_create(MPI_User_function* fn, int commute, MPI_Op* op) {
   CALL(SMPI_OP_OP_CREATE, A(fn), A(commute), A(op));
 }
@@ -767,6 +840,10 @@ int MPI_Free_mem(void* base) {
   return MPI_SUCCESS;
 }
 int MPI_Error_class(int errorcode, int* errorclass) {
+  if (smpi_dispatch) {
+    smpi_arg_t args_[] = {A(errorcode), A(errorclass)};
+    return smpi_dispatch(SMPI_OP_ERROR_CLASS, args_);
+  }
   *errorclass = errorcode;
   return MPI_SUCCESS;
 }
@@ -981,7 +1058,7 @@ int MPI_Group_translate_ranks(MPI_Group group1, int n, const int* ranks1,
 int MPI_Group_compare(MPI_Group group1, MPI_Group group2, int* result) {
   CALL(SMPI_OP_GROUP_COMPARE, A(group1), A(group2), A(result));
 }
-static int smpi_info_counter = 1;
+static int smpi_info_counter = 2; /* 1 is MPI_INFO_ENV (empty) */
 /* Info objects are a pure C-side key/value store: the simulation kernel
  * treats hints as opaque, so no dispatch round-trip is needed (the
  * reference's smpi_info.cpp is likewise a plain std::map). */
@@ -990,12 +1067,25 @@ typedef struct smpi_info_kv {
   char val[MPI_MAX_INFO_VAL + 1];
   struct smpi_info_kv* next;
 } smpi_info_kv;
-#define SMPI_INFO_CAP 1024
-static smpi_info_kv* smpi_info_store[SMPI_INFO_CAP];
+static smpi_info_kv** smpi_info_store = 0;
+static int smpi_info_cap = 0;
+/* grow-on-demand handle table: info/infomany creates thousands */
+static int smpi_info_ok(int h) {
+  if (h <= 0 || h >= smpi_info_counter) return 0;
+  if (h >= smpi_info_cap) {
+    int i, ncap = smpi_info_cap ? smpi_info_cap * 2 : 1024;
+    while (ncap <= h) ncap *= 2;
+    smpi_info_store =
+        (smpi_info_kv**)realloc(smpi_info_store, ncap * sizeof(*smpi_info_store));
+    for (i = smpi_info_cap; i < ncap; i++) smpi_info_store[i] = 0;
+    smpi_info_cap = ncap;
+  }
+  return 1;
+}
 
 int MPI_Info_create(MPI_Info* info) {
   *info = smpi_info_counter++;
-  if (*info < SMPI_INFO_CAP) smpi_info_store[*info] = 0;
+  if (smpi_info_ok(*info)) smpi_info_store[*info] = 0;
   return MPI_SUCCESS;
 }
 static int smpi_strcpy_n(char* dst, const char* src, int cap) {
@@ -1010,7 +1100,7 @@ static int smpi_streq(const char* a, const char* b) {
 }
 int MPI_Info_set(MPI_Info info, const char* key, const char* value) {
   smpi_info_kv* kv;
-  if (info <= 0 || info >= SMPI_INFO_CAP) return MPI_ERR_INFO;
+  if (!smpi_info_ok(info)) return MPI_ERR_INFO;
   for (kv = smpi_info_store[info]; kv; kv = kv->next)
     if (smpi_streq(kv->key, key)) {
       smpi_strcpy_n(kv->val, value, MPI_MAX_INFO_VAL);
@@ -1031,7 +1121,7 @@ int MPI_Info_set(MPI_Info info, const char* key, const char* value) {
 }
 static smpi_info_kv* smpi_info_find(MPI_Info info, const char* key) {
   smpi_info_kv* kv;
-  if (info <= 0 || info >= SMPI_INFO_CAP) return 0;
+  if (!smpi_info_ok(info)) return 0;
   for (kv = smpi_info_store[info]; kv; kv = kv->next)
     if (smpi_streq(kv->key, key)) return kv;
   return 0;
@@ -1057,14 +1147,14 @@ int MPI_Info_get_valuelen(MPI_Info info, const char* key, int* valuelen,
 int MPI_Info_get_nkeys(MPI_Info info, int* nkeys) {
   int n = 0;
   smpi_info_kv* kv;
-  if (info <= 0 || info >= SMPI_INFO_CAP) return MPI_ERR_INFO;
+  if (!smpi_info_ok(info)) return MPI_ERR_INFO;
   for (kv = smpi_info_store[info]; kv; kv = kv->next) n++;
   *nkeys = n;
   return MPI_SUCCESS;
 }
 int MPI_Info_get_nthkey(MPI_Info info, int n, char* key) {
   smpi_info_kv* kv;
-  if (info <= 0 || info >= SMPI_INFO_CAP) return MPI_ERR_INFO;
+  if (!smpi_info_ok(info)) return MPI_ERR_INFO;
   kv = smpi_info_store[info];
   while (n-- > 0 && kv) kv = kv->next;
   if (!kv) return MPI_ERR_ARG;
@@ -1073,7 +1163,7 @@ int MPI_Info_get_nthkey(MPI_Info info, int n, char* key) {
 }
 int MPI_Info_delete(MPI_Info info, const char* key) {
   smpi_info_kv **p, *kv;
-  if (info <= 0 || info >= SMPI_INFO_CAP) return MPI_ERR_INFO;
+  if (!smpi_info_ok(info)) return MPI_ERR_INFO;
   for (p = &smpi_info_store[info]; (kv = *p); p = &kv->next)
     if (smpi_streq(kv->key, key)) {
       *p = kv->next;
@@ -1085,13 +1175,13 @@ int MPI_Info_delete(MPI_Info info, const char* key) {
 int MPI_Info_dup(MPI_Info info, MPI_Info* newinfo) {
   smpi_info_kv* kv;
   MPI_Info_create(newinfo);
-  if (info > 0 && info < SMPI_INFO_CAP)
+  if (smpi_info_ok(info))
     for (kv = smpi_info_store[info]; kv; kv = kv->next)
       MPI_Info_set(*newinfo, kv->key, kv->val);
   return MPI_SUCCESS;
 }
 int MPI_Info_free(MPI_Info* info) {
-  if (*info > 0 && *info < SMPI_INFO_CAP) {
+  if (smpi_info_ok(*info)) {
     smpi_info_kv* kv = smpi_info_store[*info];
     while (kv) {
       smpi_info_kv* next = kv->next;
@@ -1126,8 +1216,36 @@ int MPI_Group_range_incl(MPI_Group group, int n, int ranges[][3],
 int MPI_Comm_create_keyval(MPI_Comm_copy_attr_function* copy_fn,
                            MPI_Comm_delete_attr_function* delete_fn,
                            int* keyval, void* extra_state) {
-  (void)copy_fn; (void)delete_fn; (void)extra_state;
-  CALL(SMPI_OP_KEYVAL_CREATE, A(keyval));
+  CALL(SMPI_OP_KEYVAL_CREATE, A(copy_fn), A(delete_fn), A(keyval),
+       A(extra_state));
+}
+/* the portable dup fn (reference smpi_keyvals.hpp exposes it the same
+   way: copies the value verbatim and accepts the copy) */
+int MPI_DUP_FN(MPI_Comm oldcomm, int keyval, void* extra_state,
+               void* attribute_val_in, void* attribute_val_out, int* flag) {
+  (void)oldcomm; (void)keyval; (void)extra_state;
+  *(void**)attribute_val_out = attribute_val_in;
+  *flag = 1;
+  return MPI_SUCCESS;
+}
+int MPI_Type_create_keyval(MPI_Type_copy_attr_function* copy_fn,
+                           MPI_Type_delete_attr_function* delete_fn,
+                           int* keyval, void* extra_state) {
+  CALL(SMPI_OP_TYPE_KEYVAL_CREATE, A(copy_fn), A(delete_fn), A(keyval),
+       A(extra_state));
+}
+int MPI_Type_free_keyval(int* keyval) {
+  CALL(SMPI_OP_KEYVAL_FREE, A(keyval));
+}
+int MPI_Type_set_attr(MPI_Datatype type, int keyval, void* value) {
+  CALL(SMPI_OP_TYPE_SET_ATTR, A(type), A(keyval), A(value));
+}
+int MPI_Type_get_attr(MPI_Datatype type, int keyval, void* value,
+                      int* flag) {
+  CALL(SMPI_OP_TYPE_GET_ATTR, A(type), A(keyval), A(value), A(flag));
+}
+int MPI_Type_delete_attr(MPI_Datatype type, int keyval) {
+  CALL(SMPI_OP_TYPE_DELETE_ATTR, A(type), A(keyval));
 }
 int MPI_Comm_free_keyval(int* keyval) {
   CALL(SMPI_OP_KEYVAL_FREE, A(keyval));
@@ -1355,9 +1473,7 @@ int MPI_Win_get_errhandler(MPI_Win win, MPI_Errhandler* errhandler) {
 }
 int MPI_Win_create_errhandler(MPI_Win_errhandler_function* fn,
                               MPI_Errhandler* errhandler) {
-  (void)fn;
-  if (errhandler) *errhandler = 3; /* user win errhandler (opaque) */
-  return MPI_SUCCESS;
+  CALL(SMPI_OP_ERRHANDLER_CREATE, A(fn), A(errhandler));
 }
 int MPI_Win_call_errhandler(MPI_Win win, int errorcode) {
   CALL(SMPI_OP_WIN_CALL_ERRHANDLER, A(win), A(errorcode));

@@ -256,11 +256,18 @@ class _CRankCtx:
         self.next_group = 10
         self.files: Dict[int, object] = {}
         self.next_file = 1
-        self.comm_attrs: Dict = {}
+        self.comm_attrs: Dict[int, Dict[int, int]] = {}
+        self.type_attrs: Dict[int, Dict[int, int]] = {}
+        self.keyvals: Dict[int, dict] = {}    # unified comm/type/win
         self.next_keyval = 64
+        self.errhandlers: Dict[int, int] = {}  # handle -> C fn addr
+        self.next_errh = 10       # 0=NULL 1=RETURN 2=FATAL predefined
+        self.comm_errh: Dict[int, int] = {}
+        self.user_err_strings: Dict[int, str] = {}
+        self.user_err_class: Dict[int, int] = {}  # dyn code -> class
+        self.last_used_code = 74  # MPI_ERR_LASTCODE (mpi.h:245)
         self.wins: Dict[int, dict] = {}
         self.next_win = 1
-        self.win_keyvals: Dict[int, tuple] = {}
         self.messages: Dict[int, object] = {}     # MPI_Mprobe plucks
         self.next_msg = 1
         self.cart_topos: Dict[int, object] = {}
@@ -539,12 +546,17 @@ def _comm_of(ctx: _CRankCtx, handle: int) -> Optional[Comm]:
     return ctx.comms.get(handle)
 
 
-def _new_comm_handle(ctx: _CRankCtx, comm: Optional[Comm]) -> int:
+def _new_comm_handle(ctx: _CRankCtx, comm: Optional[Comm],
+                     parent: Optional[int] = None) -> int:
     if comm is None:
         return COMM_NULL
     h = ctx.next_comm
     ctx.next_comm += 1
     ctx.comms[h] = comm
+    # every comm-creating call propagates the parent's error handler
+    # (MPI-3 §8.3.1; Comm_dup additionally copies attributes)
+    if parent is not None and int(parent) in ctx.comm_errh:
+        ctx.comm_errh[h] = ctx.comm_errh[int(parent)]
     return h
 
 
@@ -701,6 +713,13 @@ def _h_init(ctx, a):
 
 
 def _h_finalize(ctx, a):
+    # delete callbacks fire on COMM_SELF then COMM_WORLD attrs at the
+    # very beginning of MPI_Finalize (MPI-2 §4.8 "at_exit" idiom,
+    # attr/attrend — the reference skips this; we support it)
+    for ch in (COMM_SELF, COMM_WORLD):
+        store = ctx.comm_attrs.get(ch)
+        if store:
+            _attrs_free_all(ctx, store, ch, lifo=True)
     ctx.finalized = True
     return MPI_SUCCESS
 
@@ -745,9 +764,21 @@ def _h_comm_dup(ctx, a):
     comm = _comm_of(ctx, a[0])
     if comm is None:
         return MPI_ERR_COMM
-    h = _new_comm_handle(ctx, comm.dup())
-    # MPI_Comm_dup propagates the topology (MPI-3 §6.4.2; topo/topodup)
     old = int(a[0])
+    # attribute copy callbacks run first: a failing copy fn aborts the
+    # dup and yields MPI_COMM_NULL (MPI-1.2 clarification, attr/attrerr)
+    err, new_attrs = _attrs_copy_all(ctx, ctx.comm_attrs.get(old, {}),
+                                     old)
+    if err != MPI_SUCCESS:
+        _write_i32(a[1], COMM_NULL)
+        return err
+    h = _new_comm_handle(ctx, comm.dup())
+    if new_attrs:
+        ctx.comm_attrs[h] = new_attrs
+    # ... and the error handler (MPI-3 §6.4.2; errhan/commcall)
+    if old in ctx.comm_errh:
+        ctx.comm_errh[h] = ctx.comm_errh[old]
+    # MPI_Comm_dup propagates the topology (MPI-3 §6.4.2; topo/topodup)
     if old in ctx.cart_topos:
         ctx.cart_topos[h] = ctx.cart_topos[old]
     if old in ctx.graph_topos:
@@ -762,13 +793,20 @@ def _h_comm_split(ctx, a):
         return MPI_ERR_COMM
     color, key = int(a[1]), int(a[2])
     new = comm.split(-1 if color == C_UNDEFINED else color, key)
-    _write_i32(a[3], _new_comm_handle(ctx, new))
+    _write_i32(a[3], _new_comm_handle(ctx, new, parent=a[0]))
     return MPI_SUCCESS
 
 
 def _h_comm_free(ctx, a):
-    h = ctypes.cast(int(a[0]), _pi32)[0] if a[0] else 0
-    ctx.comms.pop(int(h), None)
+    h = int(ctypes.cast(int(a[0]), _pi32)[0]) if a[0] else 0
+    store = ctx.comm_attrs.get(h)
+    if store:
+        rc = _attrs_free_all(ctx, store, h)
+        if rc != MPI_SUCCESS:
+            return rc
+    ctx.comm_attrs.pop(h, None)
+    ctx.comm_errh.pop(h, None)
+    ctx.comms.pop(h, None)
     _write_i32(a[0], COMM_NULL)
     return MPI_SUCCESS
 
@@ -2099,6 +2137,12 @@ def _h_type_free(ctx, a):
     h = int(ctypes.cast(int(a[0]), _pi32)[0])
     if h in _PREDEF_DTYPES:
         return MPI_ERR_ARG       # freeing a predefined type is erroneous
+    store = ctx.type_attrs.get(h)
+    if store:
+        rc = _attrs_free_all(ctx, store, h)
+        if rc != MPI_SUCCESS:
+            return rc
+    ctx.type_attrs.pop(h, None)
     if ctx.dtypes.pop(h, None) is not None:
         if not hasattr(ctx, "free_dtype_handles"):
             ctx.free_dtype_handles = []
@@ -2114,6 +2158,15 @@ def _h_op_create(ctx, a):
     hint: Dict = {}
     ctx.ops[h] = _user_op(fn_addr, bool(commute), hint)
     _write_i32(op_addr, h)
+    return MPI_SUCCESS
+
+
+def _h_op_commutative(ctx, a):
+    # predefined reduction ops are all commutative (MPI-3 §5.9.1);
+    # user ops report the flag given to MPI_Op_create
+    op = ctx.ops.get(int(a[0]))
+    commute = 1 if op is None else int(bool(op.commutative))
+    _write_i32(a[1], commute)
     return MPI_SUCCESS
 
 
@@ -2382,11 +2435,14 @@ def _h_comm_create(ctx, a):
     group = ctx.groups.get(int(a[1]))
     if comm is None or group is None:
         return MPI_ERR_COMM
-    _write_i32(a[2], _new_comm_handle(ctx, comm.create(group)))
+    _write_i32(a[2], _new_comm_handle(ctx, comm.create(group),
+                                       parent=a[0]))
     return MPI_SUCCESS
 
 
 def _new_group_handle(ctx, group) -> int:
+    if not group.world_ranks:
+        return 1                # the canonical MPI_GROUP_EMPTY handle
     h = ctx.next_group
     ctx.next_group += 1
     ctx.groups[h] = group
@@ -2411,6 +2467,7 @@ def _h_group_incl(ctx, a, mode="incl"):
 
 #: predefined COMM_WORLD attribute keyvals (mpi.h)
 _ATTR_TAG_UB, _ATTR_WTIME_GLOBAL = 1, 4
+_ATTR_HOST, _ATTR_IO, _ATTR_LASTUSEDCODE = 2, 3, 7
 _ATTR_UNIVERSE, _ATTR_APPNUM = 5, 6
 _WIN_BASE, _WIN_SIZE, _WIN_DISP = 16, 17, 18
 
@@ -2426,30 +2483,136 @@ def _attr_cell(keyval: int, value: int) -> int:
     return ctypes.addressof(cell)
 
 
-def _h_keyval_create(ctx, a):
+# Keyvals are refcounted MPICH-style (1 for the user handle + 1 per
+# attached attribute): MPI_*_free_keyval only invalidates the user's
+# handle; the callbacks survive until the last attribute detaches, so
+# delete callbacks still fire at object-free time (MPI-3 §6.7.2,
+# attr/fkeyval*, rma/fkeyvalwin).  Ids are never reused.
+MPI_ERR_KEYVAL = 35
+
+_ATTR_COPY_CFUNC = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
+    ctypes.c_void_p, ctypes.c_void_p, ctypes.POINTER(ctypes.c_int))
+_ATTR_DELETE_CFUNC = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
+    ctypes.c_void_p)
+
+
+def _kv_new(ctx, copy_fn, delete_fn, extra) -> int:
     h = ctx.next_keyval
     ctx.next_keyval += 1
-    _write_i32(a[0], h)
+    ctx.keyvals[h] = {"copy": int(copy_fn), "delete": int(delete_fn),
+                      "extra": int(extra), "refs": 1, "freed": False}
+    return h
+
+
+def _kv_deref(ctx, kv: int) -> None:
+    e = ctx.keyvals.get(kv)
+    if e is not None:
+        e["refs"] -= 1
+        if e["refs"] <= 0:
+            ctx.keyvals.pop(kv, None)
+
+
+def _attr_fire_delete(ctx, store, oh: int, kv: int):
+    """Run the delete callback; on success detach the attr. Returns an
+    MPI error code (nonzero keeps the attribute attached, MPI-1.2
+    clarification exercised by attr/attrerr)."""
+    if kv not in store:
+        return MPI_SUCCESS
+    e = ctx.keyvals.get(kv)
+    if e is not None and e["delete"]:
+        rc = _ATTR_DELETE_CFUNC(e["delete"])(oh, kv, store[kv],
+                                             e["extra"])
+        if rc != MPI_SUCCESS:
+            return rc
+    store.pop(kv, None)
+    _kv_deref(ctx, kv)
+    return MPI_SUCCESS
+
+
+def _attrs_set(ctx, store, oh: int, kv: int, value: int):
+    e = ctx.keyvals.get(kv)
+    if e is None or e["freed"]:
+        return MPI_ERR_KEYVAL
+    if kv in store:
+        rc = _attr_fire_delete(ctx, store, oh, kv)
+        if rc != MPI_SUCCESS:
+            return rc
+    store[kv] = value
+    e["refs"] += 1
+    return MPI_SUCCESS
+
+
+def _attrs_copy_all(ctx, src_store, oldh: int):
+    """Copy-callback pass for Comm_dup/Type_dup. Returns (err, dict)."""
+    new_store: Dict[int, int] = {}
+    for kv, value in list(src_store.items()):
+        e = ctx.keyvals.get(kv)
+        if e is None or not e["copy"]:
+            continue
+        out = ctypes.c_void_p(0)
+        flag = ctypes.c_int(0)
+        rc = _ATTR_COPY_CFUNC(e["copy"])(
+            oldh, kv, e["extra"], value, ctypes.byref(out),
+            ctypes.byref(flag))
+        if rc != MPI_SUCCESS:
+            for kv2 in new_store:
+                _kv_deref(ctx, kv2)
+            return rc, None
+        if flag.value:
+            new_store[kv] = out.value or 0
+            e["refs"] += 1
+    return MPI_SUCCESS, new_store
+
+
+def _attrs_free_all(ctx, store, oh: int, lifo: bool = False):
+    """Fire every delete callback at object-free time (insertion
+    order, matching MPICH; COMM_SELF at finalize is LIFO per MPI-2.2,
+    init/attrself); first error aborts the free."""
+    keys = list(store)
+    if lifo:
+        keys.reverse()
+    for kv in keys:
+        rc = _attr_fire_delete(ctx, store, oh, kv)
+        if rc != MPI_SUCCESS:
+            return rc
+    return MPI_SUCCESS
+
+
+def _h_keyval_create(ctx, a):
+    _write_i32(a[2], _kv_new(ctx, a[0], a[1], a[3]))
     return MPI_SUCCESS
 
 
 def _h_keyval_free(ctx, a):
+    h = ctypes.cast(int(a[0]), _pi32)[0] if a[0] else 0
+    e = ctx.keyvals.get(int(h))
+    if e is not None and not e["freed"]:
+        e["freed"] = True
+        _kv_deref(ctx, int(h))
     _write_i32(a[0], -1)      # MPI_KEYVAL_INVALID
     return MPI_SUCCESS
 
 
 def _h_attr_put(ctx, a):
-    ctx.comm_attrs[(int(a[0]), int(a[1]))] = int(a[2])
-    return MPI_SUCCESS
+    ch, kv = int(a[0]), int(a[1])
+    if _comm_of(ctx, ch) is None:
+        return MPI_ERR_COMM
+    return _attrs_set(ctx, ctx.comm_attrs.setdefault(ch, {}), ch, kv,
+                      int(a[2]))
 
 
 def _h_attr_get(ctx, a):
     ch, kv, val_addr, flag_addr = int(a[0]), int(a[1]), a[2], a[3]
     predefined = {
         _ATTR_TAG_UB: 2**30 - 1,
+        _ATTR_HOST: C_PROC_NULL,        # no distinguished host process
+        _ATTR_IO: C_ANY_SOURCE,         # every rank can do I/O
         _ATTR_WTIME_GLOBAL: 1,          # one simulated clock: global
         _ATTR_UNIVERSE: runtime.world().size(),
         _ATTR_APPNUM: 0,
+        _ATTR_LASTUSEDCODE: ctx.last_used_code,
     }
     if kv in predefined:
         # MPI contract: *(void**)val receives a pointer to the value
@@ -2457,7 +2620,9 @@ def _h_attr_get(ctx, a):
             kv, predefined[kv])
         _write_i32(flag_addr, 1)
         return MPI_SUCCESS
-    stored = ctx.comm_attrs.get((ch, kv))
+    if kv < 0:
+        return MPI_ERR_KEYVAL
+    stored = ctx.comm_attrs.get(ch, {}).get(kv)
     if stored is None:
         _write_i32(flag_addr, 0)
     else:
@@ -2467,7 +2632,151 @@ def _h_attr_get(ctx, a):
 
 
 def _h_attr_delete(ctx, a):
-    ctx.comm_attrs.pop((int(a[0]), int(a[1])), None)
+    ch, kv = int(a[0]), int(a[1])
+    store = ctx.comm_attrs.get(ch, {})
+    if kv not in store:
+        return MPI_SUCCESS if kv >= 0 else MPI_ERR_KEYVAL
+    return _attr_fire_delete(ctx, store, ch, kv)
+
+
+def _h_type_set_attr(ctx, a):
+    th, kv = int(a[0]), int(a[1])
+    if ctx.dtypes.get(th) is None:
+        return MPI_ERR_TYPE
+    return _attrs_set(ctx, ctx.type_attrs.setdefault(th, {}), th, kv,
+                      int(a[2]))
+
+
+def _h_type_get_attr(ctx, a):
+    th, kv, val_addr, flag_addr = int(a[0]), int(a[1]), a[2], a[3]
+    if kv < 0:
+        return MPI_ERR_KEYVAL
+    stored = ctx.type_attrs.get(th, {}).get(kv)
+    if stored is None:
+        _write_i32(flag_addr, 0)
+    else:
+        ctypes.cast(int(val_addr), _pi64)[0] = stored
+        _write_i32(flag_addr, 1)
+    return MPI_SUCCESS
+
+
+def _h_type_delete_attr(ctx, a):
+    th, kv = int(a[0]), int(a[1])
+    store = ctx.type_attrs.get(th, {})
+    if kv not in store:
+        return MPI_SUCCESS if kv >= 0 else MPI_ERR_KEYVAL
+    return _attr_fire_delete(ctx, store, th, kv)
+
+
+# -- error handlers & dynamic error codes -----------------------------------
+# Implicit MPI errors return codes (matching the reference SMPI default);
+# MPI_Comm_call_errhandler / MPI_Win_call_errhandler honour the installed
+# handler: ERRORS_RETURN is a no-op, a user handler (Comm_create_errhandler)
+# is invoked via ctypes, and ERRORS_ARE_FATAL — the MPI default — aborts
+# (errhan/errfatal runs under resultTest=TestErrFatal).
+
+_ERRH_CFUNC = ctypes.CFUNCTYPE(None, ctypes.POINTER(ctypes.c_int),
+                               ctypes.POINTER(ctypes.c_int))
+
+_ERR_STRINGS = {
+    0: "MPI_SUCCESS: no error", 1: "MPI_ERR_BUFFER: invalid buffer",
+    2: "MPI_ERR_COUNT: invalid count", 3: "MPI_ERR_TYPE: invalid datatype",
+    4: "MPI_ERR_TAG: invalid tag", 5: "MPI_ERR_COMM: invalid communicator",
+    6: "MPI_ERR_RANK: invalid rank", 7: "MPI_ERR_REQUEST: invalid request",
+    12: "MPI_ERR_ARG: invalid argument", 13: "MPI_ERR_UNKNOWN: unknown",
+    14: "MPI_ERR_TRUNCATE: message truncated",
+    15: "MPI_ERR_OTHER: known error not in this list",
+    16: "MPI_ERR_INTERN: internal error",
+    17: "MPI_ERR_WIN: invalid window",
+    35: "MPI_ERR_KEYVAL: invalid keyval",
+}
+
+
+def _h_errhandler_create(ctx, a):
+    h = ctx.next_errh
+    ctx.next_errh += 1
+    ctx.errhandlers[h] = int(a[0])
+    _write_i32(a[1], h)
+    return MPI_SUCCESS
+
+
+def _h_errhandler_free(ctx, a):
+    # only the user handle dies: a handler installed on a comm/win
+    # outlives it (MPI-3 §8.3, errhan/commcall frees then dups)
+    _write_i32(a[0], 0)       # MPI_ERRHANDLER_NULL
+    return MPI_SUCCESS
+
+
+def _invoke_errhandler(ctx, errh: int, oh: int, code: int) -> int:
+    if errh == 1:             # MPI_ERRORS_RETURN
+        return MPI_SUCCESS
+    fn = ctx.errhandlers.get(errh)
+    if fn:
+        c_oh, c_code = ctypes.c_int(oh), ctypes.c_int(code)
+        _ERRH_CFUNC(fn)(ctypes.byref(c_oh), ctypes.byref(c_code))
+        return MPI_SUCCESS
+    sys.stderr.write("MPI: fatal error %d on rank %d (errhandler is "
+                     "MPI_ERRORS_ARE_FATAL); aborting\n"
+                     % (code, runtime.this_rank()))
+    return _h_abort(ctx, (0, code or 1))
+
+
+def _h_comm_set_errhandler(ctx, a):
+    if _comm_of(ctx, a[0]) is None:
+        return MPI_ERR_COMM
+    ctx.comm_errh[int(a[0])] = int(a[1])
+    return MPI_SUCCESS
+
+
+def _h_comm_get_errhandler(ctx, a):
+    _write_i32(a[1], ctx.comm_errh.get(int(a[0]), 2))
+    return MPI_SUCCESS
+
+
+def _h_comm_call_errhandler(ctx, a):
+    ch = int(a[0])
+    if _comm_of(ctx, ch) is None:
+        return MPI_ERR_COMM
+    return _invoke_errhandler(ctx, ctx.comm_errh.get(ch, 2), ch,
+                              int(a[1]))
+
+
+def _h_add_error_class(ctx, a):
+    ctx.last_used_code += 1
+    ctx.user_err_class[ctx.last_used_code] = ctx.last_used_code
+    _write_i32(a[0], ctx.last_used_code)
+    return MPI_SUCCESS
+
+
+def _h_add_error_code(ctx, a):
+    ctx.last_used_code += 1
+    ctx.user_err_class[ctx.last_used_code] = int(a[0])
+    _write_i32(a[1], ctx.last_used_code)
+    return MPI_SUCCESS
+
+
+def _h_add_error_string(ctx, a):
+    ctx.user_err_strings[int(a[0])] = ctypes.string_at(
+        int(a[1])).decode(errors="replace")[:255]
+    return MPI_SUCCESS
+
+
+def _h_error_string(ctx, a):
+    code = int(ctypes.c_int(int(a[0]) & 0xFFFFFFFF).value)
+    if code > 74:   # dynamic codes with no string registered are ""
+        s = ctx.user_err_strings.get(code, "")
+    else:
+        s = (ctx.user_err_strings.get(code) or _ERR_STRINGS.get(code)
+             or "MPI error %d" % code)
+    b = s.encode()[:255]
+    ctypes.memmove(int(a[1]), b + b"\0", len(b) + 1)
+    _write_i32(a[2], len(b))
+    return MPI_SUCCESS
+
+
+def _h_error_class(ctx, a):
+    code = int(ctypes.c_int(int(a[0]) & 0xFFFFFFFF).value)
+    _write_i32(a[1], ctx.user_err_class.get(code, code))
     return MPI_SUCCESS
 
 
@@ -2481,11 +2790,6 @@ MPI_ERR_RANK = 7
 OP_REPLACE, OP_NO_OP = 13, 14
 _WIN_FLAVOR_KV, _WIN_MODEL_KV = 19, 20
 C_WIN_UNIFIED = 2
-_WIN_ERRORS_RETURN = 1
-
-_WIN_DELETE_CFUNC = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int,
-                                     ctypes.c_int, ctypes.c_void_p,
-                                     ctypes.c_void_p)
 
 
 class _RmaReq:
@@ -2638,12 +2942,14 @@ def _h_win_shared_query(ctx, a):
 
 
 def _h_win_free(ctx, a):
-    h = ctypes.cast(int(a[0]), _pi32)[0] if a[0] else 0
-    entry = ctx.wins.pop(int(h), None)
+    h = int(ctypes.cast(int(a[0]), _pi32)[0]) if a[0] else 0
+    entry = ctx.wins.get(h)
     if entry is not None:
         # delete-attr callbacks fire on free (MPI-3 §6.7.2)
-        for kv in list(entry["attrs"]):
-            _win_attr_delete(ctx, entry, int(h), kv)
+        rc = _attrs_free_all(ctx, entry["attrs"], h)
+        if rc != MPI_SUCCESS:
+            return rc
+        ctx.wins.pop(h, None)
         entry["win"].free()
     _write_i32(a[0], 0)
     return MPI_SUCCESS
@@ -2683,47 +2989,26 @@ def _h_win_get_attr(ctx, a):
     return MPI_SUCCESS
 
 
-def _win_attr_delete(ctx, entry, wh: int, kv: int) -> None:
-    value = entry["attrs"].pop(kv, None)
-    fns = ctx.win_keyvals.get(kv)
-    if value is None or fns is None:
-        return
-    _copy_fn, delete_fn, extra = fns
-    if delete_fn:
-        _WIN_DELETE_CFUNC(delete_fn)(wh, kv, value, extra)
-
-
 def _h_win_set_attr(ctx, a):
     entry = ctx.wins.get(int(a[0]))
     if entry is None:
         return MPI_ERR_WIN
-    kv = int(a[1])
-    if kv in entry["attrs"]:
-        _win_attr_delete(ctx, entry, int(a[0]), kv)
-    entry["attrs"][kv] = int(a[2])
-    return MPI_SUCCESS
+    return _attrs_set(ctx, entry["attrs"], int(a[0]), int(a[1]),
+                      int(a[2]))
 
 
 def _h_win_delete_attr(ctx, a):
     entry = ctx.wins.get(int(a[0]))
     if entry is None:
         return MPI_ERR_WIN
-    _win_attr_delete(ctx, entry, int(a[0]), int(a[1]))
-    return MPI_SUCCESS
+    kv = int(a[1])
+    if kv not in entry["attrs"]:
+        return MPI_SUCCESS if kv >= 0 else MPI_ERR_KEYVAL
+    return _attr_fire_delete(ctx, entry["attrs"], int(a[0]), kv)
 
 
 def _h_win_keyval_create(ctx, a):
-    h = ctx.next_keyval
-    ctx.next_keyval += 1
-    ctx.win_keyvals[h] = (int(a[0]), int(a[1]), int(a[3]))
-    _write_i32(a[2], h)
-    return MPI_SUCCESS
-
-
-def _h_win_keyval_free(ctx, a):
-    h = ctypes.cast(int(a[0]), _pi32)[0] if a[0] else 0
-    ctx.win_keyvals.pop(int(h), None)
-    _write_i32(a[0], -1)      # MPI_KEYVAL_INVALID
+    _write_i32(a[2], _kv_new(ctx, a[0], a[1], a[3]))
     return MPI_SUCCESS
 
 
@@ -2774,7 +3059,8 @@ def _h_win_call_errhandler(ctx, a):
     entry = _win_entry(ctx, a[0])
     if entry is None:
         return MPI_ERR_WIN
-    return MPI_SUCCESS        # ERRORS_RETURN semantics: report, continue
+    return _invoke_errhandler(ctx, entry["errh"] or 2, int(a[0]),
+                              int(a[1]))
 
 
 def _rma_op_of(ctx, oph, dt):
@@ -3258,13 +3544,24 @@ def _h_type_indexed_block(ctx, a):
 
 def _h_type_dup(ctx, a):
     old = _dt(ctx, a[0])
+    # MPI_Type_dup is the one type constructor that copies attributes
+    # (MPI-3 §6.7.4; attr/fkeyvaltype)
+    err, new_attrs = _attrs_copy_all(ctx, ctx.type_attrs.get(int(a[0]),
+                                                             {}),
+                                     int(a[0]))
+    if err != MPI_SUCCESS:
+        _write_i32(a[1], 0)
+        return err
     dt = Datatype(old.size_, old.np_dtype, old.name, old.extent_)
     dt.c_segments = _segments_of(old)
     dt.c_basics = list(_basics_of(old))
     dt.c_lb = int(getattr(old, "c_lb", 0))
     dt.c_env = (C_COMBINER_DUP, [], [], [int(a[0])])
     dt.c_env_types = [old]
-    _write_i32(a[1], _new_dtype_handle(ctx, dt))
+    h = _new_dtype_handle(ctx, dt)
+    if new_attrs:
+        ctx.type_attrs[h] = new_attrs
+    _write_i32(a[1], h)
     return MPI_SUCCESS
 
 
@@ -3705,11 +4002,20 @@ def _h_ibcast(ctx, a):
     if comm is None:
         return MPI_ERR_COMM
     dt = _dt(ctx, dth)
+    root = int(root)
+    if _is_inter(comm):
+        obj = _arr_in(buf, count, dt) if root == C_ROOT else None
+        req = comm.ibcast(obj, root)
+        post = None
+        if root >= 0:                       # leaf side receives
+            post = lambda res: _arr_out(buf, res,
+                                        int(count) * dt.size_, dt=dt)
+        return _nbc_handle(ctx, req, req_addr, post)
     me = comm.rank()
-    obj = _arr_in(buf, count, dt) if me == int(root) else None
-    req = comm.ibcast(obj, int(root))
+    obj = _arr_in(buf, count, dt) if me == root else None
+    req = comm.ibcast(obj, root)
     post = None
-    if me != int(root):
+    if me != root:
         post = lambda res: _arr_out(buf, res, int(count) * dt.size_,
                                     dt=dt)
     return _nbc_handle(ctx, req, req_addr, post)
@@ -3721,11 +4027,22 @@ def _h_ireduce(ctx, a):
     if comm is None:
         return MPI_ERR_COMM
     dt = _dt(ctx, dth)
+    root = int(root)
+    if _is_inter(comm) and root in (C_ROOT, C_PROC_NULL):
+        arr = np.zeros(0) if int(sbuf) in (0, C_IN_PLACE) \
+            else _arr_in(sbuf, count, dt)
+        req = comm.ireduce(arr, _op_of(ctx, oph, dt, dt_handle=dth,
+                                       count=int(count)), root)
+        post = None
+        if root == C_ROOT:
+            post = lambda res: _arr_out(
+                rbuf, np.asarray(res), int(count) * dt.size_, dt=dt)
+        return _nbc_handle(ctx, req, req_addr, post)
     arr = _arr_in(rbuf if int(sbuf) == C_IN_PLACE else sbuf, count, dt)
     op = _op_of(ctx, oph, dt, dt_handle=dth, count=int(count))
-    req = comm.ireduce(arr, op, int(root))
+    req = comm.ireduce(arr, op, root)
     post = None
-    if comm.rank() == int(root):
+    if not _is_inter(comm) and comm.rank() == root:
         post = lambda res: _arr_out(
             rbuf, np.asarray(res).astype(arr.dtype, copy=False),
             int(count) * dt.size_, dt=dt)
@@ -4051,8 +4368,8 @@ def _h_comm_create_group(ctx, a):
     group = ctx.groups.get(int(a[1]))
     if comm is None or group is None:
         return MPI_ERR_COMM
-    _write_i32(a[3], _new_comm_handle(ctx, comm.create_group(group,
-                                                             int(a[2]))))
+    _write_i32(a[3], _new_comm_handle(ctx, comm.create_group(
+        group, int(a[2])), parent=a[0]))
     return MPI_SUCCESS
 
 
@@ -4061,7 +4378,7 @@ def _h_comm_idup(ctx, a):
     comm = _comm_of(ctx, a[0])
     if comm is None:
         return MPI_ERR_COMM
-    h = _new_comm_handle(ctx, comm.dup())
+    h = _new_comm_handle(ctx, comm.dup(), parent=a[0])
     old = int(a[0])
     if old in ctx.cart_topos:         # same copy semantics as Comm_dup
         ctx.cart_topos[h] = ctx.cart_topos[old]
@@ -4088,15 +4405,20 @@ def _h_comm_split_type(ctx, a):
     comm = _comm_of(ctx, ch)
     if comm is None:
         return MPI_ERR_COMM
-    if int(split_type) == C_UNDEFINED:
-        new = comm.split(-1, int(key))
+    # one uniform collective for every rank: MPI_UNDEFINED callers must
+    # still participate (comm/cmsplit_type mixes SHARED and UNDEFINED)
+    st = int(split_type)
+    me_host = runtime.this_rank_state().host
+    mine = me_host.name if st != C_UNDEFINED else None
+    hosts = comm.allgather(mine)
+    if st == C_UNDEFINED:
+        color = -1
     else:
         # MPI_COMM_TYPE_SHARED: ranks sharing a host
-        me_host = runtime.this_rank_state().host
-        hosts = comm.allgather(me_host.name)
-        color = sorted(set(hosts)).index(me_host.name)
-        new = comm.split(color, int(key))
-    _write_i32(out_addr, _new_comm_handle(ctx, new))
+        color = sorted({h for h in hosts if h is not None}).index(
+            me_host.name)
+    new = comm.split(color, int(key))
+    _write_i32(out_addr, _new_comm_handle(ctx, new, parent=ch))
     return MPI_SUCCESS
 
 
@@ -4148,10 +4470,11 @@ def _h_group_translate(ctx, a):
     if g1 is None or g2 is None:
         return MPI_ERR_ARG
     n = int(a[1])
-    ranks = _read_i32s(a[2], n)
-    out = g1.translate_ranks(ranks, g2)
-    for i, r in enumerate(out):
-        ctypes.cast(int(a[4]), _pi32)[i] = r
+    if n <= 0:                       # n=0 with NULL arrays is legal
+        return MPI_SUCCESS
+    src = ctypes.cast(int(a[2]), ctypes.POINTER(ctypes.c_int * n)).contents
+    out = (ctypes.c_int * n)(*g1.translate_ranks(src, g2))
+    ctypes.memmove(int(a[4]), out, 4 * n)
     return MPI_SUCCESS
 
 
@@ -4567,12 +4890,22 @@ _HANDLERS = {
     182: _h_win_sync, 183: _h_win_start, 184: _h_win_complete,
     185: _h_win_post, 186: _h_win_wait, 187: _h_win_test,
     188: _h_win_get_group, 189: _h_win_set_name, 190: _h_win_get_name,
-    191: _h_win_keyval_create, 192: _h_win_keyval_free,
+    191: _h_win_keyval_create, 192: _h_keyval_free,
     193: _h_win_delete_attr, 194: _h_win_set_errhandler,
     195: _h_win_get_errhandler, 196: _h_win_call_errhandler,
     # matched probe + generalized requests
     197: _h_mprobe, 198: _h_improbe, 199: _h_mrecv, 200: _h_imrecv,
     201: _h_grequest_start, 202: _h_grequest_complete,
+    # datatype attributes
+    203: _h_keyval_create, 204: _h_type_set_attr, 205: _h_type_get_attr,
+    206: _h_type_delete_attr,
+    # error handlers + dynamic error codes
+    207: _h_errhandler_create, 208: _h_errhandler_free,
+    209: _h_comm_set_errhandler, 210: _h_comm_get_errhandler,
+    211: _h_comm_call_errhandler, 212: _h_add_error_class,
+    213: _h_add_error_code, 214: _h_add_error_string,
+    215: _h_error_string, 216: _h_error_class,
+    217: _h_op_commutative,
 }
 
 #: ops that are pure local queries — no bench end/begin cycle needed
@@ -4583,7 +4916,8 @@ _LOCAL_OPS = {3, 4, 24, 41, 42, 45, 46, 48, 50, 51, 63, 64, 66, 69,
               97, 98, 99, 101, 102, 103, 129, 130, 131, 132, 133,
               134, 135, 136, 137, 139, 140, 141, 142,
               171, 172, 173, 188, 189, 190, 191, 192, 193, 194, 195,
-              196, 201, 202}
+              196, 201, 202, 203, 204, 205, 206, 207, 208, 209, 210,
+              211, 212, 213, 214, 215, 216, 217}
 
 
 def _dispatch_py(opcode: int, args) -> int:

@@ -57,9 +57,34 @@ class NbcRequest:
         return False
 
 
+from .intercomm import MPI_PROC_NULL, MPI_ROOT
+
+
+def _is_inter(comm) -> bool:
+    return getattr(comm, "is_inter", lambda: False)()
+
+
+def _fold(op: Op, data):
+    """Reduce received contributions in ascending-rank order (keeps
+    non-commutative ops deterministic)."""
+    result = data[-1]
+    for i in range(len(data) - 2, -1, -1):
+        result = op(data[i], result)
+    return result
+
+
 def ibarrier(comm) -> NbcRequest:
     """Flat ibarrier (smpi_nbc_impl.cpp ibarrier): everyone -> 0, then
     0 -> everyone; all requests posted now."""
+    if _is_inter(comm):
+        # intercomm barrier: full flat exchange with the remote group
+        # (p2p on an InterComm addresses the remote side), completing
+        # only after every remote rank has entered — all posted now
+        nrem = comm.remote_size()
+        sends = [comm.isend(b"", dst, TAG_IBARRIER)
+                 for dst in range(nrem)]
+        recvs = [comm.irecv(src, TAG_IBARRIER) for src in range(nrem)]
+        return NbcRequest(sends, recvs, lambda _: None)
     rank, size = comm.rank(), comm.size()
     if size == 1:
         return NbcRequest([], [])
@@ -79,6 +104,17 @@ def ibarrier(comm) -> NbcRequest:
 
 def ibcast(comm, obj, root: int = 0) -> NbcRequest:
     """Flat ibcast (smpi_nbc_impl.cpp ibcast): root isends to all."""
+    if _is_inter(comm):
+        # origin side: MPI_ROOT ships to every remote rank, other
+        # origin ranks pass MPI_PROC_NULL and are complete immediately
+        if root == MPI_ROOT:
+            sends = [comm.isend(obj, dst, TAG_IBCAST)
+                     for dst in range(comm.remote_size())]
+            return NbcRequest(sends, [], lambda _: obj)
+        if root == MPI_PROC_NULL:
+            return NbcRequest([], [])
+        recv = comm.irecv(root, TAG_IBCAST)   # root = remote rank
+        return NbcRequest([], [recv], lambda data: data[0])
     rank, size = comm.rank(), comm.size()
     if size == 1:
         return NbcRequest([], [], lambda _: obj)
@@ -92,6 +128,15 @@ def ibcast(comm, obj, root: int = 0) -> NbcRequest:
 
 def ireduce(comm, sendobj, op: Op = MPI_SUM, root: int = 0) -> NbcRequest:
     """Flat ireduce: root irecvs from all, folds at completion."""
+    if _is_inter(comm):
+        if root == MPI_ROOT:
+            nrem = comm.remote_size()
+            recvs = [comm.irecv(src, TAG_IREDUCE) for src in range(nrem)]
+            return NbcRequest([], recvs, lambda data: _fold(op, data))
+        if root == MPI_PROC_NULL:
+            return NbcRequest([], [])
+        return NbcRequest([comm.isend(sendobj, root, TAG_IREDUCE)], [],
+                          lambda _: None)
     rank, size = comm.rank(), comm.size()
     if size == 1:
         return NbcRequest([], [], lambda _: sendobj)
@@ -116,6 +161,14 @@ def ireduce(comm, sendobj, op: Op = MPI_SUM, root: int = 0) -> NbcRequest:
 def iallreduce(comm, sendobj, op: Op = MPI_SUM) -> NbcRequest:
     """Flat iallreduce: exchange with everyone, fold at completion
     (smpi_nbc_impl.cpp iallreduce)."""
+    if _is_inter(comm):
+        # MPI-2 intercomm allreduce: each side gets the reduction of
+        # the OTHER side's data; flat cross-group exchange
+        nrem = comm.remote_size()
+        sends = [comm.isend(sendobj, dst, TAG_IALLREDUCE)
+                 for dst in range(nrem)]
+        recvs = [comm.irecv(src, TAG_IALLREDUCE) for src in range(nrem)]
+        return NbcRequest(sends, recvs, lambda data: _fold(op, data))
     rank, size = comm.rank(), comm.size()
     if size == 1:
         return NbcRequest([], [], lambda _: sendobj)

@@ -43,6 +43,7 @@ def main() -> int:
     os.makedirs("/tmp/mpich3", exist_ok=True)
 
     np_of = {}
+    rtest_of = {}
     try:
         for line in open(f"{M}/{d}/testlist"):
             # honour np hints on commented-out entries too
@@ -50,6 +51,16 @@ def main() -> int:
             parts = line.lstrip("#").split()
             if len(parts) >= 2 and parts[1].isdigit():
                 np_of.setdefault(parts[0], int(parts[1]))
+            # MPICH runtests annotations: resultTest=TestStatus
+            # (nonzero exit status is the expected result) and
+            # resultTest=TestErrFatal (the program must abort).
+            # Only ACTIVE lines count — a prose comment starting with
+            # a test name must not invert the active entry's grading.
+            if not line.startswith("#"):
+                for p in parts[2:]:
+                    if p.startswith("resultTest="):
+                        rtest_of.setdefault(parts[0],
+                                            p.split("=", 1)[1])
     except FileNotFoundError:
         pass
 
@@ -63,8 +74,15 @@ def main() -> int:
     def run_test(src: str) -> None:
         name = os.path.basename(src)[:-2]
         np_ranks = np_of.get(name, 2)   # MPICH runtests default: 2
+        rtest = rtest_of.get(name)
         cfgs = TEST_CONFIGS.get(name,
                                 ("smpi/simulate-computation:false",))
+        if rtest in ("TestStatus", "TestErrFatal"):
+            # inverted tests: the expected outcome is a nonzero exit
+            # status (exit-status propagation / fatal-errhandler abort)
+            check = "assert any(c != 0 for c in codes.values()), codes"
+        else:
+            check = "assert all(c == 0 for c in codes.values()), codes"
         code = f"""
 import sys; sys.path.insert(0, {REPO!r})
 from simgrid_tpu.smpi.c_api import compile_program, run_c_program
@@ -73,7 +91,7 @@ compile_program([{src!r}, "{M}/util/mtest.c", "{M}/util/mtest_datatype.c",
                 "/tmp/mpich3/{d}-{name}.so", extra_flags=["-I{M}/include"])
 engine, codes = run_c_program("/tmp/mpich3/{d}-{name}.so",
     np_ranks={np_ranks}, configs={cfgs!r})
-assert all(c == 0 for c in codes.values()), codes
+{check}
 """
         try:
             r = subprocess.run([sys.executable, "-c", code],
@@ -85,6 +103,7 @@ assert all(c == 0 for c in codes.values()), codes
             out_l = r.stdout.lower()
             ok = r.returncode == 0 and (
                 "no errors" in out_l
+                or rtest in ("TestStatus", "TestErrFatal")
                 or (name in OUTPUT_ONLY
                     and not _re.search(r"\berrors?\b|\bfail|abort|deadlock",
                                        out_l)))
