@@ -93,10 +93,11 @@ def stage_device(n_c: int, n_v: int, deg: int, seed: int,
 
     out = {"platform": dev.platform, "dtype": np.dtype(dtype).name}
     modes = [("local", True), ("global", False)]
-    if on_tpu and n_v > 50_000:
-        # global mode needs ~10k sequential rounds here (~8 min of
-        # accelerator time for a number nobody uses — local is the
-        # accelerator mode); measure it on the small classes only
+    if on_tpu and n_v > 5_000:
+        # global mode fixes ~one variable per round (7k+ sequential
+        # rounds at 20k, 10k at 100k) — minutes of accelerator time for
+        # a number nobody uses; local is the accelerator mode.  Measure
+        # global on the small class only.
         modes = [("local", True)]
     for name, parallel in modes:
         _, _, _, rounds = solve_arrays(arrays, eps, parallel_rounds=parallel)
@@ -213,31 +214,55 @@ def run_stage(stage: str, timeout: float, errors: dict, cpu=False,
     return out
 
 
+def probe_accel(errors: dict, tries: int = 3, wait_s: float = 20.0):
+    """Probe the accelerator with retries: a tunneled TPU can be
+    transiently wedged, and three rounds of benches died on a single
+    unlucky probe (BENCH_r01..r03).  Called again before every device
+    stage — the chip's health at bench START says nothing about its
+    health twenty minutes in."""
+    for i in range(tries):
+        probe = run_stage("probe", timeout=120, errors=errors)
+        if probe is not None:
+            return probe
+        if i + 1 < tries:
+            log(f"[bench] probe attempt {i + 1} failed; "
+                f"retrying in {wait_s:.0f}s")
+            time.sleep(wait_s)
+    return None
+
+
 def main() -> None:
     errors: dict = {}
     detail: dict = {}
 
-    probe = run_stage("probe", timeout=120, errors=errors)
+    probe = probe_accel(errors)
     platform = probe["platform"] if probe else "unavailable"
-    # Device stages go to the accelerator when it answered the probe, to
-    # the CPU backend otherwise (partial results beat none).
-    cpu_fallback = probe is None or platform == "cpu"
+    accel = probe is not None and platform != "cpu"
     if probe is None:
         log("[bench] accelerator unusable; device stages fall back to CPU")
-    detail["platform"] = "cpu" if cpu_fallback else platform
+    detail["platform"] = platform if accel else "cpu"
 
     # --- headline: 100k flows over 16k links, 4 links per flow ---------
+    # The device stage runs on BOTH backends: the solver dispatches by
+    # system size in production, so the honest headline is the best
+    # backend for the class (TPU at 100k, CPU for the small classes
+    # where the ~70ms tunnel round-trip dominates).
     big100k = dict(n_c=16384, n_v=100_000, deg=4, seed=42, reps=3)
-    dev100k = run_stage("dev", timeout=2400, errors=errors,
-                        cpu=cpu_fallback, **big100k)
-    if dev100k is None and not cpu_fallback:
-        # accelerator answered the probe but died solving: retry on CPU
-        cpu_fallback = True
-        detail["platform"] = "cpu"
-        dev100k = run_stage("dev", timeout=2400, errors=errors, cpu=True,
+    dev100k = None
+    if accel:   # the initial probe just succeeded; no need to re-probe
+        dev100k = run_stage("dev", timeout=2400, errors=errors,
+                            cpu=False, **big100k)
+    dev100k_cpu = run_stage("dev", timeout=2400, errors=errors, cpu=True,
                             **big100k)
     if dev100k:
         detail["dev_100k"] = dev100k
+    if dev100k_cpu:
+        detail["dev_100k_cpu"] = dev100k_cpu
+
+    def best_ms(*stage_outs):
+        cands = [v for out in stage_outs if out
+                 for k, v in out.items() if k.startswith("ms_")]
+        return min(cands) if cands else None
 
     # --- speedup vs exact host solver on maxmin_bench classes ----------
     classes = [("big 2000x2000", dict(n_c=2000, n_v=2000, deg=3, seed=1)),
@@ -258,20 +283,30 @@ def main() -> None:
                 host_slow = True  # next class is ~100x: skip its host stage
         if native is None and host is None:
             break
-        dev = run_stage("dev", timeout=900, errors=errors,
-                        cpu=cpu_fallback, reps=5, **params)
+        dev_acc = None
+        if accel and probe_accel(errors, tries=2) is not None:
+            dev_acc = run_stage("dev", timeout=900, errors=errors,
+                                cpu=False, reps=5, **params)
+        dev = run_stage("dev", timeout=900, errors=errors, cpu=True,
+                        reps=5, **params)
         detail[name] = {"host_ms": host["ms"] if host else "skipped",
                         "native_ms": native["ms"] if native else "failed",
                         "dev": dev if dev else "failed"}
-        if dev:
-            dev_ms = min(v for k, v in dev.items() if k.startswith("ms_"))
+        if dev_acc:
+            detail[name]["dev_accel"] = dev_acc
+        dev_ms = best_ms(dev, dev_acc)
+        if dev_ms:
             base_ms = native["ms"] if native else host["ms"]
             speedup = round(base_ms / dev_ms, 2) if dev_ms > 0 else None
             speedup_class = name + ("" if native else " (vs host python)")
 
-    value = None
-    if dev100k:
-        value = min(v for k, v in dev100k.items() if k.startswith("ms_"))
+    value = best_ms(dev100k, dev100k_cpu)
+    # the reported platform is the backend the headline number actually
+    # came from — a dead TPU stage must not attribute the CPU fallback
+    # latency to the accelerator
+    if value is not None and value != best_ms(dev100k):
+        detail["platform"] = "cpu"
+    detail["headline_platform"] = detail["platform"]
 
     result = {
         "metric": (f"LMM solve latency @{big100k['n_v']} flows on "

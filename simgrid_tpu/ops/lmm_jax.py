@@ -56,10 +56,14 @@ class LmmArrays(NamedTuple):
     n_var: int
 
 
-def _bucket(n: int) -> int:
-    """Round up to a bucketed size to bound XLA recompiles."""
-    if n <= 16:
-        return 16
+def _bucket(n: int, floor: int = 16) -> int:
+    """Round up to a bucketed power-of-2 size to bound XLA recompiles.
+    ELL row widths pass floor=4: every padded slot is gathered in EVERY
+    round and the tunneled-TPU gather cost is proportional to gathered
+    elements, so a deg-4 graph packed at width 16 would pay 4x on each
+    vc-side gather."""
+    if n <= floor:
+        return floor
     return 1 << (n - 1).bit_length()
 
 
@@ -84,6 +88,11 @@ class LmmEllArrays(NamedTuple):
     v_bound: np.ndarray
     n_cnst: int
     n_var: int
+    #: [V, Wv] float — element weight in VARIABLE-row layout.  The
+    #: var-side rows are near-unpadded (width = max var degree, usually
+    #: the flow's route length), so the vc-centric round body gathers/
+    #: scatters ~2-4x fewer elements than the constraint-side tables.
+    vc_w: Optional[np.ndarray] = None
 
 
 #: Conversion to ELL is refused when a row would exceed this width
@@ -107,7 +116,7 @@ def ell_from_arrays(arrays: LmmArrays) -> Optional[LmmEllArrays]:
     wv = int(v_deg.max()) if E else 1
     if wc > _ELL_MAX_WIDTH or wv > _ELL_MAX_WIDTH:
         return None
-    Wc, Wv = _bucket(max(wc, 1)), _bucket(max(wv, 1))
+    Wc, Wv = _bucket(max(wc, 1), floor=4), _bucket(max(wv, 1), floor=4)
     if E and (C * Wc + V * Wv) > _ELL_MAX_FILL * 2 * E:
         return None
 
@@ -129,14 +138,16 @@ def ell_from_arrays(arrays: LmmArrays) -> Optional[LmmEllArrays]:
 
     vc_cnst = np.zeros((V, Wv), np.int32)
     vc_valid = np.zeros((V, Wv), bool)
+    vc_w = np.zeros((V, Wv), arrays.e_w.dtype)
     order, rows, slots = row_slots(e_var, V)
     vc_cnst[rows, slots] = e_cnst[order]
     vc_valid[rows, slots] = e_w[order] > 0
+    vc_w[rows, slots] = e_w[order]
 
     return LmmEllArrays(cv_var, cv_w, cv_valid, vc_cnst, vc_valid,
                         arrays.c_bound, arrays.c_fatpipe,
                         arrays.v_penalty, arrays.v_bound,
-                        arrays.n_cnst, arrays.n_var)
+                        arrays.n_cnst, arrays.n_var, vc_w)
 
 
 def _run_rounds(cond, body, carry, max_rounds: int, unroll: bool):
@@ -168,13 +179,16 @@ def fixpoint_ell(ell: LmmEllArrays, eps, carry=None,
                  parallel_rounds: bool = False,
                  max_rounds: Optional[int] = None,
                  return_carry: bool = False,
-                 unroll: bool = False):
+                 unroll: bool = False,
+                 has_bounds: bool = True,
+                 has_fatpipe: bool = True):
     """The saturate-bottleneck fixpoint on the ELL layout: identical
     round structure and epsilon semantics to `fixpoint` (see there for
     the algorithm), with every segment reduction expressed as a masked
     dense 2D row-reduction."""
     cv_var, cv_w, cv_valid = ell.cv_var, ell.cv_w, ell.cv_valid
     vc_cnst, vc_valid = ell.vc_cnst, ell.vc_valid
+    vc_w = ell.vc_w
     c_bound, c_fatpipe = ell.c_bound, ell.c_fatpipe
     v_penalty, v_bound = ell.v_penalty, ell.v_bound
     n_c = c_bound.shape[0]
@@ -198,8 +212,9 @@ def fixpoint_ell(ell: LmmEllArrays, eps, carry=None,
     v_fixed0 = v_penalty < 0
 
     if carry is None:
+        cv_live0 = cv_evalid & ~jnp.take(v_fixed0, cv_var)
         carry = (v_value0, v_fixed0, remaining0, usage0, light0,
-                 jnp.array(0, jnp.int32))
+                 jnp.array(0, jnp.int32), cv_live0)
     start_it = carry[5]
     if max_rounds is None:
         max_rounds = _MAX_ROUNDS
@@ -214,13 +229,21 @@ def fixpoint_ell(ell: LmmEllArrays, eps, carry=None,
                 & (it - start_it < max_rounds))
 
     def apply_fixes(state, fix_now, new_value):
-        v_value, v_fixed, remaining, usage, light, it = state
+        v_value, v_fixed, remaining, usage, light, it = state[:6]
+        cv_live_in = state[6]
         v_value = jnp.where(fix_now, new_value, v_value)
         v_fixed = v_fixed | fix_now
 
-        cv_fix = cv_evalid & jnp.take(fix_now, cv_var)
-        d_rem = jnp.where(cv_fix, cv_w * jnp.take(v_value, cv_var),
-                          0.0).sum(axis=1)
+        # one stacked row-gather instead of three element gathers: the
+        # tunneled-TPU gather cost is per INDEX, so fetching both
+        # channels [v_value, v_fixed] per slot is ~free.  fix_now needs
+        # no channel: newly-fixed = (was live at round start) & (fixed
+        # now), and the round-start liveness rides the carry.
+        stacked = jnp.stack([v_value, v_fixed.astype(dtype)], axis=1)
+        g = jnp.take(stacked, cv_var, axis=0)
+        g_fixed = g[..., 1] > 0
+        cv_fix = cv_live_in & g_fixed
+        d_rem = jnp.where(cv_fix, cv_w * g[..., 0], 0.0).sum(axis=1)
         d_use = jnp.where(cv_fix, cv_upen, 0.0).sum(axis=1)
 
         new_remaining = remaining - d_rem
@@ -229,24 +252,31 @@ def fixpoint_ell(ell: LmmEllArrays, eps, carry=None,
         new_usage_sum = usage - d_use
         new_usage_sum = jnp.where(new_usage_sum < eps, 0.0, new_usage_sum)
 
-        cv_live2 = cv_evalid & ~jnp.take(v_fixed, cv_var)
-        new_usage_max = jnp.where(cv_live2, cv_upen,
-                                  0.0).max(axis=1, initial=0.0)
-
+        cv_live2 = cv_evalid & ~g_fixed
         touched = cv_fix.any(axis=1)
-        new_usage = jnp.where(c_fatpipe, new_usage_max, new_usage_sum)
-        usage = jnp.where(touched, new_usage, usage)
-        remaining = jnp.where(touched & ~c_fatpipe, new_remaining,
-                              remaining)
+        if has_fatpipe:
+            new_usage_max = jnp.where(cv_live2, cv_upen,
+                                      0.0).max(axis=1, initial=0.0)
+            new_usage = jnp.where(c_fatpipe, new_usage_max, new_usage_sum)
+            usage = jnp.where(touched, new_usage, usage)
+            remaining = jnp.where(touched & ~c_fatpipe, new_remaining,
+                                  remaining)
+        else:
+            # static specialization: no fatpipe constraint in the
+            # system, so the max-usage recompute drops out
+            usage = jnp.where(touched, new_usage_sum, usage)
+            remaining = jnp.where(touched, new_remaining, remaining)
 
         drop = touched & (~(usage > eps) | ~(remaining > c_bound * eps))
         light = light & ~drop
         has_live = cv_live2.any(axis=1)
         light = light & has_live
-        return v_value, v_fixed, remaining, usage, light, it + 1
+        # the fresh liveness mask rides the carry so the next round
+        # does not re-gather v_fixed over the cv table
+        return v_value, v_fixed, remaining, usage, light, it + 1, cv_live2
 
     def body_global(state):
-        v_value, v_fixed, remaining, usage, light, it = state
+        v_value, v_fixed, remaining, usage, light, it = state[:6]
         rou = jnp.where(light, remaining / jnp.where(light, usage, 1.0),
                         inf)
         min_usage = jnp.min(rou)
@@ -269,29 +299,31 @@ def fixpoint_ell(ell: LmmEllArrays, eps, carry=None,
         return apply_fixes(state, fix_now, new_value)
 
     def body_local(state):
-        v_value, v_fixed, remaining, usage, light, it = state
+        v_value, v_fixed, remaining, usage, light, it = state[:6]
         rou = jnp.where(light, remaining / jnp.where(light, usage, 1.0),
                         inf)
         vc_live = vc_evalid & ~v_fixed[:, None]
-        cv_live = cv_evalid & ~jnp.take(v_fixed, cv_var)
+        cv_live = state[6]        # maintained by apply_fixes
 
         # Two-hop neighborhood min of rou: constraint -> vars -> cnst.
-        nmin_v = jnp.where(vc_live, jnp.take(rou, vc_cnst),
+        # rou_vc is gathered ONCE and reused for nmin_v and level2_v.
+        rou_vc = jnp.take(rou, vc_cnst)
+        nmin_v = jnp.where(vc_live, rou_vc,
                            inf).min(axis=1, initial=jnp.inf)
         nmin_c = jnp.where(cv_live, jnp.take(nmin_v, cv_var),
                            inf).min(axis=1, initial=jnp.inf)
         processable = light & (rou <= nmin_c)
 
-        v_sat = (vc_live & jnp.take(processable, vc_cnst)).any(axis=1)
-        level_v = nmin_v
+        vc_proc = vc_live & jnp.take(processable, vc_cnst)
+        v_sat = vc_proc.any(axis=1)
 
+        level_v = nmin_v
         bp = v_bound * v_penalty
         low_v = v_sat & (v_bound > 0) & (bp < level_v)
         cv_bp = jnp.where(cv_live & jnp.take(low_v, cv_var),
                           jnp.take(bp, cv_var), inf)
         mb_c = cv_bp.min(axis=1, initial=jnp.inf)
         mb_c = jnp.where(processable, mb_c, inf)
-        vc_proc = vc_live & jnp.take(processable, vc_cnst)
         mb_v = jnp.where(vc_proc, jnp.take(mb_c, vc_cnst),
                          inf).min(axis=1, initial=jnp.inf)
         cv_proc = cv_live & processable[:, None]
@@ -300,7 +332,7 @@ def fixpoint_ell(ell: LmmEllArrays, eps, carry=None,
 
         ok_c = processable & ~blocked_c
         level2_v = jnp.where(vc_live & jnp.take(ok_c, vc_cnst),
-                             jnp.take(rou, vc_cnst),
+                             rou_vc,
                              inf).min(axis=1, initial=jnp.inf)
 
         fix_bound = low_v & (jnp.abs(bp - mb_v) < eps)
@@ -311,9 +343,86 @@ def fixpoint_ell(ell: LmmEllArrays, eps, carry=None,
                                                    1.0))
         return apply_fixes(state, fix_now, new_value)
 
-    out = _run_rounds(cond, body_local if parallel_rounds else body_global,
-                      carry, max_rounds, unroll)
-    v_value, v_fixed, remaining, usage, light, rounds = out
+    vc_upen_v = (jnp.where(vc_evalid, vc_w, 0.0)
+                 / jnp.where(v_enabled, v_penalty, 1.0)[:, None]
+                 ) if vc_w is not None else None
+    vc_flat = vc_cnst.ravel() if vc_w is not None else None
+
+    def body_local_vc(state):
+        """The bound-free local round written entirely in the VARIABLE-
+        row layout: 2 element gathers + 2 scatters over the near-
+        unpadded vc tables.  On the tunneled TPU both gather and
+        scatter cost ~6 ns per ELEMENT, so working on [V, Wv] (~1x
+        element count) instead of the padded [C, Wc] tables (~2.6x)
+        and replacing constraint-row reductions with scatters more
+        than halves the round latency (bench_results/
+        tpu_round_profile.jsonl)."""
+        v_value, v_fixed, remaining, usage, light, it = state[:6]
+        vc_live = vc_evalid & ~v_fixed[:, None]
+        rou = jnp.where(light, remaining / jnp.where(light, usage, 1.0),
+                        inf)
+        rou_vc = jnp.take(rou, vc_cnst)
+        nmin_v = jnp.where(vc_live, rou_vc,
+                           inf).min(axis=1, initial=jnp.inf)
+        el_nmin = jnp.where(vc_live, nmin_v[:, None], inf)
+        nmin_c = jnp.full(n_c, jnp.inf, dtype).at[vc_flat].min(
+            el_nmin.ravel())
+        processable = light & (rou <= nmin_c)
+        vc_proc = vc_live & jnp.take(processable, vc_cnst)
+        level2_v = jnp.where(vc_proc, rou_vc,
+                             inf).min(axis=1, initial=jnp.inf)
+        fix_now = jnp.isfinite(level2_v) & ~v_fixed
+        new_value = level2_v / jnp.where(v_enabled, v_penalty, 1.0)
+        v_value = jnp.where(fix_now, new_value, v_value)
+        v_fixed = v_fixed | fix_now
+
+        # newly-fixed contributions + liveness census in one stacked
+        # 3-channel scatter-add; `touched` needs no channel of its own
+        # (valid elements have strictly positive w/penalty, so d_use>0
+        # exactly when some element of the row was newly fixed)
+        el_fix = vc_live & fix_now[:, None]
+        live2 = vc_live & ~fix_now[:, None]
+        contrib = jnp.stack(
+            [jnp.where(el_fix, vc_w * v_value[:, None], 0.0),
+             jnp.where(el_fix, vc_upen_v, 0.0),
+             live2.astype(dtype)], axis=-1)
+        sums = jnp.zeros((n_c, 3), dtype).at[vc_flat].add(
+            contrib.reshape(-1, 3))
+        d_rem, d_use = sums[:, 0], sums[:, 1]
+        touched = d_use > 0
+        has_live = sums[:, 2] > 0
+
+        new_remaining = remaining - d_rem
+        new_remaining = jnp.where(new_remaining < c_bound * eps, 0.0,
+                                  new_remaining)
+        new_usage_sum = usage - d_use
+        new_usage_sum = jnp.where(new_usage_sum < eps, 0.0,
+                                  new_usage_sum)
+        if has_fatpipe:
+            el_upen = jnp.where(live2, vc_upen_v, 0.0)
+            usage_max = jnp.zeros(n_c, dtype).at[vc_flat].max(
+                el_upen.ravel())
+            new_usage = jnp.where(c_fatpipe, usage_max, new_usage_sum)
+            usage = jnp.where(touched, new_usage, usage)
+            remaining = jnp.where(touched & ~c_fatpipe, new_remaining,
+                                  remaining)
+        else:
+            usage = jnp.where(touched, new_usage_sum, usage)
+            remaining = jnp.where(touched, new_remaining, remaining)
+
+        drop = touched & (~(usage > eps) | ~(remaining > c_bound * eps))
+        light = light & ~drop & has_live
+        return (v_value, v_fixed, remaining, usage, light, it + 1,
+                state[6])
+
+    if parallel_rounds and not has_bounds and vc_w is not None:
+        body = body_local_vc
+    elif parallel_rounds:
+        body = body_local
+    else:
+        body = body_global
+    out = _run_rounds(cond, body, carry, max_rounds, unroll)
+    v_value, v_fixed, remaining, usage, light, rounds = out[:6]
     if return_carry:
         return v_value, remaining, usage, rounds, out
     return v_value, remaining, usage, rounds
@@ -533,19 +642,22 @@ def fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
 
 @functools.partial(jax.jit,
                    static_argnames=("eps", "parallel_rounds", "chunk",
-                                    "unroll"))
+                                    "unroll", "has_bounds",
+                                    "has_fatpipe"))
 def _solve_ell_chunk(cv_var, cv_w, cv_valid, vc_cnst, vc_valid, c_bound,
-                     c_fatpipe, v_penalty, v_bound, carry,
+                     c_fatpipe, v_penalty, v_bound, vc_w, carry,
                      eps: float, parallel_rounds: bool, chunk: int,
-                     unroll: bool = False):
+                     unroll: bool = False, has_bounds: bool = True,
+                     has_fatpipe: bool = True):
     """eps is static: it is fixed per run (maxmin/precision), and a
     traced scalar would be one more host->device transfer per chunk —
     each costing hundreds of ms of latency on a tunneled accelerator."""
     ell = LmmEllArrays(cv_var, cv_w, cv_valid, vc_cnst, vc_valid, c_bound,
-                       c_fatpipe, v_penalty, v_bound, 0, 0)
+                       c_fatpipe, v_penalty, v_bound, 0, 0, vc_w)
     return fixpoint_ell(ell, jnp.asarray(eps, cv_w.dtype), carry=carry,
                         parallel_rounds=parallel_rounds, max_rounds=chunk,
-                        return_carry=True, unroll=unroll)
+                        return_carry=True, unroll=unroll,
+                        has_bounds=has_bounds, has_fatpipe=has_fatpipe)
 
 
 #: Device-resident copies of solver inputs, keyed by (kind, ids,
@@ -695,7 +807,12 @@ def use_local_rounds() -> bool:
 # dispatch under ~10s worst-case while local-rounds solves typically
 # finish in one.
 _CHUNK_ROUNDS = 4096
-_CHUNK_ROUNDS_ACCEL = 64
+#: Local-rounds solves converge in O(10-100) rounds and the vc-centric
+#: ELL round is ~2-17 ms of device time, so 256 rounds per dispatch
+#: stays well under the axon watchdog while letting every practical
+#: solve finish in ONE dispatch (each host sync costs a ~70 ms tunnel
+#: round-trip); the while_loop cond exits early once converged.
+_CHUNK_ROUNDS_ACCEL = 256
 #: Rounds per dispatch in unrolled mode: compile time scales linearly
 #: with the unroll factor, so keep chunks small — local-rounds solves
 #: typically converge in O(10) rounds anyway.
@@ -728,8 +845,13 @@ def solve_arrays(arrays: LmmArrays, eps: float, device=None,
         if mode not in ("auto", "on", "off"):
             raise ValueError(f"Unknown lmm/unroll {mode!r} "
                              "(expected auto, on or off)")
-        unroll = (mode == "on"
-                  or (mode == "auto" and _default_platform() != "cpu"))
+        # 'auto' now means OFF everywhere: the round-4 on-chip profile
+        # (bench_results/tpu_round_profile.jsonl) shows while_loop
+        # gathers lower fine on the axon TPU — the round-3 serialized-
+        # gather pathology was the wedged chip, not the lowering — and
+        # unrolling only multiplies compile time.  'on' stays available
+        # as the escape hatch.
+        unroll = mode == "on"
     if chunk is None:
         chunk = _CHUNK_ROUNDS_UNROLL if unroll else _default_chunk()
 
@@ -742,17 +864,25 @@ def solve_arrays(arrays: LmmArrays, eps: float, device=None,
         ell = _ell_cached(arrays)
 
     eps_f = float(eps)
+    # static specialization: systems with no active variable bound
+    # (the common network/bench case) compile a round body with half
+    # the gathers — decided HOST-side so it stays a compile-time flag
+    has_bounds = bool(np.any((arrays.v_bound[:arrays.n_var] > 0)
+                             & (arrays.v_penalty[:arrays.n_var] > 0)))
+    has_fatpipe = bool(np.any(arrays.c_fatpipe[:arrays.n_cnst]))
     if ell is not None:
         args = _device_args(
             "ell",
             [ell.cv_var, ell.cv_w, ell.cv_valid, ell.vc_cnst,
              ell.vc_valid, ell.c_bound, ell.c_fatpipe, ell.v_penalty,
-             ell.v_bound], device)
+             ell.v_bound, ell.vc_w], device)
 
         def run_chunk(carry):
             return _solve_ell_chunk(*args, carry, eps=eps_f,
                                     parallel_rounds=parallel_rounds,
-                                    chunk=chunk, unroll=unroll)
+                                    chunk=chunk, unroll=unroll,
+                                    has_bounds=has_bounds,
+                                    has_fatpipe=has_fatpipe)
     else:
         args = _device_args(
             "coo",
