@@ -1,0 +1,201 @@
+"""s4u-cloud-simple replica (reference
+examples/s4u/cloud-simple/s4u-cloud-simple.cpp): computation and
+communication on PMs and VMs, collocation, and live migration."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.plugins.vm import VirtualMachine, migrate
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_test")
+
+
+def computation_fun():
+    clock_sta = s4u.Engine.get_clock()
+    s4u.this_actor.execute(1_000_000)
+    clock_end = s4u.Engine.get_clock()
+    LOG.info("%s:%s task executed %g"
+             % (s4u.this_actor.get_host().name, s4u.this_actor.get_name(),
+                clock_end - clock_sta))
+
+
+def launch_computation_worker(host):
+    s4u.Actor.create("compute", host, computation_fun)
+
+
+def communication_tx_fun(mbox_name):
+    mbox = s4u.Mailbox.by_name(mbox_name)
+    payload = (s4u.this_actor.get_host(), s4u.this_actor.get_name(),
+               s4u.Engine.get_clock())
+    mbox.put(payload, 1_000_000)
+
+
+def communication_rx_fun(mbox_name):
+    actor_name = s4u.this_actor.get_name()
+    host_name = s4u.this_actor.get_host().name
+    mbox = s4u.Mailbox.by_name(mbox_name)
+    tx_host, tx_name, clock_sta = mbox.get()
+    clock_end = s4u.Engine.get_clock()
+    LOG.info("%s:%s to %s:%s => %g sec"
+             % (tx_host.name, tx_name, host_name, actor_name,
+                clock_end - clock_sta))
+
+
+def launch_communication_worker(tx_host, rx_host):
+    mbox_name = "MBOX:%s-%s" % (tx_host.name, rx_host.name)
+    s4u.Actor.create("comm_tx", tx_host, communication_tx_fun, mbox_name)
+    s4u.Actor.create("comm_rx", rx_host, communication_rx_fun, mbox_name)
+
+
+def master_main():
+    e = s4u.Engine.get_instance()
+    pm0 = e.host_by_name("Fafard")
+    pm1 = e.host_by_name("Tremblay")
+    pm2 = e.host_by_name("Bourassa")
+
+    LOG.info("## Test 1 (started): check computation on normal PMs")
+    LOG.info("### Put a task on a PM")
+    launch_computation_worker(pm0)
+    s4u.this_actor.sleep_for(2)
+
+    LOG.info("### Put two tasks on a PM")
+    launch_computation_worker(pm0)
+    launch_computation_worker(pm0)
+    s4u.this_actor.sleep_for(2)
+
+    LOG.info("### Put a task on each PM")
+    launch_computation_worker(pm0)
+    launch_computation_worker(pm1)
+    s4u.this_actor.sleep_for(2)
+    LOG.info("## Test 1 (ended)")
+
+    LOG.info("## Test 2 (started): check impact of running a task inside"
+             " a VM (there is no degradation for the moment)")
+    LOG.info("### Put a VM on a PM, and put a task to the VM")
+    vm0 = VirtualMachine("VM0", pm0, 1)
+    vm0.start()
+    launch_computation_worker(vm0)
+    s4u.this_actor.sleep_for(2)
+    vm0.destroy()
+    LOG.info("## Test 2 (ended)")
+
+    LOG.info("## Test 3 (started): check impact of running a task "
+             "collocated with a VM (there is no VM noise for the moment)")
+    LOG.info("### Put a VM on a PM, and put a task to the PM")
+    vm0 = VirtualMachine("VM0", pm0, 1)
+    vm0.start()
+    launch_computation_worker(pm0)
+    s4u.this_actor.sleep_for(2)
+    vm0.destroy()
+    LOG.info("## Test 3 (ended)")
+
+    LOG.info("## Test 4 (started): compare the cost of running two tasks"
+             " inside two different VMs collocated or not (for the moment"
+             ", there is no degradation for the VMs. Hence, the time "
+             "should be equals to the time of test 1")
+    LOG.info("### Put two VMs on a PM, and put a task to each VM")
+    vm0 = VirtualMachine("VM0", pm0, 1)
+    vm0.start()
+    vm1 = VirtualMachine("VM1", pm0, 1)
+    launch_computation_worker(vm0)
+    launch_computation_worker(vm1)
+    s4u.this_actor.sleep_for(2)
+    vm0.destroy()
+    vm1.destroy()
+
+    LOG.info("### Put a VM on each PM, and put a task to each VM")
+    vm0 = VirtualMachine("VM0", pm0, 1)
+    vm1 = VirtualMachine("VM1", pm1, 1)
+    vm0.start()
+    vm1.start()
+    launch_computation_worker(vm0)
+    launch_computation_worker(vm1)
+    s4u.this_actor.sleep_for(2)
+    vm0.destroy()
+    vm1.destroy()
+    LOG.info("## Test 4 (ended)")
+
+    LOG.info("## Test 5  (started): Analyse network impact")
+    LOG.info("### Make a connection between PM0 and PM1")
+    launch_communication_worker(pm0, pm1)
+    s4u.this_actor.sleep_for(5)
+
+    LOG.info("### Make two connection between PM0 and PM1")
+    launch_communication_worker(pm0, pm1)
+    launch_communication_worker(pm0, pm1)
+    s4u.this_actor.sleep_for(5)
+
+    LOG.info("### Make a connection between PM0 and VM0@PM0")
+    vm0 = VirtualMachine("VM0", pm0, 1)
+    vm0.start()
+    launch_communication_worker(pm0, vm0)
+    s4u.this_actor.sleep_for(5)
+    vm0.destroy()
+
+    LOG.info("### Make a connection between PM0 and VM0@PM1")
+    vm0 = VirtualMachine("VM0", pm1, 1)
+    launch_communication_worker(pm0, vm0)
+    s4u.this_actor.sleep_for(5)
+    vm0.destroy()
+
+    LOG.info("### Make two connections between PM0 and VM0@PM1")
+    vm0 = VirtualMachine("VM0", pm1, 1)
+    vm0.start()
+    launch_communication_worker(pm0, vm0)
+    launch_communication_worker(pm0, vm0)
+    s4u.this_actor.sleep_for(5)
+    vm0.destroy()
+
+    LOG.info("### Make a connection between PM0 and VM0@PM1, and also "
+             "make a connection between PM0 and PM1")
+    vm0 = VirtualMachine("VM0", pm1, 1)
+    vm0.start()
+    launch_communication_worker(pm0, vm0)
+    launch_communication_worker(pm0, pm1)
+    s4u.this_actor.sleep_for(5)
+    vm0.destroy()
+
+    LOG.info("### Make a connection between VM0@PM0 and PM1@PM1, and "
+             "also make a connection between VM0@PM0 and VM1@PM1")
+    vm0 = VirtualMachine("VM0", pm0, 1)
+    vm1 = VirtualMachine("VM1", pm1, 1)
+    vm0.start()
+    vm1.start()
+    launch_communication_worker(vm0, vm1)
+    launch_communication_worker(vm0, vm1)
+    s4u.this_actor.sleep_for(5)
+    vm0.destroy()
+    vm1.destroy()
+    LOG.info("## Test 5 (ended)")
+
+    LOG.info("## Test 6 (started): Check migration impact (not yet "
+             "implemented neither on the CPU resource nor on the network"
+             " one")
+    LOG.info("### Relocate VM0 between PM0 and PM1")
+    vm0 = VirtualMachine("VM0", pm0, 1, ramsize=1024 * 1024 * 1024)
+    vm0.start()
+    launch_communication_worker(vm0, pm2)
+    s4u.this_actor.sleep_for(0.01)
+    migrate(vm0, pm1)
+    s4u.this_actor.sleep_for(0.01)
+    migrate(vm0, pm0)
+    s4u.this_actor.sleep_for(5)
+    vm0.destroy()
+    LOG.info("## Test 6 (ended)")
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    s4u.Actor.create("master_", e.host_by_name("Fafard"), master_main)
+    e.run()
+    LOG.info("Simulation time %g" % e.get_clock())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+"""s4u-energy-exec replica (reference
+examples/s4u/energy-exec/s4u-energy-exec.cpp): host_energy plugin with
+pstate switches and a powered-off host."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.plugins import host_energy
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_test")
+
+
+def fmt0e(x):
+    """%.0E with C's two-digit exponent collapsed like glibc prints."""
+    return "%.0E" % x
+
+
+def dvfs():
+    e = s4u.Engine.get_instance()
+    host1 = e.host_by_name("MyHost1")
+    host2 = e.host_by_name("MyHost2")
+
+    LOG.info("Energetic profile: %s" % host1.properties["watt_per_state"])
+    LOG.info("Initial peak speed=%s flop/s; Energy dissipated =%s J"
+             % (fmt0e(host1.get_speed()),
+                fmt0e(host_energy.get_consumed_energy(host1))))
+
+    start = s4u.Engine.get_clock()
+    LOG.info("Sleep for 10 seconds")
+    s4u.this_actor.sleep_for(10)
+    LOG.info("Done sleeping (duration: %.2f s). Current peak speed=%s; "
+             "Energy dissipated=%.2f J"
+             % (s4u.Engine.get_clock() - start, fmt0e(host1.get_speed()),
+                host_energy.get_consumed_energy(host1)))
+
+    start = s4u.Engine.get_clock()
+    flop_amount = 100e6
+    LOG.info("Run a task of %s flops" % fmt0e(flop_amount))
+    s4u.this_actor.execute(flop_amount)
+    LOG.info("Task done (duration: %.2f s). Current peak speed=%s flop/s;"
+             " Current consumption: from %.0fW to %.0fW depending on load"
+             "; Energy dissipated=%.0f J"
+             % (s4u.Engine.get_clock() - start, fmt0e(host1.get_speed()),
+                host_energy.get_watt_min_at(host1, host1.get_pstate()),
+                host_energy.get_watt_max_at(host1, host1.get_pstate()),
+                host_energy.get_consumed_energy(host1)))
+
+    pstate = 2
+    host1.set_pstate(pstate)
+    LOG.info("========= Requesting pstate %d (speed should be of %s "
+             "flop/s and is of %s flop/s)"
+             % (pstate, fmt0e(host1.get_pstate_speed(pstate)),
+                fmt0e(host1.get_speed())))
+
+    start = s4u.Engine.get_clock()
+    LOG.info("Run a task of %s flops" % fmt0e(flop_amount))
+    s4u.this_actor.execute(flop_amount)
+    LOG.info("Task done (duration: %.2f s). Current peak speed=%s flop/s;"
+             " Energy dissipated=%.0f J"
+             % (s4u.Engine.get_clock() - start, fmt0e(host1.get_speed()),
+                host_energy.get_consumed_energy(host1)))
+
+    start = s4u.Engine.get_clock()
+    LOG.info("Sleep for 4 seconds")
+    s4u.this_actor.sleep_for(4)
+    LOG.info("Done sleeping (duration: %.2f s). Current peak speed=%s "
+             "flop/s; Energy dissipated=%.0f J"
+             % (s4u.Engine.get_clock() - start, fmt0e(host1.get_speed()),
+                host_energy.get_consumed_energy(host1)))
+
+    LOG.info("Turning MyHost2 off, and sleeping another 10 seconds. "
+             "MyHost2 dissipated %.0f J so far."
+             % host_energy.get_consumed_energy(host2))
+    host2.turn_off()
+    start = s4u.Engine.get_clock()
+    s4u.this_actor.sleep_for(10)
+    LOG.info("Done sleeping (duration: %.2f s). Current peak speed=%s "
+             "flop/s; Energy dissipated=%.0f J"
+             % (s4u.Engine.get_clock() - start, fmt0e(host1.get_speed()),
+                host_energy.get_consumed_energy(host1)))
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    host_energy.host_energy_plugin_init(e)
+    s4u.Actor.create("dvfs_test", e.host_by_name("MyHost1"), dvfs)
+    e.run()
+    LOG.info("End of simulation.")
+
+
+if __name__ == "__main__":
+    main()

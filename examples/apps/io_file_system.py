@@ -1,0 +1,80 @@
+"""s4u-io-file-system replica (reference
+examples/s4u/io-file-system/s4u-io-file-system.cpp): file create/read/
+write/move/unlink through the file_system plugin, storage usage info."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.plugins import file_system
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_test")
+
+
+def show_info(mounts):
+    LOG.info("Storage info on %s:" % s4u.this_actor.get_host().name)
+    for mountpoint, storage in mounts.items():
+        used = file_system.storage_used_size(storage)
+        total = int(storage.size)
+        LOG.info("    %s (%s) Used: %d; Free: %d; Total: %d."
+                 % (storage.name, mountpoint, used, total - used, total))
+
+
+def host():
+    e = s4u.Engine.get_instance()
+    mounts = file_system._mounts_of(s4u.this_actor.get_host(), e.pimpl)
+
+    show_info(mounts)
+
+    filename = "/home/tmp/data.txt"
+    f = file_system.File(filename)
+
+    write = f.write(200000)
+    LOG.info("Create a %d bytes file named '%s' on /sd1"
+             % (write, filename))
+
+    show_info(mounts)
+
+    file_size = f.get_size()
+    f.seek(0)
+    read = f.read(file_size)
+    LOG.info("Read %d bytes on %s" % (read, filename))
+
+    write = f.write(100000)
+    LOG.info("Write %d bytes on %s" % (write, filename))
+
+    storage = next(st for st in mounts.values() if st.name == "Disk4")
+
+    newpath = "/home/tmp/simgrid.readme"
+    LOG.info("Move '%s' to '%s'" % (filename, newpath))
+    f.move(newpath)
+
+    f.userdata = "777"
+    LOG.info("User data attached to the file: %s" % f.userdata)
+
+    LOG.info("Get/set data for storage element: %s" % storage.name)
+    LOG.info("    Uninitialized storage data: '%s'"
+             % (getattr(storage, "userdata", None) or "(null)"))
+    storage.userdata = "Some user data"
+    LOG.info("    Set and get data: '%s'" % storage.userdata)
+
+    LOG.info("Unlink file: '%s'" % newpath)
+    f.unlink()
+
+    show_info(mounts)
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    file_system.file_system_plugin_init(e)
+    s4u.Actor.create("host", e.host_by_name("denise"), host)
+    e.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""s4u-app-masterworkers replica (reference
+examples/s4u/app-masterworkers/s4u-app-masterworkers-class.cpp):
+round-robin task dispatch over mailbox-named workers, deployment XML."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_app_masterworker")
+
+
+def master(*args):
+    tasks_count = int(args[0])
+    compute_cost = float(args[1])
+    communicate_cost = float(args[2])
+    workers = [s4u.Mailbox.by_name(name) for name in args[3:]]
+
+    LOG.info("Got %d workers and %d tasks to process"
+             % (len(workers), tasks_count))
+
+    for i in range(tasks_count):
+        mailbox = workers[i % len(workers)]
+        if (tasks_count < 10000 or (tasks_count < 100000
+                                    and i % 10000 == 0)
+                or i % 100000 == 0):
+            LOG.info("Sending task %d of %d to mailbox '%s'"
+                     % (i, tasks_count, mailbox.name))
+        mailbox.put(compute_cost, communicate_cost)
+
+    LOG.info("All tasks have been dispatched. "
+             "Request all workers to stop.")
+    for i in range(len(workers)):
+        workers[i % len(workers)].put(-1.0, 0)
+
+
+def worker(*args):
+    assert not args, "The worker expects to not get any argument"
+    mailbox = s4u.Mailbox.by_name(s4u.this_actor.get_host().name)
+    while True:
+        compute_cost = mailbox.get()
+        if compute_cost > 0:
+            s4u.this_actor.execute(compute_cost)
+        else:
+            break
+    LOG.info("Exiting now.")
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.register_function("master", master)
+    e.register_function("worker", worker)
+    e.load_platform(sys.argv[1])
+    e.load_deployment(sys.argv[2])
+    e.run()
+    LOG.info("Simulation is over")
+
+
+if __name__ == "__main__":
+    main()

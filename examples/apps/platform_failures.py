@@ -1,0 +1,86 @@
+"""s4u-platform-failures replica (reference
+examples/s4u/platform-failures/s4u-platform-failures.cpp): state
+profiles turn hosts/links off and on; RESTART actors come back; comms
+fail or time out and the master keeps going."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.exceptions import NetworkFailureException, TimeoutException
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_test")
+
+
+def master(*args):
+    number_of_tasks = int(args[0])
+    comp_size = float(args[1])
+    comm_size = float(args[2])
+    workers_count = int(args[3])
+
+    LOG.info("Got %d workers and %d tasks to process"
+             % (workers_count, number_of_tasks))
+
+    for i in range(number_of_tasks):
+        mailbox = s4u.Mailbox.by_name("worker-%d" % (i % workers_count))
+        try:
+            LOG.info("Send a message to %s" % mailbox.name)
+            mailbox.put(comp_size, comm_size, timeout=10.0)
+            LOG.info("Send to %s completed" % mailbox.name)
+        except TimeoutException:
+            LOG.info("Mmh. Got timeouted while speaking to '%s'. "
+                     "Nevermind. Let's keep going!" % mailbox.name)
+        except NetworkFailureException:
+            LOG.info("Mmh. The communication with '%s' failed. "
+                     "Nevermind. Let's keep going!" % mailbox.name)
+
+    LOG.info("All tasks have been dispatched. Let's tell everybody the "
+             "computation is over.")
+    for i in range(workers_count):
+        mailbox = s4u.Mailbox.by_name("worker-%d" % i)
+        try:
+            mailbox.put(-1.0, 0, timeout=1.0)
+        except TimeoutException:
+            LOG.info("Mmh. Got timeouted while speaking to '%s'. "
+                     "Nevermind. Let's keep going!" % mailbox.name)
+        except NetworkFailureException:
+            LOG.info("Mmh. Something went wrong with '%s'. Nevermind. "
+                     "Let's keep going!" % mailbox.name)
+
+    LOG.info("Goodbye now!")
+
+
+def worker(*args):
+    wid = int(args[0])
+    mailbox = s4u.Mailbox.by_name("worker-%d" % wid)
+    while True:
+        try:
+            LOG.info("Waiting a message on %s" % mailbox.name)
+            comp_size = mailbox.get()
+            if comp_size < 0:
+                LOG.info("I'm done. See you!")
+                break
+            LOG.info("Start execution...")
+            s4u.this_actor.execute(comp_size)
+            LOG.info("Execution complete.")
+        except NetworkFailureException:
+            LOG.info("Mmh. Something went wrong. Nevermind. "
+                     "Let's keep going!")
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    e.register_function("master", master)
+    e.register_function("worker", worker)
+    e.load_deployment(sys.argv[2])
+    e.run()
+    LOG.info("Simulation time %g" % e.get_clock())
+
+
+if __name__ == "__main__":
+    main()

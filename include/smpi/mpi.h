@@ -465,6 +465,19 @@ int MPI_Reduce_scatter_block(const void* sendbuf, void* recvbuf,
 
 /* xbt concatenation helpers: the reference's smpi.h include chain
  * provides them (xbt/base.h) and its patched mpich3 tests use them */
+/* xbt allocation helpers: the reference's smpi.h include chain pulls
+ * in xbt/sysdep.h and its tests use these without any extra include
+ * (teshsuite/smpi/coll-allreduce/coll-allreduce.c:30) */
+#include <stdlib.h>
+#ifndef xbt_new0
+#define xbt_new(type, count) ((type*)malloc((count) * sizeof(type)))
+#define xbt_new0(type, count) ((type*)calloc((count), sizeof(type)))
+#define xbt_malloc(n) malloc(n)
+#define xbt_malloc0(n) calloc(1, (n))
+#define xbt_free(p) free(p)
+#define xbt_free_f free
+#endif
+
 #ifndef _XBT_CONCAT
 #define _XBT_CONCAT(a, b) a##b
 #define _XBT_CONCAT3(a, b, c) a##b##c

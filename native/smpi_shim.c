@@ -1274,13 +1274,52 @@ int MPI_Attr_get(MPI_Comm comm, int keyval, void* value, int* flag) {
 int MPI_Attr_delete(MPI_Comm comm, int keyval) {
   return MPI_Comm_delete_attr(comm, keyval);
 }
+/* Per-window info store, kept entirely C-side like the info objects
+ * themselves: the simulation kernel treats hints as opaque
+ * (rma/win_info checks the set->get round trip). */
+static MPI_Info* smpi_win_info_tbl = 0;
+static int smpi_win_info_cap = 0;
+static MPI_Info* smpi_win_info_slot(MPI_Win win) {
+  int i;
+  if (win < 0) return 0;
+  if (win >= smpi_win_info_cap) {
+    int ncap = smpi_win_info_cap ? smpi_win_info_cap * 2 : 64;
+    MPI_Info* grown;
+    while (ncap <= win) ncap *= 2;
+    grown = (MPI_Info*)realloc(smpi_win_info_tbl, ncap * sizeof(MPI_Info));
+    if (!grown) return 0;   /* out of memory: hints are best-effort */
+    smpi_win_info_tbl = grown;
+    for (i = smpi_win_info_cap; i < ncap; i++)
+      smpi_win_info_tbl[i] = MPI_INFO_NULL;
+    smpi_win_info_cap = ncap;
+  }
+  return &smpi_win_info_tbl[win];
+}
+static void smpi_win_record_info(const MPI_Win* win, MPI_Info info) {
+  MPI_Info* slot;
+  if (!win) return;
+  slot = smpi_win_info_slot(*win);
+  if (!slot) return;
+  if (*slot != MPI_INFO_NULL) MPI_Info_free(slot);
+  if (info != MPI_INFO_NULL) MPI_Info_dup(info, slot);
+  else *slot = MPI_INFO_NULL;
+}
+
 int MPI_Win_create(void* base, MPI_Aint size, int disp_unit,
                    MPI_Info info, MPI_Comm comm, MPI_Win* win) {
-  (void)info;
-  CALL(SMPI_OP_WIN_CREATE, A(base), A(size), A(disp_unit), A(comm),
-       A(win));
+  int rc;
+  smpi_arg_t args_[] = {A(base), A(size), A(disp_unit), A(comm), A(win)};
+  if (!smpi_dispatch) return MPI_ERR_INTERN;
+  rc = smpi_dispatch(SMPI_OP_WIN_CREATE, args_);
+  if (rc == MPI_SUCCESS) smpi_win_record_info(win, info);
+  return rc;
 }
-int MPI_Win_free(MPI_Win* win) { CALL(SMPI_OP_WIN_FREE, A(win)); }
+int MPI_Win_free(MPI_Win* win) {
+  if (win && *win >= 0 && *win < smpi_win_info_cap &&
+      smpi_win_info_tbl[*win] != MPI_INFO_NULL)
+    MPI_Info_free(&smpi_win_info_tbl[*win]);
+  CALL(SMPI_OP_WIN_FREE, A(win));
+}
 int MPI_Win_fence(int assertion, MPI_Win win) {
   CALL(SMPI_OP_WIN_FENCE, A(assertion), A(win));
 }
@@ -1380,16 +1419,31 @@ int MPI_Rget_accumulate(const void* origin_addr, int origin_count,
 }
 int MPI_Win_allocate(MPI_Aint size, int disp_unit, MPI_Info info,
                      MPI_Comm comm, void* baseptr, MPI_Win* win) {
-  CALL(SMPI_OP_WIN_ALLOCATE, A(size), A(disp_unit), A(info), A(comm),
-       A(baseptr), A(win));
+  int rc;
+  smpi_arg_t args_[] = {A(size), A(disp_unit), A(info), A(comm),
+                        A(baseptr), A(win)};
+  if (!smpi_dispatch) return MPI_ERR_INTERN;
+  rc = smpi_dispatch(SMPI_OP_WIN_ALLOCATE, args_);
+  if (rc == MPI_SUCCESS) smpi_win_record_info(win, info);
+  return rc;
 }
 int MPI_Win_allocate_shared(MPI_Aint size, int disp_unit, MPI_Info info,
                             MPI_Comm comm, void* baseptr, MPI_Win* win) {
-  CALL(SMPI_OP_WIN_ALLOCATE_SHARED, A(size), A(disp_unit), A(info), A(comm),
-       A(baseptr), A(win));
+  int rc;
+  smpi_arg_t args_[] = {A(size), A(disp_unit), A(info), A(comm),
+                        A(baseptr), A(win)};
+  if (!smpi_dispatch) return MPI_ERR_INTERN;
+  rc = smpi_dispatch(SMPI_OP_WIN_ALLOCATE_SHARED, args_);
+  if (rc == MPI_SUCCESS) smpi_win_record_info(win, info);
+  return rc;
 }
 int MPI_Win_create_dynamic(MPI_Info info, MPI_Comm comm, MPI_Win* win) {
-  CALL(SMPI_OP_WIN_CREATE_DYNAMIC, A(info), A(comm), A(win));
+  int rc;
+  smpi_arg_t args_[] = {A(info), A(comm), A(win)};
+  if (!smpi_dispatch) return MPI_ERR_INTERN;
+  rc = smpi_dispatch(SMPI_OP_WIN_CREATE_DYNAMIC, args_);
+  if (rc == MPI_SUCCESS) smpi_win_record_info(win, info);
+  return rc;
 }
 int MPI_Win_attach(MPI_Win win, void* base, MPI_Aint size) {
   CALL(SMPI_OP_WIN_ATTACH, A(win), A(base), A(size));
@@ -1479,11 +1533,24 @@ int MPI_Win_call_errhandler(MPI_Win win, int errorcode) {
   CALL(SMPI_OP_WIN_CALL_ERRHANDLER, A(win), A(errorcode));
 }
 int MPI_Win_get_info(MPI_Win win, MPI_Info* info) {
-  (void)win;
+  MPI_Info* slot = smpi_win_info_slot(win);
+  if (slot && *slot != MPI_INFO_NULL) return MPI_Info_dup(*slot, info);
   return MPI_Info_create(info);
 }
 int MPI_Win_set_info(MPI_Win win, MPI_Info info) {
-  (void)win; (void)info;
+  /* merge the supplied hints into the window's info (MPI-3 11.2.7) */
+  MPI_Info* slot = smpi_win_info_slot(win);
+  int n = 0, i;
+  char key[MPI_MAX_INFO_KEY + 1], val[MPI_MAX_INFO_VAL + 1];
+  int flag;
+  if (!slot || info == MPI_INFO_NULL) return MPI_SUCCESS;
+  if (*slot == MPI_INFO_NULL) MPI_Info_create(slot);
+  MPI_Info_get_nkeys(info, &n);
+  for (i = 0; i < n; i++) {
+    MPI_Info_get_nthkey(info, i, key);
+    MPI_Info_get(info, key, MPI_MAX_INFO_VAL, val, &flag);
+    if (flag) MPI_Info_set(*slot, key, val);
+  }
   return MPI_SUCCESS;
 }
 

@@ -115,6 +115,17 @@ class Cpu(Resource):
 
     def set_state_profile(self, profile: profile_mod.Profile) -> None:
         self.state_event = profile.schedule(self.model.engine.future_evt_set, self)
+        # a profile whose first point is (0, 0) means the host exists
+        # but starts OFF: apply eagerly so a deployment parsed before
+        # run() already sees it down (platform-failures: Fafard's
+        # '0 0' profile + 'Cannot launch actor on failed host')
+        # (delta encoding: event_list[0].date is the delay before the
+        # first real point, whose value sits in event_list[1])
+        if (len(profile.event_list) > 1
+                and profile.event_list[0].date == 0.0
+                and profile.event_list[1].value == 0.0
+                and self.is_on()):
+            self.host.turn_off()
 
     def apply_event(self, event: profile_mod.Event, value: float) -> None:
         # reference CpuCas01::apply_event
@@ -124,6 +135,12 @@ class Cpu(Resource):
         elif event is self.state_event:
             if value > 0:
                 if not self.is_on():
+                    # CpuCas01::apply_event logs at verbose before the
+                    # reboot (platform-failures tesh runs with
+                    # --log=surf_cpu.t:verbose to see these)
+                    from ..utils import log as _log
+                    _log.get_category("surf_cpu").verbose(
+                        "Restart processes on host %s" % self.host.name)
                     self.host.turn_on()
             else:
                 date = self.model.engine.now

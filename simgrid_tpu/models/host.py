@@ -28,6 +28,10 @@ class Host:
         self.netpoint = None              # routing endpoint
         self.actor_list: List = []
         self.properties: Dict[str, str] = {}
+        self.mounts: Dict[str, str] = {}   # mount point -> storage id
+        #: boot specs: every deployment actor + every actor that called
+        #: set_auto_restart (HostImpl::actors_at_boot_)
+        self.actors_at_boot: list = []
         self.storages: Dict[str, object] = {}
         self.data = None
         engine.hosts[name] = self
@@ -52,9 +56,24 @@ class Host:
             self.cpu.turn_off()
             for actor in list(self.actor_list):
                 self.engine.maestro.kill(actor)
+            # keep only the specs that should reboot with the host
+            # (HostImpl::turn_off's remove_if)
+            self.actors_at_boot = [spec for spec in self.actors_at_boot
+                                   if spec.get("auto_restart")]
             Host.on_state_change(self)
 
     def engine_on_host_restart(self) -> None:
+        # boot every recorded spec (HostImpl::turn_on)
+        specs, self.actors_at_boot = self.actors_at_boot, []
+        for spec in specs:
+            from ..s4u.actor import Actor
+            actor = Actor.create(spec["name"], self, spec["code"],
+                                 *spec.get("args", ()))
+            if spec.get("kill_time", -1) >= 0:
+                actor.set_kill_time(spec["kill_time"])
+            if spec.get("auto_restart"):
+                actor.pimpl.auto_restart = True
+                self.actors_at_boot.append(spec)
         restart = getattr(self.engine, "on_host_restart", None)
         if restart is not None:
             restart(self)

@@ -123,6 +123,14 @@ class NetworkL07Model(NetworkModel):
 
 
 class CpuL07(Cpu):
+    def __init__(self, model, host, speed_per_pstate, core_count=1):
+        super().__init__(model, host, speed_per_pstate, core_count)
+        # the L07 cpu constraint ignores multicore: the reference
+        # creates it with the bare pstate speed (ptask_L07.cpp:240),
+        # not core_count x speed (energy-exec ptask tesh pins this)
+        model.system.update_constraint_bound(self.constraint,
+                                             speed_per_pstate[0])
+
     def execution_start(self, size: float,
                         requested_cores: int = 1) -> "L07Action":
         flops = [size]
@@ -144,6 +152,11 @@ class CpuL07(Cpu):
             if action is not None:
                 self.model.system.update_variable_bound(
                     action.variable, self.speed_scale * self.speed_peak)
+        # fire the host-level speed-change signal like the Cas01 cpu
+        # (Cpu::on_speed_change): the energy plugin tracks pstate
+        # switches through it (energy-exec ptask oracle)
+        from .cpu import Host_on_speed_change
+        Host_on_speed_change(self.host)
 
 
 class LinkL07(LinkImpl):

@@ -85,6 +85,11 @@ def setup_models(engine) -> None:
     host_model_name = config["host/model"]
     if host_model_name == "ptask_L07":
         from .ptask_l07 import HostL07Model
+        from ..utils import log as _log
+        # surf_host_model_init_ptask_L07 announces the switch on
+        # xbt_cfg (ptask_L07.cpp:21; energy-exec.tesh pins the line)
+        _log.get_category("xbt_cfg").info(
+            "Switching to the L07 model to handle parallel tasks.")
         HostL07Model(engine)
         return
     host_models[host_model_name](engine)

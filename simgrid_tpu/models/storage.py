@@ -124,6 +124,7 @@ def parse_storage_tag(loader, elem, zone) -> None:
                 model_props[child.get("id")] = child.get("value")
         _storage_types[elem.get("id")] = {
             "size": parse_size(elem.get("size", "0")),
+            "content": elem.get("content", ""),
             "props": props,
             "model_props": model_props,
         }
@@ -137,7 +138,8 @@ def parse_storage_tag(loader, elem, zone) -> None:
         if engine.storage_model is None:
             StorageN11Model(engine)
         engine.storage_model.create_storage(
-            elem.get("id"), type_id, elem.get("content", ""),
+            elem.get("id"), type_id,
+            elem.get("content") or st.get("content", ""),
             elem.get("attach", ""), read_bw, write_bw, st["size"])
     elif elem.tag == "mount":
         storage = engine.storages.get(elem.get("storageId"))

@@ -72,6 +72,9 @@ class PlatformLoader:
         root = tree.getroot()
         from .dtd import validate
         validate(root, path)
+        # remember where the platform file lives: storage content files
+        # resolve against it (and against the 'path' config entries)
+        self.engine.platform_dir = self.base_dir
         for child in root:
             self._dispatch_toplevel(child, None)
         if self.engine.netzone_root is not None:
@@ -91,6 +94,10 @@ class PlatformLoader:
             self.trace_connect_list.append(dict(elem.attrib))
         elif tag == "config":
             self._parse_config(elem)
+        elif tag == "cluster":
+            # a top-level <cluster> IS the platform (the DTD allows it;
+            # energy_cluster.xml) — it becomes its own root zone
+            self._parse_cluster(elem, zone)
         elif tag == "prop":
             pass
         else:
@@ -162,6 +169,12 @@ class PlatformLoader:
         for child in elem:
             if child.tag == "prop":
                 host.properties[child.get("id")] = child.get("value")
+            elif child.tag == "mount":
+                # <mount storageId=... name=...>: per-HOST mount table
+                # (a storage can be attached to one host and mounted
+                # on another — storage.xml mounts alice's Disk2 on
+                # denise as 'c:')
+                host.mounts[child.get("name")] = child.get("storageId")
         from ..models.host import Host as H
         H.on_creation(host)
 

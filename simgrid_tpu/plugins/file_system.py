@@ -40,9 +40,17 @@ def _storage_ext(storage) -> FileSystemStorageExt:
 
 
 def _mounts_of(host, engine) -> Dict[str, object]:
-    """mount_point -> storage for one host: every storage attached to
-    the host, mounted at its <mount name=...> point (default '/')."""
+    """mount_point -> storage for one host: the host's <mount> table
+    when present (it may mount storages attached elsewhere), otherwise
+    every storage attached to the host at its default point."""
     mounts = {}
+    table = getattr(host, "mounts", None)
+    if table:
+        for point, storage_id in table.items():
+            storage = engine.storages.get(storage_id)
+            if storage is not None:
+                mounts[point] = storage
+        return mounts
     for storage in engine.storages.values():
         if storage.attach == host.name:
             mounts[getattr(storage, "mount_point", "/") or "/"] = storage
@@ -164,7 +172,53 @@ def storage_content(storage) -> Dict[str, int]:
     return _storage_ext(storage).content
 
 
+def _load_contents(impl) -> None:
+    """Populate each storage's content map from its declared content
+    file (path + size per line); files resolve against the platform
+    file's directory and the 'path' config entries."""
+    import os
+
+    from ..utils.config import config
+    search = [getattr(impl, "platform_dir", "."), config["path"], "."]
+    for storage in impl.storages.values():
+        content_name = getattr(storage, "content_name", "")
+        if not content_name or id(storage) in _EXT:
+            continue
+        for base in search:
+            candidate = os.path.join(base, content_name)
+            if os.path.isfile(candidate):
+                ext = _storage_ext(storage)
+                with open(candidate) as fh:
+                    for line in fh:
+                        parts = line.split()
+                        if len(parts) == 2:
+                            ext.content[parts[0]] = int(parts[1])
+                ext.used_size = sum(ext.content.values())
+                break
+
+
 def file_system_plugin_init(engine=None) -> None:
-    """sg_storage_file_system_init: content maps start empty and fill
-    lazily; nothing else to hook (files are purely host-side state)."""
+    """sg_storage_file_system_init: loads declared storage contents so
+    used/free sizes match the platform description.  Works in either
+    call order: storages already created are loaded now, and a
+    platform loaded LATER (the reference's mandatory init-first order)
+    is handled through the platform-created hook."""
     _EXT.clear()
+    if engine is None:
+        from ..s4u.engine import Engine
+        engine = Engine._instance
+        if engine is None:
+            # no engine yet: defer everything to the platform hook
+            from ..kernel.engine import EngineImpl
+
+            def on_created():
+                from ..s4u.engine import Engine as E
+                if E._instance is not None:
+                    _load_contents(E._instance.pimpl)
+            EngineImpl.on_platform_created.connect(on_created)
+            return
+    impl = getattr(engine, "pimpl", engine)
+    _load_contents(impl)
+    from ..kernel.engine import EngineImpl
+    impl.connect_signal(EngineImpl.on_platform_created,
+                        lambda: _load_contents(impl))

@@ -179,6 +179,31 @@ def host_energy_plugin_init(engine=None) -> None:
 
     impl.connect_signal(EngineImpl.on_simulation_end, on_end)
 
+    # Per-host consumption reports at engine teardown (the reference
+    # logs them from on_host_destruction, which runs after main's last
+    # statement); atexit mirrors that ordering for the Python engine.
+    # One registration per engine: a re-init on the same engine must
+    # not double the report lines.
+    import atexit
+
+    if getattr(impl, "_host_energy_atexit", False):
+        return
+    impl._host_energy_atexit = True
+
+    def per_host_report(engine_impl=impl):
+        from ..s4u.engine import Engine
+        current = Engine._instance.pimpl if Engine._instance else None
+        if current is not engine_impl:
+            return                # a later engine replaced this one
+        for host in engine_impl.hosts.values():
+            he = _EXT.get(host)
+            if he is None or not he.power_ranges:
+                continue
+            _logger.info("Energy consumption of host %s: %f Joules",
+                         host.name, he.get_consumed_energy())
+
+    atexit.register(per_host_report)
+
 
 def get_consumed_energy(host) -> float:
     """sg_host_get_consumed_energy."""
@@ -186,6 +211,20 @@ def get_consumed_energy(host) -> float:
     assert he is not None, \
         "The Energy plugin is not active on this engine"
     return he.get_consumed_energy()
+
+
+def get_watt_min_at(host, pstate: int) -> float:
+    """sg_host_get_wattmin_at."""
+    he = _EXT.get(host)
+    assert he is not None
+    return he.get_watt_min_at(pstate)
+
+
+def get_watt_max_at(host, pstate: int) -> float:
+    """sg_host_get_wattmax_at."""
+    he = _EXT.get(host)
+    assert he is not None
+    return he.get_watt_max_at(pstate)
 
 
 def get_current_consumption(host) -> float:

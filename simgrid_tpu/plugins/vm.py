@@ -257,7 +257,7 @@ def migrate(vm: VirtualMachine, dst_pm: Host) -> None:
     def rx():
         while mbox.get() != _EOS:
             pass
-        done.put(b"", 4)
+        done.put(b"", 0)
 
     Actor.create(f"__mig_rx__{vm.name}", dst_pm, rx)
 
@@ -268,24 +268,32 @@ def migrate(vm: VirtualMachine, dst_pm: Host) -> None:
         mbox.put(b"m", max(size, 1.0))
         return Engine.get_clock() - t0
 
-    # Stage 1: the whole RAM working set.
-    elapsed = put(ramsize)
-    # Stage 2: iterative pre-copy of dirtied pages; geometric decrease
-    # unless the dirtying rate outruns the link.
-    threshold = ramsize * 0.01
-    updated = min(dp_intensity * ramsize * min(elapsed, 1.0),
-                  dp_cap * ramsize)
-    for _ in range(4):
-        if updated <= threshold:
-            break
-        elapsed = put(updated)
+    def tx():
+        # Stage 1: the whole RAM working set.
+        elapsed = put(ramsize)
+        # Stage 2: iterative pre-copy of dirtied pages; geometric
+        # decrease unless the dirtying rate outruns the link.
+        threshold = ramsize * 0.01
         updated = min(dp_intensity * ramsize * min(elapsed, 1.0),
                       dp_cap * ramsize)
-    # Stage 3: stop-and-copy.
-    vm.suspend()
-    if updated > 0:
-        put(updated)
-    mbox.put(_EOS, 4)      # close stream
+        for _ in range(4):
+            if updated <= threshold:
+                break
+            elapsed = put(updated)
+            updated = min(dp_intensity * ramsize * min(elapsed, 1.0),
+                          dp_cap * ramsize)
+        # Stage 3: stop-and-copy.
+        vm.suspend()
+        if updated > 0:
+            put(updated)
+        mbox.put(_EOS, 0)      # close stream (0-byte control msg,
+        # like the reference's stage-3 finalize + mbox_ctl ACK)
+
+    # The migration stream runs between the CURRENT physical host and
+    # the destination (sg_vm_migrate puts MigrationTx on src_pm): the
+    # caller may sit on a third host, and after a first migration the
+    # source is wherever the VM lives NOW — not where the caller is.
+    Actor.create(f"__mig_tx__{vm.name}", vm.pm, tx)
     done.get()
     vm.migrate_now(dst_pm)
     vm.resume()

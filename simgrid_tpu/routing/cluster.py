@@ -181,12 +181,19 @@ def parse_cluster_tag(loader, elem, father) -> None:
     zone.num_links_per_node += (1 if zone.has_loopback else 0) + \
         (1 if zone.has_limiter else 0)
 
+    # cluster-level <prop> entries are copied onto every created host
+    # (sg_platf.cpp:70-78; energy_cluster.xml sets watt_per_state here)
+    cluster_props = {child.get("id"): child.get("value")
+                     for child in elem if child.tag == "prop"}
+
     ids = parse_radical(radical)
     for rank, node_id in enumerate(ids):
         host_name = f"{prefix}{node_id}{suffix}"
         host = Host(engine, host_name)
         host.netpoint = NetPoint(engine, host_name, NetPointType.HOST, zone)
         engine.cpu_model.create_cpu(host, speed_list, core)
+        if cluster_props:
+            host.properties.update(cluster_props)
         zone.node_rank[host.netpoint.id] = rank
 
         if zone.has_loopback:

@@ -168,7 +168,22 @@ class Actor:
         engine.timer_set(time, lambda: engine.maestro.kill(target))
 
     def set_auto_restart(self, autorestart: bool = True) -> None:
+        already = self.pimpl.auto_restart
         self.pimpl.auto_restart = autorestart
+        host = self.pimpl.host
+        if not hasattr(host, "actors_at_boot"):
+            return
+        if autorestart and not already:
+            # programmatically-created actors record their boot spec on
+            # the host too (s4u::Actor::set_auto_restart appends a
+            # ProcessArg to actors_at_boot_); idempotent on re-enable
+            host.actors_at_boot.append(
+                {"name": self.pimpl.name, "code": self.pimpl.code,
+                 "args": (), "auto_restart": True, "owner": self.pimpl})
+        elif not autorestart:
+            host.actors_at_boot = [
+                spec for spec in host.actors_at_boot
+                if spec.get("owner") is not self.pimpl]
 
     def set_host(self, new_host) -> None:
         issuer = _current_impl()

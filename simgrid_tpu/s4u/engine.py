@@ -15,6 +15,10 @@ from ..kernel.engine import EngineImpl
 from ..models.registry import setup_models
 from ..platform.xml import PlatformLoader
 from ..utils.config import config
+from ..utils import log as _xlog
+
+#: deployment warnings (ActorImpl::start / sg_platf's catch)
+_deploy_log = _xlog.get_category("simix_process")
 from ..utils.signal import Signal
 
 
@@ -140,13 +144,33 @@ class Engine:
             kill_time = float(elem.get("kill_time", "-1"))
             on_failure = elem.get("on_failure", "DIE")
 
+            auto_restart = on_failure != "DIE"
+            # every deployment actor joins its host's boot list
+            # (sg_platf.cpp:447: unconditional emplace); turn_off
+            # prunes non-restart entries, turn_on reboots the rest
+            host.actors_at_boot.append(
+                {"name": func_name, "code": code, "args": args,
+                 "kill_time": kill_time, "auto_restart": auto_restart})
+
             def launch(code=code, args=args, host=host, name=func_name,
-                       kill_time=kill_time, on_failure=on_failure):
+                       kill_time=kill_time, auto_restart=auto_restart):
+                if not host.is_on():
+                    # ActorImpl::start + sg_platf's catch around it;
+                    # the failed creation still consumed a PID (the
+                    # ActorImpl was built before start() threw)
+                    self.pimpl.next_pid()
+                    _deploy_log.warning(
+                        "Cannot launch actor '%s' on failed host '%s'"
+                        % (name, host.name))
+                    _deploy_log.warning(
+                        "Deployment includes some initially turned off "
+                        "Hosts ... nevermind.")
+                    return None
                 actor = Actor.create(name, host, code, *args)
                 if kill_time >= 0:
                     actor.set_kill_time(kill_time)
-                if on_failure != "DIE":
-                    actor.set_auto_restart(True)
+                if auto_restart:
+                    actor.pimpl.auto_restart = True
                 return actor
 
             if start_time > 0:

@@ -1717,6 +1717,18 @@ def _h_allreduce(ctx, a):
     comm = _comm_of(ctx, a[5])
     if comm is None:
         return MPI_ERR_COMM
+    # argument validation BEFORE any communication (smpi_pmpi_coll.cpp
+    # order; teshsuite coll-allreduce probes each error path and the
+    # erroneous calls must not corrupt the later real exchange)
+    count_arg = int(ctypes.c_int(int(a[2]) & 0xFFFFFFFF).value)
+    if count_arg > 0 and (int(a[0]) == 0 or int(a[1]) == 0):
+        return 31                       # MPI_ERR_BUFFER (mpi.h:222)
+    if count_arg < 0:
+        return 6                        # MPI_ERR_COUNT
+    if int(a[3]) == 0:
+        return MPI_ERR_TYPE
+    if int(a[4]) == 0:
+        return 10                       # MPI_ERR_OP
     arr, rbuf, count, dt = _reduce_args(ctx, a)
     op = _op_of(ctx, a[4], dt, dt_handle=a[3], count=count)
     res = comm.allreduce(arr, op)
@@ -2794,29 +2806,52 @@ C_WIN_UNIFIED = 2
 
 class _RmaReq:
     """Request adapter for MPI_Rget/Rget_accumulate (reply in flight)
-    and the already-locally-complete Rput/Raccumulate (comm=None)."""
+    and the already-locally-complete Rput/Raccumulate (comm=None).
 
-    __slots__ = ("_comm", "_payload", "finished")
+    Delivery into the user buffer happens at the EARLIER of the next
+    window sync (unlock/flush/fence force-complete every outstanding
+    request — MPI-3 §11.5.4, rma/rget-unlock reuses the buffer right
+    after unlock_all) and MPI_Wait on the request; never twice."""
 
-    def __init__(self, comm=None):
+    __slots__ = ("_comm", "_payload", "_deliver", "finished")
+
+    def __init__(self, comm=None, deliver=None):
         self._comm = comm
         self._payload = None
+        self._deliver = deliver
         self.finished = comm is None
+
+    def _complete(self):
+        self._payload = self._comm.get_payload()[0]
+        self.finished = True
+        if self._deliver is not None:
+            self._deliver(self._payload)
+
+    def force(self) -> None:
+        """Window-sync completion: receive + deliver now."""
+        if not self.finished:
+            self._comm.wait()
+            self._complete()
 
     def wait(self):
         if not self.finished:
             self._comm.wait()
-            self._payload = self._comm.get_payload()[0]
-            self.finished = True
+            self._complete()
         return self._payload
 
     def test(self) -> bool:
         if self.finished:
             return True
         if self._comm.test():
-            self._payload = self._comm.get_payload()[0]
-            self.finished = True
+            self._complete()
             return True
+        # raw s4u activity: inject the smpi/test clock advance here —
+        # a busy Testall loop must let simulated time move or the
+        # in-flight reply never completes (rma/rget-testall)
+        sleep = config["smpi/test"]
+        if sleep > 0:
+            from ..s4u import this_actor
+            this_actor.sleep_for(sleep)
         return False
 
 
@@ -3124,9 +3159,10 @@ def _h_rma_get(ctx, a, with_req=False):
     nbytes = int(tcount) * tdt.size_
     if with_req:
         comm = entry["win"].c_get_async(trank, args, nbytes)
-        creq = _CReq(_RmaReq(comm), 0, None, "nbc",
-                     post=_scatter_closure(int(obuf), odt))
-        _write_i32(a[8], _new_req_handle(ctx, creq))
+        rreq = _RmaReq(comm, deliver=_scatter_closure(int(obuf), odt))
+        entry["win"].register_async(rreq)
+        _write_i32(a[8], _new_req_handle(ctx, _CReq(rreq, 0, None,
+                                                    "nbc")))
         return MPI_SUCCESS
     payload = entry["win"].c_get(trank, args, nbytes)
     _arr_out(int(obuf), payload, dt=odt)
@@ -3192,9 +3228,10 @@ def _h_rma_gacc(ctx, a, with_req=False):
     args = (int(tdisp), int(tcount), tdt, leaf.np_dtype)
     if with_req:
         comm = entry["win"].c_gacc_async(trank, args, payload, op, nbytes)
-        creq = _CReq(_RmaReq(comm), 0, None, "nbc",
-                     post=_scatter_closure(int(rbuf), rdt))
-        _write_i32(a[12], _new_req_handle(ctx, creq))
+        rreq = _RmaReq(comm, deliver=_scatter_closure(int(rbuf), rdt))
+        entry["win"].register_async(rreq)
+        _write_i32(a[12], _new_req_handle(ctx, _CReq(rreq, 0, None,
+                                                     "nbc")))
         return MPI_SUCCESS
     old = entry["win"].c_gacc(trank, args, payload, op, nbytes)
     _arr_out(int(rbuf), old, dt=rdt)

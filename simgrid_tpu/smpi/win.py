@@ -201,6 +201,7 @@ class Win:
         self._pscw_exposed: Optional[List[int]] = None
         self._trigger = None                # (pred, Semaphore) of main
         self._free_pending = False
+        self._async_reqs: List = []         # outstanding Rget/Rgacc
 
         me = runtime.this_rank_state()
         self._daemon = Actor.create(f"__win{self.win_id}_rma_{rank}",
@@ -475,6 +476,7 @@ class Win:
     def fence(self, assertion: int = 0) -> None:
         """Close the access+exposure epoch (Win::fence): every daemon
         has applied the traffic addressed to it, then a barrier."""
+        self._drain_async()
         for t in range(self.comm.size()):
             self._flush_fast(t)
         expected = self.comm.alltoall(list(self._sent_total))
@@ -507,6 +509,7 @@ class Win:
         """Close the access epoch: each target learns how many of my
         ops to expect; its wait() blocks until they are applied."""
         targets, self._pscw_targets = self._pscw_targets or [], None
+        self._drain_async()
         for t in targets:
             self._flush_fast(t)
             self._send(t, ("complete", self.rank, self._sent_total[t]),
@@ -542,6 +545,14 @@ class Win:
         if self._pscw_done():
             self._pscw_consume()
             return True
+        # an unsuccessful MPI_Win_test advances the clock a little, or
+        # a busy wait-for-exposure loop freezes simulated time forever
+        # (same smpi/test injection as MPI_Test; rma/wintest)
+        from ..utils.config import config
+        sleep = config["smpi/test"]
+        if sleep > 0:
+            from ..s4u import this_actor
+            this_actor.sleep_for(sleep)
         return False
 
     # ------------------------------------------------------------------
@@ -577,8 +588,21 @@ class Win:
         for t in range(self.comm.size()):
             self.unlock(t)
 
+    def register_async(self, rreq) -> None:
+        """Track a request-based op: window syncs (flush/unlock/fence/
+        complete) force-complete it so the user may reuse the result
+        buffer right after the sync (MPI-3 §11.5.4, rma/rget-unlock);
+        the later MPI_Wait is then a no-op."""
+        self._async_reqs.append(rreq)
+
+    def _drain_async(self) -> None:
+        for r in self._async_reqs:
+            r.force()
+        self._async_reqs.clear()
+
     def flush(self, target: int) -> None:
         """Remote completion of all my outstanding ops to ``target``."""
+        self._drain_async()
         self._flush_fast(target)
         if self._sent_total[target] == 0:
             return

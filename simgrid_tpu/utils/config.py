@@ -198,6 +198,10 @@ declare_flag("maxmin/precision",
 declare_flag("surf/precision",
              "Numerical precision used when comparing simulated times",
              1e-5)
+declare_flag("path",
+             "Lookup path for inclusions in platform and deployment "
+             "XML files",
+             "./")
 declare_flag("maxmin/concurrency-limit",
              "Maximum number of concurrent variables per resource (-1: none)",
              -1)

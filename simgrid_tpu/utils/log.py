@@ -257,7 +257,9 @@ def apply_control(control: str) -> None:
         if setting == "add":
             get_category(cat_name).additional.append(_make_appender(value))
             continue
-        if len(setting) < 2 or not "threshold".startswith(setting):
+        # any prefix of 'threshold' is accepted, down to the bare 't'
+        # the reference teshes use (s4u-platform-failures: surf_cpu.t)
+        if not setting or not "threshold".startswith(setting):
             raise ValueError(f"Unknown log setting {setting!r} in {token!r}")
         level = _LEVELS.get(value.lower())
         if level is None:

@@ -29,6 +29,18 @@ TEST_CONFIGS = {
     "bsendpending": ("smpi/simulate-computation:true",),
 }
 
+# helper translation units that are not standalone tests (no main)
+HELPER_SRC = {"mcs-mutex"}
+# tests that link a helper .c from the same dir
+EXTRA_SRC = {"mutex_bench": ["mcs-mutex.c"]}
+# template tests built per-operation via -DTEST_x in MPICH's makefiles;
+# sweep the PUT variant (the others are the same skeleton)
+EXTRA_DEFS = {
+    "wrma_flush_get": ["-DTEST_PUT"],
+    "win_shared_rma_flush_load": ["-DTEST_PUT"],
+    "overlap_wins_rma": ["-DTEST_PUT"],
+}
+
 
 def main() -> int:
     ap = argparse.ArgumentParser()
@@ -64,7 +76,8 @@ def main() -> int:
     except FileNotFoundError:
         pass
 
-    srcs = sorted(glob.glob(f"{M}/{d}/*.c"))
+    srcs = [s for s in sorted(glob.glob(f"{M}/{d}/*.c"))
+            if os.path.basename(s)[:-2] not in HELPER_SRC]
     if args.only:
         keep = set(args.only.split(","))
         srcs = [s for s in srcs if os.path.basename(s)[:-2] in keep]
@@ -83,12 +96,16 @@ def main() -> int:
             check = "assert any(c != 0 for c in codes.values()), codes"
         else:
             check = "assert all(c == 0 for c in codes.values()), codes"
+        extra_src = [f"{M}/{d}/{x}" for x in EXTRA_SRC.get(name, [])]
+        extra_defs = EXTRA_DEFS.get(name, [])
         code = f"""
 import sys; sys.path.insert(0, {REPO!r})
 from simgrid_tpu.smpi.c_api import compile_program, run_c_program
-compile_program([{src!r}, "{M}/util/mtest.c", "{M}/util/mtest_datatype.c",
+compile_program([{src!r}, *{extra_src!r},
+                 "{M}/util/mtest.c", "{M}/util/mtest_datatype.c",
                  "{M}/util/mtest_datatype_gen.c"],
-                "/tmp/mpich3/{d}-{name}.so", extra_flags=["-I{M}/include"])
+                "/tmp/mpich3/{d}-{name}.so",
+                extra_flags=["-I{M}/include", *{extra_defs!r}])
 engine, codes = run_c_program("/tmp/mpich3/{d}-{name}.so",
     np_ranks={np_ranks}, configs={cfgs!r})
 {check}
