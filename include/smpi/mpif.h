@@ -1,0 +1,66 @@
+! mpif.h — Fortran 77 MPI constants for the simulator (the role of the
+! reference's generated include/smpi/mpif.h).  Every value matches the
+! C handle in mpi.h: the binding layer (native/smpi_f77_gen.c +
+! hand-written wrappers in smpi_shim.c) treats Fortran handles as the
+! identity mapping of the C ones.
+      integer MPI_COMM_NULL, MPI_COMM_WORLD, MPI_COMM_SELF
+      parameter (MPI_COMM_NULL=0, MPI_COMM_WORLD=1, MPI_COMM_SELF=2)
+      integer MPI_SUCCESS, MPI_UNDEFINED, MPI_KEYVAL_INVALID
+      parameter (MPI_SUCCESS=0, MPI_UNDEFINED=-32766)
+      parameter (MPI_KEYVAL_INVALID=-1)
+      integer MPI_ANY_SOURCE, MPI_ANY_TAG, MPI_PROC_NULL, MPI_ROOT
+      parameter (MPI_ANY_SOURCE=-1, MPI_ANY_TAG=-1)
+      parameter (MPI_PROC_NULL=-2, MPI_ROOT=-3)
+      integer MPI_STATUS_SIZE, MPI_MAX_PROCESSOR_NAME
+      integer MPI_MAX_ERROR_STRING, MPI_ERR_LASTCODE
+      parameter (MPI_STATUS_SIZE=6, MPI_MAX_PROCESSOR_NAME=256)
+      parameter (MPI_MAX_ERROR_STRING=256, MPI_ERR_LASTCODE=74)
+      integer MPI_REQUEST_NULL, MPI_GROUP_NULL, MPI_GROUP_EMPTY
+      parameter (MPI_REQUEST_NULL=0, MPI_GROUP_NULL=0, MPI_GROUP_EMPTY=1)
+      integer MPI_INFO_NULL, MPI_WIN_NULL, MPI_DATATYPE_NULL
+      parameter (MPI_INFO_NULL=0, MPI_WIN_NULL=0, MPI_DATATYPE_NULL=0)
+      integer MPI_ERRHANDLER_NULL, MPI_ERRORS_RETURN
+      integer MPI_ERRORS_ARE_FATAL
+      parameter (MPI_ERRHANDLER_NULL=0, MPI_ERRORS_RETURN=1)
+      parameter (MPI_ERRORS_ARE_FATAL=2)
+      integer MPI_TAG_UB
+      parameter (MPI_TAG_UB=1)
+
+!     Fortran datatypes (handles shared with the C layer)
+      integer MPI_BYTE, MPI_PACKED, MPI_CHARACTER, MPI_LOGICAL
+      parameter (MPI_BYTE=1, MPI_PACKED=33)
+      parameter (MPI_CHARACTER=57, MPI_LOGICAL=56)
+      integer MPI_INTEGER, MPI_INTEGER1, MPI_INTEGER2
+      integer MPI_INTEGER4, MPI_INTEGER8
+      parameter (MPI_INTEGER=55, MPI_INTEGER1=49, MPI_INTEGER2=50)
+      parameter (MPI_INTEGER4=51, MPI_INTEGER8=52)
+      integer MPI_REAL, MPI_REAL4, MPI_REAL8, MPI_REAL16
+      integer MPI_DOUBLE_PRECISION
+      parameter (MPI_REAL=54, MPI_REAL4=43, MPI_REAL8=44, MPI_REAL16=45)
+      parameter (MPI_DOUBLE_PRECISION=61)
+      integer MPI_COMPLEX, MPI_COMPLEX8, MPI_COMPLEX16, MPI_COMPLEX32
+      parameter (MPI_COMPLEX=35, MPI_COMPLEX8=46, MPI_COMPLEX16=47)
+      parameter (MPI_COMPLEX32=48)
+      integer MPI_2INTEGER, MPI_2REAL, MPI_2DOUBLE_PRECISION
+      parameter (MPI_2REAL=58, MPI_2DOUBLE_PRECISION=59)
+      parameter (MPI_2INTEGER=60)
+
+!     reduction operators
+      integer MPI_OP_NULL, MPI_MAX, MPI_MIN, MPI_SUM, MPI_PROD
+      parameter (MPI_OP_NULL=0, MPI_MAX=1, MPI_MIN=2)
+      parameter (MPI_SUM=3, MPI_PROD=4)
+      integer MPI_LAND, MPI_BAND, MPI_LOR, MPI_BOR, MPI_LXOR, MPI_BXOR
+      parameter (MPI_LAND=5, MPI_BAND=6, MPI_LOR=7, MPI_BOR=8)
+      parameter (MPI_LXOR=9, MPI_BXOR=10)
+      integer MPI_MAXLOC, MPI_MINLOC
+      parameter (MPI_MAXLOC=11, MPI_MINLOC=12)
+
+      integer MPI_ADDRESS_KIND, MPI_OFFSET_KIND, MPI_COUNT_KIND
+      parameter (MPI_ADDRESS_KIND=8, MPI_OFFSET_KIND=8)
+      parameter (MPI_COUNT_KIND=8)
+
+!     MPI_IN_PLACE is intentionally NOT declared: the F77 in-place
+!     sentinel needs address-of-common detection in the shim, which is
+!     not wired yet — better a loud compile error than silent garbage.
+      double precision MPI_WTIME, MPI_WTICK
+      external MPI_WTIME, MPI_WTICK

@@ -2005,3 +2005,38 @@ void mpi_alltoall_(void* sendbuf, MPI_Fint* sendcount, MPI_Fint* sendtype,
                        recvbuf, *recvcount, SMPI_F2C_TYPE(recvtype),
                        SMPI_F2C_COMM(comm));
 }
+/* Completion calls returning request INDICES need hand translation:
+ * Fortran indices are 1-based, and MPI_UNDEFINED passes through
+ * unchanged (reference smpi_f77_request.cpp does the same +1). */
+void mpi_waitany_(MPI_Fint* count, MPI_Fint* requests, MPI_Fint* index,
+                  MPI_Fint* status, MPI_Fint* ierr) {
+  *ierr = MPI_Waitany(*count, requests, index, (MPI_Status*)status);
+  if (*index != MPI_UNDEFINED) *index += 1;
+}
+void mpi_testany_(MPI_Fint* count, MPI_Fint* requests, MPI_Fint* index,
+                  MPI_Fint* flag, MPI_Fint* status, MPI_Fint* ierr) {
+  *ierr = MPI_Testany(*count, requests, index, flag, (MPI_Status*)status);
+  if (*index != MPI_UNDEFINED) *index += 1;
+}
+void mpi_waitsome_(MPI_Fint* incount, MPI_Fint* requests,
+                   MPI_Fint* outcount, MPI_Fint* indices,
+                   MPI_Fint* statuses, MPI_Fint* ierr) {
+  int i;
+  *ierr = MPI_Waitsome(*incount, requests, outcount, indices,
+                       (MPI_Status*)statuses);
+  if (*outcount != MPI_UNDEFINED)
+    for (i = 0; i < *outcount; i++) indices[i] += 1;
+}
+void mpi_testsome_(MPI_Fint* incount, MPI_Fint* requests,
+                   MPI_Fint* outcount, MPI_Fint* indices,
+                   MPI_Fint* statuses, MPI_Fint* ierr) {
+  int i;
+  *ierr = MPI_Testsome(*incount, requests, outcount, indices,
+                       (MPI_Status*)statuses);
+  if (*outcount != MPI_UNDEFINED)
+    for (i = 0; i < *outcount; i++) indices[i] += 1;
+}
+
+/* Generated F77 wrappers for everything not hand-written above
+ * (tools/gen_f77.py over include/smpi/mpi.h). */
+#include "smpi_f77_gen.c"

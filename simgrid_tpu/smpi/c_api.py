@@ -1721,14 +1721,19 @@ def _h_allreduce(ctx, a):
     # order; teshsuite coll-allreduce probes each error path and the
     # erroneous calls must not corrupt the later real exchange)
     count_arg = int(ctypes.c_int(int(a[2]) & 0xFFFFFFFF).value)
-    if count_arg > 0 and (int(a[0]) == 0 or int(a[1]) == 0):
-        return 31                       # MPI_ERR_BUFFER (mpi.h:222)
     if count_arg < 0:
         return 6                        # MPI_ERR_COUNT
     if int(a[3]) == 0:
         return MPI_ERR_TYPE
     if int(a[4]) == 0:
         return 10                       # MPI_ERR_OP
+    if count_arg > 0 and (int(a[0]) == 0 or int(a[1]) == 0):
+        # address 0 is MPI_BOTTOM, legal with absolute-displacement
+        # typemaps; a contiguous datatype at NULL is the error the
+        # reference's CHECK_BUFFER reports (coll-allreduce probes it)
+        dt0 = ctx.dtypes.get(int(a[3]))
+        if dt0 is None or getattr(dt0, "c_segments", None) is None:
+            return 31                   # MPI_ERR_BUFFER (mpi.h:222)
     arr, rbuf, count, dt = _reduce_args(ctx, a)
     op = _op_of(ctx, a[4], dt, dt_handle=a[3], count=count)
     res = comm.allreduce(arr, op)

@@ -81,3 +81,107 @@ def test_f77_ring_and_allreduce(tmp_path, capfd):
     # ring: 42 + one increment per non-root rank; allreduce: 1+2+3+4
     assert "f77 ring token=45 allreduce=10.0" in stdout
     assert all(c == 0 for c in codes.values())
+
+
+F77_FAMILIES = r"""
+/* generated-wrapper families: datatype ctors, NBC, cart topology, RMA
+   and group algebra, driven by reference the way gfortran object code
+   calls them (all by reference, mangled lowercase_) */
+#include <mpi.h>
+#include <stdio.h>
+
+extern void mpi_init_(int*);
+extern void mpi_finalize_(int*);
+extern void mpi_comm_rank_(int*, int*, int*);
+extern void mpi_comm_size_(int*, int*, int*);
+extern void mpi_type_vector_(int*, int*, int*, int*, int*, int*);
+extern void mpi_type_commit_(int*, int*);
+extern void mpi_type_size_(int*, int*, int*);
+extern void mpi_type_free_(int*, int*);
+extern void mpi_ibarrier_(int*, int*, int*);
+extern void mpi_iallreduce_(void*, void*, int*, int*, int*, int*, int*, int*);
+extern void mpi_wait_(int*, int*, int*);
+extern void mpi_cart_create_(int*, int*, int*, int*, int*, int*, int*);
+extern void mpi_cart_coords_(int*, int*, int*, int*, int*);
+extern void mpi_comm_free_(int*, int*);
+extern void mpi_win_create_(void*, MPI_Aint*, int*, int*, int*, int*, int*);
+extern void mpi_win_fence_(int*, int*, int*);
+extern void mpi_put_(void*, int*, int*, int*, MPI_Aint*, int*, int*, int*, int*);
+extern void mpi_win_free_(int*, int*);
+extern void mpi_comm_group_(int*, int*, int*);
+extern void mpi_group_size_(int*, int*, int*);
+extern void mpi_group_free_(int*, int*);
+
+int main(int argc, char** argv) {
+    int ierr, rank, size, comm = MPI_COMM_WORLD;
+    mpi_init_(&ierr);
+    mpi_comm_rank_(&comm, &rank, &ierr);
+    mpi_comm_size_(&comm, &size, &ierr);
+
+    /* datatype constructor family */
+    int vec, three = 3, two = 2, stride = 4, base = MPI_INT, tsize;
+    mpi_type_vector_(&three, &two, &stride, &base, &vec, &ierr);
+    mpi_type_commit_(&vec, &ierr);
+    mpi_type_size_(&vec, &tsize, &ierr);
+    if (tsize != 24) { printf("BAD type_size %d\n", tsize); return 1; }
+    mpi_type_free_(&vec, &ierr);
+
+    /* nonblocking collectives */
+    int req, one = 1, op = MPI_SUM, dtype = MPI_INT;
+    int mine = rank + 1, total = 0;
+    mpi_iallreduce_(&mine, &total, &one, &dtype, &op, &comm, &req, &ierr);
+    mpi_wait_(&req, 0, &ierr);
+    if (total != size * (size + 1) / 2) { printf("BAD iallreduce %d\n", total); return 1; }
+    mpi_ibarrier_(&comm, &req, &ierr);
+    mpi_wait_(&req, 0, &ierr);
+
+    /* cart topology */
+    int cart, ndims = 2, dims[2] = {2, 2}, periods[2] = {1, 1},
+        reorder = 0, coords[2];
+    mpi_cart_create_(&comm, &ndims, dims, periods, &reorder, &cart, &ierr);
+    mpi_cart_coords_(&cart, &rank, &ndims, coords, &ierr);
+    if (coords[0] != rank / 2 || coords[1] != rank % 2) {
+        printf("BAD coords\n"); return 1; }
+    mpi_comm_free_(&cart, &ierr);
+
+    /* one-sided */
+    int winbuf[4] = {0, 0, 0, 0}, win, disp = (int)sizeof(int),
+        info = MPI_INFO_NULL, zero = 0, target = (rank + 1) % size;
+    MPI_Aint wsize = 4 * sizeof(int), tdisp = 0;
+    mpi_win_create_(winbuf, &wsize, &disp, &info, &comm, &win, &ierr);
+    mpi_win_fence_(&zero, &win, &ierr);
+    int val = 100 + rank;
+    mpi_put_(&val, &one, &dtype, &target, &tdisp, &one, &dtype, &win, &ierr);
+    mpi_win_fence_(&zero, &win, &ierr);
+    int left = (rank + size - 1) % size;
+    if (winbuf[0] != 100 + left) { printf("BAD rma %d\n", winbuf[0]); return 1; }
+    mpi_win_free_(&win, &ierr);
+
+    /* group algebra */
+    int grp, gsize;
+    mpi_comm_group_(&comm, &grp, &ierr);
+    mpi_group_size_(&grp, &gsize, &ierr);
+    if (gsize != size) { printf("BAD group size\n"); return 1; }
+    mpi_group_free_(&grp, &ierr);
+
+    if (rank == 0) printf("f77 families ok\n");
+    mpi_finalize_(&ierr);
+    return 0;
+}
+"""
+
+
+def test_f77_generated_families(tmp_path, capfd):
+    """Datatype ctors, NBC, cart topologies, RMA and group algebra all
+    reach the kernel through the GENERATED wrappers
+    (native/smpi_f77_gen.c, from tools/gen_f77.py)."""
+    from simgrid_tpu.smpi.c_api import compile_program, run_c_program
+    src = tmp_path / "f77fam.c"
+    src.write_text(F77_FAMILIES)
+    out = str(tmp_path / "f77fam.so")
+    compile_program([str(src)], out)
+    engine, codes = run_c_program(
+        out, np_ranks=4, configs=("smpi/simulate-computation:false",))
+    stdout = capfd.readouterr().out
+    assert "f77 families ok" in stdout, stdout[-600:]
+    assert all(c == 0 for c in codes.values()), codes

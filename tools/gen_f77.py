@@ -1,0 +1,159 @@
+#!/usr/bin/env python3
+"""Generate the Fortran-77 binding layer from the C prototypes in
+include/smpi/mpi.h (the approach the reference hand-writes across
+src/smpi/bindings/smpi_f77*.cpp, ~2,000 LoC).
+
+The gfortran ABI makes this mechanical: every argument is passed by
+reference, all handles are MPI_Fint (our C handles are ints, so
+translation is the identity), MPI_Status is ABI-identical to a
+6-integer array, and symbols are lowercase with a trailing underscore.
+So each wrapper simply dereferences scalars and forwards pointers.
+
+Skipped (hand-written in smpi_shim.c or not expressible in F77):
+functions taking function pointers, char* strings (hidden-length
+convention), varargs, or argv.  Output: native/smpi_f77_gen.c,
+#included at the end of native/smpi_shim.c and committed to the repo
+(regenerate with: python tools/gen_f77.py).
+"""
+
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HEADER = os.path.join(ROOT, "include", "smpi", "mpi.h")
+OUT = os.path.join(ROOT, "native", "smpi_f77_gen.c")
+
+#: C types that are ints by construction: deref an MPI_Fint*
+INT_LIKE = {
+    "int", "MPI_Comm", "MPI_Datatype", "MPI_Op", "MPI_Request",
+    "MPI_Group", "MPI_Info", "MPI_File", "MPI_Win", "MPI_Errhandler",
+    "MPI_Message",
+}
+#: 64-bit scalars: deref the wider Fortran kind
+WIDE = {"MPI_Aint", "MPI_Count", "MPI_Offset"}
+
+#: symbols already hand-written in smpi_shim.c (kept there because
+#: they need argc/argv, string, or status-shape special handling)
+def handwritten():
+    src = open(os.path.join(ROOT, "native", "smpi_shim.c")).read()
+    return set(re.findall(r"^(?:void|double) (mpi_[a-z0-9_]+_)\(", src,
+                          re.M))
+
+
+def parse_protos(text):
+    """Yield (name, [(type, is_ptr, is_array)]) for each
+    `int MPI_X(...)` prototype."""
+    text = re.sub(r"/\*.*?\*/", " ", text, flags=re.S)
+    for m in re.finditer(r"\bint\s+(MPI_[A-Za-z0-9_]+)\s*\(([^;{]*)\)\s*;",
+                         text):
+        if "typedef" in text[max(0, m.start() - 40):m.start()]:
+            continue                     # function TYPE, not a function
+        name, argstr = m.group(1), " ".join(m.group(2).split())
+        if not argstr or argstr == "void":
+            yield name, []
+            continue
+        args = []
+        ok = True
+        depth = 0
+        parts, cur = [], ""
+        for ch in argstr:
+            if ch == "," and depth == 0:
+                parts.append(cur)
+                cur = ""
+                continue
+            if ch in "([":
+                depth += 1
+            elif ch in ")]":
+                depth -= 1
+            cur += ch
+        parts.append(cur)
+        for p in parts:
+            p = p.strip()
+            if "(" in p or "..." in p:   # function pointer / varargs
+                ok = False
+                break
+            p = re.sub(r"\bconst\b", "", p).strip()
+            mm = re.match(r"([A-Za-z_][A-Za-z0-9_ ]*?)\s*(\*{0,3})\s*"
+                          r"([A-Za-z_][A-Za-z0-9_]*)?\s*(\[\s*\]|\[\s*3\s*\])?$", p)
+            if not mm:
+                ok = False
+                break
+            ctype = mm.group(1).strip()
+            ptr = len(mm.group(2) or "")
+            arr = bool(mm.group(4))
+            args.append((ctype, ptr, arr, mm.group(4) or ""))
+        if ok:
+            yield name, args
+
+
+def wrapper(name, args):
+    fname = name.lower() + "_"
+    params, call = [], []
+    for i, (ctype, ptr, arr, arrsfx) in enumerate(args):
+        an = "a%d" % i
+        if ctype == "char" or ctype.startswith("char"):
+            return None                  # hidden-length convention
+        if arr and arrsfx.strip("[] ") == "3":
+            # int ranges[][3]
+            params.append("MPI_Fint* %s" % an)
+            call.append("(int(*)[3])%s" % an)
+        elif arr and (ctype in INT_LIKE or ctype in WIDE):
+            # `type name[]` decays to a pointer: forward it
+            params.append("%s* %s" % (ctype, an))
+            call.append(an)
+        elif ptr == 0 and ctype in INT_LIKE:
+            params.append("MPI_Fint* %s" % an)
+            call.append("*%s" % an)
+        elif ptr == 0 and ctype in WIDE:
+            params.append("%s* %s" % (ctype, an))
+            call.append("*%s" % an)
+        elif ptr == 0 and ctype == "double":
+            params.append("double* %s" % an)
+            call.append("*%s" % an)
+        elif ptr == 1 and (ctype in INT_LIKE or ctype in WIDE
+                           or ctype == "double"):
+            params.append("%s* %s" % (ctype, an))
+            call.append(an)
+        elif ptr == 1 and ctype == "MPI_Status":
+            params.append("MPI_Fint* %s" % an)
+            call.append("(MPI_Status*)%s" % an)
+        elif ptr >= 1 and ctype == "void":
+            params.append("void* %s" % an)
+            call.append(an)
+        else:
+            return None
+    sig = ", ".join(params + ["MPI_Fint* ierr"])
+    body = "  *ierr = %s(%s);" % (name, ", ".join(call))
+    return "void %s(%s) {\n%s\n}\n" % (fname, sig, body)
+
+
+def main():
+    text = open(HEADER).read()
+    skip = handwritten()
+    out = [
+        "/* GENERATED by tools/gen_f77.py — do not edit by hand.",
+        " * F77 wrappers derived from include/smpi/mpi.h prototypes",
+        " * (role of reference src/smpi/bindings/smpi_f77*.cpp). */",
+        "",
+    ]
+    n = 0
+    seen = set()
+    for name, args in parse_protos(text):
+        fname = name.lower() + "_"
+        if fname in skip or fname in seen:
+            continue
+        w = wrapper(name, args)
+        if w is None:
+            continue
+        seen.add(fname)
+        out.append(w)
+        n += 1
+    with open(OUT, "w") as fh:
+        fh.write("\n".join(out))
+    print("generated %d wrappers -> %s" % (n, OUT))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
