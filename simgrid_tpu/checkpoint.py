@@ -1,19 +1,26 @@
-"""Simulation checkpoint/resume via deterministic re-execution.
+"""Simulation checkpoint/resume: deterministic re-execution with a
+recorded solve stream.
 
 The reference checkpoints by copying dirty memory pages of the whole
 simulated process (src/mc/sosp/PageStore.hpp:62-97) — a design forced
 by C actor stacks that cannot be rebuilt any other way.  This kernel
 is deterministic by construction (serial scheduling rounds, FIFO
 simcall answering, deterministic solver), so a checkpoint does not
-need the memory image at all: it is the pair
+need the memory image at all: it is
 
-    (how to rebuild the simulation, the simulated date reached)
+    (how to rebuild the simulation, the simulated date reached,
+     the solver results produced along the way)
 
-and resuming is rebuilding + fast-forwarding with Engine.run_until —
-bit-identical state by determinism, the same argument that lets the
-model checker re-execute instead of snapshotting (mc/explorer.py).
-Tokens serialize to a few hundred bytes of JSON and survive process
-restarts, which page-store snapshots cannot.
+and resuming is rebuilding + fast-forwarding with Engine.run_until.
+The third element is the state-dict half: actor control flow re-runs
+(Python continuations cannot be serialized), but every max-min solve
+— what dominates a long simulation at scale — is INSTALLED from the
+recording instead of re-solved, so fast-forward pays O(system state)
+per step rather than O(fixpoint rounds).  Bit-identical by the same
+determinism argument that lets the model checker re-execute instead
+of snapshotting (mc/explorer.py); any structural mismatch falls back
+to a real solve.  Tokens serialize to JSON + a numeric .npz and
+survive process restarts, which page-store snapshots cannot.
 
 SECURITY: ``resume()`` imports and CALLS the module-level callable
 named in the token, so only load checkpoint files you trust — the
@@ -30,7 +37,144 @@ from __future__ import annotations
 
 import importlib
 import json
-from typing import Any, Optional, Tuple
+from typing import Any, List, Optional, Tuple
+
+
+class _SolveStream:
+    """Recorded solver results, one record per ACTUAL solve (calls that
+    early-return on `not modified` record nothing — the replay side
+    gates identically, so the streams stay aligned by determinism).
+
+    This is the state-dict half of the checkpoint: re-execution still
+    replays the actor control flow (Python continuations cannot be
+    serialized), but every max-min solve — the cost that dominates a
+    long simulation at scale — is replaced by installing the recorded
+    fixpoint, turning fast-forward solver cost from O(fixpoint rounds)
+    into O(state) per step.  Sound for the same reason re-execution is:
+    the kernel is deterministic, so call k of the resumed run has
+    exactly the inputs call k of the original had."""
+
+    def __init__(self):
+        #: per-system (in creation order) list of records
+        self.per_system: List[list] = []
+        self._order: dict = {}
+
+    def _sys_idx(self, system) -> int:
+        key = id(system)
+        idx = self._order.get(key)
+        if idx is None:
+            idx = self._order[key] = len(self.per_system)
+            self.per_system.append([])
+        return idx
+
+    @staticmethod
+    def snapshot(system, new_flags) -> dict:
+        """Full post-solve solver state: variable values, per-active-
+        constraint (remaining, usage, enabled count, active element
+        positions), and the indices of the variables whose action this
+        particular solve reported as modified — so install() replays
+        EXACTLY the state transition, not an approximation (extra
+        flags would split double_update intervals and change float
+        rounding; a different active-element arrangement would change
+        later accumulation order)."""
+        vs = list(system.variable_set)
+        index_of = {id(var): i for i, var in enumerate(vs)}
+        values = [var.value for var in vs]
+        cnst = []
+        for c in system.active_constraint_set:
+            enabled = list(c.enabled_element_set)
+            pos_of = {id(elem): pos for pos, elem in enumerate(enabled)}
+            # the SEQUENCE of the active list is state too: it drives
+            # the accumulation order of later real solves
+            active = [pos_of[id(e)] for e in c.active_element_set
+                      if id(e) in pos_of]
+            cnst.append((c.remaining, c.usage, len(enabled), active))
+        flags = [index_of[id(action.variable)] for action in new_flags
+                 if id(action.variable) in index_of]
+        return {"values": values, "cnst": cnst, "flags": flags}
+
+    @staticmethod
+    def install(system, rec: dict) -> bool:
+        """Install one recorded solve; False when the structure no
+        longer matches (the caller then abandons replay for good —
+        once alignment is lost a later coincidental size match would
+        install a stale record)."""
+        vs = list(system.variable_set)
+        cs = list(system.active_constraint_set)
+        if len(vs) != len(rec["values"]) or len(cs) != len(rec["cnst"]):
+            return False
+        for c, (_, _, n_enabled, _) in zip(cs, rec["cnst"]):
+            if len(c.enabled_element_set) != n_enabled:
+                return False
+        for var, value in zip(vs, rec["values"]):
+            var.value = value
+        for c, (remaining, usage, _, active) in zip(cs, rec["cnst"]):
+            c.remaining = remaining
+            c.usage = usage
+            enabled = list(c.enabled_element_set)
+            for elem in enabled:
+                elem.make_inactive()
+            # make_active pushes FRONT: reverse reproduces the sequence
+            for pos in reversed(active):
+                enabled[pos].make_active()
+        for i in rec["flags"]:
+            system.flag_action_modified(vs[i].id)
+        system.modified = False
+        if system.selective_update_active:
+            system.remove_all_modified_set()
+        return True
+
+
+def record_solves(stream: _SolveStream):
+    """Class-level patch of System.solve that tees each result into
+    `stream`; returns an uninstall callable."""
+    from .ops.lmm_host import System
+
+    orig = System.solve
+
+    def recording_solve(self):
+        if not self.modified:
+            return
+        before = len(self.modified_actions or ())
+        orig(self)
+        new_flags = (self.modified_actions or [])[before:]
+        stream.per_system[stream._sys_idx(self)].append(
+            _SolveStream.snapshot(self, new_flags))
+
+    System.solve = recording_solve
+    return lambda: setattr(System, "solve", orig)
+
+
+def replay_solves(stream: _SolveStream):
+    """Class-level patch of System.solve that installs recorded
+    results instead of solving; exhausted or mismatched streams fall
+    back to the real solver (sound: same inputs, just slower)."""
+    from .ops.lmm_host import System
+
+    orig = System.solve
+    cursors: dict = {}
+    order: dict = {}
+    poisoned: set = set()
+
+    def replaying_solve(self):
+        if not self.modified:
+            return
+        idx = order.setdefault(id(self), len(order))
+        if idx not in poisoned and idx < len(stream.per_system):
+            recs = stream.per_system[idx]
+            k = cursors.get(idx, 0)
+            if k < len(recs):
+                if _SolveStream.install(self, recs[k]):
+                    cursors[idx] = k + 1
+                    return
+                # structure diverged: alignment is gone for THIS system
+                # for good — a later coincidental size match would
+                # install a stale record, so abandon its stream
+                poisoned.add(idx)
+        orig(self)
+
+    System.solve = replaying_solve
+    return lambda: setattr(System, "solve", orig)
 
 
 class Checkpoint:
@@ -48,16 +192,30 @@ class Checkpoint:
                 "be resolved when the checkpoint is loaded later")
         self.args = tuple(args)
         self.at = float(at)
+        self.solves: Optional[_SolveStream] = None
 
     # -- capture -------------------------------------------------------
     @classmethod
-    def capture(cls, setup, args: Tuple = (), at: float = 0.0):
+    def capture(cls, setup, args: Tuple = (), at: float = 0.0,
+                record: bool = True):
         """Build the simulation, advance it to `at`, and return
         (engine paused at `at`, checkpoint token).  The caller may keep
-        running the engine; the token is independent of it."""
+        running the engine; the token is independent of it.
+
+        With ``record=True`` every solver fixpoint along the way is
+        recorded into the token, so ``resume()`` fast-forwards by
+        INSTALLING results instead of re-solving — O(state) per step
+        for the part that dominates long simulations."""
         token = cls(setup, args, at)
-        engine = token._rebuild()
-        engine.run_until(at)
+        stream = _SolveStream() if record else None
+        uninstall = record_solves(stream) if record else None
+        try:
+            engine = token._rebuild()
+            engine.run_until(at)
+        finally:
+            if uninstall is not None:
+                uninstall()
+        token.solves = stream
         return engine, token
 
     # -- resume --------------------------------------------------------
@@ -74,26 +232,60 @@ class Checkpoint:
 
     def resume(self):
         """Rebuild the simulation and fast-forward to the checkpointed
-        date; returns the engine paused there, ready for run()."""
-        engine = self._rebuild()
-        engine.run_until(self.at)
+        date; returns the engine paused there, ready for run().  When
+        the token carries a solve stream, the fast-forward installs
+        the recorded fixpoints instead of re-solving (falling back to
+        real solves on any structural mismatch)."""
+        uninstall = (replay_solves(self.solves)
+                     if self.solves is not None else None)
+        try:
+            engine = self._rebuild()
+            engine.run_until(self.at)
+        finally:
+            if uninstall is not None:
+                uninstall()
         return engine
 
     # -- persistence ---------------------------------------------------
     def save(self, path: str) -> None:
         """JSON on purpose: a checkpoint file must be data, not code
         (pickle.load would execute arbitrary payloads).  Args are
-        therefore restricted to JSON-representable plain data."""
+        therefore restricted to JSON-representable plain data.  The
+        recorded solve stream rides along in `path`.solves.npz (pure
+        numeric arrays — also data, not code)."""
         try:
             blob = json.dumps({"module": self._module,
                                "qualname": self._qualname,
-                               "args": list(self.args), "at": self.at})
+                               "args": list(self.args), "at": self.at,
+                               "has_solves": self.solves is not None})
         except TypeError as exc:
             raise TypeError(
                 "checkpoint args must be JSON-serializable plain data "
                 f"(module={self._module}, args={self.args!r}): {exc}")
         with open(path, "w") as f:
             f.write(blob)
+        if self.solves is not None:
+            import numpy as np
+            arrays = {}
+            for i, recs in enumerate(self.solves.per_system):
+                for k, rec in enumerate(recs):
+                    p = f"s{i}r{k}"
+                    arrays[p + "v"] = np.asarray(rec["values"],
+                                                 np.float64)
+                    arrays[p + "c"] = np.asarray(
+                        [(r, u, n) for r, u, n, _ in rec["cnst"]],
+                        np.float64).reshape(-1, 3)
+                    # ragged active-position lists: flat + offsets
+                    flat, offs = [], [0]
+                    for _, _, _, active in rec["cnst"]:
+                        flat.extend(active)
+                        offs.append(len(flat))
+                    arrays[p + "a"] = np.asarray(flat, np.int64)
+                    arrays[p + "o"] = np.asarray(offs, np.int64)
+                    arrays[p + "f"] = np.asarray(rec["flags"], np.int64)
+            arrays["shape"] = np.asarray(
+                [len(recs) for recs in self.solves.per_system], np.int64)
+            np.savez_compressed(path + ".solves.npz", **arrays)
 
     @classmethod
     def load(cls, path: str) -> "Checkpoint":
@@ -104,4 +296,31 @@ class Checkpoint:
         token._qualname = str(d["qualname"])
         token.args = tuple(d["args"])
         token.at = float(d["at"])
+        token.solves = None
+        if d.get("has_solves"):
+            import os
+
+            import numpy as np
+            npz_path = path + ".solves.npz"
+            if os.path.exists(npz_path):
+                with np.load(npz_path) as z:
+                    stream = _SolveStream()
+                    for i, n in enumerate(z["shape"]):
+                        recs = []
+                        for k in range(int(n)):
+                            p = f"s{i}r{k}"
+                            cn = z[p + "c"]
+                            flat = z[p + "a"].tolist()
+                            offs = z[p + "o"].tolist()
+                            cnst = []
+                            for j, (r, u, ne) in enumerate(cn):
+                                cnst.append((float(r), float(u), int(ne),
+                                             flat[offs[j]:offs[j + 1]]))
+                            recs.append({
+                                "values": z[p + "v"].tolist(),
+                                "cnst": cnst,
+                                "flags": z[p + "f"].tolist(),
+                            })
+                        stream.per_system.append(recs)
+                    token.solves = stream
         return token

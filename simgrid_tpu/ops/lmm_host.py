@@ -327,6 +327,14 @@ class System:
         #: sync with every graph mutation
         self.array_view = None
 
+    def flag_action_modified(self, action) -> None:
+        """Report one action's rate as changed by the current solve
+        (idempotent; the shared idiom of every solve backend)."""
+        if (self.modified_actions is not None and action is not None
+                and not getattr(action, "in_modified_set", False)):
+            action.in_modified_set = True
+            self.modified_actions.append(action)
+
     def drain_modified_actions(self) -> List[Any]:
         """Pop the actions whose rate changed in the last solve (the
         Action::ModifiedSet analog consumed by lazy model updates), clearing
@@ -655,14 +663,9 @@ class System:
                 # Unlike the reference (maxmin.cpp:523-525), still report the
                 # actions as modified so the lazy model drops their stale
                 # completion dates (park support, see Model lazy path).
-                if self.modified_actions is not None:
-                    for elem in cnst.enabled_element_set:
-                        action = elem.variable.id
-                        if (elem.consumption_weight > 0 and action is not None
-                                and not getattr(action, "in_modified_set",
-                                                False)):
-                            action.in_modified_set = True
-                            self.modified_actions.append(action)
+                for elem in cnst.enabled_element_set:
+                    if elem.consumption_weight > 0:
+                        self.flag_action_modified(elem.variable.id)
                 continue
             cnst.usage = 0.0
             for elem in cnst.enabled_element_set:
@@ -673,11 +676,7 @@ class System:
                     elif cnst.usage < w:
                         cnst.usage = w
                     elem.make_active()
-                    action = elem.variable.id
-                    if (self.modified_actions is not None and action is not None
-                            and not getattr(action, "in_modified_set", False)):
-                        action.in_modified_set = True
-                        self.modified_actions.append(action)
+                    self.flag_action_modified(elem.variable.id)
             if cnst.usage > 0:
                 rou = cnst.remaining / cnst.usage
                 entry = _LightEntry(cnst, rou)

@@ -998,10 +998,7 @@ def solve_flattened(system: System, dtype, solve_flat) -> None:
         for cnst in cnst_list:
             for elem in cnst.enabled_element_set:
                 if elem.consumption_weight > 0:
-                    action = elem.variable.id
-                    if action is not None and not getattr(action, "in_modified_set", False):
-                        action.in_modified_set = True
-                        system.modified_actions.append(action)
+                    system.flag_action_modified(elem.variable.id)
 
     flat = flatten(cnst_list, dtype)
     if flat is not None:

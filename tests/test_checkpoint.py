@@ -83,3 +83,58 @@ def test_checkpoint_mid_run_state_is_live(tmp_path):
     resumed.run_until(ref_clock / 2)
     resumed.run()
     assert resumed.clock == ref_clock
+
+
+def test_resume_replays_solves_without_resolving(tmp_path):
+    """The solve-stream upgrade: resume() fast-forwards by installing
+    recorded fixpoints — the real solver must not run before `at`, and
+    completion stays bit-identical to an untouched run."""
+    import simgrid_tpu.ops.lmm_host as lh
+
+    ref_clock = _full_run_clock()
+    s4u.Engine._reset()
+
+    _, token = Checkpoint.capture(build_masterworkers, at=ref_clock / 2)
+    assert token.solves is not None
+    assert sum(len(r) for r in token.solves.per_system) > 0
+
+    path = str(tmp_path / "ck.json")
+    token.save(path)
+    assert os.path.exists(path + ".solves.npz")
+    loaded = Checkpoint.load(path)
+    assert loaded.solves is not None
+
+    s4u.Engine._reset()
+    calls = {"n": 0}
+    orig = lh.System.solve_exact
+
+    def counting(self):
+        calls["n"] += 1
+        return orig(self)
+
+    lh.System.solve_exact = counting
+    try:
+        engine = loaded.resume()
+        fastforward_solves = calls["n"]
+        engine.run()
+    finally:
+        lh.System.solve_exact = orig
+    assert fastforward_solves == 0, \
+        "fast-forward must install recorded results, not re-solve"
+    assert engine.clock == ref_clock
+
+
+def test_resume_survives_tampered_stream(tmp_path):
+    """A diverged/tampered solve stream abandons replay (no stale
+    installs) and the real solver takes over — same final clock."""
+    ref_clock = _full_run_clock()
+    s4u.Engine._reset()
+    _, token = Checkpoint.capture(build_masterworkers, at=ref_clock / 2)
+    # corrupt record 0 of every system so the first install mismatches
+    for recs in token.solves.per_system:
+        if recs:
+            recs[0]["values"] = recs[0]["values"] + [0.0]
+    s4u.Engine._reset()
+    engine = token.resume()
+    engine.run()
+    assert engine.clock == ref_clock
