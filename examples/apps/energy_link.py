@@ -1,0 +1,61 @@
+"""s4u-energy-link replica (reference
+examples/s4u/energy-link/s4u-energy-link.cpp): link_energy plugin under
+the CM02 network model."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.plugins import link_energy
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_test")
+
+
+def sender(flow_amount, comm_size):
+    LOG.info("Send %.0f bytes, in %d flows" % (comm_size, flow_amount))
+    mailbox = s4u.Mailbox.by_name("message")
+    s4u.this_actor.sleep_for(10)
+    if flow_amount == 1:
+        mailbox.put("%f" % comm_size, comm_size)
+    else:
+        comms = [mailbox.put_async(str(i), comm_size)
+                 for i in range(flow_amount)]
+        for c in comms:
+            c.wait()
+    LOG.info("sender done.")
+
+
+def receiver(flow_amount):
+    LOG.info("Receiving %d flows ..." % flow_amount)
+    mailbox = s4u.Mailbox.by_name("message")
+    if flow_amount == 1:
+        mailbox.get()
+    else:
+        comms = [mailbox.get_async() for _ in range(flow_amount)]
+        for c in comms:
+            c.wait()
+    LOG.info("receiver done.")
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    LOG.info("Activating the SimGrid link energy plugin")
+    rest = [a for a in sys.argv[1:]
+            if not a.startswith("--cfg=") and not a.startswith("--log=")]
+    e.load_platform(rest[0])
+    link_energy.link_energy_plugin_init(e)
+    flow_amount = int(rest[1]) if len(rest) > 1 else 1
+    comm_size = float(rest[2]) if len(rest) > 2 else 25000.0
+    s4u.Actor.create("sender", e.host_by_name("MyHost1"), sender,
+                     flow_amount, comm_size)
+    s4u.Actor.create("receiver", e.host_by_name("MyHost2"), receiver,
+                     flow_amount)
+    e.run()
+
+
+if __name__ == "__main__":
+    main()

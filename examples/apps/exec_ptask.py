@@ -1,0 +1,69 @@
+"""s4u-exec-ptask replica (reference
+examples/s4u/exec-ptask/s4u-exec-ptask.cpp): parallel tasks under the
+L07 model, with timeout and uncategorized resource tracing."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.exceptions import TimeoutException
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_ptask")
+
+
+def runner():
+    e = s4u.Engine.get_instance()
+    hosts = e.get_all_hosts()
+    n = len(hosts)
+
+    LOG.info("First, build a classical parallel task, with 1 Gflop to "
+             "execute on each node, and 10MB to exchange between each "
+             "pair")
+    computation_amounts = [1e9] * n
+    communication_amounts = [0.0] * (n * n)
+    for i in range(n):
+        for j in range(i + 1, n):
+            communication_amounts[i * n + j] = 1e7
+    s4u.this_actor.parallel_execute(hosts, computation_amounts,
+                                    communication_amounts)
+
+    LOG.info("We can do the same with a timeout of 10 seconds enabled.")
+    computation_amounts = [1e9] * n
+    communication_amounts = [0.0] * (n * n)
+    for i in range(n):
+        for j in range(i + 1, n):
+            communication_amounts[i * n + j] = 1e7
+    try:
+        s4u.this_actor.parallel_execute(hosts, computation_amounts,
+                                        communication_amounts, 10.0)
+        raise AssertionError("Woops, this did not timeout as expected..."
+                             " Please report that bug.")
+    except TimeoutException:
+        LOG.info("Caught the expected timeout exception.")
+
+    LOG.info("Then, build a parallel task involving only computations "
+             "(of different amounts) and no communication")
+    computation_amounts = [3e8, 6e8, 1e9]
+    s4u.this_actor.parallel_execute(hosts, computation_amounts, [])
+
+    LOG.info("Then, build a parallel task with no computation nor "
+             "communication (synchro only)")
+    s4u.this_actor.parallel_execute(hosts, [], [])
+
+    LOG.info("Goodbye now!")
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    s4u.Actor.create("test", e.host_by_name("MyHost1"), runner)
+    e.run()
+    LOG.info("Simulation done.")
+
+
+if __name__ == "__main__":
+    main()

@@ -89,6 +89,11 @@ typedef struct MPI_Status {
 #define MPI_C_FLOAT_COMPLEX 36
 #define MPI_C_COMPLEX MPI_C_FLOAT_COMPLEX
 #define MPI_C_DOUBLE_COMPLEX 37
+/* C++ type aliases (MPI-3; datatype/cxx-types drives them from C) */
+#define MPI_CXX_BOOL MPI_C_BOOL
+#define MPI_CXX_FLOAT_COMPLEX MPI_C_FLOAT_COMPLEX
+#define MPI_CXX_DOUBLE_COMPLEX MPI_C_DOUBLE_COMPLEX
+#define MPI_CXX_LONG_DOUBLE_COMPLEX MPI_C_LONG_DOUBLE_COMPLEX
 #define MPI_C_LONG_DOUBLE_COMPLEX 38
 #define MPI_SHORT_INT 39
 #define MPI_LONG_DOUBLE_INT 40
@@ -687,6 +692,8 @@ int MPI_Iexscan(const void* sendbuf, void* recvbuf, int count,
 /* -- reduction ops ------------------------------------------------------- */
 int MPI_Op_create(MPI_User_function* fn, int commute, MPI_Op* op);
 int MPI_Op_commutative(MPI_Op op, int* commute);
+int MPI_Reduce_local(const void* inbuf, void* inoutbuf, int count,
+                     MPI_Datatype datatype, MPI_Op op);
 int MPI_Op_free(MPI_Op* op);
 
 /* -- memory / info / naming / groups / windows --------------------------- */

@@ -249,6 +249,7 @@ enum {
   SMPI_OP_ERROR_STRING,       /* 215 */
   SMPI_OP_ERROR_CLASS,
   SMPI_OP_OP_COMMUTATIVE,
+  SMPI_OP_REDUCE_LOCAL,
 };
 
 /* sub-modes for FILE_READ / FILE_WRITE */
@@ -700,6 +701,11 @@ int MPI_Type_free(MPI_Datatype* datatype) {
 /* -- reduction ops ---------------------------------------------------------- */
 int MPI_Op_commutative(MPI_Op op, int* commute) {
   CALL(SMPI_OP_OP_COMMUTATIVE, A(op), A(commute));
+}
+int MPI_Reduce_local(const void* inbuf, void* inoutbuf, int count,
+                     MPI_Datatype datatype, MPI_Op op) {
+  CALL(SMPI_OP_REDUCE_LOCAL, A(inbuf), A(inoutbuf), A(count),
+       A(datatype), A(op));
 }
 int MPI_Op_create(MPI_User_function* fn, int commute, MPI_Op* op) {
   CALL(SMPI_OP_OP_CREATE, A(fn), A(commute), A(op));

@@ -185,6 +185,43 @@ def _wire_utilization(engine_impl) -> None:
 
     engine_impl.connect_signal(EngineImpl.on_time_advance, on_advance)
 
+    # Per-action utilization on every action state change
+    # (instr_platform.cpp instr_action_on_state_change + the UNCAT
+    # debug lines of instr_resource_utilization.cpp:22, which the
+    # exec-ptask tesh pins at --log=instr_resource.t:debug)
+    from ..models.cpu import Cpu, CpuAction
+    from ..models.network import NetworkAction
+    from ..utils import log as _xlog
+    res_log = _xlog.get_category("instr_resource")
+
+    def on_action_state_change(action, *_):
+        var = getattr(action, "variable", None)
+        if var is None or _trace is None:
+            return
+        now = engine_impl.now
+        since = getattr(action, "last_update", 0.0)
+        for elem in var.cnsts:
+            value = elem.consumption_weight * var.value
+            if not value:
+                continue
+            resource = elem.constraint.id
+            if isinstance(resource, Cpu):
+                kind, rname, vname = ("HOST", resource.host.name,
+                                      "speed_used")
+            else:
+                rname = getattr(resource, "name", None)
+                kind, vname = "LINK", "bandwidth_used"
+            if rname is None or rname not in _trace.containers_by_name:
+                continue
+            # lazy args: the disabled-debug path must stay ~free
+            res_log.debug("UNCAT %s [%f - %f] %s %s %f", kind, since,
+                          now, rname, vname, value)
+
+    engine_impl.connect_signal(CpuAction.on_state_change,
+                               on_action_state_change)
+    engine_impl.connect_signal(NetworkAction.on_state_change,
+                               on_action_state_change)
+
 
 def _cnst_usage(resource) -> float:
     cnst = getattr(resource, "constraint", None)

@@ -303,6 +303,16 @@ class NetworkCm02Model(NetworkModel):
         else:
             action.variable = self.system.variable_new(
                 action, 1.0, -1.0, constraints_per_variable)
+            if (action.sharing_penalty <= 0 and weight_s <= 0
+                    and not action.parked_links):
+                # pure CM02 (weight-S 0) on a zero-latency route: the
+                # variable runs at penalty 1 immediately, and the lazy
+                # drain's bogus-priority skip must not ignore the
+                # action or its completion never gets scheduled
+                # (energy-link tesh: 25kB over the latency-0 bus).
+                # Parked weight-S flows keep 0: un-parking re-adds
+                # their S/bw terms from that base.
+                action.sharing_penalty = 1.0
 
         gamma = config["network/TCP-gamma"]
         if action.rate < 0:

@@ -47,6 +47,22 @@ class ExtensionMap:
         return self._map.values()
 
 
+_atexit_reports: set = set()
+
+
+def register_atexit_report(key: str, callback: Callable) -> None:
+    """One module-level atexit hook per plugin (keyed by name): mirrors
+    the reference's destruction-time reports, which run after main's
+    last statement.  The callback must look up the CURRENT engine
+    itself — closing over an engine would pin every torn-down engine in
+    memory for the whole process."""
+    if key in _atexit_reports:
+        return
+    _atexit_reports.add(key)
+    import atexit
+    atexit.register(callback)
+
+
 def cpu_hosts_of_action(action) -> Iterator:
     """The hosts whose CPUs an action's LMM variable touches (reference
     CpuAction::cpus walks the same element structure)."""

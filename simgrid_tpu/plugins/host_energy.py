@@ -181,28 +181,21 @@ def host_energy_plugin_init(engine=None) -> None:
 
     # Per-host consumption reports at engine teardown (the reference
     # logs them from on_host_destruction, which runs after main's last
-    # statement); atexit mirrors that ordering for the Python engine.
-    # One registration per engine: a re-init on the same engine must
-    # not double the report lines.
-    import atexit
+    # statement).
+    from ._base import register_atexit_report
+    register_atexit_report("host_energy", _per_host_report)
 
-    if getattr(impl, "_host_energy_atexit", False):
+
+def _per_host_report() -> None:
+    from ..s4u.engine import Engine
+    if Engine._instance is None:
         return
-    impl._host_energy_atexit = True
-
-    def per_host_report(engine_impl=impl):
-        from ..s4u.engine import Engine
-        current = Engine._instance.pimpl if Engine._instance else None
-        if current is not engine_impl:
-            return                # a later engine replaced this one
-        for host in engine_impl.hosts.values():
-            he = _EXT.get(host)
-            if he is None or not he.power_ranges:
-                continue
-            _logger.info("Energy consumption of host %s: %f Joules",
-                         host.name, he.get_consumed_energy())
-
-    atexit.register(per_host_report)
+    for host in Engine._instance.pimpl.hosts.values():
+        he = _EXT.get(host)
+        if he is None or not he.power_ranges:
+            continue
+        _logger.info("Energy consumption of host %s: %f Joules",
+                     host.name, he.get_consumed_energy())
 
 
 def get_consumed_energy(host) -> float:

@@ -18,8 +18,10 @@ class LinkEnergy:
         self._clock = clock_getter
         self.total_energy = 0.0
         self.last_updated = clock_getter()
-        rng = link.properties.get("wattage_range") if hasattr(
-            link, "properties") else None
+        props = getattr(link, "properties", {})
+        # the reference plugin reads 'watt_range' (link_energy.cpp:90);
+        # 'wattage_range' is the post-3.25 rename — accept both
+        rng = props.get("watt_range") or props.get("wattage_range")
         if rng:
             idle, busy = (float(x) for x in rng.split(":"))
             self.range: Optional[Tuple[float, float]] = (idle, busy)
@@ -90,6 +92,40 @@ def link_energy_plugin_init(engine=None) -> None:
     impl.connect_signal(NetworkAction.on_state_change,
                         lambda action, *a: on_communicate(action, None,
                                                           None))
+
+    # end-of-run totals + per-link teardown report (link_energy.cpp
+    # on_simulation_end / Link::on_destruction; energy-link tesh)
+    from ..kernel.engine import EngineImpl
+    from ..utils import log as _xlog
+    _logger = _xlog.get_category("link_energy")
+
+    def on_end():
+        total = 0.0
+        for link in impl.links.values():
+            le = _EXT.get(link)
+            if le is not None:
+                le.update()
+                total += le.get_consumed_energy()
+        _logger.info("Total energy over all links: %f" % total)
+
+    impl.connect_signal(EngineImpl.on_simulation_end, on_end)
+
+    from ._base import register_atexit_report
+    register_atexit_report("link_energy", _per_link_report)
+
+
+def _per_link_report() -> None:
+    from ..s4u.engine import Engine
+    from ..utils import log as _xlog
+    if Engine._instance is None:
+        return
+    logger = _xlog.get_category("link_energy")
+    for link in Engine._instance.pimpl.links.values():
+        le = _EXT.get(link)
+        if le is None or link.name == "__loopback__":
+            continue
+        logger.info("Energy consumption of link '%s': %f Joules"
+                    % (link.name, le.get_consumed_energy()))
 
 
 def get_consumed_energy(link) -> float:

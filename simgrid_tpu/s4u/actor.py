@@ -279,12 +279,17 @@ class this_actor:
         this_actor.exec_init(flops).set_priority(priority).wait()
 
     @staticmethod
-    def parallel_execute(hosts, flops_amounts, bytes_amounts) -> None:
+    def parallel_execute(hosts, flops_amounts, bytes_amounts,
+                         timeout: float = -1.0) -> None:
         from .activity import Exec
         exec_ = Exec()
         exec_.hosts = list(hosts)
         exec_.flops_amounts = list(flops_amounts)
         exec_.bytes_amounts = list(bytes_amounts)
+        if timeout > 0:
+            exec_.set_timeout(timeout)
+        # a fired timeout detector surfaces as a TimeoutException
+        # raised out of the wait simcall
         exec_.wait()
 
     @staticmethod

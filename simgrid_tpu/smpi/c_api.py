@@ -1997,6 +1997,16 @@ def _h_reduce_scatter(ctx, a):
     counts = _read_i32s(rcounts, n)
     op = _op_of(ctx, oph, dt, dt_handle=dth)
     me = comm.rank()
+    if _is_inter(comm):
+        # intercomm reduce_scatter (MPI-2 §7.3.4): each side receives
+        # the reduction of the OTHER side's full vector, scattered
+        # over the LOCAL group per recvcounts (coll/redscatinter)
+        full = _arr_in(sbuf, sum(counts), dt)
+        remote_red = np.asarray(comm.allreduce(full, op))
+        off = sum(counts[:me])
+        _arr_out(rbuf, remote_red[off:off + counts[me]].astype(
+            full.dtype, copy=False), counts[me] * dt.size_, dt=dt)
+        return MPI_SUCCESS
     if int(sbuf) == C_IN_PLACE:
         total = sum(counts)
         full = _arr_in(rbuf, total, dt)
@@ -2184,6 +2194,30 @@ def _h_op_commutative(ctx, a):
     op = ctx.ops.get(int(a[0]))
     commute = 1 if op is None else int(bool(op.commutative))
     _write_i32(a[1], commute)
+    return MPI_SUCCESS
+
+
+def _h_reduce_local(ctx, a):
+    """MPI_Reduce_local: inoutbuf = op(inbuf, inoutbuf), no
+    communication (MPI-2.2 §5.9.7; coll/reduce_local)."""
+    inbuf, inoutbuf, count, dth, oph = a[:5]
+    count = int(ctypes.c_int(int(count) & 0xFFFFFFFF).value)
+    if count < 0:
+        return 6                        # MPI_ERR_COUNT
+    if int(dth) == 0:                   # handles validate even at 0
+        return MPI_ERR_TYPE
+    if int(oph) == 0:
+        return 10                       # MPI_ERR_OP
+    if count == 0:
+        return MPI_SUCCESS
+    dt = _dt(ctx, dth)
+    op = _op_of(ctx, oph, dt, dt_handle=dth, count=count)
+    a_in = _arr_in(inbuf, count, dt)
+    b_inout = _arr_in(inoutbuf, count, dt)
+    res = op(a_in, b_inout)
+    _arr_out(int(inoutbuf),
+             np.asarray(res).astype(b_inout.dtype, copy=False),
+             count * dt.size_, dt=dt)
     return MPI_SUCCESS
 
 
@@ -3936,15 +3970,40 @@ def _h_pack(ctx, a):
         direction = a[:8]
     dt = _dt(ctx, dth)
     pos = ctypes.cast(int(pos_addr), _pi32)[0]
-    nbytes = int(count) * dt.size_
+    count = int(count)
+    struct_sz = dt.size_
+    mpi_sz = int(getattr(dt, "c_mpi_size", struct_sz))
+    basics = list(getattr(dt, "c_basics", ()) or ())
+    # value+index pair types pack at their MPI size (6 for SHORT_INT),
+    # not their padded C struct size (8): strip/reinsert the ABI
+    # padding between the two members (datatype/pairtype-pack)
+    paired = mpi_sz != struct_sz and len(basics) == 2
+    per = mpi_sz if paired else struct_sz
+    nbytes = count * per
     if pos + nbytes > int(packed_size):
         return MPI_ERR_OTHER
+    if paired:
+        b0, b1 = basics
+        off1 = -(-b0 // b1) * b1        # member 1 at its alignment
     if int(direction) == 0:
         arr = _arr_in(typed_buf, count, dt)     # gather through typemap
         data = np.ascontiguousarray(arr).tobytes()
+        if paired:
+            rows = np.frombuffer(data, np.uint8).reshape(count,
+                                                         struct_sz)
+            packed = np.empty((count, per), np.uint8)
+            packed[:, :b0] = rows[:, :b0]
+            packed[:, b0:per] = rows[:, off1:off1 + b1]
+            data = packed.tobytes()
         ctypes.memmove(int(packed_buf) + pos, data, nbytes)
     else:
         raw = ctypes.string_at(int(packed_buf) + pos, nbytes)
+        if paired:
+            rows = np.frombuffer(raw, np.uint8).reshape(count, per)
+            structs = np.zeros((count, struct_sz), np.uint8)
+            structs[:, :b0] = rows[:, :b0]
+            structs[:, off1:off1 + b1] = rows[:, b0:per]
+            raw = structs.tobytes()
         arr = np.frombuffer(bytearray(raw), np.uint8)
         _arr_out(typed_buf, arr, dt=dt)         # scatter through typemap
     ctypes.cast(int(pos_addr), _pi32)[0] = pos + nbytes
@@ -4204,14 +4263,21 @@ def _h_alltoallw(ctx, a):
     if comm is None:
         return MPI_ERR_COMM
     n = comm.remote_size() if _is_inter(comm) else comm.size()
-    sc = _read_i32s(scounts, n)
-    so = _read_i32s(sdispls, n)       # BYTE displacements in alltoallw
-    st = _read_i32s(stypes, n)
     rc = _read_i32s(rcounts, n)
     ro = _read_i32s(rdispls, n)
     rt = _read_i32s(rtypes, n)
+    if int(sbuf) != C_IN_PLACE:
+        # the s-side arrays are NULL under MPI_IN_PLACE (MPI-2.2)
+        sc = _read_i32s(scounts, n)
+        so = _read_i32s(sdispls, n)   # BYTE displacements in alltoallw
+        st = _read_i32s(stypes, n)
     if int(sbuf) == C_IN_PLACE:
-        sendobjs = [_arr_in(int(rbuf) + ro[i], rc[i], _dt(ctx, rt[i]))
+        # the send blocks alias the receive buffer: COPY them now, or
+        # a peer still reading our block sees it already overwritten
+        # by our own incoming results (payloads travel by reference
+        # inside the simulator; coll/alltoallw2's IN_PLACE section)
+        sendobjs = [np.array(_arr_in(int(rbuf) + ro[i], rc[i],
+                                     _dt(ctx, rt[i])), copy=True)
                     for i in range(n)]
     else:
         sendobjs = [_arr_in(int(sbuf) + so[i], sc[i], _dt(ctx, st[i]))
@@ -4230,16 +4296,19 @@ def _h_ialltoallw(ctx, a):
     if comm is None:
         return MPI_ERR_COMM
     n = comm.size()
-    sc = _read_i32s(scounts, n)
-    so = _read_i32s(sdispls, n)
-    st = _read_i32s(stypes, n)
     rc = _read_i32s(rcounts, n)
     ro = _read_i32s(rdispls, n)
     rt = _read_i32s(rtypes, n)
     if int(sbuf) == C_IN_PLACE:
-        sendobjs = [_arr_in(int(rbuf) + ro[i], rc[i], _dt(ctx, rt[i]))
+        # the s-side arrays are NULL under MPI_IN_PLACE, and the send
+        # blocks alias the receive buffer: copy (see _h_alltoallw)
+        sendobjs = [np.array(_arr_in(int(rbuf) + ro[i], rc[i],
+                                     _dt(ctx, rt[i])), copy=True)
                     for i in range(n)]
     else:
+        sc = _read_i32s(scounts, n)
+        so = _read_i32s(sdispls, n)
+        st = _read_i32s(stypes, n)
         sendobjs = [_arr_in(int(sbuf) + so[i], sc[i], _dt(ctx, st[i]))
                     for i in range(n)]
     req = comm.ialltoall(sendobjs)
@@ -4947,7 +5016,7 @@ _HANDLERS = {
     211: _h_comm_call_errhandler, 212: _h_add_error_class,
     213: _h_add_error_code, 214: _h_add_error_string,
     215: _h_error_string, 216: _h_error_class,
-    217: _h_op_commutative,
+    217: _h_op_commutative, 218: _h_reduce_local,
 }
 
 #: ops that are pure local queries — no bench end/begin cycle needed
@@ -4959,7 +5028,7 @@ _LOCAL_OPS = {3, 4, 24, 41, 42, 45, 46, 48, 50, 51, 63, 64, 66, 69,
               134, 135, 136, 137, 139, 140, 141, 142,
               171, 172, 173, 188, 189, 190, 191, 192, 193, 194, 195,
               196, 201, 202, 203, 204, 205, 206, 207, 208, 209, 210,
-              211, 212, 213, 214, 215, 216, 217}
+              211, 212, 213, 214, 215, 216, 217, 218}
 
 
 def _dispatch_py(opcode: int, args) -> int:

@@ -1,0 +1,46 @@
+#!/usr/bin/env python3
+"""Aggregate the committed per-dir MPICH3 sweep JSONs into one summary
+(bench_results/mpich3_summary.json) with pass counts and the names of
+every non-passing test, so conformance claims are reproducible from
+artifacts rather than commit messages."""
+
+import glob
+import json
+import os
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BR = os.path.join(ROOT, "bench_results")
+
+
+def main() -> int:
+    summary = {"ts": time.time(), "dirs": {}}
+    total_pass = total = 0
+    for path in sorted(glob.glob(os.path.join(BR, "mpich3_*.json"))):
+        name = os.path.basename(path)[len("mpich3_"):-len(".json")]
+        if name == "summary":
+            continue
+        results = json.load(open(path))
+        n_pass = sum(1 for v in results.values() if v == "PASS")
+        summary["dirs"][name] = {
+            "pass": n_pass,
+            "total": len(results),
+            "failing": {k: v for k, v in sorted(results.items())
+                        if v != "PASS"},
+        }
+        total_pass += n_pass
+        total += len(results)
+    summary["total_pass"] = total_pass
+    summary["total"] = total
+    out = os.path.join(BR, "mpich3_summary.json")
+    json.dump(summary, open(out, "w"), indent=1, sort_keys=True)
+    print(f"{total_pass}/{total} across {len(summary['dirs'])} dirs "
+          f"-> {out}")
+    for name, d in sorted(summary["dirs"].items()):
+        print(f"  {name:10s} {d['pass']}/{d['total']}"
+              + (f"  ({', '.join(d['failing'])})" if d["failing"] else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    main()

@@ -32,7 +32,9 @@ TEST_CONFIGS = {
 # helper translation units that are not standalone tests (no main)
 HELPER_SRC = {"mcs-mutex"}
 # tests that link a helper .c from the same dir
-EXTRA_SRC = {"mutex_bench": ["mcs-mutex.c"]}
+EXTRA_SRC = {"mutex_bench": ["mcs-mutex.c"],
+             "sendrecvt2": ["../util/dtypes.c"],
+             "sendrecvt4": ["../util/dtypes.c"]}
 # template tests built per-operation via -DTEST_x in MPICH's makefiles;
 # sweep the PUT variant (the others are the same skeleton)
 EXTRA_DEFS = {
