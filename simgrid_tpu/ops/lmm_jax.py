@@ -432,7 +432,8 @@ def fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
              eps, n_c: int, n_v: int, axis: Optional[str] = None,
              parallel_rounds: bool = False, carry=None,
              max_rounds: Optional[int] = None, return_carry: bool = False,
-             unroll: bool = False):
+             unroll: bool = False, has_bounds: bool = True,
+             has_fatpipe: bool = True):
     """The saturate-bottleneck fixpoint over padded COO arrays.
 
     The single implementation behind every solve path: single-device
@@ -524,15 +525,21 @@ def fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
         new_usage_sum = usage - d_use
         new_usage_sum = jnp.where(new_usage_sum < eps, 0.0, new_usage_sum)
 
-        # FATPIPE: usage is re-derived as the max over still-unset variables.
         e_live2 = e_valid & ~jnp.take(v_fixed, e_var)
-        new_usage_max = allmax(jnp.zeros(n_c, dtype).at[e_cnst].max(
-            jnp.where(e_live2, e_upen, 0.0)))
-
         touched = allmax(jnp.zeros(n_c, dtype=bool).at[e_cnst].max(e_fix))
-        new_usage = jnp.where(c_fatpipe, new_usage_max, new_usage_sum)
-        usage = jnp.where(touched, new_usage, usage)
-        remaining = jnp.where(touched & ~c_fatpipe, new_remaining, remaining)
+        if has_fatpipe:
+            # FATPIPE: usage is re-derived as the max over unset variables.
+            new_usage_max = allmax(jnp.zeros(n_c, dtype).at[e_cnst].max(
+                jnp.where(e_live2, e_upen, 0.0)))
+            new_usage = jnp.where(c_fatpipe, new_usage_max, new_usage_sum)
+            usage = jnp.where(touched, new_usage, usage)
+            remaining = jnp.where(touched & ~c_fatpipe, new_remaining,
+                                  remaining)
+        else:
+            # static specialization (host-checked): no FATPIPE constraint
+            # in the system, so the max-usage recompute drops out
+            usage = jnp.where(touched, new_usage_sum, usage)
+            remaining = jnp.where(touched, new_remaining, remaining)
 
         # A constraint leaves the light set only when *touched* by a fixed
         # variable and failing the epsilon tests (maxmin.cpp:607-609);
@@ -563,6 +570,13 @@ def fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
         e_live = e_valid & ~jnp.take(v_fixed, e_var)
         e_sat = e_live & jnp.take(saturated_c, e_cnst)
         v_sat = allmax(jnp.zeros(n_v, dtype=bool).at[e_var].max(e_sat))
+
+        if not has_bounds:
+            # static specialization: no active variable bound, so the
+            # bound-first rule drops out of the compiled round body
+            return apply_fixes(state, v_sat,
+                               min_usage / jnp.where(v_enabled, v_penalty,
+                                                     1.0))
 
         # Bound-first rule (maxmin.cpp:566-596): if any saturated variable's
         # bound*penalty sits below min_usage, fix (only) the variables whose
@@ -600,6 +614,18 @@ def fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
 
         # Saturated vars and their levels (min processable rou containing v).
         e_proc = e_live & jnp.take(processable, e_cnst)
+
+        if not has_bounds:
+            # static specialization: with no active variable bound every
+            # processable constraint is unblocked, so the level of a
+            # saturated variable is just its min processable rou
+            level2_v = allmin(jnp.full(n_v, inf, dtype).at[e_var].min(
+                jnp.where(e_proc, e_rou, inf)))
+            fix_now = jnp.isfinite(level2_v) & ~v_fixed
+            return apply_fixes(state, fix_now,
+                               level2_v / jnp.where(v_enabled, v_penalty,
+                                                    1.0))
+
         v_sat = allmax(jnp.zeros(n_v, dtype=bool).at[e_var].max(e_proc))
         level_v = nmin_v
 
@@ -717,11 +743,13 @@ def _ell_cached(arrays: LmmArrays) -> Optional[LmmEllArrays]:
 
 @functools.partial(jax.jit,
                    static_argnames=("eps", "n_c", "n_v",
-                                    "parallel_rounds", "chunk", "unroll"))
+                                    "parallel_rounds", "chunk", "unroll",
+                                    "has_bounds", "has_fatpipe"))
 def _solve_kernel_chunk(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty,
                         v_bound, carry, eps: float, n_c: int, n_v: int,
                         parallel_rounds: bool, chunk: int,
-                        unroll: bool = False):
+                        unroll: bool = False, has_bounds: bool = True,
+                        has_fatpipe: bool = True):
     """Run at most `chunk` more saturation rounds from `carry` (None =
     fresh start) and return (values, remaining, usage, rounds, carry).
     eps is static for the same reason as _solve_ell_chunk's."""
@@ -729,7 +757,8 @@ def _solve_kernel_chunk(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty,
                     v_bound, jnp.asarray(eps, e_w.dtype), n_c, n_v,
                     axis=None, parallel_rounds=parallel_rounds,
                     carry=carry, max_rounds=chunk, return_carry=True,
-                    unroll=unroll)
+                    unroll=unroll, has_bounds=has_bounds,
+                    has_fatpipe=has_fatpipe)
 
 
 def flatten(cnst_list: List[Constraint], dtype=np.float64
@@ -817,6 +846,12 @@ _CHUNK_ROUNDS_ACCEL = 256
 #: with the unroll factor, so keep chunks small — local-rounds solves
 #: typically converge in O(10) rounds anyway.
 _CHUNK_ROUNDS_UNROLL = 16
+#: Below this element count the whole solve costs ~a millisecond and
+#: compaction's per-chunk host sync + repack + per-shape recompiles
+#: are pure overhead on the simulator's per-step hot path.
+_COMPACT_MIN_ELEMS = 4096
+#: one-shot flag for the lmm/compact:on-with-ELL warning
+_WARNED_COMPACT_ELL = False
 
 
 def _default_platform() -> str:
@@ -831,6 +866,118 @@ def _default_chunk() -> int:
         else _CHUNK_ROUNDS_ACCEL
 
 
+class _Compactor:
+    """Host-side active-set compaction for the COO chunk loop (see
+    solve_arrays).  Owns the CURRENT (possibly repacked) host arrays,
+    the current->original row maps, and the full-size result mirrors
+    retired rows are merged into.
+
+    Exact by construction: a retired element only ever contributes
+    identity values to the round reductions (0.0 to the scatter-adds
+    and the bool/float maxes, inf to the mins; 0.0 + x == x,
+    max(0.0, u>=0) == u, min(inf, r) == r), and a retired row's state
+    is frozen the moment its last live element dies — a variable
+    retires fixed, a constraint that can never again be touched keeps
+    its remaining/usage."""
+
+    def __init__(self, arrays: LmmArrays, device):
+        self.device = device
+        self.e = (arrays.e_var, arrays.e_cnst, arrays.e_w)
+        self.vc = (arrays.v_penalty, arrays.v_bound, arrays.c_bound,
+                   arrays.c_fatpipe)
+        self.v_map = self.c_map = None
+        self.final = None
+        self.orig_nv = len(arrays.v_penalty)
+        self.orig_nc = len(arrays.c_bound)
+
+    def try_compact(self, carry):
+        """Repack when at most half the element rows are still live.
+        Returns (device_args, carry, n_v, n_c) for the shrunken
+        system, or None when density is still high."""
+        e_var, e_cnst, e_w = self.e
+        v_pen, v_bnd, c_bnd, c_fat = self.vc
+        vfix = np.asarray(carry[1])
+        live = (e_w > 0) & (v_pen[e_var] > 0) & ~vfix[e_var]
+        n_live = int(live.sum())
+        if n_live > len(e_var) // 2:
+            return None
+        dt = e_w.dtype
+        # rows referenced by a live element stay; all others retire
+        vmask = np.zeros(len(v_pen), bool)
+        vmask[e_var[live]] = True
+        kept_v = np.flatnonzero(vmask)
+        cmask = np.zeros(len(c_bnd), bool)
+        cmask[e_cnst[live]] = True
+        kept_c = np.flatnonzero(cmask)
+
+        vv, vfx, rem, use, lig = (np.asarray(x) for x in carry[:5])
+        if self.final is None:
+            self.final = (np.zeros(self.orig_nv, dt),
+                          np.zeros(self.orig_nc, dt),
+                          np.zeros(self.orig_nc, dt))
+        vm = (self.v_map if self.v_map is not None
+              else np.arange(len(v_pen)))
+        cm = (self.c_map if self.c_map is not None
+              else np.arange(len(c_bnd)))
+        fv, fr, fu = self.final
+        # current arrays are bucket-padded beyond the map length
+        fv[vm] = vv[:len(vm)]
+        fr[cm] = rem[:len(cm)]
+        fu[cm] = use[:len(cm)]
+        self.v_map, self.c_map = vm[kept_v], cm[kept_c]
+
+        Eb = _bucket(max(n_live, 1))
+        Vb = _bucket(max(len(kept_v), 1))
+        Cb = _bucket(max(len(kept_c), 1))
+        v_o2n = np.zeros(len(v_pen), np.int32)
+        v_o2n[kept_v] = np.arange(len(kept_v), dtype=np.int32)
+        c_o2n = np.zeros(len(c_bnd), np.int32)
+        c_o2n[kept_c] = np.arange(len(kept_c), dtype=np.int32)
+
+        def repack(src, fill, n, idx):
+            out = np.full(n, fill, src.dtype)
+            out[:len(idx)] = src[idx]
+            return out
+
+        ev = np.zeros(Eb, np.int32)
+        ev[:n_live] = v_o2n[e_var[live]]
+        ec = np.zeros(Eb, np.int32)
+        ec[:n_live] = c_o2n[e_cnst[live]]
+        ew = np.zeros(Eb, dt)
+        ew[:n_live] = e_w[live]
+        self.e = (ev, ec, ew)
+        self.vc = (repack(v_pen, 0.0, Vb, kept_v),
+                   repack(v_bnd, -1.0, Vb, kept_v),
+                   repack(c_bnd, 0.0, Cb, kept_c),
+                   repack(c_fat, False, Cb, kept_c))
+
+        # compacted arrays bypass _DEVICE_ARGS_CACHE — they are fresh
+        # per solve and would thrash it
+        def put(a):
+            return jax.device_put(a, self.device)
+        args = [put(a) for a in
+                (ev, ec, ew, self.vc[2], self.vc[3],
+                 self.vc[0], self.vc[1])]
+        carry = (put(repack(vv, 0.0, Vb, kept_v)),
+                 put(repack(vfx, False, Vb, kept_v)),
+                 put(repack(rem, 0.0, Cb, kept_c)),
+                 put(repack(use, 0.0, Cb, kept_c)),
+                 put(repack(lig, False, Cb, kept_c)),
+                 carry[5])
+        return args, carry, Vb, Cb
+
+    def merge(self, values, remaining, usage):
+        """Final (values, remaining, usage) at ORIGINAL row numbering,
+        or None when no compaction ever ran."""
+        if self.final is None:
+            return None
+        fv, fr, fu = self.final
+        fv[self.v_map] = np.asarray(values)[:len(self.v_map)]
+        fr[self.c_map] = np.asarray(remaining)[:len(self.c_map)]
+        fu[self.c_map] = np.asarray(usage)[:len(self.c_map)]
+        return fv, fr, fu
+
+
 def solve_arrays(arrays: LmmArrays, eps: float, device=None,
                  parallel_rounds: Optional[bool] = None,
                  chunk: Optional[int] = None,
@@ -838,6 +985,7 @@ def solve_arrays(arrays: LmmArrays, eps: float, device=None,
     """Run the jit'd fixpoint in bounded-round chunks with host-side
     convergence checks between dispatches; returns
     (values, remaining, usage, rounds)."""
+    chunk_given = chunk is not None
     if parallel_rounds is None:
         parallel_rounds = use_local_rounds()
     if unroll is None:
@@ -859,9 +1007,46 @@ def solve_arrays(arrays: LmmArrays, eps: float, device=None,
     # the graph is not too skewed; COO everywhere else. lmm/layout
     # overrides (coo|ell|auto).
     layout = config["lmm/layout"]
+    platform = (device.platform if device is not None
+                else _default_platform())
     ell = None
-    if layout == "ell" or (layout == "auto" and _default_platform() != "cpu"):
+    if layout == "ell" or (layout == "auto" and platform != "cpu"):
         ell = _ell_cached(arrays)
+
+    # Active-set compaction: between chunks, repack the element list
+    # dropping elements of already-fixed variables.  Bit-identical to
+    # the dense run — a dead element contributes exact identities to
+    # every reduction (0.0 to the scatter-adds and the bool/float
+    # maxes, inf to the min-reductions), and float identities commute:
+    # 0.0 + x == x, max(0.0, u>=0) == u, min(inf, r) == r.  COO on CPU
+    # only by default: the per-chunk host sync and device_put that
+    # compaction needs are free there, while on a tunneled accelerator
+    # each costs a ~70 ms round-trip (and a fresh ~30 s XLA compile per
+    # new element-bucket size).
+    cmode = config["lmm/compact"]
+    if cmode not in ("auto", "on", "off"):
+        raise ValueError(f"Unknown lmm/compact {cmode!r} "
+                         "(expected auto, on or off)")
+    if cmode == "on" and ell is not None:
+        global _WARNED_COMPACT_ELL
+        if not _WARNED_COMPACT_ELL:
+            _WARNED_COMPACT_ELL = True
+            from ..utils import log as _log
+            _log.get_category("lmm").warning(
+                "lmm/compact:on has no effect on the ELL layout; set "
+                "lmm/layout:coo to compact on this device")
+    compacting = (ell is None
+                  and arrays.n_elem >= _COMPACT_MIN_ELEMS
+                  and (cmode == "on"
+                       or (cmode == "auto" and platform == "cpu")))
+    if compacting and not chunk_given:
+        # short chunks create the compaction points (the live element
+        # count at 100k flows halves roughly every 13 local rounds and
+        # far faster on small systems); global mode fixes ~one variable
+        # per round, so halvings are ~n_v rounds apart and short chunks
+        # would only add per-dispatch sync overhead.  An explicit
+        # caller-chosen chunk is honored as-is.
+        chunk = min(chunk, 4 if parallel_rounds else 64)
 
     eps_f = float(eps)
     # static specialization: systems with no active variable bound
@@ -870,6 +1055,7 @@ def solve_arrays(arrays: LmmArrays, eps: float, device=None,
     has_bounds = bool(np.any((arrays.v_bound[:arrays.n_var] > 0)
                              & (arrays.v_penalty[:arrays.n_var] > 0)))
     has_fatpipe = bool(np.any(arrays.c_fatpipe[:arrays.n_cnst]))
+    compactor = None
     if ell is not None:
         args = _device_args(
             "ell",
@@ -888,13 +1074,16 @@ def solve_arrays(arrays: LmmArrays, eps: float, device=None,
             "coo",
             [arrays.e_var, arrays.e_cnst, arrays.e_w, arrays.c_bound,
              arrays.c_fatpipe, arrays.v_penalty, arrays.v_bound], device)
-        n_c, n_v = len(arrays.c_bound), len(arrays.v_penalty)
+        cur_nc, cur_nv = len(arrays.c_bound), len(arrays.v_penalty)
+        if compacting:
+            compactor = _Compactor(arrays, device)
 
         def run_chunk(carry):
             return _solve_kernel_chunk(
-                *args, carry, eps=eps_f, n_c=n_c, n_v=n_v,
+                *args, carry, eps=eps_f, n_c=cur_nc, n_v=cur_nv,
                 parallel_rounds=parallel_rounds, chunk=chunk,
-                unroll=unroll)
+                unroll=unroll, has_bounds=has_bounds,
+                has_fatpipe=has_fatpipe)
 
     carry = None
     prev_progress = None
@@ -926,6 +1115,20 @@ def solve_arrays(arrays: LmmArrays, eps: float, device=None,
                 f"the system does not converge at eps={eps} in "
                 f"{arrays.e_w.dtype} precision")
         prev_progress = progress
+        if compactor is not None:
+            packed = compactor.try_compact(carry)
+            if packed is not None:
+                args, carry, cur_nv, cur_nc = packed
+                # the repack drops the already-fixed rows, so the
+                # fixed-count census restarts near zero — a progress
+                # comparison across a compaction would false-positive
+                # the stall detector (a stalled solve never compacts:
+                # compaction requires the live set to halve)
+                prev_progress = None
+    merged = (compactor.merge(values, remaining, usage)
+              if compactor is not None else None)
+    if merged is not None:
+        return merged[0], merged[1], merged[2], rounds
     # One transfer for all three result vectors.
     flat = np.asarray(jnp.concatenate(
         [values.astype(arrays.e_w.dtype),

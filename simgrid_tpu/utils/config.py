@@ -252,6 +252,16 @@ declare_flag("lmm/rounds",
              "(fix every local-minimum constraint per round; exact because "
              "rou levels only increase, and far fewer device rounds)",
              "local")
+declare_flag("lmm/compact",
+             "Repack the device element list between solver chunks, "
+             "dropping elements of already-fixed variables: on, off, or "
+             "auto (on for the COO layout on CPU backends, where the "
+             "host round-trip is free).  COO-only — combine with "
+             "lmm/layout:coo on accelerators — and skipped below a few "
+             "thousand elements where repacking costs more than it "
+             "saves.  Bit-identical: dead elements contribute exact "
+             "identities (0.0 to the scatter-adds and maxes, inf to "
+             "the min-reductions)", "auto")
 declare_flag("lmm/unroll",
              "Unroll the device fixpoint into straight-line XLA instead "
              "of lax.while_loop: on, off, or auto (on for accelerators — "

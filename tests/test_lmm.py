@@ -420,6 +420,35 @@ def test_chunked_solve_matches_single_dispatch():
 
 
 @pytest.mark.parametrize("rounds_mode", [False, True])
+@pytest.mark.parametrize("dtype,eps", [(np.float64, 1e-9),
+                                       (np.float32, 1e-5)])
+def test_compaction_bit_identical(rounds_mode, dtype, eps):
+    """Active-set compaction (lmm/compact) shrinks the element list AND
+    the variable/constraint rows between chunks; the result must be
+    bit-identical to the dense run — retired rows only ever contribute
+    exact identities (0.0 to adds/maxes, inf to mins), and a retired
+    row's state is frozen the moment its last live element dies."""
+    from simgrid_tpu.utils.config import config
+    from simgrid_tpu.ops.lmm_jax import solve_arrays
+    arrays = _bench_arrays(np.random.default_rng(11), 600, 2000, 3,
+                           dtype)
+    # exercise the bound-first rule and FATPIPE rows through the
+    # shrinking system too
+    arrays.v_bound[:400] = 0.25
+    arrays.c_fatpipe[:100] = True
+    try:
+        config["lmm/compact"] = "off"
+        dense = solve_arrays(arrays, eps, parallel_rounds=rounds_mode)
+        config["lmm/compact"] = "on"
+        packed = solve_arrays(arrays, eps, parallel_rounds=rounds_mode)
+    finally:
+        config["lmm/compact"] = "auto"
+    assert dense[3] == packed[3]
+    for d, p in zip(dense[:3], packed[:3]):
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(p))
+
+
+@pytest.mark.parametrize("rounds_mode", [False, True])
 def test_f32_convergence_100k_flows(rounds_mode):
     """The round-1 TPU failure mode: a 100k-flow / 16k-link system in f32
     must converge (stuck constraints with no live variables are pruned
