@@ -97,11 +97,12 @@ def stage_device(n_c: int, n_v: int, deg: int, seed: int,
 
     out = {"platform": dev.platform, "dtype": np.dtype(dtype).name}
     modes = [("local", True), ("global", False)]
-    if on_tpu and n_v > 5_000:
+    if (on_tpu and n_v > 5_000) or n_v > 20_000:
         # global mode fixes ~one variable per round (7k+ sequential
-        # rounds at 20k, 10k at 100k) — minutes of accelerator time for
-        # a number nobody uses; local is the accelerator mode.  Measure
-        # global on the small class only.
+        # rounds at 20k, ~40k at the giant class) — minutes of device
+        # time for a number nobody uses; local is the device mode.
+        # Measure global up to the huge class on CPU, small class on
+        # accelerators.
         modes = [("local", True)]
     for name, parallel in modes:
         _, _, _, rounds = solve_arrays(arrays, eps, parallel_rounds=parallel)

@@ -1,0 +1,80 @@
+"""s4u-actor-kill replica (reference
+examples/s4u/actor-kill/s4u-actor-kill.cpp): kill a resumed-then-working
+actor, kill an already-dead actor (no-op), kill a fresh actor before it
+runs (on_exit still fires), kill_all, and self-exit."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_actor_kill")
+
+
+def victim_a():
+    s4u.this_actor.on_exit(lambda failed: LOG.info("I have been killed!"))
+    LOG.info("Hello!")
+    LOG.info("Suspending myself")
+    s4u.this_actor.suspend()
+    LOG.info("OK, OK. Let's work")
+    s4u.this_actor.execute(1e9)
+    LOG.info("Bye!")
+
+
+def victim_b():
+    LOG.info("Terminate before being killed")
+
+
+def killer():
+    e = s4u.Engine.get_instance()
+    LOG.info("Hello!")
+    victim_a_ref = s4u.Actor.create("victim A",
+                                    e.host_by_name("Fafard"), victim_a)
+    victim_b_ref = s4u.Actor.create("victim B",
+                                    e.host_by_name("Jupiter"), victim_b)
+    s4u.this_actor.sleep_for(10)
+
+    LOG.info("Resume the victim A")
+    victim_a_ref.resume()
+    s4u.this_actor.sleep_for(2)
+
+    LOG.info("Kill the victim A")
+    s4u.Actor.by_pid(victim_a_ref.get_pid()).kill()
+
+    s4u.this_actor.sleep_for(1)
+
+    LOG.info("Kill victimB, even if it's already dead")
+    victim_b_ref.kill()
+
+    s4u.this_actor.sleep_for(1)
+
+    LOG.info("Start a new actor, and kill it right away")
+    victim_c = s4u.Actor.create("victim C", e.host_by_name("Jupiter"),
+                                victim_a)
+    victim_c.kill()
+
+    s4u.this_actor.sleep_for(1)
+
+    LOG.info("Killing everybody but myself")
+    s4u.Actor.kill_all()
+
+    LOG.info("OK, goodbye now. I commit a suicide.")
+    s4u.this_actor.exit()
+
+    LOG.info("This line never gets displayed: I'm already dead since the "
+             "previous line.")
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    s4u.Actor.create("killer", e.host_by_name("Tremblay"), killer)
+    e.run()
+
+
+if __name__ == "__main__":
+    main()

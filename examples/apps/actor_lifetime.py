@@ -1,0 +1,36 @@
+"""s4u-actor-lifetime replica (reference
+examples/s4u/actor-lifetime/s4u-actor-lifetime.cpp): actors deployed
+from XML with explicit start_time / kill_time; on_exit fires both on
+natural termination and on the deployment-driven kill."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("test")
+
+
+def sleeper():
+    s4u.this_actor.on_exit(
+        lambda failed: LOG.info("Exiting now (done sleeping or got "
+                                "killed)."))
+    LOG.info("Hello! I go to sleep.")
+    s4u.this_actor.sleep_for(10)
+    LOG.info("Done sleeping.")
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    e.register_function("sleeper", sleeper)
+    e.load_deployment(sys.argv[2])
+    e.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""s4u-actor-suspend replica (reference
+examples/s4u/actor-suspend/s4u-actor-suspend.cpp): suspend/resume of a
+sleeping actor (the sleep timer keeps running while suspended) and of a
+computing actor (the execution IS paused)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_actor_suspend")
+
+
+def lazy_guy():
+    LOG.info("Nobody's watching me ? Let's go to sleep.")
+    s4u.this_actor.suspend()
+    LOG.info("Uuuh ? Did somebody call me ?")
+
+    LOG.info("Going to sleep...")
+    s4u.this_actor.sleep_for(10)
+    LOG.info("Mmm... waking up.")
+
+    LOG.info("Going to sleep one more time (for 10 sec)...")
+    s4u.this_actor.sleep_for(10)
+    LOG.info("Waking up once for all!")
+
+    LOG.info("Ok, let's do some work, then (for 10 sec on Boivin).")
+    s4u.this_actor.execute(980.95e6)
+
+    LOG.info("Mmmh, I'm done now. Goodbye.")
+
+
+def dream_master():
+    LOG.info("Let's create a lazy guy.")
+    lazy = s4u.Actor.create("Lazy", s4u.this_actor.get_host(), lazy_guy)
+    LOG.info("Let's wait a little bit...")
+    s4u.this_actor.sleep_for(10)
+    LOG.info("Let's wake the lazy guy up! >:) BOOOOOUUUHHH!!!!")
+    if lazy.is_suspended():
+        lazy.resume()
+    else:
+        LOG.error("I was thinking that the lazy guy would be suspended now")
+
+    s4u.this_actor.sleep_for(5)
+    LOG.info("Suspend the lazy guy while he's sleeping...")
+    lazy.suspend()
+    LOG.info("Let him finish his siesta.")
+    s4u.this_actor.sleep_for(10)
+    LOG.info("Wake up, lazy guy!")
+    lazy.resume()
+
+    s4u.this_actor.sleep_for(5)
+    LOG.info("Suspend again the lazy guy while he's sleeping...")
+    lazy.suspend()
+    LOG.info("This time, don't let him finish his siesta.")
+    s4u.this_actor.sleep_for(2)
+    LOG.info("Wake up, lazy guy!")
+    lazy.resume()
+
+    s4u.this_actor.sleep_for(5)
+    LOG.info("Give a 2 seconds break to the lazy guy while he's working...")
+    lazy.suspend()
+    s4u.this_actor.sleep_for(2)
+    LOG.info("Back to work, lazy guy!")
+    lazy.resume()
+
+    LOG.info("OK, I'm done here.")
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    s4u.Actor.create("dream_master", e.host_by_name("Boivin"),
+                     dream_master)
+    e.run()
+
+
+if __name__ == "__main__":
+    main()

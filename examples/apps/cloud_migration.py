@@ -1,0 +1,100 @@
+"""s4u-cloud-migration replica (reference
+examples/s4u/cloud-migration/s4u-cloud-migration.cpp): three-stage
+pre-copy live migrations — serial, two-at-once over the same route,
+and two-at-once to different destinations."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.plugins import vm as vm_plugin
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_cloud_migration")
+
+
+def vm_migrate(vm, dst_pm):
+    src_pm = vm.pm
+    mig_sta = s4u.Engine.get_clock()
+    vm_plugin.migrate(vm, dst_pm)
+    mig_end = s4u.Engine.get_clock()
+    LOG.info("%s migrated: %s->%s in %g s"
+             % (vm.name, src_pm.name, dst_pm.name, mig_end - mig_sta))
+
+
+def vm_migrate_async(vm, dst_pm):
+    s4u.Actor.create("mig_wrk", s4u.this_actor.get_host(), vm_migrate,
+                     vm, dst_pm)
+
+
+def master_main():
+    e = s4u.Engine.get_instance()
+    pm0 = e.host_by_name("Fafard")
+    pm1 = e.host_by_name("Tremblay")
+    pm2 = e.host_by_name("Bourassa")
+
+    vm0 = s4u.VirtualMachine("VM0", pm0, 1)
+    vm0.ramsize = int(1e9)
+    vm0.start()
+
+    LOG.info("Test: Migrate a VM with %d Mbytes RAM"
+             % (vm0.ramsize // 1000 // 1000))
+    vm_migrate(vm0, pm1)
+
+    vm0.destroy()
+
+    vm0 = s4u.VirtualMachine("VM0", pm0, 1)
+    vm0.ramsize = int(1e8)
+    vm0.start()
+
+    LOG.info("Test: Migrate a VM with %d Mbytes RAM"
+             % (vm0.ramsize // 1000 // 1000))
+    vm_migrate(vm0, pm1)
+
+    vm0.destroy()
+
+    vm0 = s4u.VirtualMachine("VM0", pm0, 1)
+    vm1 = s4u.VirtualMachine("VM1", pm0, 1)
+    vm0.ramsize = int(1e9)
+    vm1.ramsize = int(1e9)
+    vm0.start()
+    vm1.start()
+
+    LOG.info("Test: Migrate two VMs at once from PM0 to PM1")
+    vm_migrate_async(vm0, pm1)
+    vm_migrate_async(vm1, pm1)
+    s4u.this_actor.sleep_for(10000)
+
+    vm0.destroy()
+    vm1.destroy()
+
+    vm0 = s4u.VirtualMachine("VM0", pm0, 1)
+    vm1 = s4u.VirtualMachine("VM1", pm0, 1)
+    vm0.ramsize = int(1e9)
+    vm1.ramsize = int(1e9)
+    vm0.start()
+    vm1.start()
+
+    LOG.info("Test: Migrate two VMs at once to different PMs")
+    vm_migrate_async(vm0, pm1)
+    vm_migrate_async(vm1, pm2)
+    s4u.this_actor.sleep_for(10000)
+
+    vm0.destroy()
+    vm1.destroy()
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    vm_plugin.vm_live_migration_plugin_init(e.pimpl)
+    e.load_platform(sys.argv[1])
+    s4u.Actor.create("master_", e.host_by_name("Fafard"), master_main)
+    e.run()
+    LOG.info("Bye (simulation time %g)" % e.clock)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""s4u-exec-dvfs replica (reference
+examples/s4u/exec-dvfs/s4u-exec-dvfs.cpp): pstate introspection and
+runtime pstate switching (a running exec continues at the new speed)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_test")
+
+
+def dvfs():
+    workload = 100e6
+    host = s4u.this_actor.get_host()
+
+    LOG.info("Count of Processor states=%d" % host.get_pstate_count())
+    LOG.info("Current power peak=%f" % host.get_speed())
+
+    s4u.this_actor.execute(workload)
+
+    task_time = s4u.Engine.get_clock()
+    LOG.info("Task1 duration: %.2f" % task_time)
+
+    new_pstate = 2
+    LOG.info("Changing power peak value to %f (at index %d)"
+             % (host.get_pstate_speed(new_pstate), new_pstate))
+    host.set_pstate(new_pstate)
+
+    LOG.info("Current power peak=%f" % host.get_speed())
+
+    s4u.this_actor.execute(workload)
+
+    task_time = s4u.Engine.get_clock() - task_time
+    LOG.info("Task2 duration: %.2f" % task_time)
+
+    host = s4u.Engine.get_instance().host_by_name("MyHost2")
+    LOG.info("Count of Processor states=%d" % host.get_pstate_count())
+    LOG.info("Current power peak=%f" % host.get_speed())
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    s4u.Actor.create("dvfs_test", e.host_by_name("MyHost1"), dvfs)
+    s4u.Actor.create("dvfs_test", e.host_by_name("MyHost2"), dvfs)
+    e.run()
+    LOG.info("Total simulation time: %e" % e.clock)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""s4u-synchro-semaphore replica (reference
+examples/s4u/synchro-semaphore/s4u-synchro-semaphore.cpp): a
+producer/consumer pair over a 1-slot buffer guarded by two semaphores —
+pins the acquire/release wake ordering."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_test")
+
+state = {"buffer": ""}
+sem_empty = None
+sem_full = None
+
+
+def producer(items):
+    for s in items:
+        sem_empty.acquire()
+        LOG.info("Pushing '%s'" % s)
+        state["buffer"] = s
+        sem_full.release()
+    LOG.info("Bye!")
+
+
+def consumer():
+    while True:
+        sem_full.acquire()
+        s = state["buffer"]
+        LOG.info("Receiving '%s'" % s)
+        sem_empty.release()
+        if s == "":
+            break
+    LOG.info("Bye!")
+
+
+def main():
+    global sem_empty, sem_full
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    sem_empty = s4u.Semaphore(1)
+    sem_full = s4u.Semaphore(0)
+    s4u.Actor.create("producer", e.host_by_name("Tremblay"), producer,
+                     ["one", "two", "three", ""])
+    s4u.Actor.create("consumer", e.host_by_name("Jupiter"), consumer)
+    e.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -118,6 +118,12 @@ class ActorImpl:
 
     def _suspend_self(self):
         from . import activity
+        # Re-arm the flag first (reference ActorImpl::suspend sets
+        # suspended_ back to true when re-parking, ActorImpl.cpp:366):
+        # resume_actor() must see a suspended actor, else a resume()
+        # arriving while we are parked is a silent no-op and the actor
+        # hangs forever ("waiting for nothing" deadlock).
+        self.suspended = True
         # Block on a signal-less exec (reference suspends via a 0-flop exec)
         self.simcall("actor_suspend", lambda sc: None)
 

@@ -359,8 +359,26 @@ class EngineImpl:
         finally:
             _sys.setswitchinterval(_prev_interval)
 
+    def _presolve(self) -> None:
+        """reference surf_presolve (surf_interface.cpp:57-73): apply
+        every profile event dated at the simulation start BEFORE the
+        first scheduling round, so t=0 profile values (speed_file
+        "0 0.5" lines etc.) are already visible to the first actor —
+        pinned by the platform-profile oracle's first output line."""
+        while True:
+            popped = self.future_evt_set.pop_leq(self.now)
+            if popped is None:
+                break
+            event, value, resource = popped
+            if value < 0:
+                continue    # idx-0 placeholder (see surf_solve)
+            resource.apply_event(event, value)
+
     def _run_loop(self, until: float) -> None:
         time = 0.0
+        if not getattr(self, "_presolved", False):
+            self._presolved = True
+            self._presolve()
         while True:
             self._execute_tasks()
 

@@ -83,7 +83,14 @@ class Host:
 
     # -- perf -------------------------------------------------------------
     def get_speed(self) -> float:
-        return self.cpu.get_speed()
+        # nominal speed of the current pstate (s4u::Host::get_speed);
+        # the availability-profile factor is get_available_speed() —
+        # the reference keeps them separate (s4u_Host.cpp), and the
+        # platform-profile oracle pins the product decomposition
+        return self.cpu.speed_per_pstate[self.cpu.pstate]
+
+    def get_available_speed(self) -> float:
+        return self.cpu.speed_scale
 
     def get_core_count(self) -> int:
         return self.cpu.core_count
@@ -93,7 +100,18 @@ class Host:
 
     # -- pstates (s4u::Host::set_pstate & friends) ------------------------
     def set_pstate(self, index: int) -> None:
-        self.cpu.set_pstate(index)
+        # A SIMCALL like the reference's s4u::Host::set_pstate
+        # (kernel::actor::simcall): the calling actor yields, so
+        # concurrent actors' log lines interleave exactly as the
+        # exec-dvfs oracle pins.  Outside any actor context the
+        # simcall executes inline through the maestro pseudo-actor.
+        from ..s4u.actor import _current_impl
+        issuer = _current_impl()
+
+        def handler(sc):
+            self.cpu.set_pstate(index)
+            sc.issuer.simcall_answer()
+        issuer.simcall("host_set_pstate", handler)
 
     def get_pstate(self) -> int:
         return self.cpu.pstate

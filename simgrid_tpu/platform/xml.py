@@ -160,7 +160,8 @@ class PlatformLoader:
         coords = elem.get("coordinates")
         if coords:
             host.netpoint.coords = [float(x) for x in coords.split()]
-        avail_file = elem.get("availability_file")
+        # "speed_file" is the v4.1 name, "availability_file" the legacy one
+        avail_file = elem.get("speed_file") or elem.get("availability_file")
         if avail_file:
             cpu.set_speed_profile(self._profile_from_file(avail_file))
         state_file = elem.get("state_file")

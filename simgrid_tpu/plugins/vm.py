@@ -51,11 +51,21 @@ class VMModel(CpuCas01Model):
             for host in exec_impl.hosts:
                 if isinstance(host, VirtualMachine):
                     host.add_active_task()
+                    host.active_execs.add(exec_impl)
+                    if host.dp_enabled and exec_impl.surf_action:
+                        host.dp_objs[exec_impl] = \
+                            exec_impl.surf_action.get_remains()
 
         def on_exec_completion(exec_impl):
             for host in exec_impl.hosts:
                 if isinstance(host, VirtualMachine):
                     host.remove_active_task()
+                    host.active_execs.discard(exec_impl)
+                    if host.dp_enabled:
+                        # a finished exec consumed everything recorded
+                        # for it since the last lookup
+                        host.dp_updated_by_deleted += \
+                            host.dp_objs.pop(exec_impl, 0.0)
 
         engine.connect_signal(ExecImpl.on_creation, on_exec_creation)
         engine.connect_signal(ExecImpl.on_completion, on_exec_completion)
@@ -107,6 +117,14 @@ class VirtualMachine(Host):
         self.state = VirtualMachine.CREATED
         self.params = {"dp_intensity": 0.0, "dp_cap": 0.9,
                        "mig_speed": -1.0}
+        # dirty-page tracking (VirtualMachineImpl dp_* machinery):
+        # computed flops per tracking interval drive the stage-2
+        # re-send volume of a live migration
+        self.active_execs: set = set()
+        self.dp_enabled = False
+        self.dp_objs: dict = {}
+        self.dp_updated_by_deleted = 0.0
+        self.is_migrating = False
         # VCPU: a cpu in the VM model, capacity core_amount x PM speed
         # for now; the real bound arrives from the PM solution each
         # round.
@@ -153,15 +171,12 @@ class VirtualMachine(Host):
     def start(self) -> "VirtualMachine":
         assert self.state == VirtualMachine.CREATED, \
             f"Cannot start VM {self.name} in state {self.state}"
-        # Core availability check (s4u_VirtualMachine.cpp start): sum of
-        # running VMs' cores on this PM must fit.
-        used = sum(vm.core_amount
-                   for vm in self.engine.vm_model.vms
-                   if vm is not self and vm.pm is self.pm
-                   and vm.state == VirtualMachine.RUNNING)
-        assert used + self.core_amount <= self.pm.cpu.core_count, \
-            (f"Cannot start VM {self.name}: {self.pm.name} has "
-             f"{self.pm.cpu.core_count} cores, {used} already assigned")
+        # The reference start() has NO core-capacity check — CPU
+        # overcommit is allowed and resolved by the two-layer fairness
+        # (s4u_VirtualMachine.cpp:63-94 only guards RAM overcommit,
+        # and only when the PM declares a ramsize) — pinned by the
+        # cloud-migration oracle, which runs two 1-core VMs on the
+        # 1-core Fafard.
         self.state = VirtualMachine.RUNNING
         VirtualMachine.on_start(self)
         return self
@@ -207,6 +222,29 @@ class VirtualMachine(Host):
         self.state = VirtualMachine.DESTROYED
         VirtualMachine.on_destruction(self)
 
+    # -- dirty-page tracking (VirtualMachineImpl::start_dirty_page_
+    # tracking / lookup_computed_flops) -----------------------------------
+    def start_dirty_page_tracking(self) -> None:
+        self.dp_enabled = True
+        self.dp_objs = {e: e.surf_action.get_remains()
+                        for e in self.active_execs if e.surf_action}
+        self.dp_updated_by_deleted = 0.0
+
+    def stop_dirty_page_tracking(self) -> None:
+        self.dp_enabled = False
+        self.dp_objs = {}
+
+    def lookup_computed_flops(self) -> float:
+        """Flops the VM computed since tracking started / the previous
+        lookup; resets the interval."""
+        total = self.dp_updated_by_deleted
+        for e, recorded in list(self.dp_objs.items()):
+            cur = e.surf_action.get_remains() if e.surf_action else 0.0
+            total += recorded - cur
+            self.dp_objs[e] = cur
+        self.dp_updated_by_deleted = 0.0
+        return total
+
     # -- migration (VirtualMachineImpl::migrate + VmLiveMigration) --------
     def migrate_now(self, dst_pm: Host) -> None:
         """Instant re-homing (VirtualMachineImpl::migrate): move the PM
@@ -233,68 +271,108 @@ def vm_live_migration_plugin_init(engine=None) -> None:
 
 def migrate(vm: VirtualMachine, dst_pm: Host) -> None:
     """Live migration with the reference's three-stage pre-copy
-    (VmLiveMigration.cpp MigrationTx::operator()); must be called from
-    inside an actor. Stage 1 ships the RAM working set, stage 2
-    iterates over pages dirtied during the previous transfer
-    (dp_intensity x migration throughput, capped at dp_cap x ramsize),
-    stage 3 stops the VM and ships the residue."""
+    (VmLiveMigration.cpp MigrationTx/MigrationRx); must be called from
+    inside an actor.  Stage 1 ships the whole RAM, stage 2 iterates on
+    the pages dirtied meanwhile (the VM's computed flops per interval
+    x dp_rate, capped at the working set) until the residue fits under
+    bandwidth x max_downtime, stage 3 stops the VM and ships the
+    residue; the RECEIVER re-homes and resumes the VM, then ACKs the
+    issuer (timestamps pinned by the cloud-migration oracle)."""
     from ..s4u import Engine, Mailbox
     from ..s4u.actor import Actor
+    from ..exceptions import TimeoutException
 
     assert vm.state == VirtualMachine.RUNNING, \
         "Cannot migrate a VM that is not running"
     VirtualMachine.on_migration_start(vm)
-    ramsize = vm.ramsize or 1
-    dp_intensity = vm.params["dp_intensity"]
-    dp_cap = vm.params["dp_cap"]
-    mig_speed = vm.params["mig_speed"]
-
-    mbox = Mailbox.by_name(f"__mig__{vm.name}")
-    done = Mailbox.by_name(f"__mig_done__{vm.name}")
-
-    _EOS = "__mig_eos__"
+    vm.is_migrating = True
+    src_pm = vm.pm
+    sid = f"{vm.name}({src_pm.name}-{dst_pm.name})"
+    mbox = Mailbox.by_name(f"__mbox_mig_dst:{sid}")
+    mbox_ctl = Mailbox.by_name(f"__mbox_mig_ctl:{sid}")
 
     def rx():
-        while mbox.get() != _EOS:
+        # MigrationRx::operator() (VmLiveMigration.cpp:24-85)
+        finalize = f"__mig_stage3:{sid}"
+        while mbox.get() != finalize:
             pass
-        done.put(b"", 0)
-
-    Actor.create(f"__mig_rx__{vm.name}", dst_pm, rx)
-
-    del mig_speed   # rate-capping the stream is not modeled yet
-
-    def put(size: float) -> float:
-        t0 = Engine.get_clock()
-        mbox.put(b"m", max(size, 1.0))
-        return Engine.get_clock() - t0
+        vm.migrate_now(dst_pm)
+        vm.resume()
+        vm.is_migrating = False
+        mbox_ctl.put(f"__mig_stage4:{sid}", 0)
 
     def tx():
-        # Stage 1: the whole RAM working set.
-        elapsed = put(ramsize)
-        # Stage 2: iterative pre-copy of dirtied pages; geometric
-        # decrease unless the dirtying rate outruns the link.
-        threshold = ramsize * 0.01
-        updated = min(dp_intensity * ramsize * min(elapsed, 1.0),
-                      dp_cap * ramsize)
-        for _ in range(4):
-            if updated <= threshold:
-                break
-            elapsed = put(updated)
-            updated = min(dp_intensity * ramsize * min(elapsed, 1.0),
-                          dp_cap * ramsize)
+        # MigrationTx::operator() (VmLiveMigration.cpp:137-280)
+        host_speed = vm.pm.get_speed()
+        ramsize = vm.ramsize
+        mig_speed = vm.params["mig_speed"]
+        # dp_rate couples the dirtying volume to the migration speed
+        # (VmLiveMigration.cpp:144-146): with mig_speed unset (<=0,
+        # the default) the reference computes no dirtied pages at all
+        # — clamp so the sentinel -1 cannot produce negative sizes
+        dp_rate = ((max(mig_speed, 0.0) * vm.params["dp_intensity"])
+                   / host_speed if host_speed else 1.0)
+        dp_cap = vm.params["dp_cap"] * ramsize
+        max_downtime = 0.03
+        mig_timeout = 10000000.0
+
+        def send(size, stage, timeout):
+            sent = size
+            comm = mbox.put_init(f"__mig_stage{stage}:{sid}", size)
+            if mig_speed > 0:
+                comm.set_rate(mig_speed)
+            try:
+                comm.wait_for(timeout)
+            except TimeoutException:
+                sent -= comm.get_remaining()
+            return sent
+
+        remaining = ramsize
+        vm.start_dirty_page_tracking()
+        skip_stage2 = False
+        t0 = Engine.get_clock()
+        sent = send(ramsize, 1, -1)
+        computed = vm.lookup_computed_flops()
+        remaining -= sent
+        if sent < ramsize:
+            skip_stage2 = True
+        t1 = Engine.get_clock()
+        mig_timeout -= t1 - t0
+        if mig_timeout < 0:
+            skip_stage2 = True
+        bandwidth = ramsize / (t1 - t0) if t1 > t0 else float("inf")
+        threshold = bandwidth * max_downtime
+
+        if not skip_stage2:
+            updated = min(computed * dp_rate, dp_cap)
+            remaining += updated
+            while threshold < remaining:
+                tp = Engine.get_clock()
+                sent = send(updated, 2, mig_timeout)
+                remaining -= sent
+                computed = vm.lookup_computed_flops()
+                tq = Engine.get_clock()
+                if sent == updated and tq > tp:
+                    bandwidth = updated / (tq - tp)
+                    threshold = bandwidth * max_downtime
+                    mig_timeout -= tq - tp
+                    updated = min(computed * dp_rate, dp_cap)
+                    remaining += updated
+                else:
+                    # timeout: the pages dirtied before it still count
+                    remaining += min(computed * dp_rate, dp_cap)
+                    break
+
         # Stage 3: stop-and-copy.
         vm.suspend()
-        if updated > 0:
-            put(updated)
-        mbox.put(_EOS, 0)      # close stream (0-byte control msg,
-        # like the reference's stage-3 finalize + mbox_ctl ACK)
+        vm.stop_dirty_page_tracking()
+        send(remaining, 3, -1)
 
     # The migration stream runs between the CURRENT physical host and
     # the destination (sg_vm_migrate puts MigrationTx on src_pm): the
     # caller may sit on a third host, and after a first migration the
     # source is wherever the VM lives NOW — not where the caller is.
-    Actor.create(f"__mig_tx__{vm.name}", vm.pm, tx)
-    done.get()
-    vm.migrate_now(dst_pm)
-    vm.resume()
+    Actor.create(f"__pr_mig_rx:{sid}", dst_pm, rx)
+    Actor.create(f"__pr_mig_tx:{sid}", src_pm, tx)
+    mbox_ctl.get()
     VirtualMachine.on_migration_end(vm)

@@ -53,6 +53,16 @@ class Actor:
         return Actor(pimpl)
 
     @staticmethod
+    def by_pid(pid: int) -> Optional["Actor"]:
+        """Retrieve a live actor from its PID, or None (reference
+        s4u::Actor::by_pid, s4u_Actor.cpp)."""
+        engine = Engine.get_instance().pimpl
+        impl = engine.process_list.get(pid)
+        if impl is None or impl.finished:
+            return None
+        return getattr(impl, "s4u_actor", None) or Actor(impl)
+
+    @staticmethod
     def self() -> Optional["Actor"]:
         engine = Engine.get_instance().pimpl
         actor = engine.context_factory.current_actor
@@ -67,6 +77,9 @@ class Actor:
 
     @property
     def pid(self) -> int:
+        return self.pimpl.pid
+
+    def get_pid(self) -> int:
         return self.pimpl.pid
 
     @property
@@ -314,4 +327,13 @@ class this_actor:
 
     @staticmethod
     def on_exit(callback: Callable[[bool], None]) -> None:
-        _current_impl().on_exit_callbacks.append(callback)
+        """Register a termination callback.  A SIMCALL, like the
+        reference's simcall_process_on_exit: the registering actor
+        yields to the kernel, so an actor killed in the same scheduling
+        round dies having registered its callback but before executing
+        its next statement (pinned by the actor-kill oracle, where
+        victim C logs 'I have been killed!' but never 'Hello!')."""
+        issuer = _current_impl()
+        issuer.on_exit_callbacks.append(callback)
+        issuer.simcall("actor_on_exit",
+                       lambda sc: sc.issuer.simcall_answer())

@@ -241,6 +241,11 @@ def apply_control(control: str) -> None:
     ``threshold`` of length >= 2 is accepted (``th``, ``thres``, ...);
     unknown settings raise instead of being silently dropped."""
     for token in control.split():
+        if token == "no_loc":
+            # reference xbt_log_control_set("no_loc"): hide source
+            # locations (for tesh reproducibility); our layouts never
+            # print locations, so this is accepted as a no-op
+            continue
         if ":" not in token:
             raise ValueError(f"Invalid log control {token!r}: expected "
                              f"'category.setting:value'")

@@ -274,6 +274,10 @@ def test_vm_live_migration(vmplat):
     vm1 = vm.VirtualMachine("vm1", pm1, core_amount=1,
                             ramsize=125_000_000).start()
     vm1.params["dp_intensity"] = 0.5
+    # dp_rate = mig_speed*dp_intensity/host_speed (the reference
+    # couples dirtying to the migration speed): stage-2 pre-copy only
+    # engages when mig_speed is set
+    vm1.params["mig_speed"] = 1.25e8
     log = {}
 
     def worker():
@@ -296,13 +300,16 @@ def test_vm_live_migration(vmplat):
     assert vm1.pm is pm2
 
 
-def test_vm_core_capacity_check(vmplat):
+def test_vm_core_overcommit_allowed(vmplat):
+    """The reference start() has NO core-capacity check: CPU
+    overcommit is allowed and resolved by the two-layer fairness
+    (s4u_VirtualMachine.cpp:63-94 only guards RAM overcommit) — the
+    cloud-migration oracle runs two 1-core VMs on 1-core Fafard."""
     e = s4u.Engine(["t"])
     e.load_platform(vmplat)
     pm1 = e.host_by_name("pm1")
     vm.VirtualMachine("a", pm1, core_amount=3).start()
-    with pytest.raises(AssertionError):
-        vm.VirtualMachine("b", pm1, core_amount=2).start()
+    vm.VirtualMachine("b", pm1, core_amount=2).start()  # overcommit ok
 
 
 def test_file_remote_copy(tmp_path):

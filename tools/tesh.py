@@ -74,7 +74,12 @@ def run_cmd(cmd: Cmd, env: dict, verbose: bool) -> bool:
     actual = [l for l in proc.stdout.splitlines()]
     expected = list(cmd.expected)
     if cmd.sort_output:
-        actual, expected = sorted(actual), sorted(expected)
+        if cmd.sort_output is True:
+            actual, expected = sorted(actual), sorted(expected)
+        else:
+            n = cmd.sort_output
+            actual = sorted(actual, key=lambda l: l[:n])
+            expected = sorted(expected, key=lambda l: l[:n])
     if actual != expected:
         print(f"Output mismatch for: {args}", file=sys.stderr)
         import difflib
@@ -120,8 +125,11 @@ def run_tesh(path: str, env: dict, verbose: bool = False) -> bool:
                     current.timeout = float(directive.split()[1])
                 elif directive.startswith("expect return"):
                     current.expect_return = int(directive.split()[2])
-                elif directive == "output sort":
-                    current.sort_output = True
+                elif directive.startswith("output sort"):
+                    # "output sort N" compares only the first N chars
+                    # (stable), the reference's timestamp-prefix sort
+                    rest_d = directive[len("output sort"):].strip()
+                    current.sort_output = int(rest_d) if rest_d else True
                 elif directive == "output ignore":
                     current.ignore_output = True
                 elif directive.startswith("setenv"):
