@@ -79,6 +79,16 @@ class ActivityImpl:
 
     def clean_action(self) -> None:
         if self.surf_action is not None:
+            # keep the final progress readable after the action is
+            # released: a sender catching a wait_for timeout reads
+            # get_remaining() to learn how much was actually shipped
+            # (reference keeps the surf action alive until the comm
+            # object dies, so comm->get_remaining() works there).
+            # Raw .remains, NOT get_remains(): the lazy-update path
+            # asserts on actions already pulled off the running set,
+            # and a finishing/cancelled action's remains was already
+            # settled by update_actions_state.
+            self._final_remains = self.surf_action.remains
             self.surf_action.activity = None
             self.surf_action.unref()
             self.surf_action = None
@@ -96,7 +106,9 @@ class ActivityImpl:
             self.surf_action.cancel()
 
     def get_remaining(self) -> float:
-        return self.surf_action.get_remains() if self.surf_action else 0.0
+        if self.surf_action is not None:
+            return self.surf_action.get_remains()
+        return getattr(self, "_final_remains", 0.0)
 
     def post(self) -> None:
         raise NotImplementedError

@@ -284,6 +284,8 @@ def migrate(vm: VirtualMachine, dst_pm: Host) -> None:
 
     assert vm.state == VirtualMachine.RUNNING, \
         "Cannot migrate a VM that is not running"
+    assert not vm.is_migrating, \
+        f"Cannot migrate VM '{vm.name}' that is already migrating"
     VirtualMachine.on_migration_start(vm)
     vm.is_migrating = True
     src_pm = vm.pm
@@ -292,7 +294,11 @@ def migrate(vm: VirtualMachine, dst_pm: Host) -> None:
     mbox_ctl = Mailbox.by_name(f"__mbox_mig_ctl:{sid}")
 
     def rx():
-        # MigrationRx::operator() (VmLiveMigration.cpp:24-85)
+        # MigrationRx::operator() (VmLiveMigration.cpp:24-85).  Like
+        # the reference's rx, an in-flight failure (the ~1e7 s
+        # migration timeout, a dying link) is not caught here: the
+        # escape hatch is shutting the VM down, which kills both
+        # migration actors (reference onVirtualMachineShutdown).
         finalize = f"__mig_stage3:{sid}"
         while mbox.get() != finalize:
             pass
