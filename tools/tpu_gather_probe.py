@@ -1,0 +1,86 @@
+#!/usr/bin/env python3
+"""Pin down the axon-TPU gather fast path: same gathered volume
+(524288 elements), different index shapes / source sizes / modes.
+Chained-dispatch timing protocol (see tpu_opcost.py)."""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+OUT = os.path.join(ROOT, "bench_results", "tpu_opcost.jsonl")
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    dtype = jnp.float32 if dev.platform != "cpu" else jnp.float64
+    rec = {"platform": dev.platform, "probe": "gather_shapes",
+           "ts": round(time.time(), 1)}
+
+    C, V, E = 16384, 131072, 524288
+    rng = np.random.default_rng(7)
+    idxC = rng.integers(0, C, E).astype(np.int32)
+    idxV = rng.integers(0, V, E).astype(np.int32)
+    tabC = jnp.asarray(rng.uniform(1, 2, C), dtype)
+    tabV = jnp.asarray(rng.uniform(1, 2, V), dtype)
+
+    sync = None
+
+    def timed(name, fn, K=24):
+        nonlocal sync
+        f = jax.jit(fn)
+        s = jnp.asarray(0.0, dtype)
+        float(np.asarray(f(s).ravel()[0]))
+        t0 = time.perf_counter()
+        s = jnp.asarray(0.0, dtype)
+        for _ in range(K):
+            s = f(s).ravel()[0] * 1e-30
+        float(np.asarray(s))
+        wall = time.perf_counter() - t0
+        rec[name] = round((wall - (sync or 0.0) / 1e3) / K * 1e3, 3)
+        print(f"  {name}: {rec[name]} ms")
+
+    triv = jax.jit(lambda s: s + 1.0)
+    float(np.asarray(triv(jnp.asarray(0.0, dtype))))
+    ts = []
+    for _ in range(6):
+        t0 = time.perf_counter()
+        float(np.asarray(triv(jnp.asarray(0.0, dtype))))
+        ts.append(time.perf_counter() - t0)
+    sync = rec["sync_ms"] = round(float(np.median(ts)) * 1e3, 3)
+    print(f"  sync_ms: {sync}")
+
+    shapes = {"flat": (E,), "x128": (E // 128, 128),
+              "x4": (E // 4, 4), "x8": (E // 8, 8),
+              "x512": (E // 512, 512)}
+    for nm, shp in shapes.items():
+        idx = jnp.asarray(idxC.reshape(shp))
+        timed(f"gC_{nm}", lambda s, idx=idx: jnp.take(tabC + s, idx))
+    for nm, shp in [("flat", (E,)), ("x4", (E // 4, 4)),
+                    ("x128", (E // 128, 128))]:
+        idx = jnp.asarray(idxV.reshape(shp))
+        timed(f"gV_{nm}", lambda s, idx=idx: jnp.take(tabV + s, idx))
+    # sorted indices, flat
+    idxs = jnp.asarray(np.sort(idxC))
+    timed("gC_flat_sorted", lambda s: jnp.take(tabC + s, idxs))
+    # repeat-based expansion (var-major broadcast): [V] -> [V,4] -> flat
+    timed("repeat_V4", lambda s: jnp.repeat(tabV + s, 4))
+    # one flat gather then reshape out
+    idxf = jnp.asarray(idxC)
+    timed("gC_flat_reshaped_out",
+          lambda s: jnp.take(tabC + s, idxf).reshape(-1, 128))
+
+    with open(OUT, "a") as fh:
+        fh.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,123 @@
+#!/usr/bin/env python3
+"""Which 2D-gather forms does Mosaic accept on this chip, and how fast?
+
+Forms probed (all gather 524288 f32 from a 16384-entry table):
+  A. take_along_axis(tab_bcast [8, C], idx [8, K], axis=1), looped
+  B. take_along_axis(tab_rows [R, C], idx [R, Kc], axis=1) one shot,
+     R x C table materialized in-kernel by broadcast
+  C. jnp.take(tab [C, 1], idx [Vr, 4], axis=0)  (row gather)
+  D. tab2d[idx, lane_iota] style take_along_axis along axis 0
+Appends to bench_results/tpu_opcost.jsonl."""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+OUT = os.path.join(ROOT, "bench_results", "tpu_opcost.jsonl")
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    dev = jax.devices()[0]
+    dtype = jnp.float32
+    rec = {"platform": dev.platform, "probe": "pallas_gather_forms",
+           "ts": round(time.time(), 1)}
+    C, V, DEG = 16384, 131072, 4
+    rng = np.random.default_rng(7)
+    idx_np = rng.integers(0, C, (V, DEG)).astype(np.int32)
+    tab_np = rng.uniform(1, 2, C).astype(np.float32)
+    want = tab_np[idx_np]
+    tab = jnp.asarray(tab_np)
+    sync = 66.0
+
+    def timed(f, K=16):
+        s = jnp.asarray(0.0, dtype)
+        float(np.asarray(f(s).ravel()[0]))
+        t0 = time.perf_counter()
+        s = jnp.asarray(0.0, dtype)
+        for _ in range(K):
+            s = f(s).ravel()[0] * 1e-30
+        float(np.asarray(s))
+        return round((time.perf_counter() - t0 - sync / 1e3) / K * 1e3, 3)
+
+    def try_form(name, build):
+        try:
+            f = jax.jit(build())
+            got = np.asarray(f(jnp.asarray(0.0, dtype)))
+            ok = got.shape == want.reshape(got.shape).shape and \
+                np.allclose(got.ravel(), want.ravel())
+            if not ok:
+                rec[name] = f"WRONG (shape {got.shape})"
+            else:
+                rec[name] = timed(f)
+            print(f"  {name}: {rec[name]}")
+        except Exception as exc:  # noqa: BLE001
+            rec[name] = f"{type(exc).__name__}: {exc}"[:250]
+            print(f"  {name}: {rec[name]}")
+
+    # Form A: [8, C] broadcast table, idx rows of 8 x K, fori over V/8/K'
+    # simplest variant: idx reshaped [8, E/8], one take_along_axis call
+    idxA = jnp.asarray(idx_np.reshape(8, -1))
+
+    def buildA():
+        def k(tab_ref, idx_ref, o_ref):
+            t8 = jnp.broadcast_to(tab_ref[:].reshape(1, C), (8, C))
+            o_ref[:] = jnp.take_along_axis(t8, idx_ref[:], axis=1)
+        return lambda s: pl.pallas_call(
+            k, out_shape=jax.ShapeDtypeStruct((8, V * DEG // 8), dtype),
+        )(tab + s, idxA)
+    try_form("A_tala_8xK", buildA)
+
+    # Form B: [64, C] table rows, idx [64, E/64]
+    idxB = jnp.asarray(idx_np.reshape(64, -1))
+
+    def buildB():
+        def k(tab_ref, idx_ref, o_ref):
+            t = jnp.broadcast_to(tab_ref[:].reshape(1, C), (64, C))
+            o_ref[:] = jnp.take_along_axis(t, idx_ref[:], axis=1)
+        return lambda s: pl.pallas_call(
+            k, out_shape=jax.ShapeDtypeStruct((64, V * DEG // 64), dtype),
+        )(tab + s, idxB)
+    try_form("B_tala_64xK", buildB)
+
+    # Form C: row gather from [C, 1]
+    idxC2 = jnp.asarray(idx_np)
+
+    def buildC():
+        def k(tab_ref, idx_ref, o_ref):
+            o_ref[:] = jnp.take(tab_ref[:], idx_ref[:], axis=0)[..., 0]
+        return lambda s: pl.pallas_call(
+            k, out_shape=jax.ShapeDtypeStruct((V, DEG), dtype),
+        )((tab + s).reshape(C, 1), idxC2)
+    try_form("C_rowgather", buildC)
+
+    # Form D: take_along_axis along axis 0: tab2d [C, 128], idx [E/128,
+    # 128] -> out[i, j] = tab2d[idx[i, j], j]; table replicated to 128
+    # lanes in-kernel
+    idxD = jnp.asarray(idx_np.reshape(-1, 128))
+
+    def buildD():
+        def k(tab_ref, idx_ref, o_ref):
+            t = jnp.broadcast_to(tab_ref[:].reshape(C, 1), (C, 128))
+            o_ref[:] = jnp.take_along_axis(t, idx_ref[:], axis=0)
+        return lambda s: pl.pallas_call(
+            k, out_shape=jax.ShapeDtypeStruct((V * DEG // 128, 128),
+                                              dtype),
+        )(tab + s, idxD)
+    try_form("D_tala_axis0", buildD)
+
+    with open(OUT, "a") as fh:
+        fh.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

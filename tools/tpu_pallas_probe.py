@@ -1,0 +1,135 @@
+#!/usr/bin/env python3
+"""Can Mosaic lower the solver's hot ops inside one pallas kernel on
+this chip?  Probes, in order of ambition:
+
+  1. in-kernel jnp.take: gather tab[C] at idx [V,4]  (VMEM gather)
+  2. in-kernel segment-sum via jnp.zeros(C).at[idx].add(w)
+  3. in-kernel fori_loop of K gather rounds (the whole-fixpoint shape)
+
+Each probe checks CORRECTNESS against numpy and reports timing with
+the chained-dispatch protocol.  Appends to bench_results/tpu_opcost.jsonl.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+OUT = os.path.join(ROOT, "bench_results", "tpu_opcost.jsonl")
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    dev = jax.devices()[0]
+    dtype = jnp.float32
+    rec = {"platform": dev.platform, "probe": "pallas_ops",
+           "ts": round(time.time(), 1)}
+
+    C, V, DEG = 16384, 131072, 4
+    E = V * DEG
+    rng = np.random.default_rng(7)
+    idx_np = rng.integers(0, C, (V, DEG)).astype(np.int32)
+    tab_np = rng.uniform(1, 2, C).astype(np.float32)
+    w_np = rng.uniform(0.5, 1.5, (V, DEG)).astype(np.float32)
+    idx = jnp.asarray(idx_np)
+    tab = jnp.asarray(tab_np)
+    w = jnp.asarray(w_np)
+
+    sync = 66.0
+
+    def timed(name, f, K=24):
+        s = jnp.asarray(0.0, dtype)
+        float(np.asarray(f(s).ravel()[0]))
+        t0 = time.perf_counter()
+        s = jnp.asarray(0.0, dtype)
+        for _ in range(K):
+            s = f(s).ravel()[0] * 1e-30
+        float(np.asarray(s))
+        wall = time.perf_counter() - t0
+        rec[name] = round((wall - sync / 1e3) / K * 1e3, 3)
+        print(f"  {name}: {rec[name]} ms")
+
+    # --- probe 1: gather ---
+    def gk(tab_ref, idx_ref, o_ref):
+        o_ref[:] = jnp.take(tab_ref[:], idx_ref[:], axis=0)
+
+    try:
+        @jax.jit
+        def pgather(s):
+            return pl.pallas_call(
+                gk, out_shape=jax.ShapeDtypeStruct((V, DEG), dtype),
+            )(tab + s, idx)
+        got = np.asarray(pgather(jnp.asarray(0.0, dtype)))
+        want = tab_np[idx_np]
+        ok = np.allclose(got, want)
+        rec["pallas_gather_ok"] = bool(ok)
+        print(f"  gather correct: {ok}")
+        if ok:
+            timed("pallas_gather_ms", pgather)
+    except Exception as exc:  # noqa: BLE001
+        rec["pallas_gather_ok"] = f"{type(exc).__name__}: {exc}"[:400]
+        print(f"  gather FAILED: {rec['pallas_gather_ok']}")
+
+    # --- probe 2: segment-sum (scatter-add) ---
+    def sk(idx_ref, w_ref, o_ref):
+        o_ref[:] = jnp.zeros((C,), dtype).at[idx_ref[:].ravel()].add(
+            w_ref[:].ravel())
+
+    try:
+        @jax.jit
+        def pseg(s):
+            return pl.pallas_call(
+                sk, out_shape=jax.ShapeDtypeStruct((C,), dtype),
+            )(idx, w + s)
+        got = np.asarray(pseg(jnp.asarray(0.0, dtype)))
+        want = np.zeros(C, np.float32)
+        np.add.at(want, idx_np.ravel(), w_np.ravel())
+        ok = np.allclose(got, want, rtol=1e-4)
+        rec["pallas_segsum_ok"] = bool(ok)
+        print(f"  segsum correct: {ok}")
+        if ok:
+            timed("pallas_segsum_ms", pseg)
+    except Exception as exc:  # noqa: BLE001
+        rec["pallas_segsum_ok"] = f"{type(exc).__name__}: {exc}"[:400]
+        print(f"  segsum FAILED: {rec['pallas_segsum_ok']}")
+
+    # --- probe 3: K gather-rounds inside one kernel ---
+    K_ROUNDS = 16
+
+    def lk(tab_ref, idx_ref, o_ref):
+        def body(i, acc):
+            g = jnp.take(tab_ref[:] + acc[0, 0] * 1e-30, idx_ref[:],
+                         axis=0)
+            return acc + g.sum(axis=1, keepdims=True)[:8, :1] * 0 + \
+                g[:8, :1]
+        o_ref[:] = jax.lax.fori_loop(0, K_ROUNDS, body,
+                                     jnp.zeros((8, 1), dtype))
+
+    try:
+        @jax.jit
+        def ploop(s):
+            return pl.pallas_call(
+                lk, out_shape=jax.ShapeDtypeStruct((8, 1), dtype),
+            )(tab + s, idx)
+        np.asarray(ploop(jnp.asarray(0.0, dtype)))
+        rec["pallas_loop_ok"] = True
+        print("  loop kernel ran")
+        timed("pallas_loop16_ms", ploop)
+    except Exception as exc:  # noqa: BLE001
+        rec["pallas_loop_ok"] = f"{type(exc).__name__}: {exc}"[:400]
+        print(f"  loop FAILED: {rec['pallas_loop_ok']}")
+
+    with open(OUT, "a") as fh:
+        fh.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,87 @@
+#!/usr/bin/env python3
+"""Scaling laws for the axon-TPU's gather/scatter costs.
+
+Two questions the round-body redesign hinges on:
+  1. element scaling: cost of one [N/4,4] gather / scatter-add as N
+     grows 64k -> 2M.  Linear => minimize gathered elements; flat =>
+     per-op overhead dominates, minimize op COUNT.
+  2. op-count scaling: K chained gathers in ONE jit at fixed N.
+
+Chained-dispatch timing (one fetch), appends to tpu_opcost.jsonl.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+OUT = os.path.join(ROOT, "bench_results", "tpu_opcost.jsonl")
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    dtype = jnp.float32
+    rec = {"platform": dev.platform, "probe": "scaling",
+           "ts": round(time.time(), 1)}
+    C = 16384
+    rng = np.random.default_rng(7)
+    tab = jnp.asarray(rng.uniform(1, 2, C).astype(np.float32))
+    sync = 66.0
+
+    def timed(f, K=16):
+        s = jnp.asarray(0.0, dtype)
+        float(np.asarray(f(s).ravel()[0]))
+        t0 = time.perf_counter()
+        s = jnp.asarray(0.0, dtype)
+        for _ in range(K):
+            s = f(s).ravel()[0] * 1e-30
+        float(np.asarray(s))
+        return round((time.perf_counter() - t0 - sync / 1e3) / K * 1e3, 3)
+
+    for N in (65536, 131072, 262144, 524288, 1048576, 2097152):
+        idx = jnp.asarray(rng.integers(0, C, (N // 4, 4)).astype(np.int32))
+        w = jnp.asarray(rng.uniform(0.5, 1.5, (N // 4, 4)).astype(
+            np.float32))
+        g = jax.jit(lambda s, idx=idx: jnp.take(tab + s, idx))
+        rec[f"gather_{N}"] = timed(g)
+        sc = jax.jit(lambda s, idx=idx, w=w: jnp.zeros(C, dtype)
+                     .at[idx.ravel()].add(w.ravel() + s))
+        rec[f"scatter_{N}"] = timed(sc)
+        print(f"  N={N}: gather {rec[f'gather_{N}']} ms, "
+              f"scatter {rec[f'scatter_{N}']} ms")
+
+    # op-count scaling at N=524288
+    idx = jnp.asarray(rng.integers(0, C, (131072, 4)).astype(np.int32))
+    for K_OPS in (1, 2, 4, 8):
+        def chain(s, K_OPS=K_OPS):
+            x = tab + s
+            acc = jnp.zeros((131072, 4), dtype)
+            for i in range(K_OPS):
+                acc = acc + jnp.take(x + i * 1e-30, idx)
+            return acc
+        rec[f"chain{K_OPS}_gathers"] = timed(jax.jit(chain))
+        print(f"  {K_OPS} chained gathers: {rec[f'chain{K_OPS}_gathers']}"
+              " ms")
+
+    # dense-vector ops for comparison: elementwise + reduction over [N]
+    big = jnp.asarray(rng.uniform(1, 2, 2097152).astype(np.float32))
+    f = jax.jit(lambda s: ((big + s) * 1.5 - (big + s) ** 2).sum(
+        keepdims=True))
+    rec["dense_2M_elemwise_reduce"] = timed(f)
+    print(f"  dense 2M elemwise+reduce: {rec['dense_2M_elemwise_reduce']}"
+          " ms")
+
+    with open(OUT, "a") as fh:
+        fh.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
