@@ -56,12 +56,32 @@ class LmmArrays(NamedTuple):
     n_var: int
 
 
-def _bucket(n: int, floor: int = 16) -> int:
-    """Round up to a bucketed power-of-2 size to bound XLA recompiles.
+def _bucket(n: int, floor: int = 16, grow: bool = False) -> int:
+    """Round up to a bucketed static size to bound XLA recompiles.
     ELL row widths pass floor=4: every padded slot is gathered in EVERY
     round and the tunneled-TPU gather cost is proportional to gathered
     elements, so a deg-4 graph packed at width 16 would pay 4x on each
-    vc-side gather."""
+    vc-side gather.
+
+    Default policy is power-of-2; ``lmm/pad:tight`` switches to exact
+    row widths and multiple-of-4096 array sizes — per-round device cost
+    is proportional to padded volume (~8 ns per gathered/scattered
+    element on the tunneled TPU, bench_results/tpu_opcost.jsonl), so
+    one-shot solves of large systems should not pay the up-to-2x pow2
+    padding.  Hot simulation paths keep pow2: each fresh shape is a
+    multi-second XLA compile.  ``grow=True`` callers (the incremental
+    ArrayView's reallocation policy) always get pow2: ceil-to-4096
+    growth would copy the arrays every 4096 insertions (O(n^2) total)
+    and compile a fresh shape each time."""
+    pad = config["lmm/pad"]
+    if pad not in ("pow2", "tight"):
+        raise ValueError(f"Unknown lmm/pad {pad!r} "
+                         "(expected pow2 or tight)")
+    if pad == "tight" and not grow:
+        if floor <= 8:              # ELL row width: exact
+            return max(n, 1)
+        if n > 4096:
+            return -(-n // 4096) * 4096
     if n <= floor:
         return floor
     return 1 << (n - 1).bit_length()
@@ -343,77 +363,17 @@ def fixpoint_ell(ell: LmmEllArrays, eps, carry=None,
                                                    1.0))
         return apply_fixes(state, fix_now, new_value)
 
-    vc_upen_v = (jnp.where(vc_evalid, vc_w, 0.0)
-                 / jnp.where(v_enabled, v_penalty, 1.0)[:, None]
-                 ) if vc_w is not None else None
-    vc_flat = vc_cnst.ravel() if vc_w is not None else None
-
     def body_local_vc(state):
-        """The bound-free local round written entirely in the VARIABLE-
-        row layout: 2 element gathers + 2 scatters over the near-
-        unpadded vc tables.  On the tunneled TPU both gather and
-        scatter cost ~6 ns per ELEMENT, so working on [V, Wv] (~1x
-        element count) instead of the padded [C, Wc] tables (~2.6x)
-        and replacing constraint-row reductions with scatters more
-        than halves the round latency (bench_results/
-        tpu_round_profile.jsonl)."""
-        v_value, v_fixed, remaining, usage, light, it = state[:6]
-        vc_live = vc_evalid & ~v_fixed[:, None]
-        rou = jnp.where(light, remaining / jnp.where(light, usage, 1.0),
-                        inf)
-        rou_vc = jnp.take(rou, vc_cnst)
-        nmin_v = jnp.where(vc_live, rou_vc,
-                           inf).min(axis=1, initial=jnp.inf)
-        el_nmin = jnp.where(vc_live, nmin_v[:, None], inf)
-        nmin_c = jnp.full(n_c, jnp.inf, dtype).at[vc_flat].min(
-            el_nmin.ravel())
-        processable = light & (rou <= nmin_c)
-        vc_proc = vc_live & jnp.take(processable, vc_cnst)
-        level2_v = jnp.where(vc_proc, rou_vc,
-                             inf).min(axis=1, initial=jnp.inf)
-        fix_now = jnp.isfinite(level2_v) & ~v_fixed
-        new_value = level2_v / jnp.where(v_enabled, v_penalty, 1.0)
-        v_value = jnp.where(fix_now, new_value, v_value)
-        v_fixed = v_fixed | fix_now
+        """The bound-free local round in the VARIABLE-row layout —
+        shared with the compaction chain via _vc_round_body (see its
+        docstring for the op-cost rationale); this wrapper threads the
+        cv-side carry member the 6-tuple body does not use."""
+        out6 = _vc_body6(state[:6])
+        return (*out6, state[6])
 
-        # newly-fixed contributions + liveness census in one stacked
-        # 3-channel scatter-add; `touched` needs no channel of its own
-        # (valid elements have strictly positive w/penalty, so d_use>0
-        # exactly when some element of the row was newly fixed)
-        el_fix = vc_live & fix_now[:, None]
-        live2 = vc_live & ~fix_now[:, None]
-        contrib = jnp.stack(
-            [jnp.where(el_fix, vc_w * v_value[:, None], 0.0),
-             jnp.where(el_fix, vc_upen_v, 0.0),
-             live2.astype(dtype)], axis=-1)
-        sums = jnp.zeros((n_c, 3), dtype).at[vc_flat].add(
-            contrib.reshape(-1, 3))
-        d_rem, d_use = sums[:, 0], sums[:, 1]
-        touched = d_use > 0
-        has_live = sums[:, 2] > 0
-
-        new_remaining = remaining - d_rem
-        new_remaining = jnp.where(new_remaining < c_bound * eps, 0.0,
-                                  new_remaining)
-        new_usage_sum = usage - d_use
-        new_usage_sum = jnp.where(new_usage_sum < eps, 0.0,
-                                  new_usage_sum)
-        if has_fatpipe:
-            el_upen = jnp.where(live2, vc_upen_v, 0.0)
-            usage_max = jnp.zeros(n_c, dtype).at[vc_flat].max(
-                el_upen.ravel())
-            new_usage = jnp.where(c_fatpipe, usage_max, new_usage_sum)
-            usage = jnp.where(touched, new_usage, usage)
-            remaining = jnp.where(touched & ~c_fatpipe, new_remaining,
-                                  remaining)
-        else:
-            usage = jnp.where(touched, new_usage_sum, usage)
-            remaining = jnp.where(touched, new_remaining, remaining)
-
-        drop = touched & (~(usage > eps) | ~(remaining > c_bound * eps))
-        light = light & ~drop & has_live
-        return (v_value, v_fixed, remaining, usage, light, it + 1,
-                state[6])
+    _vc_body6 = (_vc_round_body(vc_cnst, vc_w, vc_valid, v_penalty,
+                                c_bound, c_fatpipe, eps, has_fatpipe)
+                 if vc_w is not None else None)
 
     if parallel_rounds and not has_bounds and vc_w is not None:
         body = body_local_vc
@@ -664,6 +624,304 @@ def fixpoint(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
     if return_carry:
         return v_value, remaining, usage, rounds, out
     return v_value, remaining, usage, rounds
+
+
+def _vc_round_body(vc_cnst, vc_w, vc_valid, v_penalty, c_bound,
+                   c_fatpipe, eps, has_fatpipe):
+    """THE bound-free vc-centric local round, on a 6-tuple state
+    (v_value, v_fixed, remaining, usage, light, it): single source for
+    both fixpoint_ell's dense path (which wraps it to thread its unused
+    cv-side carry member) and the compaction chain.
+
+    2 element gathers + 2 scatters over the near-unpadded vc tables.
+    On the tunneled TPU both gather and scatter cost ~7-8 ns per
+    ELEMENT, so working on [V, Wv] (~1x element count) instead of the
+    padded [C, Wc] tables (~2.6x) and replacing constraint-row
+    reductions with scatters more than halves the round latency.
+    Every scatter keeps the 2D [V, Wv] index shape: the axon backend
+    lowers flat-1D-index scatters ~7x slower than identical 2D-index
+    ones (bench_results/tpu_opcost.jsonl)."""
+    n_c = c_bound.shape[0]
+    dtype = vc_w.dtype
+    inf = jnp.array(jnp.inf, dtype)
+    v_enabled = v_penalty > 0
+    vc_evalid = vc_valid & v_enabled[:, None]
+    vc_upen_v = (jnp.where(vc_evalid, vc_w, 0.0)
+                 / jnp.where(v_enabled, v_penalty, 1.0)[:, None])
+
+    def body(state):
+        v_value, v_fixed, remaining, usage, light, it = state
+        vc_live = vc_evalid & ~v_fixed[:, None]
+        rou = jnp.where(light, remaining / jnp.where(light, usage, 1.0),
+                        inf)
+        rou_vc = jnp.take(rou, vc_cnst)
+        nmin_v = jnp.where(vc_live, rou_vc,
+                           inf).min(axis=1, initial=jnp.inf)
+        el_nmin = jnp.where(vc_live, nmin_v[:, None], inf)
+        nmin_c = jnp.full(n_c, jnp.inf, dtype).at[vc_cnst].min(el_nmin)
+        processable = light & (rou <= nmin_c)
+        vc_proc = vc_live & jnp.take(processable, vc_cnst)
+        level2_v = jnp.where(vc_proc, rou_vc,
+                             inf).min(axis=1, initial=jnp.inf)
+        fix_now = jnp.isfinite(level2_v) & ~v_fixed
+        new_value = level2_v / jnp.where(v_enabled, v_penalty, 1.0)
+        v_value = jnp.where(fix_now, new_value, v_value)
+        v_fixed = v_fixed | fix_now
+
+        el_fix = vc_live & fix_now[:, None]
+        live2 = vc_live & ~fix_now[:, None]
+        contrib = jnp.stack(
+            [jnp.where(el_fix, vc_w * v_value[:, None], 0.0),
+             jnp.where(el_fix, vc_upen_v, 0.0),
+             live2.astype(dtype)], axis=-1)
+        sums = jnp.zeros((n_c, 3), dtype).at[vc_cnst].add(contrib)
+        d_rem, d_use = sums[:, 0], sums[:, 1]
+        touched = d_use > 0
+        has_live = sums[:, 2] > 0
+
+        new_remaining = remaining - d_rem
+        new_remaining = jnp.where(new_remaining < c_bound * eps, 0.0,
+                                  new_remaining)
+        new_usage_sum = usage - d_use
+        new_usage_sum = jnp.where(new_usage_sum < eps, 0.0,
+                                  new_usage_sum)
+        if has_fatpipe:
+            el_upen = jnp.where(live2, vc_upen_v, 0.0)
+            usage_max = jnp.zeros(n_c, dtype).at[vc_cnst].max(el_upen)
+            new_usage = jnp.where(c_fatpipe, usage_max, new_usage_sum)
+            usage = jnp.where(touched, new_usage, usage)
+            remaining = jnp.where(touched & ~c_fatpipe, new_remaining,
+                                  remaining)
+        else:
+            usage = jnp.where(touched, new_usage_sum, usage)
+            remaining = jnp.where(touched, new_remaining, remaining)
+
+        drop = touched & (~(usage > eps) | ~(remaining > c_bound * eps))
+        light = light & ~drop & has_live
+        return (v_value, v_fixed, remaining, usage, light, it + 1)
+
+    return body
+
+
+def _pos_group(n: int) -> int:
+    """Index-array group width for scatters over [n] vectors: the axon
+    backend lowers flat-1D-index scatters pathologically (~7x); any 2D
+    shape takes the fast path."""
+    for g in (128, 8):
+        if n % g == 0:
+            return g
+    return 1
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("eps", "cap", "half", "has_fatpipe"))
+def _ell_chain_stage(vc_cnst, vc_w, vc_valid, v_penalty, orig_idx,
+                     c_bound, c_fatpipe, v_final, carry,
+                     eps: float, cap: int, half: int,
+                     has_fatpipe: bool):
+    """One compaction-chain stage: run vc rounds until the live variable
+    count is <= half (or convergence / round cap), then partition the
+    variable rows live-first (STABLE: live rows keep their relative
+    order, so the scatter-add reduction order over the survivors is
+    unchanged — dropping rows that contribute exact 0.0/inf identities
+    keeps the chain bit-identical to the dense run) and slice the first
+    `half` rows for the next stage.
+
+    Dead rows' values are recorded into v_final (original numbering)
+    before the slice.  Returns (new tables, new carry, v_final,
+    overflow) — `overflow` set when the cap expired with > half rows
+    live, in which case downstream stages are garbage and the caller
+    falls back to the dense path."""
+    dtype = vc_w.dtype
+    body = _vc_round_body(vc_cnst, vc_w, vc_valid, v_penalty, c_bound,
+                          c_fatpipe, jnp.asarray(eps, dtype),
+                          has_fatpipe)
+    v_enabled = v_penalty > 0
+    start_it = carry[5]
+
+    def cond(st):
+        live = jnp.count_nonzero(~st[1] & v_enabled)
+        return (jnp.any(st[4]) & (st[5] - start_it < cap)
+                & (live > half))
+
+    st = lax.while_loop(cond, body, carry)
+    v_value, v_fixed = st[0], st[1]
+    v_final = v_final.at[orig_idx].set(v_value)
+
+    livemask = ~v_fixed & v_enabled
+    n_live = jnp.count_nonzero(livemask)
+    overflow = (n_live > half) & jnp.any(st[4])
+    lm = livemask.astype(jnp.int32)
+    pos = jnp.where(livemask, jnp.cumsum(lm) - 1,
+                    n_live + jnp.cumsum(1 - lm) - 1).astype(jnp.int32)
+    V = vc_cnst.shape[0]
+    g = _pos_group(V)
+    perm = jnp.zeros(V, jnp.int32).at[pos.reshape(-1, g)].set(
+        jnp.arange(V, dtype=jnp.int32).reshape(-1, g))
+    keep = perm[:half]
+
+    def rows(a):
+        return jnp.take(a, keep, axis=0)
+
+    tables = (rows(vc_cnst), rows(vc_w), rows(vc_valid),
+              rows(v_penalty), rows(orig_idx))
+    carry2 = (rows(st[0]), rows(st[1]), st[2], st[3], st[4], st[5])
+    return tables, carry2, v_final, overflow
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("eps", "chunk", "has_fatpipe"))
+def _vc_chunk(vc_cnst, vc_w, vc_valid, v_penalty, c_bound, c_fatpipe,
+              carry, eps: float, chunk: int, has_fatpipe: bool):
+    """Finisher chunk for the chain: plain bounded vc rounds."""
+    body = _vc_round_body(vc_cnst, vc_w, vc_valid, v_penalty, c_bound,
+                          c_fatpipe, jnp.asarray(eps, vc_w.dtype),
+                          has_fatpipe)
+    start_it = carry[5]
+
+    def cond(st):
+        return (jnp.any(st[4]) & (st[5] < _MAX_ROUNDS)
+                & (st[5] - start_it < chunk))
+
+    return lax.while_loop(cond, body, carry)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _chain_fetch(v_final, orig_idx, carry, overflow):
+    """Assemble the chain's single device->host transfer: stats,
+    overflow flag, merged values, remaining, usage."""
+    v_value, v_fixed, remaining, usage, light, it = carry
+    dtype = v_final.dtype
+    v_final = v_final.at[orig_idx].set(v_value)
+    stats = jnp.stack([it.astype(dtype),
+                       jnp.count_nonzero(light).astype(dtype),
+                       jnp.count_nonzero(v_fixed).astype(dtype),
+                       overflow.astype(dtype)])
+    return jnp.concatenate([stats, v_final, remaining.astype(dtype),
+                            usage.astype(dtype)])
+
+
+#: Memo of chain init arrays per (ell identity, eps): fresh host->device
+#: transfers per solve would cost more than the chain saves.
+_CHAIN_INIT_CACHE: dict = {}
+#: Chain stages stop once the halved shape would fall below this: the
+#: per-round device time down there is microseconds and each extra
+#: stage is one more XLA compile.
+_CHAIN_MIN_V = 8192
+#: Per-stage round cap.  The live set at the bench classes halves every
+#: ~13 local rounds; 64 is generous while keeping one stage's device
+#: time safely under the axon kernel watchdog.
+_CHAIN_STAGE_CAP = 64
+
+
+def _solve_ell_chain(ell: LmmEllArrays, eps: float, device,
+                     has_fatpipe: bool, chunk: int):
+    """Device-resident active-set compaction for the ELL/vc path: chain
+    jitted stages at halving static shapes, each dispatched WITHOUT a
+    host sync (the tunnel costs ~70 ms per round-trip); one fetch at
+    the end returns stats + results.  Falls back (returns None) when a
+    stage overflowed its cap or the system stalled.
+
+    The CPU _Compactor repacks on the host between chunks — free there,
+    ~70 ms + a fresh XLA compile per shape on a tunneled accelerator.
+    This chain moves the same idea on-device: the partition is a stable
+    live-first permutation, so dropped rows only remove exact-identity
+    contributions (cf. _Compactor's docstring); results match the dense
+    run up to XLA per-program reduction-order ulps (pinned by
+    tests/test_lmm.py::test_ell_chain_matches_dense)."""
+    dtype = ell.vc_w.dtype
+    V0 = ell.v_penalty.shape[0]
+    eps_f = float(eps)
+
+    args = _device_args(
+        "vc_chain",
+        [ell.vc_cnst, ell.vc_w, ell.vc_valid, ell.v_penalty,
+         ell.c_bound, ell.c_fatpipe], device)
+    vc_cnst, vc_w, vc_valid, v_pen, c_bound, c_fat = args
+
+    # Initial carry, matching fixpoint_ell's None-carry init (usage0
+    # from cv row-sums; numpy's pairwise row-sum can differ from the
+    # device reduce in final ulps — the oracle tests bound that).
+    # Memoized per (ell, eps) so repeated solves reuse the same host
+    # arrays and _DEVICE_ARGS_CACHE skips the ~150-500 ms re-upload.
+    key = (id(ell.vc_cnst), id(ell.cv_w), eps_f)
+    hit = _CHAIN_INIT_CACHE.get(key)
+    if hit is not None and hit[0] is ell.vc_cnst and hit[1] is ell.cv_w:
+        init_np = hit[2]
+        # refresh LRU position so the hot entry survives transients
+        # (eviction below pops oldest-first)
+        _CHAIN_INIT_CACHE.pop(key)
+        _CHAIN_INIT_CACHE[key] = hit
+    else:
+        np_pen = ell.v_penalty
+        safe_pen = np.where(np_pen > 0, np_pen, 1.0)
+        cv_evalid = ell.cv_valid & (np_pen[ell.cv_var] > 0)
+        cv_upen = np.where(cv_evalid,
+                           ell.cv_w / safe_pen[ell.cv_var],
+                           0.0).astype(dtype)
+        usage0_np = cv_upen.sum(axis=1, dtype=dtype)
+        if has_fatpipe:
+            usage0_np = np.where(ell.c_fatpipe,
+                                 cv_upen.max(axis=1, initial=0.0),
+                                 usage0_np)
+        light0_np = ((ell.c_bound > ell.c_bound * eps_f)
+                     & (usage0_np > 0))
+        init_np = [np.zeros(V0, dtype), (np_pen < 0),
+                   ell.c_bound.astype(dtype), usage0_np, light0_np,
+                   np.arange(V0, dtype=np.int32)]
+        if len(_CHAIN_INIT_CACHE) >= 8:
+            _CHAIN_INIT_CACHE.pop(next(iter(_CHAIN_INIT_CACHE)))
+        _CHAIN_INIT_CACHE[key] = (ell.vc_cnst, ell.cv_w, init_np)
+    init = _device_args("vc_chain_init", init_np, device)
+    carry = (init[0], init[1], init[2], init[3], init[4],
+             jnp.asarray(0, jnp.int32))
+    orig_idx = init[5]
+    v_final = jnp.zeros(V0, dtype)
+
+    overflow = jnp.asarray(False)
+    tables = (vc_cnst, vc_w, vc_valid, v_pen, orig_idx)
+    Vs = V0
+    while Vs // 2 >= _CHAIN_MIN_V:
+        tables, carry, v_final, ov = _ell_chain_stage(
+            *tables, c_bound, c_fat, v_final, carry,
+            eps=eps_f, cap=_CHAIN_STAGE_CAP, half=Vs // 2,
+            has_fatpipe=has_fatpipe)
+        overflow = overflow | ov
+        Vs //= 2
+
+    # Finisher: bounded chunks to convergence, still sync-free between
+    # dispatches; each iteration fetches stats+results in ONE transfer.
+    prev_progress = None
+    while True:
+        carry = _vc_chunk(*tables[:4], c_bound, c_fat, carry,
+                          eps=eps_f, chunk=chunk,
+                          has_fatpipe=has_fatpipe)
+        fetched = np.asarray(_chain_fetch(v_final, tables[4], carry,
+                                          overflow))
+        rounds, n_light, n_fixed, oflow = (int(fetched[0]),
+                                           int(fetched[1]),
+                                           int(fetched[2]),
+                                           bool(fetched[3]))
+        if oflow:
+            return None     # caller re-solves on the dense path
+        if n_light == 0:
+            break
+        if rounds >= _MAX_ROUNDS:
+            raise RuntimeError(
+                f"LMM chain solve did not converge within {_MAX_ROUNDS} "
+                f"saturation rounds ({ell.n_cnst} constraints, "
+                f"{ell.n_var} variables, {n_light} still active); "
+                f"check maxmin/precision vs the system's magnitudes")
+        progress = (n_light, n_fixed)
+        if progress == prev_progress:
+            return None     # stalled: let the dense path diagnose
+        prev_progress = progress
+
+    n_cc = ell.c_bound.shape[0]
+    values = fetched[4:4 + V0]
+    remaining = fetched[4 + V0:4 + V0 + n_cc]
+    usage = fetched[4 + V0 + n_cc:4 + V0 + 2 * n_cc]
+    return values, remaining, usage, rounds
 
 
 @functools.partial(jax.jit,
@@ -1035,6 +1293,15 @@ def solve_arrays(arrays: LmmArrays, eps: float, device=None,
             _log.get_category("lmm").warning(
                 "lmm/compact:on has no effect on the ELL layout; set "
                 "lmm/layout:coo to compact on this device")
+    if (ell is None and platform != "cpu" and not chunk_given
+            and len(arrays.e_var) >= 1 << 20):
+        # Big COO systems on the accelerator: a round costs tens of ms
+        # of device time, so 256 rounds in one dispatch can exceed the
+        # axon watchdog's kernel-runtime budget (observed as "TPU
+        # worker crashed" on the 1.3M-element config-#4 alltoall
+        # system).  Cap the per-dispatch round count so one chunk
+        # stays ~1-2 s worst case.
+        chunk = min(chunk, 32)
     compacting = (ell is None
                   and arrays.n_elem >= _COMPACT_MIN_ELEMS
                   and (cmode == "on"
@@ -1055,6 +1322,22 @@ def solve_arrays(arrays: LmmArrays, eps: float, device=None,
     has_bounds = bool(np.any((arrays.v_bound[:arrays.n_var] > 0)
                              & (arrays.v_penalty[:arrays.n_var] > 0)))
     has_fatpipe = bool(np.any(arrays.c_fatpipe[:arrays.n_cnst]))
+    chain_mode = config["lmm/chain"]
+    if chain_mode not in ("auto", "on", "off"):
+        raise ValueError(f"Unknown lmm/chain {chain_mode!r} "
+                         "(expected auto, on or off)")
+    if (ell is not None and ell.vc_w is not None and parallel_rounds
+            and not has_bounds and not unroll
+            and len(ell.v_penalty) >= 2 * _CHAIN_MIN_V
+            and (chain_mode == "on"
+                 or (chain_mode == "auto" and platform != "cpu"))):
+        res = _solve_ell_chain(ell, eps_f, device, has_fatpipe,
+                               chunk if chunk_given
+                               else _CHUNK_ROUNDS_ACCEL)
+        if res is not None:
+            return res
+        # overflow/stall: fall through to the dense path below
+
     compactor = None
     if ell is not None:
         args = _device_args(
@@ -1089,15 +1372,24 @@ def solve_arrays(arrays: LmmArrays, eps: float, device=None,
     prev_progress = None
     while True:
         values, remaining, usage, rounds, carry = run_chunk(carry)
-        # One host sync per chunk: [rounds, light count, fixed count]
-        # in a single device->host transfer (per-transfer latency is
-        # the cost driver on a tunneled accelerator).
-        stats = np.asarray(jnp.stack(
-            [rounds, jnp.count_nonzero(carry[4]).astype(jnp.int32),
-             jnp.count_nonzero(carry[1]).astype(jnp.int32)]))
-        rounds, n_light, n_fixed = (int(stats[0]), int(stats[1]),
-                                    int(stats[2]))
+        # ONE host sync per chunk: [rounds, light count, fixed count]
+        # AND the result vectors ride a single device->host transfer
+        # (per-transfer latency, not size, is the cost driver on a
+        # tunneled accelerator — a converged solve pays exactly one
+        # ~70 ms round-trip).  Counts are exact in f32 (< 2^24).
+        rdt = values.dtype
+        n_vc, n_cc = values.shape[0], remaining.shape[0]
+        fetched = np.asarray(jnp.concatenate([
+            jnp.stack([rounds.astype(rdt),
+                       jnp.count_nonzero(carry[4]).astype(rdt),
+                       jnp.count_nonzero(carry[1]).astype(rdt)]),
+            values, remaining.astype(rdt), usage.astype(rdt)]))
+        rounds, n_light, n_fixed = (int(fetched[0]), int(fetched[1]),
+                                    int(fetched[2]))
         if n_light == 0:
+            values = fetched[3:3 + n_vc]
+            remaining = fetched[3 + n_vc:3 + n_vc + n_cc]
+            usage = fetched[3 + n_vc + n_cc:3 + n_vc + 2 * n_cc]
             break
         if rounds >= _MAX_ROUNDS:
             raise RuntimeError(
@@ -1129,14 +1421,9 @@ def solve_arrays(arrays: LmmArrays, eps: float, device=None,
               if compactor is not None else None)
     if merged is not None:
         return merged[0], merged[1], merged[2], rounds
-    # One transfer for all three result vectors.
-    flat = np.asarray(jnp.concatenate(
-        [values.astype(arrays.e_w.dtype),
-         remaining.astype(arrays.e_w.dtype),
-         usage.astype(arrays.e_w.dtype)]))
-    n_vb, n_cb = len(arrays.v_penalty), len(arrays.c_bound)
-    return (flat[:n_vb], flat[n_vb:n_vb + n_cb],
-            flat[n_vb + n_cb:n_vb + 2 * n_cb], rounds)
+    # values/remaining/usage are host np slices of the converged
+    # chunk's single fetch.
+    return values, remaining, usage, rounds
 
 
 def check_convergence(rounds: int, n_cnst, n_var) -> None:

@@ -132,7 +132,7 @@ class ArrayView:
             slot = len(self.slot_cnst)
             self.slot_cnst.append(cnst)
             if slot >= len(self.c_bound):
-                grow = _bucket(slot + 1)
+                grow = _bucket(slot + 1, grow=True)
                 cb = np.zeros(grow, self.dtype)
                 cb[:len(self.c_bound)] = self.c_bound
                 self.c_bound = cb
@@ -153,7 +153,7 @@ class ArrayView:
             slot = len(self.slot_var)
             self.slot_var.append(var)
             if slot >= len(self.v_penalty):
-                grow = _bucket(slot + 1)
+                grow = _bucket(slot + 1, grow=True)
                 vp = np.zeros(grow, self.dtype)
                 vp[:len(self.v_penalty)] = self.v_penalty
                 self.v_penalty = vp
@@ -169,7 +169,7 @@ class ArrayView:
     def on_expand(self, elem) -> None:
         k = self.n_elem
         if k >= len(self.e_var):
-            grow = _bucket(k + 1)
+            grow = _bucket(k + 1, grow=True)
             ev = np.zeros(grow, np.int32); ev[:len(self.e_var)] = self.e_var
             ec = np.zeros(grow, np.int32); ec[:len(self.e_cnst)] = self.e_cnst
             self.e_var, self.e_cnst = ev, ec

@@ -262,6 +262,22 @@ declare_flag("lmm/compact",
              "saves.  Bit-identical: dead elements contribute exact "
              "identities (0.0 to the scatter-adds and maxes, inf to "
              "the min-reductions)", "auto")
+declare_flag("lmm/chain",
+             "Device-resident active-set compaction for the ELL/vc "
+             "solver path: chain jitted solve stages at halving static "
+             "shapes with no host sync between them (one fetch per "
+             "solve).  on, off, or auto (accelerators only — the CPU "
+             "backend compacts host-side via lmm/compact instead)",
+             "auto")
+declare_flag("lmm/pad",
+             "Static-shape padding policy for device solver arrays: "
+             "pow2 (power-of-two buckets — few XLA recompiles as a "
+             "simulation's live system grows/shrinks, up to 2x padded "
+             "volume) or tight (multiples of 4096 and exact ELL row "
+             "widths — per-element device cost tracks the real system; "
+             "right for one-shot solves of big fixed systems, wrong "
+             "for hot simulation loops where every new shape is a "
+             "multi-second XLA compile)", "pow2")
 declare_flag("lmm/unroll",
              "Unroll the device fixpoint into straight-line XLA instead "
              "of lax.while_loop: on, off, or auto (on for accelerators — "

@@ -699,3 +699,66 @@ def test_limit_raise_wakes_staged_variable():
     # maxmin.cpp:255 does the same), so only enablement is asserted.
     s.solve_exact()
     assert v1.value > 0
+
+
+@pytest.mark.parametrize("dtype,eps", [(np.float64, 1e-9),
+                                       (np.float32, 1e-5)])
+def test_ell_chain_matches_dense(dtype, eps):
+    """The device-resident compaction chain (lmm/chain) partitions
+    variable rows live-first between stages; the partition is stable
+    and dropped rows only contribute exact identities, so the chain
+    must agree with the dense ELL run (up to summation-order ulps in
+    the init row-sums) and converge in the same number of rounds.
+    Also pins _vc_round_body to fixpoint_ell's body_local_vc.
+
+    Tolerances: the chain is a DIFFERENT compiled program than the
+    dense chunk, and XLA may reassociate float reductions differently
+    per program, so agreement is up to reduction-order ulps — plus one
+    eps-clamp width on `remaining` (an ulp at the clamp threshold
+    flips a value to exact 0.0)."""
+    from simgrid_tpu.utils.config import config
+    from simgrid_tpu.ops.lmm_jax import solve_arrays
+    # big enough to trigger the chain (V0 >= 2 * _CHAIN_MIN_V after
+    # pow2 bucketing) but CPU-fast; deg 3 keeps the ELL width small
+    arrays = _bench_arrays(np.random.default_rng(13), 4096, 33000, 3,
+                           dtype)
+    try:
+        config["lmm/layout"] = "ell"
+        config["lmm/chain"] = "off"
+        dense = solve_arrays(arrays, eps, parallel_rounds=True)
+        config["lmm/chain"] = "on"
+        chain = solve_arrays(arrays, eps, parallel_rounds=True)
+    finally:
+        config["lmm/layout"] = "auto"
+        config["lmm/chain"] = "auto"
+    assert dense[3] == chain[3], "round counts diverged"
+    rtol = 1e-4 if dtype is np.float32 else 1e-9
+    atol = 2 * eps * float(np.max(arrays.c_bound))
+    for d, p in zip(dense[:3], chain[:3]):
+        np.testing.assert_allclose(np.asarray(d), np.asarray(p),
+                                   rtol=rtol, atol=atol)
+
+
+def test_ell_chain_overflow_falls_back():
+    """A chain stage that cannot halve the live set within its round
+    cap must flag overflow and the solve must fall back to the dense
+    path with a correct result."""
+    from simgrid_tpu.utils.config import config
+    from simgrid_tpu.ops import lmm_jax
+    from simgrid_tpu.ops.lmm_jax import solve_arrays
+    arrays = _bench_arrays(np.random.default_rng(17), 4096, 33000, 3,
+                           np.float64)
+    cap = lmm_jax._CHAIN_STAGE_CAP
+    try:
+        config["lmm/layout"] = "ell"
+        config["lmm/chain"] = "off"
+        dense = solve_arrays(arrays, 1e-9, parallel_rounds=True)
+        config["lmm/chain"] = "on"
+        lmm_jax._CHAIN_STAGE_CAP = 1   # force overflow
+        chain = solve_arrays(arrays, 1e-9, parallel_rounds=True)
+    finally:
+        lmm_jax._CHAIN_STAGE_CAP = cap
+        config["lmm/layout"] = "auto"
+        config["lmm/chain"] = "auto"
+    for d, p in zip(dense[:3], chain[:3]):
+        np.testing.assert_allclose(np.asarray(d), np.asarray(p))
