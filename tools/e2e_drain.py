@@ -1,0 +1,220 @@
+#!/usr/bin/env python3
+"""End-to-end north-star bench (BASELINE config #4): drain a 100k-flow
+set on a 65,536-host dragonfly to completion, native C++ maxmin vs the
+JAX backend (CPU or TPU), comparing WALL-CLOCK and EVENT ORDER.
+
+The simulation phase measured is the whole network drain: every
+solve, every time advance, every completion event, until no flow
+remains.  Platform parse + route expansion are reported separately
+(identical work for every backend).
+
+Workloads:
+  random   N random host pairs (the literal config-#4 stress shape)
+  alltoall R ranks spread evenly, all ordered pairs (the north-star
+           text's SMPI alltoall shape; contention depth ~R)
+
+Usage:
+  python tools/e2e_drain.py --backend native|jax [--platform cpu|tpu]
+         [--workload random|alltoall] [--flows 100000] [--ranks 320]
+         [--out bench_results/e2e_drain.jsonl] [--events-out FILE.npz]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def build_system(workload: str, flows: int, ranks: int, size: float):
+    """Parse the 65k dragonfly, post the flow set, advance past the
+    latency phase, and flatten to COO arrays + flow action order."""
+    import numpy as np
+    from simgrid_tpu import s4u
+    from simgrid_tpu.ops import lmm_jax
+    from tools.scale_proof import build_platform
+
+    t0 = time.perf_counter()
+    platform = build_platform("/tmp/dragonfly65k.xml", 65536)
+    e = s4u.Engine(["e2e", "--cfg=lmm/backend:list",
+                    "--cfg=network/maxmin-selective-update:no",
+                    "--cfg=network/optim:Full"])
+    e.load_platform(platform)
+    hosts = e.get_all_hosts()
+    n_hosts = len(hosts)
+    build_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    model = e.pimpl.network_model
+    actions = []
+    if workload == "alltoall":
+        stride = n_hosts // ranks
+        rh = [hosts[i * stride] for i in range(ranks)]
+        for i in range(ranks):
+            for j in range(ranks):
+                if i != j:
+                    actions.append(model.communicate(rh[i], rh[j],
+                                                     size, -1.0))
+    else:
+        rng = np.random.default_rng(42)
+        pairs = rng.integers(0, n_hosts, size=(flows, 2))
+        for k in range(flows):
+            src, dst = int(pairs[k, 0]), int(pairs[k, 1])
+            if src == dst:
+                dst = (dst + 1) % n_hosts
+            actions.append(model.communicate(hosts[src], hosts[dst],
+                                             size, -1.0))
+    for _ in range(400):
+        n_live = sum(1 for a in actions
+                     if a.variable is not None
+                     and a.variable.sharing_penalty > 0)
+        if n_live == len(actions):
+            break
+        e.pimpl.surf_solve(-1.0)
+    route_s = time.perf_counter() - t0
+
+    flat = lmm_jax.flatten(list(model.system.active_constraint_set))
+    arrays, vars_in_order = flat
+    # flow id per variable slot = index into `actions`
+    var_slot = {id(a.variable): k for k, a in enumerate(actions)}
+    slot_flow = np.array([var_slot[id(v)] for v in vars_in_order],
+                         np.int64)
+    return arrays, slot_flow, dict(build_s=round(build_s, 1),
+                                   route_s=round(route_s, 1),
+                                   n_hosts=n_hosts,
+                                   flows=len(actions))
+
+
+def drain_native(arrays, slot_flow, size, done_eps=1e-4):
+    """Reference-architecture baseline: the exact C++ maxmin list
+    solver (native/lmm.cc) drives the same drain loop.  Per advance the
+    live system is repacked with vectorized numpy (cheap next to the
+    solve) so the C++ solver only ever sees live flows — the same
+    favor the JAX path gets from its repacks."""
+    import numpy as np
+    from simgrid_tpu.ops import lmm_native
+
+    E = arrays.n_elem
+    e_var = arrays.e_var[:E].copy()
+    e_cnst = arrays.e_cnst[:E].copy()
+    e_w = arrays.e_w[:E].astype(np.float64)
+    c_bound = arrays.c_bound.astype(np.float64)
+    n_c = len(c_bound)
+    n_v = arrays.n_var
+    rem = np.full(n_v, float(size))
+    live = np.ones(n_v, bool)
+    ids = np.arange(n_v)
+    t = 0.0
+    events = []
+    advances = 0
+    t0 = time.perf_counter()
+    while live.any():
+        keep = np.flatnonzero(live)
+        old2new = np.full(n_v, -1, np.int32)
+        old2new[keep] = np.arange(len(keep), dtype=np.int32)
+        emask = live[e_var]
+        ev, ec, ew = old2new[e_var[emask]], e_cnst[emask], e_w[emask]
+        pen = np.ones(len(keep))
+        vb = np.full(len(keep), -1.0)
+        vals, _, _ = lmm_native.solve_coo(
+            ev, ec, ew, c_bound, np.zeros(n_c, np.uint8), pen, vb,
+            1e-5, len(ev), n_c, len(keep))
+        rate = np.asarray(vals)
+        flowing = rate > 0
+        rl = rem[keep]
+        dts = np.where(flowing, rl / np.where(flowing, rate, 1.0),
+                       np.inf)
+        dt = dts.min()
+        if not np.isfinite(dt):
+            raise RuntimeError("native drain stalled")
+        rl2 = np.where(flowing, rl - rate * dt, rl)
+        done = flowing & (rl2 <= done_eps)
+        t += dt
+        advances += 1
+        for fid in ids[keep[np.flatnonzero(done)]]:
+            events.append((t, int(slot_flow[fid])))
+        rem[keep] = np.where(done, 0.0, rl2)
+        live[keep[done]] = False
+    wall = time.perf_counter() - t0
+    return events, dict(advances=advances, wall_s=round(wall, 1),
+                        t_sim=t)
+
+
+def drain_jax(arrays, slot_flow, size, platform=None, done_eps=1e-4):
+    import numpy as np
+    if platform:
+        import jax
+        jax.config.update("jax_platforms", platform)
+    import jax
+    from simgrid_tpu.ops.lmm_drain import DrainSim
+
+    dev = jax.devices()[0]
+    dtype = np.float32 if dev.platform != "cpu" else np.float64
+    E = arrays.n_elem
+    sim = DrainSim(arrays.e_var[:E], arrays.e_cnst[:E],
+                   arrays.e_w[:E].astype(dtype),
+                   arrays.c_bound[:arrays.n_cnst].astype(dtype),
+                   np.full(arrays.n_var, float(size)),
+                   eps=1e-5, done_eps=done_eps, dtype=dtype)
+    # warm the jits on the first advance before timing?  No: honest
+    # end-to-end wall-clock includes compiles once per shape; report
+    # both (first advance separately).
+    t0 = time.perf_counter()
+    sim.run()
+    wall = time.perf_counter() - t0
+    events = [(t, int(slot_flow[fid])) for t, fid in sim.events]
+    return events, dict(advances=sim.advances, wall_s=round(wall, 1),
+                        t_sim=sim.t, rounds=sim.rounds, syncs=sim.syncs,
+                        repacks=sim.repacks, jax_platform=dev.platform)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--backend", choices=["native", "jax"],
+                    required=True)
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--workload", default="random",
+                    choices=["random", "alltoall"])
+    ap.add_argument("--flows", type=int, default=100_000)
+    ap.add_argument("--ranks", type=int, default=320)
+    ap.add_argument("--size", type=float, default=1e6)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--events-out", default=None)
+    args = ap.parse_args()
+
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+
+    import numpy as np
+
+    arrays, slot_flow, info = build_system(args.workload, args.flows,
+                                           args.ranks, args.size)
+    rec = {"backend": args.backend, "platform": args.platform,
+           "workload": args.workload, **info,
+           "n_cnst": arrays.n_cnst, "n_var": arrays.n_var,
+           "n_elem": arrays.n_elem}
+    print(json.dumps(rec), flush=True)
+
+    if args.backend == "native":
+        events, stats = drain_native(arrays, slot_flow, args.size)
+    else:
+        events, stats = drain_jax(arrays, slot_flow, args.size,
+                                  args.platform)
+    rec.update(stats)
+    rec["n_events"] = len(events)
+    print(json.dumps(rec), flush=True)
+
+    if args.events_out:
+        np.savez_compressed(args.events_out,
+                            t=np.array([e[0] for e in events]),
+                            flow=np.array([e[1] for e in events]))
+    if args.out:
+        with open(args.out, "a") as fh:
+            fh.write(json.dumps(rec) + "\n")
+
+
+if __name__ == "__main__":
+    main()
