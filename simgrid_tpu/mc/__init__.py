@@ -23,9 +23,11 @@ __all__ += ["CommunicationDeterminismChecker", "NonDeterminismError"]
 
 from .liveness import (BuchiAutomaton, LivenessChecker,  # noqa: E402
                        LivenessError)
+from .ltl import LtlSyntaxError, ltl_to_buchi, never_claim  # noqa: E402
 from .record import record_of, parse_record, replay  # noqa: E402
 from .state import note, state_signature  # noqa: E402
 
 __all__ += ["BuchiAutomaton", "LivenessChecker", "LivenessError",
+            "ltl_to_buchi", "never_claim", "LtlSyntaxError",
             "record_of", "parse_record", "replay", "state_signature",
             "note"]

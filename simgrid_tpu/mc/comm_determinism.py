@@ -3,11 +3,31 @@ src/mc/checker/CommunicationDeterminismChecker.cpp).
 
 Explores scheduling interleavings like the safety checker and records
 every completed communication as a pattern (mailbox, src pid, dst pid)
-in per-actor order. The first completed execution fixes the reference
-patterns (initial_communications_pattern); any later interleaving whose
-per-actor sequences differ makes the application non-send-deterministic
-and/or non-recv-deterministic — the MPI message-race detector (an
-MPI_ANY_SOURCE whose match depends on scheduling, etc.)."""
+in per-actor order.  The first completed execution fixes the reference
+patterns (initial_communications_pattern); later interleavings are
+compared pattern-by-pattern and every divergence CLASSIFIES the actor
+(deterministic_comm_pattern, CommunicationDeterminismChecker.cpp:118-160):
+
+* a diverging send pattern clears that actor's send-determinism,
+* a diverging receive clears its recv-determinism,
+* the diff itself is kept, named like the reference's
+  print_determinism_result (mailbox/src/dst difference, or a
+  missing/extra communication).
+
+Exploration then CONTINUES — the classification covers the whole
+exploration — unless the configured property is already hopeless,
+mirroring the reference's early exits:
+
+* ``model-check/send-determinism``: checking send-determinism only —
+  abort the moment any actor loses it;
+* otherwise (communications-determinism, the default property): abort
+  when some actor has lost BOTH send- and recv-determinism.
+
+``run()`` returns the classification
+(``{"send_deterministic": bool, "recv_deterministic": bool,
+"per_actor": {pid: {"send": ..., "recv": ...}}, "diffs": [...]}`` —
+the reference's log_state summary, .cpp:305-331).
+"""
 
 from __future__ import annotations
 
@@ -15,6 +35,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..exceptions import SimgridException
 from ..utils import log as _log
+from ..utils.config import config
 from .explorer import SafetyChecker, Session
 
 _logger = _log.get_category("mc_comm_determinism")
@@ -22,23 +43,43 @@ _logger = _log.get_category("mc_comm_determinism")
 Pattern = Tuple[str, int, int]   # (mailbox, src pid, dst pid)
 
 
+def _diff_kind(ref: Optional[Pattern], got: Optional[Pattern]) -> str:
+    """Name the difference like compare_comm_pattern
+    (CommunicationDeterminismChecker.cpp:40-70)."""
+    if ref is None:
+        return "extra communication"
+    if got is None:
+        return "missing communication"
+    if ref[0] != got[0]:
+        return f"mailbox ({ref[0]!r} vs {got[0]!r})"
+    if ref[1] != got[1]:
+        return f"source actor ({ref[1]} vs {got[1]})"
+    if ref[2] != got[2]:
+        return f"destination actor ({ref[2]} vs {got[2]})"
+    return "none"
+
+
 class NonDeterminismError(SimgridException):
     def __init__(self, message, kind, actor, reference, observed):
         super().__init__(message)
-        self.kind = kind            # "send" | "recv"
+        self.kind = kind            # "send" | "both"
         self.actor = actor
         self.reference = reference
         self.observed = observed
 
 
 class CommunicationDeterminismChecker(SafetyChecker):
-    """SafetyChecker + per-path communication-pattern comparison."""
+    """SafetyChecker + per-actor send/recv-determinism classification."""
 
     def __init__(self, program):
         super().__init__(program)
         self.reference_sends: Optional[Dict[int, List[Pattern]]] = None
         self.reference_recvs: Optional[Dict[int, List[Pattern]]] = None
         self.paths_checked = 0
+        #: pid -> still-deterministic flags, over the WHOLE exploration
+        self.send_deterministic: Dict[int, bool] = {}
+        self.recv_deterministic: Dict[int, bool] = {}
+        self.diffs: List[str] = []
         self._sends: Dict[int, List[Pattern]] = {}
         self._recvs: Dict[int, List[Pattern]] = {}
 
@@ -59,30 +100,88 @@ class CommunicationDeterminismChecker(SafetyChecker):
         session.engine.connect_signal(CommImpl.on_completion, on_comm)
         return session
 
+    @staticmethod
+    def _first_diff(ref: List[Pattern], got: List[Pattern]):
+        for i in range(max(len(ref), len(got))):
+            r = ref[i] if i < len(ref) else None
+            g = got[i] if i < len(got) else None
+            if r != g:
+                return i, _diff_kind(r, g)
+        return None
+
     def _on_path_complete(self, session: Session) -> None:
         self.paths_checked += 1
         if self.reference_sends is None:
-            # compare_comm_pattern: the first path defines the law
+            # the first complete path defines the law
             self.reference_sends = {k: list(v)
                                     for k, v in self._sends.items()}
             self.reference_recvs = {k: list(v)
                                     for k, v in self._recvs.items()}
+            for pid in set(self.reference_sends) | \
+                    set(self.reference_recvs):
+                self.send_deterministic.setdefault(pid, True)
+                self.recv_deterministic.setdefault(pid, True)
             return
-        for pid in set(self.reference_sends) | set(self._sends):
-            ref = self.reference_sends.get(pid, [])
-            got = self._sends.get(pid, [])
-            if got != ref:
-                _logger.info("***** Non-send-deterministic communications "
-                             "pattern *****")
-                raise NonDeterminismError(
-                    f"Non-send-deterministic communications pattern for "
-                    f"actor {pid}", "send", pid, ref, got)
-        for pid in set(self.reference_recvs) | set(self._recvs):
-            ref = self.reference_recvs.get(pid, [])
-            got = self._recvs.get(pid, [])
-            if got != ref:
-                _logger.info("***** Non-recv-deterministic communications "
-                             "pattern *****")
-                raise NonDeterminismError(
-                    f"Non-recv-deterministic communications pattern for "
-                    f"actor {pid}", "recv", pid, ref, got)
+
+        send_only = config["model-check/send-determinism"]
+        for kind, flags, refs, gots in (
+                ("send", self.send_deterministic,
+                 self.reference_sends, self._sends),
+                ("recv", self.recv_deterministic,
+                 self.reference_recvs, self._recvs)):
+            for pid in set(refs) | set(gots):
+                ref = refs.get(pid, [])
+                got = gots.get(pid, [])
+                diff = self._first_diff(ref, got)
+                if diff is None:
+                    continue
+                if flags.get(pid, True):
+                    flags[pid] = False
+                    idx, why = diff
+                    msg = (f"The {kind} communications pattern of the "
+                           f"actor {pid} is different! ({why} at "
+                           f"communication #{idx + 1})")
+                    self.diffs.append(msg)
+                    _logger.info("%s", msg)
+                # reference early exits (deterministic_comm_pattern,
+                # .cpp:139-160)
+                if send_only and kind == "send":
+                    _logger.info("***** Non-send-deterministic "
+                                 "communications pattern *****")
+                    raise NonDeterminismError(
+                        f"Non-send-deterministic communications "
+                        f"pattern for actor {pid}", "send", pid, ref,
+                        got)
+                if (not send_only
+                        and config["model-check/"
+                                   "communications-determinism"]
+                        and not self.send_deterministic.get(pid, True)
+                        and not self.recv_deterministic.get(pid, True)):
+                    _logger.info("***** Non-deterministic communications "
+                                 "pattern *****")
+                    raise NonDeterminismError(
+                        f"Non-deterministic communications pattern for "
+                        f"actor {pid} (neither send- nor "
+                        f"recv-deterministic)", "both", pid, ref, got)
+
+    def classification(self) -> Dict:
+        """The reference's log_state summary (.cpp:305-331)."""
+        send_ok = all(self.send_deterministic.values())
+        recv_ok = all(self.recv_deterministic.values())
+        _logger.info("Send-deterministic : %s", "Yes" if send_ok else "No")
+        _logger.info("Recv-deterministic : %s", "Yes" if recv_ok else "No")
+        return {
+            "send_deterministic": send_ok,
+            "recv_deterministic": recv_ok,
+            "per_actor": {
+                pid: {"send": self.send_deterministic.get(pid, True),
+                      "recv": self.recv_deterministic.get(pid, True)}
+                for pid in set(self.send_deterministic)
+                | set(self.recv_deterministic)},
+            "diffs": list(self.diffs),
+            "paths_checked": self.paths_checked,
+        }
+
+    def run(self) -> Dict:
+        super().run()
+        return self.classification()

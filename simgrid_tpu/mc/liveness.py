@@ -67,8 +67,12 @@ class LivenessChecker:
     the exploration stack with an accepting claim state inside the loop
     is an accepted infinite run (LivenessChecker.cpp:80-150)."""
 
-    def __init__(self, program: Callable, automaton: BuchiAutomaton,
+    def __init__(self, program: Callable, automaton,
                  propositions: Dict[str, Callable]):
+        if isinstance(automaton, str):
+            # an LTL property string: check its never claim
+            from .ltl import never_claim
+            automaton = never_claim(automaton)
         self.program = program
         self.automaton = automaton
         self.propositions = propositions

@@ -1,0 +1,339 @@
+"""LTL -> Büchi translation: the never-claim front end.
+
+The reference accepts Promela-style never claims and LTL atoms through
+a lex/yacc pair (xbt/automaton/parserPromela.lex, parserPromela.yacc,
+automaton.c) and evaluates the resulting xbt_automaton during liveness
+checking.  Re-designed here: formulas are translated directly to a
+Büchi automaton with the classic on-the-fly tableau of Gerth, Peled,
+Vardi & Wolper (PSTV'95), and the generalized acceptance condition is
+degeneralized with the standard counter construction, so a property can
+be stated as a plain string:
+
+    LivenessChecker(program, never_claim("[]<> progress"), props).run()
+
+Syntax (the reference's Promela operator set):
+    ap          atomic proposition (identifier, looked up in the
+                checker's proposition table)
+    1 / 0       true / false
+    ! f         negation            X f   next
+    [] f        always (G)          <> f  eventually (F)
+    f U g       until               f R g / f V g   release
+    f && g, f || g, f -> g, f <-> g
+
+`ltl_to_buchi(f)` accepts exactly the infinite words satisfying f;
+`never_claim(f)` is sugar for `ltl_to_buchi("!(f)")` — the automaton
+the liveness checker must find empty for the property to hold.
+"""
+
+from __future__ import annotations
+
+import itertools
+import re
+from typing import Dict, FrozenSet, List, Set, Tuple
+
+from .liveness import BuchiAutomaton
+
+__all__ = ["ltl_to_buchi", "never_claim", "LtlSyntaxError"]
+
+
+class LtlSyntaxError(ValueError):
+    pass
+
+
+# -- parsing ---------------------------------------------------------------
+
+_TOKEN = re.compile(r"""\s*(?:
+      (?P<lbr>\()|(?P<rbr>\))
+    | (?P<glob>\[\])|(?P<fin><>)
+    | (?P<and>&&)|(?P<or>\|\|)
+    | (?P<iff><->)|(?P<impl>->)
+    | (?P<not>!)
+    | (?P<ap>[A-Za-z_][A-Za-z0-9_.]*)
+    | (?P<one>1)|(?P<zero>0)
+)""", re.X)
+
+_UNARY = {"glob", "fin", "not", "X"}
+
+
+def _tokenize(src: str) -> List[Tuple[str, str]]:
+    out, pos = [], 0
+    while pos < len(src):
+        m = _TOKEN.match(src, pos)
+        if not m or m.end() == pos:
+            rest = src[pos:].strip()
+            if not rest:
+                break
+            raise LtlSyntaxError(f"cannot tokenize {rest[:20]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        text = m.group(kind)
+        if kind == "ap" and text in ("U", "R", "V", "X", "G", "F"):
+            kind = {"U": "U", "R": "R", "V": "R",
+                    "X": "X", "G": "glob", "F": "fin"}[text]
+        out.append((kind, text))
+    out.append(("eof", ""))
+    return out
+
+
+class _Parser:
+    """Recursive descent; precedence low->high:
+    <->  ->  ||  &&  U/R  unary  atom."""
+
+    def __init__(self, src: str):
+        self.toks = _tokenize(src)
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i][0]
+
+    def eat(self, kind=None):
+        k, t = self.toks[self.i]
+        if kind is not None and k != kind:
+            raise LtlSyntaxError(f"expected {kind}, got {t!r}")
+        self.i += 1
+        return k, t
+
+    def parse(self):
+        f = self.iff()
+        if self.peek() != "eof":
+            raise LtlSyntaxError(
+                f"trailing input at {self.toks[self.i][1]!r}")
+        return f
+
+    def iff(self):
+        f = self.impl()
+        while self.peek() == "iff":
+            self.eat()
+            g = self.impl()
+            f = ("iff", f, g)
+        return f
+
+    def impl(self):
+        f = self.disj()
+        if self.peek() == "impl":        # right-assoc
+            self.eat()
+            return ("impl", f, self.impl())
+        return f
+
+    def disj(self):
+        f = self.conj()
+        while self.peek() == "or":
+            self.eat()
+            f = ("or", f, self.conj())
+        return f
+
+    def conj(self):
+        f = self.until()
+        while self.peek() == "and":
+            self.eat()
+            f = ("and", f, self.until())
+        return f
+
+    def until(self):
+        f = self.unary()
+        if self.peek() in ("U", "R"):    # right-assoc
+            kind = self.eat()[0]
+            return (kind, f, self.until())
+        return f
+
+    def unary(self):
+        k = self.peek()
+        if k in _UNARY:
+            self.eat()
+            g = self.unary()
+            return {"not": ("not", g), "X": ("X", g),
+                    "glob": ("R", ("ff",), g),
+                    "fin": ("U", ("tt",), g)}[k]
+        return self.atom()
+
+    def atom(self):
+        k, t = self.eat()
+        if k == "lbr":
+            f = self.iff()
+            self.eat("rbr")
+            return f
+        if k == "ap":
+            return ("ap", t)
+        if k == "one":
+            return ("tt",)
+        if k == "zero":
+            return ("ff",)
+        raise LtlSyntaxError(f"unexpected {t!r}")
+
+
+def _nnf(f, neg=False):
+    """Negation normal form over {ap, !ap, tt, ff, and, or, X, U, R}."""
+    op = f[0]
+    if op == "not":
+        return _nnf(f[1], not neg)
+    if op == "tt":
+        return ("ff",) if neg else ("tt",)
+    if op == "ff":
+        return ("tt",) if neg else ("ff",)
+    if op == "ap":
+        return ("not", f) if neg else f
+    if op == "impl":
+        return _nnf(("or", ("not", f[1]), f[2]), neg)
+    if op == "iff":
+        return _nnf(("or", ("and", f[1], f[2]),
+                     ("and", ("not", f[1]), ("not", f[2]))), neg)
+    if op == "X":
+        return ("X", _nnf(f[1], neg))
+    dual = {"and": "or", "or": "and", "U": "R", "R": "U"}
+    if neg:
+        op = dual[op]
+    return (op, _nnf(f[1], neg), _nnf(f[2], neg))
+
+
+# -- GPVW tableau ----------------------------------------------------------
+
+def _is_literal(f) -> bool:
+    return f[0] in ("tt", "ff", "ap") or \
+        (f[0] == "not" and f[1][0] == "ap")
+
+
+def _negate_literal(f):
+    if f[0] == "tt":
+        return ("ff",)
+    if f[0] == "ff":
+        return ("tt",)
+    if f[0] == "not":
+        return f[1]
+    return ("not", f)
+
+
+class _Node:
+    __slots__ = ("id", "incoming", "new", "old", "next")
+
+    def __init__(self, nid, incoming, new, old, nxt):
+        self.id = nid
+        self.incoming: Set = set(incoming)
+        self.new: Set = set(new)
+        self.old: Set = set(old)
+        self.next: Set = set(nxt)
+
+
+def _expand(node: _Node, nodes: List[_Node], counter) -> None:
+    if not node.new:
+        for nd in nodes:
+            if nd.old == node.old and nd.next == node.next:
+                nd.incoming |= node.incoming
+                return
+        nodes.append(node)
+        _expand(_Node(next(counter), {node.id}, set(node.next),
+                      set(), set()), nodes, counter)
+        return
+    eta = node.new.pop()
+    op = eta[0]
+    if _is_literal(eta):
+        if eta == ("ff",) or _negate_literal(eta) in node.old:
+            return                        # contradiction: drop branch
+        node.old.add(eta)
+        _expand(node, nodes, counter)
+    elif op == "and":
+        node.new |= {eta[1], eta[2]} - node.old
+        node.old.add(eta)
+        _expand(node, nodes, counter)
+    elif op == "X":
+        node.old.add(eta)
+        node.next.add(eta[1])
+        _expand(node, nodes, counter)
+    elif op in ("or", "U", "R"):
+        a, b = eta[1], eta[2]
+        if op == "or":
+            new1, next1, new2 = {a}, set(), {b}
+        elif op == "U":
+            new1, next1, new2 = {a}, {eta}, {b}
+        else:  # R
+            new1, next1, new2 = {b}, {eta}, {a, b}
+        n1 = _Node(next(counter), node.incoming,
+                   node.new | (new1 - node.old),
+                   node.old | {eta}, node.next | next1)
+        n2 = _Node(next(counter), node.incoming,
+                   node.new | (new2 - node.old),
+                   node.old | {eta}, node.next)
+        _expand(n1, nodes, counter)
+        _expand(n2, nodes, counter)
+    else:  # pragma: no cover — exhaustive over NNF operators
+        raise AssertionError(f"unexpected operator {op}")
+
+
+def _subformulas(f, acc: Set) -> Set:
+    acc.add(f)
+    if f[0] in ("and", "or", "U", "R"):
+        _subformulas(f[1], acc)
+        _subformulas(f[2], acc)
+    elif f[0] in ("X", "not"):
+        _subformulas(f[1], acc)
+    return acc
+
+
+def _make_guard(literals: FrozenSet):
+    pos = tuple(sorted(f[1] for f in literals if f[0] == "ap"))
+    neg = tuple(sorted(f[1][1] for f in literals if f[0] == "not"))
+
+    def guard(valuation: Dict[str, bool], _pos=pos, _neg=neg) -> bool:
+        return (all(valuation.get(p, False) for p in _pos)
+                and not any(valuation.get(p, False) for p in _neg))
+    return guard
+
+
+def ltl_to_buchi(formula: str) -> BuchiAutomaton:
+    """Translate an LTL formula to a BuchiAutomaton accepting exactly
+    the infinite proposition sequences that satisfy it."""
+    f = _nnf(_Parser(formula).parse())
+    counter = itertools.count()
+    nodes: List[_Node] = []
+    _expand(_Node(next(counter), {"init"}, {f}, set(), set()),
+            nodes, counter)
+
+    untils = sorted(g for g in _subformulas(f, set()) if g[0] == "U")
+    k = len(untils)
+    fsets = [{nd.id for nd in nodes
+              if u not in nd.old or u[2] in nd.old} for u in untils]
+
+    by_id = {nd.id: nd for nd in nodes}
+    guards = {nd.id: _make_guard(frozenset(
+        g for g in nd.old if _is_literal(g) and g[0] != "tt"))
+        for nd in nodes}
+
+    def sname(nid, layer):
+        return f"n{nid}@{layer}"
+
+    states = ["init"]
+    transitions = []
+    accepting: Set[str] = set()
+    layers = range(max(k, 1))
+    for nd in nodes:
+        for i in layers:
+            states.append(sname(nd.id, i))
+    if k == 0:
+        # no Until obligation: every infinite run is fair ("init" can
+        # never sit on a cycle, so including it is harmless)
+        accepting = set(states)
+    else:
+        accepting = {sname(nid, 0) for nid in fsets[0]}
+
+    def next_layer(src_id, i):
+        if k == 0:
+            return 0
+        return (i + 1) % k if src_id in fsets[i] else i
+
+    for nd in nodes:
+        g = guards[nd.id]
+        for src in nd.incoming:
+            if src == "init":
+                transitions.append(("init", sname(nd.id, 0), g))
+            else:
+                for i in layers:
+                    transitions.append(
+                        (sname(src, i),
+                         sname(nd.id, next_layer(src, i)), g))
+    return BuchiAutomaton(states=states, initial="init",
+                          accepting=accepting, transitions=transitions)
+
+
+def never_claim(formula: str) -> BuchiAutomaton:
+    """The Büchi automaton of the NEGATED property — what the liveness
+    checker must find empty for `formula` to hold on every run."""
+    return ltl_to_buchi(f"!({formula})")

@@ -189,7 +189,22 @@ class NetworkCm02Model(NetworkModel):
 
     def create_link(self, name: str, bandwidth: float, latency: float,
                     policy: SharingPolicy = SharingPolicy.SHARED) -> "NetworkCm02Link":
+        if policy == SharingPolicy.WIFI:
+            # single-rate WIFI declaration: one modulation level
+            if latency:
+                raise ValueError(
+                    f"WIFI link {name!r}: latency is not modeled on "
+                    "access points (the reference hardcodes 0, "
+                    "network_cm02.cpp:385) — refusing to drop it "
+                    "silently")
+            return NetworkWifiLink(self, name, [bandwidth])
         return NetworkCm02Link(self, name, bandwidth, latency, policy)
+
+    def create_wifi_link(self, name: str,
+                         bandwidths: List[float]) -> "NetworkWifiLink":
+        """An access-point link with one bandwidth per modulation level
+        (reference NetworkCm02Model::create_link, network_cm02.cpp:93-97)."""
+        return NetworkWifiLink(self, name, bandwidths)
 
     def update_actions_state_lazy(self, now: float, delta: float) -> None:
         eps = config["surf/precision"]
@@ -327,13 +342,84 @@ class NetworkCm02Model(NetworkModel):
                 if action.lat_current > 0 else action.rate)
 
         for link in route:
-            self.system.expand(link.constraint, action.variable, 1.0)
+            if link.get_sharing_policy() == SharingPolicy.WIFI:
+                # WIFI constraint capacity is normalized AIRTIME (1.0);
+                # a station's flow consumes airtime at 1/host_rate per
+                # byte/s, so faster modulations leave more airtime for
+                # the others (reference network_cm02.cpp:240-260).
+                # Explicit raises (not bare asserts): user-input
+                # validation must survive python -O.
+                if crosstraffic:
+                    raise AssertionError(
+                        "Cross-traffic is not yet supported when using "
+                        "WIFI. Please use --cfg=network/crosstraffic:0")
+                src_rate = link.get_host_rate(src)
+                dst_rate = link.get_host_rate(dst)
+                if src_rate < 0 and dst_rate < 0:
+                    raise AssertionError(
+                        "Some stations are not associated to any access "
+                        "point. Make sure to call set_host_rate on all "
+                        "stations.")
+                # when BOTH endpoints are stations of this AP the src
+                # modulation wins — the reference's own open TODO
+                # (network_cm02.cpp:249 "for the moment we use src rate")
+                rate = src_rate if src_rate >= 0 else dst_rate
+                self.system.expand(link.constraint, action.variable,
+                                   1.0 / rate)
+            else:
+                self.system.expand(link.constraint, action.variable, 1.0)
         if crosstraffic:
             for link in back_route:
                 self.system.expand(link.constraint, action.variable, 0.05)
 
         LinkImpl.on_communicate(action, src, dst)
         return action
+
+
+class NetworkWifiLink(LinkImpl):
+    """An 802.11 access point: the LMM constraint shares normalized
+    AIRTIME (capacity 1.0 after the bandwidth factor), per-station
+    modulation levels translate byte rates into airtime weights at
+    expand time (reference NetworkWifiLink, network_cm02.hpp:56-80,
+    network_cm02.cpp:383-420).  Stations associate with
+    set_host_rate(host, level); level indexes the bandwidths list."""
+
+    def __init__(self, model: NetworkCm02Model, name: str,
+                 bandwidths: List[float]):
+        bw_factor = config["network/bandwidth-factor"]
+        # bound = bw_factor * (1/bw_factor) = exactly 1.0 of airtime
+        super().__init__(model, name,
+                         model.system.constraint_new(None, 1.0))
+        self.constraint.id = self
+        self.constraint.sharing_policy = SharingPolicy.WIFI
+        self.bandwidth_peak = 1.0 / bw_factor
+        self.latency_peak = 0.0
+        self.bandwidths = list(bandwidths)
+        self.host_rates: dict = {}
+        LinkImpl.on_creation(self)
+
+    def get_sharing_policy(self) -> SharingPolicy:
+        return SharingPolicy.WIFI
+
+    def set_host_rate(self, host, rate_level: int) -> None:
+        self.host_rates[host.name] = rate_level
+
+    def get_host_rate(self, host) -> float:
+        level = self.host_rates.get(host.name)
+        if level is None:
+            return -1.0
+        assert 0 <= level < len(self.bandwidths), \
+            f"Host {host.name!r} has an invalid rate {level}"
+        return self.bandwidths[level] * self.bandwidth_scale
+
+    def apply_event(self, event: profile_mod.Event, value: float) -> None:
+        if event is self.state_event:
+            if value > 0:
+                self.turn_on()
+            else:
+                self.turn_off()
+        else:
+            raise AssertionError("Unknown event on a WIFI link!")
 
 
 class NetworkCm02Link(LinkImpl):

@@ -188,13 +188,36 @@ class PlatformLoader:
 
     def _parse_link(self, elem, zone) -> None:
         name = elem.get("id")
-        bandwidth = parse_bandwidth(elem.get("bandwidth"))
         latency = parse_time(elem.get("latency", "0"))
         policy_str = elem.get("sharing_policy", "SHARED")
-        policy = {"SHARED": SharingPolicy.SHARED,
-                  "FATPIPE": SharingPolicy.FATPIPE,
-                  "SPLITDUPLEX": SharingPolicy.SHARED,
-                  "WIFI": SharingPolicy.WIFI}[policy_str]
+        policies = {"SHARED": SharingPolicy.SHARED,
+                    "FATPIPE": SharingPolicy.FATPIPE,
+                    "SPLITDUPLEX": SharingPolicy.SHARED,
+                    "WIFI": SharingPolicy.WIFI}
+        if policy_str not in policies:
+            raise ValueError(
+                f"Link {name!r}: unknown sharing_policy {policy_str!r} "
+                f"(expected one of {sorted(policies)})")
+        policy = policies[policy_str]
+        if policy_str == "WIFI":
+            # one bandwidth per modulation level, comma-separated
+            # (reference sg_platf link parsing for WIFI links)
+            if latency:
+                raise ValueError(
+                    f"Link {name!r}: latency is not modeled on WIFI "
+                    "access points — refusing to drop it silently")
+            bandwidths = [parse_bandwidth(b) for b in
+                          elem.get("bandwidth").split(",")]
+            model = self.engine.network_model
+            if not hasattr(model, "create_wifi_link"):
+                raise ValueError(
+                    f"Link {name!r}: sharing_policy WIFI is not "
+                    f"supported by the {type(model).__name__} network "
+                    "model — refusing to simulate it as a wired link")
+            link = model.create_wifi_link(name, bandwidths)
+            self._attach_link_extras(elem, link)
+            return
+        bandwidth = parse_bandwidth(elem.get("bandwidth"))
         if policy_str == "SPLITDUPLEX":
             # two directed links, suffixed _UP and _DOWN (sg_platf.cpp)
             for suffix in ("_UP", "_DOWN"):

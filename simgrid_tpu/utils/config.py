@@ -328,5 +328,13 @@ declare_flag("smpi/cpu-threshold",
 declare_flag("smpi/coll-selector", "Collective algorithm selector", "default")
 declare_flag("model-check/reduction", "DPOR reduction (none|dpor)", "dpor")
 declare_flag("model-check/max-depth", "Maximal exploration depth", 1000)
+declare_flag("model-check/send-determinism",
+             "Check send-determinism only: abort the exploration as "
+             "soon as any actor's send pattern diverges (reference "
+             "_sg_mc_send_determinism)", False)
+declare_flag("model-check/communications-determinism",
+             "Classify send- AND recv-determinism per actor over the "
+             "whole exploration, aborting only when an actor loses "
+             "both (reference _sg_mc_comms_determinism)", True)
 declare_flag("precision-tracking/jax",
              "Tolerance used when cross-checking JAX solver results", 1e-9)

@@ -1,0 +1,131 @@
+"""LTL -> Büchi translation (mc/ltl.py): word-level semantics of the
+tableau construction, and the formula-string liveness front end.
+Reference analog: xbt/automaton/parserPromela.lex + automaton.c."""
+
+import itertools
+
+import pytest
+
+from simgrid_tpu import mc
+from simgrid_tpu.mc.ltl import LtlSyntaxError, ltl_to_buchi, never_claim
+
+
+def accepts_lasso(aut, prefix, cycle):
+    """Does `aut` accept the infinite word prefix . cycle^omega?
+    Explicit product search: track (automaton state, position) pairs;
+    acceptance = a reachable cycle in the lasso's cycle part touching
+    an accepting automaton state."""
+    word = list(prefix) + list(cycle)
+    n_pre, n_cyc = len(prefix), len(cycle)
+
+    def step(states, letter):
+        out = set()
+        for s in states:
+            out.update(aut.successors(s, letter))
+        return out
+
+    # advance through the prefix
+    states = {aut.initial}
+    # product graph over (aut state, cycle position), explored from the
+    # state set after the prefix
+    for letter in prefix:
+        states = step(states, letter)
+        if not states:
+            return False
+
+    # Build reachable product nodes (s, i) where i = index in cycle
+    seen = set()
+    frontier = {(s, 0) for s in states}
+    edges = {}
+    while frontier:
+        nxt = set()
+        for (s, i) in frontier:
+            if (s, i) in seen:
+                continue
+            seen.add((s, i))
+            for s2 in aut.successors(s, cycle[i]):
+                j = (i + 1) % n_cyc
+                edges.setdefault((s, i), set()).add((s2, j))
+                nxt.add((s2, j))
+        frontier = nxt - seen
+
+    # accepting cycle search (DFS per accepting node)
+    def reaches(start, target):
+        stack, vis = [start], set()
+        while stack:
+            n = stack.pop()
+            if n == target:
+                return True
+            if n in vis:
+                continue
+            vis.add(n)
+            stack.extend(edges.get(n, ()))
+        return False
+
+    for node in seen:
+        s, i = node
+        if s in aut.accepting:
+            for succ in edges.get(node, ()):
+                if succ == node or reaches(succ, node):
+                    return True
+    return False
+
+
+def w(*names):
+    """Letter: valuation with the named propositions true."""
+    return [{n: True for n in ls.split()} if ls else {} for ls in names]
+
+
+@pytest.mark.parametrize("formula,pos,neg", [
+    # (formula, accepted lassos, rejected lassos) — lasso = (prefix, cycle)
+    ("<> p",  [((), w("p")), (w("", ""), w("p", ""))],
+              [((), w(""))]),
+    ("[] p",  [((), w("p"))],
+              [((), w("")), (w("p"), w("p", ""))]),
+    ("p U q", [((), w("q")), (w("p", "p"), w("q"))],
+              [((), w("")), (w("", "q"), w("q"))]),
+    ("[] <> p", [((), w("p", "")), (w(""), w("", "p"))],
+                [(w("p p p"), w("")), ((), w(""))]),
+    ("<> [] p", [(w("", ""), w("p")), ((), w("p"))],
+                [((), w("p", ""))]),
+    ("! p",   [((), w(""))], [((), w("p"))]),
+    ("p -> <> q", [((), w("")), (w("p"), w("q")), (w("p q"), w(""))],
+                  [(w("p"), w(""))]),
+    ("X p",   [(w(""), w("p"))], [(w("p"), w(""))]),
+    ("p R q", [((), w("q")), (w("q", "q"), w("p q", ""))],
+              [((), w("q", "")), ((), w(""))]),
+])
+def test_word_semantics(formula, pos, neg):
+    aut = ltl_to_buchi(formula)
+    for prefix, cycle in pos:
+        assert accepts_lasso(aut, prefix, cycle), \
+            f"{formula} must accept {prefix}+{cycle}^w"
+    for prefix, cycle in neg:
+        assert not accepts_lasso(aut, prefix, cycle), \
+            f"{formula} must reject {prefix}+{cycle}^w"
+
+
+def test_never_claim_is_negation():
+    aut = never_claim("<> done")
+    # a run where done never holds violates <> done: claim accepts
+    assert accepts_lasso(aut, (), w(""))
+    assert not accepts_lasso(aut, w(""), w("done"))
+
+
+def test_syntax_errors():
+    for bad in ("p &&", "(p", "p <>", "p ? q", ""):
+        with pytest.raises(LtlSyntaxError):
+            ltl_to_buchi(bad)
+
+
+def test_operator_sugar_equivalences():
+    """G/F keyword aliases and <->; spot-check a tautology and a
+    contradiction."""
+    # p <-> p is a tautology: never claim is empty (rejects everything)
+    aut = never_claim("[] (p <-> p)")
+    for cyc in (w("p"), w(""), w("p", "")):
+        assert not accepts_lasso(aut, (), cyc)
+    # G p equivalent to [] p
+    a1, a2 = ltl_to_buchi("G p"), ltl_to_buchi("[] p")
+    for lasso in [((), w("p")), ((), w("p", "")), (w(""), w("p"))]:
+        assert accepts_lasso(a1, *lasso) == accepts_lasso(a2, *lasso)

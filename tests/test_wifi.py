@@ -1,0 +1,111 @@
+"""WiFi link model (reference NetworkWifiLink, network_cm02.hpp:56-80,
+network_cm02.cpp:93-97 + 240-260 + 383-420): the AP constraint shares
+normalized airtime, stations consume airtime at 1/modulation_rate."""
+
+import pytest
+
+from simgrid_tpu import s4u
+
+
+WIFI_XML = """<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="z" routing="Full">
+    <host id="S1" speed="1Gf"/>
+    <host id="S2" speed="1Gf"/>
+    <host id="H" speed="1Gf"/>
+    <link id="AP" bandwidth="54MBps,6MBps" sharing_policy="WIFI"/>
+    <link id="wire" bandwidth="1GBps" latency="0"/>
+    <route src="S1" dst="H"><link_ctn id="AP"/><link_ctn id="wire"/></route>
+    <route src="S2" dst="H"><link_ctn id="AP"/><link_ctn id="wire"/></route>
+  </zone>
+</platform>
+"""
+
+
+def _engine(tmp_path, xml=WIFI_XML, cfg=()):
+    plat = tmp_path / "wifi.xml"
+    plat.write_text(xml)
+    e = s4u.Engine(["wifi", "--cfg=network/model:CM02",
+                    "--cfg=network/crosstraffic:0", *cfg])
+    e.load_platform(str(plat))
+    return e
+
+
+def test_airtime_sharing(tmp_path):
+    """Two stations at different modulation levels sending through the
+    same AP: max-min equalizes their byte rates x with
+    x/r1 + x/r2 = 1 airtime -> x = 1/(1/54e6 + 1/6e6) = 5.4e6."""
+    e = _engine(tmp_path)
+    s1, s2, h = (e.host_by_name(n) for n in ("S1", "S2", "H"))
+    ap = e.link_by_name("AP")
+    ap.set_host_rate(s1, 0)   # 54 MBps modulation
+    ap.set_host_rate(s2, 1)   # 6 MBps modulation
+    model = e.pimpl.network_model
+    a1 = model.communicate(s1, h, 1e7, -1.0)
+    a2 = model.communicate(s2, h, 1e7, -1.0)
+    e.pimpl.surf_solve(-1.0)
+    assert a1.variable.value == pytest.approx(5.4e6, rel=1e-9)
+    assert a2.variable.value == pytest.approx(5.4e6, rel=1e-9)
+
+
+def test_airtime_asymmetry_favors_fast_modulation(tmp_path):
+    """A single slow station saturates the AP at its modulation rate; a
+    single fast station alone gets its own (faster) rate."""
+    e = _engine(tmp_path)
+    s1, s2, h = (e.host_by_name(n) for n in ("S1", "S2", "H"))
+    ap = e.link_by_name("AP")
+    ap.set_host_rate(s1, 0)
+    ap.set_host_rate(s2, 1)
+    model = e.pimpl.network_model
+    a1 = model.communicate(s1, h, 1e7, -1.0)
+    e.pimpl.surf_solve(-1.0)
+    assert a1.variable.value == pytest.approx(54e6, rel=1e-9)
+
+
+def test_dst_station_rate_used_when_src_wired(tmp_path):
+    """Traffic TOWARD a station uses the station's (dst) modulation."""
+    e = _engine(tmp_path)
+    s2, h = e.host_by_name("S2"), e.host_by_name("H")
+    ap = e.link_by_name("AP")
+    ap.set_host_rate(s2, 1)
+    model = e.pimpl.network_model
+    a = model.communicate(h, s2, 1e7, -1.0)
+    e.pimpl.surf_solve(-1.0)
+    assert a.variable.value == pytest.approx(6e6, rel=1e-9)
+
+
+def test_unassociated_station_rejected(tmp_path):
+    e = _engine(tmp_path)
+    s1, h = e.host_by_name("S1"), e.host_by_name("H")
+    model = e.pimpl.network_model
+    with pytest.raises(AssertionError, match="not associated"):
+        model.communicate(s1, h, 1e7, -1.0)
+
+
+def test_crosstraffic_rejected_with_wifi(tmp_path):
+    e = _engine(tmp_path, cfg=("--cfg=network/crosstraffic:1",))
+    s1, h = e.host_by_name("S1"), e.host_by_name("H")
+    e.link_by_name("AP").set_host_rate(s1, 0)
+    model = e.pimpl.network_model
+    with pytest.raises(AssertionError, match="Cross-traffic"):
+        model.communicate(s1, h, 1e7, -1.0)
+
+
+def test_unknown_sharing_policy_rejected(tmp_path):
+    xml = WIFI_XML.replace('sharing_policy="WIFI"',
+                           'sharing_policy="QUANTUM"')
+    # the DTD layer rejects the enum value before the loader does;
+    # either way the platform must not load
+    with pytest.raises(Exception, match="QUANTUM|sharing_policy"):
+        _engine(tmp_path, xml=xml)
+
+
+def test_wifi_rejected_on_unsupporting_model(tmp_path):
+    """A model without WiFi semantics must refuse the platform rather
+    than silently simulating the AP as a wired link (VERDICT r4 #8)."""
+    plat = tmp_path / "wifi.xml"
+    plat.write_text(WIFI_XML)
+    e = s4u.Engine(["wifi", "--cfg=network/model:Packet",
+                    "--cfg=network/crosstraffic:0"])
+    with pytest.raises(ValueError, match="WIFI is not supported"):
+        e.load_platform(str(plat))
