@@ -1,0 +1,41 @@
+"""s4u-actor-daemon replica (reference
+examples/s4u/actor-daemon/s4u-actor-daemon.cpp): a daemonized actor
+loops forever and dies with the last regular actor."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_actor_daemon")
+
+
+def worker():
+    LOG.info("Let's do some work (for 10 sec on Boivin).")
+    s4u.this_actor.execute(980.95e6)
+    LOG.info("I'm done now. I leave even if it makes the daemon die.")
+
+
+def my_daemon():
+    s4u.Actor.self().daemonize()
+    while s4u.this_actor.get_host().is_on():
+        LOG.info("Hello from the infinite loop")
+        s4u.this_actor.sleep_for(3.0)
+    LOG.info("I will never reach that point: daemons are killed when "
+             "regular processes are done")
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    s4u.Actor.create("worker", e.host_by_name("Boivin"), worker)
+    s4u.Actor.create("daemon", e.host_by_name("Tremblay"), my_daemon)
+    e.run()
+
+
+if __name__ == "__main__":
+    main()

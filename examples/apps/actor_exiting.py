@@ -1,0 +1,39 @@
+"""s4u-actor-exiting replica (reference
+examples/s4u/actor-exiting/s4u-actor-exiting.cpp): on_exit vs the
+engine-wide on_termination / on_destruction signals."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_actor_exiting")
+
+
+def actor_a():
+    s4u.this_actor.on_exit(lambda failed: LOG.info("I stop now"))
+    s4u.this_actor.execute(1e9)
+
+
+def actor_b():
+    s4u.this_actor.execute(2e9)
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    s4u.Actor.on_termination.connect(
+        lambda actor: LOG.info("Actor %s terminates now", actor.name))
+    s4u.Actor.on_destruction.connect(
+        lambda actor: LOG.info("Actor %s gets destroyed now", actor.name))
+    s4u.Actor.create("A", e.host_by_name("Tremblay"), actor_a)
+    s4u.Actor.create("B", e.host_by_name("Fafard"), actor_b)
+    e.run()
+
+
+if __name__ == "__main__":
+    main()

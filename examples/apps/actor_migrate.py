@@ -1,0 +1,56 @@
+"""s4u-actor-migrate replica (reference
+examples/s4u/actor-migrate/s4u-actor-migrate.cpp): self-migration mid
+execution and monitor-driven migration of a suspended actor."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_actor_migration")
+
+
+def worker(first, second):
+    flop_amount = first.get_speed() * 5 + second.get_speed() * 5
+    LOG.info("Let's move to %s to execute %.2f Mflops (5sec on %s and "
+             "5sec on %s)", first.name, flop_amount / 1e6, first.name,
+             second.name)
+    s4u.this_actor.migrate(first)
+    s4u.this_actor.execute(flop_amount)
+    LOG.info("I wake up on %s. Let's suspend a bit",
+             s4u.this_actor.get_host().name)
+    s4u.this_actor.suspend()
+    LOG.info("I wake up on %s", s4u.this_actor.get_host().name)
+    LOG.info("Done")
+
+
+def monitor():
+    e = s4u.Engine.get_instance()
+    boivin = e.host_by_name("Boivin")
+    jacquelin = e.host_by_name("Jacquelin")
+    fafard = e.host_by_name("Fafard")
+    actor = s4u.Actor.create("worker", fafard,
+                             lambda: worker(boivin, jacquelin))
+    s4u.this_actor.sleep_for(5)
+    LOG.info("After 5 seconds, move the process to %s", jacquelin.name)
+    actor.migrate(jacquelin)
+    s4u.this_actor.sleep_until(15)
+    LOG.info("At t=15, move the process to %s and resume it.",
+             fafard.name)
+    actor.migrate(fafard)
+    actor.resume()
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    s4u.Actor.create("monitor", e.host_by_name("Boivin"), monitor)
+    e.run()
+
+
+if __name__ == "__main__":
+    main()

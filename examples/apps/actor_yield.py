@@ -1,0 +1,32 @@
+"""s4u-actor-yield replica (reference
+examples/s4u/actor-yield/s4u-actor-yield.cpp): over-polite actors yield
+N times; deployment-file instantiation."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_actor_yield")
+
+
+def yielder(n):
+    for _ in range(int(n)):
+        s4u.this_actor.yield_()
+    LOG.info("I yielded %s times. Goodbye now!", int(n))
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    e.register_function("yielder", yielder)
+    e.load_deployment(sys.argv[2])
+    e.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""s4u-exec-async replica (reference
+examples/s4u/exec-async/s4u-exec-async.cpp): start/wait, test-poll,
+and cancel of asynchronous executions."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_test")
+
+
+def waiter():
+    amount = s4u.this_actor.get_host().get_speed()
+    LOG.info("Execute %g flops, should take 1 second.", amount)
+    activity = s4u.this_actor.exec_init(amount)
+    activity.start()
+    activity.wait()
+    LOG.info("Goodbye now!")
+
+
+def monitor():
+    amount = s4u.this_actor.get_host().get_speed()
+    LOG.info("Execute %g flops, should take 1 second.", amount)
+    activity = s4u.this_actor.exec_init(amount)
+    activity.start()
+    while not activity.test():
+        LOG.info("Remaining amount of flops: %g (%.0f%%)",
+                 activity.get_remaining(),
+                 100 * activity.get_remaining_ratio())
+        s4u.this_actor.sleep_for(0.3)
+    activity.wait()
+    LOG.info("Goodbye now!")
+
+
+def canceller():
+    amount = s4u.this_actor.get_host().get_speed()
+    LOG.info("Execute %g flops, should take 1 second.", amount)
+    activity = s4u.this_actor.exec_async(amount)
+    s4u.this_actor.sleep_for(0.5)
+    LOG.info("I changed my mind, cancel!")
+    activity.cancel()
+    LOG.info("Goodbye now!")
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    s4u.Actor.create("wait", e.host_by_name("Fafard"), waiter)
+    s4u.Actor.create("monitor", e.host_by_name("Ginette"), monitor)
+    s4u.Actor.create("cancel", e.host_by_name("Boivin"), canceller)
+    e.run()
+    LOG.info("Simulation time %g", e.clock)
+
+
+if __name__ == "__main__":
+    main()

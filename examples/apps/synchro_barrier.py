@@ -1,0 +1,44 @@
+"""s4u-synchro-barrier replica (reference
+examples/s4u/synchro-barrier/s4u-synchro-barrier.cpp)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_test")
+
+
+def worker(barrier):
+    LOG.info("Waiting on the barrier")
+    barrier.wait()
+    LOG.info("Bye")
+
+
+def master(process_count):
+    e = s4u.Engine.get_instance()
+    barrier = s4u.Barrier(process_count)
+    LOG.info("Spawning %d workers", process_count - 1)
+    for _ in range(process_count - 1):
+        s4u.Actor.create("worker", e.host_by_name("Jupiter"),
+                         lambda: worker(barrier))
+    LOG.info("Waiting on the barrier")
+    barrier.wait()
+    LOG.info("Bye")
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    process_count = int(sys.argv[1])
+    e.load_platform("/root/reference/examples/platforms/two_hosts.xml")
+    s4u.Actor.create("master", e.host_by_name("Tremblay"),
+                     lambda: master(process_count))
+    e.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""s4u-synchro-mutex replica (reference
+examples/s4u/synchro-mutex/s4u-synchro-mutex.cpp): regular lock/unlock
+vs context-manager locking (the lock_guard analogue)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_test")
+
+NB_ACTOR = 6
+result = [0]
+
+
+def worker(mutex):
+    mutex.lock()
+    LOG.info("Hello s4u, I'm ready to compute after a regular lock")
+    result[0] += 1
+    LOG.info("I'm done, good bye")
+    mutex.unlock()
+
+
+def worker_lock_guard(mutex):
+    with mutex:
+        LOG.info("Hello s4u, I'm ready to compute after a lock_guard")
+        result[0] += 1
+        LOG.info("I'm done, good bye")
+
+
+def master():
+    e = s4u.Engine.get_instance()
+    mutex = s4u.Mutex()
+    for i in range(NB_ACTOR * 2):
+        if i % 2 == 0:
+            s4u.Actor.create("worker", e.host_by_name("Jupiter"),
+                             lambda m=mutex: worker_lock_guard(m))
+        else:
+            s4u.Actor.create("worker", e.host_by_name("Tremblay"),
+                             lambda m=mutex: worker(m))
+    s4u.this_actor.sleep_for(10)
+    LOG.info("Results is -> %d", result[0])
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform("/root/reference/examples/platforms/two_hosts.xml")
+    s4u.Actor.create("main", e.host_by_name("Tremblay"), master)
+    e.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -402,6 +402,30 @@ class ExecImpl(ActivityImpl):
             self.timeout_detector = self.hosts[0].cpu.sleep(timeout)
             self.timeout_detector.activity = self
 
+    def migrate(self, to_host) -> None:
+        """Re-home a RUNNING single-host execution: a fresh CPU action
+        on the destination carries over the remaining flops (reference
+        ExecImpl::migrate, src/kernel/activity/ExecImpl.cpp — the
+        mechanism behind actor migration mid-execute)."""
+        if self.surf_action is None or len(self.hosts) != 1:
+            self.hosts = [to_host]
+            return
+        old = self.surf_action
+        new = to_host.cpu.execution_start(0.0)
+        new.remains = old.get_remains()
+        new.cost = old.cost
+        new.set_sharing_penalty(old.sharing_penalty)
+        new.category = old.category
+        if self.bound > 0:
+            new.set_bound(self.bound)
+        old.activity = None
+        old.cancel()
+        old.destroy()   # free the LMM variable now: the source host's
+        # load must drop immediately (exec-remote oracle pins it)
+        self.surf_action = new
+        new.activity = self
+        self.hosts = [to_host]
+
     def start(self) -> "ExecImpl":
         self.state = State.RUNNING
         if len(self.hosts) == 1:

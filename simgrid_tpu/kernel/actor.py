@@ -232,5 +232,7 @@ class ActorImpl:
             sc.issuer.simcall_answer()
         if hasattr(self, "_join_simcalls"):
             self._join_simcalls.clear()
-        ActorImpl.on_termination(self)
+        # on_termination fires from MAESTRO (the engine queues it):
+        # reference signal callbacks run in the kernel, so their log
+        # lines carry the maestro context, not the dying actor's
         self.engine.actor_terminated(self)

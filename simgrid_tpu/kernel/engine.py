@@ -74,6 +74,7 @@ class EngineImpl:
         self.maestro.pid = 0
         self._pid = 1        # maestro consumed pid 1; reclaim it
         self.actors_to_run: List[ActorImpl] = []
+        self.actors_terminated_pending: List[ActorImpl] = []
         self.actors_that_ran: List[ActorImpl] = []
         self.process_list: Dict[int, ActorImpl] = {}
         self.actors_to_destroy: List[ActorImpl] = []
@@ -196,6 +197,7 @@ class EngineImpl:
         for comm in list(actor.comms):
             comm.cancel()
         actor.comms.clear()
+        self.actors_terminated_pending.append(actor)
         self.actors_to_destroy.append(actor)
 
     def actor_crashed(self, actor: ActorImpl, exc: BaseException) -> None:
@@ -336,8 +338,22 @@ class EngineImpl:
                     action.activity.post()
                 action = model.extract_done_action()
 
+    def _fire_terminations(self) -> None:
+        """Fire on_termination from the maestro context (the reference
+        runs signal callbacks in the kernel, so e.g. the actor-exiting
+        example's lines read "(maestro@) Actor A terminates now")."""
+        while self.actors_terminated_pending:
+            from .actor import ActorImpl
+            ActorImpl.on_termination(self.actors_terminated_pending.pop(0))
+
     def _empty_trash(self) -> None:
-        self.actors_to_destroy.clear()
+        """Destroy dead actors (reference intrusive-refcount release):
+        fired one simulation round AFTER termination — the C++ ActorPtr
+        held through the scheduling round keeps the actor alive until
+        the next maestro pass (pinned by the actor-exiting oracle)."""
+        from .actor import ActorImpl
+        while self.actors_to_destroy:
+            ActorImpl.on_destruction(self.actors_to_destroy.pop(0))
 
     # ------------------------------------------------------------------
     # The main loop (SIMIX_run, smx_global.cpp:377-529)
@@ -391,6 +407,7 @@ class EngineImpl:
                 for actor in self.actors_that_ran:
                     if actor.simcall_.call is not None:
                         actor.simcall_handle()
+                self._fire_terminations()
                 self._execute_tasks()
                 while True:
                     self._wake_processes()

@@ -271,6 +271,18 @@ class Exec(Activity):
 
     def set_host(self, host) -> "Exec":
         self.hosts = [host]
+        if self.state == ActivityState.STARTED and self.pimpl is not None:
+            # re-home the RUNNING execution (reference Exec::set_host ->
+            # ExecImpl::migrate): remaining flops continue at the
+            # destination's speed
+            from .actor import _current_impl
+            issuer = _current_impl()
+            target = self.pimpl
+
+            def handler(sc):
+                target.migrate(host)
+                sc.issuer.simcall_answer()
+            issuer.simcall("execution_change_host", handler)
         return self
 
     def set_flops_amount(self, flops: float) -> "Exec":

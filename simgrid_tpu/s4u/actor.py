@@ -207,6 +207,13 @@ class Actor:
                 target.host.actor_list.remove(target)
             target.host = new_host
             new_host.actor_list.append(target)
+            # a RUNNING execution migrates with its actor (reference
+            # ActorImpl::set_host + ExecImpl::migrate): the remaining
+            # flops continue at the destination's speed
+            synchro = getattr(target, "waiting_synchro", None)
+            if synchro is not None and hasattr(synchro, "migrate") \
+                    and getattr(synchro, "hosts", None):
+                synchro.migrate(new_host)
             sc.issuer.simcall_answer()
         issuer.simcall("actor_set_host", handler)
         Actor.on_migration(self)
@@ -320,6 +327,12 @@ class this_actor:
     @staticmethod
     def suspend() -> None:
         Actor(_current_impl()).suspend()
+
+    @staticmethod
+    def set_host(new_host) -> None:
+        Actor(_current_impl()).set_host(new_host)
+
+    migrate = set_host
 
     @staticmethod
     def exit() -> None:

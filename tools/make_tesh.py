@@ -1,0 +1,84 @@
+#!/usr/bin/env python3
+"""Generate a corpus tesh file from commands, VERIFYING each command's
+output against the corresponding reference tesh block first.
+
+Usage (one spec per line on stdin or as args is overkill — edit the
+SPECS dict in callers):  used by the round-5 example-porting workflow:
+
+    python tools/make_tesh.py OUT.tesh REF.tesh -- cmd1... [--- cmd2...]
+
+Each command is run from the repo root; its stdout lines must equal the
+"> "-lines of the corresponding block of REF.tesh (same order).  On
+success OUT.tesh is written with our commands and the shared pinned
+output; on mismatch the diff is printed and nothing is written.
+"""
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def ref_blocks(path):
+    """Output blocks of a tesh file: list of lists of expected lines."""
+    blocks = []
+    cur = None
+    for line in open(path):
+        if line.startswith("$ "):
+            if cur is not None:
+                blocks.append(cur)
+            cur = []
+        elif line.startswith("> ") and cur is not None:
+            cur.append(line[2:].rstrip("\n"))
+    if cur is not None:
+        blocks.append(cur)
+    return blocks
+
+
+def main() -> int:
+    out_path, ref_path = sys.argv[1], sys.argv[2]
+    assert sys.argv[3] == "--"
+    cmds = []
+    cur = []
+    for a in sys.argv[4:]:
+        if a == "---":
+            cmds.append(cur)
+            cur = []
+        else:
+            cur.append(a)
+    cmds.append(cur)
+
+    refs = ref_blocks(ref_path)
+    assert len(refs) == len(cmds), \
+        f"{len(cmds)} commands vs {len(refs)} reference blocks"
+
+    sections = []
+    for cmd, expected in zip(cmds, refs):
+        proc = subprocess.run(cmd, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True,
+                              cwd=ROOT, timeout=600)
+        got = [ln for ln in proc.stdout.splitlines()]
+        if got != expected:
+            print(f"MISMATCH for {' '.join(cmd)}")
+            for i in range(max(len(got), len(expected))):
+                g = got[i] if i < len(got) else "<missing>"
+                e = expected[i] if i < len(expected) else "<missing>"
+                mark = " " if g == e else "!"
+                print(f"{mark} got: {g}\n{mark} exp: {e}")
+            return 1
+        shown = " ".join(c if " " not in c else f'"{c}"' for c in cmd)
+        sections.append(f"$ {shown}\n" +
+                        "".join(f"> {ln}\n" for ln in expected))
+
+    rel = os.path.relpath(ref_path, "/root/reference")
+    with open(out_path, "w") as fh:
+        fh.write("#!/usr/bin/env tesh\n"
+                 f"p Reference oracle: {rel}\n"
+                 "p (same pinned output, reproduced by the Python "
+                 "replica)\n\n" + "\n".join(sections))
+    print(f"wrote {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
