@@ -1,0 +1,59 @@
+"""s4u-actor-create replica (reference
+examples/s4u/actor-create/s4u-actor-create.cpp): the three actor
+creation styles — direct create, parameterized, and deployment-file."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_actor_create")
+
+
+def receiver(mailbox_name):
+    mailbox = s4u.Mailbox.by_name(mailbox_name)
+    LOG.info("Hello s4u, I'm ready to get any message you'd want on %s",
+             mailbox.name)
+    msg1 = mailbox.get()
+    msg2 = mailbox.get()
+    msg3 = mailbox.get()
+    LOG.info("I received '%s', '%s' and '%s'", msg1, msg2, msg3)
+    LOG.info("I'm done. See you.")
+
+
+def forwarder(in_name, out_name):
+    in_box = s4u.Mailbox.by_name(in_name)
+    out_box = s4u.Mailbox.by_name(out_name)
+    msg = in_box.get()
+    LOG.info("Forward '%s'.", msg)
+    out_box.put(msg, len(msg))
+
+
+def sender(msg="GaBuZoMeu", mbox="mb42"):
+    LOG.info("Hello s4u, I have something to send")
+    s4u.Mailbox.by_name(mbox).put(msg, len(msg))
+    LOG.info("I'm done. See you.")
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform("/root/reference/examples/platforms/"
+                    "small_platform.xml")
+    s4u.Actor.create("receiver", e.host_by_name("Fafard"),
+                     lambda: receiver("mb42"))
+    s4u.Actor.create("sender1", e.host_by_name("Tremblay"), sender)
+    s4u.Actor.create("sender2", e.host_by_name("Jupiter"),
+                     lambda: sender("GloubiBoulga"))
+    e.register_function("sender", sender)
+    e.register_function("forwarder", forwarder)
+    e.load_deployment("/root/reference/examples/s4u/actor-create/"
+                      "s4u-actor-create_d.xml")
+    e.run()
+
+
+if __name__ == "__main__":
+    main()

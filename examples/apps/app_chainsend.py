@@ -1,0 +1,76 @@
+"""s4u-app-chainsend replica (reference
+examples/s4u/app-chainsend/s4u-app-chainsend.cpp): pipeline broadcast —
+a broadcaster streams file pieces down a chain of peers, each
+forwarding asynchronously to its successor (BASELINE config-#5 family:
+churnless pipelined fleet)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_chainsend")
+
+PIECE_SIZE = 65536
+MESSAGE_BUILD_CHAIN_SIZE = 40
+MESSAGE_SEND_DATA_HEADER_SIZE = 1
+
+
+def peer():
+    me = s4u.Mailbox.by_name(s4u.this_actor.get_host().name)
+    start_time = s4u.Engine.get_clock()
+    # joinChain
+    prev, nxt, total_pieces = me.get()
+    received_bytes = 0
+    received_pieces = 0
+    pending_sends = []
+    # forwardFile
+    while received_pieces < total_pieces:
+        received = me.get()
+        if nxt is not None:
+            pending_sends.append(s4u.Mailbox.by_name(nxt).put_async(
+                received, MESSAGE_SEND_DATA_HEADER_SIZE + PIECE_SIZE))
+        received_pieces += 1
+        received_bytes += PIECE_SIZE
+    s4u.Comm.wait_all(pending_sends)
+    end_time = s4u.Engine.get_clock()
+    LOG.info("### %f %d bytes (Avg %f MB/s); copy finished (simulated).",
+             end_time - start_time, received_bytes,
+             received_bytes / 1024.0 / 1024.0 / (end_time - start_time))
+
+
+def broadcaster(hostcount, piece_count):
+    names = [f"node-{i}.simgrid.org" for i in range(1, hostcount + 1)]
+    # buildChain
+    for i, name in enumerate(names):
+        prev = names[i - 1] if i > 0 else None
+        nxt = names[i + 1] if i < len(names) - 1 else None
+        s4u.Mailbox.by_name(name).put((prev, nxt, piece_count),
+                                      MESSAGE_BUILD_CHAIN_SIZE)
+    # sendFile
+    first = s4u.Mailbox.by_name(names[0])
+    pending = [first.put_async("piece",
+                               MESSAGE_SEND_DATA_HEADER_SIZE + PIECE_SIZE)
+               for _ in range(piece_count)]
+    s4u.Comm.wait_all(pending)
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    s4u.Actor.create("broadcaster",
+                     e.host_by_name("node-0.simgrid.org"),
+                     lambda: broadcaster(8, 256))
+    for i in range(1, 9):
+        s4u.Actor.create("peer",
+                         e.host_by_name(f"node-{i}.simgrid.org"), peer)
+    e.run()
+    LOG.info("Total simulation time: %e", e.clock)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""s4u-async-ready replica (reference
+examples/s4u/async-ready/s4u-async-ready.cpp): permanent receivers +
+Mailbox.ready() polling instead of blocking waits."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_async_ready")
+
+
+def peer(my_id, messages_count, msg_size, peers_count):
+    my_id, messages_count, peers_count = (int(my_id),
+                                          int(messages_count),
+                                          int(peers_count))
+    msg_size = float(msg_size)
+    my_mbox = s4u.Mailbox.by_name(f"peer-{my_id}")
+    my_mbox.set_receiver(s4u.Actor.self())
+
+    pending = []
+    for i in range(messages_count):
+        for peer_id in range(peers_count):
+            if peer_id != my_id:
+                name = f"peer-{peer_id}"
+                msg = f"Message {i} from peer {my_id}"
+                LOG.info("Send '%s' to '%s'", msg, name)
+                pending.append(s4u.Mailbox.by_name(name).put_async(
+                    msg, msg_size))
+    for peer_id in range(peers_count):
+        if peer_id != my_id:
+            pending.append(s4u.Mailbox.by_name(
+                f"peer-{peer_id}").put_async("finalize", msg_size))
+            LOG.info("Send 'finalize' to 'peer-%d'", peer_id)
+    LOG.info("Done dispatching all messages")
+
+    pending_finalize = peers_count - 1
+    while pending_finalize > 0:
+        if my_mbox.ready():
+            received = my_mbox.get()
+            LOG.info("I got a '%s'.", received)
+            if received == "finalize":
+                pending_finalize -= 1
+        else:
+            LOG.info("Nothing ready to consume yet, I better sleep "
+                     "for a while")
+            s4u.this_actor.sleep_for(.01)
+
+    LOG.info("I'm done, just waiting for my peers to receive the "
+             "messages before exiting")
+    s4u.Comm.wait_all(pending)
+    LOG.info("Goodbye now!")
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.register_function("peer", peer)
+    e.load_platform(sys.argv[1])
+    e.load_deployment(sys.argv[2])
+    e.run()
+
+
+if __name__ == "__main__":
+    main()

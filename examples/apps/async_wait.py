@@ -1,0 +1,59 @@
+"""s4u-async-wait replica (reference
+examples/s4u/async-wait/s4u-async-wait.cpp): put_async fan-out, waits
+in reverse creation order."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_async_wait")
+
+
+def sender(messages_count, msg_size, receivers_count):
+    messages_count, receivers_count = int(messages_count), \
+        int(receivers_count)
+    msg_size = float(msg_size)
+    pending = []
+    mboxes = [s4u.Mailbox.by_name(f"receiver-{i}")
+              for i in range(receivers_count)]
+    for i in range(messages_count):
+        content = f"Message {i}"
+        LOG.info("Send '%s' to '%s'", content,
+                 mboxes[i % receivers_count].name)
+        pending.append(mboxes[i % receivers_count].put_async(
+            content, msg_size))
+    for i in range(receivers_count):
+        LOG.info("Send 'finalize' to 'receiver-%d'", i)
+        pending.append(mboxes[i].put_async("finalize", 0))
+    LOG.info("Done dispatching all messages")
+    while pending:
+        pending.pop().wait()
+    LOG.info("Goodbye now!")
+
+
+def receiver(rid):
+    mbox = s4u.Mailbox.by_name(f"receiver-{rid}")
+    LOG.info("Wait for my first message")
+    while True:
+        received = mbox.get()
+        LOG.info("I got a '%s'.", received)
+        if received == "finalize":
+            break
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.register_function("sender", sender)
+    e.register_function("receiver", receiver)
+    e.load_platform(sys.argv[1])
+    e.load_deployment(sys.argv[2])
+    e.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""s4u-async-waituntil replica (reference
+examples/s4u/async-waituntil/s4u-async-wait.cpp): like async-wait but each
+wait is a bounded wait_for(1)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_async_waituntil")
+
+
+def sender(messages_count, msg_size, receivers_count):
+    messages_count, receivers_count = int(messages_count), \
+        int(receivers_count)
+    msg_size = float(msg_size)
+    pending = []
+    mboxes = [s4u.Mailbox.by_name(f"receiver-{i}")
+              for i in range(receivers_count)]
+    for i in range(messages_count):
+        content = f"Message {i}"
+        LOG.info("Send '%s' to '%s'", content,
+                 mboxes[i % receivers_count].name)
+        pending.append(mboxes[i % receivers_count].put_async(
+            content, msg_size))
+    for i in range(receivers_count):
+        pending.append(mboxes[i % receivers_count].put_async(
+            "finalize", 0))
+        LOG.info("Send 'finalize' to 'receiver-%d'", i % receivers_count)
+    LOG.info("Done dispatching all messages")
+    while pending:
+        pending.pop().wait_for(1)
+    LOG.info("Goodbye now!")
+
+
+def receiver(rid):
+    mbox = s4u.Mailbox.by_name(f"receiver-{rid}")
+    LOG.info("Wait for my first message")
+    while True:
+        received = mbox.get()
+        LOG.info("I got a '%s'.", received)
+        if received == "finalize":
+            break
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.register_function("sender", sender)
+    e.register_function("receiver", receiver)
+    e.load_platform(sys.argv[1])
+    e.load_deployment(sys.argv[2])
+    e.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+"""s4u-exec-waitany replica (reference
+examples/s4u/exec-waitany/s4u-exec-waitany.cpp): wait_any /
+wait_any_for over concurrent executions on a multicore host."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+from simgrid_tpu.exceptions import TimeoutException
+
+LOG = xlog.get_category("s4u_exec_waitany")
+
+
+def worker(with_timeout):
+    pending = []
+    for i in range(3):
+        name = f"Exec-{i}"
+        amount = (6 * (i % 2) + i + 1) * \
+            s4u.this_actor.get_host().get_speed()
+        exec_ = s4u.this_actor.exec_init(amount).set_name(name)
+        pending.append(exec_)
+        exec_.start()
+        LOG.info("Activity %s has started for %.0f seconds", name,
+                 amount / s4u.this_actor.get_host().get_speed())
+    while pending:
+        try:
+            if with_timeout:
+                pos = s4u.Exec.wait_any_for(pending, 4)
+            else:
+                pos = s4u.Exec.wait_any(pending)
+        except TimeoutException:
+            pos = -1
+        if pos < 0:
+            LOG.info("Do not wait any longer for an activity")
+            pending.clear()
+        else:
+            LOG.info("Activity '%s' (at position %d) is complete",
+                     pending[pos].name, pos)
+            del pending[pos]
+        LOG.info("%d activities remain pending", len(pending))
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    s4u.Actor.create("worker", e.host_by_name("Tremblay"),
+                     lambda: worker(False))
+    s4u.Actor.create("worker_timeout", e.host_by_name("Tremblay"),
+                     lambda: worker(True))
+    e.run()
+
+
+if __name__ == "__main__":
+    main()

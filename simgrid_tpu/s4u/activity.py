@@ -355,6 +355,16 @@ class Exec(Activity):
         self.state = ActivityState.CANCELED
         return self
 
+    @staticmethod
+    def wait_any(execs: List["Exec"]) -> int:
+        """Index of the first completed execution (s4u::Exec::wait_any)."""
+        return Activity.wait_any_of(list(execs))
+
+    @staticmethod
+    def wait_any_for(execs: List["Exec"], timeout: float) -> int:
+        """wait_any with a timeout; -1 when it expires."""
+        return Activity.wait_any_of(list(execs), timeout)
+
     def get_remaining(self) -> float:
         return self.pimpl.get_remaining() if self.pimpl else 0.0
 

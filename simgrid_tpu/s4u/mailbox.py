@@ -81,9 +81,14 @@ class Mailbox:
         return bool(self.pimpl.comm_queue) or bool(self.pimpl.done_comm_queue)
 
     def ready(self) -> bool:
-        """True if a completed comm is deliverable right now."""
+        """True if a completed comm is deliverable right now
+        (reference s4u_Mailbox.cpp:47-56 — the permanent-receiver mode
+        checks the done queue)."""
         if self.pimpl.comm_queue:
             return self.pimpl.comm_queue[0].state == kact.State.DONE
+        if self.pimpl.permanent_receiver is not None and \
+                self.pimpl.done_comm_queue:
+            return self.pimpl.done_comm_queue[0].state == kact.State.DONE
         return False
 
     def set_receiver(self, actor) -> None:

@@ -123,8 +123,16 @@ def render_layout(fmt: str, category: str, level_name: str,
             pid, aname, hname = (actor_info_getter()
                                  if actor_info_getter
                                  else (0, "maestro", ""))
-            out.append(str(pid) if code == "i"
-                       else aname if code == "P" else hname)
+            val = (str(pid) if code == "i"
+                   else aname if code == "P" else hname)
+            if spec:
+                # printf width spec, e.g. %14P right-pads like the
+                # reference's xbt_log layout (exec-waitany oracle)
+                try:
+                    val = ("%" + spec + "s") % val
+                except (ValueError, TypeError):
+                    pass
+            out.append(val)
         elif code == "%":
             out.append("%")
         else:

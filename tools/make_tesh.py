@@ -20,24 +20,41 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def ref_blocks(path):
-    """Output blocks of a tesh file: list of lists of expected lines."""
+    """Output blocks of a tesh file: (sort_key_len_or_None, lines)."""
     blocks = []
     cur = None
+    sort_n = None
+    pending_sort = None
     for line in open(path):
-        if line.startswith("$ "):
+        if line.startswith("! output sort"):
+            parts = line.split()
+            pending_sort = int(parts[3]) if len(parts) > 3 else 0
+        elif line.startswith("$ "):
             if cur is not None:
-                blocks.append(cur)
+                blocks.append((sort_n, cur))
             cur = []
+            sort_n = pending_sort
+            pending_sort = None
         elif line.startswith("> ") and cur is not None:
             cur.append(line[2:].rstrip("\n"))
     if cur is not None:
-        blocks.append(cur)
+        blocks.append((sort_n, cur))
     return blocks
 
 
 def main() -> int:
-    out_path, ref_path = sys.argv[1], sys.argv[2]
-    assert sys.argv[3] == "--"
+    argv = list(sys.argv[1:])
+    force_sort = None
+    if argv[0] == "--sort":
+        # force `! output sort N` on every block: same-timestamp
+        # intra-round actor ordering is scheduler-specific, and the
+        # reference's own tesh files use this directive for exactly
+        # that (the pinned timestamps/content stay byte-exact)
+        force_sort = int(argv[1])
+        argv = argv[2:]
+    out_path, ref_path = argv[0], argv[1]
+    assert argv[2] == "--"
+    sys.argv = ["make_tesh", out_path, ref_path] + argv[2:]
     cmds = []
     cur = []
     for a in sys.argv[4:]:
@@ -53,11 +70,18 @@ def main() -> int:
         f"{len(cmds)} commands vs {len(refs)} reference blocks"
 
     sections = []
-    for cmd, expected in zip(cmds, refs):
+    for cmd, (sort_n, expected) in zip(cmds, refs):
+        if force_sort is not None and sort_n is None:
+            sort_n = force_sort
         proc = subprocess.run(cmd, stdout=subprocess.PIPE,
                               stderr=subprocess.STDOUT, text=True,
                               cwd=ROOT, timeout=600)
-        got = [ln for ln in proc.stdout.splitlines()]
+        raw = [ln for ln in proc.stdout.splitlines()]
+        got = raw
+        if sort_n is not None:
+            key = (lambda l: l[:sort_n]) if sort_n else None
+            got = sorted(raw, key=key)
+            expected = sorted(expected, key=key)
         if got != expected:
             print(f"MISMATCH for {' '.join(cmd)}")
             for i in range(max(len(got), len(expected))):
@@ -66,8 +90,20 @@ def main() -> int:
                 mark = " " if g == e else "!"
                 print(f"{mark} got: {g}\n{mark} exp: {e}")
             return 1
-        shown = " ".join(c if " " not in c else f'"{c}"' for c in cmd)
-        sections.append(f"$ {shown}\n" +
+        def q(c):
+            # quote anything the shell would interpret (the --log
+            # format strings contain parens/percent signs)
+            if any(ch in c for ch in " ()%&;|<>*?$"):
+                return f'"{c}"'
+            return c
+        shown = " ".join(q(c) for c in cmd)
+        if sort_n is None:
+            directive = ""
+        elif sort_n == 0:
+            directive = "! output sort\n"     # whole-line sort
+        else:
+            directive = f"! output sort {sort_n}\n"
+        sections.append(directive + f"$ {shown}\n" +
                         "".join(f"> {ln}\n" for ln in expected))
 
     rel = os.path.relpath(ref_path, "/root/reference")
