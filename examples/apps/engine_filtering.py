@@ -1,0 +1,68 @@
+"""s4u-engine-filtering replica (reference
+examples/s4u/engine-filtering/s4u-engine-filtering.cpp): filter hosts
+with predicates — plain functions, stateless and stateful functors."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_engine_filtering")
+
+
+def filter_speed_more_than_50mf(host):
+    return host.get_speed() > 50e6
+
+
+class SingleCore:
+    def __call__(self, host):
+        return host.get_core_count() == 1
+
+
+class FrequencyChanged:
+    def __init__(self, e):
+        self.host_list = {host: host.get_pstate()
+                          for host in e.get_all_hosts()}
+
+    def __call__(self, host):
+        return host.get_pstate() != self.host_list[host]
+
+    def get_old_speed(self, host):
+        return self.host_list[host]
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    LOG.info("Hosts currently registered with this engine: %d",
+             e.get_host_count())
+    hosts = [h for h in e.get_all_hosts() if h.get_core_count() > 1]
+    for host in hosts:
+        LOG.info("The following hosts have more than one core: %s",
+                 host.name)
+    assert len(hosts) == 1
+
+    for host in filter(SingleCore(), e.get_all_hosts()):
+        LOG.info("The following hosts are SingleCore: %s", host.name)
+
+    LOG.info("A simple example: Let's retrieve all hosts that changed "
+             "their frequency")
+    freq_filter = FrequencyChanged(e)
+    e.host_by_name("MyHost2").set_pstate(2)
+    for host in filter(freq_filter, e.get_all_hosts()):
+        LOG.info("The following hosts changed their frequency: %s "
+                 "(from %.1ff to %.1ff)", host.name,
+                 host.get_pstate_speed(freq_filter.get_old_speed(host)),
+                 host.get_speed())
+
+    for host in filter(filter_speed_more_than_50mf, e.get_all_hosts()):
+        LOG.info("The following hosts have a frequency > 50Mf: %s",
+                 host.name)
+
+
+if __name__ == "__main__":
+    main()

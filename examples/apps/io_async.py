@@ -1,0 +1,50 @@
+"""s4u-io-async replica (reference
+examples/s4u/io-async/s4u-io-async.cpp): async storage reads and a
+cancelled write."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_test")
+
+
+def test(size):
+    e = s4u.Engine.get_instance()
+    storage = e.pimpl.storages["Disk1"]
+    LOG.info("Hello! read %d bytes from Storage %s", size, storage.name)
+    activity = s4u.Io(storage, size, s4u.Io.OpType.READ)
+    activity.start()
+    activity.wait()
+    LOG.info("Goodbye now!")
+
+
+def test_cancel(size):
+    e = s4u.Engine.get_instance()
+    storage = e.pimpl.storages["Disk2"]
+    LOG.info("Hello! write %d bytes from Storage %s", size, storage.name)
+    activity = s4u.Io(storage, size, s4u.Io.OpType.WRITE)
+    activity.start()
+    s4u.this_actor.sleep_for(0.5)
+    LOG.info("I changed my mind, cancel!")
+    activity.cancel()
+    LOG.info("Goodbye now!")
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    s4u.Actor.create("test", e.host_by_name("bob"), lambda: test(int(2e7)))
+    s4u.Actor.create("test_cancel", e.host_by_name("alice"),
+                     lambda: test_cancel(int(5e7)))
+    e.run()
+    LOG.info("Simulation time %g", e.clock)
+
+
+if __name__ == "__main__":
+    main()

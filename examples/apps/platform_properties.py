@@ -1,0 +1,90 @@
+"""s4u-platform-properties replica (reference
+examples/s4u/platform-properties/s4u-platform-properties.cpp): host,
+zone, and actor properties from the platform/deployment XML."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("s4u_test")
+
+
+def test_host(hostname):
+    e = s4u.Engine.get_instance()
+    thehost = e.host_by_name(hostname)
+    props = thehost.properties
+    LOG.info("== Print the properties of the host '%s'", hostname)
+    for key in sorted(props):
+        LOG.info("  Host property: '%s' -> '%s'", key, props[key])
+    LOG.info("== Try to get a host property that does not exist")
+    assert props.get("Unknown") is None
+    LOG.info("== Try to get a host property that does exist")
+    value = props.get("Hdd")
+    assert value == "180"
+    LOG.info("   Property: Hdd old value: %s", value)
+    LOG.info("== Trying to modify a host property")
+    props["Hdd"] = "250"
+    value = props.get("Hdd")
+    assert value == "250"
+    LOG.info("   Property: Hdd old value: %s", value)
+    props["Hdd"] = "180"
+    zone = thehost.netpoint.englobing_zone
+    LOG.info("== Print the properties of the zone '%s' that contains "
+             "'%s'", zone.name, hostname)
+    for key in sorted(zone.properties):
+        LOG.info("  Zone property: '%s' -> '%s'", key,
+                 zone.properties[key])
+
+
+def alice():
+    test_host("host1")
+
+
+def carole():
+    s4u.this_actor.sleep_for(1)
+    test_host("host1")
+
+
+def david():
+    s4u.this_actor.sleep_for(2)
+    test_host("node-0.simgrid.org")
+
+
+def bob():
+    root = s4u.Engine.get_instance().get_netzone_root()
+    LOG.info("== Print the properties of the root zone")
+    LOG.info("   Zone property: filename -> %s",
+             root.properties.get("filename"))
+    LOG.info("   Zone property: date -> %s", root.properties.get("date"))
+    LOG.info("   Zone property: author -> %s",
+             root.properties.get("author"))
+    props = s4u.Actor.self().get_properties()
+    LOG.info("== Print the properties of the actor")
+    for k, v in props.items():
+        LOG.info("   Actor property: %s -> %s", k, v)
+    LOG.info("== Try to get an actor property that does not exist")
+    assert props.get("UnknownProcessProp") is None
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    e.register_function("alice", alice)
+    e.register_function("bob", bob)
+    e.register_function("carole", carole)
+    e.register_function("david", david)
+    LOG.info("There are %d hosts in the environment", e.get_host_count())
+    for host in e.get_all_hosts():
+        LOG.info("Host '%s' runs at %.0f flops/s", host.name,
+                 host.get_speed())
+    e.load_deployment(sys.argv[2])
+    e.run()
+
+
+if __name__ == "__main__":
+    main()

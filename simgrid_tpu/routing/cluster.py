@@ -183,8 +183,13 @@ def parse_cluster_tag(loader, elem, father) -> None:
 
     # cluster-level <prop> entries are copied onto every created host
     # (sg_platf.cpp:70-78; energy_cluster.xml sets watt_per_state here)
+    # AND kept on the cluster's own NetZone (the reference attaches
+    # them to the zone too — platform-properties oracle reads them via
+    # get_englobing_zone()->get_properties())
     cluster_props = {child.get("id"): child.get("value")
                      for child in elem if child.tag == "prop"}
+    if cluster_props:
+        zone.properties.update(cluster_props)
 
     ids = parse_radical(radical)
     for rank, node_id in enumerate(ids):

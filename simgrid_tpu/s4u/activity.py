@@ -422,5 +422,18 @@ class Io(Activity):
         self.state = ActivityState.FINISHED
         return self
 
+    def cancel(self) -> "Io":
+        from .actor import _current_impl
+        issuer = _current_impl()
+        io_impl = self.pimpl
+
+        def handler(sc):
+            if io_impl is not None:
+                io_impl.cancel()
+            sc.issuer.simcall_answer()
+        issuer.simcall("io_cancel", handler)
+        self.state = ActivityState.CANCELED
+        return self
+
     def get_performed_ioops(self) -> float:
         return self.pimpl.performed_ioops if self.pimpl else 0.0

@@ -140,6 +140,8 @@ class Engine:
             assert code is not None, f"Function '{func_name}' unknown"
             args = [child.get("value") for child in elem
                     if child.tag == "argument"]
+            props = {child.get("id"): child.get("value")
+                     for child in elem if child.tag == "prop"}
             start_time = float(elem.get("start_time", "0"))
             kill_time = float(elem.get("kill_time", "-1"))
             on_failure = elem.get("on_failure", "DIE")
@@ -153,7 +155,8 @@ class Engine:
                  "kill_time": kill_time, "auto_restart": auto_restart})
 
             def launch(code=code, args=args, host=host, name=func_name,
-                       kill_time=kill_time, auto_restart=auto_restart):
+                       kill_time=kill_time, auto_restart=auto_restart,
+                       props=props):
                 if not host.is_on():
                     # ActorImpl::start + sg_platf's catch around it;
                     # the failed creation still consumed a PID (the
@@ -167,6 +170,8 @@ class Engine:
                         "Hosts ... nevermind.")
                     return None
                 actor = Actor.create(name, host, code, *args)
+                if props:
+                    actor.pimpl.properties.update(props)
                 if kill_time >= 0:
                     actor.set_kill_time(kill_time)
                 if auto_restart:
