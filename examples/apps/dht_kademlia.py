@@ -1,0 +1,306 @@
+"""s4u-dht-kademlia replica (reference
+examples/s4u/dht-kademlia/: node.cpp, routing_table.cpp, answer.cpp,
+s4u-dht-kademlia.cpp): the Kademlia DHT — XOR-metric routing tables,
+iterative FIND_NODE lookups with ALPHA parallelism, periodic random
+lookups until a deadline (BASELINE config #5 family: churny DHT
+fleet)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+
+LOG = xlog.get_category("kademlia")
+
+FIND_NODE_TIMEOUT = 10.0
+FIND_NODE_GLOBAL_TIMEOUT = 50.0
+KADEMLIA_ALPHA = 3
+BUCKET_SIZE = 20
+IDENTIFIER_SIZE = 32
+RANDOM_LOOKUP_INTERVAL = 100.0
+MAX_STEPS = 10
+JOIN_BUCKETS_QUERIES = 5
+RANDOM_LOOKUP_NODE = 0
+
+
+def get_id_in_prefix(node_id, prefix):
+    if prefix == 0:
+        return 0
+    return (1 << (prefix - 1)) ^ node_id
+
+
+def get_node_prefix(node_id, nb_bits):
+    size = 32
+    for j in range(size):
+        if (node_id >> (size - 1 - j)) & 0x1:
+            return nb_bits - j
+    return 0
+
+
+class Answer:
+    """Sorted closest-node list for one destination (answer.cpp)."""
+
+    def __init__(self, destination_id):
+        self.destination_id = destination_id
+        self.nodes = []        # (id, distance) pairs
+
+    def size(self):
+        return len(self.nodes)
+
+    def add_bucket(self, bucket):
+        for nid in bucket.nodes:
+            self.nodes.append((nid, nid ^ self.destination_id))
+
+    def merge(self, other):
+        if other is self:
+            return 0
+        added = 0
+        for contact in other.nodes:
+            if contact not in self.nodes:
+                self.nodes.append(contact)
+                added += 1
+        self.nodes.sort(key=lambda c: c[1])
+        self.trim()
+        return added
+
+    def trim(self):
+        del self.nodes[BUCKET_SIZE:]
+
+    def destination_found(self):
+        return bool(self.nodes) and self.nodes[0][1] == 0
+
+
+class Bucket:
+    def __init__(self, bucket_id):
+        self.id = bucket_id
+        self.nodes = []        # most-recent first
+
+
+class RoutingTable:
+    def __init__(self, node_id):
+        self.id = node_id
+        self.buckets = [Bucket(i) for i in range(IDENTIFIER_SIZE + 1)]
+
+    def find_bucket(self, node_id):
+        prefix = get_node_prefix(self.id ^ node_id, IDENTIFIER_SIZE)
+        return self.buckets[prefix]
+
+
+class Message:
+    def __init__(self, sender_id, destination_id, answer, answer_to,
+                 issuer_host_name):
+        self.sender_id = sender_id
+        self.destination_id = destination_id
+        self.answer = answer
+        self.answer_to = answer_to       # mailbox NAME to reply to
+        self.issuer_host_name = issuer_host_name
+
+
+class Node:
+    def __init__(self, node_id):
+        self.id = node_id
+        self.table = RoutingTable(node_id)
+        self.find_node_success = 0
+        self.find_node_failed = 0
+        self.receive_comm = None
+
+    # -- routing table ------------------------------------------------
+    def routing_table_update(self, node_id):
+        bucket = self.table.find_bucket(node_id)
+        if node_id not in bucket.nodes:
+            if len(bucket.nodes) >= BUCKET_SIZE:
+                bucket.nodes.pop()
+            bucket.nodes.insert(0, node_id)
+        else:
+            bucket.nodes.remove(node_id)
+            bucket.nodes.insert(0, node_id)
+
+    def find_closest(self, destination_id):
+        answer = Answer(destination_id)
+        bucket = self.table.find_bucket(destination_id)
+        bucket_id = bucket.id
+        answer.add_bucket(bucket)
+        i = 1
+        while answer.size() < BUCKET_SIZE and \
+                (bucket_id - i > 0 or bucket_id + i < IDENTIFIER_SIZE):
+            if bucket_id - i >= 0:
+                answer.add_bucket(self.table.buckets[bucket_id - i])
+            if bucket_id + i <= IDENTIFIER_SIZE:
+                answer.add_bucket(self.table.buckets[bucket_id + i])
+            i += 1
+        answer.nodes.sort(key=lambda c: c[1])
+        answer.trim()
+        return answer
+
+    # -- messaging ----------------------------------------------------
+    def send_find_node(self, node_id, destination):
+        mailbox = s4u.Mailbox.by_name(str(node_id))
+        msg = Message(self.id, destination, None, str(self.id),
+                      s4u.this_actor.get_host().name)
+        mailbox.put_init(msg, 1).detach()
+
+    def send_find_node_to_best(self, node_list):
+        i = j = 0
+        destination = node_list.destination_id
+        for node_to_query, _dist in node_list.nodes:
+            if node_to_query != self.id:
+                self.send_find_node(node_to_query, destination)
+                j += 1
+            i += 1
+            if j == KADEMLIA_ALPHA:
+                break
+        return i
+
+    def handle_find_node(self, msg):
+        self.routing_table_update(msg.sender_id)
+        answer = Message(self.id, msg.destination_id,
+                         self.find_closest(msg.destination_id),
+                         str(self.id),
+                         s4u.this_actor.get_host().name)
+        s4u.Mailbox.by_name(msg.answer_to).put_init(answer, 1).detach()
+
+    # -- lookups ------------------------------------------------------
+    def find_node(self, id_to_find, count_in_stats):
+        e = s4u.Engine.get_instance()
+        destination_found = False
+        nodes_added = 0
+        global_timeout = e.clock + FIND_NODE_GLOBAL_TIMEOUT
+        steps = 0
+        node_list = self.find_closest(id_to_find)
+        mailbox = s4u.Mailbox.by_name(str(self.id))
+        while True:
+            answers = 0
+            queries = self.send_find_node_to_best(node_list)
+            nodes_added = 0
+            timeout = e.clock + FIND_NODE_TIMEOUT
+            steps += 1
+            time_beginreceive = e.clock
+            while True:
+                if self.receive_comm is None:
+                    self.receive_comm = mailbox.get_async()
+                if self.receive_comm.test():
+                    msg = self.receive_comm.get_payload()
+                    if msg.answer is not None and \
+                            msg.answer.destination_id == id_to_find:
+                        self.routing_table_update(msg.sender_id)
+                        for contact, _d in node_list.nodes:
+                            self.routing_table_update(contact)
+                        answers += 1
+                        nodes_added = node_list.merge(msg.answer)
+                    elif msg.answer is not None:
+                        self.routing_table_update(msg.sender_id)
+                    else:
+                        self.handle_find_node(msg)
+                        timeout += e.clock - time_beginreceive
+                        time_beginreceive = e.clock
+                    self.receive_comm = None
+                else:
+                    s4u.this_actor.sleep_for(1)
+                if not (e.clock < timeout and answers < queries):
+                    break
+            destination_found = node_list.destination_found()
+            if not (not destination_found
+                    and (nodes_added > 0 or answers == 0)
+                    and e.clock < global_timeout and steps < MAX_STEPS):
+                break
+        if destination_found:
+            if count_in_stats:
+                self.find_node_success += 1
+            self.routing_table_update(id_to_find)
+        elif count_in_stats:
+            self.find_node_failed += 1
+        return destination_found
+
+    def random_lookup(self):
+        self.find_node(RANDOM_LOOKUP_NODE, True)
+
+    def join(self, known_id):
+        e = s4u.Engine.get_instance()
+        got_answer = False
+        self.routing_table_update(self.id)
+        self.routing_table_update(known_id)
+        self.send_find_node(known_id, self.id)
+        mailbox = s4u.Mailbox.by_name(str(self.id))
+        while not got_answer:
+            if self.receive_comm is None:
+                self.receive_comm = mailbox.get_async()
+            if self.receive_comm.test():
+                msg = self.receive_comm.get_payload()
+                if msg.answer is not None:
+                    got_answer = True
+                    for contact, _d in msg.answer.nodes:
+                        self.routing_table_update(contact)
+                else:
+                    self.handle_find_node(msg)
+                self.receive_comm = None
+            else:
+                s4u.this_actor.sleep_for(1)
+
+        bucket_id = self.table.find_bucket(known_id).id
+        i = 0
+        while (bucket_id > i or bucket_id + i <= IDENTIFIER_SIZE) and \
+                i < JOIN_BUCKETS_QUERIES:
+            if bucket_id > i:
+                self.find_node(get_id_in_prefix(self.id, bucket_id - i),
+                               False)
+            if bucket_id + i <= IDENTIFIER_SIZE:
+                self.find_node(get_id_in_prefix(self.id, bucket_id + i),
+                               False)
+            i += 1
+        return got_answer
+
+
+def node(*args):
+    e = s4u.Engine.get_instance()
+    join_success = True
+    node_id = int(args[0], 0)
+    n = Node(node_id)
+    if len(args) == 3:
+        LOG.info("Hi, I'm going to join the network with id %u", n.id)
+        known_id = int(args[1], 0)
+        join_success = n.join(known_id)
+        deadline = float(args[2])
+    else:
+        deadline = float(args[1])
+        LOG.info("Hi, I'm going to create the network with id %u", n.id)
+        n.routing_table_update(n.id)
+
+    if join_success:
+        next_lookup_time = e.clock + RANDOM_LOOKUP_INTERVAL
+        mailbox = s4u.Mailbox.by_name(str(n.id))
+        while e.clock < deadline:
+            if n.receive_comm is None:
+                n.receive_comm = mailbox.get_async()
+            if n.receive_comm.test():
+                msg = n.receive_comm.get_payload()
+                if msg is not None:
+                    n.handle_find_node(msg)
+                    n.receive_comm = None
+                else:
+                    s4u.this_actor.sleep_for(1)
+            elif e.clock >= next_lookup_time:
+                n.random_lookup()
+                next_lookup_time += RANDOM_LOOKUP_INTERVAL
+            else:
+                s4u.this_actor.sleep_for(1)
+    else:
+        LOG.info("I couldn't join the network :(")
+    LOG.info("%u/%u FIND_NODE have succeeded", n.find_node_success,
+             n.find_node_success + n.find_node_failed)
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    e.register_function("node", node)
+    e.load_deployment(sys.argv[2])
+    e.run()
+    LOG.info("Simulated time: %g", e.clock)
+
+
+if __name__ == "__main__":
+    main()

@@ -97,11 +97,17 @@ class DrainSim:
 
     def __init__(self, e_var, e_cnst, e_w, c_bound, sizes,
                  eps: float = 1e-5, done_eps: float = 1e-4,
-                 dtype=np.float32, solve_chunk: int = 64,
+                 dtype=np.float32, solve_chunk: int = 0,
                  repack_at: float = 0.5, device=None):
         self.eps = float(eps)
         self.done_eps = float(done_eps)
         self.dtype = np.dtype(dtype)
+        if not solve_chunk:
+            # bound per-dispatch kernel time: big-system rounds cost
+            # ~100-150 ms of device time and the axon watchdog kills
+            # kernels in the ~10 s range (observed: a 64-round chunk at
+            # 1.24M elements hangs the worker)
+            solve_chunk = 16 if len(e_var) >= 1 << 20 else 64
         self.solve_chunk = int(solve_chunk)
         self.repack_at = float(repack_at)
         self.device = device

@@ -122,8 +122,13 @@ class Comm(Activity):
         return self
 
     def detach(self) -> "Comm":
+        assert self.state == ActivityState.INITED, \
+            "You cannot use detach() once your communication started"
         self.detached_ = True
-        return self
+        # the reference's Comm::detach STARTS the communication
+        # (s4u_Comm.cpp:192-198): fire-and-forget sends go on the wire
+        # immediately
+        return self.start()
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "Comm":
