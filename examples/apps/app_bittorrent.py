@@ -1,0 +1,488 @@
+"""s4u-app-bittorrent replica (reference
+examples/s4u/app-bittorrent/: s4u-bittorrent.cpp, s4u-tracker.cpp,
+s4u-peer.cpp): the BitTorrent protocol — tracker-mediated peer
+discovery, handshake/bitfield exchange, choke/unchoke rounds
+(optimistic + fastest-download policies), rarest-first and end-game
+piece selection (BASELINE config #5 family: churn-heavy fleet)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from simgrid_tpu import s4u
+from simgrid_tpu.utils import log as xlog
+from simgrid_tpu.utils.rngstream import RngStream
+from simgrid_tpu.exceptions import TimeoutException
+
+TRACKER_LOG = xlog.get_category("s4u_bt_tracker")
+PEER_LOG = xlog.get_category("s4u_bt_peer")
+
+TRACKER_MAILBOX = "tracker_mailbox"
+MAXIMUM_PEERS = 50
+TRACKER_QUERY_INTERVAL = 1000
+TRACKER_COMM_SIZE = 1
+GET_PEERS_TIMEOUT = 10000.0
+UPDATE_CHOKED_INTERVAL = 30
+
+MESSAGE_SIZES = dict(HANDSHAKE=68, CHOKE=5, UNCHOKE=5, INTERESTED=5,
+                     NOTINTERESTED=5, HAVE=9, BITFIELD=5, REQUEST=17,
+                     PIECE=13, CANCEL=17)
+
+FILE_PIECES = 10
+PIECES_BLOCKS = 5
+BLOCK_SIZE = 16384
+BLOCKS_REQUESTED = 2
+ENABLE_END_GAME_MODE = True
+SLEEP_DURATION = 1.0
+
+#: per-host RngStreams, created in host order like the reference's
+#: extension install loop (s4u-bittorrent.cpp:24-26)
+_HOST_STREAMS = {}
+
+
+def install_streams(engine):
+    for host in engine.get_all_hosts():
+        _HOST_STREAMS[host.name] = RngStream(f"RngSream<{host.name}>")
+
+
+def my_stream():
+    return _HOST_STREAMS[s4u.this_actor.get_host().name]
+
+
+class Message:
+    def __init__(self, type_, peer_id, return_mailbox, bitfield=0,
+                 piece=0, block_index=0, block_length=0):
+        self.type = type_
+        self.peer_id = peer_id
+        self.return_mailbox = return_mailbox    # mailbox NAME
+        self.bitfield = bitfield
+        self.piece = piece
+        self.block_index = block_index
+        self.block_length = block_length
+
+
+def tracker(deadline):
+    deadline = float(deadline)
+    stream = my_stream()
+    e = s4u.Engine.get_instance()
+    mailbox = s4u.Mailbox.by_name(TRACKER_MAILBOX)
+    known_peers = []
+    TRACKER_LOG.info("Tracker launched.")
+    comm = None
+    while e.clock < deadline:
+        if comm is None:
+            comm = mailbox.get_async()
+        if comm.test():
+            peer_id, return_mailbox = comm.get_payload()
+            if peer_id not in known_peers:
+                known_peers.append(peer_id)
+            answer = set()
+            max_tries = min(MAXIMUM_PEERS, len(known_peers))
+            tried = 0
+            while tried < max_tries:
+                while True:
+                    nxt = known_peers[stream.rand_int(
+                        0, len(known_peers) - 1)]
+                    if nxt not in answer:
+                        break
+                answer.add(nxt)
+                tried += 1
+            s4u.Mailbox.by_name(return_mailbox).put_init(
+                sorted(answer), TRACKER_COMM_SIZE).detach()
+            comm = None
+        else:
+            s4u.this_actor.sleep_for(1)
+    TRACKER_LOG.info("Tracker is leaving")
+
+
+class Connection:
+    def __init__(self, peer_id):
+        self.id = peer_id
+        self.mailbox = str(peer_id)
+        self.bitfield = 0
+        self.peer_speed = 0.0
+        self.last_unchoke = 0.0
+        self.current_piece = -1
+        self.am_interested = False
+        self.interested = False
+        self.choked_upload = True
+        self.choked_download = True
+
+    def add_speed_value(self, speed):
+        self.peer_speed = self.peer_speed * 0.6 + speed * 0.4
+
+    def has_piece(self, piece):
+        return bool(self.bitfield & (1 << piece))
+
+
+class Peer:
+    def __init__(self, args):
+        self.id = int(args[0])
+        self.mailbox = s4u.Mailbox.by_name(str(self.id))
+        self.deadline = float(args[1])
+        self.stream = my_stream()
+        self.bitfield = 0
+        self.bitfield_blocks = 0
+        if len(args) == 3 and args[2] == "1":
+            self.bitfield = (1 << FILE_PIECES) - 1
+            self.bitfield_blocks = (1 << (FILE_PIECES *
+                                          PIECES_BLOCKS)) - 1
+        self.pieces_count = [0] * FILE_PIECES
+        self.connected_peers = {}
+        self.active_peers = []
+        self.current_pieces = 0
+        self.begin_receive_time = 0.0
+        self.round = 0
+        self.comm_received = None
+        PEER_LOG.info("Hi, I'm joining the network with id %d", self.id)
+
+    # -- helpers ------------------------------------------------------
+    def get_status(self):
+        return "".join("1" if self.bitfield & (1 << i) else "0"
+                       for i in range(FILE_PIECES - 1, -1, -1))
+
+    def has_finished(self):
+        return self.bitfield == (1 << FILE_PIECES) - 1
+
+    def has_not_piece(self, piece):
+        return not (self.bitfield & (1 << piece))
+
+    def is_not_downloading_piece(self, piece):
+        return not (self.current_pieces & (1 << piece))
+
+    def is_interested_by(self, rp):
+        return bool(rp.bitfield & (self.bitfield ^
+                                   ((1 << FILE_PIECES) - 1)))
+
+    def is_interested_by_free(self, rp):
+        return any(self.has_not_piece(i) and rp.has_piece(i)
+                   and self.is_not_downloading_piece(i)
+                   for i in range(FILE_PIECES))
+
+    @staticmethod
+    def count_pieces(bitfield):
+        return bin(bitfield).count("1")
+
+    def nb_interested_peers(self):
+        return sum(1 for c in self.connected_peers.values()
+                   if c.interested)
+
+    def update_pieces_count_from_bitfield(self, bitfield):
+        for i in range(FILE_PIECES):
+            if bitfield & (1 << i):
+                self.pieces_count[i] += 1
+
+    # -- block bookkeeping -------------------------------------------
+    def update_bitfield_blocks(self, piece, block_index, block_length):
+        for i in range(block_index, block_index + block_length):
+            self.bitfield_blocks |= 1 << (piece * PIECES_BLOCKS + i)
+
+    def has_completed_piece(self, piece):
+        return all(self.bitfield_blocks &
+                   (1 << (piece * PIECES_BLOCKS + i))
+                   for i in range(PIECES_BLOCKS))
+
+    def get_first_missing_block_from(self, piece):
+        for i in range(PIECES_BLOCKS):
+            if not (self.bitfield_blocks &
+                    (1 << (piece * PIECES_BLOCKS + i))):
+                return i
+        return -1
+
+    def partially_downloaded_piece(self, rp):
+        for i in range(FILE_PIECES):
+            if self.has_not_piece(i) and rp.has_piece(i) and \
+                    self.is_not_downloading_piece(i) and \
+                    self.get_first_missing_block_from(i) > 0:
+                return i
+        return -1
+
+    # -- sending ------------------------------------------------------
+    def send_message(self, mailbox_name, type_, size):
+        s4u.Mailbox.by_name(mailbox_name).put_init(
+            Message(type_, self.id, str(self.id),
+                    bitfield=self.bitfield), size).detach()
+
+    def send_bitfield(self, mailbox_name):
+        s4u.Mailbox.by_name(mailbox_name).put_init(
+            Message("BITFIELD", self.id, str(self.id),
+                    bitfield=self.bitfield),
+            MESSAGE_SIZES["BITFIELD"] + 1).detach()
+
+    def send_piece(self, mailbox_name, piece, block_index, block_length):
+        s4u.Mailbox.by_name(mailbox_name).put_init(
+            Message("PIECE", self.id, str(self.id), piece=piece,
+                    block_index=block_index,
+                    block_length=block_length), BLOCK_SIZE).detach()
+
+    def send_handshake_to_all_peers(self):
+        for rp in self.connected_peers.values():
+            s4u.Mailbox.by_name(rp.mailbox).put_init(
+                Message("HANDSHAKE", self.id, str(self.id)),
+                MESSAGE_SIZES["HANDSHAKE"]).detach()
+
+    def send_have_to_all_peers(self, piece):
+        for rp in self.connected_peers.values():
+            s4u.Mailbox.by_name(rp.mailbox).put_init(
+                Message("HAVE", self.id, str(self.id), piece=piece),
+                MESSAGE_SIZES["HAVE"]).detach()
+
+    def send_request_to(self, rp, piece):
+        rp.current_piece = piece
+        block_index = self.get_first_missing_block_from(piece)
+        if block_index != -1:
+            block_length = min(BLOCKS_REQUESTED,
+                               PIECES_BLOCKS - block_index)
+            s4u.Mailbox.by_name(rp.mailbox).put_init(
+                Message("REQUEST", self.id, str(self.id), piece=piece,
+                        block_index=block_index,
+                        block_length=block_length),
+                MESSAGE_SIZES["REQUEST"]).detach()
+
+    # -- tracker ------------------------------------------------------
+    def get_peers_from_tracker(self):
+        tracker_mb = s4u.Mailbox.by_name(TRACKER_MAILBOX)
+        try:
+            tracker_mb.put((self.id, str(self.id)), TRACKER_COMM_SIZE,
+                           GET_PEERS_TIMEOUT)
+        except TimeoutException:
+            return False
+        try:
+            answer = self.mailbox.get(GET_PEERS_TIMEOUT)
+        except TimeoutException:
+            return False
+        for peer_id in answer:
+            if peer_id != self.id:
+                self.connected_peers[peer_id] = Connection(peer_id)
+        return True
+
+    # -- choking ------------------------------------------------------
+    def update_active_peers_set(self, rp):
+        if rp.interested and not rp.choked_upload:
+            if rp not in self.active_peers:
+                self.active_peers.append(rp)
+        elif rp in self.active_peers:
+            self.active_peers.remove(rp)
+
+    def update_choked_peers(self):
+        e = s4u.Engine.get_instance()
+        if self.nb_interested_peers() == 0:
+            return
+        self.round = (self.round + 1) % 3
+        chosen = None
+        choked = self.active_peers.pop(0) if self.active_peers else None
+
+        if self.has_finished():
+            unchoke_time = e.clock + 1
+            for rp in self.connected_peers.values():
+                if rp.last_unchoke < unchoke_time and rp.interested \
+                        and rp.choked_upload:
+                    unchoke_time = rp.last_unchoke
+                    chosen = rp
+        elif self.round == 0:
+            keys = list(self.connected_peers)
+            for _ in range(MAXIMUM_PEERS):
+                cand = self.connected_peers[keys[self.stream.rand_int(
+                    0, len(keys) - 1)]]
+                if cand.interested and cand.choked_upload:
+                    chosen = cand
+                    break
+        else:
+            fastest = 0.0
+            for rp in self.connected_peers.values():
+                if rp.peer_speed > fastest and rp.choked_upload and \
+                        rp.interested:
+                    fastest = rp.peer_speed
+                    chosen = rp
+
+        if choked is not chosen:
+            if choked is not None:
+                choked.choked_upload = True
+                self.update_active_peers_set(choked)
+                self.send_message(choked.mailbox, "CHOKE",
+                                  MESSAGE_SIZES["CHOKE"])
+            if chosen is not None:
+                chosen.choked_upload = False
+                chosen.last_unchoke = e.clock
+                self.update_active_peers_set(chosen)
+                self.send_message(chosen.mailbox, "UNCHOKE",
+                                  MESSAGE_SIZES["UNCHOKE"])
+
+    def update_interested_after_receive(self):
+        for rp in self.connected_peers.values():
+            if rp.am_interested:
+                interested = any(
+                    self.has_not_piece(i) and rp.has_piece(i)
+                    for i in range(FILE_PIECES))
+                if not interested:
+                    rp.am_interested = False
+                    self.send_message(rp.mailbox, "NOTINTERESTED",
+                                      MESSAGE_SIZES["NOTINTERESTED"])
+
+    # -- piece selection ----------------------------------------------
+    def select_piece_to_download(self, rp):
+        piece = self.partially_downloaded_piece(rp)
+        if piece != -1:
+            return piece
+        if self.count_pieces(self.current_pieces) >= \
+                (FILE_PIECES - self.count_pieces(self.bitfield)) and \
+                self.is_interested_by(rp):
+            if not ENABLE_END_GAME_MODE:
+                return -1
+            interesting = [i for i in range(FILE_PIECES)
+                           if self.has_not_piece(i) and rp.has_piece(i)]
+            return interesting[self.stream.rand_int(
+                0, len(interesting) - 1)]
+        if self.count_pieces(self.bitfield) < 4 and \
+                self.is_interested_by_free(rp):
+            interesting = [i for i in range(FILE_PIECES)
+                           if self.has_not_piece(i) and rp.has_piece(i)
+                           and self.is_not_downloading_piece(i)]
+            return interesting[self.stream.rand_int(
+                0, len(interesting) - 1)]
+        # rarest-first
+        candidates = [i for i in range(FILE_PIECES)
+                      if self.has_not_piece(i) and rp.has_piece(i)
+                      and self.is_not_downloading_piece(i)]
+        if not candidates:
+            return -1
+        min_count = min(self.pieces_count[i] for i in candidates)
+        rarest = [i for i in candidates
+                  if self.pieces_count[i] == min_count]
+        return rarest[self.stream.rand_int(0, len(rarest) - 1)]
+
+    def request_new_piece_to(self, rp):
+        piece = self.select_piece_to_download(rp)
+        if piece != -1:
+            self.current_pieces |= 1 << piece
+            self.send_request_to(rp, piece)
+
+    def remove_current_piece(self, rp, piece):
+        self.current_pieces &= ~(1 << piece)
+        rp.current_piece = -1
+
+    # -- message handling ---------------------------------------------
+    def handle_message(self, msg):
+        e = s4u.Engine.get_instance()
+        rp = self.connected_peers.get(msg.peer_id)
+        t = msg.type
+        if t == "HANDSHAKE":
+            if rp is None:
+                self.connected_peers[msg.peer_id] = \
+                    Connection(msg.peer_id)
+                rp = self.connected_peers[msg.peer_id]
+                self.send_message(msg.return_mailbox, "HANDSHAKE",
+                                  MESSAGE_SIZES["HANDSHAKE"])
+            self.send_bitfield(msg.return_mailbox)
+        elif t == "BITFIELD":
+            self.update_pieces_count_from_bitfield(msg.bitfield)
+            rp.bitfield = msg.bitfield
+            if self.is_interested_by(rp):
+                rp.am_interested = True
+                self.send_message(msg.return_mailbox, "INTERESTED",
+                                  MESSAGE_SIZES["INTERESTED"])
+        elif t == "INTERESTED":
+            rp.interested = True
+            self.update_active_peers_set(rp)
+        elif t == "NOTINTERESTED":
+            rp.interested = False
+            self.update_active_peers_set(rp)
+        elif t == "UNCHOKE":
+            rp.choked_download = False
+            if rp.am_interested:
+                self.request_new_piece_to(rp)
+        elif t == "CHOKE":
+            rp.choked_download = True
+            if rp.current_piece != -1:
+                self.remove_current_piece(rp, rp.current_piece)
+        elif t == "HAVE":
+            rp.bitfield |= 1 << msg.piece
+            self.pieces_count[msg.piece] += 1
+            if not rp.am_interested and self.has_not_piece(msg.piece):
+                rp.am_interested = True
+                self.send_message(msg.return_mailbox, "INTERESTED",
+                                  MESSAGE_SIZES["INTERESTED"])
+                if not rp.choked_download:
+                    self.request_new_piece_to(rp)
+        elif t == "REQUEST":
+            if not rp.choked_upload and not self.has_not_piece(
+                    msg.piece):
+                self.send_piece(msg.return_mailbox, msg.piece,
+                                msg.block_index, msg.block_length)
+        elif t == "PIECE":
+            if self.has_not_piece(msg.piece):
+                self.update_bitfield_blocks(msg.piece, msg.block_index,
+                                            msg.block_length)
+                if self.has_completed_piece(msg.piece):
+                    self.remove_current_piece(rp, msg.piece)
+                    self.bitfield |= 1 << msg.piece
+                    self.send_have_to_all_peers(msg.piece)
+                    self.update_interested_after_receive()
+                else:
+                    self.send_request_to(rp, msg.piece)
+            else:
+                self.request_new_piece_to(rp)
+        elif t == "CANCEL":
+            pass
+        if rp is not None:
+            dt = e.clock - self.begin_receive_time
+            # C computes 1.0/0.0 = inf here without complaint
+            rp.add_speed_value(1.0 / dt if dt > 0 else float("inf"))
+        self.begin_receive_time = e.clock
+
+    # -- main loops ---------------------------------------------------
+    def _loop(self, stop_when_complete):
+        e = s4u.Engine.get_instance()
+        next_choked_update = e.clock + UPDATE_CHOKED_INTERVAL
+        while e.clock < self.deadline and not (
+                stop_when_complete
+                and self.count_pieces(self.bitfield) >= FILE_PIECES):
+            if self.comm_received is None:
+                self.comm_received = self.mailbox.get_async()
+            if self.comm_received.test():
+                msg = self.comm_received.get_payload()
+                self.handle_message(msg)
+                self.comm_received = None
+            elif e.clock >= next_choked_update and (
+                    not stop_when_complete
+                    or self.count_pieces(self.bitfield) > 0):
+                self.update_choked_peers()
+                next_choked_update += UPDATE_CHOKED_INTERVAL
+            else:
+                s4u.this_actor.sleep_for(SLEEP_DURATION)
+
+    def run(self):
+        e = s4u.Engine.get_instance()
+        if self.get_peers_from_tracker():
+            self.begin_receive_time = e.clock
+            self.mailbox.set_receiver(s4u.Actor.self())
+            if self.has_finished():
+                self.send_handshake_to_all_peers()
+            else:
+                # leech(): handshake everyone, then download
+                self.send_handshake_to_all_peers()
+                self._loop(stop_when_complete=True)
+            self._loop(stop_when_complete=False)      # seed
+        else:
+            PEER_LOG.info("Couldn't contact the tracker.")
+        PEER_LOG.info("Here is my current status: %s", self.get_status())
+
+
+def peer(*args):
+    Peer(list(args)).run()
+
+
+def main():
+    e = s4u.Engine(sys.argv)
+    e.load_platform(sys.argv[1])
+    install_streams(e)
+    e.register_function("tracker", tracker)
+    e.register_function("peer", peer)
+    e.load_deployment(sys.argv[2])
+    e.run()
+
+
+if __name__ == "__main__":
+    main()
