@@ -8,6 +8,22 @@ OS threads with semaphore handoff — exactly one runnable thread at any
 instant, so scheduling stays as deterministic as the reference's serial
 context factory (ContextSwapped.cpp:152-170).  The factory abstraction is
 kept so a C fiber extension can slot in later without touching the kernel.
+
+Why there is deliberately NO parallel-actor-execution mode (the
+reference's Parmap thread pool, ContextSwapped.cpp:152-170 +
+xbt/parmap.hpp): that lever parallelizes the per-round USER CODE of
+actors across OS threads.  Here actor user code is Python — under the
+GIL a Parmap clone would serialize anyway and only add
+synchronization cost — and the workloads where the reference's Parmap
+pays (many CPU-heavy ranks per round) are exactly the ones this
+rebuild accelerates on the DEVICE instead: per-rank compute is
+batched into the vectorized solver rounds (ops/lmm_jax.py), whole
+network phases batch into one device program
+(ops/lmm_drain.DrainSim), and SMPI's C ranks execute real native code
+via per-rank dlopen copies (smpi/c_api.py) where the heavy lifting
+(BLAS, compute loops) already releases the GIL.  The scaling axis
+moved from host threads to device vectorization — re-adding a host
+thread pool would parallelize the bookkeeping, not the bottleneck.
 """
 
 from __future__ import annotations

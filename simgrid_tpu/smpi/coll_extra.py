@@ -11,9 +11,16 @@ arrays and ship (metadata, chunk) tuples with an explicit
 payloads fall back to an unsplit algorithm (results stay correct at
 slightly different simulated cost).
 
-SMP-aware variants (mvapich2 two-level, SMP-binomial) are intentionally
-not modeled: simulated ranks are deployed one per host, where those
-algorithms degenerate to their flat counterparts.
+SMP-aware variants (mvapich2 two-level, SMP-binomial) are substituted
+by their flat counterparts.  This is exact when ranks are deployed one
+per host, and an APPROXIMATION when a hostfile packs several ranks per
+host (tools/smpirun.py wraps ranks round-robin over the host list, so
+multi-rank hosts are reachable in the default path): there the real
+two-level algorithms would do intra-node exchanges over the loopback
+first and fewer inter-node messages, so their simulated timing differs
+from the flat substitute's.  Known limitation, not a claim of
+equivalence — selector tables still dispatch to the flat algorithm and
+log the substitution at debug level.
 """
 
 from __future__ import annotations
