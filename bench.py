@@ -350,6 +350,27 @@ def main() -> None:
         detail["platform"] = "cpu"
     detail["headline_platform"] = detail["platform"]
 
+    # committed end-to-end drain results (tools/e2e_drain.py, run
+    # separately because the native baseline alone takes ~an hour):
+    # full config-#4 simulations to completion, with event-order
+    # equality checked across backends
+    e2e_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "bench_results", "e2e_drain.jsonl")
+    if os.path.exists(e2e_path):
+        rows = []
+        for line in open(e2e_path):
+            try:
+                r = json.loads(line)
+            except ValueError:
+                continue
+            if r.get("flows") == 100_000 and "wall_s" in r:
+                rows.append({k: r.get(k) for k in
+                             ("backend", "jax_platform", "workload",
+                              "advances", "wall_s", "t_sim",
+                              "n_events", "rounds")})
+        if rows:
+            detail["e2e_drain_100k"] = rows
+
     # top-level accelerator-only ratio for the largest class that has
     # both a native and an accelerator measurement
     vs_tpu = None

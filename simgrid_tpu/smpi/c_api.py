@@ -4675,6 +4675,12 @@ def _h_request_get_status(ctx, a):
     entry = ctx.reqs.get(h)
     if h == 0 or entry is None:
         _write_i32(flag_addr, 1)
+        # MPI-2.2: MPI_REQUEST_NULL yields the EMPTY status (source
+        # MPI_ANY_SOURCE, tag MPI_ANY_TAG, error MPI_SUCCESS, count 0)
+        # — pt2pt/rqstatus checks the fields, so the struct cannot be
+        # left holding caller stack garbage
+        _set_status(st_addr, C_ANY_SOURCE, C_ANY_TAG, MPI_SUCCESS, 0,
+                    False, keep_error=False)
         return MPI_SUCCESS
     status = Status()
     if isinstance(entry, _CPersist):

@@ -60,7 +60,7 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("dir", nargs="?", default="coll")
     ap.add_argument("--jobs", type=int, default=6)
-    ap.add_argument("--timeout", type=float, default=330.0)
+    ap.add_argument("--timeout", type=float, default=600.0)
     ap.add_argument("--only", default="")
     ap.add_argument("--out", default="")
     args = ap.parse_args()
@@ -70,6 +70,7 @@ def main() -> int:
 
     np_of = {}
     rtest_of = {}
+    active = set()
     try:
         for line in open(f"{M}/{d}/testlist"):
             # honour np hints on commented-out entries too
@@ -83,6 +84,8 @@ def main() -> int:
             # Only ACTIVE lines count — a prose comment starting with
             # a test name must not invert the active entry's grading.
             if not line.startswith("#"):
+                if parts:
+                    active.add(parts[0])
                 for p in parts[2:]:
                     if p.startswith("resultTest="):
                         rtest_of.setdefault(parts[0],
@@ -90,11 +93,19 @@ def main() -> int:
     except FileNotFoundError:
         pass
 
+    # Sweep exactly the reference's ACTIVE testlist entries: files the
+    # reference never runs (segtest needs MPICH-internal mpiimpl.h,
+    # dims5 is commented out, glpid is absent from its dir's testlist)
+    # must not count against parity.
     srcs = [s for s in sorted(glob.glob(f"{M}/{d}/*.c"))
             if os.path.basename(s)[:-2] not in HELPER_SRC]
     if args.only:
+        # an explicit request overrides the testlist filter (debugging
+        # a commented-out test must stay possible)
         keep = set(args.only.split(","))
         srcs = [s for s in srcs if os.path.basename(s)[:-2] in keep]
+    elif active:
+        srcs = [s for s in srcs if os.path.basename(s)[:-2] in active]
     results = {}
     lock = threading.Lock()
 
@@ -114,6 +125,7 @@ def main() -> int:
         extra_defs = EXTRA_DEFS.get(name, [])
         code = f"""
 import sys; sys.path.insert(0, {REPO!r})
+import jax; jax.config.update("jax_platforms", "cpu")
 from simgrid_tpu.smpi.c_api import compile_program, run_c_program
 compile_program([{src!r}, *{extra_src!r},
                  "{M}/util/mtest.c", "{M}/util/mtest_datatype.c",
