@@ -71,7 +71,7 @@ class ChordNode:
     # -- messaging ---------------------------------------------------------
     def _send(self, dst_id: int, msg: dict) -> None:
         s4u.Mailbox.by_name(f"chord-{dst_id}").put_init(
-            msg, COMM_SIZE).detach().start()
+            msg, COMM_SIZE).detach()
 
     def _handle(self, msg: dict) -> None:
         kind = msg["type"]

@@ -162,7 +162,13 @@ def drain_jax(arrays, slot_flow, size, platform=None, done_eps=1e-4):
     # end-to-end wall-clock includes compiles once per shape; report
     # both (first advance separately).
     t0 = time.perf_counter()
-    sim.run()
+    n = sim.n_v
+    while n:
+        n = sim.advance()
+        if sim.advances % 50 == 0 or sim.advances <= 2:
+            print(f"[drain] advance {sim.advances}: live {n}, "
+                  f"t_sim {sim.t:.4f}, wall {time.perf_counter()-t0:.0f}s",
+                  flush=True)
     wall = time.perf_counter() - t0
     events = [(t, int(slot_flow[fid])) for t, fid in sim.events]
     return events, dict(advances=sim.advances, wall_s=round(wall, 1),
