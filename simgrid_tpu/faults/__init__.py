@@ -1,0 +1,22 @@
+"""Fault injection: deterministic failure campaigns + programmatic injector.
+
+The simulator's failure machinery (state profiles -> ``apply_event`` ->
+``HostFailureException`` / auto-restart) is driven from two entry points:
+
+- :class:`FaultCampaign` compiles seeded MTBF/MTTR schedules into kernel
+  state :class:`~simgrid_tpu.kernel.profile.Profile` streams, so injected
+  failures ride the exact same FutureEvtSet path as platform traces and
+  keep event ordering bit-deterministic.
+- :class:`Injector` scripts point failures (host/link off, bandwidth
+  degradation, network partitions) with engine timers, usable
+  mid-simulation from maestro or from actors.
+
+See also :mod:`simgrid_tpu.plugins.fault_stats` for the observability
+side and ``RetryPolicy`` in :mod:`simgrid_tpu.s4u.activity` for the
+application-level recovery side.
+"""
+
+from .campaign import FaultCampaign
+from .injector import Injector
+
+__all__ = ["FaultCampaign", "Injector"]

@@ -1,0 +1,169 @@
+"""Deterministic fault-injection campaigns.
+
+A :class:`FaultCampaign` turns per-resource MTBF/MTTR distributions into
+explicit failure/recovery schedules, generated from seeded RngStream
+draws (one substream per resource, assigned in sorted name order, so the
+event stream of each resource is independent of every other resource's
+draw count and of insertion order).  Schedules are compiled into kernel
+state :class:`~simgrid_tpu.kernel.profile.Profile` streams and scheduled
+on the engine's FutureEvtSet: an injected host failure flows through
+``Cpu.apply_event`` exactly like a platform ``<trace>`` state event —
+actors are killed, auto-restart actors reboot on recovery, and the
+deterministic event ordering of the engine loop is preserved.
+
+Same modeling role as the availability-trace-driven campaigns of the
+infrastructure papers (see PAPERS.md): identical seeds give bit-identical
+event streams and final clocks.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+from ..kernel.profile import Profile
+from ..utils.rngstream import RngStream, seeded_stream
+
+#: supported inter-event distributions
+DISTRIBUTIONS = ("exponential", "weibull", "fixed")
+
+
+def _draw(rng: RngStream, dist: str, mean: float, shape: float) -> float:
+    """One inter-event delay by inverse-CDF sampling (u in [0,1))."""
+    if dist == "fixed":
+        return mean
+    u = rng.rand_u01()
+    if dist == "exponential":
+        return -mean * math.log(1.0 - u)
+    # weibull, parameterized by its mean: scale = mean / Gamma(1 + 1/shape)
+    scale = mean / math.gamma(1.0 + 1.0 / shape)
+    return scale * (-math.log(1.0 - u)) ** (1.0 / shape)
+
+
+class _Spec:
+    __slots__ = ("kind", "name", "mtbf", "mttr", "dist", "shape")
+
+    def __init__(self, kind: str, name: str, mtbf: float, mttr: float,
+                 dist: str, shape: float):
+        if dist not in DISTRIBUTIONS:
+            raise ValueError(f"Unknown distribution {dist!r} "
+                             f"(expected one of {DISTRIBUTIONS})")
+        if mtbf <= 0 or mttr <= 0:
+            raise ValueError(f"{kind} '{name}': MTBF and MTTR must be > 0")
+        if shape <= 0:
+            raise ValueError(f"{kind} '{name}': weibull shape must be > 0")
+        self.kind = kind
+        self.name = name
+        self.mtbf = mtbf
+        self.mttr = mttr
+        self.dist = dist
+        self.shape = shape
+
+
+class FaultCampaign:
+    """A seeded host/link failure+recovery schedule generator.
+
+    Usage::
+
+        campaign = FaultCampaign(seed=42, horizon=3600.0)
+        campaign.add_host("Jupiter", mtbf=300.0, mttr=60.0)
+        campaign.add_link("backbone", mtbf=900.0, mttr=30.0,
+                          dist="weibull", shape=1.5)
+        campaign.schedule(engine)     # before or between run() calls
+        engine.run()
+    """
+
+    def __init__(self, seed: int = 0, horizon: float = 1000.0):
+        if horizon <= 0:
+            raise ValueError("horizon must be > 0")
+        self.seed = int(seed)
+        self.horizon = float(horizon)
+        self._specs: Dict[Tuple[str, str], _Spec] = {}
+        self._events: Optional[Dict[Tuple[str, str],
+                                    List[Tuple[float, float]]]] = None
+        self._scheduled = False
+
+    # -- declaration -------------------------------------------------------
+    def _add(self, kind: str, resource, mtbf: float, mttr: float,
+             dist: str, shape: float) -> "FaultCampaign":
+        name = getattr(resource, "name", resource)
+        self._specs[(kind, str(name))] = _Spec(kind, str(name), mtbf, mttr,
+                                               dist, shape)
+        self._events = None     # invalidate any generated schedule
+        return self
+
+    def add_host(self, host, mtbf: float, mttr: float,
+                 dist: str = "exponential", shape: float = 1.0
+                 ) -> "FaultCampaign":
+        """Declare a host to fail with the given mean-time-between-failures
+        and mean-time-to-repair (accepts a Host or its name)."""
+        return self._add("host", host, mtbf, mttr, dist, shape)
+
+    def add_link(self, link, mtbf: float, mttr: float,
+                 dist: str = "exponential", shape: float = 1.0
+                 ) -> "FaultCampaign":
+        """Declare a link to fail (accepts a Link/LinkImpl or its name)."""
+        return self._add("link", link, mtbf, mttr, dist, shape)
+
+    # -- generation --------------------------------------------------------
+    def generate(self) -> Dict[Tuple[str, str], List[Tuple[float, float]]]:
+        """Generate (and cache) the event schedule: a sorted-by-resource
+        dict of ``(kind, name) -> [(date, value), ...]`` with value 0.0
+        for failure and 1.0 for recovery.  Identical seeds and specs give
+        bit-identical schedules."""
+        if self._events is not None:
+            return self._events
+        rng = seeded_stream(self.seed, "fault-campaign")
+        events: Dict[Tuple[str, str], List[Tuple[float, float]]] = {}
+        for key in sorted(self._specs):
+            spec = self._specs[key]
+            rng.reset_next_substream()
+            points: List[Tuple[float, float]] = []
+            t = 0.0
+            while True:
+                t += _draw(rng, spec.dist, spec.mtbf, spec.shape)
+                if t >= self.horizon:
+                    break
+                points.append((t, 0.0))
+                t += _draw(rng, spec.dist, spec.mttr, spec.shape)
+                if t >= self.horizon:
+                    break
+                points.append((t, 1.0))
+            events[key] = points
+        self._events = events
+        return events
+
+    # -- compilation onto an engine ---------------------------------------
+    def schedule(self, engine=None) -> Dict[Tuple[str, str],
+                                            List[Tuple[float, float]]]:
+        """Compile the generated schedule into state Profiles attached to
+        the engine's resources (hosts' CPUs / links) and scheduled on its
+        FutureEvtSet.  Returns the schedule dict.  One-shot per campaign:
+        re-attaching the same event streams twice would double-fire."""
+        from ..plugins._base import resolve_engine
+        if self._scheduled:
+            raise RuntimeError("This FaultCampaign was already scheduled; "
+                               "build a new campaign (same seed for the "
+                               "same schedule) to drive another engine")
+        impl = resolve_engine(engine)
+        assert impl is not None, "No engine: create s4u.Engine first"
+        events = self.generate()
+        for (kind, name), points in sorted(events.items()):
+            if kind == "host":
+                host = impl.hosts.get(name)
+                assert host is not None, f"Host '{name}' not found"
+                target = host.cpu
+            else:
+                target = impl.links.get(name)
+                assert target is not None, f"Link '{name}' not found"
+            if target.state_event is not None:
+                raise RuntimeError(
+                    f"{kind} '{name}' already has a state profile; "
+                    "campaign events would be mistaken for its events")
+            if not points:
+                continue        # horizon shorter than the first failure
+            profile = Profile.from_dated_values(
+                f"__fault_{kind}_{name}", points)
+            target.set_state_profile(profile)
+        self._scheduled = True
+        return events

@@ -1,0 +1,212 @@
+"""Programmatic fault injection with engine timers.
+
+``Injector`` scripts point failures against a live simulation::
+
+    inj = Injector()                       # current engine
+    inj.at(10.0).host_off("Jupiter")
+    inj.at(12.5).link_degrade("backbone", 0.25)
+    inj.at(20.0).partition(["A", "B"], ["C", "D"], duration=5.0)
+    inj.at(40.0).restore_all()
+
+Each operation is an engine :class:`~simgrid_tpu.kernel.engine.Timer`
+callback, so it fires maestro-side at a deterministic position of the
+event loop; ``.now`` variants (calling the operation methods on the
+injector itself) execute immediately — through a simcall when called
+from an actor, inline from maestro or the main thread.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Set
+
+
+def _resolve_host(impl, host):
+    if hasattr(host, "actor_list"):
+        return host
+    resolved = impl.hosts.get(str(host))
+    assert resolved is not None, f"Host '{host}' not found"
+    return resolved
+
+
+def _resolve_link(impl, link):
+    if hasattr(link, "bandwidth_peak"):
+        return link
+    resolved = impl.links.get(str(link))
+    assert resolved is not None, f"Link '{link}' not found"
+    return resolved
+
+
+class _At:
+    """Operations bound to one injection date (chainable)."""
+
+    def __init__(self, injector: "Injector", date: float):
+        self._injector = injector
+        self._date = date
+
+    def _schedule(self, fn) -> "_At":
+        self._injector._engine.timer_set(self._date, fn)
+        return self
+
+    def host_off(self, host) -> "_At":
+        return self._schedule(lambda: self._injector.host_off(host))
+
+    def host_on(self, host) -> "_At":
+        return self._schedule(lambda: self._injector.host_on(host))
+
+    def link_off(self, link) -> "_At":
+        return self._schedule(lambda: self._injector.link_off(link))
+
+    def link_on(self, link) -> "_At":
+        return self._schedule(lambda: self._injector.link_on(link))
+
+    def link_degrade(self, link, fraction: float) -> "_At":
+        return self._schedule(
+            lambda: self._injector.link_degrade(link, fraction))
+
+    def partition(self, zone_a: Iterable, zone_b: Iterable,
+                  duration: float = -1.0) -> "_At":
+        return self._schedule(
+            lambda: self._injector.partition(zone_a, zone_b, duration))
+
+    def restore_all(self) -> "_At":
+        return self._schedule(lambda: self._injector.restore_all())
+
+
+class Injector:
+    """Mid-simulation fault injection API (see module docstring)."""
+
+    def __init__(self, engine=None):
+        from ..plugins._base import resolve_engine
+        self._engine = resolve_engine(engine)
+        assert self._engine is not None, \
+            "No engine: create s4u.Engine first"
+        self._hosts_off: Set[str] = set()
+        self._links_off: Set[str] = set()
+        #: link name -> original bandwidth_peak, recorded at first degrade
+        self._degraded: Dict[str, float] = {}
+
+    def at(self, date: float) -> _At:
+        """Bind the chained operations to an absolute simulated date."""
+        return _At(self, date)
+
+    # -- immediate operations ---------------------------------------------
+    def _do(self, fn):
+        """Run a state mutation kernel-side: as a simcall from an actor
+        context (the mutation may kill actors — including the caller),
+        inline from maestro/main (the reference routes s4u::Host::turn_off
+        through kernel::actor::simcall the same way)."""
+        from ..s4u.actor import _current_impl
+        issuer = _current_impl()
+
+        def handler(sc):
+            fn()
+            sc.issuer.simcall_answer()
+        issuer.simcall("fault_inject", handler)
+
+    def host_off(self, host) -> None:
+        host = _resolve_host(self._engine, host)
+
+        def op():
+            if host.is_on():
+                self._hosts_off.add(host.name)
+                host.turn_off()
+        self._do(op)
+
+    def host_on(self, host) -> None:
+        host = _resolve_host(self._engine, host)
+
+        def op():
+            self._hosts_off.discard(host.name)
+            host.turn_on()
+        self._do(op)
+
+    def link_off(self, link) -> None:
+        link = _resolve_link(self._engine, link)
+
+        def op():
+            if link.is_on():
+                self._links_off.add(link.name)
+                link.turn_off()
+        self._do(op)
+
+    def link_on(self, link) -> None:
+        link = _resolve_link(self._engine, link)
+
+        def op():
+            self._links_off.discard(link.name)
+            link.turn_on()
+        self._do(op)
+
+    def link_degrade(self, link, fraction: float) -> None:
+        """Scale a link's bandwidth to ``fraction`` of its ORIGINAL
+        capacity (0 parks in-flight flows, 1 restores)."""
+        assert 0.0 <= fraction, "fraction must be >= 0"
+        link = _resolve_link(self._engine, link)
+        assert hasattr(link, "set_bandwidth"), \
+            f"Link '{link.name}' does not support bandwidth changes"
+
+        def op():
+            original = self._degraded.setdefault(link.name,
+                                                 link.bandwidth_peak)
+            link.set_bandwidth(original * fraction)
+            if fraction >= 1.0:
+                self._degraded.pop(link.name, None)
+        self._do(op)
+
+    def partition(self, zone_a: Iterable, zone_b: Iterable,
+                  duration: float = -1.0) -> None:
+        """Cut every link on the routes between the two host groups
+        (both directions); with ``duration`` >= 0 the cut heals itself
+        that many simulated seconds later.  Links shared with intra-zone
+        routes are cut too — a partition severs the physical medium."""
+        hosts_a = [_resolve_host(self._engine, h) for h in zone_a]
+        hosts_b = [_resolve_host(self._engine, h) for h in zone_b]
+
+        def op():
+            cut: List = []
+            seen: Set[str] = set()
+            for a in hosts_a:
+                for b in hosts_b:
+                    for src, dst in ((a, b), (b, a)):
+                        route: List = []
+                        src.route_to(dst, route)
+                        for link in route:
+                            if link.name not in seen:
+                                seen.add(link.name)
+                                cut.append(link)
+            for link in sorted(cut, key=lambda l: l.name):
+                if link.is_on():
+                    self._links_off.add(link.name)
+                    link.turn_off()
+            if duration >= 0:
+                names = sorted(seen)
+
+                def heal():
+                    for name in names:
+                        link = self._engine.links.get(name)
+                        if link is not None:
+                            self._links_off.discard(name)
+                            link.turn_on()
+                self._engine.timer_set(self._engine.now + duration, heal)
+        self._do(op)
+
+    def restore_all(self) -> None:
+        """Undo every injection this injector performed: power failed
+        hosts/links back on and restore degraded bandwidths."""
+        def op():
+            for name in sorted(self._hosts_off):
+                host = self._engine.hosts.get(name)
+                if host is not None:
+                    host.turn_on()
+            self._hosts_off.clear()
+            for name in sorted(self._links_off):
+                link = self._engine.links.get(name)
+                if link is not None:
+                    link.turn_on()
+            self._links_off.clear()
+            for name in sorted(self._degraded):
+                link = self._engine.links.get(name)
+                if link is not None:
+                    link.set_bandwidth(self._degraded[name])
+            self._degraded.clear()
+        self._do(op)

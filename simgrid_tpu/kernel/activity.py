@@ -219,7 +219,22 @@ class CommImpl(ActivityImpl):
             self.state = State.DST_HOST_FAILURE
         elif (self.surf_action is not None
                 and self.surf_action.get_state() == ActionState.FAILED):
-            self.state = State.LINK_FAILURE
+            # Disambiguate what killed the flow: a genuine link failure
+            # (tagged by LinkImpl.turn_off) is a LINK_FAILURE; a flow
+            # cancelled because an endpoint host died maps to the
+            # host-failure states so the surviving peer learns the right
+            # cause ("Remote peer failed", not a phantom link outage).
+            cause = getattr(self.surf_action, "failure_cause", None)
+            if (cause != "link" and self.src_actor is not None
+                    and self.src_actor.host is not None
+                    and not self.src_actor.host.is_on()):
+                self.state = State.SRC_HOST_FAILURE
+            elif (cause != "link" and self.dst_actor is not None
+                    and self.dst_actor.host is not None
+                    and not self.dst_actor.host.is_on()):
+                self.state = State.DST_HOST_FAILURE
+            else:
+                self.state = State.LINK_FAILURE
         else:
             self.state = State.DONE
         self.cleanup_surf()

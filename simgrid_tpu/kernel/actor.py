@@ -39,6 +39,7 @@ class ActorImpl:
     on_creation = Signal()
     on_termination = Signal()
     on_destruction = Signal()
+    on_kill = Signal()           # (victim) — fired once per forceful kill
 
     def __init__(self, engine, name: str, host, code: Optional[Callable] = None):
         self.engine = engine
@@ -151,6 +152,7 @@ class ActorImpl:
         """Maestro-side kill (reference ActorImpl::kill, ActorImpl.cpp:189+)."""
         if victim.finished:
             return
+        ActorImpl.on_kill(victim)
         victim.context.iwannadie = True
         victim.exception = None
         # Detach from whatever it waits on

@@ -110,6 +110,34 @@ class Profile:
         return profile
 
     @staticmethod
+    def from_dated_values(name: str, points, periodicity: float = -1.0,
+                          register: bool = False) -> "Profile":
+        """Build a profile from in-memory (date, value) pairs — the
+        programmatic analog of from_string, used by fault campaigns to
+        compile generated failure schedules into the same delta-encoded
+        stream the platform traces flow through."""
+        if register and name in trace_list:
+            raise ParseError(f"Refusing to define trace '{name}' twice")
+        profile = Profile()
+        last_event = profile.event_list[-1]
+        for date, value in points:
+            event = DatedValue(float(date), float(value))
+            if last_event.date > event.date:
+                raise ParseError(
+                    f"{name}: invalid schedule: events must be sorted "
+                    f"({last_event.date} > {event.date})")
+            last_event.date = event.date - last_event.date
+            profile.event_list.append(event)
+            last_event = event
+        if periodicity > 0:
+            last_event.date = periodicity + profile.event_list[0].date
+        else:
+            last_event.date = -1
+        if register:
+            trace_list[name] = profile
+        return profile
+
+    @staticmethod
     def from_file(path: str) -> "Profile":
         if not path:
             raise ParseError("Cannot parse a trace from an empty filename")

@@ -151,6 +151,7 @@ class Cpu(Resource):
                             ActionState.INITED, ActionState.STARTED,
                             ActionState.IGNORED):
                         action.finish_time = date
+                        action.failure_cause = "host"
                         action.set_state(ActionState.FAILED)
         else:
             raise AssertionError("Unknown event!")

@@ -20,6 +20,7 @@ class Host:
     on_destruction = Signal()
     on_state_change = Signal()
     on_speed_change_sig = Signal()
+    on_restart = Signal()        # (host, n_actors_rebooted)
 
     def __init__(self, engine, name: str):
         self.engine = engine
@@ -46,6 +47,7 @@ class Host:
     def turn_on(self) -> None:
         if not self.is_on():
             self.cpu.turn_on()
+            self.engine.watched_hosts.discard(self.name)
             Host.on_state_change(self)
             # autorestart actors are relaunched by the engine hook
             self.engine_on_host_restart()
@@ -54,8 +56,20 @@ class Host:
         # reference s4u::Host::turn_off: kill every actor of the host
         if self.is_on():
             self.cpu.turn_off()
+            # SIMIX watched-host semantics: a failed host whose actors
+            # were killed while actions were pending joins the watched
+            # set, so its recovery profile event forces a zero-length
+            # re-solve even though no action uses the CPU any more
+            # (surf_solve's is_used() test alone would let the engine
+            # sleep past the reboot).  Sampled BEFORE the kills: they
+            # cancel the very synchros that make the host "pending".
+            pending = self.cpu.is_used() or any(
+                actor.waiting_synchro is not None
+                for actor in self.actor_list)
             for actor in list(self.actor_list):
                 self.engine.maestro.kill(actor)
+            if pending:
+                self.engine.watched_hosts.add(self.name)
             # keep only the specs that should reboot with the host
             # (HostImpl::turn_off's remove_if)
             self.actors_at_boot = [spec for spec in self.actors_at_boot
@@ -74,6 +88,7 @@ class Host:
             if spec.get("auto_restart"):
                 actor.pimpl.auto_restart = True
                 self.actors_at_boot.append(spec)
+        Host.on_restart(self, len(specs))
         restart = getattr(self.engine, "on_host_restart", None)
         if restart is not None:
             restart(self)

@@ -38,6 +38,14 @@ class NetworkAction(Action):
         # only the *finite* part so a later bandwidth restore can undo the
         # park without inf-inf arithmetic (C++ would NaN here).
         self.parked_links = 0
+        # link name -> how many weight-S terms of this flow that link
+        # carries (occurrences on the FORWARD route).  set_bandwidth
+        # must adjust exactly these: the constraint also holds
+        # cross-traffic flows (reverse route, weight 0.05) that carry
+        # no weight-S term for this link at all, and a flow whose
+        # forward and reverse routes share a link sits on the
+        # constraint twice but pays the term only once.
+        self.ws_links: dict = {}
 
     @property
     def effective_penalty(self) -> float:
@@ -119,6 +127,11 @@ class LinkImpl(Resource):
                         ActionState.INITED, ActionState.STARTED,
                         ActionState.IGNORED):
                     action.finish_time = now
+                    # the comm post path maps link-killed flows to
+                    # LINK_FAILURE and endpoint-host kills to
+                    # SRC/DST_HOST_FAILURE; the cause is recorded here
+                    # because the FAILED state alone cannot tell them apart
+                    action.failure_cause = "link"
                     action.set_state(ActionState.FAILED)
 
     def set_bandwidth_profile(self, profile: profile_mod.Profile) -> None:
@@ -295,6 +308,8 @@ class NetworkCm02Model(NetworkModel):
                     action.sharing_penalty += weight_s / bw
                 else:
                     action.parked_links += 1
+                action.ws_links[link.name] = \
+                    action.ws_links.get(link.name, 0) + 1
 
         bw_factor = self.get_bandwidth_factor(size)
         bandwidth_bound = -1.0 if not route else bw_factor * route[0].get_bandwidth()
@@ -462,17 +477,26 @@ class NetworkCm02Link(LinkImpl):
             # A zero-bandwidth trace event parks the flows (infinite
             # penalty) instead of aborting; the park is tracked as a count
             # so a later restore works (delta arithmetic with inf would NaN).
+            # Each flow is adjusted by its recorded number of weight-S
+            # terms for THIS link (ws_links): iter_variables yields one
+            # entry per element, and with cross-traffic a constraint also
+            # holds reverse flows that carry no term for this link.
+            seen: set = set()
             for var in list(self.constraint.iter_variables()):
                 action = var.id
-                if isinstance(action, NetworkAction):
+                if isinstance(action, NetworkAction) and id(var) not in seen:
+                    seen.add(id(var))
+                    n = action.ws_links.get(self.name, 0)
+                    if not n:
+                        continue
                     if old > 0:
-                        action.sharing_penalty -= weight_s / old
+                        action.sharing_penalty -= n * (weight_s / old)
                     else:
-                        action.parked_links -= 1
+                        action.parked_links -= n
                     if value > 0:
-                        action.sharing_penalty += weight_s / value
+                        action.sharing_penalty += n * (weight_s / value)
                     else:
-                        action.parked_links += 1
+                        action.parked_links += n
                     if not action.is_suspended():
                         self.model.system.update_variable_penalty(
                             action.variable, action.effective_penalty)

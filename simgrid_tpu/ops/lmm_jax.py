@@ -1507,15 +1507,67 @@ def solve_flattened(system: System, dtype, solve_flat) -> None:
         system.remove_all_modified_set()
 
 
+#: solves completed by the exact host solver after the device kernel
+#: failed (non-convergence, stall, or non-finite output); see solve_jax
+_fallback_count = 0
+_fallback_warned = False
+
+
+def get_fallback_count() -> int:
+    return _fallback_count
+
+
+def reset_fallback_count() -> None:
+    global _fallback_count
+    _fallback_count = 0
+
+
+def _solve_host_exact(system: System) -> None:
+    """The graceful-degradation target: exact host solve of the same
+    system (native C++ when available, Python list solver otherwise)."""
+    from . import lmm_native
+    if lmm_native.available():
+        lmm_native.solve_native(system)
+    else:
+        system.solve_exact()
+
+
 def solve_jax(system: System) -> None:
-    """Backend entry: flatten host graph, solve on device, scatter back."""
+    """Backend entry: flatten host graph, solve on device, scatter back.
+
+    Graceful degradation: when the device fixpoint fails to converge
+    (round cap, stall) or returns non-finite rates, the solve is redone
+    by the exact host solver instead of aborting the whole simulation —
+    a production run survives one numerically-degenerate system.  The
+    hard raise is preserved behind ``--cfg=lmm/strict:1`` for
+    convergence testing."""
+    global _fallback_count, _fallback_warned
     dtype = np.float32 if config["lmm/dtype"] == "float32" else np.float64
 
     def solve_flat(arrays, eps):
         values, remaining, usage, _ = solve_arrays(arrays, eps)
+        if not np.all(np.isfinite(np.asarray(values))):
+            raise RuntimeError(
+                "LMM JAX solve returned non-finite rates "
+                f"({arrays.n_cnst} constraints, {arrays.n_var} variables, "
+                f"dtype {np.dtype(dtype).name})")
         return values, remaining, usage
 
-    solve_flattened(system, dtype, solve_flat)
+    try:
+        solve_flattened(system, dtype, solve_flat)
+    except RuntimeError as exc:
+        if config["lmm/strict"]:
+            raise
+        _fallback_count += 1
+        system.fallback_count = getattr(system, "fallback_count", 0) + 1
+        if not _fallback_warned:
+            _fallback_warned = True
+            from ..utils import log as _log
+            _log.get_category("lmm").warning(
+                "JAX solve failed (%s); falling back to the exact host "
+                "solver for this solve. Further fallbacks are silent "
+                "(lmm/strict:1 restores the hard error)." % (exc,))
+        _solve_host_exact(system)
 
 
 def _count_live_vars(system: System) -> int:

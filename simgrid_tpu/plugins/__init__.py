@@ -7,6 +7,8 @@ the reference's ``sg_<name>_plugin_init()`` registration entry points
 torn-down engine's plugins never fire into a fresh one.
 """
 
-from . import file_system, host_energy, host_load, link_energy, vm  # noqa: F401
+from . import (fault_stats, file_system, host_energy, host_load,  # noqa: F401
+               link_energy, vm)
 
-__all__ = ["host_energy", "host_load", "link_energy", "file_system", "vm"]
+__all__ = ["host_energy", "host_load", "link_energy", "file_system",
+           "fault_stats", "vm"]

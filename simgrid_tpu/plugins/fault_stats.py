@@ -1,0 +1,148 @@
+"""fault_stats plugin: failure/recovery observability.
+
+Counts per-resource failures and accumulated downtime, actor kills and
+auto-restart reboots, communications failed and retried — everything a
+fault-injection campaign (simgrid_tpu.faults) perturbs — through the
+same engine-scoped signal subscriptions as host_load.  Exposed as a
+plain dict (``summary()``) and via the underlying signals for live
+consumers.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ._base import resolve_engine
+
+
+class _ResourceStat:
+    __slots__ = ("failures", "downtime", "off_since")
+
+    def __init__(self):
+        self.failures = 0
+        self.downtime = 0.0
+        self.off_since: Optional[float] = None
+
+
+class FaultStats:
+    """Aggregated failure statistics for one engine."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.hosts: Dict[str, _ResourceStat] = {}
+        self.links: Dict[str, _ResourceStat] = {}
+        self.actors_killed = 0
+        self.actors_restarted = 0
+        self.comms_failed = 0
+        self.comms_retried = 0
+        self.execs_retried = 0
+
+    # -- state-change accounting ------------------------------------------
+    def _stat(self, table: Dict[str, _ResourceStat], name: str) -> _ResourceStat:
+        stat = table.get(name)
+        if stat is None:
+            stat = table[name] = _ResourceStat()
+        return stat
+
+    def _on_state_change(self, table: Dict[str, _ResourceStat], name: str,
+                         is_on: bool) -> None:
+        stat = self._stat(table, name)
+        now = self.engine.now
+        if not is_on:
+            if stat.off_since is None:
+                stat.failures += 1
+                stat.off_since = now
+        elif stat.off_since is not None:
+            stat.downtime += now - stat.off_since
+            stat.off_since = None
+
+    # -- reporting ---------------------------------------------------------
+    def _table_dict(self, table: Dict[str, _ResourceStat]) -> dict:
+        now = self.engine.now
+        out = {}
+        for name in sorted(table):
+            stat = table[name]
+            downtime = stat.downtime
+            if stat.off_since is not None:     # still down: bill up to now
+                downtime += now - stat.off_since
+            out[name] = {"failures": stat.failures, "downtime": downtime}
+        return out
+
+    def summary(self) -> dict:
+        from ..ops import lmm_jax
+        return {
+            "hosts": self._table_dict(self.hosts),
+            "links": self._table_dict(self.links),
+            "actors_killed": self.actors_killed,
+            "actors_restarted": self.actors_restarted,
+            "comms_failed": self.comms_failed,
+            "comms_retried": self.comms_retried,
+            "execs_retried": self.execs_retried,
+            "lmm_fallbacks": lmm_jax.get_fallback_count(),
+        }
+
+
+#: engine -> FaultStats (one live engine at a time, like ExtensionMap)
+_active: Dict[str, object] = {"engine": None, "stats": None}
+
+
+def fault_stats_plugin_init(engine=None) -> FaultStats:
+    """Activate the plugin on an engine (idempotent); returns the stats
+    object (also reachable later via get_stats())."""
+    from ..kernel.actor import ActorImpl
+    from ..models.host import Host
+    from ..models.network import LinkImpl, NetworkAction
+    from ..s4u.activity import Comm, Exec
+
+    impl = resolve_engine(engine)
+    if _active["engine"] is impl:
+        return _active["stats"]
+    stats = FaultStats(impl)
+    _active["engine"] = impl
+    _active["stats"] = stats
+
+    impl.connect_signal(
+        Host.on_state_change,
+        lambda host, *a: stats._on_state_change(stats.hosts, host.name,
+                                                host.is_on()))
+    impl.connect_signal(
+        LinkImpl.on_state_change,
+        lambda link, *a: stats._on_state_change(stats.links, link.name,
+                                                link.is_on()))
+
+    def on_kill(victim):
+        stats.actors_killed += 1
+    impl.connect_signal(ActorImpl.on_kill, on_kill)
+
+    def on_restart(host, n):
+        stats.actors_restarted += n
+    impl.connect_signal(Host.on_restart, on_restart)
+
+    def on_net_action_state(action, *a):
+        from ..kernel.activity import CommImpl
+        from ..kernel.resource import ActionState
+        if (action.get_state() == ActionState.FAILED
+                and isinstance(action.activity, CommImpl)):
+            stats.comms_failed += 1
+    impl.connect_signal(NetworkAction.on_state_change, on_net_action_state)
+
+    def on_comm_retry(mailbox, attempt, exc):
+        stats.comms_retried += 1
+    impl.connect_signal(Comm.on_retry, on_comm_retry)
+
+    def on_exec_retry(exec_, attempt, exc):
+        stats.execs_retried += 1
+    impl.connect_signal(Exec.on_retry, on_exec_retry)
+
+    return stats
+
+
+def get_stats(engine=None) -> FaultStats:
+    impl = resolve_engine(engine)
+    assert _active["engine"] is impl and _active["stats"] is not None, \
+        "The fault_stats plugin is not active on this engine"
+    return _active["stats"]
+
+
+def summary(engine=None) -> dict:
+    return get_stats(engine).summary()

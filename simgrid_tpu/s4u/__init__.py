@@ -15,7 +15,7 @@ Usage:
 
 from ..models.host import Host
 from ..models.network import LinkImpl as Link
-from .activity import Activity, ActivitySet, Comm, Exec, Io
+from .activity import (Activity, ActivitySet, Comm, Exec, Io, RetryPolicy)
 from .actor import Actor, this_actor
 from .engine import Engine, get_clock
 from .mailbox import Mailbox
@@ -26,4 +26,4 @@ from ..plugins.vm import VirtualMachine  # noqa: E402  (s4u::VirtualMachine)
 __all__ = ["Engine", "Actor", "this_actor", "Host", "Link", "Mailbox",
            "Comm", "Exec", "Io", "Activity", "ActivitySet", "Mutex",
            "ConditionVariable", "Semaphore", "Barrier", "get_clock",
-           "VirtualMachine"]
+           "RetryPolicy", "VirtualMachine"]

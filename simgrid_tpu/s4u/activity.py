@@ -7,13 +7,52 @@ activities via simcalls.
 
 from __future__ import annotations
 
+import math
 from enum import Enum
 from typing import List, Optional
 
-from ..exceptions import TimeoutException
+from ..exceptions import (HostFailureException, NetworkFailureException,
+                          StorageFailureException, TimeoutException)
 from ..kernel import activity as kact
+from ..utils.rngstream import seeded_stream
 from ..utils.signal import Signal
 from .engine import Engine
+
+#: transient failures worth re-issuing an activity for
+RETRYABLE_EXCEPTIONS = (TimeoutException, NetworkFailureException,
+                        StorageFailureException)
+
+
+class RetryPolicy:
+    """Bounded retry with exponential backoff and deterministic jitter.
+
+    Delays are ``base_delay * multiplier**(attempt-1)`` capped at
+    ``max_delay``; with ``jitter`` in (0, 1] each delay is scaled by a
+    factor drawn from ``[1-jitter, 1]`` on a seeded RngStream, so two
+    runs of the same simulation de-synchronize their retries identically
+    (simulated-time jitter, bit-reproducible across runs)."""
+
+    def __init__(self, max_attempts: int = 4, base_delay: float = 1.0,
+                 multiplier: float = 2.0, max_delay: float = math.inf,
+                 jitter: float = 0.0, seed: int = 0):
+        assert max_attempts >= 1, "max_attempts must be >= 1"
+        assert base_delay >= 0 and multiplier > 0, "invalid backoff"
+        assert 0.0 <= jitter <= 1.0, "jitter must be in [0, 1]"
+        self.max_attempts = max_attempts
+        self.base_delay = base_delay
+        self.multiplier = multiplier
+        self.max_delay = max_delay
+        self.jitter = jitter
+        self._rng = seeded_stream(seed, "retry-policy") if jitter else None
+
+    def backoff(self, attempt: int) -> float:
+        """The delay to sleep after failed attempt number `attempt`
+        (1-based)."""
+        delay = min(self.base_delay * self.multiplier ** (attempt - 1),
+                    self.max_delay)
+        if self._rng is not None:
+            delay *= 1.0 - self.jitter * self._rng.rand_u01()
+        return delay
 
 
 class ActivityState(Enum):
@@ -95,6 +134,7 @@ class Comm(Activity):
     on_sender_start = Signal()
     on_receiver_start = Signal()
     on_completion = Signal()
+    on_retry = Signal()          # (mailbox, attempt, exception)
 
     def __init__(self, mailbox=None):
         super().__init__()
@@ -249,12 +289,36 @@ class Comm(Activity):
         for comm in comms:
             comm.wait()
 
+    @staticmethod
+    def send_with_retry(mailbox, payload, size: float,
+                        policy: Optional[RetryPolicy] = None,
+                        timeout: float = -1.0) -> int:
+        """Send with retry: a blocking put re-issued on transient failure
+        (timeout, link failure, peer host failure), sleeping the policy's
+        backoff in simulated time between attempts.  Returns the number
+        of attempts used; re-raises the last exception once the policy's
+        attempt budget is exhausted."""
+        from .actor import this_actor
+        policy = policy or RetryPolicy()
+        attempt = 1
+        while True:
+            try:
+                mailbox.put(payload, size, timeout=timeout)
+                return attempt
+            except RETRYABLE_EXCEPTIONS as exc:
+                if attempt >= policy.max_attempts:
+                    raise
+                Comm.on_retry(mailbox, attempt, exc)
+                this_actor.sleep_for(policy.backoff(attempt))
+                attempt += 1
+
 
 class Exec(Activity):
     """A computation activity (s4u_Exec.cpp)."""
 
     on_start = Signal()
     on_completion = Signal()
+    on_retry = Signal()          # (exec, attempt, exception)
 
     def __init__(self):
         super().__init__()
@@ -369,6 +433,45 @@ class Exec(Activity):
     def wait_any_for(execs: List["Exec"], timeout: float) -> int:
         """wait_any with a timeout; -1 when it expires."""
         return Activity.wait_any_of(list(execs), timeout)
+
+    def _clone(self) -> "Exec":
+        """A fresh INITED copy of this execution's declaration (a failed
+        kernel activity cannot be restarted; retries re-issue)."""
+        clone = Exec()
+        clone.hosts = list(self.hosts)
+        clone.flops_amounts = list(self.flops_amounts)
+        clone.bytes_amounts = list(self.bytes_amounts)
+        clone.priority = self.priority
+        clone.bound = self.bound
+        clone.timeout = self.timeout
+        clone.name = self.name
+        return clone
+
+    def with_retry(self, policy: Optional[RetryPolicy] = None) -> "Exec":
+        """Run this execution to completion with retry: on a transient
+        failure (timeout, or the target host down — surfacing as
+        HostFailureException for a remote issuer) sleep the policy's
+        backoff in simulated time and re-issue a fresh copy.  Returns
+        the Exec that completed; re-raises once the attempt budget is
+        exhausted.  Call on an un-started Exec (the declaration is
+        cloned for every attempt)."""
+        from .actor import this_actor
+        assert self.state == ActivityState.INITED, \
+            "with_retry() drives the whole lifecycle: call it instead " \
+            "of start()/wait()"
+        policy = policy or RetryPolicy()
+        attempt = 1
+        while True:
+            exec_ = self._clone()
+            try:
+                exec_.start().wait()
+                return exec_
+            except RETRYABLE_EXCEPTIONS + (HostFailureException,) as exc:
+                if attempt >= policy.max_attempts:
+                    raise
+                Exec.on_retry(exec_, attempt, exc)
+                this_actor.sleep_for(policy.backoff(attempt))
+                attempt += 1
 
     def get_remaining(self) -> float:
         return self.pimpl.get_remaining() if self.pimpl else 0.0

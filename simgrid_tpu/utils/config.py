@@ -269,6 +269,10 @@ declare_flag("lmm/chain",
              "solve).  on, off, or auto (accelerators only — the CPU "
              "backend compacts host-side via lmm/compact instead)",
              "auto")
+declare_flag("lmm/strict",
+             "Abort on a failed device LMM solve (non-convergence, stall "
+             "or non-finite rates) instead of gracefully degrading to the "
+             "exact host solver for that solve", False)
 declare_flag("lmm/pad",
              "Static-shape padding policy for device solver arrays: "
              "pow2 (power-of-two buckets — few XLA recompiles as a "

@@ -109,3 +109,35 @@ class RngStream:
 
     def rand_int(self, low: int, high: int) -> int:
         return low + int(self.rand_u01() * (high - low + 1))
+
+
+def _derive_seed6(seed: int) -> List[int]:
+    """Expand one integer into a valid 6-component RngStream seed
+    (each in [1, m-1], so neither triple can be all-zero) with a
+    splitmix64-style scrambler: avalanching, and distinct inputs give
+    unrelated states."""
+    out: List[int] = []
+    x = int(seed) & 0xFFFFFFFFFFFFFFFF
+    for i in range(6):
+        x = (x + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+        z = x
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+        z ^= z >> 31
+        m = int(_M1) if i < 3 else int(_M2)
+        out.append(int(z % (m - 1)) + 1)
+    return out
+
+
+def seeded_stream(seed: int, name: str = "") -> RngStream:
+    """An RngStream at a reproducible state derived from an integer seed.
+
+    Unlike a plain ``RngStream()`` construction, this does NOT consume a
+    slot of the package-level stream sequence: components that seed
+    explicitly (fault campaigns, retry policies) stay bit-reproducible
+    no matter how many implicit streams were created before them."""
+    saved = list(RngStream._next_seed)
+    rng = RngStream(name)
+    RngStream._next_seed = saved
+    rng.set_seed(_derive_seed6(seed))
+    return rng

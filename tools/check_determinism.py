@@ -1,0 +1,81 @@
+#!/usr/bin/env python3
+"""Static determinism lint for the simulation core.
+
+The kernel, the solver and the fault-injection subsystem must be
+bit-reproducible: all randomness goes through the seeded RngStream
+(simgrid_tpu/utils/rngstream.py) and all time through the simulated
+clock.  This lint fails if any file under the audited packages reaches
+for the wall clock or Python's global RNG:
+
+    random.<anything>      (incl. np.random / jax.random)
+    time.time(
+    datetime.now(
+
+Comments are stripped before matching so prose mentioning the banned
+names stays legal; code and docstrings are audited as written.
+Run directly (exit 1 on violations) or through tests/test_determinism_lint.py.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List, Tuple
+
+AUDITED_DIRS = (
+    os.path.join("simgrid_tpu", "kernel"),
+    os.path.join("simgrid_tpu", "ops"),
+    os.path.join("simgrid_tpu", "faults"),
+)
+
+BANNED = [
+    (re.compile(r"\brandom\s*\."), "random."),
+    (re.compile(r"\btime\.time\s*\("), "time.time("),
+    (re.compile(r"\bdatetime\.now\s*\("), "datetime.now("),
+]
+
+_COMMENT = re.compile(r"#.*$")
+
+
+def collect_violations(repo_root: str) -> List[Tuple[str, int, str]]:
+    """(relative path, line number, stripped line) for every banned
+    pattern occurrence under the audited directories."""
+    violations: List[Tuple[str, int, str]] = []
+    for rel_dir in AUDITED_DIRS:
+        top = os.path.join(repo_root, rel_dir)
+        if not os.path.isdir(top):
+            continue
+        for dirpath, _dirnames, filenames in os.walk(top):
+            for filename in sorted(filenames):
+                if not filename.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, filename)
+                with open(path, encoding="utf-8") as f:
+                    for lineno, line in enumerate(f, 1):
+                        code = _COMMENT.sub("", line)
+                        for pattern, label in BANNED:
+                            if pattern.search(code):
+                                violations.append(
+                                    (os.path.relpath(path, repo_root),
+                                     lineno, line.strip()))
+                                break
+    return violations
+
+
+def main(argv: List[str]) -> int:
+    repo_root = argv[1] if len(argv) > 1 else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    violations = collect_violations(repo_root)
+    if not violations:
+        print("check_determinism: OK (%s clean)" % ", ".join(AUDITED_DIRS))
+        return 0
+    print("check_determinism: nondeterminism sources found "
+          "(use utils/rngstream.py and the simulated clock):")
+    for path, lineno, text in violations:
+        print(f"  {path}:{lineno}: {text}")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
