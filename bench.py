@@ -367,7 +367,9 @@ def main() -> None:
                 rows.append({k: r.get(k) for k in
                              ("backend", "jax_platform", "workload",
                               "advances", "wall_s", "t_sim",
-                              "n_events", "rounds")})
+                              "n_events", "rounds", "mode",
+                              "superstep_k", "syncs",
+                              "syncs_per_advance")})
         if rows:
             detail["e2e_drain_100k"] = rows
 

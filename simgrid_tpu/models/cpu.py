@@ -50,7 +50,9 @@ class CpuModel(Model):
             action.finish(ActionState.FINISHED)
 
     def update_actions_state_full(self, now: float, delta: float) -> None:
-        for action in list(self.started_action_set):
+        # direct IntrusiveList traversal (removal-safe for the current
+        # node): no O(V) list(...) allocation per advance
+        for action in self.started_action_set:
             action.update_remains(action.variable.value * delta)
             action.update_max_duration(delta)
             if ((action.get_remains_no_update() <= 0

@@ -33,6 +33,9 @@ class NetworkAction(Action):
         self.latency = 0.0
         self.lat_current = 0.0
         self.rate = -1.0
+        # True while this running action is counted in the model's
+        # latency_phase_count census (FULL-mode fast path)
+        self._lat_counted = False
         # Links on the route whose bandwidth is currently 0: the flow is
         # parked (infinite penalty) while any exist.  sharing_penalty keeps
         # only the *finite* part so a later bandwidth restore can undo the
@@ -52,6 +55,12 @@ class NetworkAction(Action):
         return math.inf if self.parked_links else self.sharing_penalty
 
     def set_state(self, state: ActionState) -> None:
+        if self._lat_counted and state != ActionState.STARTED:
+            # leaving the started set while still in the latency phase
+            # (failure, cancel, early finish): drop it from the model's
+            # latency census
+            self._lat_counted = False
+            self.model.latency_phase_count -= 1
         super().set_state(state)
         NetworkAction.on_state_change(self)
 
@@ -154,6 +163,10 @@ class NetworkModel(Model):
         super().__init__(engine, algo)
         engine.network_model = self
         self.loopback: Optional[LinkImpl] = None
+        #: running actions still in their latency phase (FULL mode).
+        #: Maintained so next_occurring_event_full can skip its O(V)
+        #: latency walk in the common all-latencies-paid drain phase.
+        self.latency_phase_count = 0
 
     def get_latency_factor(self, size: float) -> float:
         return config["network/latency-factor"]
@@ -167,12 +180,15 @@ class NetworkModel(Model):
 
     def next_occurring_event_full(self, now: float) -> float:
         # reference NetworkModel::next_occuring_event_full: account for the
-        # latency phase of not-yet-flowing actions
+        # latency phase of not-yet-flowing actions.  The walk is O(V)
+        # per advance and a pure-drain phase (all latencies paid) never
+        # needs it: the census counter skips it outright.
         min_res = super().next_occurring_event_full(now)
-        for action in self.started_action_set:
-            if action.latency > 0:
-                min_res = action.latency if min_res < 0 else min(min_res,
-                                                                 action.latency)
+        if self.latency_phase_count:
+            for action in self.started_action_set:
+                if action.latency > 0:
+                    min_res = action.latency if min_res < 0 \
+                        else min(min_res, action.latency)
         return min_res
 
     def communicate(self, src, dst, size: float, rate: float) -> NetworkAction:
@@ -196,6 +212,11 @@ class NetworkCm02Model(NetworkModel):
                 "You cannot disable network selective update with lazy updates"
             select = True
         self.set_maxmin_system(System(select))
+        # device-resident drain fast path (ops.drain_path): FULL-mode
+        # pure-drain phases delegate batches of advances to the
+        # superstep executor; a no-op until its preconditions hold
+        from ..ops.drain_path import DrainFastPath
+        self.drain_fastpath = DrainFastPath(self)
         self.loopback = self.create_link(
             "__loopback__", config["network/loopback-bw"],
             config["network/loopback-lat"], SharingPolicy.FATPIPE)
@@ -234,9 +255,19 @@ class NetworkCm02Model(NetworkModel):
                 action.finish(ActionState.FINISHED)
                 self.action_heap.remove(action)
 
+    def next_occurring_event_full(self, now: float) -> float:
+        dt = self.drain_fastpath.serve(now)
+        if dt is not None:
+            return dt
+        return super().next_occurring_event_full(now)
+
     def update_actions_state_full(self, now: float, delta: float) -> None:
+        if self.drain_fastpath.apply(now, delta):
+            return
         eps = config["surf/precision"]
-        for action in list(self.started_action_set):
+        # direct IntrusiveList traversal (removal-safe for the current
+        # node): no O(V) list(...) allocation per advance
+        for action in self.started_action_set:
             deltap = delta
             if action.latency > 0:
                 if action.latency > deltap:
@@ -245,9 +276,13 @@ class NetworkCm02Model(NetworkModel):
                 else:
                     deltap = double_update(deltap, action.latency, eps)
                     action.latency = 0.0
-                if action.latency <= 0.0 and not action.is_suspended():
-                    self.system.update_variable_penalty(action.variable,
-                                                        action.effective_penalty)
+                if action.latency <= 0.0:
+                    if action._lat_counted:
+                        action._lat_counted = False
+                        self.latency_phase_count -= 1
+                    if not action.is_suspended():
+                        self.system.update_variable_penalty(
+                            action.variable, action.effective_penalty)
             if not action.variable.get_number_of_constraint():
                 # no link on the route (e.g. vivaldi): complete immediately
                 action.update_remains(action.get_remains_no_update())
@@ -330,6 +365,11 @@ class NetworkCm02Model(NetworkModel):
                 date = action.latency + action.last_update
                 type_ = HeapType.NORMAL if not route else HeapType.LATENCY
                 self.action_heap.insert(action, date, type_)
+            elif action.state_set is self.started_action_set:
+                # FULL mode latency census (skips the O(V) walk in
+                # next_occurring_event_full once all latencies are paid)
+                action._lat_counted = True
+                self.latency_phase_count += 1
         else:
             action.variable = self.system.variable_new(
                 action, 1.0, -1.0, constraints_per_variable)
@@ -548,7 +588,7 @@ class NetworkConstantModel(NetworkModel):
 
     def update_actions_state(self, now: float, delta: float) -> None:
         eps = config["surf/precision"]
-        for action in list(self.started_action_set):
+        for action in self.started_action_set:
             if action.latency > 0:
                 if action.latency > delta:
                     action.latency = double_update(action.latency, delta, eps)

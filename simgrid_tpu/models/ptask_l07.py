@@ -42,7 +42,7 @@ class HostL07Model(Model):
     def update_actions_state(self, now: float, delta: float) -> None:
         # ptask_L07.cpp:86-134
         eps = config["surf/precision"]
-        for action in list(self.started_action_set):
+        for action in self.started_action_set:
             if action.latency > 0:
                 if action.latency > delta:
                     action.latency = double_update(action.latency, delta, eps)

@@ -46,7 +46,7 @@ class StorageN11Model(Model):
                           read_bw, write_bw, size)
 
     def update_actions_state_full(self, now: float, delta: float) -> None:
-        for action in list(self.started_action_set):
+        for action in self.started_action_set:
             action.update_remains(action.variable.value * delta)
             action.update_max_duration(delta)
             if ((action.get_remains_no_update() <= 0

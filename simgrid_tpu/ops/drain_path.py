@@ -1,0 +1,299 @@
+"""Engine-side drain fast path: delegate pure-drain phases to the
+device-resident superstep executor.
+
+A *pure-drain phase* is the shape the end-to-end north star degenerates
+to (BASELINE config #4): every started network flow has paid its
+latency, none carries a deadline, and no profile event fires before the
+next completion — the maestro's loop is then exactly
+
+    solve rates -> dt to next completion -> retire flows
+
+per advance, costing >= 3 host<->device syncs plus an O(V) Python walk
+each time through the generic `Model::update_actions_state` path.  This
+module detects that phase from `NetworkCm02Model`'s FULL-mode hooks and
+serves *batches* of advances from one `DrainSim` superstep dispatch
+(ops.lmm_drain), keeping completion-event ordering identical:
+
+* completions are emitted by walking `started_action_set` in order and
+  finishing exactly the planned set — the same traversal order the
+  generic path uses;
+* the plan is built from the incrementally-maintained ArrayView
+  (ops.lmm_view) — no graph walk — and is invalidated by its mutation
+  `version` counter, with the frees caused by *our own* served
+  completions whitelisted (`expected_frees`);
+* a partial advance (the engine chose a smaller delta: another model's
+  event, a profile event, a run-until bound) is handed back to the
+  generic loop after a deterministic REPLAY: the batch is re-executed
+  from its saved device state up to the served prefix (jax arrays are
+  immutable, so batch-start state is a free O(1) snapshot), remains and
+  rates are written back, and the generic code runs unchanged.
+
+Precision: f64 plans retire flows at the engine's absolute
+`maxmin/precision * surf/precision` threshold — bit-matching the
+generic double_update path — while f32 plans use the RELATIVE
+`drain/done-eps * size` rule so chip-precision ties stay grouped
+(see ops.lmm_drain).
+
+Fidelity trade documented in README: while a plan is being served, the
+`remains` of still-live flows and link usage introspection lag until
+the plan ends (they are synced on every invalidation); actors in a pure
+drain are blocked in comm waits, so nothing observes the lag.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.config import config
+
+#: started-flow census below which a plan is never attempted (plan
+#: bookkeeping beats the generic path only at scale); the config flag
+#: drain/min-flows overrides per run.
+_MIN_FLOWS_FLOOR = 8
+
+
+class DrainFastPath:
+    """Per-network-model drain plan server (see module docstring)."""
+
+    def __init__(self, model):
+        self.model = model
+        self.sim = None                     # active DrainSim, or None
+        self.slot_action: Dict[int, object] = {}
+        self.version = -1                   # ArrayView version at build
+        self.batches: List[Tuple[float, List[int]]] = []
+        self.saved = None                   # (pen, rem) at batch start
+        self.served = 0                     # advances of current batch
+        # observability (asserted by tests, reported by tools)
+        self.plans = 0
+        self.advances_served = 0
+        self.invalidations = 0
+        self.rollbacks = 0
+
+    # -- eligibility -------------------------------------------------------
+
+    def _enabled(self) -> bool:
+        mode = config["drain/fastpath"]
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(f"Unknown drain/fastpath {mode!r} "
+                             "(expected auto, on or off)")
+        if mode == "off":
+            return False
+        backend = config["lmm/backend"]
+        if backend not in ("jax", "auto"):
+            return False
+        model = self.model
+        if model.is_lazy() or model.system.selective_update_active:
+            return False
+        n = len(model.started_action_set)
+        if n < max(int(config["drain/min-flows"]), _MIN_FLOWS_FLOOR):
+            return False
+        if backend == "auto" and n < config["lmm/jax-threshold"]:
+            return False
+        if model.latency_phase_count:
+            return False
+        return True
+
+    def _build(self) -> bool:
+        """One O(V) walk to check the drain preconditions and map view
+        slots to actions, then a snapshot + DrainSim construction.
+        Amortized over the K advances each superstep serves."""
+        from ..kernel.resource import NO_MAX_DURATION
+        import jax
+        from .lmm_drain import DrainSim
+        from .lmm_view import ArrayView
+
+        model = self.model
+        system = model.system
+        view = system.array_view
+        if view is None:
+            view = ArrayView(system)
+
+        slot_action: Dict[int, object] = {}
+        for action in model.started_action_set:
+            var = action.variable
+            if (var is None or var.sharing_penalty <= 0
+                    or action.latency > 0
+                    or action.max_duration != NO_MAX_DURATION
+                    or action.is_suspended()
+                    or var.get_number_of_constraint() == 0):
+                return False
+            slot_action[var._view_slot] = action
+
+        dtype = (np.float32 if config["lmm/dtype"] == "float32"
+                 else np.float64)
+        snap = view.snapshot(dtype)
+        # NOTE: snapshot() may compact, which renumbers element slots
+        # but not variable slots — the slot map above stays valid.
+        pen_all = snap.v_penalty
+        live = np.flatnonzero(pen_all > 0)
+        # a live variable that is NOT a started flow (e.g. a failed
+        # action not yet reaped) shares bandwidth in the generic solve:
+        # not a pure drain
+        if len(live) != len(slot_action) or \
+                not all(int(s) in slot_action for s in live):
+            return False
+
+        n_v = len(pen_all)
+        sizes = np.ones(n_v)
+        rem = np.zeros(n_v)
+        pen = np.zeros(n_v, dtype)
+        for slot, action in slot_action.items():
+            sizes[slot] = max(action.cost, 1.0)
+            rem[slot] = action.get_remains_no_update()
+            pen[slot] = pen_all[slot]
+        if np.any(rem[live] <= 0):
+            return False        # zero-remains flows: let generic finish
+
+        if dtype == np.float64:
+            done_mode = "abs"
+            done_eps = (config["maxmin/precision"]
+                        * config["surf/precision"])
+        else:
+            done_mode = "rel"
+            done_eps = config["drain/done-eps"]
+
+        E = snap.n_elem
+        sim = DrainSim(
+            snap.e_var[:E], snap.e_cnst[:E], snap.e_w[:E],
+            snap.c_bound, sizes,
+            eps=config["maxmin/precision"], done_eps=done_eps,
+            dtype=dtype, done_mode=done_mode,
+            v_bound=snap.v_bound,
+            superstep=int(config["drain/superstep"]),
+            penalty=pen, remains=rem,
+            # device repacks would detach the replay snapshot from the
+            # element tables; plans are rebuilt often enough that the
+            # view's own host-side compaction covers shrinkage
+            repack_min=1 << 62)
+        self.sim = sim
+        self.slot_action = slot_action
+        self.version = view.version
+        self.batches = []
+        self.saved = None
+        self.served = 0
+        self.plans += 1
+        return True
+
+    # -- plan serving ------------------------------------------------------
+
+    def _dispatch_batch(self) -> bool:
+        """One superstep dispatch + fetch; False when it made no
+        progress (solve exceeded the round budget, or the drain
+        stalled — a parked/zero-rate remainder the generic path knows
+        how to diagnose)."""
+        sim = self.sim
+        self.saved = (sim._pen, sim._rem)
+        self.served = 0
+        try:
+            n_live, batches = sim.superstep_batch()
+        except RuntimeError:
+            # stall/non-convergence surfaced mid-batch: the advances it
+            # applied were never served, so restore the batch-start
+            # state (immutable arrays: an O(1) rollback) and hand the
+            # phase back to the generic path
+            sim._pen, sim._rem = self.saved
+            return False
+        if not batches:
+            return False
+        self.batches = batches
+        return True
+
+    def serve(self, now: float) -> Optional[float]:
+        """next_occurring_event_full hook: the dt to the next planned
+        completion, or None to fall back to the generic path."""
+        model = self.model
+        if self.sim is not None:
+            view = model.system.array_view
+            if view is None or view.version != self.version:
+                self._invalidate(sync=True)
+            elif not self.batches and not self._dispatch_batch():
+                self._invalidate(sync=True)
+        if self.sim is None:
+            if not self._enabled() or not self._build():
+                return None
+            if not self._dispatch_batch():
+                self._invalidate(sync=True)
+                return None
+        if not self.batches:
+            self._invalidate(sync=True)
+            return None
+        dt = self.batches[0][0]
+        # a profile event before the completion horizon can mutate the
+        # system mid-advance: generic path's turn
+        next_event = model.engine.future_evt_set.next_date()
+        if 0.0 <= next_event <= now + dt:
+            self._invalidate(sync=True)
+            return None
+        return dt
+
+    def apply(self, now: float, delta: float) -> bool:
+        """update_actions_state_full hook: commit the planned advance
+        when the engine advanced by exactly its dt; otherwise roll back
+        deterministically and let the generic loop run.  Returns True
+        when the advance was fully handled here."""
+        if self.sim is None or not self.batches:
+            return False
+        dt, slots = self.batches[0]
+        if delta != dt:
+            # partial advance (another model's event or a run bound):
+            # replay to the served prefix, write remains+rates back,
+            # generic loop takes it from here
+            self._invalidate(sync=True, with_rates=True)
+            return False
+        self.batches.pop(0)
+        self.served += 1
+        self.advances_served += 1
+        done = set(slots)
+        view = self.model.system.array_view
+        from ..kernel.resource import ActionState
+        # started-set order, exactly like the generic sweep
+        for action in self.model.started_action_set:
+            var = action.variable
+            if var is not None and var._view_slot in done:
+                view.expected_frees.add(id(var))
+                action.finish(ActionState.FINISHED)
+        return True
+
+    # -- teardown ----------------------------------------------------------
+
+    def _invalidate(self, sync: bool, with_rates: bool = False) -> None:
+        """Retire the plan.  With sync=True the device flow state is
+        replayed to the served prefix and `remains` written back to the
+        still-live actions (with_rates also refreshes
+        action.variable.value so the generic loop can apply a partial
+        advance)."""
+        sim, saved = self.sim, self.saved
+        self.sim = None
+        if sim is None:
+            return
+        self.invalidations += 1
+        if not sync:
+            return
+        if self.batches or with_rates:
+            # mid-batch stop: deterministic replay of the served prefix
+            # from the immutable batch-start arrays (no transfer)
+            if saved is not None:
+                sim._pen, sim._rem = saved
+                if self.served:
+                    sim.superstep_batch(k=self.served, fetch=False)
+                self.rollbacks += 1
+        rem = np.asarray(sim._rem)
+        pen = np.asarray(sim._pen)
+        rates = sim.solve_rates() if with_rates else None
+        # any advances this plan served mean the host System's cached
+        # rates are stale: force the next generic call to re-solve
+        self.model.system.modified = True
+        for slot, action in self.slot_action.items():
+            if pen[slot] <= 0:
+                continue
+            if action.state_set is not self.model.started_action_set:
+                continue
+            action.remains = float(rem[slot])
+            if rates is not None:
+                action.variable.value = float(rates[slot])
+        self.batches = []
+        self.saved = None
+        self.served = 0
+        self.slot_action = {}

@@ -713,6 +713,24 @@ def _pos_group(n: int) -> int:
     return 1
 
 
+def _stable_livefirst_perm(livemask, group: int):
+    """STABLE live-first partition permutation: perm[k] = index of the
+    k-th row when live rows come first, each side keeping its original
+    relative order.  Stability is what makes partition-based compaction
+    exact: the reduction order over the survivors is unchanged, so
+    dropping rows that contribute identity values keeps results
+    bit-identical to the dense run.  Shared by the ELL compaction chain
+    (_ell_chain_stage) and the drain executor's on-device repack.
+    `group` is the 2D scatter-index width (_pos_group)."""
+    lm = livemask.astype(jnp.int32)
+    n_live = jnp.count_nonzero(livemask)
+    pos = jnp.where(livemask, jnp.cumsum(lm) - 1,
+                    n_live + jnp.cumsum(1 - lm) - 1).astype(jnp.int32)
+    n = livemask.shape[0]
+    return jnp.zeros(n, jnp.int32).at[pos.reshape(-1, group)].set(
+        jnp.arange(n, dtype=jnp.int32).reshape(-1, group))
+
+
 @functools.partial(jax.jit,
                    static_argnames=("eps", "cap", "half", "has_fatpipe"))
 def _ell_chain_stage(vc_cnst, vc_w, vc_valid, v_penalty, orig_idx,
@@ -751,13 +769,8 @@ def _ell_chain_stage(vc_cnst, vc_w, vc_valid, v_penalty, orig_idx,
     livemask = ~v_fixed & v_enabled
     n_live = jnp.count_nonzero(livemask)
     overflow = (n_live > half) & jnp.any(st[4])
-    lm = livemask.astype(jnp.int32)
-    pos = jnp.where(livemask, jnp.cumsum(lm) - 1,
-                    n_live + jnp.cumsum(1 - lm) - 1).astype(jnp.int32)
     V = vc_cnst.shape[0]
-    g = _pos_group(V)
-    perm = jnp.zeros(V, jnp.int32).at[pos.reshape(-1, g)].set(
-        jnp.arange(V, dtype=jnp.int32).reshape(-1, g))
+    perm = _stable_livefirst_perm(livemask, _pos_group(V))
     keep = perm[:half]
 
     def rows(a):

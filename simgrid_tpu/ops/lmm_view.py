@@ -53,6 +53,13 @@ class ArrayView:
     def __init__(self, system):
         self.system = system
         self.dtype = np.float64          # master array dtype
+        #: mutation census for plan-based consumers (the drain fast
+        #: path): bumped by every hook EXCEPT the free of a variable
+        #: the consumer pre-registered in `expected_frees` — retiring a
+        #: flow the device plan already retired changes nothing the
+        #: plan does not know about
+        self.version = 0
+        self.expected_frees: set = set()
         #: per-requested-dtype dirty sets and handout snapshots
         self._dirty: Dict[np.dtype, set] = {}
         self._handout: Dict[np.dtype, Dict[str, np.ndarray]] = {}
@@ -111,7 +118,9 @@ class ArrayView:
         self.dead_elems = 0
 
     # -- mutation hooks (called from System) ------------------------------
-    def _touch(self, field: str) -> None:
+    def _touch(self, field: str, bump: bool = True) -> None:
+        if bump:
+            self.version += 1
         for dirty in self._dirty.values():
             dirty.add(field)
 
@@ -206,6 +215,13 @@ class ArrayView:
     def on_var_free(self, var) -> None:
         """Called BEFORE var.cnsts is cleared: kill the elements on
         device (zero weight) and recycle the variable slot."""
+        # an expected free (a retirement the drain fast path already
+        # applied on device) leaves the plan-consistency version alone
+        bump = True
+        if self.expected_frees:
+            bump = id(var) not in self.expected_frees
+            if not bump:
+                self.expected_frees.discard(id(var))
         for elem in var.cnsts:
             self.e_w[elem._view_eslot] = 0.0
             self.dead_elems += 1
@@ -213,8 +229,8 @@ class ArrayView:
         self.v_penalty[slot] = 0.0
         self.slot_var[slot] = None
         self._free_var_slots.append(slot)
-        self._touch("e_w")
-        self._touch("v_penalty")
+        self._touch("e_w", bump)
+        self._touch("v_penalty", bump)
 
     def on_cnst_free(self, cnst) -> None:
         slot = cnst._view_slot

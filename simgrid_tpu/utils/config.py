@@ -282,6 +282,29 @@ declare_flag("lmm/pad",
              "right for one-shot solves of big fixed systems, wrong "
              "for hot simulation loops where every new shape is a "
              "multi-second XLA compile)", "pow2")
+declare_flag("drain/fastpath",
+             "Delegate pure-drain phases (every started flow past its "
+             "latency phase, no deadlines, no profile event before the "
+             "next completion) to the device-resident superstep "
+             "executor: batches of advances run in one dispatch with "
+             "event ordering preserved.  auto/on require a JAX-capable "
+             "lmm/backend and at least drain/min-flows started flows; "
+             "off disables the fast path", "auto")
+declare_flag("drain/superstep",
+             "Advances per device dispatch in the drain fast path "
+             "(the K of the superstep executor; amortized host syncs "
+             "are ~1/K per advance)", 16)
+declare_flag("drain/min-flows",
+             "Minimum started network flows before the drain fast "
+             "path engages (below it the generic per-advance path is "
+             "cheaper than plan bookkeeping)", 4096)
+declare_flag("drain/done-eps",
+             "Relative completion threshold of the f32 drain "
+             "executor: a flow retires when its remainder falls to "
+             "done-eps * size (reference sg_maxmin_precision "
+             "semantics; keeps chip-precision ties in the f64 tie "
+             "groups).  f64 drains use the engine's absolute "
+             "maxmin*surf precision instead", 1e-4)
 declare_flag("lmm/unroll",
              "Unroll the device fixpoint into straight-line XLA instead "
              "of lax.while_loop: on, off, or auto (on for accelerators — "

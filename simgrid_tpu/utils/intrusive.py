@@ -73,6 +73,13 @@ class IntrusiveList:
         return self.size
 
     def __iter__(self):
+        # Safe against removal of the CURRENT node (the successor is
+        # captured before yielding) — the same guarantee the
+        # reference's ++it-before-erase idiom gives, so per-advance
+        # model sweeps can traverse the live list directly instead of
+        # paying an O(n) list(...) copy per advance.  Removing the
+        # *successor* mid-iteration is not supported (same as the
+        # reference).
         node = self.head
         while node is not None:
             nxt = getattr(node, self.hook)[1]

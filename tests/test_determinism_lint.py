@@ -25,6 +25,16 @@ def test_core_packages_are_deterministic():
         + "\n".join(f"{p}:{n}: {t}" for p, n, t in violations))
 
 
+def test_drain_runtime_determinism():
+    """Dynamic coverage of the superstep path (ISSUE 2 tooling): two
+    runs per dispatch mode are bit-identical and all modes agree on
+    completion order (small system — the tool's default size runs via
+    `check_determinism.py --runtime-drain`)."""
+    checker = _load_checker()
+    problems = checker.check_drain_runtime(n_c=48, n_v=200, k=8)
+    assert problems == []
+
+
 def test_checker_flags_violations(tmp_path):
     """The lint itself works: a planted file with each banned pattern is
     reported (guards against the lint silently matching nothing)."""

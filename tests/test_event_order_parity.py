@@ -14,10 +14,13 @@ from simgrid_tpu.ops.lmm_drain import DrainSim
 
 def drain_events(arrays, sizes, dtype, eps):
     E = arrays.n_elem
+    # fused solve+advance: halves the dispatches per advance and is
+    # bit-identical to the unfused path (pinned by
+    # tests/test_drain_superstep.py::test_fused_bit_identical_to_unfused)
     sim = DrainSim(arrays.e_var[:E], arrays.e_cnst[:E],
                    arrays.e_w[:E].astype(dtype),
                    arrays.c_bound[:arrays.n_cnst].astype(dtype),
-                   sizes, eps=eps, dtype=dtype)
+                   sizes, eps=eps, dtype=dtype, fused=True)
     sim.run()
     return sim.events
 
@@ -47,21 +50,24 @@ def test_f32_f64_event_order_parity(seed, n_c, n_v, deg):
     ids32 = [fid for _, fid in ev32]
     if ids64 == ids32:
         return
-    # Bound any divergence: f32 carries ~1.2e-7 relative error per
-    # value and the drain ACCUMULATES time over thousands of advances,
-    # so flows whose f64 completion times sit within ~1e-5 relative of
-    # each other are legitimate near-ties at chip precision — an
-    # ordering flip there is the bounded divergence the property
-    # documents (measured: 1 swap in 3000 events at 1.04e-6 rel on
-    # seed 3).  Anything beyond 1e-5 is a real parity failure.
+    # Bound any divergence.  Two legitimate sources: (1) f32 carries
+    # ~1.2e-7 relative error per value and the drain ACCUMULATES time
+    # over thousands of advances; (2) RELATIVE completion grouping
+    # (done_eps=1e-4 * size, the reference sg_maxmin_precision
+    # semantics) retires a flow up to done_eps of its size early, so a
+    # flow landing within the threshold window of a completion-group
+    # boundary may join the group in one dtype and miss it in the
+    # other — those flips sit within ~done_eps relative of each other
+    # in f64 time.  Anything beyond 2x the done threshold is a real
+    # parity failure.
     t64 = {fid: t for t, fid in ev64}
     flips = [(a, b) for a, b in zip(ids64, ids32) if a != b]
     for a, b in flips:
         rel = abs(t64[a] - t64[b]) / max(t64[a], t64[b])
-        assert rel < 1e-5, \
+        assert rel < 2e-4, \
             (f"f32 drain reordered flows {a} and {b} whose f64 "
              f"completion times differ by {rel:.2e} rel — beyond "
-             "accumulated chip precision")
+             "accumulated chip precision + relative-grouping window")
     # near-tie flips must stay rare (<1% of events)
     assert len(flips) < n_v * 0.01, \
         f"{len(flips)} order flips out of {n_v} events"
@@ -79,6 +85,6 @@ def test_equal_flows_complete_in_one_tie_group():
         sim = DrainSim(arrays.e_var[:E], arrays.e_cnst[:E],
                        arrays.e_w[:E].astype(dtype),
                        arrays.c_bound[:arrays.n_cnst].astype(dtype),
-                       sizes, eps=eps, dtype=dtype)
+                       sizes, eps=eps, dtype=dtype, fused=True)
         sim.run()
         assert len(sim.events) == 1000

@@ -14,6 +14,13 @@ for the wall clock or Python's global RNG:
 Comments are stripped before matching so prose mentioning the banned
 names stays legal; code and docstrings are audited as written.
 Run directly (exit 1 on violations) or through tests/test_determinism_lint.py.
+
+``--runtime-drain`` additionally executes the drain executor's three
+dispatch shapes (unfused, fused, superstep) twice each on a seeded
+system and verifies (a) run-to-run bit-reproducibility and (b)
+cross-mode completion-order equality — the dynamic counterpart of the
+static lint for the superstep path, whose ring-buffer event extraction
+must stay deterministic.
 """
 
 from __future__ import annotations
@@ -63,7 +70,61 @@ def collect_violations(repo_root: str) -> List[Tuple[str, int, str]]:
     return violations
 
 
+def check_drain_runtime(seed: int = 13, n_c: int = 128, n_v: int = 800,
+                        k: int = 8) -> List[str]:
+    """Dynamic determinism of the drain executor incl. the superstep
+    path: two runs per mode must be bit-identical (events, advance
+    count, clock) and all modes must agree on completion ORDER.
+    Returns a list of problem descriptions (empty = OK)."""
+    import numpy as np
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import build_arrays
+    from simgrid_tpu.ops.lmm_drain import DrainSim
+
+    rng = np.random.default_rng(seed)
+    arrays = build_arrays(rng, n_c, n_v, 3, np.float64)
+    sizes = rng.choice(np.linspace(1e5, 2e6, 32), n_v)
+    E = arrays.n_elem
+
+    def run(**kw):
+        sim = DrainSim(arrays.e_var[:E], arrays.e_cnst[:E],
+                       arrays.e_w[:E].astype(np.float64),
+                       arrays.c_bound[:arrays.n_cnst].astype(np.float64),
+                       sizes, eps=1e-9, dtype=np.float64,
+                       repack_min=64, **kw)
+        sim.run()
+        return sim
+
+    problems: List[str] = []
+    streams = {}
+    for label, kw in (("unfused", {}), ("fused", dict(fused=True)),
+                      ("superstep", dict(superstep=k))):
+        a, b = run(**kw), run(**kw)
+        if a.events != b.events or a.advances != b.advances \
+                or a.t != b.t:
+            problems.append(f"{label}: two identical runs diverged "
+                            f"({a.advances} vs {b.advances} advances)")
+        streams[label] = [f for _, f in a.events]
+    base = streams["unfused"]
+    for label in ("fused", "superstep"):
+        if streams[label] != base:
+            problems.append(
+                f"{label}: completion order differs from unfused")
+    return problems
+
+
 def main(argv: List[str]) -> int:
+    if "--runtime-drain" in argv:
+        problems = check_drain_runtime()
+        if problems:
+            print("check_determinism: drain runtime check FAILED:")
+            for p in problems:
+                print(f"  {p}")
+            return 1
+        print("check_determinism: drain runtime OK "
+              "(unfused/fused/superstep bit-reproducible, orders agree)")
+        argv = [a for a in argv if a != "--runtime-drain"]
     repo_root = argv[1] if len(argv) > 1 else os.path.dirname(
         os.path.dirname(os.path.abspath(__file__)))
     violations = collect_violations(repo_root)

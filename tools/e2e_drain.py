@@ -16,7 +16,17 @@ Workloads:
 Usage:
   python tools/e2e_drain.py --backend native|jax [--platform cpu|tpu]
          [--workload random|alltoall] [--flows 100000] [--ranks 320]
+         [--fused] [--superstep K]
          [--out bench_results/e2e_drain.jsonl] [--events-out FILE.npz]
+
+`--fused` runs the jax drain with the single-dispatch solve+advance
+kernel (1 sync/advance); `--superstep K` batches K advances per
+dispatch with the device completion ring (~1/K syncs/advance) and
+on-device repacks.  Rows are labeled with mode/superstep_k/syncs so
+bench.py reports each shape separately.  Completion grouping is
+RELATIVE (done_eps * size) on every backend, the reference's
+sg_maxmin_precision semantics — the fix for the round-5 f32
+tie-splitting abort.
 """
 import argparse
 import json
@@ -92,7 +102,8 @@ def drain_native(arrays, slot_flow, size, done_eps=1e-4):
     solver (native/lmm.cc) drives the same drain loop.  Per advance the
     live system is repacked with vectorized numpy (cheap next to the
     solve) so the C++ solver only ever sees live flows — the same
-    favor the JAX path gets from its repacks."""
+    favor the JAX path gets from its repacks.  Completion grouping is
+    relative (done_eps * size), matching DrainSim's default rule."""
     import numpy as np
     from simgrid_tpu.ops import lmm_native
 
@@ -130,7 +141,7 @@ def drain_native(arrays, slot_flow, size, done_eps=1e-4):
         if not np.isfinite(dt):
             raise RuntimeError("native drain stalled")
         rl2 = np.where(flowing, rl - rate * dt, rl)
-        done = flowing & (rl2 <= done_eps)
+        done = flowing & (rl2 < done_eps * size)
         t += dt
         advances += 1
         for fid in ids[keep[np.flatnonzero(done)]]:
@@ -142,7 +153,8 @@ def drain_native(arrays, slot_flow, size, done_eps=1e-4):
                         t_sim=t)
 
 
-def drain_jax(arrays, slot_flow, size, platform=None, done_eps=1e-4):
+def drain_jax(arrays, slot_flow, size, platform=None, done_eps=1e-4,
+              fused=False, superstep=0):
     import numpy as np
     if platform:
         import jax
@@ -157,23 +169,41 @@ def drain_jax(arrays, slot_flow, size, platform=None, done_eps=1e-4):
                    arrays.e_w[:E].astype(dtype),
                    arrays.c_bound[:arrays.n_cnst].astype(dtype),
                    np.full(arrays.n_var, float(size)),
-                   eps=1e-5, done_eps=done_eps, dtype=dtype)
+                   eps=1e-5, done_eps=done_eps, dtype=dtype,
+                   fused=fused, superstep=superstep)
     # warm the jits on the first advance before timing?  No: honest
     # end-to-end wall-clock includes compiles once per shape; report
     # both (first advance separately).
     t0 = time.perf_counter()
     n = sim.n_v
-    while n:
-        n = sim.advance()
-        if sim.advances % 50 == 0 or sim.advances <= 2:
-            print(f"[drain] advance {sim.advances}: live {n}, "
-                  f"t_sim {sim.t:.4f}, wall {time.perf_counter()-t0:.0f}s",
-                  flush=True)
+    if superstep:
+        while n:
+            before = sim.advances
+            n, _ = sim.superstep_batch()
+            if n and sim.advances == before:
+                n = sim._advance_fused()
+            print(f"[drain] superstep {sim.supersteps}: "
+                  f"advances {sim.advances}, live {n}, "
+                  f"t_sim {sim.t:.4f}, syncs {sim.syncs}, "
+                  f"wall {time.perf_counter()-t0:.0f}s", flush=True)
+    else:
+        while n:
+            n = sim.advance()
+            if sim.advances % 50 == 0 or sim.advances <= 2:
+                print(f"[drain] advance {sim.advances}: live {n}, "
+                      f"t_sim {sim.t:.4f}, "
+                      f"wall {time.perf_counter()-t0:.0f}s", flush=True)
     wall = time.perf_counter() - t0
     events = [(t, int(slot_flow[fid])) for t, fid in sim.events]
+    mode = ("superstep" if superstep else
+            "fused" if fused else "unfused")
     return events, dict(advances=sim.advances, wall_s=round(wall, 1),
                         t_sim=sim.t, rounds=sim.rounds, syncs=sim.syncs,
-                        repacks=sim.repacks, jax_platform=dev.platform)
+                        repacks=sim.repacks, jax_platform=dev.platform,
+                        mode=mode, superstep_k=superstep,
+                        supersteps=sim.supersteps,
+                        syncs_per_advance=round(
+                            sim.syncs / max(sim.advances, 1), 4))
 
 
 def main() -> None:
@@ -186,6 +216,11 @@ def main() -> None:
     ap.add_argument("--flows", type=int, default=100_000)
     ap.add_argument("--ranks", type=int, default=320)
     ap.add_argument("--size", type=float, default=1e6)
+    ap.add_argument("--fused", action="store_true",
+                    help="jax: fused solve+advance, 1 sync/advance")
+    ap.add_argument("--superstep", type=int, default=0, metavar="K",
+                    help="jax: K advances per dispatch (~1/K "
+                         "syncs/advance, on-device repacks)")
     ap.add_argument("--out", default=None)
     ap.add_argument("--events-out", default=None)
     args = ap.parse_args()
@@ -208,7 +243,8 @@ def main() -> None:
         events, stats = drain_native(arrays, slot_flow, args.size)
     else:
         events, stats = drain_jax(arrays, slot_flow, args.size,
-                                  args.platform)
+                                  args.platform, fused=args.fused,
+                                  superstep=args.superstep)
     rec.update(stats)
     rec["n_events"] = len(events)
     print(json.dumps(rec), flush=True)
