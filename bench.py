@@ -170,6 +170,103 @@ def stage_native(n_c: int, n_v: int, deg: int, seed: int) -> dict:
     return {"ms": round((time.perf_counter() - t0) * 1e3, 3)}
 
 
+def stage_churn(n_v: int, seed: int, cpu: bool, mode: str,
+                clusters: int = 960, chain: int = 96,
+                churn: float = 0.01, steps: int = 6) -> dict:
+    """Incremental-churn scenario (the warm-start trajectory metric):
+    `n_v` flows spread over independent cluster constraints plus a deep
+    background saturation chain (bounds doubling => ~`chain` fixpoint
+    rounds from a cold start in local-rounds mode).  Between solves,
+    `churn` of the flows retire and are replaced — the SMPI-style
+    mutating phase.  Modes map to the lmm/warm-start x lmm/delta-upload
+    grid:
+
+      legacy-subset   warm-start:off  (re-flatten the modified subset)
+      cold-full       cold + delta-upload:off (device-resident arrays,
+                      whole-field re-uploads, cold fixpoint)
+      cold-delta      cold + delta-upload:on  (indexed uploads only)
+      warm-selective  on   + delta-upload:on  (modified-component
+                      restarts: the headline)
+
+    Reported per mode: per-solve wall, fixpoint rounds, upload bytes
+    (full vs delta) and dirty-slot counts, medians over the churn
+    steps with the cold first solve separated out."""
+    if cpu:
+        _force_cpu()
+    import jax  # noqa: F401  (select backend before importing ops)
+    from simgrid_tpu.ops import lmm_jax, make_new_maxmin_system, opstats
+    from simgrid_tpu.utils.config import config
+
+    flags = {"legacy-subset": ("off", "off"),
+             "cold-full": ("cold", "off"),
+             "cold-delta": ("cold", "on"),
+             "warm-selective": ("on", "on")}[mode]
+    config["lmm/warm-start"], config["lmm/delta-upload"] = flags
+
+    rng = np.random.default_rng(seed)
+    s = make_new_maxmin_system(True)
+    s.solve_fn = lmm_jax.solve_jax
+    chain_cs = [s.constraint_new(None, float(2.0 ** i))
+                for i in range(chain)]
+    for i in range(chain - 1):
+        v = s.variable_new(None, 1, -1, 2)
+        s.expand(chain_cs[i], v, 1)
+        s.expand(chain_cs[i + 1], v, 1)
+    n_flows = n_v - (chain - 1)
+    cluster_cs = [s.constraint_new(None, float(rng.uniform(50, 200)))
+                  for _ in range(clusters)]
+    flows = [[] for _ in range(clusters)]
+    weights = rng.choice([0.5, 1.0, 2.0], size=n_flows)
+    for i in range(n_flows):
+        k = i % clusters
+        v = s.variable_new(None, 1.0)
+        s.expand(cluster_cs[k], v, float(weights[i]))
+        flows[k].append(v)
+
+    out = {"mode": mode, "flows": n_flows, "clusters": clusters,
+           "chain": chain, "churn": churn, "steps": steps}
+    before = opstats.snapshot()
+    t0 = time.perf_counter()
+    s.solve()
+    out["first_solve_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+    d = opstats.diff(before)
+    out["rounds_first"] = int(d.get("fixpoint_rounds", 0))
+    out["bytes_full_first"] = int(d.get("uploaded_bytes_full", 0))
+
+    churn_n = max(1, int(n_flows * churn))
+    walls, rounds, b_full, b_delta, dirt = [], [], [], [], []
+    for step in range(steps):
+        ks = rng.integers(0, clusters, size=churn_n)
+        for k in ks:
+            k = int(k)
+            if flows[k]:
+                s.variable_free(flows[k].pop(0))
+            v = s.variable_new(None, 1.0)
+            s.expand(cluster_cs[k], v, float(rng.choice([0.5, 1.0, 2.0])))
+            flows[k].append(v)
+        before = opstats.snapshot()
+        t0 = time.perf_counter()
+        s.solve()
+        walls.append((time.perf_counter() - t0) * 1e3)
+        d = opstats.diff(before)
+        rounds.append(int(d.get("fixpoint_rounds", 0)))
+        b_full.append(int(d.get("uploaded_bytes_full", 0)))
+        b_delta.append(int(d.get("uploaded_bytes_delta", 0)))
+        ws = s.warm_solver
+        dirt.append(ws.last_dirty_slots if ws else -1)
+        log(f"[stage churn/{mode}] step {step}: {walls[-1]:.1f} ms, "
+            f"{rounds[-1]} rounds, full {b_full[-1]}B, "
+            f"delta {b_delta[-1]}B")
+    med = lambda xs: round(float(np.median(xs)), 1)  # noqa: E731
+    out.update(solve_ms_med=med(walls), rounds_med=int(np.median(rounds)),
+               bytes_full_med=int(np.median(b_full)),
+               bytes_delta_med=int(np.median(b_delta)),
+               dirty_slots_med=int(np.median(dirt)),
+               warm_solves=(s.warm_solver.warm_solves
+                            if s.warm_solver else 0))
+    return out
+
+
 STAGES = {
     "probe": lambda args: stage_probe(),
     "dev": lambda args: stage_device(args.n_c, args.n_v, args.deg,
@@ -179,6 +276,9 @@ STAGES = {
                                     args.seed),
     "native": lambda args: stage_native(args.n_c, args.n_v, args.deg,
                                         args.seed),
+    "churn": lambda args: stage_churn(args.n_v, args.seed, args.cpu,
+                                      args.mode, args.clusters,
+                                      args.chain, args.churn, args.steps),
 }
 
 
@@ -350,6 +450,37 @@ def main() -> None:
         detail["platform"] = "cpu"
     detail["headline_platform"] = detail["platform"]
 
+    # --- incremental churn: warm-started selective solves --------------
+    # 100k flows, 1% retired+replaced between solves, against a deep
+    # background chain the churn never touches.  The trajectory metric:
+    # warm-started modified-component restarts vs cold full restarts
+    # (fixpoint rounds) and indexed delta uploads vs whole-field
+    # re-uploads (bytes/solve).  Rows land in
+    # bench_results/lmm_churn.jsonl for the record.
+    churn_rows = []
+    churn_params = dict(n_v=100_000, seed=42)
+    for mode in ("legacy-subset", "cold-full", "cold-delta",
+                 "warm-selective"):
+        row = run_stage("churn", timeout=1800, errors=errors, cpu=True,
+                        mode=mode, **churn_params)
+        if row:
+            row["bench"] = "lmm_churn"
+            row["platform"] = "cpu"
+            churn_rows.append(row)
+    if churn_rows:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "bench_results", "lmm_churn.jsonl")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "a") as fh:
+            for row in churn_rows:
+                fh.write(json.dumps(row) + "\n")
+        detail["lmm_churn"] = churn_rows
+        by_mode = {r["mode"]: r for r in churn_rows}
+        cold, warm = by_mode.get("cold-full"), by_mode.get("warm-selective")
+        if cold and warm and warm.get("rounds_med"):
+            detail["churn_rounds_cold_over_warm"] = round(
+                cold["rounds_med"] / max(warm["rounds_med"], 1), 1)
+
     # committed end-to-end drain results (tools/e2e_drain.py, run
     # separately because the native baseline alone takes ~an hour):
     # full config-#4 simulations to completion, with event-order
@@ -410,6 +541,13 @@ if __name__ == "__main__":
     parser.add_argument("--reps", type=int, default=5)
     parser.add_argument("--cpu", action="store_true",
                         help="force the CPU JAX backend")
+    parser.add_argument("--mode", default="warm-selective",
+                        help="churn stage: legacy-subset | cold-full | "
+                        "cold-delta | warm-selective")
+    parser.add_argument("--clusters", type=int, default=960)
+    parser.add_argument("--chain", type=int, default=96)
+    parser.add_argument("--churn", type=float, default=0.01)
+    parser.add_argument("--steps", type=int, default=6)
     parser.add_argument("--dtype", choices=["auto", "f32", "f64"],
                         default="auto",
                         help="solve precision (auto: f32 on TPU, f64 on "

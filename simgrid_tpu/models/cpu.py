@@ -175,6 +175,11 @@ class CpuCas01Model(CpuModel):
                 "You cannot disable cpu selective update with lazy updates"
             select = True
         self.set_maxmin_system(System(select))
+        if select and algo != UpdateAlgo.LAZY:
+            # FULL-mode never drains the modified-actions list (see
+            # NetworkCm02Model): selective bookkeeping here feeds the
+            # warm-started device solve only
+            self.system.modified_actions = None
 
     def create_cpu(self, host, speed_per_pstate: List[float],
                    core_count: int = 1) -> "CpuCas01":

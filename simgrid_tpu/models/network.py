@@ -212,6 +212,14 @@ class NetworkCm02Model(NetworkModel):
                 "You cannot disable network selective update with lazy updates"
             select = True
         self.set_maxmin_system(System(select))
+        if select and config["network/optim"] == "Full":
+            # FULL-mode sharing recomputation never drains the
+            # modified-actions list; keeping it would pin every retired
+            # action forever.  Selective bookkeeping here tracks
+            # constraints only — the input of the warm-started device
+            # solve (ops.lmm_warm), which is what Full+selective buys:
+            # mutating phases re-solve only the modified component.
+            self.system.modified_actions = None
         # device-resident drain fast path (ops.drain_path): FULL-mode
         # pure-drain phases delegate batches of advances to the
         # superstep executor; a no-op until its preconditions hold

@@ -84,7 +84,12 @@ class DrainFastPath:
         if backend not in ("jax", "auto"):
             return False
         model = self.model
-        if model.is_lazy() or model.system.selective_update_active:
+        # FULL-mode only (the hooks live in next_occurring_event_full);
+        # selective-update systems are fine: served completions feed
+        # the modified set through the var-free closure, so the warm
+        # solver (ops.lmm_warm) picks up exactly where the plan left
+        # off when the drain phase ends
+        if model.is_lazy():
             return False
         n = len(model.started_action_set)
         if n < max(int(config["drain/min-flows"]), _MIN_FLOWS_FLOOR):

@@ -65,6 +65,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from . import opstats
 from .lmm_jax import (_MAX_ROUNDS, _pos_group, _stable_livefirst_perm,
                       fixpoint)
 
@@ -438,6 +439,10 @@ class DrainSim:
             vb = np.full(self.n_v, -1.0, self.dtype)
             self.has_bounds = False
         self._vb = jax.device_put(vb, device)
+        opstats.bump("uploaded_bytes_full",
+                     pen0.nbytes + rem0.nbytes + thresh.nbytes
+                     + self._ids_dev.nbytes + self._cb.nbytes + vb.nbytes
+                     + sum(d.nbytes for d in self._dev))
         self._live0 = (int(np.count_nonzero(pen0 > 0))
                        if penalty is not None else self.n_v)
 
@@ -548,6 +553,8 @@ class DrainSim:
             if rounds >= _MAX_ROUNDS:
                 raise RuntimeError("drain solve did not converge")
         self.rounds += rounds
+        opstats.bump("dispatches")
+        opstats.bump("fixpoint_rounds", rounds)
 
         self._pen, self._rem, out = _drain_advance(
             self._pen, self._rem, self._thresh, carry[0])
@@ -573,6 +580,8 @@ class DrainSim:
             if rounds >= _MAX_ROUNDS:
                 raise RuntimeError("drain solve did not converge")
         self.rounds += rounds
+        opstats.bump("dispatches")
+        opstats.bump("fixpoint_rounds", rounds)
         dt, n_live = float(st[2]), int(st[3])
         done = st[4:] > 0
         return self._commit_advance(dt, n_live, done)
@@ -656,6 +665,7 @@ class DrainSim:
             eps=self.eps, n_c=self.n_c, n_v=self.n_v, k_max=k_max,
             group=group, has_bounds=self.has_bounds)
         self.supersteps += 1
+        opstats.bump("dispatches")
         if not fetch:
             return None, None
         p = np.asarray(packed)
@@ -672,6 +682,7 @@ class DrainSim:
         ring_id = p[o + self.n_v:o + 2 * self.n_v].astype(np.int64)
 
         self.rounds += rounds
+        opstats.bump("fixpoint_rounds", rounds)
         self.advances += adv
         batches: List[Tuple[float, List[int]]] = []
         start = 0

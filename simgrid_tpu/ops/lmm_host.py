@@ -326,6 +326,9 @@ class System:
         #: created lazily by the device backend; hooks below keep it in
         #: sync with every graph mutation
         self.array_view = None
+        #: device-resident incremental solver (ops.lmm_warm.WarmSolver),
+        #: created lazily on the first selective device solve
+        self.warm_solver = None
 
     def flag_action_modified(self, action) -> None:
         """Report one action's rate as changed by the current solve

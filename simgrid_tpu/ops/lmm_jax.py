@@ -982,6 +982,9 @@ def _device_args(kind: str, host_args, device):
             _DEVICE_ARGS_CACHE[key] = hit
             return dev_args
     dev_args = [jax.device_put(a, device) for a in host_args]
+    from . import opstats
+    opstats.bump("uploaded_bytes_full",
+                 sum(getattr(a, "nbytes", 0) for a in host_args))
     if len(_DEVICE_ARGS_CACHE) >= 8:
         # evict oldest-first (dict preserves insertion order) instead of
         # dropping the whole cache — the hot entry is usually the newest
@@ -1381,10 +1384,12 @@ def solve_arrays(arrays: LmmArrays, eps: float, device=None,
                 unroll=unroll, has_bounds=has_bounds,
                 has_fatpipe=has_fatpipe)
 
+    from . import opstats
     carry = None
     prev_progress = None
     while True:
         values, remaining, usage, rounds, carry = run_chunk(carry)
+        opstats.bump("dispatches")
         # ONE host sync per chunk: [rounds, light count, fixed count]
         # AND the result vectors ride a single device->host transfer
         # (per-transfer latency, not size, is the cost driver on a
@@ -1430,6 +1435,7 @@ def solve_arrays(arrays: LmmArrays, eps: float, device=None,
                 # the stall detector (a stalled solve never compacts:
                 # compaction requires the live set to halve)
                 prev_progress = None
+    opstats.bump("fixpoint_rounds", rounds)
     merged = (compactor.merge(values, remaining, usage)
               if compactor is not None else None)
     if merged is not None:
@@ -1449,7 +1455,8 @@ def check_convergence(rounds: int, n_cnst, n_var) -> None:
             f"check maxmin/precision vs the system's magnitudes")
 
 
-def solve_flattened(system: System, dtype, solve_flat) -> None:
+def solve_flattened(system: System, dtype, solve_flat,
+                    allow_device: bool = False) -> None:
     """Shared backend wrapper: flatten host graph, solve, scatter back.
 
     Mirrors the side effects of System::lmm_solve (maxmin.cpp:487-500):
@@ -1461,10 +1468,21 @@ def solve_flattened(system: System, dtype, solve_flat) -> None:
     Full-update systems run through the incrementally-maintained
     ArrayView (ops.lmm_view): no per-solve graph walk at all — the
     arrays were kept in sync by the mutation hooks, so a solve is
-    snapshot + device dispatch + scatter-back.  Selective-update
-    systems keep the walk (they solve varying subsets).
+    snapshot + device dispatch + scatter-back.
+
+    Selective-update systems on a device backend (``allow_device``)
+    are served by the warm solver (ops.lmm_warm): device-resident
+    masters, per-slot delta uploads, and warm-started modified-
+    component fixpoint restarts.  ``lmm/warm-start:off`` restores the
+    legacy behavior below — re-flatten the modified subset and solve
+    it cold each time.
     """
     eps = config["maxmin/precision"]
+
+    if system.selective_update_active and allow_device:
+        from . import lmm_warm
+        if lmm_warm.solve_selective(system, dtype, eps):
+            return
 
     if not system.selective_update_active:
         view = system.array_view
@@ -1567,10 +1585,14 @@ def solve_jax(system: System) -> None:
         return values, remaining, usage
 
     try:
-        solve_flattened(system, dtype, solve_flat)
+        solve_flattened(system, dtype, solve_flat, allow_device=True)
     except RuntimeError as exc:
         if config["lmm/strict"]:
             raise
+        # the host-exact fallback solves outside the warm solver, so
+        # any carried device fixpoint state is stale from here on
+        if system.warm_solver is not None:
+            system.warm_solver.invalidate()
         _fallback_count += 1
         system.fallback_count = getattr(system, "fallback_count", 0) + 1
         if not _fallback_warned:

@@ -26,6 +26,15 @@ an unchanged field keeps its previous ndarray identity, so the
 device-side per-array cache re-uploads only what actually changed —
 on a tunneled accelerator where every transfer costs 150-500 ms this
 is the difference between one small upload and eleven large ones.
+
+Beyond whole-field dirtiness the view also tracks dirty *indices* per
+named consumer (``consume``): the warm-start solver (ops.lmm_warm)
+keeps the master arrays resident on device and applies mutations as
+one indexed scatter update, so its upload cost scales with the number
+of touched slots instead of field size.  Index tracking is only
+meaningful while slot numbering is stable, so every renumbering or
+reallocation (growth, ``_compact``) bumps ``layout_epoch`` — consumers
+treat an epoch change as everything-dirty.
 """
 
 from __future__ import annotations
@@ -54,15 +63,24 @@ class ArrayView:
         self.system = system
         self.dtype = np.float64          # master array dtype
         #: mutation census for plan-based consumers (the drain fast
-        #: path): bumped by every hook EXCEPT the free of a variable
-        #: the consumer pre-registered in `expected_frees` — retiring a
+        #: path): bumped ONCE per mutation event (not once per touched
+        #: field) by every hook EXCEPT the free of a variable the
+        #: consumer pre-registered in `expected_frees` — retiring a
         #: flow the device plan already retired changes nothing the
         #: plan does not know about
         self.version = 0
+        #: bumped whenever slot numbering or array allocation changes
+        #: (growth, _compact): per-index dirtiness from before the bump
+        #: no longer addresses the same data
+        self.layout_epoch = 0
         self.expected_frees: set = set()
         #: per-requested-dtype dirty sets and handout snapshots
         self._dirty: Dict[np.dtype, set] = {}
         self._handout: Dict[np.dtype, Dict[str, np.ndarray]] = {}
+        #: named consumers tracking dirty INDICES per field (see
+        #: consume()); value per field is a set of slots, or True when
+        #: index identity was lost (whole field dirty)
+        self._consumers: Dict[str, Dict[str, object]] = {}
         self._free_var_slots: List[int] = []
         self._free_cnst_slots: List[int] = []
         self.slot_var: List = []
@@ -118,22 +136,42 @@ class ArrayView:
         self.dead_elems = 0
 
     # -- mutation hooks (called from System) ------------------------------
-    def _touch(self, field: str, bump: bool = True) -> None:
-        if bump:
-            self.version += 1
+    # Each hook bumps `version` exactly ONCE per mutation event (plan
+    # invalidation censuses count mutations, not fields) and marks the
+    # touched field/slot pairs via _mark.
+    def _mark(self, field: str, idx=None) -> None:
+        """Record `field` (slot `idx`, or the whole field when None) as
+        dirty for every handout dtype and every index consumer."""
         for dirty in self._dirty.values():
             dirty.add(field)
+        for cons in self._consumers.values():
+            cur = cons[field]
+            if cur is True:
+                continue
+            if idx is None:
+                cons[field] = True
+            else:
+                cur.add(idx)
 
-    def _touch_all(self) -> None:
-        for dirty in self._dirty.values():
-            dirty.update(_FIELDS)
+    def consume(self, name: str):
+        """Hand the named consumer its accumulated dirty-index map
+        ({field: set-of-slots | True}) and reset it.  Returns None on
+        the first call (unseen consumer: everything is dirty).  Index
+        validity is scoped to `layout_epoch`: after an epoch bump the
+        returned indices address renumbered slots and must be ignored
+        in favor of a full refresh."""
+        prev = self._consumers.get(name)
+        self._consumers[name] = {f: set() for f in _FIELDS}
+        return prev
 
     def on_policy(self, cnst) -> None:
+        self.version += 1
         self.c_fatpipe[cnst._view_slot] = \
             cnst.sharing_policy == SharingPolicy.FATPIPE
-        self._touch("c_fatpipe")
+        self._mark("c_fatpipe", cnst._view_slot)
 
     def on_new_cnst(self, cnst) -> None:
+        self.version += 1
         if self._free_cnst_slots:
             slot = self._free_cnst_slots.pop()
             self.slot_cnst[slot] = cnst
@@ -147,14 +185,18 @@ class ArrayView:
                 self.c_bound = cb
                 fat = np.zeros(grow, bool)
                 fat[:len(self.c_fatpipe)] = self.c_fatpipe
+                self.layout_epoch += 1
                 self.c_fatpipe = fat
+                self._mark("c_bound")
+                self._mark("c_fatpipe")
         cnst._view_slot = slot
         self.c_bound[slot] = cnst.bound
         self.c_fatpipe[slot] = cnst.sharing_policy == SharingPolicy.FATPIPE
-        self._touch("c_bound")
-        self._touch("c_fatpipe")
+        self._mark("c_bound", slot)
+        self._mark("c_fatpipe", slot)
 
     def on_new_var(self, var) -> None:
+        self.version += 1
         if self._free_var_slots:
             slot = self._free_var_slots.pop()
             self.slot_var[slot] = var
@@ -168,14 +210,18 @@ class ArrayView:
                 self.v_penalty = vp
                 vb = np.full(grow, -1.0, self.dtype)
                 vb[:len(self.v_bound)] = self.v_bound
+                self.layout_epoch += 1
                 self.v_bound = vb
+                self._mark("v_penalty")
+                self._mark("v_bound")
         var._view_slot = slot
         self.v_penalty[slot] = var.sharing_penalty
         self.v_bound[slot] = var.bound
-        self._touch("v_penalty")
-        self._touch("v_bound")
+        self._mark("v_penalty", slot)
+        self._mark("v_bound", slot)
 
     def on_expand(self, elem) -> None:
+        self.version += 1          # ONE bump per structural mutation
         k = self.n_elem
         if k >= len(self.e_var):
             grow = _bucket(k + 1, grow=True)
@@ -184,60 +230,71 @@ class ArrayView:
             self.e_var, self.e_cnst = ev, ec
             ew = np.zeros(grow, self.dtype)
             ew[:len(self.e_w)] = self.e_w
+            self.layout_epoch += 1
             self.e_w = ew
-            self._touch("e_var")
-            self._touch("e_cnst")
+            self._mark("e_var")
+            self._mark("e_cnst")
+            self._mark("e_w")
         elem._view_eslot = k
         self.e_var[k] = elem.variable._view_slot
         self.e_cnst[k] = elem.constraint._view_slot
         self.e_w[k] = elem.consumption_weight
         self.n_elem = k + 1
-        self._touch("e_var")
-        self._touch("e_cnst")
-        self._touch("e_w")
+        self._mark("e_var", k)
+        self._mark("e_cnst", k)
+        self._mark("e_w", k)
 
     def on_weight(self, elem) -> None:
+        self.version += 1
         self.e_w[elem._view_eslot] = elem.consumption_weight
-        self._touch("e_w")
+        self._mark("e_w", elem._view_eslot)
 
     def on_penalty(self, var) -> None:
+        self.version += 1
         self.v_penalty[var._view_slot] = var.sharing_penalty
-        self._touch("v_penalty")
+        self._mark("v_penalty", var._view_slot)
 
     def on_vbound(self, var) -> None:
+        self.version += 1
         self.v_bound[var._view_slot] = var.bound
-        self._touch("v_bound")
+        self._mark("v_bound", var._view_slot)
 
     def on_cbound(self, cnst) -> None:
+        self.version += 1
         self.c_bound[cnst._view_slot] = cnst.bound
-        self._touch("c_bound")
+        self._mark("c_bound", cnst._view_slot)
 
     def on_var_free(self, var) -> None:
         """Called BEFORE var.cnsts is cleared: kill the elements on
         device (zero weight) and recycle the variable slot."""
         # an expected free (a retirement the drain fast path already
-        # applied on device) leaves the plan-consistency version alone
+        # applied on device) leaves the plan-consistency version alone;
+        # the dirty-index marks still happen — device-resident masters
+        # must see the zeroing either way
         bump = True
         if self.expected_frees:
             bump = id(var) not in self.expected_frees
             if not bump:
                 self.expected_frees.discard(id(var))
+        if bump:
+            self.version += 1
         for elem in var.cnsts:
             self.e_w[elem._view_eslot] = 0.0
             self.dead_elems += 1
+            self._mark("e_w", elem._view_eslot)
         slot = var._view_slot
         self.v_penalty[slot] = 0.0
         self.slot_var[slot] = None
         self._free_var_slots.append(slot)
-        self._touch("e_w", bump)
-        self._touch("v_penalty", bump)
+        self._mark("v_penalty", slot)
 
     def on_cnst_free(self, cnst) -> None:
+        self.version += 1
         slot = cnst._view_slot
         self.c_bound[slot] = 0.0
         self.slot_cnst[slot] = None
         self._free_cnst_slots.append(slot)
-        self._touch("c_bound")
+        self._mark("c_bound", slot)
 
     # -- solve-side -------------------------------------------------------
     def _compact(self) -> None:
@@ -264,16 +321,23 @@ class ArrayView:
         self.e_var, self.e_cnst, self.e_w = e_var, e_cnst, e_w
         self.n_elem = n_e
         self.dead_elems = 0
-        self._touch("e_var")
-        self._touch("e_cnst")
-        self._touch("e_w")
+        self.version += 1          # element slots renumbered
+        self.layout_epoch += 1
+        self._mark("e_var")
+        self._mark("e_cnst")
+        self._mark("e_w")
+
+    def maybe_compact(self) -> None:
+        """Drop dead element slots once they outnumber live ones
+        (amortized O(1) per free); bumps layout_epoch when it runs."""
+        if self.dead_elems > max(64, self.n_elem - self.dead_elems):
+            self._compact()
 
     def snapshot(self, dtype) -> LmmArrays:
         """Copy-on-write handout in the requested dtype: dirty fields
         get a fresh copy (new identity => device re-upload), clean
         fields keep their previous object (device cache hit)."""
-        if self.dead_elems > max(64, self.n_elem - self.dead_elems):
-            self._compact()
+        self.maybe_compact()
         key = np.dtype(dtype)
         if key not in self._handout:
             self._handout[key] = {}

@@ -1,0 +1,49 @@
+"""Process-wide performance counters for the device solve paths.
+
+Every device-facing module reports into one flat counter table so
+tools can attribute cost per simulation phase without plumbing a
+context object through the solver entry points:
+
+* ``dispatches``            — device kernel dispatches (solver chunks,
+                              drain advances/supersteps, warm solves)
+* ``fixpoint_rounds``       — saturation rounds executed on device
+* ``uploaded_bytes_full``   — host->device bytes shipped as whole
+                              arrays (fresh ``device_put``)
+* ``uploaded_bytes_delta``  — host->device bytes shipped as indexed
+                              scatter payloads (ops.lmm_warm)
+* ``solves`` / ``warm_solves`` / ``cold_solves`` — device solve entry
+                              counts (warm = carried modified-component
+                              restart, cold = full re-init)
+
+Counters only ever increase; consumers snapshot before a phase and
+diff after (``snapshot``/``diff``).  Purely observational — nothing in
+the solve paths reads them back.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+_counters: Dict[str, float] = {}
+
+
+def bump(name: str, n=1) -> None:
+    _counters[name] = _counters.get(name, 0) + n
+
+
+def snapshot() -> Dict[str, float]:
+    return dict(_counters)
+
+
+def diff(before: Dict[str, float]) -> Dict[str, float]:
+    """Counter deltas since `before` (keys with zero delta omitted)."""
+    out = {}
+    for k, v in _counters.items():
+        d = v - before.get(k, 0)
+        if d:
+            out[k] = d
+    return out
+
+
+def reset() -> None:
+    _counters.clear()

@@ -269,6 +269,25 @@ declare_flag("lmm/chain",
              "solve).  on, off, or auto (accelerators only — the CPU "
              "backend compacts host-side via lmm/compact instead)",
              "auto")
+declare_flag("lmm/warm-start",
+             "Selective-update solves on the device backend: off "
+             "(legacy: re-flatten the modified constraint subset and "
+             "cold-solve it each time), cold (device-resident full "
+             "arrays, cold fixpoint restart every solve), on/auto "
+             "(warm-started restarts: only the modified component "
+             "re-enters the fixpoint, untouched components keep their "
+             "previous solution — exact because the max-min solution "
+             "decomposes by connected component).  Combine with "
+             "network/maxmin-selective-update (or cpu/...) to get "
+             "incremental device solves in mutating phases", "auto")
+declare_flag("lmm/delta-upload",
+             "Ship System mutations to the device-resident solver "
+             "arrays as ONE indexed scatter payload per solve (bytes "
+             "scale with touched slots) instead of re-uploading every "
+             "dirty field wholesale: on, off, or auto (on whenever the "
+             "warm-start device path serves the solve).  Off keeps "
+             "per-field copy-on-write refreshes — the bench baseline "
+             "and the escape hatch", "auto")
 declare_flag("lmm/strict",
              "Abort on a failed device LMM solve (non-convergence, stall "
              "or non-finite rates) instead of gracefully degrading to the "

@@ -1,0 +1,173 @@
+"""ArrayView churn stress: after ANY interleaving of mutations the
+incrementally-maintained arrays must agree bit-for-bit with a fresh
+flatten() of the same System, and the mutation census must count one
+version bump per mutation event (drain-plan invalidation counts
+mutations, not fields)."""
+
+import numpy as np
+import pytest
+
+from simgrid_tpu.ops import SharingPolicy, lmm_jax, make_new_maxmin_system
+from simgrid_tpu.ops.lmm_view import ArrayView
+
+
+def _assert_view_matches_flatten(s, dtype):
+    """The view's snapshot must carry, per live object, exactly the
+    values a fresh flatten() of the System would: same weights per
+    (variable, constraint) incidence, same bounds/penalties/policies —
+    bit-identical (==), in the requested handout dtype."""
+    view = s.array_view
+    snap = view.snapshot(dtype)
+    flat = lmm_jax.flatten(list(s.constraint_set), dtype)
+
+    # per-object scalar fields
+    for cnst in s.constraint_set:
+        ci = cnst._view_slot
+        assert snap.c_bound[ci] == np.dtype(dtype).type(cnst.bound)
+        assert snap.c_fatpipe[ci] == \
+            (cnst.sharing_policy == SharingPolicy.FATPIPE)
+    for var in s.variable_set:
+        vi = var._view_slot
+        assert snap.v_penalty[vi] == np.dtype(dtype).type(var.sharing_penalty)
+        assert snap.v_bound[vi] == np.dtype(dtype).type(var.bound)
+
+    # element incidences: snapshot slots resolve to the same
+    # (variable, constraint, weight) triples flatten produces
+    seen = []
+    for cnst in s.constraint_set:
+        for elem in list(cnst.enabled_element_set) \
+                + list(cnst.disabled_element_set):
+            k = elem._view_eslot
+            assert view.slot_var[snap.e_var[k]] is elem.variable
+            assert view.slot_cnst[snap.e_cnst[k]] is elem.constraint
+            assert snap.e_w[k] == \
+                np.dtype(dtype).type(elem.consumption_weight)
+            if elem._enabled_hook is not None:
+                seen.append((id(elem.variable), id(elem.constraint),
+                             float(elem.consumption_weight)))
+
+    if flat is not None:
+        arrays, vars_in_order = flat
+        fl = []
+        cnsts = list(s.constraint_set)
+        for k in range(arrays.n_elem):
+            fl.append((id(vars_in_order[arrays.e_var[k]]),
+                       id(cnsts[arrays.e_cnst[k]]),
+                       float(np.float64(arrays.e_w[k]))))
+        assert sorted(fl) == sorted(
+            (v, c, float(np.float64(np.dtype(dtype).type(w))))
+            for v, c, w in seen)
+
+    # no live slot beyond the padded shapes, dead slots invisible
+    live_w = snap.e_w[:snap.n_elem]
+    dead = [k for k in range(snap.n_elem)
+            if view.slot_var[snap.e_var[k]] is None
+            or view.slot_cnst[snap.e_cnst[k]] is None]
+    assert all(live_w[k] == 0 for k in dead)
+
+
+def test_churn_stress_view_matches_flatten():
+    """Interleaved create/free/update/compact churn with f64/f32
+    handout alternation; the view must stay exact after EVERY step."""
+    s = make_new_maxmin_system(False)
+    ArrayView(s)
+    rng = np.random.default_rng(123)
+    cnsts, variables = [], []
+    dtypes = [np.float64, np.float32]
+    for step in range(120):
+        op = rng.random()
+        if op < 0.22 or len(cnsts) < 2:
+            c = s.constraint_new(None, float(rng.uniform(1, 100)))
+            if rng.random() < 0.3:
+                c.sharing_policy = SharingPolicy.FATPIPE
+            cnsts.append(c)
+        elif op < 0.50:
+            bound = float(rng.uniform(0.5, 50)) if rng.random() < 0.4 \
+                else -1.0
+            v = s.variable_new(None, float(rng.choice([0.5, 1.0, 2.0])),
+                               bound, 3)
+            for ci in rng.choice(len(cnsts),
+                                 size=min(3, len(cnsts)), replace=False):
+                s.expand(cnsts[int(ci)], v,
+                         float(rng.choice([0.5, 1.0, 2.0])))
+            variables.append(v)
+        elif op < 0.62 and variables:
+            s.variable_free(
+                variables.pop(int(rng.integers(len(variables)))))
+        elif op < 0.74 and variables:
+            v = variables[int(rng.integers(len(variables)))]
+            if v.cnsts:
+                s.expand_add(v.cnsts[0].constraint, v,
+                             float(rng.choice([0.5, 1.0])))
+        elif op < 0.86 and cnsts:
+            s.update_constraint_bound(
+                cnsts[int(rng.integers(len(cnsts)))],
+                float(rng.uniform(1, 100)))
+        elif variables:
+            v = variables[int(rng.integers(len(variables)))]
+            if rng.random() < 0.5:
+                s.update_variable_bound(v, float(rng.uniform(0.5, 50)))
+            else:
+                s.update_variable_penalty(
+                    v, float(rng.choice([0.0, 0.5, 1.0, 2.0])))
+        if step % 13 == 12:
+            s.array_view._compact()         # forced renumbering
+        _assert_view_matches_flatten(s, dtypes[step % 2])
+
+
+def test_one_version_bump_per_mutation_event():
+    """on_expand (and every other hook) must bump the mutation census
+    exactly once per event, however many fields it touches."""
+    s = make_new_maxmin_system(False)
+    view = ArrayView(s)
+
+    v0 = view.version
+    c = s.constraint_new(None, 10.0)
+    assert view.version == v0 + 1
+    v = s.variable_new(None, 1.0)
+    assert view.version == v0 + 2
+    s.expand(c, v, 1.0)                     # the satellite case
+    assert view.version == v0 + 3
+    s.update_constraint_bound(c, 5.0)
+    assert view.version == v0 + 4
+    s.update_variable_bound(v, 2.0)
+    assert view.version == v0 + 5
+    c.sharing_policy = SharingPolicy.FATPIPE
+    assert view.version == v0 + 6
+    s.variable_free(v)                      # one event despite N marks
+    assert view.version == v0 + 7
+
+
+def test_expected_free_skips_version_but_marks_dirty():
+    """Drain-fast-path retirements must stay invisible to plan
+    invalidation while still reaching delta-upload consumers."""
+    s = make_new_maxmin_system(False)
+    view = ArrayView(s)
+    c = s.constraint_new(None, 10.0)
+    v = s.variable_new(None, 1.0)
+    s.expand(c, v, 1.0)
+    view.consume("probe")
+    ver = view.version
+    view.expected_frees.add(id(v))
+    s.variable_free(v)
+    assert view.version == ver              # plan-invisible
+    dirty = view.consume("probe")
+    assert dirty["e_w"] and dirty["v_penalty"]   # delta-visible
+
+
+def test_consumer_dirty_index_tracking():
+    s = make_new_maxmin_system(False)
+    view = ArrayView(s)
+    c = s.constraint_new(None, 10.0)
+    v = s.variable_new(None, 1.0)
+    s.expand(c, v, 1.0)
+    assert view.consume("w") is None        # first call: all dirty
+    s.update_constraint_bound(c, 4.0)
+    d = view.consume("w")
+    assert d["c_bound"] == {c._view_slot}
+    assert not d["e_w"] and not d["v_penalty"]
+    epoch = view.layout_epoch
+    view._compact()
+    assert view.layout_epoch == epoch + 1   # index identity lost
+    d = view.consume("w")
+    assert d["e_w"] is True

@@ -1,0 +1,212 @@
+"""Warm-started selective device solves (ops.lmm_warm): bit-identity
+with the cold full solve across churn, slot recycling, forced
+compaction and dtype alternation, plus the round/upload wins the path
+exists for."""
+
+import numpy as np
+import pytest
+
+from simgrid_tpu.ops import lmm_jax, make_new_maxmin_system
+from simgrid_tpu.utils.config import config
+
+
+@pytest.fixture(autouse=True)
+def _restore_flags():
+    saved = {k: config[k] for k in
+             ("lmm/warm-start", "lmm/delta-upload", "lmm/dtype",
+              "lmm/rounds")}
+    yield
+    for k, v in saved.items():
+        config[k] = v
+
+
+def _build(seed, n_clusters=8, per_cluster=6, chain=24):
+    """A selective System with component structure: a deep saturation
+    chain (bounds doubling, one fix per local round => ~`chain` rounds
+    cold) plus independent single-constraint clusters the churn
+    touches."""
+    s = make_new_maxmin_system(True)
+    s.solve_fn = lmm_jax.solve_jax
+    rng = np.random.default_rng(seed)
+    cs = [s.constraint_new(None, float(2.0 ** i)) for i in range(chain)]
+    for i in range(chain - 1):
+        v = s.variable_new(None, 1, -1, 2)
+        s.expand(cs[i], v, 1)
+        s.expand(cs[i + 1], v, 1)
+    clusters = [s.constraint_new(None, float(rng.uniform(5, 20)))
+                for _ in range(n_clusters)]
+    flows = {k: [] for k in range(n_clusters)}
+    for k in range(n_clusters):
+        for _ in range(per_cluster):
+            v = s.variable_new(None, 1.0)
+            s.expand(clusters[k], v, float(rng.choice([0.5, 1.0, 2.0])))
+            flows[k].append(v)
+    return s, clusters, flows, rng
+
+
+def _churn(s, clusters, flows, rng, step):
+    """One seeded mutation batch: retire+replace a flow (slot
+    recycling), plus periodic bound updates on constraints and
+    variables."""
+    k = int(rng.integers(len(clusters)))
+    if flows[k]:
+        s.variable_free(flows[k].pop(0))
+    v = s.variable_new(None, float(rng.choice([0.5, 1.0])))
+    s.expand(clusters[k], v, float(rng.choice([1.0, 2.0])))
+    flows[k].append(v)
+    if step % 3 == 0:
+        s.update_constraint_bound(clusters[k], float(rng.uniform(5, 20)))
+    if step % 5 == 0 and flows[k]:
+        s.update_variable_bound(flows[k][-1], float(rng.uniform(0.1, 3.0)))
+
+
+def _host_state(s):
+    return ([v.value for v in s.variable_set],
+            [(c.remaining, c.usage) for c in s.constraint_set])
+
+
+@pytest.mark.parametrize("rounds_mode", ["local", "global"])
+def test_warm_bitidentical_to_cold(rounds_mode):
+    """Warm-started selective solves produce EXACTLY the host state a
+    cold full restart produces, every step of a churny workload — the
+    soundness contract (max-min decomposes by connected component)."""
+    config["lmm/rounds"] = rounds_mode
+    config["lmm/delta-upload"] = "on"
+    A = _build(42)
+    B = _build(42)
+    rounds_cold, rounds_warm = [], []
+    for step in range(20):
+        _churn(*A[:3], A[3], step)
+        _churn(*B[:3], B[3], step)
+        config["lmm/warm-start"] = "cold"
+        A[0].solve()
+        config["lmm/warm-start"] = "on"
+        B[0].solve()
+        rounds_cold.append(A[0].warm_solver.last_rounds)
+        rounds_warm.append(B[0].warm_solver.last_rounds)
+        assert _host_state(A[0]) == _host_state(B[0]), \
+            f"step {step}: warm diverged from cold"
+    ws = B[0].warm_solver
+    assert ws.warm_solves >= 15, \
+        f"carry was not reused ({ws.warm_solves} warm solves)"
+    # the headline: small deltas skip the deep chain entirely
+    assert sum(rounds_warm[1:]) * 5 <= sum(rounds_cold[1:]), \
+        (rounds_cold, rounds_warm)
+
+
+def test_warm_survives_compaction_recycling_and_dtype_alternation():
+    """Carry invalidation must be exact across element-slot
+    renumbering (_compact), recycled variable slots, and f64/f32
+    alternation (independent per-dtype masters+carries)."""
+    config["lmm/delta-upload"] = "on"
+
+    def build(seed):
+        s = make_new_maxmin_system(True)
+        s.solve_fn = lmm_jax.solve_jax
+        rng = np.random.default_rng(seed)
+        cs = [s.constraint_new(None, float(rng.uniform(5, 50)))
+              for _ in range(12)]
+        flows = []
+        for _ in range(40):
+            v = s.variable_new(None, 1.0, -1.0, 2)
+            ks = rng.choice(12, size=2, replace=False)
+            for k in ks:
+                s.expand(cs[int(k)], v, float(rng.choice([0.5, 1.0, 2.0])))
+            flows.append(v)
+        return s, cs, flows, rng
+
+    A = build(7)
+    B = build(7)
+    dts = ["float64", "float32"]
+    for step in range(24):
+        for (s, cs, flows, rng) in (A, B):
+            for _ in range(3):
+                if flows and rng.random() < 0.5:
+                    s.variable_free(
+                        flows.pop(int(rng.integers(len(flows)))))
+                else:
+                    v = s.variable_new(None, float(rng.choice([0.5, 1.0])))
+                    s.expand(cs[int(rng.integers(12))], v, 1.0)
+                    flows.append(v)
+            if step % 4 == 0:
+                s.update_constraint_bound(cs[int(rng.integers(12))],
+                                          float(rng.uniform(5, 50)))
+            if step % 7 == 0 and s.array_view is not None:
+                s.array_view._compact()
+        config["lmm/dtype"] = dts[step % 2]
+        config["lmm/warm-start"] = "cold"
+        A[0].solve()
+        config["lmm/warm-start"] = "on"
+        B[0].solve()
+        assert _host_state(A[0]) == _host_state(B[0]), \
+            f"step {step}: warm diverged"
+    assert B[0].warm_solver.warm_solves > 0
+
+
+def test_warm_matches_exact_list_solver():
+    """Sanity: the warm path still solves the right problem (oracle
+    cross-check against the exact list solver)."""
+    config["lmm/warm-start"] = "on"
+    config["lmm/delta-upload"] = "on"
+    J = _build(3, chain=8)
+    L = _build(3, chain=8)
+    L[0].solve_fn = None
+    for step in range(8):
+        _churn(*J[:3], J[3], step)
+        _churn(*L[:3], L[3], step)
+        J[0].solve()
+        L[0].solve()
+        jv = np.array([v.value for v in J[0].variable_set])
+        lv = np.array([v.value for v in L[0].variable_set])
+        np.testing.assert_allclose(jv, lv, rtol=1e-9, atol=1e-9)
+
+
+def test_delta_upload_bytes_scale_with_dirty_slots():
+    """Per-solve upload bytes must track the touched-slot count, not
+    the field size."""
+    config["lmm/warm-start"] = "on"
+    config["lmm/delta-upload"] = "on"
+    s, clusters, flows, rng = _build(11, n_clusters=16, per_cluster=32,
+                                     chain=4)
+    s.solve()
+    ws = s.warm_solver
+    field_bytes = len(s.array_view.e_w) * 8
+    for step in range(4):
+        _churn(s, clusters, flows, rng, step + 1)   # ~4 slot touches
+        s.solve()
+        assert ws.last_dirty_slots <= 16
+        # payload ~= dirty slots * 16B (+ pow2 padding + the mc index
+        # vector); must sit far below one whole field re-upload
+        assert ws.last_upload_bytes < field_bytes / 4, \
+            (ws.last_upload_bytes, field_bytes)
+
+
+def test_off_mode_restores_legacy_path():
+    config["lmm/warm-start"] = "off"
+    s, clusters, flows, rng = _build(5, chain=4)
+    s.solve()
+    assert s.warm_solver is None       # legacy subset flatten served it
+    lv = _build(5, chain=4)
+    lv[0].solve_fn = None
+    lv[0].solve()
+    jv = np.array([v.value for v in s.variable_set])
+    ev = np.array([v.value for v in lv[0].variable_set])
+    np.testing.assert_allclose(jv, ev, rtol=1e-9, atol=1e-9)
+
+
+def test_host_fallback_invalidates_carry():
+    """After a graceful degradation to the exact host solver the
+    carried device state is stale and must not seed a warm restart."""
+    config["lmm/warm-start"] = "on"
+    s, clusters, flows, rng = _build(9, chain=4)
+    s.solve()
+    ws = s.warm_solver
+    assert any(st.carry is not None for st in ws._states.values())
+    ws.invalidate()
+    assert all(st.carry is None for st in ws._states.values())
+    _churn(s, clusters, flows, rng, 1)
+    s.solve()                          # cold restart, not warm
+    assert ws.last_mode == "cold"
+    _churn(s, clusters, flows, rng, 2)
+    s.solve()
+    assert ws.last_mode == "warm"      # carry re-established

@@ -21,6 +21,13 @@ system and verifies (a) run-to-run bit-reproducibility and (b)
 cross-mode completion-order equality — the dynamic counterpart of the
 static lint for the superstep path, whose ring-buffer event extraction
 must stay deterministic.
+
+``--runtime-warmstart`` runs a seeded mutating workload (flow churn
+over clustered constraints plus a deep background chain) twice per
+solve mode — cold full restart every solve vs warm-started selective
+(ops.lmm_warm) — and asserts (a) run-to-run bit-reproducibility per
+mode and (b) bit-identical completion-event order and final clocks
+ACROSS modes, plus that the warm runs actually reused their carry.
 """
 
 from __future__ import annotations
@@ -114,7 +121,112 @@ def check_drain_runtime(seed: int = 13, n_c: int = 128, n_v: int = 800,
     return problems
 
 
+def check_warmstart_runtime(seed: int = 17, n_clusters=24, per=12,
+                            chain=48, steps=20) -> List[str]:
+    """Dynamic determinism of the warm-started selective solve path: a
+    seeded churny mini-drain (solve -> advance to next completion ->
+    retire+replace flows) must produce bit-identical completion order,
+    event times and final clock whether every solve restarts cold or
+    warm-starts from the carried modified component."""
+    import numpy as np
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from simgrid_tpu.ops import lmm_jax, make_new_maxmin_system
+    from simgrid_tpu.utils.config import config
+
+    def run(mode):
+        saved = config["lmm/warm-start"], config["lmm/delta-upload"]
+        config["lmm/warm-start"] = mode
+        config["lmm/delta-upload"] = "on"
+        try:
+            rng = np.random.default_rng(seed)
+            s = make_new_maxmin_system(True)
+            s.solve_fn = lmm_jax.solve_jax
+            # background chain: deep cold fixpoint, untouched by churn
+            cs = [s.constraint_new(None, float(2.0 ** i))
+                  for i in range(chain)]
+            for i in range(chain - 1):
+                v = s.variable_new(None, 1, -1, 2)
+                s.expand(cs[i], v, 1)
+                s.expand(cs[i + 1], v, 1)
+            clusters = [s.constraint_new(None, float(rng.uniform(50, 200)))
+                        for _ in range(n_clusters)]
+            flows = []      # (var, remains, fid) in creation order
+            next_fid = [0]
+
+            def add_flow(k):
+                v = s.variable_new(None, 1.0)
+                s.expand(clusters[k], v, float(rng.choice([0.5, 1.0])))
+                flows.append([v, float(rng.uniform(1e3, 1e4)),
+                              next_fid[0]])
+                next_fid[0] += 1
+
+            for k in range(n_clusters):
+                for _ in range(per):
+                    add_flow(k)
+            t = 0.0
+            events = []
+            for step in range(steps):
+                if step % 4 == 3:
+                    s.update_constraint_bound(
+                        clusters[int(rng.integers(n_clusters))],
+                        float(rng.uniform(50, 200)))
+                s.solve()
+                rates = [f[0].value for f in flows]
+                dts = [f[1] / r for f, r in zip(flows, rates) if r > 0]
+                if not dts:
+                    break
+                dt = min(dts)
+                t += dt
+                done = []
+                for f, r in zip(flows, rates):
+                    if r > 0:
+                        f[1] -= r * dt
+                        if f[1] <= 1e-9:
+                            done.append(f)
+                for f in done:
+                    events.append((t, f[2]))
+                    k = int(rng.integers(n_clusters))
+                    s.variable_free(f[0])
+                    flows.remove(f)
+                    add_flow(k)
+            ws = s.warm_solver
+            return events, t, (ws.warm_solves if ws else 0)
+        finally:
+            config["lmm/warm-start"], config["lmm/delta-upload"] = saved
+
+    problems: List[str] = []
+    streams = {}
+    for mode in ("cold", "on"):
+        a, b = run(mode), run(mode)
+        if a[:2] != b[:2]:
+            problems.append(f"warm-start:{mode}: two identical runs "
+                            f"diverged ({len(a[0])} vs {len(b[0])} events)")
+        streams[mode] = a
+    if streams["cold"][:2] != streams["on"][:2]:
+        problems.append(
+            "warm-started selective run diverged from cold-every-solve "
+            f"(events {len(streams['cold'][0])} vs "
+            f"{len(streams['on'][0])}, clocks {streams['cold'][1]!r} vs "
+            f"{streams['on'][1]!r})")
+    if streams["on"][2] == 0:
+        problems.append("warm mode never reused its carry "
+                        "(nothing was actually tested)")
+    return problems
+
+
 def main(argv: List[str]) -> int:
+    if "--runtime-warmstart" in argv:
+        problems = check_warmstart_runtime()
+        if problems:
+            print("check_determinism: warm-start runtime check FAILED:")
+            for p in problems:
+                print(f"  {p}")
+            return 1
+        print("check_determinism: warm-start runtime OK (cold vs "
+              "warm-started selective bit-identical: event order and "
+              "final clocks)")
+        argv = [a for a in argv if a != "--runtime-warmstart"]
     if "--runtime-drain" in argv:
         problems = check_drain_runtime()
         if problems:

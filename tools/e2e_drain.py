@@ -22,7 +22,11 @@ Usage:
 `--fused` runs the jax drain with the single-dispatch solve+advance
 kernel (1 sync/advance); `--superstep K` batches K advances per
 dispatch with the device completion ring (~1/K syncs/advance) and
-on-device repacks.  Rows are labeled with mode/superstep_k/syncs so
+on-device repacks.  `--phase-stats` prints, per phase (build/route,
+latency advance, drain), the device dispatch count, uploaded bytes
+split full vs delta (ops.opstats counters fed by _device_args, the
+warm solver and the drain executor) and fixpoint rounds, and appends
+the counters to the labeled bench row.  Rows are labeled with mode/superstep_k/syncs so
 bench.py reports each shape separately.  Completion grouping is
 RELATIVE (done_eps * size) on every backend, the reference's
 sg_maxmin_precision semantics — the fix for the round-5 f32
@@ -221,6 +225,10 @@ def main() -> None:
     ap.add_argument("--superstep", type=int, default=0, metavar="K",
                     help="jax: K advances per dispatch (~1/K "
                          "syncs/advance, on-device repacks)")
+    ap.add_argument("--phase-stats", action="store_true",
+                    help="report per-phase dispatch count, uploaded "
+                         "bytes (full vs delta) and fixpoint rounds; "
+                         "counters ride the bench row")
     ap.add_argument("--out", default=None)
     ap.add_argument("--events-out", default=None)
     args = ap.parse_args()
@@ -230,9 +238,12 @@ def main() -> None:
         jax.config.update("jax_platforms", args.platform)
 
     import numpy as np
+    from simgrid_tpu.ops import opstats
 
+    phase_marks = [opstats.snapshot()]
     arrays, slot_flow, info = build_system(args.workload, args.flows,
                                            args.ranks, args.size)
+    phase_marks.append(opstats.snapshot())
     rec = {"backend": args.backend, "platform": args.platform,
            "workload": args.workload, **info,
            "n_cnst": arrays.n_cnst, "n_var": arrays.n_var,
@@ -247,6 +258,20 @@ def main() -> None:
                                   superstep=args.superstep)
     rec.update(stats)
     rec["n_events"] = len(events)
+    if args.phase_stats:
+        drain_mark = opstats.snapshot()
+        keys = ("dispatches", "uploaded_bytes_full",
+                "uploaded_bytes_delta", "fixpoint_rounds",
+                "warm_solves", "cold_solves")
+        phases = {}
+        for name, before, after in (
+                ("build+latency", phase_marks[0], phase_marks[1]),
+                ("drain", phase_marks[1], drain_mark)):
+            delta = {k: after.get(k, 0) - before.get(k, 0) for k in keys}
+            phases[name] = {k: v for k, v in delta.items() if v}
+            print(json.dumps({"phase": name, **phases[name]}),
+                  flush=True)
+        rec["phase_stats"] = phases
     print(json.dumps(rec), flush=True)
 
     if args.events_out:
