@@ -133,6 +133,37 @@ class FaultCampaign:
         self._events = events
         return events
 
+    def mean_availability(self, horizon: Optional[float] = None
+                          ) -> Dict[Tuple[str, str], float]:
+        """Fraction of ``[0, horizon)`` each declared resource spends UP
+        under the generated schedule — the fluid time-averaged capacity
+        factor of the campaign.
+
+        This is the static projection batched campaign drains consume
+        (:mod:`simgrid_tpu.parallel.campaign`): a pure-drain phase
+        cannot absorb mid-drain state flips, so a replica's fault
+        schedule is folded into per-resource capacity multipliers
+        instead.  Deterministic per seed, like the schedule itself."""
+        h = self.horizon if horizon is None else float(horizon)
+        if h <= 0:
+            raise ValueError("horizon must be > 0")
+        out: Dict[Tuple[str, str], float] = {}
+        for key, points in self.generate().items():
+            down = 0.0
+            fail_at: Optional[float] = None
+            for date, value in points:
+                if date >= h:
+                    break
+                if value == 0.0:
+                    fail_at = date
+                elif fail_at is not None:
+                    down += date - fail_at
+                    fail_at = None
+            if fail_at is not None:
+                down += h - fail_at
+            out[key] = 1.0 - down / h
+        return out
+
     # -- compilation onto an engine ---------------------------------------
     def schedule(self, engine=None) -> Dict[Tuple[str, str],
                                             List[Tuple[float, float]]]:

@@ -263,6 +263,19 @@ class NetworkCm02Model(NetworkModel):
                 action.finish(ActionState.FINISHED)
                 self.action_heap.remove(action)
 
+    def capture_drain_scenario(self):
+        """Snapshot the CURRENT pure-drain phase for the batched
+        campaign executor (parallel.campaign.Campaign.from_engine):
+        flattened arrays + slot/link maps, or None when the phase is
+        not a pure drain.  Gated exactly like the drain fast path —
+        FULL mode with every started flow past its latency and
+        unconstrained by deadlines — so a campaign can only fork from
+        a state the fast path itself could serve."""
+        from ..ops import drain_path
+        if self.is_lazy() or self.latency_phase_count:
+            return None
+        return drain_path.capture_scenario(self)
+
     def next_occurring_event_full(self, now: float) -> float:
         dt = self.drain_fastpath.serve(now)
         if dt is not None:

@@ -54,6 +54,83 @@ from ..utils.config import config
 _MIN_FLOWS_FLOOR = 8
 
 
+def _plan_inputs(model, dtype):
+    """The pure-drain precondition walk + flattened state, shared by
+    the fast path's plan builder and the campaign capture: one O(V)
+    pass maps view slots to started actions and rejects anything that
+    is not a pure drain (latency phases, deadlines, suspensions,
+    route-less flows, live non-flow variables, zero remains).  Returns
+    ``(slot_action, view, snap, sizes, rem, pen)`` or None."""
+    from ..kernel.resource import NO_MAX_DURATION
+    from .lmm_view import ArrayView
+
+    system = model.system
+    view = system.array_view
+    if view is None:
+        view = ArrayView(system)
+
+    slot_action: Dict[int, object] = {}
+    for action in model.started_action_set:
+        var = action.variable
+        if (var is None or var.sharing_penalty <= 0
+                or action.latency > 0
+                or action.max_duration != NO_MAX_DURATION
+                or action.is_suspended()
+                or var.get_number_of_constraint() == 0):
+            return None
+        slot_action[var._view_slot] = action
+
+    snap = view.snapshot(dtype)
+    # NOTE: snapshot() may compact, which renumbers element slots
+    # but not variable slots — the slot map above stays valid.
+    pen_all = snap.v_penalty
+    live = np.flatnonzero(pen_all > 0)
+    # a live variable that is NOT a started flow (e.g. a failed
+    # action not yet reaped) shares bandwidth in the generic solve:
+    # not a pure drain
+    if len(live) != len(slot_action) or \
+            not all(int(s) in slot_action for s in live):
+        return None
+
+    n_v = len(pen_all)
+    sizes = np.ones(n_v)
+    rem = np.zeros(n_v)
+    pen = np.zeros(n_v, dtype)
+    for slot, action in slot_action.items():
+        sizes[slot] = max(action.cost, 1.0)
+        rem[slot] = action.get_remains_no_update()
+        pen[slot] = pen_all[slot]
+    if np.any(rem[live] <= 0):
+        return None         # zero-remains flows: let generic finish
+    return slot_action, view, snap, sizes, rem, pen
+
+
+def capture_scenario(model):
+    """Snapshot the model's CURRENT pure-drain phase as the shared base
+    scenario of a batched campaign (parallel.campaign.Campaign): the
+    same preconditions as the fast path's plan builder, returned as
+    plain numpy arrays plus the slot->action and constraint->link-name
+    maps a campaign needs to label its dimensions.  None when the
+    phase is not a pure drain."""
+    plan = _plan_inputs(model, np.float64)
+    if plan is None:
+        return None
+    slot_action, view, snap, sizes, rem, pen = plan
+    E = snap.n_elem
+    names = [getattr(getattr(c, "id", None), "name", None)
+             for c in view.slot_cnst]
+    names += [None] * (len(snap.c_bound) - len(names))
+    return dict(e_var=snap.e_var[:E].copy(),
+                e_cnst=snap.e_cnst[:E].copy(),
+                e_w=snap.e_w[:E].copy(),
+                c_bound=snap.c_bound.copy(),
+                sizes=sizes, remains=rem,
+                penalty=pen.astype(np.float64),
+                v_bound=snap.v_bound.copy(),
+                link_names=names,
+                slot_action=dict(slot_action))
+
+
 class DrainFastPath:
     """Per-network-model drain plan server (see module docstring)."""
 
@@ -104,52 +181,14 @@ class DrainFastPath:
         """One O(V) walk to check the drain preconditions and map view
         slots to actions, then a snapshot + DrainSim construction.
         Amortized over the K advances each superstep serves."""
-        from ..kernel.resource import NO_MAX_DURATION
-        import jax
         from .lmm_drain import DrainSim
-        from .lmm_view import ArrayView
-
-        model = self.model
-        system = model.system
-        view = system.array_view
-        if view is None:
-            view = ArrayView(system)
-
-        slot_action: Dict[int, object] = {}
-        for action in model.started_action_set:
-            var = action.variable
-            if (var is None or var.sharing_penalty <= 0
-                    or action.latency > 0
-                    or action.max_duration != NO_MAX_DURATION
-                    or action.is_suspended()
-                    or var.get_number_of_constraint() == 0):
-                return False
-            slot_action[var._view_slot] = action
 
         dtype = (np.float32 if config["lmm/dtype"] == "float32"
                  else np.float64)
-        snap = view.snapshot(dtype)
-        # NOTE: snapshot() may compact, which renumbers element slots
-        # but not variable slots — the slot map above stays valid.
-        pen_all = snap.v_penalty
-        live = np.flatnonzero(pen_all > 0)
-        # a live variable that is NOT a started flow (e.g. a failed
-        # action not yet reaped) shares bandwidth in the generic solve:
-        # not a pure drain
-        if len(live) != len(slot_action) or \
-                not all(int(s) in slot_action for s in live):
+        plan = _plan_inputs(self.model, dtype)
+        if plan is None:
             return False
-
-        n_v = len(pen_all)
-        sizes = np.ones(n_v)
-        rem = np.zeros(n_v)
-        pen = np.zeros(n_v, dtype)
-        for slot, action in slot_action.items():
-            sizes[slot] = max(action.cost, 1.0)
-            rem[slot] = action.get_remains_no_update()
-            pen[slot] = pen_all[slot]
-        if np.any(rem[live] <= 0):
-            return False        # zero-remains flows: let generic finish
+        slot_action, view, snap, sizes, rem, pen = plan
 
         if dtype == np.float64:
             done_mode = "abs"

@@ -1,0 +1,620 @@
+"""Batched multi-replica scenario executor: vmapped solve+drain across
+a fleet of independent simulations in ONE device program.
+
+The paper's hot spot — the max-min fixpoint — is already fast for one
+simulation (fused/superstepped drains, warm-started selective solves),
+but the north star is serving *fleets* of scenarios: Monte Carlo fault
+campaigns, parameter sweeps, per-user what-ifs.  Run solo, each replica
+pays its own dispatches and uploads, and on the tunneled accelerator
+every host->device transfer costs 150-500 ms *regardless of size* —
+exactly the shape batched inference serving amortizes (cf. ASTRA-sim
+3.0 and the TPU fluid-flow framework in PAPERS.md, both of which get
+their throughput from batching many independent problem instances into
+one accelerator program).
+
+This module ``vmap``s the existing kernel *programs* (the raw functions
+behind ops.lmm_drain's solo jits and ops.lmm_jax's chunk kernels) over
+a leading replica axis:
+
+* **one shared platform flattening** — the COO structure (e_var,
+  e_cnst) and, by default, the element weights are uploaded ONCE for
+  the whole fleet; only per-replica state (bounds, remaining,
+  penalties, thresholds) carries the batch axis;
+* **compact scenario payloads** — per-replica scenarios are shipped as
+  small override records (bandwidth/size scale factors plus sparse
+  per-link and per-flow deltas) and *materialized on device*, so the
+  per-replica upload cost is O(overrides), not O(system);
+* **lockstep supersteps with an alive mask** — every dispatch runs up
+  to K advances for every live replica; finished (or diverged)
+  replicas go dark (their lane's while_loop cond is forced false, so
+  the batching rule freezes their state) instead of forcing ragged
+  shapes;
+* **per-replica completion rings, one fetch** — each superstep's
+  [B, ring] event log comes back in a single device->host transfer and
+  is demultiplexed into per-replica event streams.
+
+Determinism contract: each replica's event order AND clocks are
+bit-identical to the same scenario drained solo by ops.lmm_drain's
+DrainSim — the vmapped lane executes the exact same program, per-lane
+reductions keep the solo element order, and per-replica clocks are
+accumulated on the host in f64 exactly like the solo path
+(``tools/check_determinism.py --runtime-batch`` asserts this against a
+batch of 64 mixed fault/sweep scenarios).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import opstats
+from .lmm_jax import (_MAX_ROUNDS, _solve_kernel_chunk_batched,
+                      _solve_kernel_chunk_batched_fresh)
+from .lmm_drain import (_FLAG_BUDGET, _FLAG_OK, _FLAG_STALLED, _pos_group,
+                        _fused_step_program, _superstep_program, _to2d)
+
+
+# ---------------------------------------------------------------------------
+# Scenario overrides: compact per-replica deltas, materialized on device
+# ---------------------------------------------------------------------------
+
+class ReplicaOverrides:
+    """One replica's deviation from the shared base scenario.
+
+    Everything here is SMALL by design — a campaign's whole point is
+    that per-replica upload cost must not scale with system size:
+
+    * ``bw_scale``     — global link-capacity multiplier (sweeps);
+    * ``size_scale``   — global flow-size multiplier (sweeps);
+    * ``link_scale``   — sparse {constraint slot: capacity factor}
+                         (fault-campaign degradations, hot-spot what-ifs);
+    * ``flow_scale``   — sparse {variable slot: size factor};
+    * ``dead_flows``   — variable slots absent from this replica
+                         (penalty forced to 0: the flow never runs).
+    """
+
+    __slots__ = ("bw_scale", "size_scale", "link_scale", "flow_scale",
+                 "dead_flows")
+
+    def __init__(self, bw_scale: float = 1.0, size_scale: float = 1.0,
+                 link_scale: Optional[Dict[int, float]] = None,
+                 flow_scale: Optional[Dict[int, float]] = None,
+                 dead_flows: Iterable[int] = ()):
+        if bw_scale <= 0 or size_scale <= 0:
+            raise ValueError("bw_scale and size_scale must be > 0")
+        self.bw_scale = float(bw_scale)
+        self.size_scale = float(size_scale)
+        self.link_scale = dict(link_scale or {})
+        self.flow_scale = dict(flow_scale or {})
+        self.dead_flows = tuple(sorted(set(int(s) for s in dead_flows)))
+
+
+def derive_replica_arrays(c_bound, sizes, remains, penalty,
+                          ov: ReplicaOverrides):
+    """HOST materialization of one replica's f64 per-replica arrays —
+    the exact op-for-op mirror of the device `_materialize` kernel, so
+    a solo run (ops.lmm_drain.DrainSim over these arrays) is
+    bit-identical to the replica's lane in the batched program.  Keep
+    the two in sync: base*global-scale first, then the sparse factors
+    in sorted slot order."""
+    cb = np.asarray(c_bound, np.float64) * ov.bw_scale
+    for slot in sorted(ov.link_scale):
+        cb[slot] *= ov.link_scale[slot]
+    sz = np.asarray(sizes, np.float64) * ov.size_scale
+    rem = np.asarray(remains, np.float64) * ov.size_scale
+    for slot in sorted(ov.flow_scale):
+        sz[slot] *= ov.flow_scale[slot]
+        rem[slot] *= ov.flow_scale[slot]
+    pen = np.asarray(penalty, np.float64).copy()
+    for slot in ov.dead_flows:
+        pen[slot] = 0.0
+    return cb, sz, rem, pen
+
+
+def _pack_overrides(specs: List[ReplicaOverrides], n_c: int, n_v: int):
+    """Stack the fleet's overrides into padded payload arrays (pad
+    index = out-of-range slot, dropped by the device scatters; pad
+    factor = 1.0, a no-op)."""
+    B = len(specs)
+    sl = max(1, max(len(s.link_scale) for s in specs))
+    sf = max(1, max(len(s.flow_scale) for s in specs))
+    sd = max(1, max(len(s.dead_flows) for s in specs))
+    bw = np.array([s.bw_scale for s in specs], np.float64)
+    fs = np.array([s.size_scale for s in specs], np.float64)
+    li = np.full((B, sl), n_c, np.int32)
+    lf = np.ones((B, sl), np.float64)
+    fi = np.full((B, sf), n_v, np.int32)
+    ff = np.ones((B, sf), np.float64)
+    di = np.full((B, sd), n_v, np.int32)
+    for b, s in enumerate(specs):
+        for j, slot in enumerate(sorted(s.link_scale)):
+            li[b, j] = slot
+            lf[b, j] = s.link_scale[slot]
+        for j, slot in enumerate(sorted(s.flow_scale)):
+            fi[b, j] = slot
+            ff[b, j] = s.flow_scale[slot]
+        for j, slot in enumerate(s.dead_flows):
+            di[b, j] = slot
+    return bw, fs, li, lf, fi, ff, di
+
+
+@jax.jit
+def _materialize(base_cb, base_sizes, base_rem, base_pen,
+                 bw, fs, li, lf, fi, ff, di):
+    """DEVICE materialization of the fleet's per-replica f64 state from
+    the shared base + compact payloads: base*global-scale elementwise,
+    then sparse scatter-multiplies (pad slots scatter out of range and
+    drop).  Must stay the op-for-op mirror of derive_replica_arrays."""
+    def lane(bw_l, fs_l, li_l, lf_l, fi_l, ff_l, di_l):
+        cb = base_cb * bw_l
+        cb = cb.at[li_l].multiply(lf_l, mode="drop")
+        sz = base_sizes * fs_l
+        rem = base_rem * fs_l
+        sz = sz.at[fi_l].multiply(ff_l, mode="drop")
+        rem = rem.at[fi_l].multiply(ff_l, mode="drop")
+        pen = base_pen.at[di_l].set(0.0, mode="drop")
+        return cb, sz, rem, pen
+    return jax.vmap(lane)(bw, fs, li, lf, fi, ff, di)
+
+
+# ---------------------------------------------------------------------------
+# Batched kernel programs (vmapped solo programs + alive-mask gating)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit,
+                   static_argnames=("eps", "n_c", "n_v", "k_max",
+                                    "group", "has_bounds", "batch_w"))
+def _batch_superstep(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
+                     thresh, ids, alive, k, round_budget,
+                     eps: float, n_c: int, n_v: int, k_max: int,
+                     group: int, has_bounds: bool = False,
+                     batch_w: bool = False):
+    """One fleet superstep: the solo superstep program vmapped over the
+    replica axis.  A dead lane (alive=False) gets k=0, so its outer
+    while_loop cond is false on entry and the vmap batching rule
+    freezes its state — finished/diverged replicas cost nothing but
+    masked lanes, and their state is returned unchanged bit-for-bit."""
+    k = jnp.asarray(k, jnp.int32)
+
+    def lane(cb, pen_l, rem_l, th_l, alive_l, ew_l):
+        k_l = jnp.where(alive_l, k, jnp.int32(0))
+        return _superstep_program(
+            e_var, e_cnst, ew_l, cb, v_bound, pen_l, rem_l, th_l, ids,
+            k_l, jnp.asarray(round_budget, jnp.int32), jnp.int32(0),
+            eps=eps, n_c=n_c, n_v=n_v, k_max=k_max, group=group,
+            has_bounds=has_bounds)
+
+    return jax.vmap(lane, in_axes=(0, 0, 0, 0, 0,
+                                   0 if batch_w else None))(
+        c_bound, pen, rem, thresh, alive, e_w)
+
+
+def _batch_fused_lane(e_var, e_cnst, ew_l, cb, v_bound, pen_l, rem_l,
+                      th_l, carry_l, act, eps, n_c, n_v, chunk,
+                      has_bounds):
+    pen2, rem2, carry2, stats = _fused_step_program(
+        e_var, e_cnst, ew_l, cb, v_bound, pen_l, rem_l, th_l, carry_l,
+        eps=eps, n_c=n_c, n_v=n_v, chunk=chunk, has_bounds=has_bounds)
+    sel = lambda a, b: jnp.where(act, a, b)  # noqa: E731
+    if carry_l is None:
+        carry_out = carry2
+    else:
+        carry_out = tuple(sel(n, o) for n, o in zip(carry2, carry_l))
+    return (sel(pen2, pen_l), sel(rem2, rem_l), carry_out,
+            jnp.where(act, stats, jnp.zeros_like(stats)))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("eps", "n_c", "n_v", "chunk",
+                                    "has_bounds", "batch_w"))
+def _batch_fused_fresh(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
+                       thresh, active, eps: float, n_c: int, n_v: int,
+                       chunk: int, has_bounds: bool = False,
+                       batch_w: bool = False):
+    """Fleet fused solve+advance, fresh fixpoint start.  Inactive lanes
+    still trace through the math but every output is frozen to the
+    input state, so only `active` replicas advance."""
+    def lane(cb, pen_l, rem_l, th_l, act, ew_l):
+        return _batch_fused_lane(e_var, e_cnst, ew_l, cb, v_bound,
+                                 pen_l, rem_l, th_l, None, act, eps,
+                                 n_c, n_v, chunk, has_bounds)
+    return jax.vmap(lane, in_axes=(0, 0, 0, 0, 0,
+                                   0 if batch_w else None))(
+        c_bound, pen, rem, thresh, active, e_w)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("eps", "n_c", "n_v", "chunk",
+                                    "has_bounds", "batch_w"))
+def _batch_fused_cont(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
+                      thresh, carry, active, eps: float, n_c: int,
+                      n_v: int, chunk: int, has_bounds: bool = False,
+                      batch_w: bool = False):
+    """Continuation flavor: resume per-replica fixpoint carries (rare —
+    only when a solve needs more than one chunk of rounds)."""
+    def lane(cb, pen_l, rem_l, th_l, carry_l, act, ew_l):
+        return _batch_fused_lane(e_var, e_cnst, ew_l, cb, v_bound,
+                                 pen_l, rem_l, th_l, carry_l, act, eps,
+                                 n_c, n_v, chunk, has_bounds)
+    return jax.vmap(lane, in_axes=(0, 0, 0, 0, 0, 0,
+                                   0 if batch_w else None))(
+        c_bound, pen, rem, thresh, carry, active, e_w)
+
+
+# ---------------------------------------------------------------------------
+# Batched flattened solve (no drain): B rate queries, one program
+# ---------------------------------------------------------------------------
+
+def solve_arrays_batch(e_var, e_cnst, e_w, c_bound, c_fatpipe,
+                       v_penalty, v_bound, eps: float,
+                       parallel_rounds: bool = True,
+                       chunk: int = 4096, device=None):
+    """Solve B independent max-min systems sharing one COO structure in
+    lockstep chunks; returns (values [B,V], remaining [B,C],
+    usage [B,C], rounds [B]).
+
+    ``e_w`` may be [E] (shared weights) or [B,E]; ``c_bound``,
+    ``v_penalty``, ``v_bound`` are [B,·].  Convergence is checked once
+    per chunk for the WHOLE fleet in a single [B, 3+V+2C] fetch;
+    converged lanes are frozen by their own loop cond, so stragglers
+    never recompute finished replicas."""
+    e_w = np.asarray(e_w)
+    batch_w = e_w.ndim == 2
+    dtype = e_w.dtype
+    c_bound = np.asarray(c_bound, dtype)
+    v_penalty = np.asarray(v_penalty, dtype)
+    v_bound = np.asarray(v_bound, dtype)
+    B = c_bound.shape[0]
+    n_c, n_v = c_bound.shape[1], v_penalty.shape[1]
+    c_fatpipe = np.asarray(c_fatpipe, bool)
+    has_bounds = bool(np.any((v_bound > 0) & (v_penalty > 0)))
+    has_fatpipe = bool(c_fatpipe.any())
+    eps_f = float(eps)
+
+    shared = [jax.device_put(np.asarray(a), device)
+              for a in (e_var, e_cnst)]
+    fat = jax.device_put(c_fatpipe, device)
+    batched = [jax.device_put(a, device)
+               for a in (e_w, c_bound, v_penalty, v_bound)]
+    opstats.bump("uploaded_bytes_full",
+                 sum(a.nbytes for a in (e_w, c_bound, v_penalty,
+                                        v_bound))
+                 + sum(np.asarray(a).nbytes for a in (e_var, e_cnst))
+                 + c_fatpipe.nbytes)
+
+    carry = None
+    prev_progress = None
+    while True:
+        if carry is None:
+            out = _solve_kernel_chunk_batched_fresh(
+                shared[0], shared[1], batched[0], batched[1], fat,
+                batched[2], batched[3], eps=eps_f, n_c=n_c, n_v=n_v,
+                parallel_rounds=parallel_rounds, chunk=chunk,
+                has_bounds=has_bounds, has_fatpipe=has_fatpipe,
+                batch_w=batch_w)
+        else:
+            out = _solve_kernel_chunk_batched(
+                shared[0], shared[1], batched[0], batched[1], fat,
+                batched[2], batched[3], carry, eps=eps_f, n_c=n_c,
+                n_v=n_v, parallel_rounds=parallel_rounds, chunk=chunk,
+                has_bounds=has_bounds, has_fatpipe=has_fatpipe,
+                batch_w=batch_w)
+        values, remaining, usage, rounds, carry = out
+        opstats.bump("dispatches")
+        opstats.bump("batch_dispatches")
+        rdt = values.dtype
+        fetched = np.asarray(jnp.concatenate([
+            jnp.stack([rounds.astype(rdt),
+                       jnp.count_nonzero(carry[4], axis=1).astype(rdt),
+                       jnp.count_nonzero(carry[1], axis=1).astype(rdt)],
+                      axis=1),
+            values, remaining.astype(rdt), usage.astype(rdt)], axis=1))
+        rounds_h = fetched[:, 0].astype(np.int64)
+        n_light = fetched[:, 1].astype(np.int64)
+        n_fixed = fetched[:, 2].astype(np.int64)
+        if not n_light.any():
+            values = fetched[:, 3:3 + n_v]
+            remaining = fetched[:, 3 + n_v:3 + n_v + n_c]
+            usage = fetched[:, 3 + n_v + n_c:3 + n_v + 2 * n_c]
+            break
+        if (rounds_h >= _MAX_ROUNDS).any():
+            bad = int(np.argmax(rounds_h >= _MAX_ROUNDS))
+            raise RuntimeError(
+                f"LMM batch solve: replica {bad} did not converge "
+                f"within {_MAX_ROUNDS} saturation rounds "
+                f"({n_c} constraints, {n_v} variables, batch {B})")
+        progress = (n_light.tobytes(), n_fixed.tobytes())
+        if progress == prev_progress:
+            bad = int(np.argmax(n_light > 0))
+            raise RuntimeError(
+                f"LMM batch solve stalled: replica {bad} made no "
+                f"progress over {chunk} rounds ({int(n_light[bad])} "
+                f"active constraints); the system does not converge "
+                f"at eps={eps} in {np.dtype(dtype).name} precision")
+        prev_progress = progress
+    opstats.bump("fixpoint_rounds", int(rounds_h.sum()))
+    return values, remaining, usage, rounds_h
+
+
+# ---------------------------------------------------------------------------
+# The batched drain executor
+# ---------------------------------------------------------------------------
+
+class ReplicaState:
+    """Host-side record of one replica in a fleet."""
+
+    __slots__ = ("index", "events", "t", "advances", "alive", "error")
+
+    def __init__(self, index: int):
+        self.index = index
+        self.events: List[Tuple[float, int]] = []
+        self.t = 0.0              # f64 master clock (host-accumulated)
+        self.advances = 0
+        self.alive = True
+        self.error: Optional[str] = None
+
+
+class BatchDrainSim:
+    """Drain B scenario replicas of ONE shared platform flattening to
+    completion in lockstep batched device programs.
+
+    Constructor arguments mirror ops.lmm_drain.DrainSim — COO elements,
+    constraint capacities, flow sizes — plus ``overrides``: one
+    :class:`ReplicaOverrides` per replica, materialized on device from
+    compact payloads (upload cost O(total overrides), not O(B*system)).
+
+    Per-replica state is (c_bound, penalties, remaining, thresholds)
+    with the batch axis leading; the structure tables and (by default)
+    the element weights are shared and uploaded once.  Finished or
+    diverged replicas go dark via the alive mask instead of forcing
+    ragged shapes; the fleet repacks NEVER (lockstep shapes), so each
+    lane's reduction order — and therefore its event order and clock —
+    is bit-identical to a solo no-repack DrainSim of the same scenario.
+    """
+
+    def __init__(self, e_var, e_cnst, e_w, c_bound, sizes,
+                 overrides: List[ReplicaOverrides],
+                 eps: float = 1e-5, done_eps: float = 1e-4,
+                 dtype=np.float64, done_mode: str = "rel",
+                 superstep: int = 8, superstep_rounds: int = 0,
+                 device=None, v_bound=None, penalty=None, remains=None,
+                 e_w_batch=None):
+        if not overrides:
+            raise ValueError("BatchDrainSim needs at least one replica")
+        if done_mode not in ("rel", "abs"):
+            raise ValueError(f"Unknown done_mode {done_mode!r} "
+                             "(expected rel or abs)")
+        self.eps = float(eps)
+        self.done_eps = float(done_eps)
+        self.done_mode = done_mode
+        self.dtype = np.dtype(dtype)
+        self.device = device
+        self.B = len(overrides)
+        self.overrides = list(overrides)
+        self.n_c = len(c_bound)
+        self.n_v = len(sizes)
+        self.superstep_k = int(superstep)
+        if self.superstep_k <= 0:
+            raise ValueError("BatchDrainSim is superstep-only "
+                             "(superstep >= 1)")
+        if not superstep_rounds:
+            platform = (device.platform if device is not None
+                        else jax.devices()[0].platform)
+            # same per-dispatch round-budget reasoning as the solo
+            # DrainSim: the watchdog bound is per KERNEL, and a vmapped
+            # lane runs the same per-advance round count as solo
+            superstep_rounds = (self.superstep_k * 512
+                                if platform == "cpu" else 64 * 4)
+        self.superstep_rounds = int(superstep_rounds)
+
+        # shared base (f64 masters for materialization + dtype tables)
+        self._base_cb = np.asarray(c_bound, np.float64)
+        self._base_sizes = np.asarray(sizes, np.float64)
+        self._base_rem = (np.asarray(remains, np.float64)
+                          if remains is not None else self._base_sizes)
+        self._base_pen = (np.asarray(penalty, np.float64)
+                          if penalty is not None
+                          else np.ones(self.n_v, np.float64))
+        ev2 = _to2d(np.asarray(e_var, np.int32))
+        ec2 = _to2d(np.asarray(e_cnst, np.int32))
+        self.batch_w = e_w_batch is not None
+        if self.batch_w:
+            ew_host = np.asarray(e_w_batch, self.dtype)
+            ew2 = np.stack([_to2d(ew_host[b]) for b in range(self.B)])
+        else:
+            ew2 = _to2d(np.asarray(e_w, self.dtype))
+        if v_bound is not None:
+            vb = np.asarray(v_bound, self.dtype)
+            self.has_bounds = bool(np.any(vb > 0))
+        else:
+            vb = np.full(self.n_v, -1.0, self.dtype)
+            self.has_bounds = False
+
+        self._dev = [jax.device_put(a, device) for a in (ev2, ec2, ew2)]
+        self._vb = jax.device_put(vb, device)
+        ids = np.arange(self.n_v, dtype=np.int32)
+        self._ids_dev = jax.device_put(ids, device)
+        base_dev = [jax.device_put(a, device) for a in
+                    (self._base_cb, self._base_sizes, self._base_rem,
+                     self._base_pen)]
+        payload = _pack_overrides(overrides, self.n_c, self.n_v)
+        payload_dev = [jax.device_put(a, device) for a in payload]
+        opstats.bump("uploaded_bytes_full",
+                     ev2.nbytes + ec2.nbytes + ew2.nbytes + vb.nbytes
+                     + ids.nbytes
+                     + sum(a.nbytes for a in (self._base_cb,
+                                              self._base_sizes,
+                                              self._base_rem,
+                                              self._base_pen)))
+        opstats.bump("uploaded_bytes_delta",
+                     sum(a.nbytes for a in payload))
+
+        # one materialization dispatch derives the whole fleet's f64
+        # state on device; the dtype cast below mirrors DrainSim's
+        # host-side casts exactly (f64 math first, cast second)
+        cb64, sz64, rem64, pen64 = _materialize(*base_dev, *payload_dev)
+        opstats.bump("dispatches")
+        opstats.bump("batch_dispatches")
+        if done_mode == "rel":
+            thresh64 = self.done_eps * sz64
+        else:
+            thresh64 = jnp.full_like(sz64, self.done_eps)
+        self._cb = cb64.astype(self.dtype)
+        self._pen = pen64.astype(self.dtype)
+        self._rem = rem64.astype(self.dtype)
+        self._thresh = thresh64.astype(self.dtype)
+
+        self.replicas = [ReplicaState(b) for b in range(self.B)]
+        self._alive = np.ones(self.B, bool)
+        self.supersteps = 0
+        self.syncs = 0
+        self.rounds = 0
+        opstats.bump("batch_replicas", self.B)
+
+    # -- fleet stepping ----------------------------------------------------
+
+    def _fetch(self, packed) -> np.ndarray:
+        self.syncs += 1
+        return np.asarray(packed)
+
+    def superstep_all(self, k: Optional[int] = None) -> int:
+        """ONE batched superstep dispatch for every live replica and
+        ONE [B, ·] fetch; commits per-replica events and clocks.
+        Returns the number of still-live replicas."""
+        k_max = self.superstep_k
+        k = k_max if k is None else min(int(k), k_max)
+        group = _pos_group(self.n_v)
+        self._pen, self._rem, packed = _batch_superstep(
+            *self._dev, self._cb, self._vb, self._pen, self._rem,
+            self._thresh, self._ids_dev,
+            jnp.asarray(self._alive), np.int32(k),
+            np.int32(self.superstep_rounds),
+            eps=self.eps, n_c=self.n_c, n_v=self.n_v, k_max=k_max,
+            group=group, has_bounds=self.has_bounds,
+            batch_w=self.batch_w)
+        self.supersteps += 1
+        opstats.bump("dispatches")
+        opstats.bump("batch_dispatches")
+        p = self._fetch(packed)
+        n_v, B = self.n_v, self.B
+        o = 7
+        stuck: List[int] = []
+        for b in range(B):
+            if not self._alive[b]:
+                continue
+            rep = self.replicas[b]
+            row = p[b]
+            rounds, adv, n_ev = int(row[0]), int(row[1]), int(row[2])
+            t_sum = float(row[3])
+            n_live, flag = int(row[4]), int(row[5])
+            ring_t = row[o + 2 * k_max:o + 2 * k_max + n_v]
+            ring_id = row[o + 2 * k_max + n_v:
+                          o + 2 * k_max + 2 * n_v].astype(np.int64)
+            self.rounds += rounds
+            opstats.bump("fixpoint_rounds", rounds)
+            rep.advances += adv
+            t_base = rep.t
+            for j in range(n_ev):
+                rep.events.append((t_base + float(ring_t[j]),
+                                   int(ring_id[j])))
+            rep.t = t_base + t_sum
+            if flag == _FLAG_STALLED:
+                rep.error = (f"drain stalled: no flow holds bandwidth "
+                             f"({n_live} live)")
+                rep.alive = False
+                self._alive[b] = False
+            elif n_live == 0:
+                rep.alive = False
+                self._alive[b] = False
+            elif flag == _FLAG_BUDGET and adv == 0:
+                stuck.append(b)
+        if stuck:
+            # the round budget expired inside a replica's FIRST solve:
+            # finish exactly one advance for those lanes via the
+            # chunked fused program (converges across dispatches), the
+            # batched mirror of the solo run() rescue
+            self._rescue_fused(stuck)
+        return int(self._alive.sum())
+
+    def _rescue_fused(self, stuck: List[int]) -> None:
+        active = np.zeros(self.B, bool)
+        active[stuck] = True
+        chunk = 16 if self._dev[0].size >= 1 << 20 else 64
+        carry = None
+        k_live = 4 + self.n_v
+        while True:
+            if carry is None:
+                self._pen, self._rem, carry, stats = _batch_fused_fresh(
+                    *self._dev, self._cb, self._vb, self._pen,
+                    self._rem, self._thresh, jnp.asarray(active),
+                    eps=self.eps, n_c=self.n_c, n_v=self.n_v,
+                    chunk=chunk, has_bounds=self.has_bounds,
+                    batch_w=self.batch_w)
+            else:
+                self._pen, self._rem, carry, stats = _batch_fused_cont(
+                    *self._dev, self._cb, self._vb, self._pen,
+                    self._rem, self._thresh, carry,
+                    jnp.asarray(active), eps=self.eps, n_c=self.n_c,
+                    n_v=self.n_v, chunk=chunk,
+                    has_bounds=self.has_bounds, batch_w=self.batch_w)
+            opstats.bump("dispatches")
+            opstats.bump("batch_dispatches")
+            st = self._fetch(stats)[:, :k_live]
+            for b in list(stuck):
+                if not active[b]:
+                    continue
+                # rounds (st[b,0]) is the lane's TOTAL fixpoint
+                # iteration count across chunks — count it once, at
+                # commit/error time, like the solo _advance_fused
+                rounds, n_light = int(st[b, 0]), int(st[b, 1])
+                if n_light:
+                    if rounds >= _MAX_ROUNDS:
+                        rep = self.replicas[b]
+                        rep.error = "drain solve did not converge"
+                        rep.alive = False
+                        self._alive[b] = False
+                        active[b] = False
+                        self.rounds += rounds
+                        opstats.bump("fixpoint_rounds", rounds)
+                    continue
+                self.rounds += rounds
+                opstats.bump("fixpoint_rounds", rounds)
+                rep = self.replicas[b]
+                dt, n_live = float(st[b, 2]), int(st[b, 3])
+                done = st[b, 4:] > 0
+                if not np.isfinite(dt):
+                    rep.error = (f"drain stalled: no flow holds "
+                                 f"bandwidth ({n_live} live)")
+                    rep.alive = False
+                    self._alive[b] = False
+                    active[b] = False
+                    continue
+                rep.t += dt
+                rep.advances += 1
+                for fid in np.flatnonzero(done):
+                    rep.events.append((rep.t, int(fid)))
+                if n_live == 0:
+                    rep.alive = False
+                    self._alive[b] = False
+                active[b] = False
+            if not active.any():
+                break
+
+    def run(self, max_supersteps: int = 10_000_000) -> None:
+        """Drain every replica to completion (or error)."""
+        while self._alive.any() and max_supersteps > 0:
+            self.superstep_all()
+            max_supersteps -= 1
+
+    # -- results -----------------------------------------------------------
+
+    def events_of(self, b: int) -> List[Tuple[float, int]]:
+        return self.replicas[b].events
+
+    def clock_of(self, b: int) -> float:
+        return self.replicas[b].t

@@ -52,6 +52,12 @@ ON DEVICE on the superstep path — a stable live-first partition (the
 same machinery as lmm_jax's compaction chain) dispatched without any
 host round-trip, so halving the live set costs one kernel launch
 instead of a fetch + re-upload.
+
+The kernel programs (`_solve_chunk_program`, `_fused_step_program`,
+`_superstep_program`) double as the LANE bodies of the batched
+multi-replica executor (ops.lmm_batch), which vmaps them over a
+leading replica axis to drain whole scenario fleets per dispatch —
+keep them pure functions of their arguments.
 """
 
 from __future__ import annotations
@@ -81,12 +87,16 @@ def _to2d(a: np.ndarray, group: int = 8) -> np.ndarray:
     return a.reshape(-1, group)
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("eps", "n_c", "n_v", "chunk",
-                                    "has_bounds"))
-def _drain_solve_chunk(e_var, e_cnst, e_w, c_bound, v_penalty, v_bound,
-                       carry, eps: float, n_c: int, n_v: int, chunk: int,
-                       has_bounds: bool = False):
+# The three kernel *programs* below are defined as plain functions and
+# jitted by assignment so the batched executor (ops.lmm_batch) can vmap
+# the raw programs over a leading replica axis: one device program then
+# solves/advances a whole scenario fleet, amortizing the per-dispatch
+# tunnel latency across replicas.  Keep them functional (no global
+# state) — both the solo jits and the vmapped jits share them.
+
+def _solve_chunk_program(e_var, e_cnst, e_w, c_bound, v_penalty, v_bound,
+                         carry, eps: float, n_c: int, n_v: int, chunk: int,
+                         has_bounds: bool = False):
     dtype = e_w.dtype
     out = fixpoint(e_var, e_cnst, e_w, c_bound,
                    jnp.zeros(n_c, bool), v_penalty, v_bound,
@@ -98,6 +108,11 @@ def _drain_solve_chunk(e_var, e_cnst, e_w, c_bound, v_penalty, v_bound,
     stats = jnp.stack([out[3].astype(dtype),
                        jnp.count_nonzero(carry2[4]).astype(dtype)])
     return carry2, stats
+
+
+_drain_solve_chunk = functools.partial(
+    jax.jit, static_argnames=("eps", "n_c", "n_v", "chunk",
+                              "has_bounds"))(_solve_chunk_program)
 
 
 def _advance_math(pen, rem, thresh, values):
@@ -131,12 +146,9 @@ def _drain_advance(v_penalty, rem, thresh, values):
     return pen2, rem2, jnp.concatenate([head, done.astype(dtype)])
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("eps", "n_c", "n_v", "chunk",
-                                    "has_bounds"))
-def _drain_fused_step(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
-                      thresh, carry, eps: float, n_c: int, n_v: int,
-                      chunk: int, has_bounds: bool = False):
+def _fused_step_program(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
+                        thresh, carry, eps: float, n_c: int, n_v: int,
+                        chunk: int, has_bounds: bool = False):
     """Fused solve+advance: run up to `chunk` more saturation rounds
     and — if the fixpoint converged inside this dispatch — the dt/retire
     step too, all in ONE dispatch whose single fetch returns
@@ -166,19 +178,21 @@ def _drain_fused_step(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
         jnp.concatenate([head, done.astype(dtype)])
 
 
+_drain_fused_step = functools.partial(
+    jax.jit, static_argnames=("eps", "n_c", "n_v", "chunk",
+                              "has_bounds"))(_fused_step_program)
+
+
 #: superstep completion flags (stats slot 5)
 _FLAG_OK = 0          # exited on k / live-count / natural completion
 _FLAG_STALLED = 1     # no flow holds bandwidth (dt not finite)
 _FLAG_BUDGET = 2      # solve hit the round budget mid-superstep
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("eps", "n_c", "n_v", "k_max",
-                                    "group", "has_bounds"))
-def _drain_superstep(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
-                     thresh, ids, k, round_budget, stop_live,
-                     eps: float, n_c: int, n_v: int, k_max: int,
-                     group: int, has_bounds: bool = False):
+def _superstep_program(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
+                       thresh, ids, k, round_budget, stop_live,
+                       eps: float, n_c: int, n_v: int, k_max: int,
+                       group: int, has_bounds: bool = False):
     """Up to `k` (<= k_max) full advances in ONE dispatch: an outer
     lax.while_loop of (fixpoint to convergence -> dt -> retire), with
     completions logged into a device ring buffer and the clock carried
@@ -276,6 +290,11 @@ def _drain_superstep(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
     packed = jnp.concatenate([stats, adv_dt, adv_nev.astype(dtype),
                               ring_t, ring_id.astype(dtype)])
     return pen_o, rem_o, packed
+
+
+_drain_superstep = functools.partial(
+    jax.jit, static_argnames=("eps", "n_c", "n_v", "k_max",
+                              "group", "has_bounds"))(_superstep_program)
 
 
 @functools.partial(jax.jit,

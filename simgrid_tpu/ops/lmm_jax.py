@@ -1035,6 +1035,67 @@ def _solve_kernel_chunk(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty,
                     has_fatpipe=has_fatpipe)
 
 
+def _solve_chunk_batched_lane(e_var, e_cnst, ew, cb, fat, pen, vb, carry,
+                              eps: float, n_c: int, n_v: int,
+                              parallel_rounds: bool, chunk: int,
+                              has_bounds: bool, has_fatpipe: bool):
+    return fixpoint(e_var, e_cnst, ew, cb, fat, pen, vb,
+                    jnp.asarray(eps, ew.dtype), n_c, n_v, axis=None,
+                    parallel_rounds=parallel_rounds, carry=carry,
+                    max_rounds=chunk, return_carry=True,
+                    has_bounds=has_bounds, has_fatpipe=has_fatpipe)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("eps", "n_c", "n_v",
+                                    "parallel_rounds", "chunk",
+                                    "has_bounds", "has_fatpipe",
+                                    "batch_w"))
+def _solve_kernel_chunk_batched_fresh(e_var, e_cnst, e_w, c_bound,
+                                      c_fatpipe, v_penalty, v_bound,
+                                      eps: float, n_c: int, n_v: int,
+                                      parallel_rounds: bool, chunk: int,
+                                      has_bounds: bool = True,
+                                      has_fatpipe: bool = True,
+                                      batch_w: bool = True):
+    """Batched (leading replica axis) counterpart of _solve_kernel_chunk,
+    fresh-start flavor: ONE device program runs the first `chunk`
+    saturation rounds of B independent systems that share the COO
+    structure (e_var/e_cnst uploaded once) but carry per-replica
+    weights/bounds/penalties.  `batch_w=False` shares the element
+    weights too (pure bound/penalty sweeps).  Consumed by
+    ops.lmm_batch.solve_arrays_batch."""
+    def lane(ew, cb, pen, vb):
+        return _solve_chunk_batched_lane(
+            e_var, e_cnst, ew, cb, c_fatpipe, pen, vb, None, eps, n_c,
+            n_v, parallel_rounds, chunk, has_bounds, has_fatpipe)
+    return jax.vmap(lane, in_axes=(0 if batch_w else None, 0, 0, 0))(
+        e_w, c_bound, v_penalty, v_bound)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("eps", "n_c", "n_v",
+                                    "parallel_rounds", "chunk",
+                                    "has_bounds", "has_fatpipe",
+                                    "batch_w"))
+def _solve_kernel_chunk_batched(e_var, e_cnst, e_w, c_bound, c_fatpipe,
+                                v_penalty, v_bound, carry, eps: float,
+                                n_c: int, n_v: int,
+                                parallel_rounds: bool, chunk: int,
+                                has_bounds: bool = True,
+                                has_fatpipe: bool = True,
+                                batch_w: bool = True):
+    """Continuation flavor: resume each replica from its carried loop
+    state.  Converged lanes are frozen by their own while_loop cond, so
+    re-dispatching a mixed fleet never perturbs finished replicas."""
+    def lane(ew, cb, pen, vb, carry_l):
+        return _solve_chunk_batched_lane(
+            e_var, e_cnst, ew, cb, c_fatpipe, pen, vb, carry_l, eps,
+            n_c, n_v, parallel_rounds, chunk, has_bounds, has_fatpipe)
+    return jax.vmap(lane, in_axes=(0 if batch_w else None, 0, 0, 0, 0))(
+        e_w, c_bound, v_penalty, v_bound, carry)
+
+
 def flatten(cnst_list: List[Constraint], dtype=np.float64
             ) -> Optional[Tuple[LmmArrays, List["Variable"]]]:
     """Flatten the live portion of a host System into padded COO arrays.

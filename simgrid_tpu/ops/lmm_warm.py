@@ -50,7 +50,12 @@ Carry invalidation is exact by construction (the hard part):
   zeroed weights and the closure check sees the retired slots.
 
 Solves that cannot be warmed fall back to a cold full solve of the
-same device-resident arrays — always available, always exact.
+same device-resident arrays — always available, always exact.  That
+includes runs that selected the ELL layout (``lmm/layout:ell``, or
+auto on an accelerator): the carry and delta masters are COO-only, so
+warm restarts are refused there and counted in the
+``warm_ell_fallbacks`` opstats counter — the open vc-table delta/carry
+story stays VISIBLE instead of silently serving a different layout.
 """
 
 from __future__ import annotations
@@ -87,6 +92,14 @@ def _delta_enabled() -> bool:
         raise ValueError(f"Unknown lmm/delta-upload {mode!r} "
                          "(expected auto, on or off)")
     return mode != "off"
+
+
+def _ell_selected() -> bool:
+    """True when the run's layout choice resolves to ELL (explicit, or
+    auto on an accelerator) — the layout the warm carry cannot serve."""
+    layout = config["lmm/layout"]
+    return layout == "ell" or (layout == "auto"
+                               and _default_platform() != "cpu")
 
 
 @functools.partial(jax.jit, static_argnames=("layout",))
@@ -178,6 +191,7 @@ class WarmSolver:
         self.solves = 0
         self.warm_solves = 0
         self.cold_solves = 0
+        self.warm_ell_fallbacks = 0
         self.carry_invalidations = 0
         self.last_rounds = 0
         self.last_mode = ""
@@ -327,6 +341,18 @@ class WarmSolver:
         meta = (eps_f, parallel)
         mc = np.fromiter((c._view_slot for c in cnst_list), np.int64,
                          len(cnst_list))
+
+        # ELL guard (ROADMAP open item made explicit): the carried
+        # fixpoint state and the delta-upload masters are COO-only —
+        # there is no vc-table delta/carry story yet — so a run that
+        # selected the ELL layout must not warm-start: fall back to a
+        # cold restart of the COO masters and COUNT the gap
+        # (opstats `warm_ell_fallbacks`) instead of serving a silently
+        # different layout than the user asked for.
+        if warm and _ell_selected():
+            warm = False
+            self.warm_ell_fallbacks += 1
+            opstats.bump("warm_ell_fallbacks")
 
         carry0 = None
         if warm and st.carry is not None and st.meta == meta:

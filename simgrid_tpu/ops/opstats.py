@@ -6,25 +6,55 @@ context object through the solver entry points:
 
 * ``dispatches``            — device kernel dispatches (solver chunks,
                               drain advances/supersteps, warm solves)
+* ``batch_dispatches``      — dispatches that ran a whole replica
+                              FLEET (ops.lmm_batch); always also
+                              counted in ``dispatches``
+* ``batch_replicas``        — replicas admitted into batched fleets
 * ``fixpoint_rounds``       — saturation rounds executed on device
 * ``uploaded_bytes_full``   — host->device bytes shipped as whole
                               arrays (fresh ``device_put``)
 * ``uploaded_bytes_delta``  — host->device bytes shipped as indexed
-                              scatter payloads (ops.lmm_warm)
+                              scatter payloads (ops.lmm_warm) or
+                              compact per-replica scenario payloads
+                              (ops.lmm_batch)
 * ``solves`` / ``warm_solves`` / ``cold_solves`` — device solve entry
                               counts (warm = carried modified-component
                               restart, cold = full re-init)
+* ``warm_ell_fallbacks``    — selective solves that requested a warm
+                              restart while the ELL layout was
+                              selected: the warm carry is COO-only, so
+                              the solver falls back to cold and counts
+                              the gap here instead of hiding it
 
 Counters only ever increase; consumers snapshot before a phase and
-diff after (``snapshot``/``diff``).  Purely observational — nothing in
-the solve paths reads them back.
+diff after (``snapshot``/``diff``), or wrap the phase in ``scoped``.
+Purely observational — nothing in the solve paths reads them back.
+
+Per-stage scoping
+-----------------
+
+``scoped(name)`` brackets a phase: the yielded dict is filled with the
+phase's counter *deltas* on exit and also recorded in ``stage_stats``
+under ``name``.  Scopes nest (each diffs against its own entry
+snapshot), so a bench process running several stages — or the batch
+driver running several fleets — reports per-stage counters instead of
+process-cumulative ones, and re-running a stage in the same process
+can no longer double-count the previous stage's work::
+
+    with opstats.scoped("sweep/b64") as st:
+        campaign.run_batched(batch=64)
+    st["dispatches"]          # this stage only
 """
 
 from __future__ import annotations
 
-from typing import Dict
+import contextlib
+from typing import Dict, Iterator
 
 _counters: Dict[str, float] = {}
+
+#: per-stage deltas recorded by ``scoped`` (last run of each stage)
+stage_stats: Dict[str, Dict[str, float]] = {}
 
 
 def bump(name: str, n=1) -> None:
@@ -45,5 +75,27 @@ def diff(before: Dict[str, float]) -> Dict[str, float]:
     return out
 
 
+@contextlib.contextmanager
+def scoped(name: str) -> Iterator[Dict[str, float]]:
+    """Bracket a phase: yields a dict that receives the phase's counter
+    deltas on exit (also kept in ``stage_stats[name]``)."""
+    before = snapshot()
+    stats: Dict[str, float] = {}
+    stage_stats[name] = stats
+    try:
+        yield stats
+    finally:
+        stats.update(diff(before))
+
+
+def get_stage(name: str) -> Dict[str, float]:
+    """The recorded deltas of a completed ``scoped`` stage ({} when the
+    stage never ran)."""
+    return dict(stage_stats.get(name, {}))
+
+
 def reset() -> None:
+    """Clear every counter AND the recorded stage deltas (fresh
+    process-equivalent state for tests and multi-phase tools)."""
     _counters.clear()
+    stage_stats.clear()

@@ -12,9 +12,18 @@ answer is data parallelism over a ``jax.sharding.Mesh``:
   systems (parameter sweeps, MC branches) are vmapped and the batch axis
   is sharded over the mesh — the "data-parallel" axis;
 * both compose in one 2-D mesh ``("sim", "elem")`` — see
-  ``__graft_entry__.dryrun_multichip``.
+  ``__graft_entry__.dryrun_multichip``;
+* **scenario campaigns** (``campaign.Campaign``): fleets of what-if
+  replicas (fault seeds, parameter sweeps) of ONE platform flattening
+  drained in lockstep batched device programs (ops.lmm_batch), each
+  replica bit-identical to its solo run.
 """
 
+from .campaign import (  # noqa: F401
+    Campaign,
+    ReplicaResult,
+    ScenarioSpec,
+)
 from .sharded import (  # noqa: F401
     batched_solve,
     make_mesh,

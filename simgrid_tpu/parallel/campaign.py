@@ -1,0 +1,265 @@
+"""Batched scenario campaigns: fleets of what-if simulations drained in
+lockstep device programs.
+
+A :class:`Campaign` turns ONE platform flattening (a pure-drain LMM
+system, captured from a live engine via
+``NetworkCm02Model.capture_drain_scenario()`` or built from arrays)
+plus a list of :class:`ScenarioSpec` records into a replica fleet:
+
+* each spec contributes *sweep overrides* (global bandwidth / flow-size
+  multipliers, sparse per-link and per-flow factors, dead flows) and an
+  optional *fault dimension* — a seeded
+  :class:`~simgrid_tpu.faults.FaultCampaign` whose per-link schedules
+  are folded into static capacity multipliers
+  (``FaultCampaign.mean_availability``), so a Monte Carlo fault sweep
+  is just N seeds;
+* the fleet is stepped through :class:`~simgrid_tpu.ops.lmm_batch.
+  BatchDrainSim` in chunks of ``batch`` replicas: one shared platform
+  upload, compact per-replica payloads, lockstep supersteps with an
+  alive mask, and per-replica completion rings demultiplexed back into
+  per-replica event streams;
+* every replica's event order and clocks are bit-identical to the same
+  scenario drained solo (:meth:`Campaign.run_solo` is the oracle the
+  determinism tooling compares against), so batching is purely a
+  throughput choice.
+
+The s4u Engine is a process singleton, so replicas are kernel-level
+scenario instances sharing one flattening — the drain phase is where
+fleet scale pays (the maestro loop outside it is per-process).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..faults import FaultCampaign
+from ..ops import opstats
+from ..ops.lmm_batch import (BatchDrainSim, ReplicaOverrides,
+                             derive_replica_arrays)
+
+#: a fully-failed link would zero its capacity and stall every flow
+#: routed over it; campaigns clamp availability-derived factors here
+#: (a pure drain has no retry path — a dead link means a dead drain)
+MIN_LINK_FACTOR = 0.05
+
+
+class ScenarioSpec:
+    """One replica's scenario: seed + sweep overrides + fault model.
+
+    ``fault_mtbf``/``fault_mttr`` (simulated seconds) switch the fault
+    dimension on: every link gets a seeded failure/repair schedule over
+    ``fault_horizon`` and its time-averaged availability becomes a
+    capacity multiplier (clamped to ``MIN_LINK_FACTOR``).  Identical
+    seeds give identical scenarios, bit-for-bit.
+    """
+
+    __slots__ = ("seed", "bw_scale", "size_scale", "link_scale",
+                 "flow_scale", "dead_flows", "fault_mtbf", "fault_mttr",
+                 "fault_dist", "fault_shape", "fault_horizon", "label")
+
+    def __init__(self, seed: int = 0, bw_scale: float = 1.0,
+                 size_scale: float = 1.0,
+                 link_scale: Optional[Dict[int, float]] = None,
+                 flow_scale: Optional[Dict[int, float]] = None,
+                 dead_flows: Iterable[int] = (),
+                 fault_mtbf: Optional[float] = None,
+                 fault_mttr: float = 60.0,
+                 fault_dist: str = "exponential",
+                 fault_shape: float = 1.0,
+                 fault_horizon: float = 1000.0,
+                 label: Optional[str] = None):
+        self.seed = int(seed)
+        self.bw_scale = float(bw_scale)
+        self.size_scale = float(size_scale)
+        self.link_scale = dict(link_scale or {})
+        self.flow_scale = dict(flow_scale or {})
+        self.dead_flows = tuple(dead_flows)
+        self.fault_mtbf = fault_mtbf
+        self.fault_mttr = float(fault_mttr)
+        self.fault_dist = fault_dist
+        self.fault_shape = float(fault_shape)
+        self.fault_horizon = float(fault_horizon)
+        self.label = label if label is not None else f"seed{seed}"
+
+
+class ReplicaResult:
+    """Per-replica campaign outcome (the demultiplexed 'engine')."""
+
+    __slots__ = ("spec", "events", "t", "advances", "error")
+
+    def __init__(self, spec: ScenarioSpec, events, t: float,
+                 advances: int, error: Optional[str]):
+        self.spec = spec
+        self.events = events          # [(time, flow slot)] solo order
+        self.t = t
+        self.advances = advances
+        self.error = error
+
+
+class Campaign:
+    """A scenario fleet over one shared pure-drain flattening."""
+
+    def __init__(self, e_var, e_cnst, e_w, c_bound, sizes,
+                 specs: Sequence[ScenarioSpec],
+                 remains=None, penalty=None, v_bound=None,
+                 link_names: Optional[List[Optional[str]]] = None,
+                 eps: float = 1e-9, done_eps: float = 1e-4,
+                 dtype=np.float64, done_mode: str = "rel",
+                 superstep: int = 8):
+        self.e_var = np.asarray(e_var, np.int32)
+        self.e_cnst = np.asarray(e_cnst, np.int32)
+        self.e_w = np.asarray(e_w, np.float64)
+        self.c_bound = np.asarray(c_bound, np.float64)
+        self.sizes = np.asarray(sizes, np.float64)
+        self.remains = (np.asarray(remains, np.float64)
+                        if remains is not None else None)
+        self.penalty = (np.asarray(penalty, np.float64)
+                        if penalty is not None else None)
+        self.v_bound = (np.asarray(v_bound, np.float64)
+                        if v_bound is not None else None)
+        self.link_names = link_names
+        self.specs = list(specs)
+        self.eps = float(eps)
+        self.done_eps = float(done_eps)
+        self.dtype = np.dtype(dtype)
+        self.done_mode = done_mode
+        self.superstep = int(superstep)
+        #: constraint slots that actually carry elements — fault
+        #: schedules are drawn for these only (padding slots have no
+        #: flows and scaling them is pure noise in the RNG stream)
+        used = np.zeros(len(self.c_bound), bool)
+        used[self.e_cnst[self.e_w > 0]] = True
+        self._used_links = np.flatnonzero(used)
+
+    # -- construction from a live engine ----------------------------------
+
+    @classmethod
+    def from_engine(cls, model, specs: Sequence[ScenarioSpec], **kw
+                    ) -> "Campaign":
+        """Capture the CURRENT pure-drain phase of a network model (the
+        drain fast path's own preconditions, see
+        ``NetworkCm02Model.capture_drain_scenario``) as the fleet's
+        shared base scenario.  Raises when the phase is not a pure
+        drain — a campaign must start from a well-defined snapshot, not
+        silently diverge from the engine."""
+        snap = model.capture_drain_scenario()
+        if snap is None:
+            raise RuntimeError(
+                "capture_drain_scenario: the current phase is not a "
+                "pure drain (flows still in latency phase, suspended, "
+                "deadlined, or a non-flow variable is live)")
+        return cls(snap["e_var"], snap["e_cnst"], snap["e_w"],
+                   snap["c_bound"], snap["sizes"],
+                   remains=snap["remains"], penalty=snap["penalty"],
+                   v_bound=snap["v_bound"],
+                   link_names=snap["link_names"], specs=specs, **kw)
+
+    # -- per-spec scenario derivation --------------------------------------
+
+    def _link_name(self, slot: int) -> str:
+        if self.link_names is not None and slot < len(self.link_names) \
+                and self.link_names[slot]:
+            return str(self.link_names[slot])
+        return f"link{slot}"
+
+    def overrides_for(self, spec: ScenarioSpec) -> ReplicaOverrides:
+        """Fold one spec's sweep overrides and fault schedule into the
+        compact per-replica override record.  Pure function of the spec
+        (the FaultCampaign draw is seeded), so the solo oracle and the
+        batch path derive the identical scenario."""
+        link_scale = dict(spec.link_scale)
+        if spec.fault_mtbf is not None:
+            fc = FaultCampaign(seed=spec.seed,
+                               horizon=spec.fault_horizon)
+            names = {}
+            for slot in self._used_links:
+                name = self._link_name(int(slot))
+                names[name] = int(slot)
+                fc.add_link(name, mtbf=spec.fault_mtbf,
+                            mttr=spec.fault_mttr, dist=spec.fault_dist,
+                            shape=spec.fault_shape)
+            for (kind, name), avail in fc.mean_availability().items():
+                if avail >= 1.0:
+                    continue
+                slot = names[name]
+                factor = max(avail, MIN_LINK_FACTOR)
+                link_scale[slot] = link_scale.get(slot, 1.0) * factor
+        return ReplicaOverrides(bw_scale=spec.bw_scale,
+                                size_scale=spec.size_scale,
+                                link_scale=link_scale,
+                                flow_scale=spec.flow_scale,
+                                dead_flows=spec.dead_flows)
+
+    # -- execution ---------------------------------------------------------
+
+    def run_batched(self, batch: int = 64,
+                    superstep_rounds: int = 0) -> List[ReplicaResult]:
+        """Drain the whole fleet in chunks of ``batch`` replicas, each
+        chunk one BatchDrainSim (one shared upload, lockstep
+        supersteps).  Results come back in spec order; chunking is
+        invisible to results — lanes are independent."""
+        results: List[ReplicaResult] = []
+        for start in range(0, len(self.specs), max(1, int(batch))):
+            chunk_specs = self.specs[start:start + max(1, int(batch))]
+            overrides = [self.overrides_for(s) for s in chunk_specs]
+            sim = BatchDrainSim(
+                self.e_var, self.e_cnst, self.e_w, self.c_bound,
+                self.sizes, overrides, eps=self.eps,
+                done_eps=self.done_eps, dtype=self.dtype,
+                done_mode=self.done_mode, superstep=self.superstep,
+                superstep_rounds=superstep_rounds,
+                v_bound=self.v_bound, penalty=self.penalty,
+                remains=self.remains)
+            sim.run()
+            for b, spec in enumerate(chunk_specs):
+                rep = sim.replicas[b]
+                results.append(ReplicaResult(spec, rep.events, rep.t,
+                                             rep.advances, rep.error))
+        return results
+
+    def run_solo(self, index: int,
+                 superstep_rounds: int = 0) -> ReplicaResult:
+        """Drain ONE replica with the solo executor
+        (ops.lmm_drain.DrainSim) over host-derived scenario arrays —
+        the bit-identity oracle for the batched path.  Repacks are
+        disabled to match the fleet's lockstep (fixed-shape) program;
+        event order and clocks are repack-invariant anyway, but the
+        oracle keeps the dispatch structure aligned too."""
+        from ..ops.lmm_drain import DrainSim
+        spec = self.specs[index]
+        ov = self.overrides_for(spec)
+        base_rem = (self.remains if self.remains is not None
+                    else self.sizes)
+        base_pen = (self.penalty if self.penalty is not None
+                    else np.ones(len(self.sizes)))
+        cb, sz, rem, pen = derive_replica_arrays(
+            self.c_bound, self.sizes, base_rem, base_pen, ov)
+        sim = DrainSim(self.e_var, self.e_cnst,
+                       self.e_w.astype(self.dtype),
+                       cb.astype(self.dtype), sz, eps=self.eps,
+                       done_eps=self.done_eps, dtype=self.dtype,
+                       done_mode=self.done_mode,
+                       superstep=self.superstep,
+                       superstep_rounds=superstep_rounds,
+                       v_bound=(self.v_bound.astype(self.dtype)
+                                if self.v_bound is not None else None),
+                       penalty=pen, remains=rem, repack_min=1 << 62)
+        error = None
+        try:
+            sim.run()
+        except RuntimeError as exc:
+            error = str(exc)
+        return ReplicaResult(spec, sim.events, sim.t, sim.advances,
+                             error)
+
+    def run_scoped(self, batch: int, stage: str
+                   ) -> Tuple[List[ReplicaResult], Dict[str, float]]:
+        """run_batched under an opstats stage scope: returns (results,
+        this run's counter deltas) — the campaign's own dispatches and
+        upload bytes, unpolluted by whatever ran before in the
+        process."""
+        with opstats.scoped(stage) as stats:
+            results = self.run_batched(batch=batch)
+        return results, stats

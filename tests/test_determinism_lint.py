@@ -35,6 +35,18 @@ def test_drain_runtime_determinism():
     assert problems == []
 
 
+def test_batch_runtime_determinism():
+    """Dynamic coverage of the batched fleet executor (ISSUE 4
+    tooling, the `--quick` small-N instance): replicas extracted from
+    a mixed fault/sweep batch are bit-identical — events and clocks —
+    to the same scenario run solo.  The full 64-wide check runs via
+    `check_determinism.py --runtime-batch`."""
+    checker = _load_checker()
+    problems = checker.check_batch_runtime(n_c=32, n_v=96, batch=6,
+                                           solo_check=(0, 3, 5))
+    assert problems == []
+
+
 def test_checker_flags_violations(tmp_path):
     """The lint itself works: a planted file with each banned pattern is
     reported (guards against the lint silently matching nothing)."""

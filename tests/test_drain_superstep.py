@@ -209,6 +209,90 @@ class TestRelativeGrouping:
         assert rel.advances <= ab.advances
 
 
+class TestSuperstepSaturation:
+    """ISSUE 4 satellite: the superstep's two partial-batch exits —
+    the round budget expiring mid-superstep (_FLAG_BUDGET) and the
+    completion ring filling to capacity in one dispatch — must both
+    replay to the exact unfused event order."""
+
+    @staticmethod
+    def _chain_system(groups=6, per=40):
+        """`groups` staggered tie-groups over one shared backbone plus
+        per-group links: every advance retires a whole group, so a
+        superstep with k >= groups drains EVERYTHING in one dispatch
+        (ring filled to capacity), and the backbone's saturation chain
+        keeps each solve multi-round (budget pressure)."""
+        n_v = groups * per
+        e_var, e_cnst, e_w = [], [], []
+        for g in range(groups):
+            for j in range(per):
+                v = g * per + j
+                e_var += [v, v]
+                e_cnst += [0, 1 + g]          # backbone + group link
+                e_w += [1.0, 1.0]
+        c_bound = np.array([1e6 * groups] + [1e6] * groups)
+        # group g completes at its own distinct time: one tie group
+        # per advance, `groups` advances total
+        sizes = np.repeat(1e6 * (1.0 + np.arange(groups)), per)
+        return (np.array(e_var, np.int32), np.array(e_cnst, np.int32),
+                np.array(e_w), c_bound, sizes, n_v)
+
+    def test_ring_at_capacity_single_superstep(self):
+        ev, ec, ew, cb, sizes, n_v = self._chain_system()
+        ref = DrainSim(ev, ec, ew, cb, sizes, eps=1e-9,
+                       dtype=np.float64, repack_min=1 << 62)
+        ref.run()
+        sim = DrainSim(ev, ec, ew, cb, sizes, eps=1e-9,
+                       dtype=np.float64, superstep=K,
+                       repack_min=1 << 62)
+        sim.run()
+        # every flow's completion landed in ONE superstep: the ring
+        # held n_v events — its full capacity
+        assert sim.supersteps == 1
+        assert len(sim.events) == n_v
+        assert sim.events == ref.events       # bit-identical, not ~=
+
+    def test_budget_exhaustion_partial_batches_replay_exactly(self):
+        """A tiny per-dispatch round budget forces _FLAG_BUDGET exits
+        inside (and between) advances: the partial-batch handling —
+        committing only completed advances, then finishing one advance
+        via the chunked fused rescue — must reproduce the unfused
+        event stream bit-for-bit."""
+        ev, ec, ew, cb, sizes, n_v = self._chain_system()
+        ref = DrainSim(ev, ec, ew, cb, sizes, eps=1e-9,
+                       dtype=np.float64, repack_min=1 << 62)
+        ref.run()
+        sim = DrainSim(ev, ec, ew, cb, sizes, eps=1e-9,
+                       dtype=np.float64, superstep=K,
+                       superstep_rounds=3, repack_min=1 << 62)
+        sim.run()
+        # the budget really bit: more supersteps than the unconstrained
+        # path's single dispatch
+        assert sim.supersteps > 1
+        assert sim.events == ref.events
+        assert sim.t == ref.t
+
+    def test_budget_batch_fleet_matches_unfused(self):
+        """The BATCHED executor under the same budget pressure: every
+        replica's partial-batch rescue replays to its own solo unfused
+        order (the fleet-level mirror of the test above)."""
+        from simgrid_tpu.parallel.campaign import Campaign, ScenarioSpec
+
+        ev, ec, ew, cb, sizes, n_v = self._chain_system(groups=4, per=24)
+        specs = [ScenarioSpec(seed=s, bw_scale=1.0 + 0.25 * s)
+                 for s in range(3)]
+        camp = Campaign(ev, ec, ew, cb, sizes, specs, eps=1e-9,
+                        dtype=np.float64, superstep=K)
+        results = camp.run_batched(batch=3, superstep_rounds=3)
+        for b, spec in enumerate(specs):
+            scb = cb * spec.bw_scale
+            ref = DrainSim(ev, ec, ew, scb, sizes, eps=1e-9,
+                           dtype=np.float64, repack_min=1 << 62)
+            ref.run()
+            assert results[b].events == ref.events
+            assert results[b].t == ref.t
+
+
 class TestClockAccumulation:
     def test_host_clock_is_f64(self, drained):
         """The master clock accumulates per-advance dts in f64 on the

@@ -1,0 +1,268 @@
+"""Batched multi-replica campaigns (ISSUE 4): vmapped solve+drain
+fleets in one device program (ops.lmm_batch + parallel.campaign).
+
+The acceptance contract: a replica extracted from a batch is
+bit-identical (event order AND times AND final clock) to the same
+scenario run solo through ops.lmm_drain.DrainSim, per-replica device
+cost is amortized across the fleet, and the scenario materialization
+(device) mirrors the host derivation exactly."""
+
+import numpy as np
+import pytest
+
+from bench import build_arrays
+from simgrid_tpu.ops import opstats
+from simgrid_tpu.ops.lmm_batch import (BatchDrainSim, ReplicaOverrides,
+                                       derive_replica_arrays,
+                                       solve_arrays_batch)
+from simgrid_tpu.ops.lmm_drain import DrainSim
+from simgrid_tpu.parallel.campaign import (Campaign, ReplicaResult,
+                                           ScenarioSpec)
+
+
+@pytest.fixture(scope="module")
+def base_system():
+    rng = np.random.default_rng(7)
+    n_c, n_v = 48, 200
+    arrays = build_arrays(rng, n_c, n_v, 3, np.float64)
+    E = arrays.n_elem
+    sizes = rng.choice(np.linspace(1e5, 2e6, 16), n_v)
+    return (arrays.e_var[:E], arrays.e_cnst[:E], arrays.e_w[:E],
+            arrays.c_bound[:n_c], sizes)
+
+
+def mixed_specs(n):
+    """Mixed fault seeds + sweep overrides: the campaign shape the
+    determinism acceptance names."""
+    return [ScenarioSpec(seed=s,
+                         bw_scale=1.0 + 0.1 * (s % 5),
+                         size_scale=1.0 + 0.05 * (s % 3),
+                         fault_mtbf=400.0 if s % 2 else None,
+                         fault_mttr=50.0, fault_horizon=600.0,
+                         dead_flows=(s % 7,) if s % 3 == 0 else ())
+            for s in range(n)]
+
+
+class TestBatchSoloBitIdentity:
+    def test_every_replica_matches_solo(self, base_system):
+        """THE batching contract: each of 6 mixed fault/sweep replicas
+        demultiplexed from one fleet has bit-identical events (times
+        and ids) and final clock to its solo DrainSim run."""
+        specs = mixed_specs(6)
+        camp = Campaign(*base_system, specs, eps=1e-9,
+                        dtype=np.float64, superstep=8)
+        results = camp.run_batched(batch=6)
+        assert all(r.error is None for r in results)
+        for b in range(6):
+            solo = camp.run_solo(b)
+            assert results[b].events == solo.events
+            assert results[b].t == solo.t
+            assert results[b].advances == solo.advances
+
+    def test_chunking_is_invisible(self, base_system):
+        """Fleet chunking (batch=2 vs batch=6) cannot change any
+        replica's results — lanes are independent."""
+        specs = mixed_specs(6)
+        camp = Campaign(*base_system, specs, eps=1e-9,
+                        dtype=np.float64, superstep=8)
+        r6 = camp.run_batched(batch=6)
+        r2 = camp.run_batched(batch=2)
+        for a, b in zip(r6, r2):
+            assert a.events == b.events
+            assert a.t == b.t
+
+    def test_alive_mask_freezes_finished_replicas(self, base_system):
+        """A replica that drains much earlier (scaled-up bandwidth)
+        goes dark: its state is frozen while stragglers finish, and
+        its results still match solo exactly."""
+        e_var, e_cnst, e_w, c_bound, sizes = base_system
+        specs = [ScenarioSpec(seed=0, bw_scale=50.0),   # finishes early
+                 ScenarioSpec(seed=1, bw_scale=1.0),
+                 ScenarioSpec(seed=2, bw_scale=0.5)]    # straggler
+        camp = Campaign(e_var, e_cnst, e_w, c_bound, sizes, specs,
+                        eps=1e-9, dtype=np.float64, superstep=8)
+        results = camp.run_batched(batch=3)
+        for b in range(3):
+            solo = camp.run_solo(b)
+            assert results[b].events == solo.events
+            assert results[b].t == solo.t
+
+
+class TestMaterialization:
+    def test_device_matches_host_derivation(self, base_system):
+        """The on-device scenario materialization is the op-for-op
+        mirror of derive_replica_arrays: identical f64 bits."""
+        from simgrid_tpu.ops.lmm_batch import (_materialize,
+                                               _pack_overrides)
+        import jax
+
+        _, _, _, c_bound, sizes = base_system
+        n_c, n_v = len(c_bound), len(sizes)
+        ovs = [ReplicaOverrides(bw_scale=1.3, size_scale=0.8,
+                                link_scale={3: 0.5, 17: 0.25},
+                                flow_scale={5: 2.0},
+                                dead_flows=(1, 9)),
+               ReplicaOverrides(),                       # identity
+               ReplicaOverrides(bw_scale=0.7,
+                                link_scale={0: 0.1})]
+        payload = _pack_overrides(ovs, n_c, n_v)
+        base_pen = np.ones(n_v)
+        dev = _materialize(*[jax.device_put(a) for a in
+                             (c_bound, sizes, sizes, base_pen)],
+                           *[jax.device_put(a) for a in payload])
+        cb_d, sz_d, rem_d, pen_d = (np.asarray(a) for a in dev)
+        for b, ov in enumerate(ovs):
+            cb, sz, rem, pen = derive_replica_arrays(
+                c_bound, sizes, sizes, base_pen, ov)
+            np.testing.assert_array_equal(cb_d[b], cb)
+            np.testing.assert_array_equal(sz_d[b], sz)
+            np.testing.assert_array_equal(rem_d[b], rem)
+            np.testing.assert_array_equal(pen_d[b], pen)
+
+    def test_overrides_validation(self):
+        with pytest.raises(ValueError):
+            ReplicaOverrides(bw_scale=0.0)
+        with pytest.raises(ValueError):
+            ReplicaOverrides(size_scale=-1.0)
+
+
+class TestBatchedFlattenedSolve:
+    def test_matches_solo_solve_arrays(self, base_system):
+        """The vmapped flattened solve: B what-if rate queries in one
+        program, each lane bit-identical to solve_arrays on the same
+        per-replica system."""
+        from simgrid_tpu.ops.lmm_jax import solve_arrays, LmmArrays
+
+        e_var, e_cnst, e_w, c_bound, sizes = base_system
+        n_c, n_v, E = len(c_bound), len(sizes), len(e_var)
+        B = 4
+        scales = 1.0 + 0.2 * np.arange(B)
+        cb = np.stack([c_bound * s for s in scales])
+        pen = np.ones((B, n_v))
+        pen[2, 7] = 0.0                       # one parked flow
+        vb = np.full((B, n_v), -1.0)
+        vals, rem, use, rounds = solve_arrays_batch(
+            e_var, e_cnst, e_w, cb, np.zeros(n_c, bool), pen, vb,
+            eps=1e-9, parallel_rounds=True)
+        for b in range(B):
+            arrays = LmmArrays(
+                e_var=e_var, e_cnst=e_cnst, e_w=e_w,
+                c_bound=cb[b], c_fatpipe=np.zeros(n_c, bool),
+                v_penalty=pen[b], v_bound=vb[b],
+                n_elem=E, n_cnst=n_c, n_var=n_v)
+            v, r, u, n = solve_arrays(arrays, 1e-9,
+                                      parallel_rounds=True)
+            np.testing.assert_array_equal(vals[b], np.asarray(v))
+            np.testing.assert_array_equal(rem[b], np.asarray(r))
+            np.testing.assert_array_equal(use[b], np.asarray(u))
+            assert int(rounds[b]) == int(n)
+
+
+class TestAmortization:
+    def test_fleet_dispatches_and_uploads_beat_solo(self, base_system):
+        """Small-scale guard of the bench acceptance direction: a
+        6-replica fleet must need strictly fewer dispatches and upload
+        bytes per replica than 6 one-replica fleets (the full 64-wide
+        ratios are measured by bench.py --stage sweep)."""
+        specs = mixed_specs(6)
+        camp = Campaign(*base_system, specs, eps=1e-9,
+                        dtype=np.float64, superstep=8)
+        _, st1 = camp.run_scoped(batch=1, stage="amort/b1")
+        _, st6 = camp.run_scoped(batch=6, stage="amort/b6")
+
+        def cost(st):
+            return (st.get("dispatches", 0),
+                    st.get("uploaded_bytes_full", 0)
+                    + st.get("uploaded_bytes_delta", 0))
+
+        d1, u1 = cost(st1)
+        d6, u6 = cost(st6)
+        assert d6 * 3 <= d1          # >= 3x fewer fleet dispatches
+        assert u6 * 3 <= u1          # >= 3x fewer uploaded bytes
+        # scoping really separated the two phases
+        assert opstats.get_stage("amort/b1")["dispatches"] == d1
+        assert opstats.get_stage("amort/b6")["dispatches"] == d6
+
+
+class TestOpstatsScoping:
+    def test_scoped_isolated_and_nested(self):
+        opstats.bump("dispatches", 5)
+        with opstats.scoped("outer") as outer:
+            opstats.bump("dispatches", 2)
+            with opstats.scoped("inner") as inner:
+                opstats.bump("dispatches", 1)
+                opstats.bump("uploaded_bytes_full", 10)
+        assert inner == {"dispatches": 1, "uploaded_bytes_full": 10}
+        assert outer["dispatches"] == 3
+        assert opstats.get_stage("outer") == outer
+        # re-running a stage replaces its recorded deltas (the bench
+        # double-counting fix: per-stage numbers, not cumulative)
+        with opstats.scoped("outer"):
+            pass
+        assert opstats.get_stage("outer") == {}
+
+
+class TestEngineCapture:
+    def test_campaign_from_captured_engine_drain(self, tmp_path):
+        """End to end through the real platform/routing stack: capture
+        a fat-tree pure-drain phase from a live engine
+        (NetworkCm02Model.capture_drain_scenario), fan it into a small
+        what-if fleet, and check a replica against its solo run."""
+        from simgrid_tpu import s4u
+        from tests.test_drain_superstep import fat_tree_platform
+
+        s4u.Engine._reset()
+        try:
+            e = s4u.Engine(["cap", "--cfg=lmm/backend:list",
+                            "--cfg=network/maxmin-selective-update:no",
+                            "--cfg=network/optim:Full",
+                            "--cfg=drain/fastpath:off"])
+            e.load_platform(fat_tree_platform(str(tmp_path)))
+            hosts = e.get_all_hosts()
+            model = e.pimpl.network_model
+            rng = np.random.default_rng(5)
+            pairs = rng.integers(0, len(hosts), size=(96, 2))
+            sizes = rng.choice(np.linspace(1e5, 2e6, 12), 96)
+            for k in range(96):
+                src, dst = int(pairs[k, 0]), int(pairs[k, 1])
+                if src == dst:
+                    dst = (dst + 1) % len(hosts)
+                model.communicate(hosts[src], hosts[dst],
+                                  float(sizes[k]), -1.0)
+            snap = None
+            for _ in range(50):
+                while model.extract_done_action() is not None:
+                    pass
+                if not model.latency_phase_count \
+                        and len(model.started_action_set):
+                    snap = model.capture_drain_scenario()
+                    if snap is not None:
+                        break
+                e.pimpl.surf_solve(-1.0)
+            assert snap is not None
+            # the capture labels constraints with real link names —
+            # the fault dimension keys its schedules off them
+            assert any(n for n in snap["link_names"])
+        finally:
+            s4u.Engine._reset()
+
+        specs = [ScenarioSpec(seed=s, bw_scale=1.0 + 0.2 * s,
+                              fault_mtbf=300.0 if s % 2 else None,
+                              fault_horizon=500.0)
+                 for s in range(3)]
+        camp = Campaign(snap["e_var"], snap["e_cnst"], snap["e_w"],
+                        snap["c_bound"], snap["sizes"],
+                        remains=snap["remains"],
+                        penalty=snap["penalty"],
+                        v_bound=snap["v_bound"],
+                        link_names=snap["link_names"],
+                        specs=specs, eps=1e-9, dtype=np.float64,
+                        superstep=8)
+        results = camp.run_batched(batch=3)
+        assert all(isinstance(r, ReplicaResult) and r.error is None
+                   for r in results)
+        solo = camp.run_solo(1)
+        assert results[1].events == solo.events
+        assert results[1].t == solo.t
+        # fault replicas really diverge from the no-fault base
+        assert results[1].t != results[0].t

@@ -14,7 +14,7 @@ from simgrid_tpu.utils.config import config
 def _restore_flags():
     saved = {k: config[k] for k in
              ("lmm/warm-start", "lmm/delta-upload", "lmm/dtype",
-              "lmm/rounds")}
+              "lmm/rounds", "lmm/layout")}
     yield
     for k, v in saved.items():
         config[k] = v
@@ -210,3 +210,39 @@ def test_host_fallback_invalidates_carry():
     _churn(s, clusters, flows, rng, 2)
     s.solve()
     assert ws.last_mode == "warm"      # carry re-established
+
+
+def test_ell_layout_refuses_warm_start():
+    """ROADMAP gap made visible (ISSUE 4 satellite): the warm carry is
+    COO-only, so a run that selected the ELL layout must fall back to
+    COLD restarts — counted in `warm_ell_fallbacks` — instead of
+    silently warm-starting a layout it cannot serve, and the results
+    must still match a plain cold run bit-for-bit."""
+    from simgrid_tpu.ops import opstats
+
+    config["lmm/warm-start"] = "on"
+    config["lmm/delta-upload"] = "on"
+
+    def run(layout):
+        config["lmm/layout"] = layout
+        s, clusters, flows, rng = _build(13, chain=6)
+        states = []
+        for step in range(6):
+            _churn(s, clusters, flows, rng, step)
+            s.solve()
+            states.append(_host_state(s))
+        return s.warm_solver, states
+
+    before = opstats.snapshot()
+    ws_ell, states_ell = run("ell")
+    d = opstats.diff(before)
+    # every post-carry solve requested a warm restart and was refused
+    assert ws_ell.warm_solves == 0
+    assert ws_ell.warm_ell_fallbacks > 0
+    assert d.get("warm_ell_fallbacks") == ws_ell.warm_ell_fallbacks
+
+    ws_coo, states_coo = run("coo")
+    assert ws_coo.warm_ell_fallbacks == 0
+    assert ws_coo.warm_solves > 0      # the guard is ELL-specific
+    # cold-by-guard equals warm-by-carry: the decomposition contract
+    assert states_ell == states_coo

@@ -28,6 +28,18 @@ solve mode — cold full restart every solve vs warm-started selective
 (ops.lmm_warm) — and asserts (a) run-to-run bit-reproducibility per
 mode and (b) bit-identical completion-event order and final clocks
 ACROSS modes, plus that the warm runs actually reused their carry.
+
+``--runtime-batch`` drains a 64-replica scenario fleet (mixed fault
+seeds + sweep overrides over one shared platform flattening) through
+the batched executor (ops.lmm_batch via parallel.campaign) and
+asserts that sampled replicas extracted from the batch have
+bit-identical event order AND clocks to the same scenario run solo
+through ops.lmm_drain.DrainSim — the batching determinism contract.
+
+``--quick`` is the CI mode: the static lint plus small-N instances of
+every runtime check (drain, warm-start, batch), sized to finish in
+seconds so the tier-1 suite can run it on every test pass
+(tests/test_determinism_lint.py).
 """
 
 from __future__ import annotations
@@ -215,7 +227,97 @@ def check_warmstart_runtime(seed: int = 17, n_clusters=24, per=12,
     return problems
 
 
+def check_batch_runtime(seed: int = 23, n_c: int = 64, n_v: int = 256,
+                        batch: int = 64, k: int = 8,
+                        solo_check=(0, 13, 37, 63)) -> List[str]:
+    """Dynamic determinism of the batched multi-replica executor:
+    replica j extracted from a `batch`-wide fleet (mixed fault seeds +
+    sweep overrides) must have bit-identical completion events (order
+    AND times) and final clock to the same scenario drained solo.
+    Returns a list of problem descriptions (empty = OK)."""
+    import numpy as np
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import build_arrays
+    from simgrid_tpu.parallel.campaign import Campaign, ScenarioSpec
+
+    rng = np.random.default_rng(seed)
+    arrays = build_arrays(rng, n_c, n_v, 3, np.float64)
+    E = arrays.n_elem
+    sizes = rng.choice(np.linspace(1e5, 2e6, 16), n_v)
+    specs = [ScenarioSpec(seed=s,
+                          bw_scale=1.0 + 0.1 * (s % 5),
+                          size_scale=1.0 + 0.05 * (s % 3),
+                          fault_mtbf=400.0 if s % 2 else None,
+                          fault_mttr=50.0, fault_horizon=600.0,
+                          dead_flows=(s % 7,) if s % 3 == 0 else ())
+             for s in range(batch)]
+    campaign = Campaign(arrays.e_var[:E], arrays.e_cnst[:E],
+                        arrays.e_w[:E], arrays.c_bound[:n_c], sizes,
+                        specs, eps=1e-9, dtype=np.float64, superstep=k)
+    results = campaign.run_batched(batch=batch)
+
+    problems: List[str] = []
+    for r in results:
+        if r.error:
+            problems.append(f"replica {r.spec.label}: batched run "
+                            f"errored: {r.error}")
+    for j in solo_check:
+        if j >= batch:
+            continue
+        solo = campaign.run_solo(j)
+        got = results[j]
+        if solo.error or got.error:
+            continue        # already reported above
+        if solo.events != got.events:
+            ndiff = sum(1 for a, b in zip(solo.events, got.events)
+                        if a != b)
+            problems.append(
+                f"replica {j}: batched events differ from solo "
+                f"({len(got.events)} vs {len(solo.events)} events, "
+                f"{ndiff} mismatched pairs)")
+        if solo.t != got.t:
+            problems.append(
+                f"replica {j}: batched clock {got.t!r} != solo "
+                f"{solo.t!r}")
+    return problems
+
+
+def quick_checks() -> List[str]:
+    """The CI bundle: static lint + small-N instances of every runtime
+    check, sized for seconds, so determinism regressions fail pytest
+    instead of waiting for a manual tool run."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    problems = [f"{p}:{n}: {t}"
+                for p, n, t in collect_violations(repo_root)]
+    problems += check_drain_runtime(n_c=32, n_v=128, k=4)
+    problems += check_batch_runtime(n_c=32, n_v=96, batch=6,
+                                    solo_check=(0, 3, 5))
+    return problems
+
+
 def main(argv: List[str]) -> int:
+    if "--quick" in argv:
+        problems = quick_checks()
+        if problems:
+            print("check_determinism: quick checks FAILED:")
+            for p in problems:
+                print(f"  {p}")
+            return 1
+        print("check_determinism: quick OK (lint + small-N drain + "
+              "batch runtime)")
+        return 0
+    if "--runtime-batch" in argv:
+        problems = check_batch_runtime()
+        if problems:
+            print("check_determinism: batch runtime check FAILED:")
+            for p in problems:
+                print(f"  {p}")
+            return 1
+        print("check_determinism: batch runtime OK (replicas from a "
+              "64-wide mixed fault/sweep fleet bit-identical to solo: "
+              "event order and clocks)")
+        argv = [a for a in argv if a != "--runtime-batch"]
     if "--runtime-warmstart" in argv:
         problems = check_warmstart_runtime()
         if problems:
