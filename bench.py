@@ -404,6 +404,176 @@ def stage_sweep(n_c: int, n_v: int, deg: int, seed: int,
     return out
 
 
+def build_wave_arrays(n_c: int, per: int, waves: int, seed: int):
+    """deg=1 drain system shaped like the north-star alltoall phase:
+    `per` flows per (link, size-wave) tie group — every advance
+    retires one whole group, solves converge in ~1 round, and the
+    completion rings run fat.  The shape where the host-side event
+    consumer (engine bookkeeping) is a real fraction of the advance
+    cost, i.e. where pipelining has latency to hide."""
+    rng = np.random.default_rng(seed)
+    n_v = n_c * per * waves
+    e_var = np.arange(n_v, dtype=np.int32)
+    e_cnst = (np.arange(n_v) // (per * waves)).astype(np.int32)
+    e_w = np.ones(n_v)
+    c_bound = rng.uniform(1e5, 1e6, n_c)
+    wave = (np.arange(n_v) // per) % waves
+    sizes = 1e6 * (1.0 + 0.21 * wave)
+    return e_var, e_cnst, e_w, c_bound, sizes
+
+
+def stage_pipeline(seed: int, k: int = 8, host_work_us: float = 500.0,
+                   n_c: int = 32, per: int = 1, waves: int = 8,
+                   replicas: int = 64) -> dict:
+    """Speculative pipelined drain (the ISSUE-5 trajectory metric):
+    blocking fetches per advance, pipelined vs superstep-only at equal
+    K, plus speculation commit rate and the compact per-replica
+    element-weight payload bytes.
+
+    Two workloads, both CPU (the contract is the count of fetches the
+    host genuinely stalled on, which opstats classifies via
+    Array.is_ready at fetch time):
+
+    * **solo** — a wave-drain (build_wave_arrays) with an event
+      consumer attached (DrainSim.on_batches) that emulates
+      `host_work_us` of per-advance maestro bookkeeping (the engine
+      fast path's finish/wakeup/heap work — measured at a few hundred
+      µs/advance at engine scale).  The SAME consumer runs at every
+      depth, so the comparison is fair: superstep-only pays the device
+      round trip on every fetch ON TOP of the host work, the pipelined
+      driver hides it behind the host work.  `host_work_us` is
+      recorded on every row.
+    * **fleet** — a `replicas`-wide campaign chunk (per-lane demux is
+      the natural host work, no emulation), with per-replica elem_w
+      overrides so the indexed-payload upload bytes land on the row
+      next to the dense B×E bytes they replace.
+
+    Rows (schema-stable: stage/mode/batch/platform + depth/superstep)
+    are appended to bench_results/lmm_pipeline.jsonl."""
+    _force_cpu()
+    import time as _time
+
+    import jax  # noqa: F401
+    from simgrid_tpu.ops import opstats
+    from simgrid_tpu.ops.lmm_drain import DrainSim
+    from simgrid_tpu.parallel.campaign import Campaign, ScenarioSpec
+
+    ev, ec, ew, cb, sizes = build_wave_arrays(n_c, per, waves, seed)
+    n_v = len(sizes)
+
+    def spin(us):
+        t_end = _time.perf_counter() + us * 1e-6
+        while _time.perf_counter() < t_end:
+            pass
+
+    def run_solo(depth):
+        sim = DrainSim(ev, ec, ew, cb, sizes, eps=1e-9,
+                       dtype=np.float64, repack_min=1 << 62,
+                       superstep=k, pipeline=depth)
+        if host_work_us:
+            sim.on_batches = lambda bs: spin(host_work_us * len(bs))
+        t0 = _time.perf_counter()
+        sim.run()
+        return sim, (_time.perf_counter() - t0) * 1e3
+
+    rows = []
+    streams = {}
+    run_solo(0)                       # warm the jits once, unscoped
+    for depth in (0, 1, 2):
+        with opstats.scoped(f"pipeline/solo-d{depth}") as st:
+            sim, wall = run_solo(depth)
+        streams[depth] = (sim.events, sim.t)
+        adv = max(sim.advances, 1)
+        row = {"bench": "lmm_pipeline", "workload": "solo-wave",
+               "n_c": n_c, "n_v": n_v, "seed": seed,
+               "depth": depth, "superstep": k,
+               "host_work_us": host_work_us,
+               "advances": sim.advances,
+               "supersteps": sim.supersteps,
+               "fetches": int(st.get("fetches", 0)),
+               "blocking_fetches": int(st.get("blocking_fetches", 0)),
+               "blocking_per_advance":
+                   round(st.get("blocking_fetches", 0) / adv, 5),
+               "host_block_ms": round(st.get("host_block_ms", 0), 1),
+               "wall_ms": round(wall, 1),
+               "spec_issued": sim.spec_issued,
+               "spec_committed": sim.spec_committed,
+               "spec_rolled_back": sim.spec_rolled_back,
+               "spec_commit_rate":
+                   round(sim.spec_committed / sim.spec_issued, 3)
+                   if sim.spec_issued else None}
+        rows.append(schema_row("pipeline", row, mode="solo",
+                               platform="cpu"))
+        log(f"[stage pipeline] solo depth={depth}: "
+            f"{row['blocking_fetches']}/{row['fetches']} blocking, "
+            f"{row['host_block_ms']} ms blocked, wall {row['wall_ms']}")
+    consistent = all(streams[d] == streams[0] for d in streams)
+
+    # -- fleet chunk with compact elem_w overrides ----------------------
+    E = len(ev)
+    specs = [ScenarioSpec(seed=s, bw_scale=1.0 + 0.01 * (s % 37),
+                          elem_w={(5 * s) % E: 1.5, (5 * s + 2) % E: 0.5})
+             for s in range(replicas)]
+    camp = Campaign(ev, ec, ew, cb, sizes, specs, eps=1e-9,
+                    dtype=np.float64, superstep=k)
+    camp.run_batched(batch=replicas, pipeline=2)   # warm
+    fleet_streams = {}
+    for depth in (0, 1, 2):
+        t0 = _time.perf_counter()
+        res, st = camp.run_scoped(batch=replicas,
+                                  stage=f"pipeline/fleet-d{depth}",
+                                  pipeline=depth)
+        wall = (_time.perf_counter() - t0) * 1e3
+        adv = max(sum(r.advances for r in res), 1)
+        fleet_streams[depth] = [(r.events, r.t) for r in res]
+        dense = replicas * E * np.dtype(np.float64).itemsize
+        row = {"bench": "lmm_pipeline", "workload": "fleet-wave",
+               "n_c": n_c, "n_v": n_v, "seed": seed,
+               "depth": depth, "superstep": k, "host_work_us": 0.0,
+               "advances": int(adv),
+               "fetches": int(st.get("fetches", 0)),
+               "blocking_fetches": int(st.get("blocking_fetches", 0)),
+               "blocking_per_advance":
+                   round(st.get("blocking_fetches", 0) / adv, 6),
+               "host_block_ms": round(st.get("host_block_ms", 0), 1),
+               "wall_ms": round(wall, 1),
+               "spec_issued": int(st.get("speculations_issued", 0)),
+               "spec_committed":
+                   int(st.get("speculations_committed", 0)),
+               "spec_rolled_back":
+                   int(st.get("speculations_rolled_back", 0)),
+               "elem_w_payload_bytes":
+                   int(st.get("uploaded_bytes_delta", 0)),
+               "elem_w_dense_bytes": dense}
+        rows.append(schema_row("pipeline", row, mode="fleet",
+                               batch=replicas, platform="cpu"))
+        log(f"[stage pipeline] fleet depth={depth}: "
+            f"{row['blocking_fetches']}/{row['fetches']} blocking, "
+            f"payload {row['elem_w_payload_bytes']}B vs dense "
+            f"{dense}B")
+    consistent = consistent and all(fleet_streams[d] == fleet_streams[0]
+                                    for d in fleet_streams)
+    for row in rows:
+        row["events_consistent"] = consistent
+    path = append_rows("lmm_pipeline.jsonl", rows)
+    log(f"[stage pipeline] rows appended to {path} "
+        f"(events_consistent={consistent})")
+
+    out = {"rows": rows, "events_consistent": consistent}
+    solo = {r["depth"]: r for r in rows if r["mode"] == "solo"}
+    if solo.get(0, {}).get("blocking_fetches"):
+        best = min(r["blocking_fetches"] for d, r in solo.items() if d)
+        out["blocking_fetch_reduction"] = round(
+            solo[0]["blocking_fetches"] / max(best, 1), 1)
+    fleet = {r["depth"]: r for r in rows if r["mode"] == "fleet"}
+    if fleet:
+        f0 = fleet[0]
+        out["elem_w_bytes_vs_dense"] = round(
+            f0["elem_w_dense_bytes"]
+            / max(f0["elem_w_payload_bytes"], 1), 1)
+    return out
+
+
 STAGES = {
     "probe": lambda args: stage_probe(),
     "dev": lambda args: stage_device(args.n_c, args.n_v, args.deg,
@@ -419,6 +589,9 @@ STAGES = {
     "sweep": lambda args: stage_sweep(args.n_c, args.n_v, args.deg,
                                       args.seed, args.replicas,
                                       args.superstep),
+    "pipeline": lambda args: stage_pipeline(args.seed, args.superstep,
+                                            args.host_work_us,
+                                            replicas=args.replicas),
 }
 
 
@@ -627,6 +800,15 @@ def main() -> None:
     if sweep:
         detail["lmm_batch_sweep"] = sweep
 
+    # --- speculative pipelined drain (ops.lmm_drain pipeline=D) --------
+    # blocking fetches per advance, pipelined vs superstep-only at
+    # equal K, with speculation commit rate and the indexed elem_w
+    # payload bytes; rows land in bench_results/lmm_pipeline.jsonl
+    pipeline = run_stage("pipeline", timeout=1800, errors=errors,
+                         seed=42, replicas=64, superstep=8)
+    if pipeline:
+        detail["lmm_pipeline"] = pipeline
+
     # mergeable per-class solve rows for the record (same schema as the
     # churn/sweep files: bench_results/*.jsonl concatenate across PRs)
     solve_rows = []
@@ -709,7 +891,13 @@ if __name__ == "__main__":
     parser.add_argument("--replicas", type=int, default=64,
                         help="sweep stage: scenario fleet size")
     parser.add_argument("--superstep", type=int, default=8,
-                        help="sweep stage: advances per drain dispatch")
+                        help="sweep/pipeline stages: advances per "
+                        "drain dispatch")
+    parser.add_argument("--host-work-us", type=float, default=500.0,
+                        dest="host_work_us",
+                        help="pipeline stage: emulated per-advance "
+                        "host bookkeeping (µs) the speculative "
+                        "dispatch overlaps; recorded on every row")
     parser.add_argument("--clusters", type=int, default=960)
     parser.add_argument("--chain", type=int, default=96)
     parser.add_argument("--churn", type=float, default=0.01)

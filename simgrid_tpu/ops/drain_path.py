@@ -26,7 +26,19 @@ serves *batches* of advances from one `DrainSim` superstep dispatch
   generic loop after a deterministic REPLAY: the batch is re-executed
   from its saved device state up to the served prefix (jax arrays are
   immutable, so batch-start state is a free O(1) snapshot), remains and
-  rates are written back, and the generic code runs unchanged.
+  rates are written back, and the generic code runs unchanged;
+* with ``drain/pipeline`` > 0 the NEXT superstep is issued
+  speculatively the moment ring N is fetched — JAX dispatch is async,
+  so the device executes ring N+1 while the engine consumes ring N's
+  batches, and the next fetch finds a ready buffer instead of paying
+  the tunnel round trip.  Speculation never touches the committed
+  flow state (the dispatch chains from double-buffered immutable
+  arrays), so ANY plan invalidation — profile event before the
+  horizon, ArrayView mutation, partial advance, stall — simply
+  discards the in-flight token and the existing deterministic-replay
+  rollback proceeds exactly as in the unpipelined path.  Event order,
+  timestamps and clocks are bit-identical to ``drain/pipeline:0``
+  (enforced by ``tools/check_determinism.py --runtime-pipeline``).
 
 Precision: f64 plans retire flows at the engine's absolute
 `maxmin/precision * surf/precision` threshold — bit-matching the
@@ -142,11 +154,15 @@ class DrainFastPath:
         self.batches: List[Tuple[float, List[int]]] = []
         self.saved = None                   # (pen, rem) at batch start
         self.served = 0                     # advances of current batch
+        self.spec = None                    # in-flight speculative token
         # observability (asserted by tests, reported by tools)
         self.plans = 0
         self.advances_served = 0
         self.invalidations = 0
         self.rollbacks = 0
+        self.speculations = 0
+        self.spec_commits = 0
+        self.spec_discards = 0
 
     # -- eligibility -------------------------------------------------------
 
@@ -217,21 +233,39 @@ class DrainFastPath:
         self.batches = []
         self.saved = None
         self.served = 0
+        self.spec = None
         self.plans += 1
         return True
 
     # -- plan serving ------------------------------------------------------
 
+    def _discard_spec(self) -> None:
+        """Drop the in-flight speculative superstep (mispredict: the
+        plan is being invalidated, or its batch never materialized).
+        Issue never committed anything, so there is no state to
+        restore — only the device work is wasted (and counted)."""
+        if self.spec is not None:
+            if self.sim is not None:
+                self.sim._discard_token(self.spec)
+            self.spec_discards += 1
+            self.spec = None
+
     def _dispatch_batch(self) -> bool:
-        """One superstep dispatch + fetch; False when it made no
-        progress (solve exceeded the round budget, or the drain
-        stalled — a parked/zero-rate remainder the generic path knows
-        how to diagnose)."""
+        """Collect one superstep (the in-flight speculative one when
+        the prediction held, else a fresh dispatch + fetch); False when
+        it made no progress (solve exceeded the round budget, or the
+        drain stalled — a parked/zero-rate remainder the generic path
+        knows how to diagnose)."""
         sim = self.sim
-        self.saved = (sim._pen, sim._rem)
+        tok, self.spec = self.spec, None
+        if tok is None:
+            tok = sim._superstep_issue()
+        # batch-start snapshot for deterministic replay: the token's
+        # input arrays ARE the pre-dispatch state (immutable, O(1))
+        self.saved = (tok.pen_in, tok.rem_in)
         self.served = 0
         try:
-            n_live, batches = sim.superstep_batch()
+            n_live, batches, clean = sim._superstep_collect(tok)
         except RuntimeError:
             # stall/non-convergence surfaced mid-batch: the advances it
             # applied were never served, so restore the batch-start
@@ -239,9 +273,18 @@ class DrainFastPath:
             # phase back to the generic path
             sim._pen, sim._rem = self.saved
             return False
+        if tok.speculative:
+            self.spec_commits += 1
         if not batches:
             return False
         self.batches = batches
+        if clean and int(config["drain/pipeline"]) > 0:
+            # speculative issue of the NEXT superstep: the device
+            # executes ring N+1 while the engine consumes ring N's
+            # batches below (plans keep ONE token in flight — each
+            # ring already covers K engine advances of host work)
+            self.spec = sim._superstep_issue(speculative=True)
+            self.speculations += 1
         return True
 
     def serve(self, now: float) -> Optional[float]:
@@ -307,7 +350,10 @@ class DrainFastPath:
         replayed to the served prefix and `remains` written back to the
         still-live actions (with_rates also refreshes
         action.variable.value so the generic loop can apply a partial
-        advance)."""
+        advance).  An in-flight speculative superstep is discarded
+        FIRST — it was issued against post-batch state the rollback is
+        about to rewind past, and it never committed anything."""
+        self._discard_spec()
         sim, saved = self.sim, self.saved
         self.sim = None
         if sim is None:

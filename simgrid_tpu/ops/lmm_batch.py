@@ -75,16 +75,24 @@ class ReplicaOverrides:
                          (fault-campaign degradations, hot-spot what-ifs);
     * ``flow_scale``   — sparse {variable slot: size factor};
     * ``dead_flows``   — variable slots absent from this replica
-                         (penalty forced to 0: the flow never runs).
+                         (penalty forced to 0: the flow never runs);
+    * ``elem_w``       — sparse {element slot: sharing weight}: this
+                         replica's element-weight deviations from the
+                         shared ``e_w`` table (route-weight what-ifs,
+                         per-replica QoS shares).  The fleet's [B, E]
+                         weight table is materialized ON DEVICE from
+                         these indexed payloads — upload bytes scale
+                         with the overridden slots, never with B×E.
     """
 
     __slots__ = ("bw_scale", "size_scale", "link_scale", "flow_scale",
-                 "dead_flows")
+                 "dead_flows", "elem_w")
 
     def __init__(self, bw_scale: float = 1.0, size_scale: float = 1.0,
                  link_scale: Optional[Dict[int, float]] = None,
                  flow_scale: Optional[Dict[int, float]] = None,
-                 dead_flows: Iterable[int] = ()):
+                 dead_flows: Iterable[int] = (),
+                 elem_w: Optional[Dict[int, float]] = None):
         if bw_scale <= 0 or size_scale <= 0:
             raise ValueError("bw_scale and size_scale must be > 0")
         self.bw_scale = float(bw_scale)
@@ -92,6 +100,7 @@ class ReplicaOverrides:
         self.link_scale = dict(link_scale or {})
         self.flow_scale = dict(flow_scale or {})
         self.dead_flows = tuple(sorted(set(int(s) for s in dead_flows)))
+        self.elem_w = dict(elem_w or {})
 
 
 def derive_replica_arrays(c_bound, sizes, remains, penalty,
@@ -114,6 +123,18 @@ def derive_replica_arrays(c_bound, sizes, remains, penalty,
     for slot in ov.dead_flows:
         pen[slot] = 0.0
     return cb, sz, rem, pen
+
+
+def derive_replica_ew(e_w, ov: ReplicaOverrides, dtype) -> np.ndarray:
+    """HOST materialization of one replica's element weights — the
+    op-for-op mirror of the device `_materialize_ew` kernel: indexed
+    SET (not multiply) of the overridden slots in sorted order, then
+    the dtype cast.  Exact: scatter-set carries the payload value
+    bit-for-bit, so solo and batched lanes see identical weights."""
+    ew = np.asarray(e_w, np.float64).copy()
+    for slot in sorted(ov.elem_w):
+        ew[slot] = ov.elem_w[slot]
+    return ew.astype(dtype)
 
 
 def _pack_overrides(specs: List[ReplicaOverrides], n_c: int, n_v: int):
@@ -141,6 +162,37 @@ def _pack_overrides(specs: List[ReplicaOverrides], n_c: int, n_v: int):
         for j, slot in enumerate(s.dead_flows):
             di[b, j] = slot
     return bw, fs, li, lf, fi, ff, di
+
+
+def _pack_elem_w(specs: List[ReplicaOverrides], pad_idx: int, dtype):
+    """Stack the fleet's sparse element-weight overrides into one
+    padded indexed payload (pad index = out-of-range slot, dropped by
+    the device scatter).  Bytes scale with the widest replica's
+    override count — NEVER with B×E."""
+    B = len(specs)
+    se = max(1, max(len(s.elem_w) for s in specs))
+    ei = np.full((B, se), pad_idx, np.int32)
+    ew = np.zeros((B, se), dtype)
+    for b, s in enumerate(specs):
+        for j, slot in enumerate(sorted(s.elem_w)):
+            ei[b, j] = slot
+            ew[b, j] = s.elem_w[slot]
+    return ei, ew
+
+
+@jax.jit
+def _materialize_ew(base_ew2, ei, ew):
+    """DEVICE materialization of the fleet's [B, ·, group] element
+    weights from the shared 2D table + indexed payloads: per-lane
+    scatter-SET into the flattened table (pad slots drop).  Must stay
+    the op-for-op mirror of derive_replica_ew."""
+    flat = base_ew2.reshape(-1)
+
+    def lane(ei_l, ew_l):
+        return flat.at[ei_l].set(ew_l, mode="drop").reshape(
+            base_ew2.shape)
+
+    return jax.vmap(lane)(ei, ew)
 
 
 @jax.jit
@@ -345,6 +397,28 @@ def solve_arrays_batch(e_var, e_cnst, e_w, c_bound, c_fatpipe,
 # The batched drain executor
 # ---------------------------------------------------------------------------
 
+class FleetToken:
+    """One issued (possibly in-flight) fleet superstep: the batched
+    mirror of ops.lmm_drain.SuperstepToken, carrying the [B, ·] flow
+    state in/out plus the alive mask the dispatch ran under.  jax
+    arrays are immutable, so the token is a free double-buffered
+    snapshot; discarding an un-collected token is O(1)."""
+
+    __slots__ = ("pen_in", "rem_in", "pen_out", "rem_out", "packed",
+                 "k", "alive", "speculative")
+
+    def __init__(self, pen_in, rem_in, pen_out, rem_out, packed,
+                 k: int, alive, speculative: bool):
+        self.pen_in = pen_in
+        self.rem_in = rem_in
+        self.pen_out = pen_out
+        self.rem_out = rem_out
+        self.packed = packed
+        self.k = k
+        self.alive = alive
+        self.speculative = speculative
+
+
 class ReplicaState:
     """Host-side record of one replica in a fleet."""
 
@@ -370,11 +444,22 @@ class BatchDrainSim:
 
     Per-replica state is (c_bound, penalties, remaining, thresholds)
     with the batch axis leading; the structure tables and (by default)
-    the element weights are shared and uploaded once.  Finished or
+    the element weights are shared and uploaded once.  Replicas whose
+    overrides carry ``elem_w`` entries get per-replica weight tables
+    materialized ON DEVICE from the indexed payload (upload bytes ~
+    overridden slots, not B×E).  Finished or
     diverged replicas go dark via the alive mask instead of forcing
     ragged shapes; the fleet repacks NEVER (lockstep shapes), so each
     lane's reduction order — and therefore its event order and clock —
     is bit-identical to a solo no-repack DrainSim of the same scenario.
+
+    ``pipeline=D`` keeps up to D speculative fleet supersteps in
+    flight beyond the one being collected (see ops.lmm_drain): the
+    host demultiplexes ring N's [B, ·] fetch — a serial Python walk
+    over every lane — while the device already executes fleet
+    superstep N+1.  Any alive-mask change or budget rescue while
+    processing ring N discards the in-flight tokens; results are
+    bit-identical to ``pipeline=0``.
     """
 
     def __init__(self, e_var, e_cnst, e_w, c_bound, sizes,
@@ -383,7 +468,7 @@ class BatchDrainSim:
                  dtype=np.float64, done_mode: str = "rel",
                  superstep: int = 8, superstep_rounds: int = 0,
                  device=None, v_bound=None, penalty=None, remains=None,
-                 e_w_batch=None):
+                 pipeline: int = 0):
         if not overrides:
             raise ValueError("BatchDrainSim needs at least one replica")
         if done_mode not in ("rel", "abs"):
@@ -422,12 +507,13 @@ class BatchDrainSim:
                           else np.ones(self.n_v, np.float64))
         ev2 = _to2d(np.asarray(e_var, np.int32))
         ec2 = _to2d(np.asarray(e_cnst, np.int32))
-        self.batch_w = e_w_batch is not None
-        if self.batch_w:
-            ew_host = np.asarray(e_w_batch, self.dtype)
-            ew2 = np.stack([_to2d(ew_host[b]) for b in range(self.B)])
-        else:
-            ew2 = _to2d(np.asarray(e_w, self.dtype))
+        ew2 = _to2d(np.asarray(e_w, self.dtype))
+        # per-replica element weights ride an INDEXED payload and are
+        # materialized on device below — the shared 2D table is still
+        # uploaded exactly once whatever B is
+        self.batch_w = any(ov.elem_w for ov in overrides)
+        ew_payload = (_pack_elem_w(overrides, ew2.size, self.dtype)
+                      if self.batch_w else None)
         if v_bound is not None:
             vb = np.asarray(v_bound, self.dtype)
             self.has_bounds = bool(np.any(vb > 0))
@@ -435,7 +521,17 @@ class BatchDrainSim:
             vb = np.full(self.n_v, -1.0, self.dtype)
             self.has_bounds = False
 
-        self._dev = [jax.device_put(a, device) for a in (ev2, ec2, ew2)]
+        ew_dev = jax.device_put(ew2, device)
+        if self.batch_w:
+            ei_dev, ewv_dev = [jax.device_put(a, device)
+                               for a in ew_payload]
+            opstats.bump("uploaded_bytes_delta",
+                         sum(a.nbytes for a in ew_payload))
+            ew_dev = _materialize_ew(ew_dev, ei_dev, ewv_dev)
+            opstats.bump("dispatches")
+            opstats.bump("batch_dispatches")
+        self._dev = [jax.device_put(ev2, device),
+                     jax.device_put(ec2, device), ew_dev]
         self._vb = jax.device_put(vb, device)
         ids = np.arange(self.n_v, dtype=np.int32)
         self._ids_dev = jax.device_put(ids, device)
@@ -474,25 +570,37 @@ class BatchDrainSim:
         self.supersteps = 0
         self.syncs = 0
         self.rounds = 0
+        self.pipeline = int(pipeline)
+        # speculation census (pipelined fleet driver)
+        self.spec_issued = 0
+        self.spec_committed = 0
+        self.spec_rolled_back = 0
         opstats.bump("batch_replicas", self.B)
 
     # -- fleet stepping ----------------------------------------------------
 
     def _fetch(self, packed) -> np.ndarray:
         self.syncs += 1
-        return np.asarray(packed)
+        return opstats.timed_fetch(packed)
 
-    def superstep_all(self, k: Optional[int] = None) -> int:
-        """ONE batched superstep dispatch for every live replica and
-        ONE [B, ·] fetch; commits per-replica events and clocks.
-        Returns the number of still-live replicas."""
+    def _superstep_issue_all(self, k: Optional[int] = None, pen=None,
+                             rem=None, speculative: bool = False
+                             ) -> "FleetToken":
+        """Dispatch ONE fleet superstep without touching the committed
+        state: chains from `(pen, rem)` (default: committed) under the
+        CURRENT alive mask; inputs/outputs ride the returned token
+        (see ops.lmm_drain — same issue/collect speculation protocol,
+        one [B, ·] ring per token)."""
         k_max = self.superstep_k
         k = k_max if k is None else min(int(k), k_max)
         group = _pos_group(self.n_v)
-        self._pen, self._rem, packed = _batch_superstep(
-            *self._dev, self._cb, self._vb, self._pen, self._rem,
+        alive = self._alive.copy()
+        pen_in = self._pen if pen is None else pen
+        rem_in = self._rem if rem is None else rem
+        pen_out, rem_out, packed = _batch_superstep(
+            *self._dev, self._cb, self._vb, pen_in, rem_in,
             self._thresh, self._ids_dev,
-            jnp.asarray(self._alive), np.int32(k),
+            jnp.asarray(alive), np.int32(k),
             np.int32(self.superstep_rounds),
             eps=self.eps, n_c=self.n_c, n_v=self.n_v, k_max=k_max,
             group=group, has_bounds=self.has_bounds,
@@ -500,12 +608,36 @@ class BatchDrainSim:
         self.supersteps += 1
         opstats.bump("dispatches")
         opstats.bump("batch_dispatches")
-        p = self._fetch(packed)
-        n_v, B = self.n_v, self.B
+        if speculative:
+            self.spec_issued += 1
+            opstats.bump("speculations_issued")
+        return FleetToken(pen_in, rem_in, pen_out, rem_out, packed,
+                          k, alive, speculative)
+
+    def _discard_token(self, tok: "FleetToken") -> None:
+        """Drop an un-collected speculative fleet superstep (the alive
+        mask changed or a rescue ran while processing the preceding
+        ring): issue never committed anything, so rollback is O(1)."""
+        self.spec_rolled_back += 1
+        opstats.bump("speculations_rolled_back")
+
+    def _superstep_collect_all(self, tok: "FleetToken"
+                               ) -> Tuple[int, bool]:
+        """Commit one issued fleet superstep: adopt its output arrays,
+        fetch its [B, ·] packed rings (ONE transfer) and demultiplex
+        per-replica events/clocks on the host.  Returns
+        ``(n_alive, clean)`` — clean False when processing this ring
+        mutated the fleet (a lane died or needed the fused rescue), so
+        in-flight speculative successors must be discarded."""
+        self._pen, self._rem = tok.pen_out, tok.rem_out
+        k_max = self.superstep_k
+        p = self._fetch(tok.packed)
+        n_v = self.n_v
         o = 7
         stuck: List[int] = []
-        for b in range(B):
-            if not self._alive[b]:
+        deaths = 0
+        for b in range(self.B):
+            if not tok.alive[b]:
                 continue
             rep = self.replicas[b]
             row = p[b]
@@ -528,9 +660,11 @@ class BatchDrainSim:
                              f"({n_live} live)")
                 rep.alive = False
                 self._alive[b] = False
+                deaths += 1
             elif n_live == 0:
                 rep.alive = False
                 self._alive[b] = False
+                deaths += 1
             elif flag == _FLAG_BUDGET and adv == 0:
                 stuck.append(b)
         if stuck:
@@ -539,7 +673,19 @@ class BatchDrainSim:
             # chunked fused program (converges across dispatches), the
             # batched mirror of the solo run() rescue
             self._rescue_fused(stuck)
-        return int(self._alive.sum())
+        if tok.speculative:
+            self.spec_committed += 1
+            opstats.bump("speculations_committed")
+        clean = not deaths and not stuck
+        return int(self._alive.sum()), clean
+
+    def superstep_all(self, k: Optional[int] = None) -> int:
+        """ONE batched superstep dispatch for every live replica and
+        ONE [B, ·] fetch; commits per-replica events and clocks.
+        Returns the number of still-live replicas."""
+        n_alive, _clean = self._superstep_collect_all(
+            self._superstep_issue_all(k))
+        return n_alive
 
     def _rescue_fused(self, stuck: List[int]) -> None:
         active = np.zeros(self.B, bool)
@@ -605,8 +751,44 @@ class BatchDrainSim:
             if not active.any():
                 break
 
+    def _run_pipelined(self, max_supersteps: int) -> None:
+        """The speculative fleet driver: up to ``self.pipeline``
+        supersteps in flight beyond the one being collected, FIFO
+        collects, discard-on-mutation — the fleet mirror of
+        ops.lmm_drain.DrainSim._run_pipelined.  The host's serial
+        per-lane ring demux overlaps the device's next vmapped
+        superstep; a lane death or budget rescue discards the
+        speculative tail (their dispatches assumed a stale alive
+        mask)."""
+        from collections import deque
+        inflight: deque = deque()
+        left = max_supersteps
+        try:
+            while self._alive.any() and left > 0:
+                while (not inflight
+                       or (len(inflight) <= self.pipeline
+                           and len(inflight) < left)):
+                    spec = bool(inflight)
+                    pen, rem = ((inflight[-1].pen_out,
+                                 inflight[-1].rem_out)
+                                if inflight else (None, None))
+                    inflight.append(self._superstep_issue_all(
+                        pen=pen, rem=rem, speculative=spec))
+                tok = inflight.popleft()
+                _n_alive, clean = self._superstep_collect_all(tok)
+                left -= 1
+                if not clean:
+                    while inflight:
+                        self._discard_token(inflight.popleft())
+        finally:
+            while inflight:
+                self._discard_token(inflight.popleft())
+
     def run(self, max_supersteps: int = 10_000_000) -> None:
         """Drain every replica to completion (or error)."""
+        if self.pipeline:
+            self._run_pipelined(max_supersteps)
+            return
         while self._alive.any() and max_supersteps > 0:
             self.superstep_all()
             max_supersteps -= 1

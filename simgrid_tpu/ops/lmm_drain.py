@@ -58,11 +58,34 @@ The kernel programs (`_solve_chunk_program`, `_fused_step_program`,
 multi-replica executor (ops.lmm_batch), which vmaps them over a
 leading replica axis to drain whole scenario fleets per dispatch —
 keep them pure functions of their arguments.
+
+Speculative pipelining (``pipeline=D``): JAX dispatch is ASYNC — only
+the completion-ring fetch blocks the host — so the superstep driver
+can keep D extra supersteps in flight against double-buffered flow
+state: while the host parses ring N (a pure-Python O(events) walk),
+superstep N+1 is already executing on the device, and the fetch of
+ring N+1 finds its buffer ready instead of eating the full tunnel
+round trip.  The dispatch of a superstep is split into an *issue*
+(:meth:`DrainSim._superstep_issue` — pure with respect to the sim's
+committed flow state; the dispatch inputs/outputs ride a
+:class:`SuperstepToken`) and a *collect* (the blocking fetch + host
+event commit).  Speculation is validated at collect time: if
+processing ring N mutated anything the in-flight dispatch assumed
+frozen (a device repack, the stop-for-repack trigger decay, a budget
+rescue, a stall, or drain completion), every un-collected token is
+DISCARDED — issue never touched the committed state, jax arrays are
+immutable, so rollback is O(1) — and the pipeline restarts from the
+post-N state, recomputing exactly what the unpipelined driver would
+have.  Committed speculative supersteps are bit-identical to the
+unpipelined path by construction: the program is a deterministic
+function of its inputs and a token commits only when its inputs
+turned out to equal the unpipelined path's inputs.
 """
 
 from __future__ import annotations
 
 import functools
+from collections import deque
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -345,6 +368,33 @@ def _repack_vbound(v_bound, pen, vh: int):
     return jnp.take(v_bound, perm_v[:vh])
 
 
+class SuperstepToken:
+    """One issued (possibly still in-flight) superstep dispatch.
+
+    The token owns the dispatch's input AND output device arrays: jax
+    arrays are immutable, so ``(pen_in, rem_in)`` is a free snapshot of
+    the pre-dispatch flow state and ``(pen_out, rem_out)`` is the
+    double-buffered post-dispatch state the NEXT speculative dispatch
+    chains from.  Nothing is committed to the owning sim until the
+    token is collected; discarding an un-collected token costs nothing
+    but the device work it already burned."""
+
+    __slots__ = ("pen_in", "rem_in", "pen_out", "rem_out", "packed",
+                 "k", "k_max", "want_stop", "speculative")
+
+    def __init__(self, pen_in, rem_in, pen_out, rem_out, packed,
+                 k: int, k_max: int, want_stop: int, speculative: bool):
+        self.pen_in = pen_in
+        self.rem_in = rem_in
+        self.pen_out = pen_out
+        self.rem_out = rem_out
+        self.packed = packed
+        self.k = k
+        self.k_max = k_max
+        self.want_stop = want_stop
+        self.speculative = speculative
+
+
 class DrainSim:
     """Drain a fixed flow set to completion on the JAX backend.
 
@@ -365,6 +415,14 @@ class DrainSim:
     `superstep=K` batches up to K advances per dispatch (~1/K
     syncs/advance) with on-device repacks.  `v_bound` optionally caps
     per-flow rates (TCP-gamma windows etc.).
+
+    `pipeline=D` (superstep mode only) keeps up to D speculative
+    supersteps in flight beyond the one being collected: the host
+    processes ring N while the device executes ring N+1, hiding the
+    dispatch round trip.  Results are bit-identical to `pipeline=0` —
+    any host-side mutation while processing a ring (repack, budget
+    rescue, stall, completion) discards the in-flight work and replays
+    it from the committed state (see the module docstring).
     """
 
     def __init__(self, e_var, e_cnst, e_w, c_bound, sizes,
@@ -374,7 +432,7 @@ class DrainSim:
                  v_bound=None, done_mode: str = "rel",
                  fused: bool = False, superstep: int = 0,
                  superstep_rounds: int = 0, repack_min: int = 1024,
-                 penalty=None, remains=None):
+                 penalty=None, remains=None, pipeline: int = 0):
         self.eps = float(eps)
         self.done_eps = float(done_eps)
         if done_mode not in ("rel", "abs"):
@@ -465,6 +523,11 @@ class DrainSim:
         self._live0 = (int(np.count_nonzero(pen0 > 0))
                        if penalty is not None else self.n_v)
 
+        self.pipeline = int(pipeline)
+        if self.pipeline and not self.superstep_k:
+            raise ValueError("pipeline=D needs superstep=K (speculation "
+                             "is a property of the superstep driver)")
+
         self.t = 0.0              # f64 master clock (host-accumulated)
         self.events: list = []   # (time, original flow id), completion order
         self.advances = 0
@@ -472,6 +535,17 @@ class DrainSim:
         self.syncs = 0
         self.repacks = 0
         self.supersteps = 0
+        # speculation census (pipelined driver + drain fast path)
+        self.spec_issued = 0
+        self.spec_committed = 0
+        self.spec_rolled_back = 0
+        #: optional event consumer, called once per collected superstep
+        #: with the batch list [(dt, [flow ids])] — the host-side work
+        #: (engine bookkeeping, demux, logging) the pipelined driver
+        #: overlaps with the next in-flight dispatch.  Runs INSIDE the
+        #: collect, i.e. between the ring fetch and the next blocking
+        #: point, for both the pipelined and synchronous drivers.
+        self.on_batches = None
 
     # -- host-side helpers -------------------------------------------------
 
@@ -655,15 +729,14 @@ class DrainSim:
 
     # -- superstep path ----------------------------------------------------
 
-    def superstep_batch(self, k: Optional[int] = None,
-                        fetch: bool = True, stop_live: int = 0):
-        """Dispatch ONE superstep of up to `k` advances and (optionally)
-        fetch its packed result — a single transfer.
-
-        Returns (n_live, batches) where batches is a list of
-        (dt, [original flow ids]) per executed advance; with
-        fetch=False nothing is transferred (replay) and (None, None) is
-        returned.  Events/clock/counters are committed on fetch."""
+    def _superstep_issue(self, k: Optional[int] = None, pen=None,
+                         rem=None, speculative: bool = False,
+                         stop_live: int = 0) -> SuperstepToken:
+        """Dispatch ONE superstep of up to `k` advances WITHOUT
+        touching the committed flow state: the dispatch chains from
+        `(pen, rem)` (default: the committed state) and its outputs
+        ride the returned token.  Pure host-side except the async
+        dispatch itself, so speculative issues are free to discard."""
         if not self.superstep_k and k is None:
             raise ValueError("superstep_batch needs superstep=K "
                              "(constructor) or an explicit k")
@@ -677,17 +750,46 @@ class DrainSim:
                            if self._live0 * self.repack_at
                            >= self.repack_min else 0))
         group = _pos_group(self.n_v)
-        self._pen, self._rem, packed = _drain_superstep(
-            *self._dev, self._cb, self._vb, self._pen, self._rem,
+        pen_in = self._pen if pen is None else pen
+        rem_in = self._rem if rem is None else rem
+        pen_out, rem_out, packed = _drain_superstep(
+            *self._dev, self._cb, self._vb, pen_in, rem_in,
             self._thresh, self._ids_dev,
             np.int32(k), np.int32(budget), np.int32(want_stop),
             eps=self.eps, n_c=self.n_c, n_v=self.n_v, k_max=k_max,
             group=group, has_bounds=self.has_bounds)
         self.supersteps += 1
         opstats.bump("dispatches")
-        if not fetch:
-            return None, None
-        p = np.asarray(packed)
+        if speculative:
+            self.spec_issued += 1
+            opstats.bump("speculations_issued")
+        return SuperstepToken(pen_in, rem_in, pen_out, rem_out, packed,
+                              k, k_max, want_stop, speculative)
+
+    def _discard_token(self, tok: SuperstepToken) -> None:
+        """Drop an un-collected speculative superstep: processing the
+        preceding ring mutated the system, so the dispatch's inputs are
+        wrong.  Issue never committed anything, so discarding is O(1) —
+        only the device work is wasted (and counted)."""
+        self.spec_rolled_back += 1
+        opstats.bump("speculations_rolled_back")
+
+    def _superstep_collect(self, tok: SuperstepToken
+                           ) -> Tuple[int, List[Tuple[float, List[int]]],
+                                      bool]:
+        """Commit one issued superstep: make its output arrays the
+        committed flow state, fetch its packed ring (the ONLY blocking
+        transfer) and replay the events into the host clock/stream.
+
+        Returns ``(n_live, batches, clean)`` — `clean` is the
+        speculation-validation verdict: True iff processing this ring
+        left the system exactly as an in-flight next superstep assumed
+        it (no repack, no stop-trigger decay, flow set still live, the
+        dispatch exited _FLAG_OK), so a speculative successor may
+        commit; on False the caller must discard in-flight tokens."""
+        self._pen, self._rem = tok.pen_out, tok.rem_out
+        k_max = tok.k_max
+        p = opstats.timed_fetch(tok.packed)
         self.syncs += 1
         rounds, adv, n_ev = int(p[0]), int(p[1]), int(p[2])
         t_sum = float(p[3])
@@ -725,18 +827,104 @@ class DrainSim:
         if flag == _FLAG_BUDGET and adv == 0 and rounds >= _MAX_ROUNDS:
             raise RuntimeError("drain solve did not converge")
         repacked = False
+        decayed = False
         if self._should_repack(n_live):
             repacked = self._repack_device(n_live, live_elems)
-        if not repacked and want_stop and n_live <= want_stop:
+        if not repacked and tok.want_stop and n_live <= tok.want_stop:
             # the stop-for-repack threshold fired but no repack was
             # possible (small live set / dense elements): decay the
             # trigger so the next superstep doesn't exit immediately
             self._live0 = max(n_live, 1)
+            decayed = True
         self._last_flag = flag
+        if tok.speculative:
+            self.spec_committed += 1
+            opstats.bump("speculations_committed")
+        clean = (flag == _FLAG_OK and n_live > 0
+                 and not repacked and not decayed)
+        if self.on_batches is not None and batches:
+            self.on_batches(batches)
+        return n_live, batches, clean
+
+    def superstep_batch(self, k: Optional[int] = None,
+                        fetch: bool = True, stop_live: int = 0):
+        """Dispatch ONE superstep of up to `k` advances and (optionally)
+        fetch its packed result — a single transfer.
+
+        Returns (n_live, batches) where batches is a list of
+        (dt, [original flow ids]) per executed advance; with
+        fetch=False nothing is transferred (replay) and (None, None) is
+        returned.  Events/clock/counters are committed on fetch."""
+        tok = self._superstep_issue(k, stop_live=stop_live)
+        if not fetch:
+            self._pen, self._rem = tok.pen_out, tok.rem_out
+            return None, None
+        n_live, batches, _clean = self._superstep_collect(tok)
         return n_live, batches
+
+    def _run_pipelined(self, max_advances: int) -> None:
+        """The speculative superstep driver: keep up to
+        ``self.pipeline`` supersteps in flight beyond the one being
+        collected, each chained from its predecessor's (immutable,
+        double-buffered) output arrays.  Collect order is strictly
+        FIFO, so event order, timestamps and clocks are the committed
+        prefix of exactly the computation the unpipelined driver runs;
+        any unclean collect (repack/decay/rescue/stall/done) discards
+        the speculative tail and re-issues from the committed state."""
+        budget = max_advances
+        inflight: deque = deque()
+        issued_k = 0            # advances the in-flight tokens may eat
+        n = self.n_v
+        try:
+            while n and budget > 0:
+                # fill the pipeline: the head issue mirrors the
+                # unpipelined k=min(K, remaining); speculative issues
+                # only when a FULL K is guaranteed to still be within
+                # the advance budget whatever the in-flight tokens
+                # consume — otherwise their k would depend on counts
+                # the host has not fetched yet
+                while (not inflight
+                       or (len(inflight) <= self.pipeline
+                           and budget - issued_k >= self.superstep_k)):
+                    spec = bool(inflight)
+                    k = (self.superstep_k if spec
+                         else min(self.superstep_k, budget))
+                    pen, rem = ((inflight[-1].pen_out,
+                                 inflight[-1].rem_out)
+                                if inflight else (None, None))
+                    inflight.append(self._superstep_issue(
+                        k, pen=pen, rem=rem, speculative=spec))
+                    issued_k += k
+                tok = inflight.popleft()
+                issued_k -= tok.k
+                before = self.advances
+                n, _batches, clean = self._superstep_collect(tok)
+                budget -= self.advances - before
+                if not clean:
+                    # speculation mispredicted: processing this ring
+                    # mutated the system (repack/decay) or the batch
+                    # needs a host-side continuation (rescue/stall) —
+                    # discard the in-flight tail and restart from the
+                    # committed state
+                    while inflight:
+                        self._discard_token(inflight.popleft())
+                    issued_k = 0
+                    if n and self.advances == before:
+                        # the round budget expired inside the first
+                        # solve: finish ONE advance via the chunked
+                        # fused path (which converges across
+                        # dispatches), then resume
+                        n = self._advance_fused()
+                        budget -= 1
+        finally:
+            while inflight:
+                self._discard_token(inflight.popleft())
 
     def run(self, max_advances: int = 10_000_000) -> None:
         n = self.n_v
+        if self.superstep_k and self.pipeline:
+            self._run_pipelined(max_advances)
+            return
         if self.superstep_k:
             while n and max_advances > 0:
                 before = self.advances

@@ -25,10 +25,34 @@ context object through the solver entry points:
                               selected: the warm carry is COO-only, so
                               the solver falls back to cold and counts
                               the gap here instead of hiding it
+* ``fetches``               — device->host result transfers routed
+                              through :func:`timed_fetch` (drain ring
+                              fetches, batched fleet fetches)
+* ``blocking_fetches``      — the subset of ``fetches`` whose device
+                              computation had NOT finished when the
+                              host asked (``Array.is_ready()`` false):
+                              the host genuinely stalled on the tunnel
+                              round trip instead of overlapping it
+* ``host_block_ms``         — monotonic host milliseconds spent inside
+                              fetches (``time.perf_counter`` deltas —
+                              wall time the host driver was blocked on
+                              device results; the overlap fraction of
+                              the pipelined drain is
+                              1 - host_block_ms/phase wall)
+* ``speculations_issued`` / ``speculations_committed`` /
+  ``speculations_rolled_back`` — speculative supersteps dispatched
+                              in-flight by the pipelined drain
+                              executors, how many were committed
+                              as-is, and how many were discarded
+                              because processing the PRECEDING
+                              completion ring mutated the system
 
 Counters only ever increase; consumers snapshot before a phase and
 diff after (``snapshot``/``diff``), or wrap the phase in ``scoped``.
 Purely observational — nothing in the solve paths reads them back.
+(``host_block_ms`` uses the monotonic ``time.perf_counter`` — never
+the banned wall-clock ``time.time`` — so the determinism lint stays
+clean and the timing is immune to clock steps.)
 
 Per-stage scoping
 -----------------
@@ -49,7 +73,10 @@ can no longer double-count the previous stage's work::
 from __future__ import annotations
 
 import contextlib
+import time
 from typing import Dict, Iterator
+
+import numpy as np
 
 _counters: Dict[str, float] = {}
 
@@ -59,6 +86,25 @@ stage_stats: Dict[str, Dict[str, float]] = {}
 
 def bump(name: str, n=1) -> None:
     _counters[name] = _counters.get(name, 0) + n
+
+
+def timed_fetch(arr) -> "np.ndarray":
+    """Fetch one device array to host with blocking accounting: counts
+    the transfer in ``fetches``, classifies it as a ``blocking_fetch``
+    when the device had not finished computing it at call time
+    (``is_ready()`` false — the host is about to stall on the round
+    trip), and adds the monotonic milliseconds spent inside the fetch
+    to ``host_block_ms``.  The pipelined drain's whole point is turning
+    blocking fetches into ready ones; this is where that is measured.
+    """
+    ready = bool(getattr(arr, "is_ready", lambda: False)())
+    t0 = time.perf_counter()
+    out = np.asarray(arr)
+    bump("host_block_ms", (time.perf_counter() - t0) * 1e3)
+    bump("fetches")
+    if not ready:
+        bump("blocking_fetches")
+    return out
 
 
 def snapshot() -> Dict[str, float]:

@@ -37,7 +37,7 @@ import numpy as np
 from ..faults import FaultCampaign
 from ..ops import opstats
 from ..ops.lmm_batch import (BatchDrainSim, ReplicaOverrides,
-                             derive_replica_arrays)
+                             derive_replica_arrays, derive_replica_ew)
 
 #: a fully-failed link would zero its capacity and stall every flow
 #: routed over it; campaigns clamp availability-derived factors here
@@ -56,14 +56,16 @@ class ScenarioSpec:
     """
 
     __slots__ = ("seed", "bw_scale", "size_scale", "link_scale",
-                 "flow_scale", "dead_flows", "fault_mtbf", "fault_mttr",
-                 "fault_dist", "fault_shape", "fault_horizon", "label")
+                 "flow_scale", "dead_flows", "elem_w", "fault_mtbf",
+                 "fault_mttr", "fault_dist", "fault_shape",
+                 "fault_horizon", "label")
 
     def __init__(self, seed: int = 0, bw_scale: float = 1.0,
                  size_scale: float = 1.0,
                  link_scale: Optional[Dict[int, float]] = None,
                  flow_scale: Optional[Dict[int, float]] = None,
                  dead_flows: Iterable[int] = (),
+                 elem_w: Optional[Dict[int, float]] = None,
                  fault_mtbf: Optional[float] = None,
                  fault_mttr: float = 60.0,
                  fault_dist: str = "exponential",
@@ -76,6 +78,7 @@ class ScenarioSpec:
         self.link_scale = dict(link_scale or {})
         self.flow_scale = dict(flow_scale or {})
         self.dead_flows = tuple(dead_flows)
+        self.elem_w = dict(elem_w or {})
         self.fault_mtbf = fault_mtbf
         self.fault_mttr = float(fault_mttr)
         self.fault_dist = fault_dist
@@ -107,7 +110,7 @@ class Campaign:
                  link_names: Optional[List[Optional[str]]] = None,
                  eps: float = 1e-9, done_eps: float = 1e-4,
                  dtype=np.float64, done_mode: str = "rel",
-                 superstep: int = 8):
+                 superstep: int = 8, pipeline: int = 0):
         self.e_var = np.asarray(e_var, np.int32)
         self.e_cnst = np.asarray(e_cnst, np.int32)
         self.e_w = np.asarray(e_w, np.float64)
@@ -126,6 +129,7 @@ class Campaign:
         self.dtype = np.dtype(dtype)
         self.done_mode = done_mode
         self.superstep = int(superstep)
+        self.pipeline = int(pipeline)
         #: constraint slots that actually carry elements — fault
         #: schedules are drawn for these only (padding slots have no
         #: flows and scaling them is pure noise in the RNG stream)
@@ -190,16 +194,21 @@ class Campaign:
                                 size_scale=spec.size_scale,
                                 link_scale=link_scale,
                                 flow_scale=spec.flow_scale,
-                                dead_flows=spec.dead_flows)
+                                dead_flows=spec.dead_flows,
+                                elem_w=spec.elem_w)
 
     # -- execution ---------------------------------------------------------
 
-    def run_batched(self, batch: int = 64,
-                    superstep_rounds: int = 0) -> List[ReplicaResult]:
+    def run_batched(self, batch: int = 64, superstep_rounds: int = 0,
+                    pipeline: Optional[int] = None
+                    ) -> List[ReplicaResult]:
         """Drain the whole fleet in chunks of ``batch`` replicas, each
         chunk one BatchDrainSim (one shared upload, lockstep
         supersteps).  Results come back in spec order; chunking is
-        invisible to results — lanes are independent."""
+        invisible to results — lanes are independent.  ``pipeline``
+        overrides the campaign's speculative-superstep depth for this
+        run (bit-identical results either way)."""
+        depth = self.pipeline if pipeline is None else int(pipeline)
         results: List[ReplicaResult] = []
         for start in range(0, len(self.specs), max(1, int(batch))):
             chunk_specs = self.specs[start:start + max(1, int(batch))]
@@ -211,7 +220,7 @@ class Campaign:
                 done_mode=self.done_mode, superstep=self.superstep,
                 superstep_rounds=superstep_rounds,
                 v_bound=self.v_bound, penalty=self.penalty,
-                remains=self.remains)
+                remains=self.remains, pipeline=depth)
             sim.run()
             for b, spec in enumerate(chunk_specs):
                 rep = sim.replicas[b]
@@ -236,8 +245,8 @@ class Campaign:
                     else np.ones(len(self.sizes)))
         cb, sz, rem, pen = derive_replica_arrays(
             self.c_bound, self.sizes, base_rem, base_pen, ov)
-        sim = DrainSim(self.e_var, self.e_cnst,
-                       self.e_w.astype(self.dtype),
+        ew = derive_replica_ew(self.e_w, ov, self.dtype)
+        sim = DrainSim(self.e_var, self.e_cnst, ew,
                        cb.astype(self.dtype), sz, eps=self.eps,
                        done_eps=self.done_eps, dtype=self.dtype,
                        done_mode=self.done_mode,
@@ -254,12 +263,13 @@ class Campaign:
         return ReplicaResult(spec, sim.events, sim.t, sim.advances,
                              error)
 
-    def run_scoped(self, batch: int, stage: str
+    def run_scoped(self, batch: int, stage: str,
+                   pipeline: Optional[int] = None
                    ) -> Tuple[List[ReplicaResult], Dict[str, float]]:
         """run_batched under an opstats stage scope: returns (results,
         this run's counter deltas) — the campaign's own dispatches and
         upload bytes, unpolluted by whatever ran before in the
         process."""
         with opstats.scoped(stage) as stats:
-            results = self.run_batched(batch=batch)
+            results = self.run_batched(batch=batch, pipeline=pipeline)
         return results, stats

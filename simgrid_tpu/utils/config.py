@@ -317,6 +317,16 @@ declare_flag("drain/min-flows",
              "Minimum started network flows before the drain fast "
              "path engages (below it the generic per-advance path is "
              "cheaper than plan bookkeeping)", 4096)
+declare_flag("drain/pipeline",
+             "Speculative supersteps kept in flight by the pipelined "
+             "drain executors (the depth D of DrainSim/BatchDrainSim "
+             "pipelining; the engine fast path keeps one token in "
+             "flight whenever D > 0): while the host processes "
+             "completion ring N, superstep N+1 already executes on "
+             "the device, hiding the dispatch round trip.  Results "
+             "are bit-identical to 0 (synchronous) — a mispredicted "
+             "speculation is discarded and replayed from the "
+             "committed state", 1)
 declare_flag("drain/done-eps",
              "Relative completion threshold of the f32 drain "
              "executor: a flow retires when its remainder falls to "

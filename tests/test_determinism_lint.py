@@ -47,6 +47,19 @@ def test_batch_runtime_determinism():
     assert problems == []
 
 
+def test_pipeline_runtime_determinism():
+    """Dynamic coverage of the speculative pipelined drain (ISSUE 5
+    tooling, the `--quick` small-N instance): pipelined solo and fleet
+    drains — including forced repack/budget mispredicts that must
+    discard in-flight supersteps — are bit-identical to the
+    unpipelined superstep path.  The full-size check runs via
+    `check_determinism.py --runtime-pipeline`."""
+    checker = _load_checker()
+    problems = checker.check_pipeline_runtime(n_c=32, n_v=128, k=4,
+                                              depths=(1,), batch=4)
+    assert problems == []
+
+
 def test_checker_flags_violations(tmp_path):
     """The lint itself works: a planted file with each banned pattern is
     reported (guards against the lint silently matching nothing)."""

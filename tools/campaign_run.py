@@ -118,6 +118,10 @@ def main() -> int:
     ap.add_argument("--replicas", type=int, default=16)
     ap.add_argument("--batch", type=int, default=16)
     ap.add_argument("--superstep", type=int, default=8)
+    ap.add_argument("--pipeline", type=int, default=0,
+                    help="speculative fleet supersteps kept in "
+                         "flight (bit-identical results; see "
+                         "ops.lmm_drain)")
     ap.add_argument("--faults", type=float, default=0.5,
                     help="fraction of replicas with a fault dimension "
                     "(seeded MTBF/MTTR link degradation)")
@@ -148,7 +152,8 @@ def main() -> int:
                           fault_mttr=args.mttr,
                           fault_horizon=args.horizon)
              for s in range(args.replicas)]
-    campaign = Campaign(specs=specs, superstep=args.superstep, **base)
+    campaign = Campaign(specs=specs, superstep=args.superstep,
+                        pipeline=args.pipeline, **base)
 
     t0 = time.perf_counter()
     results, stats = campaign.run_scoped(batch=args.batch,
@@ -156,7 +161,8 @@ def main() -> int:
     wall = time.perf_counter() - t0
 
     row = dict(meta, replicas=args.replicas, batch=args.batch,
-               superstep=args.superstep, fault_replicas=n_fault,
+               superstep=args.superstep, pipeline=args.pipeline,
+               fault_replicas=n_fault,
                wall_ms=round(wall * 1e3, 1),
                dispatches=int(stats.get("dispatches", 0)),
                dispatches_per_replica=round(

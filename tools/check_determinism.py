@@ -36,9 +36,19 @@ asserts that sampled replicas extracted from the batch have
 bit-identical event order AND clocks to the same scenario run solo
 through ops.lmm_drain.DrainSim — the batching determinism contract.
 
+``--runtime-pipeline`` runs the speculative pipelined drain (solo
+DrainSim at depths 1 and 2, and a batched fleet through
+parallel.campaign) against the unpipelined superstep path and asserts
+bit-identical event order, timestamps and final clocks — INCLUDING
+forced-mispredict runs (mid-drain device repacks and
+round-budget-starved rescue exits, both of which must discard the
+in-flight speculative superstep and replay it), where it additionally
+asserts that speculation really was rolled back (otherwise nothing
+was tested).
+
 ``--quick`` is the CI mode: the static lint plus small-N instances of
-every runtime check (drain, warm-start, batch), sized to finish in
-seconds so the tier-1 suite can run it on every test pass
+every runtime check (drain, warm-start, batch, pipeline), sized to
+finish in seconds so the tier-1 suite can run it on every test pass
 (tests/test_determinism_lint.py).
 """
 
@@ -283,6 +293,94 @@ def check_batch_runtime(seed: int = 23, n_c: int = 64, n_v: int = 256,
     return problems
 
 
+def check_pipeline_runtime(seed: int = 29, n_c: int = 64, n_v: int = 400,
+                           k: int = 8, depths=(1, 2), batch: int = 8
+                           ) -> List[str]:
+    """Dynamic determinism of the speculative pipelined drain: the
+    pipelined executors must be bit-identical — event order,
+    timestamps, final clock, advance count — to the unpipelined
+    superstep path, for the solo DrainSim (at every depth in `depths`,
+    plus forced-mispredict runs: mid-drain repacks and a starved round
+    budget, both of which discard in-flight supersteps) and for a
+    `batch`-wide campaign fleet.  Also asserts that speculation
+    actually happened (commits > 0) and that the forced-mispredict
+    runs really rolled speculation back."""
+    import numpy as np
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import build_arrays
+    from simgrid_tpu.ops.lmm_drain import DrainSim
+    from simgrid_tpu.parallel.campaign import Campaign, ScenarioSpec
+
+    rng = np.random.default_rng(seed)
+    arrays = build_arrays(rng, n_c, n_v, 3, np.float64)
+    sizes = rng.choice(np.linspace(1e5, 2e6, 16), n_v)
+    E = arrays.n_elem
+
+    def run(**kw):
+        sim = DrainSim(arrays.e_var[:E], arrays.e_cnst[:E],
+                       arrays.e_w[:E].astype(np.float64),
+                       arrays.c_bound[:arrays.n_cnst].astype(np.float64),
+                       sizes, eps=1e-9, dtype=np.float64, **kw)
+        sim.run()
+        return sim
+
+    problems: List[str] = []
+    # -- solo: plain + forced-mispredict variants -----------------------
+    variants = {
+        "plain": dict(repack_min=1 << 62),
+        # small repack_min: mid-drain device repacks fire, each one a
+        # forced mispredict (the in-flight superstep ran on the
+        # un-repacked arrays and must be discarded + replayed)
+        "repack": dict(repack_min=32),
+        # starved round budget: _FLAG_BUDGET exits + fused rescues,
+        # the other mispredict class
+        "budget": dict(repack_min=1 << 62, superstep_rounds=3),
+    }
+    for label, kw in variants.items():
+        ref = run(superstep=k, pipeline=0, **kw)
+        for depth in depths:
+            a = run(superstep=k, pipeline=depth, **kw)
+            b = run(superstep=k, pipeline=depth, **kw)
+            if (a.events, a.t, a.advances) != (b.events, b.t, b.advances):
+                problems.append(f"pipeline:{label}:d{depth}: two "
+                                f"identical runs diverged")
+            if a.events != ref.events or a.t != ref.t \
+                    or a.advances != ref.advances:
+                problems.append(
+                    f"pipeline:{label}:d{depth}: diverged from the "
+                    f"unpipelined superstep drain ({len(a.events)} vs "
+                    f"{len(ref.events)} events, clocks {a.t!r} vs "
+                    f"{ref.t!r})")
+            if a.spec_committed == 0:
+                problems.append(f"pipeline:{label}:d{depth}: no "
+                                f"speculation committed (nothing "
+                                f"was actually tested)")
+            if label in ("repack", "budget") and a.spec_rolled_back == 0:
+                problems.append(
+                    f"pipeline:{label}:d{depth}: the forced mispredict "
+                    f"never rolled speculation back (forcing failed)")
+    # -- fleet: pipelined batched campaign vs unpipelined ---------------
+    specs = [ScenarioSpec(seed=s, bw_scale=1.0 + 0.15 * (s % 4),
+                          size_scale=1.0 + 0.05 * (s % 3),
+                          dead_flows=(s % 5,) if s % 3 == 0 else ())
+             for s in range(batch)]
+    camp = Campaign(arrays.e_var[:E], arrays.e_cnst[:E],
+                    arrays.e_w[:E], arrays.c_bound[:n_c], sizes,
+                    specs, eps=1e-9, dtype=np.float64, superstep=k)
+    ref_fleet = camp.run_batched(batch=batch, pipeline=0)
+    for depth in depths:
+        got = camp.run_batched(batch=batch, pipeline=depth)
+        for j in range(batch):
+            if got[j].events != ref_fleet[j].events \
+                    or got[j].t != ref_fleet[j].t:
+                problems.append(
+                    f"pipeline:fleet:d{depth}: replica {j} diverged "
+                    f"from the unpipelined fleet drain")
+                break
+    return problems
+
+
 def quick_checks() -> List[str]:
     """The CI bundle: static lint + small-N instances of every runtime
     check, sized for seconds, so determinism regressions fail pytest
@@ -293,6 +391,8 @@ def quick_checks() -> List[str]:
     problems += check_drain_runtime(n_c=32, n_v=128, k=4)
     problems += check_batch_runtime(n_c=32, n_v=96, batch=6,
                                     solo_check=(0, 3, 5))
+    problems += check_pipeline_runtime(n_c=32, n_v=128, k=4,
+                                       depths=(1,), batch=4)
     return problems
 
 
@@ -305,8 +405,21 @@ def main(argv: List[str]) -> int:
                 print(f"  {p}")
             return 1
         print("check_determinism: quick OK (lint + small-N drain + "
-              "batch runtime)")
+              "batch + pipeline runtime)")
         return 0
+    if "--runtime-pipeline" in argv:
+        problems = check_pipeline_runtime()
+        if problems:
+            print("check_determinism: pipeline runtime check FAILED:")
+            for p in problems:
+                print(f"  {p}")
+            return 1
+        print("check_determinism: pipeline runtime OK (speculative "
+              "pipelined drain — solo depths 1/2 incl. forced "
+              "repack/budget mispredicts, and a batched fleet — "
+              "bit-identical to the unpipelined superstep path: "
+              "event order, timestamps and clocks)")
+        argv = [a for a in argv if a != "--runtime-pipeline"]
     if "--runtime-batch" in argv:
         problems = check_batch_runtime()
         if problems:

@@ -22,7 +22,10 @@ Usage:
 `--fused` runs the jax drain with the single-dispatch solve+advance
 kernel (1 sync/advance); `--superstep K` batches K advances per
 dispatch with the device completion ring (~1/K syncs/advance) and
-on-device repacks.  `--phase-stats` prints, per phase (build/route,
+on-device repacks; `--pipeline D` additionally keeps D speculative
+supersteps in flight (double-buffered rings: the host processes ring
+N while the device runs ring N+1 — bit-identical results, and the
+row carries the blocking-fetch split + speculation commit counters).  `--phase-stats` prints, per phase (build/route,
 latency advance, drain), the device dispatch count, uploaded bytes
 split full vs delta (ops.opstats counters fed by _device_args, the
 warm solver and the drain executor) and fixpoint rounds, and appends
@@ -158,12 +161,13 @@ def drain_native(arrays, slot_flow, size, done_eps=1e-4):
 
 
 def drain_jax(arrays, slot_flow, size, platform=None, done_eps=1e-4,
-              fused=False, superstep=0):
+              fused=False, superstep=0, pipeline=0):
     import numpy as np
     if platform:
         import jax
         jax.config.update("jax_platforms", platform)
     import jax
+    from simgrid_tpu.ops import opstats
     from simgrid_tpu.ops.lmm_drain import DrainSim
 
     dev = jax.devices()[0]
@@ -174,13 +178,31 @@ def drain_jax(arrays, slot_flow, size, platform=None, done_eps=1e-4,
                    arrays.c_bound[:arrays.n_cnst].astype(dtype),
                    np.full(arrays.n_var, float(size)),
                    eps=1e-5, done_eps=done_eps, dtype=dtype,
-                   fused=fused, superstep=superstep)
+                   fused=fused, superstep=superstep,
+                   pipeline=pipeline)
     # warm the jits on the first advance before timing?  No: honest
     # end-to-end wall-clock includes compiles once per shape; report
     # both (first advance separately).
+    fetch_mark = opstats.snapshot()
     t0 = time.perf_counter()
     n = sim.n_v
-    if superstep:
+    if superstep and pipeline:
+        # the pipelined driver owns the loop (speculative in-flight
+        # supersteps; progress reported per collected ring)
+        last = [time.perf_counter()]
+
+        def report(batches):
+            if time.perf_counter() - last[0] >= 10.0:
+                last[0] = time.perf_counter()
+                print(f"[drain] superstep {sim.supersteps}: "
+                      f"advances {sim.advances}, t_sim {sim.t:.4f}, "
+                      f"spec {sim.spec_committed}/{sim.spec_issued}, "
+                      f"wall {time.perf_counter()-t0:.0f}s",
+                      flush=True)
+        sim.on_batches = report
+        sim.run()
+        n = 0
+    elif superstep:
         while n:
             before = sim.advances
             n, _ = sim.superstep_batch()
@@ -198,16 +220,29 @@ def drain_jax(arrays, slot_flow, size, platform=None, done_eps=1e-4,
                       f"t_sim {sim.t:.4f}, "
                       f"wall {time.perf_counter()-t0:.0f}s", flush=True)
     wall = time.perf_counter() - t0
+    fetch_stats = opstats.diff(fetch_mark)
     events = [(t, int(slot_flow[fid])) for t, fid in sim.events]
-    mode = ("superstep" if superstep else
+    mode = ("pipeline" if superstep and pipeline else
+            "superstep" if superstep else
             "fused" if fused else "unfused")
-    return events, dict(advances=sim.advances, wall_s=round(wall, 1),
-                        t_sim=sim.t, rounds=sim.rounds, syncs=sim.syncs,
-                        repacks=sim.repacks, jax_platform=dev.platform,
-                        mode=mode, superstep_k=superstep,
-                        supersteps=sim.supersteps,
-                        syncs_per_advance=round(
-                            sim.syncs / max(sim.advances, 1), 4))
+    rec = dict(advances=sim.advances, wall_s=round(wall, 1),
+               t_sim=sim.t, rounds=sim.rounds, syncs=sim.syncs,
+               repacks=sim.repacks, jax_platform=dev.platform,
+               mode=mode, superstep_k=superstep,
+               supersteps=sim.supersteps,
+               syncs_per_advance=round(
+                   sim.syncs / max(sim.advances, 1), 4))
+    if pipeline:
+        rec.update(pipeline_depth=pipeline,
+                   spec_issued=sim.spec_issued,
+                   spec_committed=sim.spec_committed,
+                   spec_rolled_back=sim.spec_rolled_back,
+                   fetches=int(fetch_stats.get("fetches", 0)),
+                   blocking_fetches=int(
+                       fetch_stats.get("blocking_fetches", 0)),
+                   host_block_ms=round(
+                       fetch_stats.get("host_block_ms", 0), 1))
+    return events, rec
 
 
 def main() -> None:
@@ -225,6 +260,10 @@ def main() -> None:
     ap.add_argument("--superstep", type=int, default=0, metavar="K",
                     help="jax: K advances per dispatch (~1/K "
                          "syncs/advance, on-device repacks)")
+    ap.add_argument("--pipeline", type=int, default=0, metavar="D",
+                    help="jax: keep D speculative supersteps in "
+                         "flight (requires --superstep; bit-identical "
+                         "results, blocking-fetch split on the row)")
     ap.add_argument("--phase-stats", action="store_true",
                     help="report per-phase dispatch count, uploaded "
                          "bytes (full vs delta) and fixpoint rounds; "
@@ -255,7 +294,8 @@ def main() -> None:
     else:
         events, stats = drain_jax(arrays, slot_flow, args.size,
                                   args.platform, fused=args.fused,
-                                  superstep=args.superstep)
+                                  superstep=args.superstep,
+                                  pipeline=args.pipeline)
     rec.update(stats)
     rec["n_events"] = len(events)
     if args.phase_stats:
