@@ -45,7 +45,8 @@ def log(*a):
 # across machines) without hand-editing: filter on (stage, mode, batch,
 # platform), order by git_rev history.
 
-SCHEMA_VERSION = 1
+# v2: +mesh_shape/+device_count on every row (topology identity)
+SCHEMA_VERSION = 2
 _GIT_REV = None
 
 
@@ -64,11 +65,24 @@ def git_rev() -> str:
 
 
 def schema_row(stage: str, payload: dict, mode=None, batch=None,
-               platform: str = "cpu") -> dict:
-    """One mergeable result row: identity keys first, payload after."""
+               platform: str = "cpu", mesh_shape=None) -> dict:
+    """One mergeable result row: identity keys first, payload after.
+
+    ``mesh_shape`` (e.g. ``[4]`` for a 4-way batch-axis mesh, None for
+    single-device runs) and ``device_count`` (visible JAX devices in
+    the measuring process, None when the stage never touched JAX)
+    identify the topology, so sharded and unsharded rows in the same
+    JSONL file cannot be confused."""
+    device_count = None
+    if "jax" in sys.modules:
+        try:
+            device_count = sys.modules["jax"].device_count()
+        except Exception:
+            device_count = None
     row = {"schema": SCHEMA_VERSION, "git_rev": git_rev(),
            "stage": stage, "mode": mode, "batch": batch,
-           "platform": platform}
+           "platform": platform, "mesh_shape": mesh_shape,
+           "device_count": device_count}
     for k, v in payload.items():
         if k not in row:
             row[k] = v
@@ -404,6 +418,124 @@ def stage_sweep(n_c: int, n_v: int, deg: int, seed: int,
     return out
 
 
+def stage_shard(n_c: int, n_v: int, deg: int, seed: int,
+                per_shard: int = 16, superstep: int = 8,
+                max_mesh: int = 4) -> dict:
+    """Mesh-sharded campaign fleets (the ISSUE-6 trajectory metric):
+    the replica axis of the batched drain sharded over a virtual CPU
+    device mesh at FIXED per-device batch — the pod-scale contract is
+    that per-replica dispatches and upload bytes stay flat (or fall)
+    as the mesh doubles, because one fleet superstep is still one
+    logical dispatch and every payload byte lands on exactly one
+    device.  Mesh sizes {1, 2, ..., max_mesh} (powers of two), fleet
+    B = per_shard * M; mesh 1 is the single-device vmapped baseline.
+
+    Honest counters per row: dispatches, logical upload bytes
+    (full+delta), the replicated-per-device vs sharded split,
+    per-shard demux fetches and fetched bytes — all per replica where
+    it matters.  Every row carries mesh_shape/device_count; the first
+    per_shard replicas exist in every fleet and their event streams
+    must be bit-identical across mesh sizes.
+
+    CPU-measured by design (forced host-platform device count): the
+    contract is counter SCALING, which is platform-independent; the
+    wall-clock story belongs to real multi-chip hardware."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count"
+            f"={max_mesh}").strip()
+    _force_cpu()
+    import jax
+    from simgrid_tpu.parallel.campaign import Campaign, ScenarioSpec
+
+    mesh_sizes = [1]
+    while mesh_sizes[-1] * 2 <= min(max_mesh, jax.device_count()):
+        mesh_sizes.append(mesh_sizes[-1] * 2)
+    rng = np.random.default_rng(seed)
+    arrays = build_arrays(rng, n_c, n_v, deg, np.float64)
+    E = arrays.n_elem
+    sizes = rng.choice(np.linspace(1e5, 2e6, 16), n_v)
+    B_max = per_shard * mesh_sizes[-1]
+    specs = [ScenarioSpec(seed=s,
+                          bw_scale=1.0 + 0.1 * (s % 5),
+                          size_scale=1.0 + 0.05 * (s % 3),
+                          fault_mtbf=400.0 if s % 2 else None,
+                          fault_mttr=50.0, fault_horizon=600.0,
+                          dead_flows=(s % 11,) if s % 3 == 0 else ())
+             for s in range(B_max)]
+
+    rows = []
+    streams = {}
+    for M in mesh_sizes:
+        B = per_shard * M
+        campaign = Campaign(arrays.e_var[:E], arrays.e_cnst[:E],
+                            arrays.e_w[:E], arrays.c_bound[:n_c],
+                            sizes, specs[:B], eps=1e-9,
+                            dtype=np.float64, superstep=superstep)
+        t0 = time.perf_counter()
+        results, st = campaign.run_scoped(
+            batch=B, stage=f"shard/m{M}",
+            mesh=(M if M > 1 else None))
+        wall = time.perf_counter() - t0
+        # the replicas shared by every fleet size must agree bit-for-bit
+        streams[M] = [[(t, f) for t, f in r.events]
+                      for r in results[:per_shard]]
+        upload = (st.get("uploaded_bytes_full", 0)
+                  + st.get("uploaded_bytes_delta", 0))
+        row = {"bench": "lmm_shard", "replicas": B,
+               "per_shard": per_shard, "mesh": M,
+               "n_c": n_c, "n_v": n_v, "deg": deg, "seed": seed,
+               "superstep": superstep,
+               "dispatches": int(st.get("dispatches", 0)),
+               "dispatches_per_replica":
+                   round(st.get("dispatches", 0) / B, 3),
+               "upload_bytes": int(upload),
+               "upload_bytes_per_replica": round(upload / B, 1),
+               "replicated_upload_bytes":
+                   int(st.get("replicated_upload_bytes", 0)),
+               "sharded_upload_bytes":
+                   int(st.get("sharded_upload_bytes", 0)),
+               "fetches": int(st.get("fetches", 0)),
+               "demux_fetches": int(st.get("demux_fetches", 0)),
+               "fetched_bytes": int(st.get("fetched_bytes", 0)),
+               "fetched_bytes_per_replica":
+                   round(st.get("fetched_bytes", 0) / B, 1),
+               "fixpoint_rounds": int(st.get("fixpoint_rounds", 0)),
+               "wall_ms": round(wall * 1e3, 1),
+               "errors": sum(1 for r in results if r.error)}
+        rows.append(schema_row("shard", row, mode="sharded-drain",
+                               batch=B, platform="cpu",
+                               mesh_shape=[M]))
+        log(f"[stage shard] mesh={M} B={B}: "
+            f"{row['dispatches_per_replica']} dispatches/replica, "
+            f"{row['upload_bytes_per_replica']} B/replica up, "
+            f"{row['fetched_bytes_per_replica']} B/replica down, "
+            f"{row['wall_ms']} ms")
+    base = streams[mesh_sizes[0]]
+    consistent = all(streams[m] == base for m in streams)
+    for row in rows:
+        row["events_consistent"] = consistent
+    path = append_rows("lmm_shard.jsonl", rows)
+    log(f"[stage shard] rows appended to {path} "
+        f"(events_consistent={consistent})")
+
+    out = {"rows": rows, "events_consistent": consistent}
+    by_mesh = {r["mesh"]: r for r in rows}
+    flat = {}
+    for a, b in zip(mesh_sizes, mesh_sizes[1:]):
+        for key in ("dispatches_per_replica", "upload_bytes_per_replica",
+                    "fetched_bytes_per_replica"):
+            prev = by_mesh[a][key]
+            ratio = by_mesh[b][key] / prev if prev else float("inf")
+            flat.setdefault(key, []).append(round(ratio, 3))
+    # flat-or-falling per-replica counters as the mesh doubles
+    out["per_replica_scaling"] = flat
+    out["per_replica_flat_or_falling"] = all(
+        r <= 1.1 for rs in flat.values() for r in rs)
+    return out
+
+
 def build_wave_arrays(n_c: int, per: int, waves: int, seed: int):
     """deg=1 drain system shaped like the north-star alltoall phase:
     `per` flows per (link, size-wave) tie group — every advance
@@ -592,6 +724,9 @@ STAGES = {
     "pipeline": lambda args: stage_pipeline(args.seed, args.superstep,
                                             args.host_work_us,
                                             replicas=args.replicas),
+    "shard": lambda args: stage_shard(args.n_c, args.n_v, args.deg,
+                                      args.seed, args.per_shard,
+                                      args.superstep, args.mesh),
 }
 
 
@@ -893,6 +1028,14 @@ if __name__ == "__main__":
     parser.add_argument("--superstep", type=int, default=8,
                         help="sweep/pipeline stages: advances per "
                         "drain dispatch")
+    parser.add_argument("--per-shard", type=int, default=16,
+                        dest="per_shard",
+                        help="shard stage: replicas per device (fleet "
+                        "B = per_shard * mesh size)")
+    parser.add_argument("--mesh", type=int, default=4,
+                        help="shard stage: largest mesh size swept "
+                        "(powers of two from 1; forces the virtual "
+                        "CPU device count)")
     parser.add_argument("--host-work-us", type=float, default=500.0,
                         dest="host_work_us",
                         help="pipeline stage: emulated per-advance "

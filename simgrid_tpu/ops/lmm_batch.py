@@ -40,6 +40,28 @@ reductions keep the solo element order, and per-replica clocks are
 accumulated on the host in f64 exactly like the solo path
 (``tools/check_determinism.py --runtime-batch`` asserts this against a
 batch of 64 mixed fault/sweep scenarios).
+
+Pod-scale sharding: ``mesh=M`` shards the REPLICA axis of the same
+vmapped programs across a device mesh with ``NamedSharding(mesh,
+PartitionSpec("batch"))`` — per-replica state ([B, ·] bounds, flow
+state, thresholds, alive mask, payloads, completion rings) is split
+into per-device blocks while the shared platform flattening (COO
+structure, base arrays) is replicated.  Compact scenario payloads are
+device_put under the batch sharding, so every payload byte lands on
+exactly ONE device and host->device traffic stays flat as B grows with
+the mesh; each superstep's completion rings come back as one fetch PER
+SHARD (``demux_fetches``) and are reassembled in replica order before
+the host demux, so the committed event stream is independent of the
+mesh shape.  The per-lane program is untouched by partitioning (no
+cross-lane math), so a sharded fleet is bit-identical to the
+single-device vmapped fleet AND to solo runs
+(``tools/check_determinism.py --runtime-shard``).  On CPU, validate
+with ``XLA_FLAGS=--xla_force_host_platform_device_count=M``.
+
+When B is not divisible by the mesh size the fleet is padded with
+DEAD lanes (neutral overrides, alive=False from birth): the vmap
+batching rule freezes them at k=0, they are excluded from the demux,
+and a runtime guard asserts they produce zero completion events.
 """
 
 from __future__ import annotations
@@ -51,12 +73,41 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import opstats
 from .lmm_jax import (_MAX_ROUNDS, _solve_kernel_chunk_batched,
                       _solve_kernel_chunk_batched_fresh)
 from .lmm_drain import (_FLAG_BUDGET, _FLAG_OK, _FLAG_STALLED, _pos_group,
                         _fused_step_program, _superstep_program, _to2d)
+
+
+#: the mesh axis name the replica dimension shards over
+BATCH_AXIS = "batch"
+
+
+def _as_mesh(mesh) -> Optional[Mesh]:
+    """Normalize the ``mesh`` argument: None stays None (single-device
+    vmap), an int M builds a 1-D ("batch",) mesh over the first M
+    devices, a jax Mesh is used as-is (it must carry a "batch" axis)."""
+    if mesh is None:
+        return None
+    if isinstance(mesh, Mesh):
+        if BATCH_AXIS not in mesh.axis_names:
+            raise ValueError(
+                f"replica-sharded fleets need a {BATCH_AXIS!r} mesh "
+                f"axis (got {mesh.axis_names})")
+        return mesh
+    n = int(mesh)
+    if n <= 0:
+        raise ValueError("mesh must be a positive device count")
+    devices = jax.devices()
+    if n > len(devices):
+        raise ValueError(
+            f"mesh={n} but only {len(devices)} device(s) visible "
+            f"(on CPU set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={n})")
+    return Mesh(np.asarray(devices[:n]), axis_names=(BATCH_AXIS,))
 
 
 # ---------------------------------------------------------------------------
@@ -305,7 +356,7 @@ def _batch_fused_cont(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
 def solve_arrays_batch(e_var, e_cnst, e_w, c_bound, c_fatpipe,
                        v_penalty, v_bound, eps: float,
                        parallel_rounds: bool = True,
-                       chunk: int = 4096, device=None):
+                       chunk: int = 4096, device=None, mesh=None):
     """Solve B independent max-min systems sharing one COO structure in
     lockstep chunks; returns (values [B,V], remaining [B,C],
     usage [B,C], rounds [B]).
@@ -314,7 +365,15 @@ def solve_arrays_batch(e_var, e_cnst, e_w, c_bound, c_fatpipe,
     ``v_penalty``, ``v_bound`` are [B,·].  Convergence is checked once
     per chunk for the WHOLE fleet in a single [B, 3+V+2C] fetch;
     converged lanes are frozen by their own loop cond, so stragglers
-    never recompute finished replicas."""
+    never recompute finished replicas.
+
+    ``mesh`` (int device count or a ("batch",) jax Mesh) shards the
+    replica axis across devices: shared structure replicated,
+    per-replica arrays split into per-device blocks.  A ragged B is
+    padded with penalty-0 lanes (they converge in zero rounds) and the
+    padding is trimmed from every output, so results are bit-identical
+    to the unsharded call."""
+    mesh = _as_mesh(mesh)
     e_w = np.asarray(e_w)
     batch_w = e_w.ndim == 2
     dtype = e_w.dtype
@@ -322,22 +381,46 @@ def solve_arrays_batch(e_var, e_cnst, e_w, c_bound, c_fatpipe,
     v_penalty = np.asarray(v_penalty, dtype)
     v_bound = np.asarray(v_bound, dtype)
     B = c_bound.shape[0]
+    n_shards = int(np.prod(list(mesh.shape.values()))) if mesh else 1
+    pad = (-B) % n_shards
+    if pad:
+        # dead padding lanes: penalty 0 everywhere, so usage0 is 0,
+        # the light set starts empty and the lane converges instantly
+        c_bound = np.concatenate([c_bound, c_bound[-1:].repeat(pad, 0)])
+        v_penalty = np.concatenate(
+            [v_penalty, np.zeros((pad,) + v_penalty.shape[1:], dtype)])
+        v_bound = np.concatenate(
+            [v_bound, np.full((pad,) + v_bound.shape[1:], -1.0, dtype)])
+        if batch_w:
+            e_w = np.concatenate([e_w, e_w[-1:].repeat(pad, 0)])
     n_c, n_v = c_bound.shape[1], v_penalty.shape[1]
     c_fatpipe = np.asarray(c_fatpipe, bool)
     has_bounds = bool(np.any((v_bound > 0) & (v_penalty > 0)))
     has_fatpipe = bool(c_fatpipe.any())
     eps_f = float(eps)
 
-    shared = [jax.device_put(np.asarray(a), device)
-              for a in (e_var, e_cnst)]
-    fat = jax.device_put(c_fatpipe, device)
-    batched = [jax.device_put(a, device)
-               for a in (e_w, c_bound, v_penalty, v_bound)]
-    opstats.bump("uploaded_bytes_full",
-                 sum(a.nbytes for a in (e_w, c_bound, v_penalty,
-                                        v_bound))
-                 + sum(np.asarray(a).nbytes for a in (e_var, e_cnst))
-                 + c_fatpipe.nbytes)
+    if mesh is not None:
+        bspec = NamedSharding(mesh, P(BATCH_AXIS))
+        rspec = NamedSharding(mesh, P())
+        put_shared = lambda a: jax.device_put(np.asarray(a), rspec)  # noqa: E731
+        put_batched = lambda a: jax.device_put(np.asarray(a), bspec)  # noqa: E731
+        opstats.bump("shards", n_shards)
+    else:
+        put_shared = put_batched = \
+            lambda a: jax.device_put(np.asarray(a), device)  # noqa: E731
+    shared = [put_shared(a) for a in (e_var, e_cnst)]
+    fat = put_shared(c_fatpipe)
+    batched = [put_batched(e_w) if batch_w else put_shared(e_w)]
+    batched += [put_batched(a) for a in (c_bound, v_penalty, v_bound)]
+    shared_bytes = (sum(np.asarray(a).nbytes for a in (e_var, e_cnst))
+                    + c_fatpipe.nbytes
+                    + (0 if batch_w else e_w.nbytes))
+    batched_bytes = (sum(a.nbytes for a in (c_bound, v_penalty, v_bound))
+                     + (e_w.nbytes if batch_w else 0))
+    opstats.bump("uploaded_bytes_full", shared_bytes + batched_bytes)
+    if mesh is not None:
+        opstats.bump("replicated_upload_bytes", shared_bytes * n_shards)
+        opstats.bump("sharded_upload_bytes", batched_bytes)
 
     carry = None
     prev_progress = None
@@ -390,6 +473,9 @@ def solve_arrays_batch(e_var, e_cnst, e_w, c_bound, c_fatpipe,
                 f"at eps={eps} in {np.dtype(dtype).name} precision")
         prev_progress = progress
     opstats.bump("fixpoint_rounds", int(rounds_h.sum()))
+    if pad:
+        values, remaining, usage, rounds_h = (
+            values[:B], remaining[:B], usage[:B], rounds_h[:B])
     return values, remaining, usage, rounds_h
 
 
@@ -460,6 +546,17 @@ class BatchDrainSim:
     superstep N+1.  Any alive-mask change or budget rescue while
     processing ring N discards the in-flight tokens; results are
     bit-identical to ``pipeline=0``.
+
+    ``mesh=M`` (int device count or a ("batch",) jax Mesh) shards the
+    replica axis across M devices: every [B, ·] array — payloads,
+    materialized state, alive mask, completion rings — is placed under
+    ``NamedSharding(mesh, P("batch"))`` while the shared flattening is
+    replicated.  One fleet superstep is still ONE logical dispatch and
+    one FleetToken; the ring comes back as one fetch per shard,
+    reassembled in replica order before the demux, so events and
+    clocks are bit-identical to ``mesh=None``.  When B is ragged the
+    fleet is padded with dead lanes (see module docstring); padded
+    lanes are asserted to produce zero events.
     """
 
     def __init__(self, e_var, e_cnst, e_w, c_bound, sizes,
@@ -468,7 +565,7 @@ class BatchDrainSim:
                  dtype=np.float64, done_mode: str = "rel",
                  superstep: int = 8, superstep_rounds: int = 0,
                  device=None, v_bound=None, penalty=None, remains=None,
-                 pipeline: int = 0):
+                 pipeline: int = 0, mesh=None):
         if not overrides:
             raise ValueError("BatchDrainSim needs at least one replica")
         if done_mode not in ("rel", "abs"):
@@ -479,8 +576,23 @@ class BatchDrainSim:
         self.done_mode = done_mode
         self.dtype = np.dtype(dtype)
         self.device = device
+        self._mesh = _as_mesh(mesh)
+        self.n_shards = (int(np.prod(list(self._mesh.shape.values())))
+                         if self._mesh is not None else 1)
+        if self._mesh is not None:
+            self._bspec = NamedSharding(self._mesh, P(BATCH_AXIS))
+            self._rspec = NamedSharding(self._mesh, P())
+            opstats.bump("shards", self.n_shards)
         self.B = len(overrides)
         self.overrides = list(overrides)
+        # ragged-fleet guard: pad to a multiple of the shard count with
+        # lanes that are dead from birth (neutral overrides, alive
+        # False) — the vmap batching rule freezes them at k=0 and the
+        # collect asserts they never log an event
+        self.B_padded = self.B + (-self.B) % self.n_shards
+        overrides = (list(overrides)
+                     + [ReplicaOverrides()
+                        for _ in range(self.B_padded - self.B)])
         self.n_c = len(c_bound)
         self.n_v = len(sizes)
         self.superstep_k = int(superstep)
@@ -521,25 +633,26 @@ class BatchDrainSim:
             vb = np.full(self.n_v, -1.0, self.dtype)
             self.has_bounds = False
 
-        ew_dev = jax.device_put(ew2, device)
+        ew_dev = self._put_shared(ew2)
         if self.batch_w:
-            ei_dev, ewv_dev = [jax.device_put(a, device)
+            ei_dev, ewv_dev = [self._put_batched(a)
                                for a in ew_payload]
             opstats.bump("uploaded_bytes_delta",
                          sum(a.nbytes for a in ew_payload))
             ew_dev = _materialize_ew(ew_dev, ei_dev, ewv_dev)
             opstats.bump("dispatches")
             opstats.bump("batch_dispatches")
-        self._dev = [jax.device_put(ev2, device),
-                     jax.device_put(ec2, device), ew_dev]
-        self._vb = jax.device_put(vb, device)
+            ew_dev = self._pin(ew_dev)
+        self._dev = [self._put_shared(ev2),
+                     self._put_shared(ec2), ew_dev]
+        self._vb = self._put_shared(vb)
         ids = np.arange(self.n_v, dtype=np.int32)
-        self._ids_dev = jax.device_put(ids, device)
-        base_dev = [jax.device_put(a, device) for a in
+        self._ids_dev = self._put_shared(ids)
+        base_dev = [self._put_shared(a) for a in
                     (self._base_cb, self._base_sizes, self._base_rem,
                      self._base_pen)]
         payload = _pack_overrides(overrides, self.n_c, self.n_v)
-        payload_dev = [jax.device_put(a, device) for a in payload]
+        payload_dev = [self._put_batched(a) for a in payload]
         opstats.bump("uploaded_bytes_full",
                      ev2.nbytes + ec2.nbytes + ew2.nbytes + vb.nbytes
                      + ids.nbytes
@@ -560,13 +673,16 @@ class BatchDrainSim:
             thresh64 = self.done_eps * sz64
         else:
             thresh64 = jnp.full_like(sz64, self.done_eps)
-        self._cb = cb64.astype(self.dtype)
-        self._pen = pen64.astype(self.dtype)
-        self._rem = rem64.astype(self.dtype)
-        self._thresh = thresh64.astype(self.dtype)
+        self._cb = self._pin(cb64.astype(self.dtype))
+        self._pen = self._pin(pen64.astype(self.dtype))
+        self._rem = self._pin(rem64.astype(self.dtype))
+        self._thresh = self._pin(thresh64.astype(self.dtype))
 
         self.replicas = [ReplicaState(b) for b in range(self.B)]
-        self._alive = np.ones(self.B, bool)
+        self._alive = np.zeros(self.B_padded, bool)
+        self._alive[:self.B] = True
+        self.pad_events = 0
+        self.rescues = 0
         self.supersteps = 0
         self.syncs = 0
         self.rounds = 0
@@ -577,11 +693,59 @@ class BatchDrainSim:
         self.spec_rolled_back = 0
         opstats.bump("batch_replicas", self.B)
 
+    # -- device placement (single-device or replica-sharded) ---------------
+
+    def _put_shared(self, a):
+        """Upload one fleet-shared array: replicated onto every mesh
+        device (counted per device copy — a pod really ships M copies)
+        or plain device_put when unsharded."""
+        if self._mesh is not None:
+            opstats.bump("replicated_upload_bytes",
+                         a.nbytes * self.n_shards)
+            return jax.device_put(a, self._rspec)
+        return jax.device_put(a, self.device)
+
+    def _put_batched(self, a):
+        """Upload one [B, ·] per-replica array split over the batch
+        axis: every byte lands on exactly one device."""
+        if self._mesh is not None:
+            opstats.bump("sharded_upload_bytes", a.nbytes)
+            return jax.device_put(a, self._bspec)
+        return jax.device_put(a, self.device)
+
+    def _pin(self, arr):
+        """Re-commit a device-resident [B, ·] result to the batch
+        sharding (device-side reshard, no host bytes; GSPMD usually
+        already chose this layout and the put is a no-op)."""
+        if self._mesh is not None:
+            return jax.device_put(arr, self._bspec)
+        return arr
+
+    def _put_mask(self, m: np.ndarray):
+        if self._mesh is not None:
+            return jax.device_put(m, self._bspec)
+        return jnp.asarray(m)
+
     # -- fleet stepping ----------------------------------------------------
 
     def _fetch(self, packed) -> np.ndarray:
         self.syncs += 1
-        return opstats.timed_fetch(packed)
+        if self._mesh is None:
+            return opstats.timed_fetch(packed)
+        # per-shard ring demux: each device's [B/M, ·] block comes back
+        # as its own transfer (counted in demux_fetches) and the blocks
+        # are reassembled in replica order, so the host walk below
+        # commits events in the same deterministic order as mesh=None.
+        # Dedupe by block start: a compiler-replicated output shows the
+        # same rows on every device.
+        parts = {}
+        for sh in packed.addressable_shards:
+            start = sh.index[0].start or 0
+            if start not in parts:
+                parts[start] = sh.data
+        fetched = [opstats.timed_fetch(parts[s]) for s in sorted(parts)]
+        opstats.bump("demux_fetches", len(fetched))
+        return np.concatenate(fetched, axis=0)
 
     def _superstep_issue_all(self, k: Optional[int] = None, pen=None,
                              rem=None, speculative: bool = False
@@ -600,7 +764,7 @@ class BatchDrainSim:
         pen_out, rem_out, packed = _batch_superstep(
             *self._dev, self._cb, self._vb, pen_in, rem_in,
             self._thresh, self._ids_dev,
-            jnp.asarray(alive), np.int32(k),
+            self._put_mask(alive), np.int32(k),
             np.int32(self.superstep_rounds),
             eps=self.eps, n_c=self.n_c, n_v=self.n_v, k_max=k_max,
             group=group, has_bounds=self.has_bounds,
@@ -667,6 +831,17 @@ class BatchDrainSim:
                 deaths += 1
             elif flag == _FLAG_BUDGET and adv == 0:
                 stuck.append(b)
+        if self.B_padded != self.B:
+            # ragged-fleet guard: padded lanes are dead from birth
+            # (k=0, state frozen), so any event they log would be a
+            # sharding/vmap bug silently corrupting the fleet
+            pad_ev = int(p[self.B:, 2].sum())
+            self.pad_events += pad_ev
+            if pad_ev:
+                raise RuntimeError(
+                    f"ragged-fleet guard: {self.B_padded - self.B} "
+                    f"padded dead lane(s) logged {pad_ev} completion "
+                    f"event(s) — the frozen-lane invariant is broken")
         if stuck:
             # the round budget expired inside a replica's FIRST solve:
             # finish exactly one advance for those lanes via the
@@ -688,7 +863,8 @@ class BatchDrainSim:
         return n_alive
 
     def _rescue_fused(self, stuck: List[int]) -> None:
-        active = np.zeros(self.B, bool)
+        self.rescues += 1
+        active = np.zeros(self.B_padded, bool)
         active[stuck] = True
         chunk = 16 if self._dev[0].size >= 1 << 20 else 64
         carry = None
@@ -697,7 +873,7 @@ class BatchDrainSim:
             if carry is None:
                 self._pen, self._rem, carry, stats = _batch_fused_fresh(
                     *self._dev, self._cb, self._vb, self._pen,
-                    self._rem, self._thresh, jnp.asarray(active),
+                    self._rem, self._thresh, self._put_mask(active),
                     eps=self.eps, n_c=self.n_c, n_v=self.n_v,
                     chunk=chunk, has_bounds=self.has_bounds,
                     batch_w=self.batch_w)
@@ -705,7 +881,7 @@ class BatchDrainSim:
                 self._pen, self._rem, carry, stats = _batch_fused_cont(
                     *self._dev, self._cb, self._vb, self._pen,
                     self._rem, self._thresh, carry,
-                    jnp.asarray(active), eps=self.eps, n_c=self.n_c,
+                    self._put_mask(active), eps=self.eps, n_c=self.n_c,
                     n_v=self.n_v, chunk=chunk,
                     has_bounds=self.has_bounds, batch_w=self.batch_w)
             opstats.bump("dispatches")
@@ -750,6 +926,8 @@ class BatchDrainSim:
                 active[b] = False
             if not active.any():
                 break
+        self._pen = self._pin(self._pen)
+        self._rem = self._pin(self._rem)
 
     def _run_pipelined(self, max_supersteps: int) -> None:
         """The speculative fleet driver: up to ``self.pipeline``

@@ -25,9 +25,31 @@ context object through the solver entry points:
                               selected: the warm carry is COO-only, so
                               the solver falls back to cold and counts
                               the gap here instead of hiding it
+* ``shards``                — shard lanes admitted into mesh-sharded
+                              fleets/solves (ops.lmm_batch ``mesh=``:
+                              one bump of the mesh's device count per
+                              sharded program set up)
+* ``demux_fetches``         — per-SHARD completion-ring transfers of
+                              sharded fleets: each fleet sync fetches
+                              one [B/M, ·] block per device and the
+                              host reassembles them in replica order
+                              before the event demux
+* ``replicated_upload_bytes`` — host->device bytes for fleet-SHARED
+                              arrays under a mesh, counted once per
+                              device copy (a pod really ships M
+                              copies of the platform flattening)
+* ``sharded_upload_bytes``  — host->device bytes for [B, ·]
+                              per-replica payloads under a mesh: each
+                              byte lands on exactly one device, so
+                              this stays flat per replica as the mesh
+                              grows
 * ``fetches``               — device->host result transfers routed
                               through :func:`timed_fetch` (drain ring
-                              fetches, batched fleet fetches)
+                              fetches, batched fleet fetches; each
+                              shard block of a sharded fleet counts
+                              once)
+* ``fetched_bytes``         — device->host bytes moved by those
+                              transfers
 * ``blocking_fetches``      — the subset of ``fetches`` whose device
                               computation had NOT finished when the
                               host asked (``Array.is_ready()`` false):
@@ -102,6 +124,7 @@ def timed_fetch(arr) -> "np.ndarray":
     out = np.asarray(arr)
     bump("host_block_ms", (time.perf_counter() - t0) * 1e3)
     bump("fetches")
+    bump("fetched_bytes", out.nbytes)
     if not ready:
         bump("blocking_fetches")
     return out

@@ -16,7 +16,14 @@ answer is data parallelism over a ``jax.sharding.Mesh``:
 * **scenario campaigns** (``campaign.Campaign``): fleets of what-if
   replicas (fault seeds, parameter sweeps) of ONE platform flattening
   drained in lockstep batched device programs (ops.lmm_batch), each
-  replica bit-identical to its solo run.
+  replica bit-identical to its solo run;
+* **sharded campaign fleets** (``Campaign(mesh=M)`` /
+  ``ops.lmm_batch.BatchDrainSim(mesh=M)``): the fleet's replica axis
+  split across a ("batch",) device mesh — per-replica state and
+  payloads sharded, platform flattening replicated, per-shard
+  completion rings demuxed in replica order — the production
+  replica-sharding path (bit-identical to single-device and solo;
+  ``tools/check_determinism.py --runtime-shard``).
 """
 
 from .campaign import (  # noqa: F401

@@ -21,7 +21,13 @@ plus a list of :class:`ScenarioSpec` records into a replica fleet:
 * every replica's event order and clocks are bit-identical to the same
   scenario drained solo (:meth:`Campaign.run_solo` is the oracle the
   determinism tooling compares against), so batching is purely a
-  throughput choice.
+  throughput choice;
+* ``mesh=M`` shards each fleet's replica axis across M devices
+  (``NamedSharding(mesh, PartitionSpec("batch"))`` on every [B, ·]
+  array, shared flattening replicated — see ops.lmm_batch): campaign
+  throughput then scales with devices, not with Python, and results
+  stay bit-identical to the single-device fleet and to solo runs
+  (``tools/check_determinism.py --runtime-shard``).
 
 The s4u Engine is a process singleton, so replicas are kernel-level
 scenario instances sharing one flattening — the drain phase is where
@@ -110,7 +116,7 @@ class Campaign:
                  link_names: Optional[List[Optional[str]]] = None,
                  eps: float = 1e-9, done_eps: float = 1e-4,
                  dtype=np.float64, done_mode: str = "rel",
-                 superstep: int = 8, pipeline: int = 0):
+                 superstep: int = 8, pipeline: int = 0, mesh=None):
         self.e_var = np.asarray(e_var, np.int32)
         self.e_cnst = np.asarray(e_cnst, np.int32)
         self.e_w = np.asarray(e_w, np.float64)
@@ -130,6 +136,7 @@ class Campaign:
         self.done_mode = done_mode
         self.superstep = int(superstep)
         self.pipeline = int(pipeline)
+        self.mesh = mesh
         #: constraint slots that actually carry elements — fault
         #: schedules are drawn for these only (padding slots have no
         #: flows and scaling them is pure noise in the RNG stream)
@@ -200,15 +207,17 @@ class Campaign:
     # -- execution ---------------------------------------------------------
 
     def run_batched(self, batch: int = 64, superstep_rounds: int = 0,
-                    pipeline: Optional[int] = None
+                    pipeline: Optional[int] = None, mesh=None
                     ) -> List[ReplicaResult]:
         """Drain the whole fleet in chunks of ``batch`` replicas, each
         chunk one BatchDrainSim (one shared upload, lockstep
         supersteps).  Results come back in spec order; chunking is
         invisible to results — lanes are independent.  ``pipeline``
-        overrides the campaign's speculative-superstep depth for this
-        run (bit-identical results either way)."""
+        overrides the campaign's speculative-superstep depth and
+        ``mesh`` its replica-axis device sharding for this run
+        (bit-identical results either way)."""
         depth = self.pipeline if pipeline is None else int(pipeline)
+        use_mesh = self.mesh if mesh is None else mesh
         results: List[ReplicaResult] = []
         for start in range(0, len(self.specs), max(1, int(batch))):
             chunk_specs = self.specs[start:start + max(1, int(batch))]
@@ -220,7 +229,8 @@ class Campaign:
                 done_mode=self.done_mode, superstep=self.superstep,
                 superstep_rounds=superstep_rounds,
                 v_bound=self.v_bound, penalty=self.penalty,
-                remains=self.remains, pipeline=depth)
+                remains=self.remains, pipeline=depth,
+                mesh=use_mesh)
             sim.run()
             for b, spec in enumerate(chunk_specs):
                 rep = sim.replicas[b]
@@ -264,12 +274,13 @@ class Campaign:
                              error)
 
     def run_scoped(self, batch: int, stage: str,
-                   pipeline: Optional[int] = None
+                   pipeline: Optional[int] = None, mesh=None
                    ) -> Tuple[List[ReplicaResult], Dict[str, float]]:
         """run_batched under an opstats stage scope: returns (results,
         this run's counter deltas) — the campaign's own dispatches and
         upload bytes, unpolluted by whatever ran before in the
         process."""
         with opstats.scoped(stage) as stats:
-            results = self.run_batched(batch=batch, pipeline=pipeline)
+            results = self.run_batched(batch=batch, pipeline=pipeline,
+                                       mesh=mesh)
         return results, stats

@@ -11,12 +11,24 @@ intrusive lists, maxmin.cpp:502-693):
   ``shard_map`` — the loop condition depends only on replicated values,
   so all chips iterate in lockstep and there is exactly one collective
   pair per round.
-* ``batched_solve``: MANY independent systems vmapped on a leading
-  batch axis, the batch sharded over the mesh axis ``"sim"`` — for
-  parameter sweeps and model-checker branch exploration.
-* ``sharded_step``: the flagship full step (solve → completion-time
-  min-reduce → advance), batched + element-sharded on a 2-D
-  ``("sim", "elem")`` mesh.
+* ``batched_solve``: MANY independent systems (each with its OWN COO
+  structure) vmapped on a leading batch axis, the batch sharded over
+  the mesh axis ``"sim"`` — for heterogeneous sweeps and model-checker
+  branch exploration.
+* ``sharded_step``: one full step (solve → completion-time min-reduce
+  → advance), batched + element-sharded on a 2-D ``("sim", "elem")``
+  mesh.
+
+This module owns the ELEMENT-sharding axis only.  The production
+replica-sharded path — fleets of scenarios over ONE shared platform
+flattening, drained to completion with per-shard completion rings,
+alive masks and speculative pipelining — lives in ``ops.lmm_batch``
+(``BatchDrainSim(mesh=...)`` / ``solve_arrays_batch(mesh=...)``) and
+is driven by ``parallel.campaign``; this prototype's earlier
+duplicated fixpoint/step wrappers were rebased onto the shared kernel
+programs (``ops.lmm_jax._solve_chunk_batched_lane``,
+``ops.lmm_drain._advance_math``), so the fixpoint and advance logic
+exist exactly once.
 """
 
 from __future__ import annotations
@@ -30,8 +42,15 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.lmm_jax import (LmmArrays, check_convergence, fixpoint,
-                           use_local_rounds)
+from ..ops.lmm_drain import _advance_math
+from ..ops.lmm_jax import (_MAX_ROUNDS, LmmArrays, _solve_chunk_batched_lane,
+                           check_convergence, fixpoint, use_local_rounds)
+
+# jax.shard_map moved to the top level after 0.4.x; fall back to the
+# experimental home so the element-sharded path works on both.
+_shard_map = getattr(jax, "shard_map", None)
+if _shard_map is None:
+    from jax.experimental.shard_map import shard_map as _shard_map
 
 
 def make_mesh(n_devices: Optional[int] = None, sim: int = 1,
@@ -68,12 +87,12 @@ def _sharded_run(mesh: Mesh, axis: str, n_c: int, n_v: int,
         in_shardings=(espec, espec, espec, rspec, rspec, rspec, rspec, rspec),
         out_shardings=rspec)
     def run(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound, eps):
-        fn = jax.shard_map(
+        fn = _shard_map(
             functools.partial(fixpoint, n_c=n_c, n_v=n_v, axis=axis,
                               parallel_rounds=parallel_rounds),
             mesh=mesh,
             in_specs=(P(axis), P(axis), P(axis), P(), P(), P(), P(), P()),
-            out_specs=P())
+            out_specs=P(), check_rep=False)
         return fn(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty,
                   v_bound, eps)
 
@@ -82,10 +101,20 @@ def _sharded_run(mesh: Mesh, axis: str, n_c: int, n_v: int,
 
 @functools.lru_cache(maxsize=64)
 def _batched_run(n_c: int, n_v: int, parallel_rounds: bool = False):
-    """Memoized jitted vmapped fixpoint for batches of independent systems."""
-    solve1 = functools.partial(fixpoint, n_c=n_c, n_v=n_v, axis=None,
-                               parallel_rounds=parallel_rounds)
-    return jax.jit(jax.vmap(solve1, in_axes=(0, 0, 0, 0, 0, 0, 0, None)))
+    """Memoized jitted vmapped solve for batches of independent
+    systems, rebased onto the SHARED chunk lane
+    (ops.lmm_jax._solve_chunk_batched_lane — the same raw function
+    behind ops.lmm_batch's fleet kernels), so the fixpoint wrapper
+    logic exists once.  Here each lane carries its own COO structure,
+    hence the extra vmapped axes."""
+    def lane(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
+             eps):
+        out = _solve_chunk_batched_lane(
+            e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
+            None, eps, n_c, n_v, parallel_rounds, _MAX_ROUNDS,
+            True, True)
+        return out[:4]
+    return jax.jit(jax.vmap(lane, in_axes=(0, 0, 0, 0, 0, 0, 0, None)))
 
 
 def sharded_solve(arrays: LmmArrays, eps: float, mesh: Mesh,
@@ -166,26 +195,29 @@ def sharded_step(mesh: Mesh, parallel_rounds=None):
             e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
             eps, n_c=n_c, n_v=n_v, axis="elem",
             parallel_rounds=parallel_rounds)
-        live = (v_penalty > 0) & (values > 0) & (v_remains > 0)
-        ttc = jnp.where(live, v_remains / jnp.where(live, values, 1.0),
-                        jnp.inf)
-        dt = jnp.min(ttc)
+        # dt/advance rides the shared drain-step math
+        # (ops.lmm_drain._advance_math): flows with exhausted remains
+        # are masked out of the min via penalty 0, threshold 0 keeps
+        # the retire semantics out of this rate-level step — the exact
+        # lane at the min date lands on remains == 0.0
+        pen_live = jnp.where(v_remains > 0, v_penalty, 0.0)
+        dt, _pen2, rem2, _done = _advance_math(
+            pen_live, v_remains, jnp.zeros_like(v_remains), values)
         dt = jnp.where(jnp.isfinite(dt), dt, 0.0)
-        v_remains = jnp.maximum(v_remains - values * dt, 0.0)
-        return values, v_remains, dt
+        return values, rem2, dt
 
     espec = P("sim", "elem")  # [sim, E] element arrays
 
     def step(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty, v_bound,
              v_remains, eps):
-        fn = jax.shard_map(
+        fn = _shard_map(
             jax.vmap(one_sim,
                      in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None)),
             mesh=mesh,
             in_specs=(espec, espec, espec,
                       P("sim"), P("sim"), P("sim"), P("sim"), P("sim"),
                       P()),
-            out_specs=(P("sim"), P("sim"), P("sim")))
+            out_specs=(P("sim"), P("sim"), P("sim")), check_rep=False)
         return fn(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty,
                   v_bound, v_remains, eps)
 

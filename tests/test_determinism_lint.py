@@ -60,6 +60,22 @@ def test_pipeline_runtime_determinism():
     assert problems == []
 
 
+def test_shard_runtime_determinism():
+    """Dynamic coverage of the mesh-sharded fleet executor (ISSUE 6
+    tooling, the `--quick` small-N instance): replicas of a fleet
+    whose batch axis is sharded over the conftest-forced virtual CPU
+    mesh are bit-identical — events and clocks — to the single-device
+    vmapped fleet and to solo runs, including ragged padding, budget
+    rescue, and pipeline depth 2 with forced speculation rollback.
+    The full-size check runs via
+    `check_determinism.py --runtime-shard`."""
+    checker = _load_checker()
+    problems = checker.check_shard_runtime(n_c=24, n_v=64, batch=4,
+                                           k=4, shards=(2,),
+                                           depths=(0, 2))
+    assert problems == []
+
+
 def test_checker_flags_violations(tmp_path):
     """The lint itself works: a planted file with each banned pattern is
     reported (guards against the lint silently matching nothing)."""

@@ -1,0 +1,198 @@
+"""Mesh-sharded campaign fleets (ISSUE 6): the replica axis of the
+batched drain split across a device mesh (ops.lmm_batch ``mesh=``,
+``NamedSharding(mesh, PartitionSpec("batch"))`` on every [B, ·] array,
+shared platform flattening replicated).
+
+The acceptance contract: every replica of a sharded fleet is
+bit-identical — event order AND times AND final Kahan clock — to the
+same replica in the single-device vmapped BatchDrainSim AND to its
+solo DrainSim run, across lane death, budget rescue, ragged padding
+and speculative pipeline depths >= 2; per-shard ring demux and the
+sharded/replicated upload split are observable in opstats.
+
+Runs on the conftest-forced 8-virtual-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from bench import build_arrays
+from simgrid_tpu.ops import opstats
+from simgrid_tpu.ops.lmm_batch import (BatchDrainSim, ReplicaOverrides,
+                                       solve_arrays_batch)
+from simgrid_tpu.parallel.campaign import Campaign, ScenarioSpec
+
+pytestmark = pytest.mark.skipif(
+    jax.device_count() < 4,
+    reason="mesh tests need the conftest-forced multi-device CPU")
+
+
+@pytest.fixture(scope="module")
+def base_system():
+    rng = np.random.default_rng(11)
+    n_c, n_v = 40, 160
+    arrays = build_arrays(rng, n_c, n_v, 3, np.float64)
+    E = arrays.n_elem
+    sizes = rng.choice(np.linspace(1e5, 2e6, 16), n_v)
+    return (arrays.e_var[:E], arrays.e_cnst[:E], arrays.e_w[:E],
+            arrays.c_bound[:n_c], sizes)
+
+
+def _overrides(n, n_v, elem_w_pool=0):
+    return [ReplicaOverrides(bw_scale=1.0 + 0.1 * (s % 5),
+                             size_scale=1.0 + 0.05 * (s % 3),
+                             dead_flows=(s % 7,) if s % 3 == 0 else (),
+                             elem_w=({(s * 5) % elem_w_pool: 1.5}
+                                     if elem_w_pool and s % 4 == 0
+                                     else {}))
+            for s in range(n)]
+
+
+def _run(base, ovs, **kw):
+    e_var, e_cnst, e_w, c_bound, sizes = base
+    sim = BatchDrainSim(e_var, e_cnst, e_w, c_bound, sizes, ovs,
+                        eps=1e-9, dtype=np.float64, superstep=8, **kw)
+    sim.run()
+    return sim
+
+
+def _assert_fleet_equal(a, b, n):
+    for j in range(n):
+        assert a.replicas[j].events == b.replicas[j].events, j
+        assert a.replicas[j].t == b.replicas[j].t, j
+        assert a.replicas[j].error == b.replicas[j].error, j
+
+
+class TestShardBitIdentity:
+    def test_shard2_and_shard4_match_vmap(self, base_system):
+        ovs = _overrides(8, 160, elem_w_pool=len(base_system[0]))
+        ref = _run(base_system, ovs)
+        for M in (2, 4):
+            got = _run(base_system, ovs, mesh=M)
+            assert got.n_shards == M
+            _assert_fleet_equal(got, ref, 8)
+
+    def test_shard_matches_solo(self, base_system):
+        """The standing oracle: a sharded lane == its solo DrainSim run
+        (via Campaign.run_solo, which derives the identical scenario)."""
+        e_var, e_cnst, e_w, c_bound, sizes = base_system
+        specs = [ScenarioSpec(seed=s, bw_scale=1.0 + 0.1 * (s % 5),
+                              size_scale=1.0 + 0.05 * (s % 3),
+                              dead_flows=(s % 7,) if s % 3 == 0 else ())
+                 for s in range(6)]
+        camp = Campaign(e_var, e_cnst, e_w, c_bound, sizes, specs,
+                        eps=1e-9, dtype=np.float64, superstep=8,
+                        mesh=2)
+        fleet = camp.run_batched(batch=6)
+        for j in (0, 3, 5):
+            solo = camp.run_solo(j)
+            assert fleet[j].events == solo.events
+            assert fleet[j].t == solo.t
+
+    def test_lane_death_and_empty_lane(self, base_system):
+        """Lanes dying mid-drain (and a lane dead at birth: every flow
+        removed) leave the surviving sharded lanes bit-identical."""
+        n_v = 160
+        ovs = _overrides(6, n_v)
+        # lane 2 has no flows at all: completes on the first superstep
+        ovs[2] = ReplicaOverrides(dead_flows=range(n_v))
+        # lane 4 drains much faster: dies (finishes) early
+        ovs[4] = ReplicaOverrides(size_scale=1e-3)
+        ref = _run(base_system, ovs)
+        got = _run(base_system, ovs, mesh=2)
+        _assert_fleet_equal(got, ref, 6)
+        assert got.replicas[2].events == []
+        assert not got.replicas[2].alive
+
+    def test_budget_rescue_sharded(self, base_system):
+        """A starved round budget forces _FLAG_BUDGET exits and the
+        batched fused rescue on the sharded path too."""
+        ovs = _overrides(6, 160)
+        ref = _run(base_system, ovs, superstep_rounds=3)
+        got = _run(base_system, ovs, superstep_rounds=3, mesh=2)
+        assert got.rescues > 0, "budget forcing never fired"
+        _assert_fleet_equal(got, ref, 6)
+
+    def test_pipeline_depth2_mispredict_replay(self, base_system):
+        """Speculative tokens over a sharded fleet: budget mispredicts
+        must discard in-flight supersteps and replay bit-identically."""
+        ovs = _overrides(6, 160)
+        ref = _run(base_system, ovs, superstep_rounds=3)
+        got = _run(base_system, ovs, superstep_rounds=3, mesh=2,
+                   pipeline=2)
+        assert got.spec_rolled_back > 0, "no mispredict was forced"
+        assert got.spec_committed > 0
+        _assert_fleet_equal(got, ref, 6)
+
+
+class TestRaggedFleets:
+    def test_ragged_padding_is_silent(self, base_system):
+        """B=5 over 4 shards pads 3 dead lanes: results match the
+        unsharded fleet, the guard sees zero padded events, and the
+        pad is invisible in the replica list."""
+        ovs = _overrides(5, 160)
+        ref = _run(base_system, ovs)
+        got = _run(base_system, ovs, mesh=4)
+        assert got.B == 5 and got.B_padded == 8
+        assert len(got.replicas) == 5
+        assert got.pad_events == 0
+        _assert_fleet_equal(got, ref, 5)
+
+    def test_ragged_alive_mask_freeze(self, base_system):
+        """The padded lanes ride the PR-4 alive-mask freeze: they are
+        dead from birth and never counted live."""
+        ovs = _overrides(3, 160)
+        got = _run(base_system, ovs, mesh=2)
+        assert got.B_padded == 4
+        assert int(got._alive.sum()) == 0          # all drained
+        assert got.pad_events == 0
+
+    def test_ragged_solve_arrays_batch(self, base_system):
+        e_var, e_cnst, e_w, c_bound, _ = base_system
+        B, n_c, n_v = 5, len(c_bound), 160
+        cb = np.stack([c_bound * (1 + 0.1 * i) for i in range(B)])
+        pen = np.ones((B, n_v))
+        vb = np.full((B, n_v), -1.0)
+        fat = np.zeros(n_c, bool)
+        ref = solve_arrays_batch(e_var, e_cnst, e_w, cb, fat, pen, vb,
+                                 1e-9)
+        got = solve_arrays_batch(e_var, e_cnst, e_w, cb, fat, pen, vb,
+                                 1e-9, mesh=2)
+        for a, b in zip(ref, got):
+            assert (np.asarray(a) == np.asarray(b)).all()
+            assert np.asarray(b).shape[0] == B
+
+
+class TestShardObservability:
+    def test_mesh_counters(self, base_system):
+        """The mesh-aware opstats: per-shard demux fetches, the
+        replicated vs sharded upload split, and the shard census."""
+        ovs = _overrides(8, 160)
+        with opstats.scoped("test/shard") as st:
+            _run(base_system, ovs, mesh=4)
+        assert st.get("shards") == 4
+        assert st.get("demux_fetches", 0) > 0
+        assert st.get("replicated_upload_bytes", 0) > 0
+        assert st.get("sharded_upload_bytes", 0) > 0
+        assert st.get("fetched_bytes", 0) > 0
+        # every logical sync fetched one block per shard
+        assert st["demux_fetches"] == st["fetches"]
+
+    def test_sharded_payload_bytes_flat_per_replica(self, base_system):
+        """The tentpole's byte contract: per-replica SHARDED payload
+        bytes stay ~flat as the fleet grows with the mesh (every
+        payload byte lands on exactly one device)."""
+        per = {}
+        for M, B in ((2, 8), (4, 16)):
+            ovs = _overrides(B, 160)
+            with opstats.scoped(f"test/shard{M}") as st:
+                _run(base_system, ovs, mesh=M)
+            per[M] = st["sharded_upload_bytes"] / B
+        ratio = per[4] / per[2]
+        assert 0.9 <= ratio <= 1.1, per
+
+    def test_mesh_rejects_overcommit(self, base_system):
+        ovs = _overrides(4, 160)
+        with pytest.raises(ValueError, match="device"):
+            _run(base_system, ovs, mesh=1024)

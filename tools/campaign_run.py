@@ -122,6 +122,13 @@ def main() -> int:
                     help="speculative fleet supersteps kept in "
                          "flight (bit-identical results; see "
                          "ops.lmm_drain)")
+    ap.add_argument("--mesh", type=int, default=0,
+                    help="shard each fleet's replica axis over this "
+                         "many devices (NamedSharding batch axis, "
+                         "bit-identical results; 0 = single-device "
+                         "vmap).  On CPU the device count is forced "
+                         "via XLA_FLAGS="
+                         "--xla_force_host_platform_device_count)")
     ap.add_argument("--faults", type=float, default=0.5,
                     help="fraction of replicas with a fault dimension "
                     "(seeded MTBF/MTTR link degradation)")
@@ -137,6 +144,15 @@ def main() -> int:
                     help="force the CPU JAX backend")
     args = ap.parse_args()
 
+    if args.mesh > 1:
+        # must land before jax initializes its backends: the forced
+        # host-platform device count only affects the CPU platform, so
+        # it is harmless on accelerator runs
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{args.mesh}").strip()
     if args.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
@@ -153,7 +169,8 @@ def main() -> int:
                           fault_horizon=args.horizon)
              for s in range(args.replicas)]
     campaign = Campaign(specs=specs, superstep=args.superstep,
-                        pipeline=args.pipeline, **base)
+                        pipeline=args.pipeline,
+                        mesh=args.mesh or None, **base)
 
     t0 = time.perf_counter()
     results, stats = campaign.run_scoped(batch=args.batch,
@@ -162,13 +179,18 @@ def main() -> int:
 
     row = dict(meta, replicas=args.replicas, batch=args.batch,
                superstep=args.superstep, pipeline=args.pipeline,
-               fault_replicas=n_fault,
+               mesh=args.mesh, fault_replicas=n_fault,
                wall_ms=round(wall * 1e3, 1),
                dispatches=int(stats.get("dispatches", 0)),
                dispatches_per_replica=round(
                    stats.get("dispatches", 0) / args.replicas, 3),
                upload_bytes=int(stats.get("uploaded_bytes_full", 0)
                                 + stats.get("uploaded_bytes_delta", 0)),
+               demux_fetches=int(stats.get("demux_fetches", 0)),
+               sharded_upload_bytes=int(
+                   stats.get("sharded_upload_bytes", 0)),
+               replicated_upload_bytes=int(
+                   stats.get("replicated_upload_bytes", 0)),
                events=sum(len(r.events) for r in results),
                errors=[r.spec.label for r in results if r.error],
                clocks=[round(r.t, 6) for r in results[:8]])

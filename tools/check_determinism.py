@@ -46,10 +46,23 @@ in-flight speculative superstep and replay it), where it additionally
 asserts that speculation really was rolled back (otherwise nothing
 was tested).
 
+``--runtime-shard`` drains mesh-sharded scenario fleets — the replica
+axis of the batched executor split across devices with
+``NamedSharding(mesh, PartitionSpec("batch"))`` (ops.lmm_batch
+``mesh=``) — and asserts every replica is bit-identical (event order,
+timestamps, Kahan clocks) to the single-device vmapped fleet AND to
+sampled solo runs, including ragged fleets (B not divisible by the
+mesh: dead padding lanes must log zero events), budget-rescue exits
+and pipeline depth >= 2 (where it additionally asserts the forced
+mispredicts really rolled speculation back).  Needs >= 2 devices: on
+CPU run under ``XLA_FLAGS=--xla_force_host_platform_device_count=N``
+(the standalone tool sets this itself before JAX initializes).
+
 ``--quick`` is the CI mode: the static lint plus small-N instances of
-every runtime check (drain, warm-start, batch, pipeline), sized to
-finish in seconds so the tier-1 suite can run it on every test pass
-(tests/test_determinism_lint.py).
+every runtime check (drain, warm-start, batch, pipeline, shard),
+sized to finish in seconds so the tier-1 suite can run it on every
+test pass (tests/test_determinism_lint.py, whose conftest forces an
+8-virtual-device CPU so the mesh path is exercised on every run).
 """
 
 from __future__ import annotations
@@ -381,6 +394,105 @@ def check_pipeline_runtime(seed: int = 29, n_c: int = 64, n_v: int = 400,
     return problems
 
 
+def check_shard_runtime(seed: int = 31, n_c: int = 48, n_v: int = 160,
+                        batch: int = 8, k: int = 8, shards=(2, 4),
+                        depths=(0, 2)) -> List[str]:
+    """Dynamic determinism of the mesh-sharded fleet executor: a
+    replica of a fleet whose batch axis is sharded over a device mesh
+    must be bit-identical — events, timestamps, final Kahan clock — to
+    the single-device vmapped fleet and to solo runs, for plain
+    drains, ragged fleets (padded dead lanes must stay silent),
+    budget-starved rescue exits, and speculative pipeline depths >= 2
+    (whose forced mispredicts must actually roll back).  Returns a
+    list of problem descriptions (empty = OK)."""
+    import numpy as np
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import jax
+    from bench import build_arrays
+    from simgrid_tpu.ops import opstats
+    from simgrid_tpu.parallel.campaign import Campaign, ScenarioSpec
+
+    need = max(shards)
+    if jax.device_count() < need:
+        return [f"shard: only {jax.device_count()} device(s) visible; "
+                f"the mesh path needs >= {need} — on CPU run under "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count="
+                f"{need}"]
+
+    rng = np.random.default_rng(seed)
+    arrays = build_arrays(rng, n_c, n_v, 3, np.float64)
+    E = arrays.n_elem
+    sizes = rng.choice(np.linspace(1e5, 2e6, 16), n_v)
+    specs = [ScenarioSpec(seed=s,
+                          bw_scale=1.0 + 0.1 * (s % 5),
+                          size_scale=1.0 + 0.05 * (s % 3),
+                          fault_mtbf=400.0 if s % 2 else None,
+                          fault_mttr=50.0, fault_horizon=600.0,
+                          dead_flows=(s % 7,) if s % 3 == 0 else ())
+             for s in range(batch)]
+    camp = Campaign(arrays.e_var[:E], arrays.e_cnst[:E],
+                    arrays.e_w[:E], arrays.c_bound[:n_c], sizes,
+                    specs, eps=1e-9, dtype=np.float64, superstep=k)
+
+    problems: List[str] = []
+
+    def diff_fleet(label, got, ref, n=None):
+        for j in range(n if n is not None else len(ref)):
+            if got[j].error or ref[j].error:
+                problems.append(f"shard:{label}: replica {j} errored "
+                                f"({got[j].error or ref[j].error})")
+                return
+            if got[j].events != ref[j].events or got[j].t != ref[j].t:
+                problems.append(
+                    f"shard:{label}: replica {j} diverged from the "
+                    f"single-device fleet ({len(got[j].events)} vs "
+                    f"{len(ref[j].events)} events, clocks "
+                    f"{got[j].t!r} vs {ref[j].t!r})")
+                return
+
+    ref = camp.run_batched(batch=batch)          # single-device vmap
+    for M in shards:
+        for depth in depths:
+            before = opstats.snapshot()
+            got = camp.run_batched(batch=batch, mesh=M, pipeline=depth)
+            d = opstats.diff(before)
+            diff_fleet(f"m{M}:d{depth}", got, ref)
+            if not d.get("demux_fetches"):
+                problems.append(f"shard:m{M}:d{depth}: no per-shard "
+                                f"demux fetch recorded (the mesh path "
+                                f"was not actually exercised)")
+    # vs solo (the standing oracle): one sharded fleet, sampled lanes
+    got = camp.run_batched(batch=batch, mesh=shards[0])
+    for j in (0, batch // 2, batch - 1):
+        solo = camp.run_solo(j)
+        if solo.events != got[j].events or solo.t != got[j].t:
+            problems.append(f"shard:solo: replica {j} of the sharded "
+                            f"fleet diverged from its solo run")
+    # ragged fleet: B-1 replicas over the same mesh → one padded lane
+    ragged = camp.specs[:batch - 1]
+    camp_r = Campaign(arrays.e_var[:E], arrays.e_cnst[:E],
+                      arrays.e_w[:E], arrays.c_bound[:n_c], sizes,
+                      ragged, eps=1e-9, dtype=np.float64, superstep=k)
+    got_r = camp_r.run_batched(batch=batch - 1, mesh=shards[0])
+    diff_fleet(f"ragged:m{shards[0]}", got_r, ref, n=batch - 1)
+    # budget-starved rescue + deep pipeline: mispredicts must roll
+    # speculation back AND stay bit-identical
+    if max(depths) >= 2:
+        ref_b = camp.run_batched(batch=batch, superstep_rounds=3)
+        before = opstats.snapshot()
+        got_b = camp.run_batched(batch=batch, superstep_rounds=3,
+                                 mesh=shards[0], pipeline=max(depths))
+        d = opstats.diff(before)
+        diff_fleet(f"budget:m{shards[0]}:d{max(depths)}", got_b, ref_b)
+        if not d.get("speculations_rolled_back"):
+            problems.append(
+                "shard:budget: the budget-starved pipelined fleet "
+                "never rolled speculation back (forcing failed — "
+                "nothing was actually tested)")
+    return problems
+
+
 def quick_checks() -> List[str]:
     """The CI bundle: static lint + small-N instances of every runtime
     check, sized for seconds, so determinism regressions fail pytest
@@ -393,10 +505,36 @@ def quick_checks() -> List[str]:
                                     solo_check=(0, 3, 5))
     problems += check_pipeline_runtime(n_c=32, n_v=128, k=4,
                                        depths=(1,), batch=4)
+    problems += check_shard_runtime(n_c=24, n_v=64, batch=4, k=4,
+                                    shards=(2,), depths=(0, 2))
     return problems
 
 
 def main(argv: List[str]) -> int:
+    if ("--runtime-shard" in argv or "--quick" in argv) \
+            and "jax" not in sys.modules:
+        # the mesh checks need >= 2 devices; the forced host-platform
+        # count must land before JAX initializes and only affects the
+        # CPU backend (harmless elsewhere)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4"
+            ).strip()
+    if "--runtime-shard" in argv:
+        problems = check_shard_runtime()
+        if problems:
+            print("check_determinism: shard runtime check FAILED:")
+            for p in problems:
+                print(f"  {p}")
+            return 1
+        print("check_determinism: shard runtime OK (mesh-sharded "
+              "replica-axis fleets — 2/4-shard, ragged padding, "
+              "budget rescue, pipeline depth 2 incl. forced-rollback "
+              "assertion — bit-identical to the single-device vmapped "
+              "fleet and to solo runs: event order, timestamps and "
+              "clocks)")
+        argv = [a for a in argv if a != "--runtime-shard"]
     if "--quick" in argv:
         problems = quick_checks()
         if problems:
@@ -405,7 +543,7 @@ def main(argv: List[str]) -> int:
                 print(f"  {p}")
             return 1
         print("check_determinism: quick OK (lint + small-N drain + "
-              "batch + pipeline runtime)")
+              "batch + pipeline + shard runtime)")
         return 0
     if "--runtime-pipeline" in argv:
         problems = check_pipeline_runtime()
