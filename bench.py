@@ -706,6 +706,159 @@ def stage_pipeline(seed: int, k: int = 8, host_work_us: float = 500.0,
     return out
 
 
+_FAT_TREE_64 = """<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <cluster id="ft" prefix="node-" radical="0-63" suffix=""
+             speed="1Gf" bw="125MBps" lat="50us" topology="FAT_TREE"
+             topo_parameters="2;8,8;1,2;1,1"/>
+  </zone>
+</platform>
+"""
+
+
+def stage_phase(seed: int = 7, ranks: int = 64, rounds: int = 4,
+                k: int = 16, min_flows: int = 32) -> dict:
+    """NAS-style compute/comm alternation through the engine (the
+    ISSUE-9 trajectory metric): every completion immediately posts its
+    successor exec or comm, so the phase is a continuous stream of the
+    mutations that used to invalidate the device plan.  Three modes
+    over the identical seeded workload on the 64-host fat tree:
+
+    * **device** — the full PR-9 path: transition payloads absorb the
+      wake/send/exec churn, supersteps keep serving.
+    * **transitions-off** — PR 6's fast path (``drain/transitions:off``):
+      every mutation discards the plan, so coverage collapses to
+      whatever pure-drain windows survive between completions.
+    * **fastpath-off** — the native per-advance host loop.
+
+    The headline is **coverage** (fastpath_advances per native-loop
+    advance, from the opstats counters satellite 2 added): the
+    acceptance bar is device >= 2x transitions-off.  Every row carries
+    the invalidation-cause histogram, wall time and the event-stream
+    consistency flag; rows append to bench_results/lmm_phase.jsonl."""
+    _force_cpu()
+    import tempfile
+    import time as _time
+
+    from simgrid_tpu import s4u
+    from simgrid_tpu.ops import opstats
+
+    plat = os.path.join(tempfile.mkdtemp(prefix="simgrid_phase_"),
+                        "ft64.xml")
+    with open(plat, "w") as f:
+        f.write(_FAT_TREE_64)
+
+    def run(cfg):
+        s4u.Engine._reset()
+        try:
+            e = s4u.Engine(["phase"] + [f"--cfg={c}" for c in cfg])
+            e.load_platform(plat)
+            hosts = e.get_all_hosts()[:ranks]
+            model = e.pimpl.network_model
+            rng = np.random.default_rng(seed)
+            dst = rng.integers(0, ranks, size=(ranks, rounds))
+            sizes = rng.choice(np.linspace(2e5, 2e6, 12),
+                               (ranks, rounds))
+            flops = rng.choice(np.linspace(5e5, 5e6, 8),
+                               (ranks, rounds))
+            stage = [0] * ranks
+            tag_of = {}
+            events = []
+
+            def post_next(r):
+                st = stage[r]
+                j = st // 2
+                if j >= rounds:
+                    return
+                if st % 2 == 0:
+                    d = int(dst[r, j])
+                    if d == r:
+                        d = (d + 1) % ranks
+                    a = model.communicate(hosts[r], hosts[d],
+                                          float(sizes[r, j]), -1.0)
+                else:
+                    a = hosts[r].cpu.execution_start(float(flops[r, j]))
+                tag_of[id(a)] = (r, st)
+                stage[r] = st + 1
+
+            for r in range(ranks):
+                post_next(r)
+            t0 = _time.perf_counter()
+            for _ in range(200_000):
+                if not any(len(m.started_action_set)
+                           for m in e.pimpl.models):
+                    break
+                e.pimpl.surf_solve(-1.0)
+                for m in list(e.pimpl.models):
+                    while True:
+                        done = m.extract_done_action()
+                        if done is None:
+                            break
+                        t = tag_of.pop(id(done), None)
+                        if t is not None:
+                            events.append((done.finish_time, t))
+                            post_next(t[0])
+                        done.unref()
+            wall = (_time.perf_counter() - t0) * 1e3
+            return events, e.pimpl.now, wall
+        finally:
+            s4u.Engine._reset()
+
+    base = ["network/optim:Full", "network/maxmin-selective-update:no",
+            "lmm/backend:jax"]
+    fast = base + ["drain/fastpath:auto",
+                   f"drain/min-flows:{min_flows}",
+                   f"drain/superstep:{k}"]
+    modes = {
+        "device": fast,
+        "transitions-off": fast + ["drain/transitions:off"],
+        "fastpath-off": base + ["drain/fastpath:off"],
+    }
+    run(modes["device"])               # warm the jits once, unscoped
+    rows, streams, coverage = [], {}, {}
+    cause_keys = ("transition", "partial_advance", "profile_event",
+                  "stall", "unrecognized")
+    for mode, cfg in modes.items():
+        before = opstats.snapshot()
+        events, t_end, wall = run(cfg)
+        d = opstats.diff(before)
+        fp = int(d.get("fastpath_advances", 0))
+        nat = int(d.get("native_advances", 0))
+        coverage[mode] = round(fp / max(nat, 1), 3)
+        streams[mode] = (events, t_end)
+        row = {"bench": "lmm_phase", "workload": "nas-alternation",
+               "ranks": ranks, "rounds": rounds, "seed": seed,
+               "superstep": k, "min_flows": min_flows,
+               "events": len(events), "wall_ms": round(wall, 1),
+               "fastpath_advances": fp, "native_advances": nat,
+               "coverage": coverage[mode],
+               "drain_transitions": int(d.get("drain_transitions", 0)),
+               "drain_transition_slots":
+                   int(d.get("drain_transition_slots", 0))}
+        for key in cause_keys:
+            row[f"cause_{key}"] = int(d.get(f"drain_cause_{key}", 0))
+        rows.append(schema_row("phase", row, mode=mode, platform="cpu"))
+        log(f"[stage phase] {mode}: {len(events)} events, "
+            f"fp/native {fp}/{nat} (coverage {coverage[mode]}), "
+            f"wall {row['wall_ms']} ms")
+    consistent = all(streams[m] == streams["fastpath-off"]
+                     for m in streams)
+    for row in rows:
+        row["events_consistent"] = consistent
+    path = append_rows("lmm_phase.jsonl", rows)
+    log(f"[stage phase] rows appended to {path} "
+        f"(events_consistent={consistent})")
+
+    out = {"rows": rows, "events_consistent": consistent,
+           "coverage": coverage}
+    if coverage.get("transitions-off"):
+        out["coverage_vs_pr6"] = round(
+            coverage["device"] / max(coverage["transitions-off"], 1e-9),
+            1)
+    return out
+
+
 STAGES = {
     "probe": lambda args: stage_probe(),
     "dev": lambda args: stage_device(args.n_c, args.n_v, args.deg,
@@ -724,6 +877,9 @@ STAGES = {
     "pipeline": lambda args: stage_pipeline(args.seed, args.superstep,
                                             args.host_work_us,
                                             replicas=args.replicas),
+    "phase": lambda args: stage_phase(args.seed, args.ranks,
+                                      args.rounds, args.superstep,
+                                      args.min_flows),
     "shard": lambda args: stage_shard(args.n_c, args.n_v, args.deg,
                                       args.seed, args.per_shard,
                                       args.superstep, args.mesh),
@@ -944,6 +1100,18 @@ def main() -> None:
     if pipeline:
         detail["lmm_pipeline"] = pipeline
 
+    # --- device-resident mutating phases (ops.drain_path transitions) --
+    # NAS-style compute/comm alternation through the engine: coverage
+    # (fastpath vs native advances) for the transition-payload path vs
+    # PR 6's invalidate-on-mutation fast path vs the native loop; rows
+    # land in bench_results/lmm_phase.jsonl
+    phase = run_stage("phase", timeout=1800, errors=errors,
+                      seed=7, ranks=64, rounds=4, superstep=16)
+    if phase:
+        detail["lmm_phase"] = phase
+        if phase.get("coverage_vs_pr6") is not None:
+            detail["phase_coverage_vs_pr6"] = phase["coverage_vs_pr6"]
+
     # mergeable per-class solve rows for the record (same schema as the
     # churn/sweep files: bench_results/*.jsonl concatenate across PRs)
     solve_rows = []
@@ -1036,6 +1204,15 @@ if __name__ == "__main__":
                         help="shard stage: largest mesh size swept "
                         "(powers of two from 1; forces the virtual "
                         "CPU device count)")
+    parser.add_argument("--ranks", type=int, default=64,
+                        help="phase stage: alternating actors (<= 64 "
+                        "fat-tree hosts)")
+    parser.add_argument("--rounds", type=int, default=4,
+                        help="phase stage: comm+exec pairs per rank")
+    parser.add_argument("--min-flows", type=int, default=32,
+                        dest="min_flows",
+                        help="phase stage: drain/min-flows eligibility "
+                        "floor for the fast path")
     parser.add_argument("--host-work-us", type=float, default=500.0,
                         dest="host_work_us",
                         help="pipeline stage: emulated per-advance "

@@ -12,6 +12,7 @@ from typing import List, Optional
 
 from ..kernel.resource import (Action, ActionState, HeapType, Model, Resource,
                                SuspendStates, NO_MAX_DURATION, UpdateAlgo)
+from ..ops import opstats
 from ..ops.lmm_host import System
 from ..utils.config import config
 from ..utils.signal import Signal
@@ -50,6 +51,8 @@ class CpuModel(Model):
             action.finish(ActionState.FINISHED)
 
     def update_actions_state_full(self, now: float, delta: float) -> None:
+        if len(self.started_action_set):
+            opstats.bump("native_advances")
         # direct IntrusiveList traversal (removal-safe for the current
         # node): no O(V) list(...) allocation per advance
         for action in self.started_action_set:

@@ -18,6 +18,7 @@ from typing import List, Optional
 from ..kernel.resource import (Action, ActionState, HeapType, Model, Resource,
                                NO_MAX_DURATION, UpdateAlgo)
 from ..kernel import profile as profile_mod
+from ..ops import opstats
 from ..ops.lmm_host import SharingPolicy, System, double_update
 from ..utils.config import config
 from ..utils.signal import Signal
@@ -285,6 +286,8 @@ class NetworkCm02Model(NetworkModel):
     def update_actions_state_full(self, now: float, delta: float) -> None:
         if self.drain_fastpath.apply(now, delta):
             return
+        if len(self.started_action_set):
+            opstats.bump("native_advances")
         eps = config["surf/precision"]
         # direct IntrusiveList traversal (removal-safe for the current
         # node): no O(V) list(...) allocation per advance

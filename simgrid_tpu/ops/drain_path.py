@@ -1,4 +1,5 @@
-"""Engine-side drain fast path: delegate pure-drain phases to the
+"""Engine-side drain fast path: delegate pure-drain phases — and, with
+``drain/transitions``, whole compute/comm ALTERNATION phases — to the
 device-resident superstep executor.
 
 A *pure-drain phase* is the shape the end-to-end north star degenerates
@@ -18,27 +19,59 @@ serves *batches* of advances from one `DrainSim` superstep dispatch
   finishing exactly the planned set — the same traversal order the
   generic path uses;
 * the plan is built from the incrementally-maintained ArrayView
-  (ops.lmm_view) — no graph walk — and is invalidated by its mutation
+  (ops.lmm_view) — no graph walk — and audited by its mutation
   `version` counter, with the frees caused by *our own* served
   completions whitelisted (`expected_frees`);
-* a partial advance (the engine chose a smaller delta: another model's
-  event, a profile event, a run-until bound) is handed back to the
-  generic loop after a deterministic REPLAY: the batch is re-executed
-  from its saved device state up to the served prefix (jax arrays are
-  immutable, so batch-start state is a free O(1) snapshot), remains and
-  rates are written back, and the generic code runs unchanged;
 * with ``drain/pipeline`` > 0 the NEXT superstep is issued
   speculatively the moment ring N is fetched — JAX dispatch is async,
   so the device executes ring N+1 while the engine consumes ring N's
   batches, and the next fetch finds a ready buffer instead of paying
   the tunnel round trip.  Speculation never touches the committed
   flow state (the dispatch chains from double-buffered immutable
-  arrays), so ANY plan invalidation — profile event before the
-  horizon, ArrayView mutation, partial advance, stall — simply
-  discards the in-flight token and the existing deterministic-replay
-  rollback proceeds exactly as in the unpipelined path.  Event order,
+  arrays), so ANY plan teardown — profile event before the horizon,
+  an unrecognized ArrayView mutation, a stall — simply discards the
+  in-flight token and the existing deterministic-replay rollback
+  proceeds exactly as in the unpipelined path.  Event order,
   timestamps and clocks are bit-identical to ``drain/pipeline:0``
   (enforced by ``tools/check_determinism.py --runtime-pipeline``).
+
+Device-resident mutating phases (``drain/transitions``, the PR 9
+tentpole): the mutation census is a CLASSIFIER, not a tripwire.  When
+the ArrayView version moves while a plan is live, the per-consumer
+dirty-INDEX map (``ArrayView.consume("drain")``) is classified:
+
+* **resumable transitions** — a latency wake or suspend/resume
+  (v_penalty), a bound/weight change (c_bound / v_bound / e_w from
+  set_bandwidth, set_latency, TCP windows), a NEW flow posted on
+  existing routes (recycled or fresh variable slot + appended element
+  slots within the plan's padded capacity), or the echo of our own
+  retirements — are batched into ONE fused indexed *transition
+  payload* (the lmm_warm delta-upload shape: [indices..., values...]
+  runs with a static layout tuple) and scattered into the live device
+  plan (`DrainSim.apply_transitions`).  No re-flatten, no platform
+  re-upload; the superstep resumes from the patched state.
+* **true invalidations** — a layout epoch bump (array reallocation /
+  compaction), whole-field dirtiness, sharing-policy changes, a
+  fatpipe route, deadlines, route-less flows, non-finite (parked)
+  penalties, or any lane the classifier cannot attribute to a started
+  action — keep today's bit-identical replay fallback: rewind to the
+  served prefix, write remains/rates back, hand the phase to the
+  generic loop.
+
+Latency phases ride the plan as *invisible lanes* (device penalty 0 —
+not flowing), so a comm wave is planned the moment it is posted:
+`serve` returns min(plan dt, min latency) and `apply` replicates the
+generic walk's latency double_update + wake (the wake's penalty update
+is itself absorbed as a transition on the next serve).  An engine
+advance decided by ANOTHER model (a CPU exec completing mid-drain)
+becomes a forced partial advance ON DEVICE (`DrainSim.partial_advance`
+— the same strict-< retirement rule at an externally fixed delta)
+instead of a plan teardown.  Together these keep the compute/comm
+alternation of the SMPI NAS workloads on the superstep path end to
+end; coverage is counted per run (`fastpath_advances` vs
+`native_advances`, plus the `drain_cause_*` histogram) and bit-identity
+against the native path is enforced by
+``tools/check_determinism.py --runtime-phase``.
 
 Precision: f64 plans retire flows at the engine's absolute
 `maxmin/precision * surf/precision` threshold — bit-matching the
@@ -48,17 +81,20 @@ generic double_update path — while f32 plans use the RELATIVE
 
 Fidelity trade documented in README: while a plan is being served, the
 `remains` of still-live flows and link usage introspection lag until
-the plan ends (they are synced on every invalidation); actors in a pure
+the plan ends (they are synced on every invalidation); actors in a
 drain are blocked in comm waits, so nothing observes the lag.
 """
 
 from __future__ import annotations
 
+import math
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..utils.config import config
+from . import opstats
+from .lmm_host import double_update
 
 #: started-flow census below which a plan is never attempted (plan
 #: bookkeeping beats the generic path only at scale); the config flag
@@ -66,13 +102,18 @@ from ..utils.config import config
 _MIN_FLOWS_FLOOR = 8
 
 
-def _plan_inputs(model, dtype):
-    """The pure-drain precondition walk + flattened state, shared by
-    the fast path's plan builder and the campaign capture: one O(V)
-    pass maps view slots to started actions and rejects anything that
-    is not a pure drain (latency phases, deadlines, suspensions,
-    route-less flows, live non-flow variables, zero remains).  Returns
-    ``(slot_action, view, snap, sizes, rem, pen)`` or None."""
+def _plan_inputs(model, dtype, allow_latency: bool = False):
+    """The drain precondition walk + flattened state, shared by the
+    fast path's plan builder and the campaign capture: one O(V) pass
+    maps view slots to started actions and rejects anything the device
+    plan has no semantics for (deadlines, route-less flows, live
+    non-flow variables, zero remains — and, unless ``allow_latency``,
+    latency phases and suspensions).  With ``allow_latency`` (the
+    drain/transitions mode) latency-phase and suspended actions are
+    accepted as INVISIBLE lanes (device penalty 0, not flowing) and
+    their slots returned in `lat_slots`.  Returns
+    ``(slot_action, view, snap, sizes, rem, pen, lat_slots)`` or None.
+    """
     from ..kernel.resource import NO_MAX_DURATION
     from .lmm_view import ArrayView
 
@@ -82,14 +123,19 @@ def _plan_inputs(model, dtype):
         view = ArrayView(system)
 
     slot_action: Dict[int, object] = {}
+    lat_slots: set = set()
     for action in model.started_action_set:
         var = action.variable
-        if (var is None or var.sharing_penalty <= 0
-                or action.latency > 0
+        if (var is None
                 or action.max_duration != NO_MAX_DURATION
-                or action.is_suspended()
                 or var.get_number_of_constraint() == 0):
             return None
+        if (action.latency > 0 or action.is_suspended()
+                or var.sharing_penalty <= 0):
+            if not allow_latency:
+                return None
+            if action.latency > 0:
+                lat_slots.add(var._view_slot)
         slot_action[var._view_slot] = action
 
     snap = view.snapshot(dtype)
@@ -99,9 +145,10 @@ def _plan_inputs(model, dtype):
     live = np.flatnonzero(pen_all > 0)
     # a live variable that is NOT a started flow (e.g. a failed
     # action not yet reaped) shares bandwidth in the generic solve:
-    # not a pure drain
-    if len(live) != len(slot_action) or \
-            not all(int(s) in slot_action for s in live):
+    # not servable by a plan
+    if not all(int(s) in slot_action for s in live):
+        return None
+    if not allow_latency and len(live) != len(slot_action):
         return None
 
     n_v = len(pen_all)
@@ -114,7 +161,7 @@ def _plan_inputs(model, dtype):
         pen[slot] = pen_all[slot]
     if np.any(rem[live] <= 0):
         return None         # zero-remains flows: let generic finish
-    return slot_action, view, snap, sizes, rem, pen
+    return slot_action, view, snap, sizes, rem, pen, lat_slots
 
 
 def capture_scenario(model):
@@ -127,7 +174,7 @@ def capture_scenario(model):
     plan = _plan_inputs(model, np.float64)
     if plan is None:
         return None
-    slot_action, view, snap, sizes, rem, pen = plan
+    slot_action, view, snap, sizes, rem, pen, _lat = plan
     E = snap.n_elem
     names = [getattr(getattr(c, "id", None), "name", None)
              for c in view.slot_cnst]
@@ -150,7 +197,13 @@ class DrainFastPath:
         self.model = model
         self.sim = None                     # active DrainSim, or None
         self.slot_action: Dict[int, object] = {}
+        self.lat_actions: Dict[int, object] = {}   # latency-phase lanes
+        self.live_slots: set = set()        # slots with device pen > 0
         self.version = -1                   # ArrayView version at build
+        self.epoch = -1                     # ArrayView layout epoch
+        self.absorbing = False              # transitions enabled at build
+        self._done_mode = "abs"
+        self._done_eps = 0.0
         self.batches: List[Tuple[float, List[int]]] = []
         self.saved = None                   # (pen, rem) at batch start
         self.served = 0                     # advances of current batch
@@ -163,6 +216,9 @@ class DrainFastPath:
         self.speculations = 0
         self.spec_commits = 0
         self.spec_discards = 0
+        self.transitions_absorbed = 0
+        self.transition_slots = 0
+        self.partial_advances = 0
 
     # -- eligibility -------------------------------------------------------
 
@@ -189,9 +245,18 @@ class DrainFastPath:
             return False
         if backend == "auto" and n < config["lmm/jax-threshold"]:
             return False
-        if model.latency_phase_count:
+        if model.latency_phase_count and not self._transitions_enabled():
+            # without transition absorption the plan cannot see latency
+            # wakes; with it, latency phases ride as invisible lanes
             return False
         return True
+
+    def _transitions_enabled(self) -> bool:
+        mode = config["drain/transitions"]
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(f"Unknown drain/transitions {mode!r} "
+                             "(expected auto, on or off)")
+        return mode != "off"
 
     def _build(self) -> bool:
         """One O(V) walk to check the drain preconditions and map view
@@ -201,10 +266,19 @@ class DrainFastPath:
 
         dtype = (np.float32 if config["lmm/dtype"] == "float32"
                  else np.float64)
-        plan = _plan_inputs(self.model, dtype)
+        absorbing = self._transitions_enabled()
+        plan = _plan_inputs(self.model, dtype, allow_latency=absorbing)
         if plan is None:
             return False
-        slot_action, view, snap, sizes, rem, pen = plan
+        slot_action, view, snap, sizes, rem, pen, lat_slots = plan
+
+        # fatpipe constraints (the default loopback) have no drain
+        # program (the superstep kernel hardcodes SHARED): refuse the
+        # plan while any mapped element rides one
+        used = np.zeros(len(snap.c_bound), bool)
+        used[snap.e_cnst[snap.e_w > 0]] = True
+        if np.any(used & snap.c_fatpipe):
+            return False
 
         if dtype == np.float64:
             done_mode = "abs"
@@ -214,9 +288,12 @@ class DrainFastPath:
             done_mode = "rel"
             done_eps = config["drain/done-eps"]
 
-        E = snap.n_elem
+        # the plan spans the FULL padded view arrays (not the tight
+        # n_elem slice): the pow2 slack is what lets transition
+        # payloads append new flows' elements without a re-upload —
+        # padding carries weight 0 and is masked by the solver
         sim = DrainSim(
-            snap.e_var[:E], snap.e_cnst[:E], snap.e_w[:E],
+            snap.e_var, snap.e_cnst, snap.e_w,
             snap.c_bound, sizes,
             eps=config["maxmin/precision"], done_eps=done_eps,
             dtype=dtype, done_mode=done_mode,
@@ -229,7 +306,14 @@ class DrainFastPath:
             repack_min=1 << 62)
         self.sim = sim
         self.slot_action = slot_action
+        self.lat_actions = {s: slot_action[s] for s in lat_slots}
+        self.live_slots = {int(s) for s in np.flatnonzero(pen > 0)}
         self.version = view.version
+        self.epoch = view.layout_epoch
+        self.absorbing = absorbing
+        self._done_mode = done_mode
+        self._done_eps = float(done_eps)
+        view.consume("drain")      # reset the dirty-index census
         self.batches = []
         self.saved = None
         self.served = 0
@@ -241,14 +325,31 @@ class DrainFastPath:
 
     def _discard_spec(self) -> None:
         """Drop the in-flight speculative superstep (mispredict: the
-        plan is being invalidated, or its batch never materialized).
-        Issue never committed anything, so there is no state to
-        restore — only the device work is wasted (and counted)."""
+        plan is being invalidated or patched, or its batch never
+        materialized).  Issue never committed anything, so there is no
+        state to restore — only the device work is wasted (and
+        counted)."""
         if self.spec is not None:
             if self.sim is not None:
                 self.sim._discard_token(self.spec)
             self.spec_discards += 1
             self.spec = None
+
+    def _sync_to_served(self) -> None:
+        """Rewind the committed device flow state to the advances
+        actually served to the engine: deterministic replay of the
+        served prefix from the immutable batch-start arrays, then drop
+        the now-stale batch tail.  No-op when nothing is outstanding
+        (the committed state already IS the served state)."""
+        sim = self.sim
+        if self.batches and self.saved is not None:
+            sim._pen, sim._rem = self.saved
+            if self.served:
+                sim.superstep_batch(k=self.served, fetch=False)
+            self.rollbacks += 1
+        self.batches = []
+        self.saved = None
+        self.served = 0
 
     def _dispatch_batch(self) -> bool:
         """Collect one superstep (the in-flight speculative one when
@@ -289,63 +390,328 @@ class DrainFastPath:
 
     def serve(self, now: float) -> Optional[float]:
         """next_occurring_event_full hook: the dt to the next planned
-        completion, or None to fall back to the generic path."""
+        completion or latency expiry, or None to fall back to the
+        generic path (None with a live idle plan means no started
+        action — the plan is parked awaiting the next wave)."""
         model = self.model
         if self.sim is not None:
             view = model.system.array_view
-            if view is None or view.version != self.version:
+            if view is None:
                 self._invalidate(sync=True)
-            elif not self.batches and not self._dispatch_batch():
-                self._invalidate(sync=True)
+            else:
+                # mirror the native path's per-advance compaction
+                # cadence: the generic solve runs maybe_compact() every
+                # next-event, and the per-constraint element ORDER it
+                # produces decides the usage sums' rounding — serving
+                # from a stale layout would drift the rates a ulp off
+                # the host walk.  A compaction here epoch-bumps the
+                # view, which is a full (bit-identical) replay below.
+                view.maybe_compact()
+                if view.layout_epoch != self.epoch:
+                    self._invalidate(sync=True)
+                elif view.version != self.version:
+                    if not (self.absorbing and self._absorb()):
+                        self._invalidate(sync=True)
+        if self.sim is not None \
+                and len(self.lat_actions) != model.latency_phase_count:
+            # an action left the latency census behind the view's back
+            # (cancel/kill carries no LMM mutation until destroy): the
+            # classifier cannot see it, so the plan cannot either
+            self._invalidate(sync=True)
         if self.sim is None:
             if not self._enabled() or not self._build():
                 return None
-            if not self._dispatch_batch():
-                self._invalidate(sync=True)
+        dt = None
+        if self.live_slots:
+            if not self.batches and not self._dispatch_batch():
+                self._invalidate(sync=True, cause="stall")
                 return None
-        if not self.batches:
+            dt = self.batches[0][0]
+        if self.lat_actions:
+            dt_lat = min(a.latency for a in self.lat_actions.values())
+            if dt is None or dt_lat < dt:
+                dt = dt_lat
+        if dt is None:
+            if self.absorbing and not len(model.started_action_set):
+                # idle plan between waves: nothing to time, nothing to
+                # go stale — hold it for the next absorbed transition
+                return None
             self._invalidate(sync=True)
             return None
-        dt = self.batches[0][0]
         # a profile event before the completion horizon can mutate the
         # system mid-advance: generic path's turn
         next_event = model.engine.future_evt_set.next_date()
         if 0.0 <= next_event <= now + dt:
-            self._invalidate(sync=True)
+            self._invalidate(sync=True, cause="profile_event")
             return None
         return dt
 
     def apply(self, now: float, delta: float) -> bool:
         """update_actions_state_full hook: commit the planned advance
-        when the engine advanced by exactly its dt; otherwise roll back
+        when the engine advanced by exactly its dt; otherwise absorb
+        the partial advance on device (drain/transitions) or roll back
         deterministically and let the generic loop run.  Returns True
         when the advance was fully handled here."""
-        if self.sim is None or not self.batches:
+        if self.sim is None:
             return False
-        dt, slots = self.batches[0]
-        if delta != dt:
+        if self.batches and delta == self.batches[0][0]:
+            _dt, slots = self.batches.pop(0)
+            self.served += 1
+            self.advances_served += 1
+            opstats.bump("fastpath_advances")
+            self._finish_slots(slots)
+            self._advance_latencies(delta)
+            return True
+        if not self.absorbing:
+            if not self.batches:
+                return False
             # partial advance (another model's event or a run bound):
             # replay to the served prefix, write remains+rates back,
             # generic loop takes it from here
-            self._invalidate(sync=True, with_rates=True)
+            self._invalidate(sync=True, with_rates=True,
+                             cause="partial_advance")
             return False
-        self.batches.pop(0)
-        self.served += 1
-        self.advances_served += 1
-        done = set(slots)
-        view = self.model.system.array_view
+        if not self.batches and not self.live_slots \
+                and not self.lat_actions \
+                and not len(self.model.started_action_set):
+            return False       # idle plan: nothing to account
+        return self._partial_advance(delta)
+
+    def _finish_slots(self, slots) -> None:
+        """Finish the planned completion set in started-set order —
+        exactly the generic sweep's traversal — whitelisting the frees
+        our own retirements are about to cause."""
         from ..kernel.resource import ActionState
-        # started-set order, exactly like the generic sweep
+        done = set(slots)
+        if not done:
+            return
+        self.live_slots.difference_update(done)
+        view = self.model.system.array_view
         for action in self.model.started_action_set:
             var = action.variable
             if var is not None and var._view_slot in done:
                 view.expected_frees.add(id(var))
                 action.finish(ActionState.FINISHED)
+
+    def _advance_latencies(self, delta: float) -> None:
+        """The generic walk's latency bookkeeping, applied to the
+        plan's invisible lanes: double_update decrement, census
+        maintenance, and the wake's penalty update — which the view
+        marks as dirty, so the NEXT serve absorbs it as a transition
+        and the lane starts flowing on device."""
+        if not self.lat_actions:
+            return
+        eps = config["surf/precision"]
+        model = self.model
+        woken = []
+        for slot, action in self.lat_actions.items():
+            if action.latency > delta:
+                action.latency = double_update(action.latency, delta,
+                                               eps)
+            else:
+                action.latency = 0.0
+            if action.latency <= 0.0:
+                if action._lat_counted:
+                    action._lat_counted = False
+                    model.latency_phase_count -= 1
+                if not action.is_suspended():
+                    model.system.update_variable_penalty(
+                        action.variable, action.effective_penalty)
+                woken.append(slot)
+        for slot in woken:
+            del self.lat_actions[slot]
+
+    def _partial_advance(self, delta: float) -> bool:
+        """Serve an engine advance SMALLER than the plan's own dt
+        (another model's event, a latency expiry) on device: forced
+        remains decrement + threshold retirement at the given delta,
+        batches flushed (their schedule shifted), plan kept alive."""
+        if self.batches and delta > self.batches[0][0]:
+            # the engine advanced PAST our served horizon: a serve/
+            # apply protocol breach this path has no semantics for
+            self._invalidate(sync=True, with_rates=True)
+            return False
+        self.partial_advances += 1
+        opstats.bump("drain_cause_partial_advance")
+        if self.live_slots:
+            self._discard_spec()
+            try:
+                self._sync_to_served()
+                done_slots, _n_live = self.sim.partial_advance(delta)
+            except RuntimeError:
+                self._invalidate(sync=True, with_rates=True,
+                                 cause="stall")
+                return False
+            self._finish_slots(int(s) for s in done_slots)
+        else:
+            self._discard_spec()
+            self.batches = []
+            self.saved = None
+            self.served = 0
+        self._advance_latencies(delta)
+        self.advances_served += 1
+        opstats.bump("fastpath_advances")
+        return True
+
+    # -- transition absorption ---------------------------------------------
+
+    def _absorb(self) -> bool:
+        """Classify the mutation batch since the plan's version and
+        absorb it into the device plan as ONE fused transition payload.
+        Returns False when any mutation is not recognized as resumable
+        — the caller then runs the bit-identical replay invalidation.
+        Nothing is shipped before classification completes, so a False
+        return leaves the device state untouched."""
+        model = self.model
+        view = model.system.array_view
+        if view.layout_epoch != self.epoch:
+            return False       # slots renumbered: indices are garbage
+        dirty = view.consume("drain")
+        if dirty is None:
+            return False
+        if any(idxs is True for idxs in dirty.values()):
+            return False       # index identity lost for a whole field
+        if dirty["c_fatpipe"]:
+            return False       # sharing-policy change: no drain program
+
+        # classification MUST NOT mutate tracking state before it is
+        # complete: a False return hands the plan to _invalidate, whose
+        # remains write-back trusts slot_action — stage everything and
+        # commit only after the whole batch is recognized
+        updates: Dict[str, tuple] = {}
+        pen_ix: List[int] = []
+        pen_v: List[float] = []
+        rem_ix: List[int] = []
+        rem_v: List[float] = []
+        th_v: List[float] = []
+        vb_ix: List[int] = []
+        vb_v: List[float] = []
+        track: List[Tuple[int, object]] = []   # slot -> action (re)binds
+        drop: List[int] = []                   # slots leaving the plan
+        lat_add: List[Tuple[int, object]] = []
+        lat_del: List[int] = []
+        live_add: List[int] = []
+        live_del: List[int] = []
+        from ..kernel.resource import NO_MAX_DURATION
+
+        # element dirt: structural appends from new flows, weight
+        # changes (set_bandwidth re-weighing), retirement zeroing —
+        # final-state scatters straight from the f64 masters
+        e_dirty = sorted(dirty["e_var"] | dirty["e_cnst"]
+                         | dirty["e_w"])
+        for i in e_dirty:
+            if view.e_w[i] > 0 and view.c_fatpipe[view.e_cnst[i]]:
+                return False   # a fatpipe route joined the plan
+        if e_dirty:
+            updates["e_var"] = (e_dirty,
+                                [int(view.e_var[i]) for i in e_dirty])
+            updates["e_cnst"] = (e_dirty,
+                                 [int(view.e_cnst[i]) for i in e_dirty])
+            updates["e_w"] = (e_dirty,
+                              [float(view.e_w[i]) for i in e_dirty])
+        cb = sorted(dirty["c_bound"])
+        if cb:
+            updates["c_bound"] = (cb,
+                                  [float(view.c_bound[i]) for i in cb])
+
+        for slot in sorted(dirty["v_penalty"] | dirty["v_bound"]):
+            var = (view.slot_var[slot]
+                   if slot < len(view.slot_var) else None)
+            known = self.slot_action.get(slot)
+            if var is None:
+                # freed lane: our own retirement's echo, or an external
+                # free whose version bump rode along — dead either way
+                pen_ix.append(slot)
+                pen_v.append(0.0)
+                drop.append(slot)
+                continue
+            action = getattr(var, "id", None)
+            pen = float(view.v_penalty[slot])
+            if (action is None or action.state_set
+                    is not model.started_action_set):
+                if pen > 0:
+                    # a live lane not owned by a started action (e.g. a
+                    # cancelled-but-undestroyed flow): the generic solve
+                    # keeps sharing bandwidth with it forever; a plan
+                    # would retire it — different semantics, bail
+                    return False
+                pen_ix.append(slot)
+                pen_v.append(0.0)
+                drop.append(slot)
+                continue
+            if not math.isfinite(pen):
+                return False   # parked flow (inf penalty): replay path
+            if known is None or known.variable is not var:
+                # a NEW lane (fresh or recycled slot): full admission
+                if action.max_duration != NO_MAX_DURATION:
+                    return False
+                if var.get_number_of_constraint() == 0:
+                    return False   # route-less: generic completes it
+                remains = action.get_remains_no_update()
+                if pen > 0 and remains <= 0:
+                    return False
+                track.append((slot, action))
+                rem_ix.append(slot)
+                rem_v.append(remains)
+                size = max(action.cost, 1.0)
+                th_v.append(self._done_eps if self._done_mode == "abs"
+                            else self._done_eps * size)
+                if action.latency > 0:
+                    lat_add.append((slot, action))
+                else:
+                    lat_del.append(slot)
+            if slot in dirty["v_penalty"]:
+                pen_ix.append(slot)
+                pen_v.append(pen)
+                if pen > 0:
+                    live_add.append(slot)
+                else:
+                    live_del.append(slot)
+            if slot in dirty["v_bound"]:
+                vb_ix.append(slot)
+                vb_v.append(float(view.v_bound[slot]))
+
+        # classification succeeded: commit the staged tracking updates
+        for slot in drop:
+            self.slot_action.pop(slot, None)
+            self.lat_actions.pop(slot, None)
+            self.live_slots.discard(slot)
+        for slot, action in track:
+            self.slot_action[slot] = action
+        for slot in lat_del:
+            self.lat_actions.pop(slot, None)
+        for slot, action in lat_add:
+            self.lat_actions[slot] = action
+        for slot in live_del:
+            self.live_slots.discard(slot)
+        for slot in live_add:
+            self.live_slots.add(slot)
+        if pen_ix:
+            updates["v_penalty"] = (pen_ix, pen_v)
+        if rem_ix:
+            updates["remains"] = (rem_ix, rem_v)
+            updates["thresh"] = (rem_ix, th_v)
+        if vb_ix:
+            updates["v_bound"] = (vb_ix, vb_v)
+
+        # commit: rewind to the served prefix (the scatters describe
+        # mutations of the SERVED state), drop speculation, ship the
+        # payload, resume — the next serve dispatches a fresh superstep
+        self._discard_spec()
+        self._sync_to_served()
+        n = self.sim.apply_transitions(updates)
+        self.version = view.version
+        self.transitions_absorbed += 1
+        self.transition_slots += n
+        opstats.bump("drain_transitions")
+        opstats.bump("drain_transition_slots", n)
+        opstats.bump("drain_cause_transition")
         return True
 
     # -- teardown ----------------------------------------------------------
 
-    def _invalidate(self, sync: bool, with_rates: bool = False) -> None:
+    def _invalidate(self, sync: bool, with_rates: bool = False,
+                    cause: str = "unrecognized") -> None:
         """Retire the plan.  With sync=True the device flow state is
         replayed to the served prefix and `remains` written back to the
         still-live actions (with_rates also refreshes
@@ -359,6 +725,7 @@ class DrainFastPath:
         if sim is None:
             return
         self.invalidations += 1
+        opstats.bump("drain_cause_" + cause)
         if not sync:
             return
         if self.batches or with_rates:
@@ -387,3 +754,5 @@ class DrainFastPath:
         self.saved = None
         self.served = 0
         self.slot_action = {}
+        self.lat_actions = {}
+        self.live_slots = set()

@@ -78,8 +78,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from . import opstats
 from .lmm_jax import (_MAX_ROUNDS, _solve_kernel_chunk_batched,
                       _solve_kernel_chunk_batched_fresh)
-from .lmm_drain import (_FLAG_BUDGET, _FLAG_OK, _FLAG_STALLED, _pos_group,
-                        _fused_step_program, _superstep_program, _to2d)
+from .lmm_drain import (_FLAG_BUDGET, _FLAG_OK, _FLAG_STALLED, _ZERO_BITS,
+                        _pos_group, _fused_step_program,
+                        _superstep_program, _to2d)
 
 
 #: the mesh axis name the replica dimension shards over
@@ -273,7 +274,7 @@ def _materialize(base_cb, base_sizes, base_rem, base_pen,
                    static_argnames=("eps", "n_c", "n_v", "k_max",
                                     "group", "has_bounds", "batch_w"))
 def _batch_superstep(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
-                     thresh, ids, alive, k, round_budget,
+                     thresh, ids, alive, k, round_budget, zero_bits,
                      eps: float, n_c: int, n_v: int, k_max: int,
                      group: int, has_bounds: bool = False,
                      batch_w: bool = False):
@@ -289,8 +290,8 @@ def _batch_superstep(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
         return _superstep_program(
             e_var, e_cnst, ew_l, cb, v_bound, pen_l, rem_l, th_l, ids,
             k_l, jnp.asarray(round_budget, jnp.int32), jnp.int32(0),
-            eps=eps, n_c=n_c, n_v=n_v, k_max=k_max, group=group,
-            has_bounds=has_bounds)
+            zero_bits, eps=eps, n_c=n_c, n_v=n_v, k_max=k_max,
+            group=group, has_bounds=has_bounds)
 
     return jax.vmap(lane, in_axes=(0, 0, 0, 0, 0,
                                    0 if batch_w else None))(
@@ -298,11 +299,12 @@ def _batch_superstep(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
 
 
 def _batch_fused_lane(e_var, e_cnst, ew_l, cb, v_bound, pen_l, rem_l,
-                      th_l, carry_l, act, eps, n_c, n_v, chunk,
-                      has_bounds):
+                      th_l, carry_l, act, zero_bits, eps, n_c, n_v,
+                      chunk, has_bounds):
     pen2, rem2, carry2, stats = _fused_step_program(
         e_var, e_cnst, ew_l, cb, v_bound, pen_l, rem_l, th_l, carry_l,
-        eps=eps, n_c=n_c, n_v=n_v, chunk=chunk, has_bounds=has_bounds)
+        zero_bits, eps=eps, n_c=n_c, n_v=n_v, chunk=chunk,
+        has_bounds=has_bounds)
     sel = lambda a, b: jnp.where(act, a, b)  # noqa: E731
     if carry_l is None:
         carry_out = carry2
@@ -316,16 +318,17 @@ def _batch_fused_lane(e_var, e_cnst, ew_l, cb, v_bound, pen_l, rem_l,
                    static_argnames=("eps", "n_c", "n_v", "chunk",
                                     "has_bounds", "batch_w"))
 def _batch_fused_fresh(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
-                       thresh, active, eps: float, n_c: int, n_v: int,
-                       chunk: int, has_bounds: bool = False,
+                       thresh, active, zero_bits, eps: float, n_c: int,
+                       n_v: int, chunk: int, has_bounds: bool = False,
                        batch_w: bool = False):
     """Fleet fused solve+advance, fresh fixpoint start.  Inactive lanes
     still trace through the math but every output is frozen to the
     input state, so only `active` replicas advance."""
     def lane(cb, pen_l, rem_l, th_l, act, ew_l):
         return _batch_fused_lane(e_var, e_cnst, ew_l, cb, v_bound,
-                                 pen_l, rem_l, th_l, None, act, eps,
-                                 n_c, n_v, chunk, has_bounds)
+                                 pen_l, rem_l, th_l, None, act,
+                                 zero_bits, eps, n_c, n_v, chunk,
+                                 has_bounds)
     return jax.vmap(lane, in_axes=(0, 0, 0, 0, 0,
                                    0 if batch_w else None))(
         c_bound, pen, rem, thresh, active, e_w)
@@ -335,15 +338,16 @@ def _batch_fused_fresh(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
                    static_argnames=("eps", "n_c", "n_v", "chunk",
                                     "has_bounds", "batch_w"))
 def _batch_fused_cont(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
-                      thresh, carry, active, eps: float, n_c: int,
-                      n_v: int, chunk: int, has_bounds: bool = False,
-                      batch_w: bool = False):
+                      thresh, carry, active, zero_bits, eps: float,
+                      n_c: int, n_v: int, chunk: int,
+                      has_bounds: bool = False, batch_w: bool = False):
     """Continuation flavor: resume per-replica fixpoint carries (rare —
     only when a solve needs more than one chunk of rounds)."""
     def lane(cb, pen_l, rem_l, th_l, carry_l, act, ew_l):
         return _batch_fused_lane(e_var, e_cnst, ew_l, cb, v_bound,
-                                 pen_l, rem_l, th_l, carry_l, act, eps,
-                                 n_c, n_v, chunk, has_bounds)
+                                 pen_l, rem_l, th_l, carry_l, act,
+                                 zero_bits, eps, n_c, n_v, chunk,
+                                 has_bounds)
     return jax.vmap(lane, in_axes=(0, 0, 0, 0, 0, 0,
                                    0 if batch_w else None))(
         c_bound, pen, rem, thresh, carry, active, e_w)
@@ -765,7 +769,7 @@ class BatchDrainSim:
             *self._dev, self._cb, self._vb, pen_in, rem_in,
             self._thresh, self._ids_dev,
             self._put_mask(alive), np.int32(k),
-            np.int32(self.superstep_rounds),
+            np.int32(self.superstep_rounds), _ZERO_BITS,
             eps=self.eps, n_c=self.n_c, n_v=self.n_v, k_max=k_max,
             group=group, has_bounds=self.has_bounds,
             batch_w=self.batch_w)
@@ -874,15 +878,15 @@ class BatchDrainSim:
                 self._pen, self._rem, carry, stats = _batch_fused_fresh(
                     *self._dev, self._cb, self._vb, self._pen,
                     self._rem, self._thresh, self._put_mask(active),
-                    eps=self.eps, n_c=self.n_c, n_v=self.n_v,
-                    chunk=chunk, has_bounds=self.has_bounds,
-                    batch_w=self.batch_w)
+                    _ZERO_BITS, eps=self.eps, n_c=self.n_c,
+                    n_v=self.n_v, chunk=chunk,
+                    has_bounds=self.has_bounds, batch_w=self.batch_w)
             else:
                 self._pen, self._rem, carry, stats = _batch_fused_cont(
                     *self._dev, self._cb, self._vb, self._pen,
                     self._rem, self._thresh, carry,
-                    self._put_mask(active), eps=self.eps, n_c=self.n_c,
-                    n_v=self.n_v, chunk=chunk,
+                    self._put_mask(active), _ZERO_BITS, eps=self.eps,
+                    n_c=self.n_c, n_v=self.n_v, chunk=chunk,
                     has_bounds=self.has_bounds, batch_w=self.batch_w)
             opstats.bump("dispatches")
             opstats.bump("batch_dispatches")

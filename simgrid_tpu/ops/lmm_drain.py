@@ -95,8 +95,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from . import opstats
-from .lmm_jax import (_MAX_ROUNDS, _pos_group, _stable_livefirst_perm,
-                      fixpoint)
+from .lmm_jax import (_MAX_ROUNDS, _bucket, _pos_group,
+                      _stable_livefirst_perm, fixpoint)
 
 
 def _to2d(a: np.ndarray, group: int = 8) -> np.ndarray:
@@ -138,18 +138,48 @@ _drain_solve_chunk = functools.partial(
                               "has_bounds"))(_solve_chunk_program)
 
 
-def _advance_math(pen, rem, thresh, values):
+#: the traced runtime zero handed to every advance kernel (see
+#: _rounded_product) — an argument, never a constant, so neither XLA's
+#: simplifier nor LLVM can fold the integer detour away
+_ZERO_BITS = np.int64(0)
+
+
+def _rounded_product(a, b, zero_bits):
+    """a*b rounded to f64 BEFORE the consumer sees it.  XLA:CPU's LLVM
+    backend contracts mul+sub chains into FMAs no matter how the HLO is
+    structured (selects and optimization_barriers are speculated/erased
+    at instruction selection), but the engine's double_update walk
+    rounds the product first — so the chained device remains would
+    drift a ulp per advance from the host walk.  Routing the product's
+    bits through an integer add of `zero_bits` (a TRACED runtime zero
+    the compiler cannot constant-fold) pins the standalone rounding."""
+    prod = a * b
+    itype = jnp.int64 if prod.dtype == jnp.float64 else jnp.int32
+    bits = lax.bitcast_convert_type(prod, itype) + zero_bits.astype(itype)
+    return lax.bitcast_convert_type(bits, prod.dtype)
+
+
+def _advance_math(pen, rem, thresh, values, zero_bits=None):
     """The shared dt/retire step: dt to the next completion, relative-
     or absolute-threshold retirement (thresh is a per-flow array, so
     the caller chooses the semantics).  Mirrors
-    Model::update_actions_state (FULL mode)."""
+    Model::update_actions_state (FULL mode).
+
+    ``zero_bits`` (a TRACED int zero) routes the rate*dt product
+    through `_rounded_product` so the chained remains walk stays
+    bit-identical to the host engine — every drain path passes
+    `_ZERO_BITS`.  Callers that don't chain remains against the host
+    (the rate-level `parallel.sharded` step) may omit it and keep the
+    plain product."""
     live = pen > 0
     rate = jnp.where(live, values, 0.0)
     flowing = live & (rate > 0)
     dt = jnp.min(jnp.where(flowing,
                            rem / jnp.where(flowing, rate, 1.0),
                            jnp.inf))
-    rem2 = jnp.where(flowing, rem - rate * dt, rem)
+    prod = (rate * dt if zero_bits is None
+            else _rounded_product(rate, dt, zero_bits))
+    rem2 = jnp.where(flowing, rem - prod, rem)
     # strict <, matching the reference double_update's `value <
     # precision` zeroing (so the absolute mode is bit-compatible with
     # the engine's generic remains bookkeeping)
@@ -160,18 +190,19 @@ def _advance_math(pen, rem, thresh, values):
 
 
 @jax.jit
-def _drain_advance(v_penalty, rem, thresh, values):
+def _drain_advance(v_penalty, rem, thresh, values, zero_bits):
     """One time advance from solved rates (unfused path)."""
     dtype = rem.dtype
-    dt, pen2, rem2, done = _advance_math(v_penalty, rem, thresh, values)
+    dt, pen2, rem2, done = _advance_math(v_penalty, rem, thresh, values,
+                                         zero_bits)
     n_live = jnp.count_nonzero(pen2 > 0)
     head = jnp.stack([dt.astype(dtype), n_live.astype(dtype)])
     return pen2, rem2, jnp.concatenate([head, done.astype(dtype)])
 
 
 def _fused_step_program(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
-                        thresh, carry, eps: float, n_c: int, n_v: int,
-                        chunk: int, has_bounds: bool = False):
+                        thresh, carry, zero_bits, eps: float, n_c: int,
+                        n_v: int, chunk: int, has_bounds: bool = False):
     """Fused solve+advance: run up to `chunk` more saturation rounds
     and — if the fixpoint converged inside this dispatch — the dt/retire
     step too, all in ONE dispatch whose single fetch returns
@@ -189,7 +220,8 @@ def _fused_step_program(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
     carry2 = out[4]
     n_light = jnp.count_nonzero(carry2[4])
     converged = n_light == 0
-    dt, pen2, rem2, done = _advance_math(pen, rem, thresh, carry2[0])
+    dt, pen2, rem2, done = _advance_math(pen, rem, thresh, carry2[0],
+                                         zero_bits)
     ok = converged & jnp.isfinite(dt)
     pen_out = jnp.where(ok, pen2, pen)
     rem_out = jnp.where(ok, rem2, rem)
@@ -213,7 +245,7 @@ _FLAG_BUDGET = 2      # solve hit the round budget mid-superstep
 
 
 def _superstep_program(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
-                       thresh, ids, k, round_budget, stop_live,
+                       thresh, ids, k, round_budget, stop_live, zero_bits,
                        eps: float, n_c: int, n_v: int, k_max: int,
                        group: int, has_bounds: bool = False):
     """Up to `k` (<= k_max) full advances in ONE dispatch: an outer
@@ -256,7 +288,7 @@ def _superstep_program(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
         r = out[3].astype(jnp.int32)
         converged = jnp.count_nonzero(carry2[4]) == 0
         dt, pen2, rem2, done = _advance_math(pen_c, rem_c, thresh,
-                                             carry2[0])
+                                             carry2[0], zero_bits)
         ok = converged & jnp.isfinite(dt)
 
         # Kahan clock: per-advance dts combine compensated so the f32
@@ -318,6 +350,60 @@ def _superstep_program(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
 _drain_superstep = functools.partial(
     jax.jit, static_argnames=("eps", "n_c", "n_v", "k_max",
                               "group", "has_bounds"))(_superstep_program)
+
+
+#: transition-payload field order (index = the static target code in
+#: the payload layout); the first three scatter into the 2D element
+#: arrays, the rest into the per-constraint / per-flow vectors
+_TRANSITION_FIELDS = ("e_var", "e_cnst", "e_w", "c_bound",
+                      "v_penalty", "remains", "thresh", "v_bound")
+
+
+@functools.partial(jax.jit, static_argnames=("layout", "group"))
+def _apply_transition_payload(payload, ev, ec, ew, cb, pen, rem,
+                              thresh, vb, layout, group: int):
+    """Scatter one fused transition payload into the plan's device
+    arrays (the drain-path analogue of lmm_warm._apply_deltas): the
+    payload is a single f64 vector of per-field [indices..., values...]
+    runs and `layout` is the static ``(target, offset, n)`` tuple
+    describing them.  Flow slots and element slots are < 2^32, so the
+    f64 round trip is exact; element targets are 2D (group columns) to
+    keep the axon scatter fast path.  Padded payload entries repeat a
+    run's first (index, value) pair — duplicate same-value scatters are
+    harmless."""
+    targets = [ev, ec, ew, cb, pen, rem, thresh, vb]
+    for ti, off, n in layout:
+        idx = payload[off:off + n].astype(jnp.int32)
+        vals = payload[off + n:off + 2 * n]
+        t = targets[ti]
+        if t.ndim == 2:
+            targets[ti] = t.at[idx // group, idx % group].set(
+                vals.astype(t.dtype))
+        else:
+            targets[ti] = t.at[idx].set(vals.astype(t.dtype))
+    return tuple(targets)
+
+
+@jax.jit
+def _drain_forced_advance(pen, rem, thresh, values, delta, zero_bits):
+    """Advance the flow state by an EXTERNALLY chosen delta (an engine
+    advance decided by another model or a latency expiry, delta <= the
+    plan's own dt): decrement remains at the solved rates and retire
+    threshold crossings with the same strict-< rule as _advance_math,
+    so a partial advance that does push a flow under its threshold
+    finishes it exactly where the generic double_update walk would."""
+    dtype = rem.dtype
+    live = pen > 0
+    rate = jnp.where(live, values, 0.0)
+    flowing = live & (rate > 0)
+    rem2 = jnp.where(flowing,
+                     rem - _rounded_product(rate, delta, zero_bits), rem)
+    done = flowing & (rem2 < thresh)
+    pen2 = jnp.where(done, 0.0, pen)
+    rem2 = jnp.where(done, 0.0, rem2)
+    n_live = jnp.count_nonzero(pen2 > 0)
+    head = n_live.astype(dtype)[None]
+    return pen2, rem2, jnp.concatenate([head, done.astype(dtype)])
 
 
 @functools.partial(jax.jit,
@@ -650,7 +736,7 @@ class DrainSim:
         opstats.bump("fixpoint_rounds", rounds)
 
         self._pen, self._rem, out = _drain_advance(
-            self._pen, self._rem, self._thresh, carry[0])
+            self._pen, self._rem, self._thresh, carry[0], _ZERO_BITS)
         out = np.asarray(out)
         self.syncs += 1
         dt, n_live = float(out[0]), int(out[1])
@@ -662,8 +748,8 @@ class DrainSim:
         while True:
             self._pen, self._rem, carry, stats = _drain_fused_step(
                 *self._dev, self._cb, self._vb, self._pen, self._rem,
-                self._thresh, carry, eps=self.eps, n_c=self.n_c,
-                n_v=self.n_v, chunk=self.solve_chunk,
+                self._thresh, carry, _ZERO_BITS, eps=self.eps,
+                n_c=self.n_c, n_v=self.n_v, chunk=self.solve_chunk,
                 has_bounds=self.has_bounds)
             st = np.asarray(stats)
             self.syncs += 1
@@ -720,6 +806,87 @@ class DrainSim:
         self.syncs += 1
         return rates
 
+    def apply_transitions(self, updates: dict) -> int:
+        """Absorb a batch of recognized engine transitions into the
+        device plan: `updates` maps _TRANSITION_FIELDS names to
+        ``(slot_indices, values)`` pairs, shipped as ONE fused indexed
+        payload (pow2-bucketed, so payload shapes — and therefore jit
+        signatures — are bounded) and applied as device scatters.  No
+        re-flatten, no platform re-upload; cost is O(dirty slots).
+        Returns the number of real (unpadded) slots scattered."""
+        layout = []
+        chunks = []
+        off = 0
+        slots = 0
+        for ti, field in enumerate(_TRANSITION_FIELDS):
+            pair = updates.get(field)
+            if pair is None or len(pair[0]) == 0:
+                continue
+            ix = np.asarray(pair[0], np.float64)
+            vals = np.asarray(pair[1], np.float64)
+            slots += len(ix)
+            n = _bucket(len(ix), floor=8)
+            if n > len(ix):
+                ix = np.concatenate([ix, np.repeat(ix[:1], n - len(ix))])
+                vals = np.concatenate([vals,
+                                       np.repeat(vals[:1], n - len(vals))])
+            layout.append((ti, off, n))
+            chunks.append(ix)
+            chunks.append(vals)
+            off += 2 * n
+        if not layout:
+            return 0
+        vb_pair = updates.get("v_bound")
+        if vb_pair is not None and len(vb_pair[0]) \
+                and np.any(np.asarray(vb_pair[1]) > 0):
+            self.has_bounds = True
+        payload = jax.device_put(np.concatenate(chunks), self.device)
+        out = _apply_transition_payload(
+            payload, *self._dev, self._cb, self._pen, self._rem,
+            self._thresh, self._vb, layout=tuple(layout),
+            group=self._dev[0].shape[1])
+        self._dev = list(out[:3])
+        (self._cb, self._pen, self._rem, self._thresh, self._vb) = out[3:]
+        self._host = None      # host element mirrors are stale now
+        opstats.bump("dispatches")
+        opstats.bump("uploaded_bytes_delta", payload.nbytes)
+        return slots
+
+    def partial_advance(self, delta: float):
+        """Solve the CURRENT flow state to convergence, then advance it
+        by an EXTERNALLY chosen `delta` (an engine advance won by
+        another model or a latency expiry; delta <= this plan's own
+        next-completion dt) with the forced-advance kernel.  Returns
+        ``(done_slots, n_live)`` — the flow slots that crossed their
+        retirement threshold inside the partial advance (emitting them
+        in started-set order is the caller's concern).  The clock is
+        the engine's on this path, so self.t/self.events are untouched.
+        """
+        carry = None
+        while True:
+            carry, stats = _drain_solve_chunk(
+                *self._dev, self._cb, self._pen, self._vb, carry,
+                eps=self.eps, n_c=self.n_c, n_v=self.n_v,
+                chunk=self.solve_chunk, has_bounds=self.has_bounds)
+            st = np.asarray(stats)
+            self.syncs += 1
+            if int(st[1]) == 0:
+                break
+            if int(st[0]) >= _MAX_ROUNDS:
+                raise RuntimeError("drain solve did not converge")
+        self.rounds += int(st[0])
+        opstats.bump("dispatches")
+        opstats.bump("fixpoint_rounds", int(st[0]))
+        self._pen, self._rem, out = _drain_forced_advance(
+            self._pen, self._rem, self._thresh, carry[0],
+            jnp.asarray(delta, self.dtype), _ZERO_BITS)
+        out = np.asarray(out)
+        self.syncs += 1
+        self.advances += 1
+        n_live = int(out[0])
+        done = np.flatnonzero(out[1:] > 0)
+        return done, n_live
+
     def _live_elems(self) -> int:
         pen = np.asarray(self._pen)
         ew = np.asarray(self._dev[2]).reshape(-1)
@@ -756,8 +923,8 @@ class DrainSim:
             *self._dev, self._cb, self._vb, pen_in, rem_in,
             self._thresh, self._ids_dev,
             np.int32(k), np.int32(budget), np.int32(want_stop),
-            eps=self.eps, n_c=self.n_c, n_v=self.n_v, k_max=k_max,
-            group=group, has_bounds=self.has_bounds)
+            _ZERO_BITS, eps=self.eps, n_c=self.n_c, n_v=self.n_v,
+            k_max=k_max, group=group, has_bounds=self.has_bounds)
         self.supersteps += 1
         opstats.bump("dispatches")
         if speculative:

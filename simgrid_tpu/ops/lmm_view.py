@@ -31,10 +31,16 @@ Beyond whole-field dirtiness the view also tracks dirty *indices* per
 named consumer (``consume``): the warm-start solver (ops.lmm_warm)
 keeps the master arrays resident on device and applies mutations as
 one indexed scatter update, so its upload cost scales with the number
-of touched slots instead of field size.  Index tracking is only
-meaningful while slot numbering is stable, so every renumbering or
-reallocation (growth, ``_compact``) bumps ``layout_epoch`` — consumers
-treat an epoch change as everything-dirty.
+of touched slots instead of field size.  The drain fast path
+(ops.drain_path) registers the same way under the name ``"drain"``
+and uses the dirty-index map as a mutation CLASSIFIER: together with
+``version``/``expected_frees`` it decides per batch whether the
+engine's transitions are resumable (scattered into the live device
+plan as one transition payload) or a true plan invalidation.  Index
+tracking is only meaningful while slot numbering is stable, so every
+renumbering or reallocation (growth, ``_compact``) bumps
+``layout_epoch`` — consumers treat an epoch change as
+everything-dirty.
 """
 
 from __future__ import annotations

@@ -50,12 +50,25 @@ Carry invalidation is exact by construction (the hard part):
   zeroed weights and the closure check sees the retired slots.
 
 Solves that cannot be warmed fall back to a cold full solve of the
-same device-resident arrays — always available, always exact.  That
-includes runs that selected the ELL layout (``lmm/layout:ell``, or
-auto on an accelerator): the carry and delta masters are COO-only, so
-warm restarts are refused there and counted in the
-``warm_ell_fallbacks`` opstats counter — the open vc-table delta/carry
-story stays VISIBLE instead of silently serving a different layout.
+same device-resident arrays — always available, always exact.
+
+Runs that selected the ELL layout (``lmm/layout:ell``, or auto on an
+accelerator) are served from device-resident ELL masters maintained
+incrementally alongside the COO ones: the view's element slots are
+append-only within a layout epoch (``on_expand`` always allocates at
+``n_elem``; only ``_compact`` renumbers, and that bumps the epoch), so
+a new element's lane is simply ``fill[row]++`` on both the cv and vc
+tables — the same lane the stable-sort ``ell_from_arrays`` build would
+assign, which keeps the row-reduction order (and therefore every
+usage sum's rounding) bit-identical to a fresh conversion.  Dead lanes
+(zeroed weights from freed variables) contribute exact identities to
+the row reductions until the next epoch rebuild.  A row overflowing
+its padded width forces a host rebuild of the tables (rare: widths are
+pow2-bucketed).  Only when the COO->ELL conversion itself is refused
+(width/fill caps — the same caps the plain solve path applies) does
+the solve drop to the COO masters, counted in ``warm_ell_fallbacks``;
+the plain path serves COO for those systems too, so the layouts stay
+consistent.
 """
 
 from __future__ import annotations
@@ -70,7 +83,8 @@ import jax.numpy as jnp
 
 from ..utils.config import config
 from . import opstats
-from .lmm_jax import (_MAX_ROUNDS, _bucket, _default_chunk, _default_platform,
+from .lmm_jax import (_ELL_MAX_FILL, _ELL_MAX_WIDTH, _MAX_ROUNDS, _bucket,
+                      _default_chunk, _default_platform, _solve_ell_chunk,
                       _solve_kernel_chunk, use_local_rounds)
 
 _FIELDS = ("e_var", "e_cnst", "e_w", "c_bound", "c_fatpipe",
@@ -123,6 +137,67 @@ def _apply_deltas(payload, e_var, e_cnst, e_w, c_bound, c_fatpipe,
     return tuple(masters)
 
 
+@jax.jit
+def _apply_deltas_ell(cv_var, cv_w, cv_valid, vc_cnst, vc_valid, vc_w,
+                      cv_idx, cv_v, cv_wv, vc_idx, vc_c, vc_wv):
+    """Scatter one element-delta batch into the ELL masters.  Indices
+    are flattened (row * width + lane); the padding entries repeat the
+    first (index, value) pair so duplicate writes agree and the scatter
+    stays deterministic (the _apply_deltas discipline)."""
+    shp_c, shp_v = cv_var.shape, vc_cnst.shape
+    cv_var = cv_var.reshape(-1).at[cv_idx].set(cv_v).reshape(shp_c)
+    cv_w = cv_w.reshape(-1).at[cv_idx].set(cv_wv).reshape(shp_c)
+    cv_valid = cv_valid.reshape(-1).at[cv_idx].set(cv_wv > 0).reshape(shp_c)
+    vc_cnst = vc_cnst.reshape(-1).at[vc_idx].set(vc_c).reshape(shp_v)
+    vc_w = vc_w.reshape(-1).at[vc_idx].set(vc_wv).reshape(shp_v)
+    vc_valid = vc_valid.reshape(-1).at[vc_idx].set(vc_wv > 0).reshape(shp_v)
+    return cv_var, cv_w, cv_valid, vc_cnst, vc_valid, vc_w
+
+
+@functools.partial(jax.jit, static_argnames=("eps",))
+def _warm_init_ell(cv_var, cv_w, cv_valid, c_bound, c_fatpipe, v_penalty,
+                   prev_value, prev_remaining, prev_usage, prev_cv_live,
+                   mc_idx, eps: float):
+    """ELL analog of `_warm_init`: cold-start expressions (mirroring
+    `fixpoint_ell`'s None-carry init, row reductions included) for the
+    modified component, previous solution masked fixed/dark elsewhere.
+    The extra carry leg is `cv_live`: modified rows are re-derived from
+    the warm v_fixed (identical to the cold expression there — every
+    live element of a modified row belongs to a modified variable by
+    the component-closure checks), untouched rows keep the previous
+    converged mask."""
+    dtype = cv_w.dtype
+    n_c = c_bound.shape[0]
+    n_v = v_penalty.shape[0]
+    eps_t = jnp.asarray(eps, dtype)
+
+    c_mod = jnp.zeros(n_c, bool).at[mc_idx].set(True)
+    live = cv_valid & (cv_w > 0)
+    v_mod = jnp.zeros(n_v, bool).at[cv_var].max(live & c_mod[:, None])
+    has_live_elem = jnp.zeros(n_v, bool).at[cv_var].max(live)
+
+    v_enabled = v_penalty > 0
+    cv_evalid = cv_valid & jnp.take(v_enabled, cv_var)
+    safe_pen = jnp.where(v_enabled, v_penalty, 1.0)
+    cv_upen = jnp.where(cv_evalid, cv_w / jnp.take(safe_pen, cv_var), 0.0)
+    usage_sum = cv_upen.sum(axis=1)
+    usage_max = cv_upen.max(axis=1, initial=0.0)
+    usage0 = jnp.where(c_fatpipe, usage_max, usage_sum)
+
+    v_value0 = jnp.where(jnp.isfinite(v_penalty), v_penalty, 0.0) * 0.0
+    keep_prev = ~v_mod & v_enabled & has_live_elem
+    v_value = jnp.where(keep_prev, prev_value, v_value0)
+    v_fixed = jnp.where(v_mod, v_penalty < 0, True)
+    remaining = jnp.where(c_mod, c_bound, prev_remaining)
+    usage = jnp.where(c_mod, usage0, prev_usage)
+    light = c_mod & (c_bound > c_bound * eps_t) & (usage0 > 0)
+    cv_live = jnp.where(c_mod[:, None],
+                        cv_evalid & ~jnp.take(v_fixed, cv_var),
+                        prev_cv_live)
+    return (v_value, v_fixed, remaining, usage, light,
+            jnp.array(0, jnp.int32), cv_live)
+
+
 @functools.partial(jax.jit, static_argnames=("eps",))
 def _warm_init(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty,
                prev_value, prev_remaining, prev_usage, mc_idx,
@@ -170,14 +245,26 @@ def _warm_init(e_var, e_cnst, e_w, c_bound, c_fatpipe, v_penalty,
 class _DtypeState:
     """Per-solve-dtype device residency: masters, carry, validity tags."""
 
-    __slots__ = ("masters", "shapes", "epoch", "carry", "meta")
+    __slots__ = ("masters", "shapes", "epoch", "carry", "meta",
+                 "ell", "ell_shape", "ell_n", "cv_lane", "vc_lane",
+                 "cv_fill", "vc_fill")
 
     def __init__(self):
         self.masters = None        # tuple of device arrays, _FIELDS order
         self.shapes = None         # (E, C, V) padded lengths
         self.epoch = -1            # view.layout_epoch the masters track
         self.carry = None          # converged fixpoint state, or None
-        self.meta = None           # (eps, parallel_rounds) of the carry
+        self.meta = None           # (eps, parallel, layout, shape) of it
+        # ELL residency (lmm/layout:ell runs): the six 2D tables plus
+        # the host lane maps that let element deltas land as scatters
+        self.ell = None            # (cv_var, cv_w, cv_valid,
+        #                             vc_cnst, vc_valid, vc_w) on device
+        self.ell_shape = None      # (C, Wc, V, Wv)
+        self.ell_n = 0             # element slots placed so far
+        self.cv_lane = None        # per-element lane in its cv row
+        self.vc_lane = None        # per-element lane in its vc row
+        self.cv_fill = None        # per-constraint occupied lane count
+        self.vc_fill = None        # per-variable occupied lane count
 
 
 class WarmSolver:
@@ -195,6 +282,7 @@ class WarmSolver:
         self.carry_invalidations = 0
         self.last_rounds = 0
         self.last_mode = ""
+        self.last_layout = ""
         self.last_upload_bytes = 0
         self.last_dirty_slots = 0
 
@@ -222,6 +310,7 @@ class WarmSolver:
         st.shapes = (len(view.e_var), len(view.c_bound),
                      len(view.v_penalty))
         st.epoch = view.layout_epoch
+        st.ell = None              # element slots may have renumbered
         opstats.bump("uploaded_bytes_full", nbytes)
         self.last_upload_bytes += nbytes
 
@@ -282,6 +371,146 @@ class WarmSolver:
             return -1
         return n_slots
 
+    # -- ELL residency -----------------------------------------------------
+
+    def _build_ell(self, st: _DtypeState, view, key) -> bool:
+        """Host rebuild of the ELL masters + lane maps from the view
+        (same widths, caps and stable element-index lane order as
+        `ell_from_arrays`, so the row-reduction rounding matches the
+        plain solve path's conversion).  Returns False when the caps
+        refuse the conversion — COO serves those systems everywhere."""
+        E = view.n_elem
+        e_var = view.e_var[:E].astype(np.int64)
+        e_cnst = view.e_cnst[:E].astype(np.int64)
+        e_w = view.e_w[:E]
+        C, V = len(view.c_bound), len(view.v_penalty)
+        c_deg = np.bincount(e_cnst, minlength=C)
+        v_deg = np.bincount(e_var, minlength=V)
+        wc = int(c_deg.max()) if E else 1
+        wv = int(v_deg.max()) if E else 1
+        if wc > _ELL_MAX_WIDTH or wv > _ELL_MAX_WIDTH:
+            st.ell = None
+            return False
+        Wc = _bucket(max(wc, 1), floor=4)
+        Wv = _bucket(max(wv, 1), floor=4)
+        if E and (C * Wc + V * Wv) > _ELL_MAX_FILL * 2 * E:
+            st.ell = None
+            return False
+
+        slots_total = len(view.e_var)
+        cv_lane = np.full(slots_total, -1, np.int32)
+        vc_lane = np.full(slots_total, -1, np.int32)
+        cv_var = np.zeros((C, Wc), np.int32)
+        cv_w = np.zeros((C, Wc), key)
+        cv_valid = np.zeros((C, Wc), bool)
+        vc_cnst = np.zeros((V, Wv), np.int32)
+        vc_valid = np.zeros((V, Wv), bool)
+        vc_w = np.zeros((V, Wv), key)
+        ew = e_w.astype(key)
+
+        def row_slots(keys, n_rows):
+            order = np.argsort(keys, kind="stable")
+            sorted_keys = keys[order]
+            group_start = np.searchsorted(sorted_keys, np.arange(n_rows))
+            lanes = np.arange(E, dtype=np.int64) - group_start[sorted_keys]
+            return order, sorted_keys, lanes
+
+        if E:
+            order, rows, lanes = row_slots(e_cnst, C)
+            cv_lane[order] = lanes
+            cv_var[rows, lanes] = e_var[order]
+            cv_w[rows, lanes] = ew[order]
+            cv_valid[rows, lanes] = ew[order] > 0
+            order, rows, lanes = row_slots(e_var, V)
+            vc_lane[order] = lanes
+            vc_cnst[rows, lanes] = e_cnst[order]
+            vc_w[rows, lanes] = ew[order]
+            vc_valid[rows, lanes] = ew[order] > 0
+
+        arrays = (cv_var, cv_w, cv_valid, vc_cnst, vc_valid, vc_w)
+        nbytes = sum(a.nbytes for a in arrays)
+        st.ell = tuple(jax.device_put(a) for a in arrays)
+        st.ell_shape = (C, Wc, V, Wv)
+        st.ell_n = E
+        st.cv_lane, st.vc_lane = cv_lane, vc_lane
+        st.cv_fill = c_deg.astype(np.int32)
+        st.vc_fill = v_deg.astype(np.int32)
+        opstats.bump("uploaded_bytes_full", nbytes)
+        self.last_upload_bytes += nbytes
+        return True
+
+    def _sync_ell(self, st: _DtypeState, view, key, dirty) -> bool:
+        """Scatter the element dirt into the resident ELL tables.  New
+        elements (append-only within an epoch) take lane ``fill[row]++``
+        on each side — the lane a fresh stable-order build would assign.
+        Returns False when a row overflows its padded width (rebuild)."""
+        e_dirty = sorted(dirty["e_var"] | dirty["e_cnst"] | dirty["e_w"])
+        if not e_dirty:
+            return True
+        C, Wc, V, Wv = st.ell_shape
+        if (len(view.c_bound) != C or len(view.v_penalty) != V
+                or len(view.e_var) != len(st.cv_lane)):
+            return False           # row/slot table growth: rebuild
+        cv_idx: list = []
+        cv_v: list = []
+        cv_wv: list = []
+        vc_idx: list = []
+        vc_c: list = []
+        vc_wv: list = []
+        for i in e_dirty:
+            v = int(view.e_var[i])
+            c = int(view.e_cnst[i])
+            w = float(view.e_w[i])
+            if i >= st.ell_n:
+                lane_c = int(st.cv_fill[c])
+                lane_v = int(st.vc_fill[v])
+                if lane_c >= Wc or lane_v >= Wv:
+                    return False
+                st.cv_fill[c] = lane_c + 1
+                st.vc_fill[v] = lane_v + 1
+                st.cv_lane[i] = lane_c
+                st.vc_lane[i] = lane_v
+            else:
+                lane_c = int(st.cv_lane[i])
+                lane_v = int(st.vc_lane[i])
+                if lane_c < 0 or lane_v < 0:
+                    return False
+            cv_idx.append(c * Wc + lane_c)
+            cv_v.append(v)
+            cv_wv.append(w)
+            vc_idx.append(v * Wv + lane_v)
+            vc_c.append(c)
+            vc_wv.append(w)
+        st.ell_n = max(st.ell_n, e_dirty[-1] + 1)
+
+        n = _bucket(len(cv_idx), floor=8)
+        pads = []
+        for src, dt in ((cv_idx, np.int32), (cv_v, np.int32),
+                        (cv_wv, key), (vc_idx, np.int32),
+                        (vc_c, np.int32), (vc_wv, key)):
+            a = np.empty(n, dt)
+            a[:len(src)] = src
+            a[len(src):] = src[0]
+            pads.append(a)
+        st.ell = _apply_deltas_ell(*st.ell, *pads)
+        nbytes = sum(a.nbytes for a in pads)
+        opstats.bump("uploaded_bytes_delta", nbytes)
+        self.last_upload_bytes += nbytes
+        return True
+
+    def _ensure_ell(self, st: _DtypeState, view, key, dirty) -> bool:
+        """Bring the ELL masters up to date with the view; returns True
+        when the solve can be served in the ELL layout."""
+        if st.ell is not None and dirty is not None \
+                and not any(dirty[f] is True
+                            for f in ("e_var", "e_cnst", "e_w")):
+            if self._sync_ell(st, view, key, dirty):
+                return True
+        # missing, stale or overflowed: rebuild from the view (the
+        # carry's cv_live leg is lane-addressed, so a rebuild means a
+        # cold restart — enforced via the meta shape tag)
+        return self._build_ell(st, view, key)
+
     # -- carry validity ----------------------------------------------------
 
     def _delta_in_component(self, view, dirty, c_mod, v_mod,
@@ -325,8 +554,9 @@ class WarmSolver:
 
         self.last_upload_bytes = 0
         self.last_dirty_slots = 0
-        if (dirty is None or st.masters is None
-                or st.epoch != view.layout_epoch or st.shapes != shapes):
+        full = (dirty is None or st.masters is None
+                or st.epoch != view.layout_epoch or st.shapes != shapes)
+        if full:
             self._upload_full(st, view, key)
             st.carry = None
         else:
@@ -336,23 +566,29 @@ class WarmSolver:
             else:
                 self.last_dirty_slots = n_slots
 
+        # ELL runs are served from the resident ELL masters (lane maps
+        # keep them delta-maintained alongside the COO ones).  Only a
+        # conversion the width/fill caps refuse drops to COO — the
+        # plain solve path serves COO for those systems too, so the
+        # layout stays what the run would get anywhere; the residual
+        # gap is counted (opstats `warm_ell_fallbacks`).
+        use_ell = False
+        if _ell_selected():
+            use_ell = self._ensure_ell(st, view, key,
+                                       None if full else dirty)
+            if not use_ell:
+                self.warm_ell_fallbacks += 1
+                opstats.bump("warm_ell_fallbacks")
+        self.last_layout = "ell" if use_ell else "coo"
+
         eps_f = float(eps)
         parallel = use_local_rounds()
-        meta = (eps_f, parallel)
+        # the carry is layout-addressed (the ELL leg's cv_live lives at
+        # (row, lane)), so a layout or table-shape flip cold-restarts
+        meta = (eps_f, parallel, "ell" if use_ell else "coo",
+                st.ell_shape if use_ell else None)
         mc = np.fromiter((c._view_slot for c in cnst_list), np.int64,
                          len(cnst_list))
-
-        # ELL guard (ROADMAP open item made explicit): the carried
-        # fixpoint state and the delta-upload masters are COO-only —
-        # there is no vc-table delta/carry story yet — so a run that
-        # selected the ELL layout must not warm-start: fall back to a
-        # cold restart of the COO masters and COUNT the gap
-        # (opstats `warm_ell_fallbacks`) instead of serving a silently
-        # different layout than the user asked for.
-        if warm and _ell_selected():
-            warm = False
-            self.warm_ell_fallbacks += 1
-            opstats.bump("warm_ell_fallbacks")
 
         carry0 = None
         if warm and st.carry is not None and st.meta == meta:
@@ -383,12 +619,20 @@ class WarmSolver:
                 opstats.bump("uploaded_bytes_delta", mc_pad.nbytes)
                 self.last_upload_bytes += mc_pad.nbytes
                 prev = st.carry
-                carry0 = _warm_init(*st.masters[:6], prev[0], prev[2],
-                                    prev[3], mc_dev, eps=eps_f)
+                if use_ell:
+                    carry0 = _warm_init_ell(
+                        st.ell[0], st.ell[1], st.ell[2],
+                        st.masters[3], st.masters[4], st.masters[5],
+                        prev[0], prev[2], prev[3], prev[6],
+                        mc_dev, eps=eps_f)
+                else:
+                    carry0 = _warm_init(*st.masters[:6], prev[0],
+                                        prev[2], prev[3], mc_dev,
+                                        eps=eps_f)
 
         st.carry = None   # poisoned until this solve converges
         values, remaining, usage, rounds, out = self._run_chunks(
-            st, carry0, eps_f, parallel, shapes, view)
+            st, carry0, eps_f, parallel, shapes, view, use_ell)
         st.carry = out
         st.meta = meta
 
@@ -406,7 +650,7 @@ class WarmSolver:
         return values, remaining, usage
 
     def _run_chunks(self, st: _DtypeState, carry, eps_f: float,
-                    parallel: bool, shapes, view):
+                    parallel: bool, shapes, view, use_ell: bool = False):
         """Bounded-round dispatch loop with host convergence checks
         between chunks; one device->host transfer per chunk (the
         solve_arrays discipline, minus host-side compaction, which
@@ -421,10 +665,21 @@ class WarmSolver:
 
         prev_progress = None
         while True:
-            values, remaining, usage, rounds, carry = _solve_kernel_chunk(
-                *st.masters, carry, eps=eps_f, n_c=n_c, n_v=n_v,
-                parallel_rounds=parallel, chunk=chunk, unroll=False,
-                has_bounds=has_bounds, has_fatpipe=has_fatpipe)
+            if use_ell:
+                values, remaining, usage, rounds, carry = _solve_ell_chunk(
+                    st.ell[0], st.ell[1], st.ell[2], st.ell[3],
+                    st.ell[4], st.masters[3], st.masters[4],
+                    st.masters[5], st.masters[6], st.ell[5], carry,
+                    eps=eps_f, parallel_rounds=parallel, chunk=chunk,
+                    unroll=False, has_bounds=has_bounds,
+                    has_fatpipe=has_fatpipe)
+            else:
+                values, remaining, usage, rounds, carry = \
+                    _solve_kernel_chunk(
+                        *st.masters, carry, eps=eps_f, n_c=n_c, n_v=n_v,
+                        parallel_rounds=parallel, chunk=chunk,
+                        unroll=False, has_bounds=has_bounds,
+                        has_fatpipe=has_fatpipe)
             opstats.bump("dispatches")
             rdt = values.dtype
             fetched = np.asarray(jnp.concatenate([

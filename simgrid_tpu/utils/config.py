@@ -327,6 +327,16 @@ declare_flag("drain/pipeline",
              "are bit-identical to 0 (synchronous) — a mispredicted "
              "speculation is discarded and replayed from the "
              "committed state", 1)
+declare_flag("drain/transitions",
+             "Absorb recognizable actor transitions (latency wakes, "
+             "new flows on existing routes, bound/weight/penalty "
+             "changes, engine-driven partial advances) into a live "
+             "drain plan as indexed device scatters instead of "
+             "discarding it: the ArrayView mutation census becomes a "
+             "resumable-vs-invalidating classifier and compute/comm "
+             "alternation stays on the superstep path.  auto/on "
+             "enable it whenever drain/fastpath engages; off restores "
+             "the invalidate-on-any-mutation behavior", "auto")
 declare_flag("drain/done-eps",
              "Relative completion threshold of the f32 drain "
              "executor: a flow retires when its remainder falls to "

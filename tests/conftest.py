@@ -28,6 +28,13 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: full-scale property instances excluded from the tier-1 "
+        "budget (`-m 'not slow'`); run explicitly before releases")
+
+
 @pytest.fixture(autouse=True)
 def _fresh_config():
     """Snapshot/restore the global flag registry around each test

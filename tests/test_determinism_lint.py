@@ -76,6 +76,23 @@ def test_shard_runtime_determinism():
     assert problems == []
 
 
+def test_phase_runtime_determinism():
+    """Dynamic coverage of the device-resident mutating phases (ISSUE
+    9 tooling, the `--quick` small-N instance): an NAS-style
+    compute/comm alternation — every completion posting its successor
+    through the transition-payload absorb path — is bit-identical,
+    events and clocks, with the drain fast path on vs off, including
+    a forced resumable mutation (mid-phase bandwidth change), a
+    forced non-resumable one (deadline'd flow → replay fallback), and
+    the pipelined fleet variant.  The full-size check runs via
+    `check_determinism.py --runtime-phase`."""
+    checker = _load_checker()
+    problems = checker.check_phase_runtime(ranks=24, rounds=2,
+                                           min_flows=8, superstep=8,
+                                           depths=(0, 2))
+    assert problems == []
+
+
 def test_checker_flags_violations(tmp_path):
     """The lint itself works: a planted file with each banned pattern is
     reported (guards against the lint silently matching nothing)."""

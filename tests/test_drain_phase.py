@@ -1,0 +1,194 @@
+"""Device-resident mutating phases (ops.drain_path transition
+payloads): the ArrayView mutation census as a CLASSIFIER.  Bounded,
+recognizable mutations — bound/weight changes, action completions
+spawning successors, new flows on existing routes — are absorbed into
+the live device plan as indexed scatter payloads; anything the drain
+program has no semantics for (deadlines, parked flows, renumbered
+element slots) takes the bit-identical replay fallback.  Every test
+here asserts EXACT event equality (order and timestamps) against the
+native per-advance loop: the fast path's standing invariant."""
+
+import os
+
+import numpy as np
+import pytest
+
+from simgrid_tpu import s4u
+
+BASE = ["lmm/backend:jax", "network/maxmin-selective-update:no",
+        "network/optim:Full"]
+FAST = BASE + ["drain/fastpath:auto", "drain/min-flows:32",
+               "drain/superstep:8"]
+
+
+@pytest.fixture(autouse=True)
+def fresh_engine():
+    s4u.Engine._reset()
+    yield
+    s4u.Engine._reset()
+
+
+def fat_tree_platform(tmp_path):
+    xml = """<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <cluster id="ft" prefix="node-" radical="0-63" suffix=""
+             speed="1Gf" bw="125MBps" lat="50us" topology="FAT_TREE"
+             topo_parameters="2;8,8;1,2;1,1"/>
+  </zone>
+</platform>
+"""
+    path = os.path.join(str(tmp_path), "ft64.xml")
+    with open(path, "w") as f:
+        f.write(xml)
+    return path
+
+
+def _drain(tmp_path, cfg, flows=220, seed=5, spawn=0, mutate=None,
+           t_mut=0.004):
+    """Drive the model layer to a full drain.  ``spawn`` successor
+    comms are posted one per completion (new flows on existing routes
+    — the wake/send shape).  ``mutate(e, model, hosts)`` fires at the
+    first solve past ``t_mut`` — a pure function of the simulated
+    timeline, so on/off runs mutate at the same instant — and the
+    fast-path counters are sampled around exactly that solve, so the
+    tests can attribute absorption vs invalidation to the mutation
+    itself rather than to the surrounding churn."""
+    e = s4u.Engine(["phase-drain"] + [f"--cfg={c}" for c in cfg])
+    e.load_platform(fat_tree_platform(tmp_path))
+    hosts = e.get_all_hosts()
+    n_hosts = len(hosts)
+    model = e.pimpl.network_model
+    rng = np.random.default_rng(seed)
+    pairs = rng.integers(0, n_hosts, size=(flows + spawn, 2))
+    sizes = rng.choice(np.linspace(1e5, 2e6, 12), flows + spawn)
+
+    def post(k):
+        src, dst = int(pairs[k, 0]), int(pairs[k, 1])
+        if src == dst:
+            dst = (dst + 1) % n_hosts
+        a = model.communicate(hosts[src], hosts[dst],
+                              float(sizes[k]), -1.0)
+        a.drain_idx = k
+
+    for k in range(flows):
+        post(k)
+    next_spawn = flows
+    events = []
+    pend = mutate
+    mark = None
+    for _ in range(100_000):
+        if not len(model.started_action_set):
+            break
+        fired = pend is not None and e.pimpl.now > t_mut
+        if fired:
+            fp = model.drain_fastpath
+            before = ((fp.plans, fp.transitions_absorbed,
+                       fp.invalidations, fp.sim is not None)
+                      if fp else None)
+            pend(e, model, hosts)
+            pend = None
+        e.pimpl.surf_solve(-1.0)
+        if fired and before is not None:
+            fp = model.drain_fastpath
+            mark = {"live": before[3],
+                    "plans": fp.plans - before[0],
+                    "transitions": fp.transitions_absorbed - before[1],
+                    "invalidations": fp.invalidations - before[2]}
+        while True:
+            done = model.extract_done_action()
+            if done is None:
+                break
+            idx = getattr(done, "drain_idx", None)
+            if idx is not None:     # untagged probes stay out of both
+                events.append((done.finish_time, idx))
+                if next_spawn < flows + spawn:
+                    post(next_spawn)
+                    next_spawn += 1
+            done.unref()
+    return events, model, mark
+
+
+def test_bound_change_rides_a_payload(tmp_path):
+    """A mid-drain bandwidth change is a RESUMABLE mutation: the solve
+    that crosses it absorbs a c_bound scatter into the live plan (no
+    invalidation, no rebuild) and the event stream stays bit-identical
+    to the native loop — which pays a full host re-solve for the same
+    change."""
+    def halve_backbone(e, model, hosts):
+        link = next(iter(e.pimpl.links.values()))
+        link.set_bandwidth(link.get_bandwidth() * 0.5)
+
+    ev_off, _, _ = _drain(str(tmp_path), BASE + ["drain/fastpath:off"],
+                          mutate=halve_backbone)
+    s4u.Engine._reset()
+    ev_on, m_on, mark = _drain(str(tmp_path), FAST,
+                               mutate=halve_backbone)
+    assert ev_on == ev_off          # order AND exact timestamps
+    assert mark is not None and mark["live"], \
+        "no device plan was live at the mutation (nothing was tested)"
+    assert mark["transitions"] >= 1     # the bound change was absorbed
+    assert mark["invalidations"] == 0   # ... not replayed
+    assert mark["plans"] == 0           # ... and the plan survived
+
+
+def test_spawned_flows_join_the_plan(tmp_path):
+    """Completions spawning successor comms on existing routes — the
+    wake/send alternation shape — are admitted as transition payloads
+    (element appends + penalty/remains scatters), keeping the plan
+    serving across the churn."""
+    ev_off, _, _ = _drain(str(tmp_path), BASE + ["drain/fastpath:off"],
+                          flows=150, spawn=60)
+    s4u.Engine._reset()
+    ev_on, m_on, _ = _drain(str(tmp_path), FAST, flows=150, spawn=60)
+    fp = m_on.drain_fastpath
+    assert ev_on == ev_off
+    assert fp.advances_served > 0
+    assert fp.transitions_absorbed > 0
+    assert fp.transition_slots > 0
+
+
+def test_deadline_flow_forces_replay_fallback(tmp_path):
+    """A flow carrying max_duration has no drain-program semantics:
+    the classifier must refuse the admission and take the replay
+    invalidation — and the event stream must STILL be bit-identical
+    (the fallback is the old, always-correct path)."""
+    extra = []
+
+    def deadline_flow(e, model, hosts):
+        a = model.communicate(hosts[0], hosts[1], 3e5, -1.0)
+        a.set_max_duration(1e9)
+        extra.append(a)
+
+    ev_off, _, _ = _drain(str(tmp_path), BASE + ["drain/fastpath:off"],
+                          mutate=deadline_flow)
+    s4u.Engine._reset()
+    extra.clear()
+    ev_on, m_on, mark = _drain(str(tmp_path), FAST,
+                               mutate=deadline_flow)
+    # the deadline'd probe has no drain_idx: filter before comparing
+    assert ev_on == ev_off
+    assert mark is not None and mark["live"], \
+        "no device plan was live at the mutation (nothing was tested)"
+    assert mark["invalidations"] >= 1   # the classifier refused
+    assert mark["transitions"] == 0
+
+
+def test_compaction_cadence_matches_native(tmp_path):
+    """The native loop compacts the ArrayView inside every host solve;
+    the fast path must mirror that cadence (serve() runs
+    maybe_compact) because the per-constraint element ORDER decides
+    the usage sums' rounding.  A drain churny enough to trigger
+    compaction mid-plan must renumber at the same points, invalidate
+    the epoch-stale plan, rebuild, and stay bit-identical."""
+    ev_off, m_off, _ = _drain(str(tmp_path),
+                              BASE + ["drain/fastpath:off"],
+                              flows=120, spawn=140)
+    epoch_off = m_off.system.array_view.layout_epoch
+    s4u.Engine._reset()
+    ev_on, m_on, _ = _drain(str(tmp_path), FAST, flows=120, spawn=140)
+    fp = m_on.drain_fastpath
+    assert epoch_off > 0, "no compaction occurred (nothing was tested)"
+    assert m_on.system.array_view.layout_epoch == epoch_off
+    assert fp.plans >= 2            # epoch bump retired + rebuilt plans
+    assert ev_on == ev_off

@@ -25,10 +25,15 @@ def drain_events(arrays, sizes, dtype, eps):
     return sim.events
 
 
+# The full-scale instances each cost minutes of single-core solve
+# compute (thousands of advances x O(10-100)-round fixpoints) — they
+# are `slow` (tier-2); the small instance keeps the parity property
+# under the tier-1 budget on every run.
 @pytest.mark.parametrize("seed,n_c,n_v,deg", [
-    (1, 512, 2000, 3),
-    (2, 1024, 4000, 4),
-    (3, 256, 3000, 2),
+    (5, 128, 600, 3),
+    pytest.param(1, 512, 2000, 3, marks=pytest.mark.slow),
+    pytest.param(2, 1024, 4000, 4, marks=pytest.mark.slow),
+    pytest.param(3, 256, 3000, 2, marks=pytest.mark.slow),
 ])
 def test_f32_f64_event_order_parity(seed, n_c, n_v, deg):
     """Random uniform systems with distinct flow sizes: the f32 drain

@@ -448,14 +448,22 @@ def test_compaction_bit_identical(rounds_mode, dtype, eps):
         np.testing.assert_array_equal(np.asarray(d), np.asarray(p))
 
 
-@pytest.mark.parametrize("rounds_mode", [False, True])
-def test_f32_convergence_100k_flows(rounds_mode):
+# Sequential rounds at the full 100k scale run the fixpoint one
+# constraint-round at a time (~minutes of single-core compute) — the
+# full-scale instance is `slow` (tier-2); the reference sequential
+# semantics stay in tier-1 at a scale that still needs >1k rounds.
+@pytest.mark.parametrize("rounds_mode,n_c,n_v", [
+    pytest.param(False, 16384, 100_000, marks=pytest.mark.slow),
+    (False, 2048, 12_500),
+    (True, 16384, 100_000),
+])
+def test_f32_convergence_100k_flows(rounds_mode, n_c, n_v):
     """The round-1 TPU failure mode: a 100k-flow / 16k-link system in f32
     must converge (stuck constraints with no live variables are pruned
     even when f32 rounding keeps their usage residual above eps) — and
     produce a feasible, near-f64 solution."""
     from simgrid_tpu.ops.lmm_jax import solve_arrays
-    n_c, n_v, deg = 16384, 100_000, 4
+    deg = 4
     arrays32 = _bench_arrays(np.random.default_rng(9), n_c, n_v, deg,
                              np.float32)
     v32, r32, u32, rounds = solve_arrays(arrays32, 1e-5,

@@ -1,27 +1,29 @@
 """NAS parallel benchmarks (EP, IS, DT) from the reference tree,
 compiled UNMODIFIED with smpicc and run on the simulator — the
-BASELINE.md conformance row (reference examples/smpi/NAS).
+BASELINE.md conformance row (reference examples/smpi/NAS) — plus a
+self-contained NAS-style compute/comm alternation that must run
+end-to-end on the device superstep path (the PR-9 transition-payload
+contract) with events and clocks bit-identical to the native solver.
 
-The sources are test INPUTS read from the read-only reference mount;
-nothing is copied into this repository."""
+The benchmark sources are test INPUTS read from the read-only
+reference mount; nothing is copied into this repository."""
 
 import os
 import subprocess
 
+import numpy as np
 import pytest
 
+from simgrid_tpu import s4u
 from simgrid_tpu.smpi.c_api import compile_program, run_c_program
 
 NAS = "/root/reference/examples/smpi/NAS"
 
-pytestmark = [
-    pytest.mark.skipif(not os.path.isdir(NAS),
-                       reason="reference NAS sources unavailable"),
-    pytest.mark.skipif(
-        subprocess.run(["which", "gcc"],
-                       capture_output=True).returncode != 0,
-        reason="no C compiler"),
-]
+needs_reference = pytest.mark.skipif(
+    not os.path.isdir(NAS)
+    or subprocess.run(["which", "gcc"],
+                      capture_output=True).returncode != 0,
+    reason="reference NAS sources or C compiler unavailable")
 
 
 @pytest.fixture(scope="module")
@@ -36,6 +38,7 @@ def binaries(tmp_path_factory):
     return out
 
 
+@needs_reference
 def test_nas_is_verifies(binaries, capfd):
     """Integer Sort moves REAL key data through alltoall/alltoallv and
     checks the global ranking: its own 'Verification = SUCCESSFUL' is
@@ -48,6 +51,7 @@ def test_nas_is_verifies(binaries, capfd):
         capfd.readouterr().out
 
 
+@needs_reference
 def test_nas_dt_verifies(binaries, capfd):
     """Data Traffic (black-hole graph) streams bytes through the task
     graph and verifies the checksum; its main returns the verified
@@ -59,6 +63,7 @@ def test_nas_dt_verifies(binaries, capfd):
         capfd.readouterr().out
 
 
+@needs_reference
 def test_nas_ep_completes_with_sampling(binaries, capfd):
     """Embarrassingly Parallel uses SMPI_SAMPLE_GLOBAL +
     SMPI_SHARED_MALLOC: the sampled loop must converge and skip the
@@ -72,3 +77,105 @@ def test_nas_ep_completes_with_sampling(binaries, capfd):
     out = capfd.readouterr().out
     assert "EP Benchmark Completed" in out
     assert engine.clock > 0.0
+
+
+# ---------------------------------------------------------------------------
+# NAS-style alternation on the device superstep path (self-contained)
+# ---------------------------------------------------------------------------
+
+FAT_TREE_64 = """<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <cluster id="ft" prefix="node-" radical="0-63" suffix=""
+             speed="1Gf" bw="125MBps" lat="50us" topology="FAT_TREE"
+             topo_parameters="2;8,8;1,2;1,1"/>
+  </zone>
+</platform>
+"""
+
+
+@pytest.fixture
+def fresh_engine():
+    s4u.Engine._reset()
+    yield
+    s4u.Engine._reset()
+
+
+def _run_alternation(plat, cfg, ranks=32, rounds=2, seed=11):
+    """Each rank chains comm -> exec -> comm -> ... (the NAS bulk-
+    synchronous shape): every completion immediately posts its
+    successor, so every advance crosses a wake/send/exec transition.
+    Returns the tagged completion stream, the final clock and the
+    network model (for its fast-path counters)."""
+    s4u.Engine._reset()
+    e = s4u.Engine(["nas-alt"] + [f"--cfg={c}" for c in cfg])
+    e.load_platform(plat)
+    hosts = e.get_all_hosts()[:ranks]
+    model = e.pimpl.network_model
+    rng = np.random.default_rng(seed)
+    dst = rng.integers(0, ranks, size=(ranks, rounds))
+    sizes = rng.choice(np.linspace(2e5, 2e6, 12), (ranks, rounds))
+    flops = rng.choice(np.linspace(5e5, 5e6, 8), (ranks, rounds))
+    stage = [0] * ranks
+    tag_of = {}
+    events = []
+
+    def post_next(r):
+        st = stage[r]
+        k = st // 2
+        if k >= rounds:
+            return
+        if st % 2 == 0:
+            d = int(dst[r, k])
+            if d == r:
+                d = (d + 1) % ranks
+            a = model.communicate(hosts[r], hosts[d],
+                                  float(sizes[r, k]), -1.0)
+        else:
+            a = hosts[r].cpu.execution_start(float(flops[r, k]))
+        tag_of[id(a)] = (r, st)
+        stage[r] = st + 1
+
+    for r in range(ranks):
+        post_next(r)
+    for _ in range(100_000):
+        if not any(len(m.started_action_set) for m in e.pimpl.models):
+            break
+        e.pimpl.surf_solve(-1.0)
+        for m in list(e.pimpl.models):
+            while True:
+                done = m.extract_done_action()
+                if done is None:
+                    break
+                t = tag_of.pop(id(done), None)
+                if t is not None:
+                    events.append((done.finish_time, t))
+                    post_next(t[0])
+                done.unref()
+    return events, e.pimpl.now, model
+
+
+def test_alternation_runs_on_superstep_path(fresh_engine, tmp_path):
+    """The ISSUE-9 acceptance workload: the compute/comm alternation
+    runs END-TO-END on the device superstep path (transition payloads
+    absorb every wake/send/exec between supersteps — the plan is
+    patched, not discarded) and its completion events AND clocks are
+    bit-identical to the native per-advance solver."""
+    plat = os.path.join(str(tmp_path), "ft64.xml")
+    with open(plat, "w") as f:
+        f.write(FAT_TREE_64)
+    base = ["network/optim:Full", "network/maxmin-selective-update:no",
+            "lmm/backend:jax"]
+    ev_native, t_native, _ = _run_alternation(
+        plat, base + ["drain/fastpath:off"])
+    ev_dev, t_dev, model = _run_alternation(
+        plat, base + ["drain/fastpath:auto", "drain/min-flows:8",
+                      "drain/superstep:8"])
+    assert len(ev_native) == 2 * 32 * 2     # every comm and exec done
+    assert ev_dev == ev_native              # order AND timestamps
+    assert t_dev == t_native
+    fp = model.drain_fastpath
+    assert fp.advances_served > 0, "the device plan never served"
+    assert fp.transitions_absorbed > 0, \
+        "no transition payload was absorbed — the alternation fell " \
+        "back to per-mutation replays"

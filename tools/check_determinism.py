@@ -58,11 +58,23 @@ mispredicts really rolled speculation back).  Needs >= 2 devices: on
 CPU run under ``XLA_FLAGS=--xla_force_host_platform_device_count=N``
 (the standalone tool sets this itself before JAX initializes).
 
+``--runtime-phase`` runs an NAS-style compute/comm alternation (every
+completion posts its successor exec or comm — the mutating-phase
+shape the device-resident transition payloads exist for) with the
+drain fast path on vs off and asserts bit-identical completion
+events, timestamps and engine clocks, including forced RESUMABLE
+mutations (a mid-phase bandwidth change, absorbed as a bound
+scatter), forced NON-RESUMABLE mutations (a deadline'd flow, which
+must take the replay fallback — asserted via the invalidation-cause
+histogram), and the pipelined fleet variant (speculative supersteps
+riding the mutating phase).
+
 ``--quick`` is the CI mode: the static lint plus small-N instances of
-every runtime check (drain, warm-start, batch, pipeline, shard),
-sized to finish in seconds so the tier-1 suite can run it on every
-test pass (tests/test_determinism_lint.py, whose conftest forces an
-8-virtual-device CPU so the mesh path is exercised on every run).
+every runtime check (drain, warm-start, batch, pipeline, shard,
+phase), sized to finish in seconds so the tier-1 suite can run it on
+every test pass (tests/test_determinism_lint.py, whose conftest
+forces an 8-virtual-device CPU so the mesh path is exercised on
+every run).
 """
 
 from __future__ import annotations
@@ -493,6 +505,173 @@ def check_shard_runtime(seed: int = 31, n_c: int = 48, n_v: int = 160,
     return problems
 
 
+_FAT_TREE_64 = """<?xml version='1.0'?>
+<platform version="4.1">
+  <zone id="world" routing="Full">
+    <cluster id="ft" prefix="node-" radical="0-63" suffix=""
+             speed="1Gf" bw="125MBps" lat="50us" topology="FAT_TREE"
+             topo_parameters="2;8,8;1,2;1,1"/>
+  </zone>
+</platform>
+"""
+
+
+def check_phase_runtime(seed: int = 37, ranks: int = 48, rounds: int = 3,
+                        min_flows: int = 16, superstep: int = 16,
+                        depths=(0, 2)) -> List[str]:
+    """Dynamic determinism of the device-resident mutating phases: an
+    NAS-style compute/comm alternation (each rank chains comm -> exec
+    -> comm ... over a 64-host fat tree, every completion immediately
+    posting its successor) must produce bit-identical completion
+    events — order AND finish timestamps — and final engine clock with
+    the drain fast path on vs off, under
+
+      * the plain alternation (every completion is a wake/send/exec
+        transition the absorb classifier must turn into a payload),
+      * a forced RESUMABLE mutation (a backbone link's bandwidth is
+        halved mid-phase: a bound-change scatter, not a replay),
+      * a forced NON-RESUMABLE mutation (a deadline'd flow joins: the
+        classifier has no drain semantics for max_duration and must
+        take the bit-identical replay fallback), and
+      * the pipelined fleet variant (every depth in `depths`: the
+        speculative superstep machinery riding the mutating phase).
+
+    Each variant also asserts the machinery it targets actually fired
+    (served advances, absorbed transitions, the unrecognized-cause
+    fallback) — otherwise nothing was tested.  Returns a list of
+    problem descriptions (empty = OK)."""
+    import tempfile
+    import numpy as np
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from simgrid_tpu import s4u
+    from simgrid_tpu.ops import opstats
+
+    plat = os.path.join(tempfile.mkdtemp(prefix="simgrid_phase_"),
+                        "ft64.xml")
+    with open(plat, "w") as f:
+        f.write(_FAT_TREE_64)
+
+    def bw_mutation(e, model, hosts):
+        # resumable: a c_bound scatter in the transition payload
+        link = next(iter(e.pimpl.links.values()))
+        link.set_bandwidth(link.get_bandwidth() * 0.5)
+
+    def deadline_mutation(e, model, hosts):
+        # non-resumable: max_duration has no drain-program semantics,
+        # so _absorb must refuse and _invalidate(cause="unrecognized")
+        a = model.communicate(hosts[0], hosts[1], 3e5, -1.0)
+        a.set_max_duration(1e9)
+
+    def run(cfg, mutate=None):
+        """One alternation phase; mutations fire at the first solve
+        after t=0.005 — a pure function of the simulated timeline, so
+        the fast-path-on and -off runs mutate at the same instant."""
+        s4u.Engine._reset()
+        try:
+            e = s4u.Engine(["phase"] + [f"--cfg={c}" for c in cfg])
+            e.load_platform(plat)
+            hosts = e.get_all_hosts()[:ranks]
+            model = e.pimpl.network_model
+            rng = np.random.default_rng(seed)
+            dst = rng.integers(0, ranks, size=(ranks, rounds))
+            sizes = rng.choice(np.linspace(2e5, 2e6, 12),
+                               (ranks, rounds))
+            flops = rng.choice(np.linspace(5e5, 5e6, 8),
+                               (ranks, rounds))
+            stage = [0] * ranks
+            tag_of = {}
+            events = []
+
+            def post_next(r):
+                st = stage[r]
+                k = st // 2
+                if k >= rounds:
+                    return
+                if st % 2 == 0:
+                    d = int(dst[r, k])
+                    if d == r:
+                        d = (d + 1) % ranks
+                    a = model.communicate(hosts[r], hosts[d],
+                                          float(sizes[r, k]), -1.0)
+                else:
+                    a = hosts[r].cpu.execution_start(float(flops[r, k]))
+                tag_of[id(a)] = (r, st)
+                stage[r] = st + 1
+
+            for r in range(ranks):
+                post_next(r)
+            pending = mutate
+            for _ in range(200_000):
+                if not any(len(m.started_action_set)
+                           for m in e.pimpl.models):
+                    break
+                if pending is not None and e.pimpl.now > 0.005:
+                    pending(e, model, hosts)
+                    pending = None
+                e.pimpl.surf_solve(-1.0)
+                for m in list(e.pimpl.models):
+                    while True:
+                        done = m.extract_done_action()
+                        if done is None:
+                            break
+                        t = tag_of.pop(id(done), None)
+                        if t is not None:
+                            events.append((done.finish_time, t))
+                            post_next(t[0])
+                        done.unref()
+            return events, e.pimpl.now
+        finally:
+            s4u.Engine._reset()
+
+    base = ["network/optim:Full", "network/maxmin-selective-update:no",
+            "lmm/backend:jax"]
+    fast = base + ["drain/fastpath:auto",
+                   f"drain/min-flows:{min_flows}",
+                   f"drain/superstep:{superstep}"]
+    variants = [("plain", [], None),
+                ("resumable", [], bw_mutation),
+                ("invalidate", [], deadline_mutation)]
+    for depth in depths:
+        if depth:
+            variants.append((f"fleet:d{depth}",
+                             [f"drain/pipeline:{depth}"], bw_mutation))
+
+    problems: List[str] = []
+    for label, extra, mutate in variants:
+        ref = run(base + ["drain/fastpath:off"] + extra, mutate)
+        before = opstats.snapshot()
+        a = run(fast + extra, mutate)
+        d = opstats.diff(before)
+        b = run(fast + extra, mutate)
+        if a != b:
+            problems.append(f"phase:{label}: two identical fast-path "
+                            f"runs diverged ({len(a[0])} vs "
+                            f"{len(b[0])} events)")
+        if a[0] != ref[0] or a[1] != ref[1]:
+            ndiff = sum(1 for x, y in zip(a[0], ref[0]) if x != y)
+            problems.append(
+                f"phase:{label}: fast-path run diverged from the "
+                f"native loop ({len(a[0])} vs {len(ref[0])} events, "
+                f"{ndiff} mismatched pairs, clocks {a[1]!r} vs "
+                f"{ref[1]!r})")
+        if not d.get("fastpath_advances"):
+            problems.append(f"phase:{label}: the device plan never "
+                            f"served an advance (nothing was "
+                            f"actually tested)")
+        if not d.get("drain_transitions"):
+            problems.append(f"phase:{label}: no transition payload was "
+                            f"absorbed — the alternation ran on the "
+                            f"replay fallback only")
+        if label == "invalidate" \
+                and not d.get("drain_cause_unrecognized"):
+            problems.append(
+                "phase:invalidate: the deadline'd flow never forced an "
+                "unrecognized-mutation replay (forcing failed — "
+                "nothing was actually tested)")
+    return problems
+
+
 def quick_checks() -> List[str]:
     """The CI bundle: static lint + small-N instances of every runtime
     check, sized for seconds, so determinism regressions fail pytest
@@ -507,6 +686,8 @@ def quick_checks() -> List[str]:
                                        depths=(1,), batch=4)
     problems += check_shard_runtime(n_c=24, n_v=64, batch=4, k=4,
                                     shards=(2,), depths=(0, 2))
+    problems += check_phase_runtime(ranks=24, rounds=2, min_flows=8,
+                                    superstep=8, depths=(0, 2))
     return problems
 
 
@@ -543,8 +724,22 @@ def main(argv: List[str]) -> int:
                 print(f"  {p}")
             return 1
         print("check_determinism: quick OK (lint + small-N drain + "
-              "batch + pipeline + shard runtime)")
+              "batch + pipeline + shard + phase runtime)")
         return 0
+    if "--runtime-phase" in argv:
+        problems = check_phase_runtime()
+        if problems:
+            print("check_determinism: phase runtime check FAILED:")
+            for p in problems:
+                print(f"  {p}")
+            return 1
+        print("check_determinism: phase runtime OK (device-resident "
+              "mutating phases — compute/comm alternation incl. "
+              "forced resumable (bandwidth change) and non-resumable "
+              "(deadline'd flow) mutations and the pipelined fleet "
+              "variant — bit-identical to the native loop: event "
+              "order, timestamps and clocks)")
+        argv = [a for a in argv if a != "--runtime-phase"]
     if "--runtime-pipeline" in argv:
         problems = check_pipeline_runtime()
         if problems:

@@ -28,8 +28,10 @@ N while the device runs ring N+1 — bit-identical results, and the
 row carries the blocking-fetch split + speculation commit counters).  `--phase-stats` prints, per phase (build/route,
 latency advance, drain), the device dispatch count, uploaded bytes
 split full vs delta (ops.opstats counters fed by _device_args, the
-warm solver and the drain executor) and fixpoint rounds, and appends
-the counters to the labeled bench row.  Rows are labeled with mode/superstep_k/syncs so
+warm solver and the drain executor), fixpoint rounds, and the runtime
+fast-path coverage split (`fastpath_advances` vs `native_advances`
+with the invalidation-cause histogram, for engine-driven runs), and
+appends the counters to the labeled bench row.  Rows are labeled with mode/superstep_k/syncs so
 bench.py reports each shape separately.  Completion grouping is
 RELATIVE (done_eps * size) on every backend, the reference's
 sg_maxmin_precision semantics — the fix for the round-5 f32
@@ -302,7 +304,15 @@ def main() -> None:
         drain_mark = opstats.snapshot()
         keys = ("dispatches", "uploaded_bytes_full",
                 "uploaded_bytes_delta", "fixpoint_rounds",
-                "warm_solves", "cold_solves")
+                "warm_solves", "cold_solves",
+                # fast-path coverage: advances served from the device
+                # plan vs the generic native loop, plus the
+                # invalidation-cause histogram (ops.drain_path)
+                "fastpath_advances", "native_advances",
+                "drain_transitions", "drain_transition_slots",
+                "drain_cause_transition", "drain_cause_partial_advance",
+                "drain_cause_profile_event", "drain_cause_stall",
+                "drain_cause_unrecognized")
         phases = {}
         for name, before, after in (
                 ("build+latency", phase_marks[0], phase_marks[1]),
@@ -312,6 +322,10 @@ def main() -> None:
             print(json.dumps({"phase": name, **phases[name]}),
                   flush=True)
         rec["phase_stats"] = phases
+        fp = phases["drain"].get("fastpath_advances", 0)
+        nat = phases["drain"].get("native_advances", 0)
+        if fp or nat:
+            rec["fastpath_coverage"] = round(fp / max(nat, 1), 3)
     print(json.dumps(rec), flush=True)
 
     if args.events_out:
