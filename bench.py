@@ -418,6 +418,99 @@ def stage_sweep(n_c: int, n_v: int, deg: int, seed: int,
     return out
 
 
+def stage_fault(n_c: int, n_v: int, deg: int, seed: int,
+                replicas: int = 32, superstep: int = 8) -> dict:
+    """Device-resident fault event tapes (the ISSUE-10 trajectory
+    metric): one campaign fleet — half the replicas carrying seeded
+    MTBF/MTTR link-failure schedules — drained once per fault mode:
+    ``off`` (fault dimension ignored: the no-tape baseline the tape
+    rows are compared against), ``static`` (pre-tape time-averaged
+    capacity folding), ``on`` (event tapes: links flip mid-drain at
+    the exact schedule dates) and ``on`` + pipeline depth 2 (tape
+    fires as clean-collect boundaries for the speculative path, the
+    discarded supersteps counted as ``fault_replays``).
+
+    Honest counters per row: compiled tape slots, events that actually
+    FIRED mid-drain, speculative replays, dispatches and wall time per
+    replica.  The ``on`` row also carries a solo spot check (a faulted
+    replica's events, fired faults and Kahan clock bit-identical to
+    its solo drain) and asserts the tape fired at all — a row whose
+    tape never fired measured nothing.
+
+    CPU-measured by design: the contract is the counter structure
+    (fires, replays, dispatch scaling), which is platform-independent;
+    tools own the on-hardware wall-clock story."""
+    _force_cpu()
+    import jax  # noqa: F401  (select backend before importing ops)
+    from simgrid_tpu.parallel.campaign import Campaign, ScenarioSpec
+
+    rng = np.random.default_rng(seed)
+    arrays = build_arrays(rng, n_c, n_v, deg, np.float64)
+    E = arrays.n_elem
+    sizes = rng.choice(np.linspace(1e5, 2e6, 16), n_v)
+    specs = [ScenarioSpec(seed=s,
+                          bw_scale=1.0 + 0.1 * (s % 5),
+                          size_scale=1.0 + 0.05 * (s % 3),
+                          fault_mtbf=400.0 if s % 2 else None,
+                          fault_mttr=50.0, fault_horizon=600.0)
+             for s in range(replicas)]
+
+    rows = []
+    fired = 0
+    variants = [("off", "off", 0), ("static", "static", 0),
+                ("on", "on", 0), ("on-d2", "on", 2)]
+    for label, mode, depth in variants:
+        campaign = Campaign(arrays.e_var[:E], arrays.e_cnst[:E],
+                            arrays.e_w[:E], arrays.c_bound[:n_c],
+                            sizes, specs, eps=1e-9, dtype=np.float64,
+                            superstep=superstep, fault_mode=mode)
+        t0 = time.perf_counter()
+        results, st = campaign.run_scoped(batch=replicas,
+                                          stage=f"fault/{label}",
+                                          pipeline=depth or None)
+        wall = time.perf_counter() - t0
+        row = {"bench": "lmm_fault", "replicas": replicas,
+               "n_c": n_c, "n_v": n_v, "deg": deg, "seed": seed,
+               "superstep": superstep, "fault_mode": mode,
+               "pipeline": depth,
+               "fault_replicas": sum(1 for s in specs
+                                     if s.fault_mtbf is not None),
+               "fault_tape_slots": int(st.get("fault_tape_slots", 0)),
+               "fault_tape_events":
+                   int(st.get("fault_tape_events", 0)),
+               "fault_replays": int(st.get("fault_replays", 0)),
+               "dispatches": int(st.get("dispatches", 0)),
+               "dispatches_per_replica":
+                   round(st.get("dispatches", 0) / replicas, 3),
+               "wall_ms": round(wall * 1e3, 1),
+               "wall_ms_per_replica": round(wall * 1e3 / replicas, 2),
+               "errors": sum(1 for r in results if r.error)}
+        if label == "on":
+            fired = row["fault_tape_events"]
+            j = 1        # first faulted replica (odd seeds)
+            solo = campaign.run_solo(j)
+            row["solo_bit_identical"] = (
+                solo.events == results[j].events
+                and solo.t == results[j].t
+                and solo.fault_events == results[j].fault_events)
+            row["tape_fired"] = fired > 0
+        rows.append(schema_row("fault", row, mode=f"fault-{label}",
+                               batch=replicas, platform="cpu"))
+        log(f"[stage fault] {label}: "
+            f"{row['fault_tape_events']} fires / "
+            f"{row['fault_tape_slots']} slots, "
+            f"{row['fault_replays']} replays, {row['wall_ms']} ms")
+    path = append_rows("lmm_fault.jsonl", rows)
+    log(f"[stage fault] rows appended to {path}")
+    by = {r["fault_mode"] + (f"-d{r['pipeline']}" if r["pipeline"]
+                             else ""): r for r in rows}
+    out = {"rows": rows, "tape_fired": fired > 0}
+    if "off" in by and "on" in by:
+        out["tape_wall_overhead"] = round(
+            by["on"]["wall_ms"] / max(by["off"]["wall_ms"], 1e-9), 2)
+    return out
+
+
 def stage_shard(n_c: int, n_v: int, deg: int, seed: int,
                 per_shard: int = 16, superstep: int = 8,
                 max_mesh: int = 4) -> dict:
@@ -883,6 +976,9 @@ STAGES = {
     "shard": lambda args: stage_shard(args.n_c, args.n_v, args.deg,
                                       args.seed, args.per_shard,
                                       args.superstep, args.mesh),
+    "fault": lambda args: stage_fault(args.n_c, args.n_v, args.deg,
+                                      args.seed, args.replicas,
+                                      args.superstep),
 }
 
 
@@ -1111,6 +1207,16 @@ def main() -> None:
         detail["lmm_phase"] = phase
         if phase.get("coverage_vs_pr6") is not None:
             detail["phase_coverage_vs_pr6"] = phase["coverage_vs_pr6"]
+
+    # --- device fault event tapes (ops.lmm_drain tape=) ----------------
+    # one fleet per fault mode (off / static / tape / tape+pipeline):
+    # fires, speculative replays and per-replica dispatch structure;
+    # rows land in bench_results/lmm_fault.jsonl
+    fault = run_stage("fault", timeout=1800, errors=errors,
+                      n_c=96, n_v=400, deg=3, seed=42, replicas=32,
+                      superstep=8)
+    if fault:
+        detail["lmm_fault"] = fault
 
     # mergeable per-class solve rows for the record (same schema as the
     # churn/sweep files: bench_results/*.jsonl concatenate across PRs)

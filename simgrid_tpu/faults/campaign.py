@@ -71,6 +71,19 @@ class FaultCampaign:
                           dist="weibull", shape=1.5)
         campaign.schedule(engine)     # before or between run() calls
         engine.run()
+
+    A campaign is ONE-SHOT with respect to an engine: :meth:`schedule`
+    (and :meth:`schedule_degrade`) compiles the event stream into
+    Profiles attached to live resources, and attaching the same stream
+    twice would double-fire every event — so a second ``schedule`` call
+    raises.  To drive another engine with the same schedule, or to
+    derive per-replica campaigns for a fleet, use :meth:`fork`: it
+    returns a FRESH campaign with the same resource specs and an
+    optionally offset seed (``fork()`` reproduces this campaign
+    bit-for-bit, ``fork(seed_offset=k)`` is replica k's independent
+    draw).  The pure projections — :meth:`generate`,
+    :meth:`mean_availability`, :meth:`compile_tape` — are repeatable
+    and never consume the one shot.
     """
 
     def __init__(self, seed: int = 0, horizon: float = 1000.0):
@@ -104,6 +117,19 @@ class FaultCampaign:
                  ) -> "FaultCampaign":
         """Declare a link to fail (accepts a Link/LinkImpl or its name)."""
         return self._add("link", link, mtbf, mttr, dist, shape)
+
+    def fork(self, seed_offset: int = 0) -> "FaultCampaign":
+        """A fresh campaign with the same horizon and resource specs and
+        seed ``self.seed + seed_offset`` — the cheap way around the
+        one-shot :meth:`schedule` contract (same seed reproduces the
+        schedule bit-for-bit; distinct offsets give replicas of a fleet
+        independent draws)."""
+        out = FaultCampaign(seed=self.seed + int(seed_offset),
+                            horizon=self.horizon)
+        for (kind, name), spec in self._specs.items():
+            out._add(kind, name, spec.mtbf, spec.mttr, spec.dist,
+                     spec.shape)
+        return out
 
     # -- generation --------------------------------------------------------
     def generate(self) -> Dict[Tuple[str, str], List[Tuple[float, float]]]:
@@ -164,6 +190,36 @@ class FaultCampaign:
             out[key] = 1.0 - down / h
         return out
 
+    def compile_tape(self, floor: float
+                     ) -> List[Tuple[float, str, str, float]]:
+        """Flatten the generated schedule into ONE time-sorted event
+        tape: ``(date, kind, name, factor)`` entries where a failure
+        degrades the resource's capacity to ``floor`` (a fully-dead
+        resource would stall a pure drain, so tapes use the same
+        clamped-degradation semantics as the static
+        :meth:`mean_availability` projection) and a recovery restores
+        ``factor = 1.0``.
+
+        The tape is a pure projection of :meth:`generate`'s cached
+        schedule — the SAME RngStream draws, in the same per-resource
+        substream order — so its event dates are bit-identical to the
+        Profiles :meth:`schedule` compiles for an engine.  Ties sort by
+        the resource key, matching the sorted order ``schedule``
+        attaches profiles in.  Batched campaign drains
+        (:mod:`simgrid_tpu.parallel.campaign`) map these entries to
+        constraint slots and absolute capacity values and upload them
+        as per-lane device event tapes."""
+        floor = float(floor)
+        if not 0.0 < floor <= 1.0:
+            raise ValueError("floor must be in (0, 1]")
+        tape: List[Tuple[float, str, str, float]] = []
+        for (kind, name), points in self.generate().items():
+            for date, value in points:
+                tape.append((date, kind, name,
+                             1.0 if value > 0 else floor))
+        tape.sort(key=lambda e: (e[0], e[1], e[2]))
+        return tape
+
     # -- compilation onto an engine ---------------------------------------
     def schedule(self, engine=None) -> Dict[Tuple[str, str],
                                             List[Tuple[float, float]]]:
@@ -198,3 +254,46 @@ class FaultCampaign:
             target.set_state_profile(profile)
         self._scheduled = True
         return events
+
+    def schedule_degrade(self, engine=None, floor: float = 0.05
+                         ) -> List[Tuple[float, str, str, float]]:
+        """Compile the schedule as BANDWIDTH-degradation Profiles instead
+        of on/off state flips: a failure drops each declared link to
+        ``peak * floor`` and a recovery restores the full peak, exactly
+        the clamped-degradation semantics :meth:`compile_tape` encodes
+        for device tapes.  Links only — a degraded host has no
+        engine-side analogue here, so campaigns with host specs raise.
+        Shares the one-shot contract with :meth:`schedule`.  Returns the
+        compiled tape."""
+        from ..plugins._base import resolve_engine
+        if self._scheduled:
+            raise RuntimeError("This FaultCampaign was already scheduled; "
+                               "build a new campaign (same seed for the "
+                               "same schedule) to drive another engine")
+        impl = resolve_engine(engine)
+        assert impl is not None, "No engine: create s4u.Engine first"
+        tape = self.compile_tape(floor)
+        by_link: Dict[str, List[Tuple[float, float]]] = {}
+        for date, kind, name, factor in tape:
+            if kind != "link":
+                raise RuntimeError(
+                    f"schedule_degrade only supports links, campaign "
+                    f"declares {kind} '{name}'")
+            by_link.setdefault(name, []).append((date, factor))
+        for name in sorted(by_link):
+            target = impl.links.get(name)
+            assert target is not None, f"Link '{name}' not found"
+            if target.bandwidth_event is not None:
+                raise RuntimeError(
+                    f"link '{name}' already has a bandwidth profile; "
+                    "campaign events would be mistaken for its events")
+            peak = target.bandwidth_peak
+            points = [(date, peak * factor)
+                      for date, factor in by_link[name]]
+            if not points:
+                continue
+            profile = Profile.from_dated_values(
+                f"__fault_bw_link_{name}", points)
+            target.set_bandwidth_profile(profile)
+        self._scheduled = True
+        return tape

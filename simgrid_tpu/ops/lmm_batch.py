@@ -272,30 +272,39 @@ def _materialize(base_cb, base_sizes, base_rem, base_pen,
 
 @functools.partial(jax.jit,
                    static_argnames=("eps", "n_c", "n_v", "k_max",
-                                    "group", "has_bounds", "batch_w"))
+                                    "group", "has_bounds", "batch_w",
+                                    "has_tape"))
 def _batch_superstep(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
                      thresh, ids, alive, k, round_budget, zero_bits,
+                     tape_t, tape_slot, tape_val, tape_pos, t0,
                      eps: float, n_c: int, n_v: int, k_max: int,
                      group: int, has_bounds: bool = False,
-                     batch_w: bool = False):
+                     batch_w: bool = False, has_tape: bool = False):
     """One fleet superstep: the solo superstep program vmapped over the
     replica axis.  A dead lane (alive=False) gets k=0, so its outer
     while_loop cond is false on entry and the vmap batching rule
     freezes its state — finished/diverged replicas cost nothing but
-    masked lanes, and their state is returned unchanged bit-for-bit."""
+    masked lanes, and their state is returned unchanged bit-for-bit.
+
+    With ``has_tape`` each lane additionally carries its own fault
+    event tape ([B, T] dates/slots/values, inf-padded), tape cursor and
+    f64 base clock — sharded shard-local like every other [B, ·]
+    payload, so a lane's fires never cross device boundaries."""
     k = jnp.asarray(k, jnp.int32)
 
-    def lane(cb, pen_l, rem_l, th_l, alive_l, ew_l):
+    def lane(cb, pen_l, rem_l, th_l, alive_l, tt_l, ts_l, tv_l, tp_l,
+             t0_l, ew_l):
         k_l = jnp.where(alive_l, k, jnp.int32(0))
         return _superstep_program(
             e_var, e_cnst, ew_l, cb, v_bound, pen_l, rem_l, th_l, ids,
             k_l, jnp.asarray(round_budget, jnp.int32), jnp.int32(0),
-            zero_bits, eps=eps, n_c=n_c, n_v=n_v, k_max=k_max,
-            group=group, has_bounds=has_bounds)
+            zero_bits, tt_l, ts_l, tv_l, tp_l, t0_l,
+            eps=eps, n_c=n_c, n_v=n_v, k_max=k_max,
+            group=group, has_bounds=has_bounds, has_tape=has_tape)
 
-    return jax.vmap(lane, in_axes=(0, 0, 0, 0, 0,
-                                   0 if batch_w else None))(
-        c_bound, pen, rem, thresh, alive, e_w)
+    return jax.vmap(lane, in_axes=(0,) * 10 + (0 if batch_w else None,))(
+        c_bound, pen, rem, thresh, alive, tape_t, tape_slot, tape_val,
+        tape_pos, t0, e_w)
 
 
 def _batch_fused_lane(e_var, e_cnst, ew_l, cb, v_bound, pen_l, rem_l,
@@ -495,10 +504,13 @@ class FleetToken:
     snapshot; discarding an un-collected token is O(1)."""
 
     __slots__ = ("pen_in", "rem_in", "pen_out", "rem_out", "packed",
-                 "k", "alive", "speculative")
+                 "k", "alive", "speculative",
+                 "cb_in", "cb_out", "tpos_out", "t0_in", "t0_out")
 
     def __init__(self, pen_in, rem_in, pen_out, rem_out, packed,
-                 k: int, alive, speculative: bool):
+                 k: int, alive, speculative: bool,
+                 cb_in=None, cb_out=None, tpos_out=None,
+                 t0_in=None, t0_out=None):
         self.pen_in = pen_in
         self.rem_in = rem_in
         self.pen_out = pen_out
@@ -507,16 +519,27 @@ class FleetToken:
         self.k = k
         self.alive = alive
         self.speculative = speculative
+        # fault-tape double buffers (see SuperstepToken): per-lane
+        # bounds in/out, post-dispatch tape cursors, and the [B] f64
+        # base clocks this dispatch started from / left behind
+        self.cb_in = cb_in
+        self.cb_out = cb_out
+        self.tpos_out = tpos_out
+        self.t0_in = t0_in
+        self.t0_out = t0_out
 
 
 class ReplicaState:
     """Host-side record of one replica in a fleet."""
 
-    __slots__ = ("index", "events", "t", "advances", "alive", "error")
+    __slots__ = ("index", "events", "fault_events", "t", "advances",
+                 "alive", "error")
 
     def __init__(self, index: int):
         self.index = index
         self.events: List[Tuple[float, int]] = []
+        #: (time, constraint slot) per fired tape entry, fire order
+        self.fault_events: List[Tuple[float, int]] = []
         self.t = 0.0              # f64 master clock (host-accumulated)
         self.advances = 0
         self.alive = True
@@ -569,7 +592,7 @@ class BatchDrainSim:
                  dtype=np.float64, done_mode: str = "rel",
                  superstep: int = 8, superstep_rounds: int = 0,
                  device=None, v_bound=None, penalty=None, remains=None,
-                 pipeline: int = 0, mesh=None):
+                 pipeline: int = 0, mesh=None, tapes=None):
         if not overrides:
             raise ValueError("BatchDrainSim needs at least one replica")
         if done_mode not in ("rel", "abs"):
@@ -682,6 +705,65 @@ class BatchDrainSim:
         self._rem = self._pin(rem64.astype(self.dtype))
         self._thresh = self._pin(thresh64.astype(self.dtype))
 
+        # per-replica fault event tapes: `tapes` is one (dates, slots,
+        # values) triple — or None — per replica (see DrainSim's tape=;
+        # identical semantics per lane).  Packed to [B_padded, T] with
+        # inf date padding (a padded entry can never fire) and sharded
+        # shard-local like every other per-replica payload.
+        self.has_tape = False
+        self._last_fired = False
+        if tapes is not None and any(
+                t is not None and len(t[0]) for t in tapes):
+            if len(tapes) != self.B:
+                raise ValueError(f"tapes must have one entry per "
+                                 f"replica ({len(tapes)} != {self.B})")
+            tapes = list(tapes) + [None] * (self.B_padded - self.B)
+            T = max(len(t[0]) for t in tapes if t is not None)
+            tt = np.full((self.B_padded, T), np.inf, np.float64)
+            ts = np.full((self.B_padded, T), self.n_c, np.int32)
+            tv = np.zeros((self.B_padded, T), np.float64)
+            n_slots = 0
+            for b, t in enumerate(tapes):
+                if t is None or not len(t[0]):
+                    continue
+                dates = np.asarray(t[0], np.float64)
+                slots = np.asarray(t[1], np.int32)
+                vals = np.asarray(t[2], np.float64)
+                if not (len(dates) == len(slots) == len(vals)):
+                    raise ValueError(
+                        f"replica {b}: tape arrays must have equal "
+                        f"length")
+                if np.any(np.diff(dates) < 0):
+                    raise ValueError(
+                        f"replica {b}: tape dates must be time-sorted")
+                if np.any((slots < 0) | (slots >= self.n_c)):
+                    raise ValueError(f"replica {b}: tape slot out of "
+                                     f"range")
+                n = len(dates)
+                tt[b, :n] = dates
+                ts[b, :n] = slots
+                tv[b, :n] = vals
+                n_slots += n
+            # same f64 -> dtype cast order as the solo DrainSim tape
+            tvd = tv.astype(self.dtype)
+            self.has_tape = True
+            self._tape = (self._put_batched(tt), self._put_batched(ts),
+                          self._put_batched(tvd))
+            opstats.bump("fault_tape_slots", n_slots)
+            opstats.bump("uploaded_bytes_delta",
+                         tt.nbytes + ts.nbytes + tvd.nbytes)
+        else:
+            # dummy [B, 1] triple keeps the jit call sites uniform;
+            # DCE'd when has_tape=False
+            self._tape = (
+                self._put_batched(np.full((self.B_padded, 1), np.inf)),
+                self._put_batched(np.full((self.B_padded, 1), self.n_c,
+                                          np.int32)),
+                self._put_batched(np.zeros((self.B_padded, 1),
+                                           self.dtype)))
+        self._tpos = self._put_batched(
+            np.zeros(self.B_padded, np.int32))
+
         self.replicas = [ReplicaState(b) for b in range(self.B)]
         self._alive = np.zeros(self.B_padded, bool)
         self._alive[:self.B] = True
@@ -752,27 +834,52 @@ class BatchDrainSim:
         return np.concatenate(fetched, axis=0)
 
     def _superstep_issue_all(self, k: Optional[int] = None, pen=None,
-                             rem=None, speculative: bool = False
-                             ) -> "FleetToken":
+                             rem=None, speculative: bool = False,
+                             alive=None, cb=None, tpos=None, t0=None,
+                             round_budget: int = 0) -> "FleetToken":
         """Dispatch ONE fleet superstep without touching the committed
         state: chains from `(pen, rem)` (default: committed) under the
-        CURRENT alive mask; inputs/outputs ride the returned token
+        CURRENT alive mask (or an explicit `alive` restriction — the
+        tape-aware rescue); inputs/outputs ride the returned token
         (see ops.lmm_drain — same issue/collect speculation protocol,
-        one [B, ·] ring per token)."""
+        one [B, ·] ring per token).  With a fault tape the dispatch
+        chains per-lane bounds/cursors (`cb`, `tpos`) and [B] f64 base
+        clocks `t0` (default: the committed replica clocks)."""
         k_max = self.superstep_k
         k = k_max if k is None else min(int(k), k_max)
+        budget = int(round_budget) or self.superstep_rounds
         group = _pos_group(self.n_v)
-        alive = self._alive.copy()
+        alive = (self._alive.copy() if alive is None
+                 else np.asarray(alive, bool).copy())
         pen_in = self._pen if pen is None else pen
         rem_in = self._rem if rem is None else rem
-        pen_out, rem_out, packed = _batch_superstep(
-            *self._dev, self._cb, self._vb, pen_in, rem_in,
+        cb_in = self._cb if cb is None else cb
+        tpos_in = self._tpos if tpos is None else tpos
+        if t0 is None:
+            # the committed host clocks ARE the lanes' f64 base clocks
+            # (padded lanes never advance, 0.0 is fine)
+            t0_in = np.zeros(self.B_padded, np.float64)
+            for b, rep in enumerate(self.replicas):
+                t0_in[b] = rep.t
+            t0_in = self._put_batched(t0_in)
+        else:
+            t0_in = t0
+        pen_out, rem_out, cb_out, tpos_out, packed = _batch_superstep(
+            *self._dev, cb_in, self._vb, pen_in, rem_in,
             self._thresh, self._ids_dev,
             self._put_mask(alive), np.int32(k),
-            np.int32(self.superstep_rounds), _ZERO_BITS,
+            np.int32(budget), _ZERO_BITS,
+            *self._tape, tpos_in, t0_in,
             eps=self.eps, n_c=self.n_c, n_v=self.n_v, k_max=k_max,
             group=group, has_bounds=self.has_bounds,
-            batch_w=self.batch_w)
+            batch_w=self.batch_w, has_tape=self.has_tape)
+        t0_out = None
+        if self.has_tape:
+            # derive the post-dispatch base clocks DEVICE-side with the
+            # exact f64 add the host collect performs (rep.t = t0 +
+            # t_sum), so a chained speculative issue is bit-identical
+            # to a fresh issue from the committed clocks
+            t0_out = t0_in + packed[:, 3].astype(jnp.float64)
         self.supersteps += 1
         opstats.bump("dispatches")
         opstats.bump("batch_dispatches")
@@ -780,7 +887,9 @@ class BatchDrainSim:
             self.spec_issued += 1
             opstats.bump("speculations_issued")
         return FleetToken(pen_in, rem_in, pen_out, rem_out, packed,
-                          k, alive, speculative)
+                          k, alive, speculative,
+                          cb_in=cb_in, cb_out=cb_out, tpos_out=tpos_out,
+                          t0_in=t0_in, t0_out=t0_out)
 
     def _discard_token(self, tok: "FleetToken") -> None:
         """Drop an un-collected speculative fleet superstep (the alive
@@ -789,21 +898,31 @@ class BatchDrainSim:
         self.spec_rolled_back += 1
         opstats.bump("speculations_rolled_back")
 
-    def _superstep_collect_all(self, tok: "FleetToken"
+    def _superstep_collect_all(self, tok: "FleetToken",
+                               rescue: bool = False
                                ) -> Tuple[int, bool]:
         """Commit one issued fleet superstep: adopt its output arrays,
         fetch its [B, ·] packed rings (ONE transfer) and demultiplex
         per-replica events/clocks on the host.  Returns
         ``(n_alive, clean)`` — clean False when processing this ring
-        mutated the fleet (a lane died or needed the fused rescue), so
-        in-flight speculative successors must be discarded."""
+        mutated the fleet (a lane died, a tape event fired, or a
+        rescue ran), so in-flight speculative successors must be
+        discarded.  With ``rescue=True`` (the tape-aware rescue's own
+        collect — the dispatch already ran with the FULL round budget)
+        still-stuck lanes are converted to non-convergence deaths
+        instead of re-rescued."""
         self._pen, self._rem = tok.pen_out, tok.rem_out
+        if self.has_tape:
+            self._cb = tok.cb_out
+            self._tpos = tok.tpos_out
         k_max = self.superstep_k
         p = self._fetch(tok.packed)
         n_v = self.n_v
+        ring_n = n_v + k_max if self.has_tape else n_v
         o = 7
         stuck: List[int] = []
         deaths = 0
+        fired = 0
         for b in range(self.B):
             if not tok.alive[b]:
                 continue
@@ -812,16 +931,28 @@ class BatchDrainSim:
             rounds, adv, n_ev = int(row[0]), int(row[1]), int(row[2])
             t_sum = float(row[3])
             n_live, flag = int(row[4]), int(row[5])
-            ring_t = row[o + 2 * k_max:o + 2 * k_max + n_v]
-            ring_id = row[o + 2 * k_max + n_v:
-                          o + 2 * k_max + 2 * n_v].astype(np.int64)
+            ring_t = row[o + 2 * k_max:o + 2 * k_max + ring_n]
+            ring_id = row[o + 2 * k_max + ring_n:
+                          o + 2 * k_max + 2 * ring_n].astype(np.int64)
             self.rounds += rounds
             opstats.bump("fixpoint_rounds", rounds)
             rep.advances += adv
             t_base = rep.t
-            for j in range(n_ev):
-                rep.events.append((t_base + float(ring_t[j]),
-                                   int(ring_id[j])))
+            if self.has_tape:
+                # demux: negative ids are tape fires (slot -(1+id)) —
+                # fault stream, not completion stream (see DrainSim)
+                for j in range(n_ev):
+                    fid = int(ring_id[j])
+                    tj = t_base + float(ring_t[j])
+                    if fid < 0:
+                        rep.fault_events.append((tj, -fid - 1))
+                        fired += 1
+                    else:
+                        rep.events.append((tj, fid))
+            else:
+                for j in range(n_ev):
+                    rep.events.append((t_base + float(ring_t[j]),
+                                       int(ring_id[j])))
             rep.t = t_base + t_sum
             if flag == _FLAG_STALLED:
                 rep.error = (f"drain stalled: no flow holds bandwidth "
@@ -834,7 +965,16 @@ class BatchDrainSim:
                 self._alive[b] = False
                 deaths += 1
             elif flag == _FLAG_BUDGET and adv == 0:
-                stuck.append(b)
+                if rescue:
+                    rep.error = "drain solve did not converge"
+                    rep.alive = False
+                    self._alive[b] = False
+                    deaths += 1
+                else:
+                    stuck.append(b)
+        self._last_fired = fired > 0
+        if fired:
+            opstats.bump("fault_tape_events", fired)
         if self.B_padded != self.B:
             # ragged-fleet guard: padded lanes are dead from birth
             # (k=0, state frozen), so any event they log would be a
@@ -848,14 +988,19 @@ class BatchDrainSim:
                     f"event(s) — the frozen-lane invariant is broken")
         if stuck:
             # the round budget expired inside a replica's FIRST solve:
-            # finish exactly one advance for those lanes via the
+            # finish exactly one advance for those lanes.  Tape-armed
+            # fleets must stay on the superstep path (the fused rescue
+            # is tape-blind and would step over events); otherwise the
             # chunked fused program (converges across dispatches), the
-            # batched mirror of the solo run() rescue
-            self._rescue_fused(stuck)
+            # batched mirror of the solo run() rescue.
+            if self.has_tape:
+                self._rescue_superstep(stuck)
+            else:
+                self._rescue_fused(stuck)
         if tok.speculative:
             self.spec_committed += 1
             opstats.bump("speculations_committed")
-        clean = not deaths and not stuck
+        clean = not deaths and not stuck and not fired
         return int(self._alive.sum()), clean
 
     def superstep_all(self, k: Optional[int] = None) -> int:
@@ -933,6 +1078,20 @@ class BatchDrainSim:
         self._pen = self._pin(self._pen)
         self._rem = self._pin(self._rem)
 
+    def _rescue_superstep(self, stuck: List[int]) -> None:
+        """The tape-aware budget rescue: re-dispatch the stuck lanes
+        only (restricted alive mask — every other lane runs k=0 and is
+        frozen bit-for-bit) for ONE advance with the FULL round budget.
+        Collecting with rescue=True converts lanes that still cannot
+        converge into non-convergence deaths, the fleet mirror of the
+        solo tape rescue raising "did not converge"."""
+        self.rescues += 1
+        restricted = np.zeros(self.B_padded, bool)
+        restricted[stuck] = True
+        tok = self._superstep_issue_all(k=1, alive=restricted,
+                                        round_budget=_MAX_ROUNDS)
+        self._superstep_collect_all(tok, rescue=True)
+
     def _run_pipelined(self, max_supersteps: int) -> None:
         """The speculative fleet driver: up to ``self.pipeline``
         supersteps in flight beyond the one being collected, FIFO
@@ -951,15 +1110,26 @@ class BatchDrainSim:
                        or (len(inflight) <= self.pipeline
                            and len(inflight) < left)):
                     spec = bool(inflight)
-                    pen, rem = ((inflight[-1].pen_out,
-                                 inflight[-1].rem_out)
-                                if inflight else (None, None))
+                    if inflight:
+                        prev = inflight[-1]
+                        pen, rem = prev.pen_out, prev.rem_out
+                        cb, tpos, t0 = (
+                            (prev.cb_out, prev.tpos_out, prev.t0_out)
+                            if self.has_tape else (None, None, None))
+                    else:
+                        pen = rem = cb = tpos = t0 = None
                     inflight.append(self._superstep_issue_all(
-                        pen=pen, rem=rem, speculative=spec))
+                        pen=pen, rem=rem, speculative=spec,
+                        cb=cb, tpos=tpos, t0=t0))
                 tok = inflight.popleft()
                 _n_alive, clean = self._superstep_collect_all(tok)
                 left -= 1
                 if not clean:
+                    # a lane death/rescue invalidated the in-flight
+                    # alive masks, or a tape fire ended the clean
+                    # window — discard and replay from committed state
+                    if self.has_tape and self._last_fired and inflight:
+                        opstats.bump("fault_replays", len(inflight))
                     while inflight:
                         self._discard_token(inflight.popleft())
         finally:

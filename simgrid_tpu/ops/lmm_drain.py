@@ -246,14 +246,17 @@ _FLAG_BUDGET = 2      # solve hit the round budget mid-superstep
 
 def _superstep_program(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
                        thresh, ids, k, round_budget, stop_live, zero_bits,
+                       tape_t, tape_slot, tape_val, tape_pos, t0,
                        eps: float, n_c: int, n_v: int, k_max: int,
-                       group: int, has_bounds: bool = False):
+                       group: int, has_bounds: bool = False,
+                       has_tape: bool = False):
     """Up to `k` (<= k_max) full advances in ONE dispatch: an outer
     lax.while_loop of (fixpoint to convergence -> dt -> retire), with
     completions logged into a device ring buffer and the clock carried
-    as a compensated (Kahan) pair.  Returns the new flow state plus one
-    packed vector (stats + per-advance dt/event-count tables + ring) so
-    the host pays a single transfer per superstep.
+    as a compensated (Kahan) pair.  Returns the new flow state, the
+    (possibly fault-mutated) constraint bounds and tape cursor, plus
+    one packed vector (stats + per-advance dt/event-count tables +
+    ring) so the host pays a single transfer per superstep.
 
     `k`, `round_budget` and `stop_live` are TRACED (dynamic) so replay
     (re-running a prefix of a batch deterministically) and budget
@@ -261,6 +264,26 @@ def _superstep_program(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
     capacity.  The round budget bounds total device rounds per dispatch
     — the axon watchdog kills long kernels, so the budget, not k, is
     the hard safety bound (reusing the _CHUNK_ROUNDS_ACCEL reasoning).
+
+    ``has_tape`` arms the FAULT EVENT TAPE: ``(tape_t, tape_slot,
+    tape_val)`` is a time-sorted schedule of constraint-capacity
+    flips (absolute f64 sim dates / constraint slots / new absolute
+    bounds) and ``tape_pos`` the cursor of the first un-fired entry.
+    Between the solve and the retire of every advance the loop peeks
+    the next tape date against the absolute clock ``t0 + t_sum`` (both
+    f64, so the comparison never loses to f32 clock granularity): if
+    the planned dt would step over it, dt is CLAMPED to land exactly on
+    the event, the new bound is scattered into ``c_bound`` (carried in
+    the loop state, so the next iteration's fixpoint sees it — the
+    device analogue of a Profile event invalidating the solver), a
+    TAGGED entry ``id = -(1 + slot)`` is logged in the ring at the
+    event time, and the cursor advances.  A fire consumes an advance
+    slot, which bounds fires per dispatch by k_max — the ring is
+    therefore oversized to ``n_v + k_max``.  A fire also rescues a
+    stalled plan (dt = inf with a pending tape date is a wake-up, not
+    a stall), mirroring how a Profile event re-arms an idle engine.
+    With ``has_tape=False`` the tape arguments are ignored and the
+    loop state/HLO are exactly the legacy 12-tuple.
     """
     dtype = e_w.dtype
     fat = jnp.zeros(n_c, bool)
@@ -268,6 +291,12 @@ def _superstep_program(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
     k = jnp.asarray(k, jnp.int32)
     round_budget = jnp.asarray(round_budget, jnp.int32)
     stop_live = jnp.asarray(stop_live, jnp.int32)
+    # completions scatter to [0, n_ev); the out-of-range sentinel and
+    # the ring capacity grow by k_max when faults may interleave
+    ring_n = n_v + k_max if has_tape else n_v
+    if has_tape:
+        T = tape_t.shape[0]
+        t0 = jnp.asarray(t0, jnp.float64)
 
     def cond(st):
         pen_c = st[0]
@@ -277,9 +306,14 @@ def _superstep_program(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
                 & (n_live > stop_live))
 
     def body(st):
-        (pen_c, rem_c, t_sum, t_comp, ring_t, ring_id, adv_dt, adv_nev,
-         n_ev, adv, rounds, flag) = st
-        out = fixpoint(e_var, e_cnst, e_w, c_bound, fat, pen_c, v_bound,
+        if has_tape:
+            (pen_c, rem_c, t_sum, t_comp, ring_t, ring_id, adv_dt,
+             adv_nev, n_ev, adv, rounds, flag, cb_c, tpos) = st
+        else:
+            (pen_c, rem_c, t_sum, t_comp, ring_t, ring_id, adv_dt,
+             adv_nev, n_ev, adv, rounds, flag) = st
+            cb_c = c_bound
+        out = fixpoint(e_var, e_cnst, e_w, cb_c, fat, pen_c, v_bound,
                        eps_c, n_c, n_v, parallel_rounds=True,
                        carry=None, max_rounds=round_budget - rounds,
                        return_carry=True, has_bounds=has_bounds,
@@ -287,8 +321,33 @@ def _superstep_program(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
         carry2 = out[4]
         r = out[3].astype(jnp.int32)
         converged = jnp.count_nonzero(carry2[4]) == 0
-        dt, pen2, rem2, done = _advance_math(pen_c, rem_c, thresh,
-                                             carry2[0], zero_bits)
+        if has_tape:
+            # planned dt (the _advance_math front half), then the tape
+            # peek: fire iff the next event lands inside this advance
+            # (ties go to the event, and a pending event rescues an
+            # infinite dt).  Clock math in f64: t0 and the tape dates
+            # are f64, so event placement is exact even on f32 drains.
+            live = pen_c > 0
+            rate = jnp.where(live, carry2[0], 0.0)
+            flowing = live & (rate > 0)
+            dt_plan = jnp.min(jnp.where(
+                flowing, rem_c / jnp.where(flowing, rate, 1.0), jnp.inf))
+            ti = jnp.minimum(tpos, T - 1)
+            next_t = jnp.where(tpos < T, tape_t[ti], jnp.inf)
+            now = t0 + t_sum.astype(jnp.float64)
+            fire = jnp.isfinite(next_t) & (
+                next_t <= now + dt_plan.astype(jnp.float64))
+            dt = jnp.where(
+                fire, jnp.maximum(next_t - now, 0.0).astype(dtype),
+                dt_plan)
+            prod = _rounded_product(rate, dt, zero_bits)
+            rem2 = jnp.where(flowing, rem_c - prod, rem_c)
+            done = flowing & (rem2 < thresh)
+            pen2 = jnp.where(done, 0.0, pen_c)
+            rem2 = jnp.where(done, 0.0, rem2)
+        else:
+            dt, pen2, rem2, done = _advance_math(pen_c, rem_c, thresh,
+                                                 carry2[0], zero_bits)
         ok = converged & jnp.isfinite(dt)
 
         # Kahan clock: per-advance dts combine compensated so the f32
@@ -302,7 +361,7 @@ def _superstep_program(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
         # scatter out-of-range and are dropped.  2D index shape keeps
         # the axon scatter fast path.
         dcount = jnp.cumsum(done.astype(jnp.int32))
-        pos = jnp.where(done, n_ev + dcount - 1, n_v)
+        pos = jnp.where(done, n_ev + dcount - 1, ring_n)
         pos2 = pos.reshape(-1, group)
         ring_t2 = ring_t.at[pos2].set(
             jnp.broadcast_to(t_new, pos2.shape), mode="drop")
@@ -310,31 +369,58 @@ def _superstep_program(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
                                         mode="drop")
         n_done = dcount[-1]
 
+        if has_tape:
+            # the fault fires AFTER this advance's completions (they
+            # retire AT the event date; the new capacity governs from
+            # the event onward): tagged ring entry, bound scatter, and
+            # cursor bump — all dropped when not firing
+            slot = tape_slot[ti]
+            fpos = jnp.where(fire, n_ev + n_done, ring_n)
+            ring_t2 = ring_t2.at[fpos].set(t_new, mode="drop")
+            ring_id2 = ring_id2.at[fpos].set(-(1 + slot), mode="drop")
+            n_new = n_ev + n_done + fire.astype(jnp.int32)
+            cb2 = cb_c.at[jnp.where(fire, slot, n_c)].set(
+                tape_val[ti], mode="drop")
+            tpos2 = tpos + (ok & fire).astype(jnp.int32)
+        else:
+            n_new = n_ev + n_done
+
         adv_dt2 = adv_dt.at[adv].set(dt.astype(dtype))
-        adv_nev2 = adv_nev.at[adv].set(n_ev + n_done)
+        adv_nev2 = adv_nev.at[adv].set(n_new)
 
         flag2 = jnp.where(~converged, _FLAG_BUDGET,
                           jnp.where(jnp.isfinite(dt), _FLAG_OK,
                                     _FLAG_STALLED)).astype(jnp.int32)
 
         sel = lambda a, b: jnp.where(ok, a, b)
-        return (sel(pen2, pen_c), sel(rem2, rem_c),
-                sel(t_new, t_sum), sel(t_comp2, t_comp),
-                jnp.where(ok, ring_t2, ring_t),
-                jnp.where(ok, ring_id2, ring_id),
-                jnp.where(ok, adv_dt2, adv_dt),
-                jnp.where(ok, adv_nev2, adv_nev),
-                sel(n_ev + n_done, n_ev),
-                adv + ok.astype(jnp.int32), rounds + r, flag2)
+        out_st = (sel(pen2, pen_c), sel(rem2, rem_c),
+                  sel(t_new, t_sum), sel(t_comp2, t_comp),
+                  jnp.where(ok, ring_t2, ring_t),
+                  jnp.where(ok, ring_id2, ring_id),
+                  jnp.where(ok, adv_dt2, adv_dt),
+                  jnp.where(ok, adv_nev2, adv_nev),
+                  sel(n_new, n_ev),
+                  adv + ok.astype(jnp.int32), rounds + r, flag2)
+        if has_tape:
+            out_st = out_st + (jnp.where(ok, cb2, cb_c),
+                               jnp.where(ok, tpos2, tpos))
+        return out_st
 
     zero = jnp.asarray(0, jnp.int32)
     st0 = (pen, rem, jnp.asarray(0.0, dtype), jnp.asarray(0.0, dtype),
-           jnp.zeros(n_v, dtype), jnp.zeros(n_v, jnp.int32),
+           jnp.zeros(ring_n, dtype), jnp.zeros(ring_n, jnp.int32),
            jnp.zeros(k_max, dtype), jnp.zeros(k_max, jnp.int32),
            zero, zero, zero, zero)
+    if has_tape:
+        st0 = st0 + (c_bound, jnp.asarray(tape_pos, jnp.int32))
     st = lax.while_loop(cond, body, st0)
     (pen_o, rem_o, t_sum, _t_comp, ring_t, ring_id, adv_dt, adv_nev,
-     n_ev, adv, rounds, flag) = st
+     n_ev, adv, rounds, flag) = st[:12]
+    if has_tape:
+        cb_o, tpos_o = st[12], st[13]
+    else:
+        cb_o = c_bound
+        tpos_o = jnp.asarray(tape_pos, jnp.int32)
     n_live = jnp.count_nonzero(pen_o > 0)
     live_elems = jnp.count_nonzero(
         (e_w > 0) & jnp.take(pen_o > 0, e_var, fill_value=False))
@@ -344,12 +430,13 @@ def _superstep_program(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
                        live_elems.astype(dtype)])
     packed = jnp.concatenate([stats, adv_dt, adv_nev.astype(dtype),
                               ring_t, ring_id.astype(dtype)])
-    return pen_o, rem_o, packed
+    return pen_o, rem_o, cb_o, tpos_o, packed
 
 
 _drain_superstep = functools.partial(
-    jax.jit, static_argnames=("eps", "n_c", "n_v", "k_max",
-                              "group", "has_bounds"))(_superstep_program)
+    jax.jit, static_argnames=("eps", "n_c", "n_v", "k_max", "group",
+                              "has_bounds",
+                              "has_tape"))(_superstep_program)
 
 
 #: transition-payload field order (index = the static target code in
@@ -466,10 +553,12 @@ class SuperstepToken:
     but the device work it already burned."""
 
     __slots__ = ("pen_in", "rem_in", "pen_out", "rem_out", "packed",
-                 "k", "k_max", "want_stop", "speculative")
+                 "k", "k_max", "want_stop", "speculative",
+                 "cb_in", "cb_out", "tpos_out", "t0")
 
     def __init__(self, pen_in, rem_in, pen_out, rem_out, packed,
-                 k: int, k_max: int, want_stop: int, speculative: bool):
+                 k: int, k_max: int, want_stop: int, speculative: bool,
+                 cb_in=None, cb_out=None, tpos_out=None, t0=None):
         self.pen_in = pen_in
         self.rem_in = rem_in
         self.pen_out = pen_out
@@ -479,6 +568,13 @@ class SuperstepToken:
         self.k_max = k_max
         self.want_stop = want_stop
         self.speculative = speculative
+        # fault-tape double buffers: the dispatch's input/output bounds
+        # and the post-dispatch tape cursor + the dispatch's f64 base
+        # clock (what chained speculative issues derive their t0 from)
+        self.cb_in = cb_in
+        self.cb_out = cb_out
+        self.tpos_out = tpos_out
+        self.t0 = t0
 
 
 class DrainSim:
@@ -518,7 +614,8 @@ class DrainSim:
                  v_bound=None, done_mode: str = "rel",
                  fused: bool = False, superstep: int = 0,
                  superstep_rounds: int = 0, repack_min: int = 1024,
-                 penalty=None, remains=None, pipeline: int = 0):
+                 penalty=None, remains=None, pipeline: int = 0,
+                 tape=None):
         self.eps = float(eps)
         self.done_eps = float(done_eps)
         if done_mode not in ("rel", "abs"):
@@ -602,6 +699,47 @@ class DrainSim:
             vb = np.full(self.n_v, -1.0, self.dtype)
             self.has_bounds = False
         self._vb = jax.device_put(vb, device)
+
+        # fault event tape: `tape` is (dates, slots, values) — f64
+        # absolute sim dates (sorted), constraint slots, and the
+        # ABSOLUTE new capacity each event installs (mirroring the
+        # engine's set_bandwidth semantics, so a recovery restores the
+        # exact pre-fault bound).  Device-resident; the superstep loop
+        # clamps dt so no advance steps over an entry (see
+        # _superstep_program).
+        self.has_tape = False
+        self.fault_events: list = []     # (time, constraint slot)
+        self._tpos_host = 0              # fired-entry count (host view)
+        self._last_fired = False
+        if tape is not None and len(tape[0]):
+            tt = np.asarray(tape[0], np.float64)
+            ts = np.asarray(tape[1], np.int32)
+            tv = np.asarray(tape[2], np.float64).astype(self.dtype)
+            if not (len(tt) == len(ts) == len(tv)):
+                raise ValueError("tape arrays must have equal length")
+            if np.any(np.diff(tt) < 0):
+                raise ValueError("tape dates must be time-sorted")
+            if np.any((ts < 0) | (ts >= self.n_c)):
+                raise ValueError("tape slot out of range")
+            if not superstep:
+                raise ValueError("tape= needs superstep=K (faults fire "
+                                 "inside the superstep loop)")
+            self.has_tape = True
+            self._tape = tuple(jax.device_put(a, device)
+                               for a in (tt, ts, tv))
+            self._tpos = jax.device_put(np.int32(0), device)
+            opstats.bump("fault_tape_slots", len(tt))
+            opstats.bump("uploaded_bytes_delta",
+                         tt.nbytes + ts.nbytes + tv.nbytes)
+        else:
+            # dummy triple keeps the jit call sites uniform; with
+            # has_tape=False the program never reads it (XLA DCE)
+            self._tape = (
+                jax.device_put(np.full(1, np.inf), device),
+                jax.device_put(np.full(1, self.n_c, np.int32), device),
+                jax.device_put(np.zeros(1, self.dtype), device))
+            self._tpos = np.int32(0)
+
         opstats.bump("uploaded_bytes_full",
                      pen0.nbytes + rem0.nbytes + thresh.nbytes
                      + self._ids_dev.nbytes + self._cb.nbytes + vb.nbytes
@@ -898,12 +1036,20 @@ class DrainSim:
 
     def _superstep_issue(self, k: Optional[int] = None, pen=None,
                          rem=None, speculative: bool = False,
-                         stop_live: int = 0) -> SuperstepToken:
+                         stop_live: int = 0, cb=None, tpos=None,
+                         t0=None, round_budget: int = 0
+                         ) -> SuperstepToken:
         """Dispatch ONE superstep of up to `k` advances WITHOUT
         touching the committed flow state: the dispatch chains from
         `(pen, rem)` (default: the committed state) and its outputs
         ride the returned token.  Pure host-side except the async
-        dispatch itself, so speculative issues are free to discard."""
+        dispatch itself, so speculative issues are free to discard.
+
+        With a fault tape the dispatch additionally chains the
+        constraint bounds and tape cursor (`cb`, `tpos`) and needs the
+        f64 base clock `t0` the dispatch starts from (default: the
+        committed ``self.t``); speculative issues derive all three
+        from their predecessor's token."""
         if not self.superstep_k and k is None:
             raise ValueError("superstep_batch needs superstep=K "
                              "(constructor) or an explicit k")
@@ -911,7 +1057,8 @@ class DrainSim:
         if k is None:
             k = k_max
         k = min(int(k), k_max)
-        budget = self.superstep_rounds or k_max * 512
+        budget = (int(round_budget) or self.superstep_rounds
+                  or k_max * 512)
         want_stop = (stop_live if stop_live
                      else (int(self._live0 * self.repack_at)
                            if self._live0 * self.repack_at
@@ -919,19 +1066,26 @@ class DrainSim:
         group = _pos_group(self.n_v)
         pen_in = self._pen if pen is None else pen
         rem_in = self._rem if rem is None else rem
-        pen_out, rem_out, packed = _drain_superstep(
-            *self._dev, self._cb, self._vb, pen_in, rem_in,
+        cb_in = self._cb if cb is None else cb
+        tpos_in = self._tpos if tpos is None else tpos
+        t0_in = np.float64(self.t) if t0 is None else t0
+        pen_out, rem_out, cb_out, tpos_out, packed = _drain_superstep(
+            *self._dev, cb_in, self._vb, pen_in, rem_in,
             self._thresh, self._ids_dev,
             np.int32(k), np.int32(budget), np.int32(want_stop),
-            _ZERO_BITS, eps=self.eps, n_c=self.n_c, n_v=self.n_v,
-            k_max=k_max, group=group, has_bounds=self.has_bounds)
+            _ZERO_BITS, *self._tape, tpos_in, t0_in,
+            eps=self.eps, n_c=self.n_c, n_v=self.n_v,
+            k_max=k_max, group=group, has_bounds=self.has_bounds,
+            has_tape=self.has_tape)
         self.supersteps += 1
         opstats.bump("dispatches")
         if speculative:
             self.spec_issued += 1
             opstats.bump("speculations_issued")
         return SuperstepToken(pen_in, rem_in, pen_out, rem_out, packed,
-                              k, k_max, want_stop, speculative)
+                              k, k_max, want_stop, speculative,
+                              cb_in=cb_in, cb_out=cb_out,
+                              tpos_out=tpos_out, t0=t0_in)
 
     def _discard_token(self, tok: SuperstepToken) -> None:
         """Drop an un-collected speculative superstep: processing the
@@ -955,6 +1109,9 @@ class DrainSim:
         dispatch exited _FLAG_OK), so a speculative successor may
         commit; on False the caller must discard in-flight tokens."""
         self._pen, self._rem = tok.pen_out, tok.rem_out
+        if self.has_tape:
+            self._cb = tok.cb_out
+            self._tpos = tok.tpos_out
         k_max = tok.k_max
         p = opstats.timed_fetch(tok.packed)
         self.syncs += 1
@@ -966,8 +1123,9 @@ class DrainSim:
         adv_dt = p[o:o + k_max]
         adv_nev = p[o + k_max:o + 2 * k_max].astype(np.int64)
         o += 2 * k_max
-        ring_t = p[o:o + self.n_v]
-        ring_id = p[o + self.n_v:o + 2 * self.n_v].astype(np.int64)
+        ring_n = self.n_v + k_max if self.has_tape else self.n_v
+        ring_t = p[o:o + ring_n]
+        ring_id = p[o + ring_n:o + 2 * ring_n].astype(np.int64)
 
         self.rounds += rounds
         opstats.bump("fixpoint_rounds", rounds)
@@ -975,14 +1133,38 @@ class DrainSim:
         batches: List[Tuple[float, List[int]]] = []
         start = 0
         t_base = self.t
-        for i in range(adv):
-            end = int(adv_nev[i])
-            batches.append((float(adv_dt[i]),
-                            [int(f) for f in ring_id[start:end]]))
-            for j in range(start, end):
-                self.events.append((t_base + float(ring_t[j]),
-                                    int(ring_id[j])))
-            start = end
+        fired = 0
+        if self.has_tape:
+            # demux the ring: negative ids are tape fires (slot
+            # -(1+id)), logged into the fault stream instead of the
+            # completion stream/batches
+            for i in range(adv):
+                end = int(adv_nev[i])
+                batch_ids: List[int] = []
+                for j in range(start, end):
+                    fid = int(ring_id[j])
+                    tj = t_base + float(ring_t[j])
+                    if fid < 0:
+                        self.fault_events.append((tj, -fid - 1))
+                        fired += 1
+                    else:
+                        batch_ids.append(fid)
+                        self.events.append((tj, fid))
+                batches.append((float(adv_dt[i]), batch_ids))
+                start = end
+            self._tpos_host += fired
+            self._last_fired = fired > 0
+            if fired:
+                opstats.bump("fault_tape_events", fired)
+        else:
+            for i in range(adv):
+                end = int(adv_nev[i])
+                batches.append((float(adv_dt[i]),
+                                [int(f) for f in ring_id[start:end]]))
+                for j in range(start, end):
+                    self.events.append((t_base + float(ring_t[j]),
+                                        int(ring_id[j])))
+                start = end
         # f64 master clock: one Kahan-compensated dtype total per
         # superstep, accumulated on host in f64
         self.t = t_base + t_sum
@@ -1007,14 +1189,19 @@ class DrainSim:
         if tok.speculative:
             self.spec_committed += 1
             opstats.bump("speculations_committed")
+        # a tape fire is a clean-collect boundary for speculation: the
+        # spec issue chained from the fired bounds (values were right),
+        # but replaying from the committed state keeps the oracle
+        # trivially aligned with the unpipelined driver
         clean = (flag == _FLAG_OK and n_live > 0
-                 and not repacked and not decayed)
+                 and not repacked and not decayed and not fired)
         if self.on_batches is not None and batches:
             self.on_batches(batches)
         return n_live, batches, clean
 
     def superstep_batch(self, k: Optional[int] = None,
-                        fetch: bool = True, stop_live: int = 0):
+                        fetch: bool = True, stop_live: int = 0,
+                        round_budget: int = 0):
         """Dispatch ONE superstep of up to `k` advances and (optionally)
         fetch its packed result — a single transfer.
 
@@ -1022,9 +1209,13 @@ class DrainSim:
         (dt, [original flow ids]) per executed advance; with
         fetch=False nothing is transferred (replay) and (None, None) is
         returned.  Events/clock/counters are committed on fetch."""
-        tok = self._superstep_issue(k, stop_live=stop_live)
+        tok = self._superstep_issue(k, stop_live=stop_live,
+                                    round_budget=round_budget)
         if not fetch:
             self._pen, self._rem = tok.pen_out, tok.rem_out
+            if self.has_tape:
+                self._cb = tok.cb_out
+                self._tpos = tok.tpos_out
             return None, None
         n_live, batches, _clean = self._superstep_collect(tok)
         return n_live, batches
@@ -1056,11 +1247,25 @@ class DrainSim:
                     spec = bool(inflight)
                     k = (self.superstep_k if spec
                          else min(self.superstep_k, budget))
-                    pen, rem = ((inflight[-1].pen_out,
-                                 inflight[-1].rem_out)
-                                if inflight else (None, None))
+                    if inflight:
+                        prev = inflight[-1]
+                        pen, rem = prev.pen_out, prev.rem_out
+                        if self.has_tape:
+                            # chain bounds/cursor and derive the f64
+                            # base clock DEVICE-side: the same IEEE
+                            # add the host collect will perform, so a
+                            # committed chain is bit-identical to a
+                            # fresh issue from the committed clock
+                            cb, tpos = prev.cb_out, prev.tpos_out
+                            t0 = prev.t0 + prev.packed[3].astype(
+                                jnp.float64)
+                        else:
+                            cb = tpos = t0 = None
+                    else:
+                        pen = rem = cb = tpos = t0 = None
                     inflight.append(self._superstep_issue(
-                        k, pen=pen, rem=rem, speculative=spec))
+                        k, pen=pen, rem=rem, speculative=spec,
+                        cb=cb, tpos=tpos, t0=t0))
                     issued_k += k
                 tok = inflight.popleft()
                 issued_k -= tok.k
@@ -1069,23 +1274,40 @@ class DrainSim:
                 budget -= self.advances - before
                 if not clean:
                     # speculation mispredicted: processing this ring
-                    # mutated the system (repack/decay) or the batch
-                    # needs a host-side continuation (rescue/stall) —
+                    # mutated the system (repack/decay), hit a tape
+                    # fire (clean-collect boundary) or the batch needs
+                    # a host-side continuation (rescue/stall) —
                     # discard the in-flight tail and restart from the
                     # committed state
+                    if self.has_tape and self._last_fired and inflight:
+                        opstats.bump("fault_replays", len(inflight))
                     while inflight:
                         self._discard_token(inflight.popleft())
                     issued_k = 0
                     if n and self.advances == before:
                         # the round budget expired inside the first
-                        # solve: finish ONE advance via the chunked
-                        # fused path (which converges across
-                        # dispatches), then resume
-                        n = self._advance_fused()
+                        # solve: finish ONE advance (full-budget
+                        # superstep when a tape is armed — the fused
+                        # rescue path cannot see tape events — else
+                        # the chunked fused path)
+                        n = self._rescue_one()
                         budget -= 1
         finally:
             while inflight:
                 self._discard_token(inflight.popleft())
+
+    def _rescue_one(self) -> int:
+        """Finish ONE advance after the superstep round budget expired
+        inside its first solve.  With a fault tape the rescue must stay
+        on the superstep path (the fused kernel would step straight
+        over a tape event): re-dispatch k=1 with the FULL round budget
+        — its collect raises "did not converge" if even that fails.
+        Without a tape, the chunked fused path (which converges across
+        dispatches) is cheaper."""
+        if self.has_tape:
+            n, _ = self.superstep_batch(k=1, round_budget=_MAX_ROUNDS)
+            return n
+        return self._advance_fused()
 
     def run(self, max_advances: int = 10_000_000) -> None:
         n = self.n_v
@@ -1100,9 +1322,8 @@ class DrainSim:
                 max_advances -= self.advances - before
                 if n and self.advances == before:
                     # the round budget expired inside the first solve:
-                    # finish ONE advance via the chunked fused path
-                    # (which converges across dispatches), then resume
-                    n = self._advance_fused()
+                    # finish ONE advance, then resume
+                    n = self._rescue_one()
                     max_advances -= 1
             return
         while n and max_advances:

@@ -642,6 +642,15 @@ class WarmSolver:
         if carry0 is not None:
             self.warm_solves += 1
             opstats.bump("warm_solves")
+            # a warm restart whose entire delta is constraint-bound
+            # flips is the fault-injection signature (link capacities
+            # changed, topology didn't) — counted separately so fault
+            # sweeps can see their re-solves ride the warm path
+            if dirty is not None and all(
+                    f == "c_bound" or not slots
+                    for f, slots in dirty.items()) \
+                    and dirty.get("c_bound"):
+                opstats.bump("warm_bound_restarts")
         else:
             self.cold_solves += 1
             opstats.bump("cold_solves")

@@ -68,6 +68,27 @@ context object through the solver entry points:
                               as-is, and how many were discarded
                               because processing the PRECEDING
                               completion ring mutated the system
+* ``fault_tape_slots``      — fault-tape entries compiled into device
+                              event tapes at sim construction
+                              (ops.lmm_drain ``tape=`` / ops.lmm_batch
+                              ``tapes=``: one bump per scheduled
+                              failure/repair date across all lanes)
+* ``fault_tape_events``     — tape events that FIRED mid-drain: the
+                              superstep clamped dt to the event date,
+                              scattered the new constraint bound and
+                              emitted the tagged ring entry the host
+                              demuxed into ``fault_events``
+* ``fault_replays``         — speculative in-flight supersteps
+                              discarded because the superstep they
+                              chained from fired a tape event (the
+                              pipelined executors treat a fire as a
+                              clean-collect boundary and replay from
+                              the post-fault state)
+* ``warm_bound_restarts``   — warm solves whose entire dirty delta was
+                              constraint-bound flips (the
+                              fault-injection signature: capacities
+                              changed, topology didn't); subset of
+                              ``warm_solves``
 
 Counters only ever increase; consumers snapshot before a phase and
 diff after (``snapshot``/``diff``), or wrap the phase in ``scoped``.

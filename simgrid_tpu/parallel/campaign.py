@@ -9,10 +9,15 @@ plus a list of :class:`ScenarioSpec` records into a replica fleet:
 * each spec contributes *sweep overrides* (global bandwidth / flow-size
   multipliers, sparse per-link and per-flow factors, dead flows) and an
   optional *fault dimension* — a seeded
-  :class:`~simgrid_tpu.faults.FaultCampaign` whose per-link schedules
-  are folded into static capacity multipliers
-  (``FaultCampaign.mean_availability``), so a Monte Carlo fault sweep
-  is just N seeds;
+  :class:`~simgrid_tpu.faults.FaultCampaign` per replica, so a Monte
+  Carlo fault sweep is just N seeds.  How the schedule is realized is
+  the ``faults/tape`` flag (or the ``fault_mode`` constructor
+  argument): ``on`` (default) compiles it into a device-resident EVENT
+  TAPE — links fail and recover mid-drain at the exact schedule dates,
+  the superstep loop clamping dt so no advance steps over an event —
+  while ``static`` demotes it to the pre-tape time-averaged capacity
+  multipliers (``FaultCampaign.mean_availability``) and ``off``
+  ignores it;
 * the fleet is stepped through :class:`~simgrid_tpu.ops.lmm_batch.
   BatchDrainSim` in chunks of ``batch`` replicas: one shared platform
   upload, compact per-replica payloads, lockstep supersteps with an
@@ -56,9 +61,12 @@ class ScenarioSpec:
 
     ``fault_mtbf``/``fault_mttr`` (simulated seconds) switch the fault
     dimension on: every link gets a seeded failure/repair schedule over
-    ``fault_horizon`` and its time-averaged availability becomes a
-    capacity multiplier (clamped to ``MIN_LINK_FACTOR``).  Identical
-    seeds give identical scenarios, bit-for-bit.
+    ``fault_horizon``.  How the schedule is realized is the campaign's
+    ``fault_mode``: a device event tape (links flip mid-drain at the
+    exact dates, failures clamped to ``MIN_LINK_FACTOR``), or a folded
+    time-averaged capacity multiplier (``static``, same clamp), or
+    nothing (``off``).  Identical seeds give identical scenarios,
+    bit-for-bit.
     """
 
     __slots__ = ("seed", "bw_scale", "size_scale", "link_scale",
@@ -96,15 +104,20 @@ class ScenarioSpec:
 class ReplicaResult:
     """Per-replica campaign outcome (the demultiplexed 'engine')."""
 
-    __slots__ = ("spec", "events", "t", "advances", "error")
+    __slots__ = ("spec", "events", "t", "advances", "error",
+                 "fault_events")
 
     def __init__(self, spec: ScenarioSpec, events, t: float,
-                 advances: int, error: Optional[str]):
+                 advances: int, error: Optional[str],
+                 fault_events=None):
         self.spec = spec
         self.events = events          # [(time, flow slot)] solo order
         self.t = t
         self.advances = advances
         self.error = error
+        #: (time, constraint slot) per fired tape event, fire order
+        #: (empty unless the campaign runs in faults/tape:on mode)
+        self.fault_events = list(fault_events or [])
 
 
 class Campaign:
@@ -116,7 +129,8 @@ class Campaign:
                  link_names: Optional[List[Optional[str]]] = None,
                  eps: float = 1e-9, done_eps: float = 1e-4,
                  dtype=np.float64, done_mode: str = "rel",
-                 superstep: int = 8, pipeline: int = 0, mesh=None):
+                 superstep: int = 8, pipeline: int = 0, mesh=None,
+                 fault_mode: Optional[str] = None):
         self.e_var = np.asarray(e_var, np.int32)
         self.e_cnst = np.asarray(e_cnst, np.int32)
         self.e_w = np.asarray(e_w, np.float64)
@@ -137,6 +151,16 @@ class Campaign:
         self.superstep = int(superstep)
         self.pipeline = int(pipeline)
         self.mesh = mesh
+        if fault_mode is None:
+            from ..utils.config import config
+            fault_mode = str(config["faults/tape"])
+        if fault_mode not in ("on", "static", "off"):
+            raise ValueError(f"Unknown fault_mode {fault_mode!r} "
+                             "(expected on, static or off)")
+        #: how specs' fault dimension is realized: "on" = device event
+        #: tapes (mid-drain capacity flips), "static" = folded
+        #: mean-availability multipliers, "off" = ignored
+        self.fault_mode = fault_mode
         #: constraint slots that actually carry elements — fault
         #: schedules are drawn for these only (padding slots have no
         #: flows and scaling them is pure noise in the RNG stream)
@@ -175,22 +199,32 @@ class Campaign:
             return str(self.link_names[slot])
         return f"link{slot}"
 
+    def _fault_campaign(self, spec: ScenarioSpec
+                        ) -> Tuple[FaultCampaign, Dict[str, int]]:
+        """Seeded per-replica FaultCampaign over the used links, plus
+        the name → constraint-slot map.  Registration order is the slot
+        order, so the RNG substream layout is a pure function of the
+        spec — the tape, the static folding and an engine-side
+        ``schedule()`` of the same campaign all see identical draws."""
+        fc = FaultCampaign(seed=spec.seed, horizon=spec.fault_horizon)
+        names: Dict[str, int] = {}
+        for slot in self._used_links:
+            name = self._link_name(int(slot))
+            names[name] = int(slot)
+            fc.add_link(name, mtbf=spec.fault_mtbf,
+                        mttr=spec.fault_mttr, dist=spec.fault_dist,
+                        shape=spec.fault_shape)
+        return fc, names
+
     def overrides_for(self, spec: ScenarioSpec) -> ReplicaOverrides:
-        """Fold one spec's sweep overrides and fault schedule into the
-        compact per-replica override record.  Pure function of the spec
-        (the FaultCampaign draw is seeded), so the solo oracle and the
-        batch path derive the identical scenario."""
+        """Fold one spec's sweep overrides — and, in ``static`` fault
+        mode, its time-averaged fault schedule — into the compact
+        per-replica override record.  Pure function of the spec (the
+        FaultCampaign draw is seeded), so the solo oracle and the batch
+        path derive the identical scenario."""
         link_scale = dict(spec.link_scale)
-        if spec.fault_mtbf is not None:
-            fc = FaultCampaign(seed=spec.seed,
-                               horizon=spec.fault_horizon)
-            names = {}
-            for slot in self._used_links:
-                name = self._link_name(int(slot))
-                names[name] = int(slot)
-                fc.add_link(name, mtbf=spec.fault_mtbf,
-                            mttr=spec.fault_mttr, dist=spec.fault_dist,
-                            shape=spec.fault_shape)
+        if spec.fault_mtbf is not None and self.fault_mode == "static":
+            fc, names = self._fault_campaign(spec)
             for (kind, name), avail in fc.mean_availability().items():
                 if avail >= 1.0:
                     continue
@@ -203,6 +237,39 @@ class Campaign:
                                 flow_scale=spec.flow_scale,
                                 dead_flows=spec.dead_flows,
                                 elem_w=spec.elem_w)
+
+    def tape_for(self, spec: ScenarioSpec
+                 ) -> Optional[Tuple[np.ndarray, np.ndarray,
+                                     np.ndarray]]:
+        """Compile one spec's fault schedule into the device event-tape
+        triple ``(dates f64, constraint slots i32, new bounds f64)``
+        consumed by DrainSim/BatchDrainSim.  ``None`` when the fault
+        mode isn't ``on``, the spec has no fault dimension, or the
+        seeded schedule is empty.  Bound values are ABSOLUTE post-event
+        capacities derived from the replica's own swept ``c_bound`` —
+        a factor-1.0 repair restores the replica bound exactly."""
+        if self.fault_mode != "on" or spec.fault_mtbf is None:
+            return None
+        fc, names = self._fault_campaign(spec)
+        entries = fc.compile_tape(floor=MIN_LINK_FACTOR)
+        if not entries:
+            return None
+        base_rem = (self.remains if self.remains is not None
+                    else self.sizes)
+        base_pen = (self.penalty if self.penalty is not None
+                    else np.ones(len(self.sizes)))
+        cb, _, _, _ = derive_replica_arrays(
+            self.c_bound, self.sizes, base_rem, base_pen,
+            self.overrides_for(spec))
+        t = np.empty(len(entries), np.float64)
+        s = np.empty(len(entries), np.int32)
+        v = np.empty(len(entries), np.float64)
+        for i, (date, kind, name, factor) in enumerate(entries):
+            slot = names[name]
+            t[i] = date
+            s[i] = slot
+            v[i] = cb[slot] * factor
+        return t, s, v
 
     # -- execution ---------------------------------------------------------
 
@@ -222,6 +289,9 @@ class Campaign:
         for start in range(0, len(self.specs), max(1, int(batch))):
             chunk_specs = self.specs[start:start + max(1, int(batch))]
             overrides = [self.overrides_for(s) for s in chunk_specs]
+            tapes = [self.tape_for(s) for s in chunk_specs]
+            if not any(t is not None for t in tapes):
+                tapes = None
             sim = BatchDrainSim(
                 self.e_var, self.e_cnst, self.e_w, self.c_bound,
                 self.sizes, overrides, eps=self.eps,
@@ -230,12 +300,13 @@ class Campaign:
                 superstep_rounds=superstep_rounds,
                 v_bound=self.v_bound, penalty=self.penalty,
                 remains=self.remains, pipeline=depth,
-                mesh=use_mesh)
+                mesh=use_mesh, tapes=tapes)
             sim.run()
             for b, spec in enumerate(chunk_specs):
                 rep = sim.replicas[b]
-                results.append(ReplicaResult(spec, rep.events, rep.t,
-                                             rep.advances, rep.error))
+                results.append(ReplicaResult(
+                    spec, rep.events, rep.t, rep.advances, rep.error,
+                    fault_events=rep.fault_events))
         return results
 
     def run_solo(self, index: int,
@@ -264,14 +335,15 @@ class Campaign:
                        superstep_rounds=superstep_rounds,
                        v_bound=(self.v_bound.astype(self.dtype)
                                 if self.v_bound is not None else None),
-                       penalty=pen, remains=rem, repack_min=1 << 62)
+                       penalty=pen, remains=rem, repack_min=1 << 62,
+                       tape=self.tape_for(spec))
         error = None
         try:
             sim.run()
         except RuntimeError as exc:
             error = str(exc)
         return ReplicaResult(spec, sim.events, sim.t, sim.advances,
-                             error)
+                             error, fault_events=sim.fault_events)
 
     def run_scoped(self, batch: int, stage: str,
                    pipeline: Optional[int] = None, mesh=None

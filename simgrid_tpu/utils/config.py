@@ -337,6 +337,17 @@ declare_flag("drain/transitions",
              "alternation stays on the superstep path.  auto/on "
              "enable it whenever drain/fastpath engages; off restores "
              "the invalidate-on-any-mutation behavior", "auto")
+declare_flag("faults/tape",
+             "How campaign fleets realize per-replica fault schedules "
+             "(parallel.campaign): on compiles each seeded "
+             "FaultCampaign into a device-resident event tape the "
+             "superstep drain consults between advances — link "
+             "capacities flip mid-drain at exact schedule dates, "
+             "bit-identical to solo Profile injection; static folds "
+             "the schedule into time-averaged capacity multipliers "
+             "(FaultCampaign.mean_availability, the pre-tape "
+             "behavior); off ignores the fault dimension entirely",
+             "on")
 declare_flag("drain/done-eps",
              "Relative completion threshold of the f32 drain "
              "executor: a flow retires when its remainder falls to "

@@ -93,6 +93,22 @@ def test_phase_runtime_determinism():
     assert problems == []
 
 
+def test_fault_runtime_determinism():
+    """Dynamic coverage of the device fault event tapes (ISSUE 10
+    tooling, the `--quick` small-N instance): a fleet with 2 faulted
+    lanes + 1 clean lane fires its seeded tape events mid-drain and
+    every lane stays bit-identical — completion events, fired faults
+    and Kahan clocks — to solo runs; the tape dates are bitwise the
+    generate() schedule, static mode reproduces the hand-folded
+    mean-availability scenario, and pipeline depth 2 plus a 2-device
+    mesh compose unchanged.  The full-size check runs via
+    `check_determinism.py --runtime-fault`."""
+    checker = _load_checker()
+    problems = checker.check_fault_runtime(n_c=24, n_v=64, k=4,
+                                           mesh=2)
+    assert problems == []
+
+
 def test_checker_flags_violations(tmp_path):
     """The lint itself works: a planted file with each banned pattern is
     reported (guards against the lint silently matching nothing)."""

@@ -16,7 +16,9 @@ from simgrid_tpu.exceptions import (HostFailureException,
 from simgrid_tpu.faults import FaultCampaign, Injector
 from simgrid_tpu.models.host import Host
 from simgrid_tpu.models.network import LinkImpl
-from simgrid_tpu.ops import make_new_maxmin_system, lmm_jax
+from simgrid_tpu.ops import make_new_maxmin_system, lmm_jax, opstats
+from simgrid_tpu.parallel.campaign import (Campaign, MIN_LINK_FACTOR,
+                                           ScenarioSpec)
 from simgrid_tpu.plugins import fault_stats
 from simgrid_tpu.utils.config import config
 
@@ -143,6 +145,37 @@ def test_campaign_schedules_only_once(tmp_path):
         campaign.schedule(e)
 
 
+def test_mean_availability_clamps_only_in_campaign_folding():
+    # a link down for essentially the whole horizon: fails at t=1 and
+    # its 1000 s repair never lands, so availability is 1/100 — far
+    # below MIN_LINK_FACTOR.  mean_availability() reports the raw
+    # fraction (never exactly zero: the first failure date is > 0);
+    # the static fleet folding is what clamps it to the floor.
+    fc = FaultCampaign(seed=11, horizon=100.0)
+    fc.add_link("wire", mtbf=1.0, mttr=1000.0, dist="fixed")
+    avail = fc.mean_availability()[("link", "wire")]
+    assert avail == pytest.approx(0.01)
+    assert 0.0 < avail < MIN_LINK_FACTOR
+
+    specs = [ScenarioSpec(seed=0, fault_mtbf=1.0, fault_mttr=1000.0,
+                          fault_horizon=100.0, fault_dist="fixed")]
+    camp = Campaign(np.array([0, 1], np.int32),
+                    np.array([0, 1], np.int32), np.ones(2),
+                    np.array([1e6, 1e6]), np.array([8e6, 1.4e7]),
+                    specs, superstep=1, fault_mode="static")
+    ov = camp.overrides_for(specs[0])
+    assert ov.link_scale, "static folding produced no link scales"
+    assert all(v == MIN_LINK_FACTOR for v in ov.link_scale.values())
+
+
+def test_mean_availability_default_horizon_matches_explicit():
+    fc = _campaign(7)
+    assert fc.mean_availability() == fc.mean_availability(horizon=100.0)
+    assert fc.mean_availability(horizon=50.0) != fc.mean_availability()
+    with pytest.raises(ValueError):
+        fc.mean_availability(horizon=0.0)
+
+
 # ---------------------------------------------------------------------------
 # End-to-end lifecycle: kill mid-Exec, auto-restart reboot, watched hosts
 # ---------------------------------------------------------------------------
@@ -262,6 +295,57 @@ def test_injector_degrade_and_restore(tmp_path):
     assert e.link_by_name("wire").bandwidth_peak == pytest.approx(5e5)
     inj.restore_all()
     assert e.link_by_name("wire").bandwidth_peak == pytest.approx(1e6)
+
+
+def test_injector_restore_all_mid_superstep_matches_native(tmp_path):
+    """restore_all() firing from an engine timer while the device
+    drain is mid-superstep must be absorbed by the transition
+    classifier (degrade and restore are both resumable c_bound flips),
+    with completion times bit-identical to the native per-event loop."""
+
+    def run(*cfg):
+        s4u.Engine._reset()
+        e = _engine(tmp_path, "--cfg=network/optim:Full",
+                    "--cfg=network/maxmin-selective-update:no",
+                    "--cfg=lmm/backend:jax", *cfg)
+        done = {}
+
+        def sender(mb, size):
+            mb.put("x", size)
+
+        def receiver(mb, key):
+            mb.get()
+            done[key] = s4u.Engine.get_clock()
+
+        # 10 concurrent flows: above the fast path's hard floor of 8
+        # started flows (ops.drain_path._MIN_FLOWS_FLOOR)
+        sizes = [1.0e6 + 0.3e6 * k for k in range(10)]
+        for k, size in enumerate(sizes):
+            mb = s4u.Mailbox.by_name(f"mb{k}")
+            s4u.Actor.create(f"s{k}", e.host_by_name("alpha"), sender,
+                             mb, size)
+            s4u.Actor.create(f"r{k}", e.host_by_name("beta"), receiver,
+                             mb, k)
+        inj = Injector(e)
+        inj.at(2.0).link_degrade("wire", 0.5)
+        inj.at(5.0).restore_all()
+        e.run()
+        assert e.link_by_name("wire").bandwidth_peak \
+            == pytest.approx(1e6), "restore_all never fired"
+        return done, e.clock
+
+    ref = run("--cfg=drain/fastpath:off")
+    before = opstats.snapshot()
+    got = run("--cfg=drain/fastpath:auto", "--cfg=drain/min-flows:8",
+              "--cfg=drain/superstep:8")
+    d = opstats.diff(before)
+    assert got == ref                      # bit-identical, not approx
+    assert max(got[0].values()) > 5.0, \
+        "every flow finished before restore_all fired"
+    assert d.get("fastpath_advances"), \
+        "the device plan never served an advance (nothing was tested)"
+    assert d.get("drain_transitions"), \
+        "degrade/restore never hit the transition classifier"
 
 
 def test_injector_partition_heals(tmp_path):

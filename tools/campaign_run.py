@@ -132,6 +132,13 @@ def main() -> int:
     ap.add_argument("--faults", type=float, default=0.5,
                     help="fraction of replicas with a fault dimension "
                     "(seeded MTBF/MTTR link degradation)")
+    ap.add_argument("--fault-mode", choices=["on", "static", "off"],
+                    default=None,
+                    help="how fault schedules are realized: on = "
+                    "device event tapes (links flip mid-drain at the "
+                    "exact seeded dates), static = folded "
+                    "mean-availability multipliers, off = ignored "
+                    "(default: the faults/tape config flag)")
     ap.add_argument("--mtbf", type=float, default=400.0)
     ap.add_argument("--mttr", type=float, default=50.0)
     ap.add_argument("--horizon", type=float, default=600.0)
@@ -170,7 +177,8 @@ def main() -> int:
              for s in range(args.replicas)]
     campaign = Campaign(specs=specs, superstep=args.superstep,
                         pipeline=args.pipeline,
-                        mesh=args.mesh or None, **base)
+                        mesh=args.mesh or None,
+                        fault_mode=args.fault_mode, **base)
 
     t0 = time.perf_counter()
     results, stats = campaign.run_scoped(batch=args.batch,
@@ -193,14 +201,21 @@ def main() -> int:
                    stats.get("replicated_upload_bytes", 0)),
                events=sum(len(r.events) for r in results),
                errors=[r.spec.label for r in results if r.error],
-               clocks=[round(r.t, 6) for r in results[:8]])
+               clocks=[round(r.t, 6) for r in results[:8]],
+               fault_mode=campaign.fault_mode,
+               fault_tape_slots=int(stats.get("fault_tape_slots", 0)),
+               fault_tape_events=int(
+                   stats.get("fault_tape_events", 0)),
+               fault_replays=int(stats.get("fault_replays", 0)))
     if 0 <= args.check < args.replicas:
         solo = campaign.run_solo(args.check)
         row["solo_check"] = dict(
             replica=args.check,
             events_bit_identical=(solo.events
                                   == results[args.check].events),
-            clock_bit_identical=solo.t == results[args.check].t)
+            clock_bit_identical=solo.t == results[args.check].t,
+            fault_events_bit_identical=(
+                solo.fault_events == results[args.check].fault_events))
     print(json.dumps(row))
     if args.out:
         with open(args.out, "a") as fh:

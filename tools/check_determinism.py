@@ -69,10 +69,21 @@ must take the replay fallback — asserted via the invalidation-cause
 histogram), and the pipelined fleet variant (speculative supersteps
 riding the mutating phase).
 
+``--runtime-fault`` drains a small fleet with per-replica fault event
+tapes (2 faulted lanes + 1 clean lane) and asserts the tape contract:
+``FaultCampaign.compile_tape`` carries bitwise the same dates as the
+``generate()`` schedule an engine-side Profile would replay; every
+lane of the batched fleet is bit-identical — events, fault fires AND
+Kahan clocks — to the same scenario run solo; at least one tape event
+actually FIRED mid-drain (otherwise nothing was tested) and the drain
+kept completing after it; ``fault_mode="static"`` still reproduces
+the pre-tape mean-availability folding exactly; and the tape composes
+with pipeline depth 2 and a 2-device mesh unchanged.
+
 ``--quick`` is the CI mode: the static lint plus small-N instances of
 every runtime check (drain, warm-start, batch, pipeline, shard,
-phase), sized to finish in seconds so the tier-1 suite can run it on
-every test pass (tests/test_determinism_lint.py, whose conftest
+phase, fault), sized to finish in seconds so the tier-1 suite can run
+it on every test pass (tests/test_determinism_lint.py, whose conftest
 forces an 8-virtual-device CPU so the mesh path is exercised on
 every run).
 """
@@ -505,6 +516,147 @@ def check_shard_runtime(seed: int = 31, n_c: int = 48, n_v: int = 160,
     return problems
 
 
+def check_fault_runtime(seed: int = 41, n_c: int = 32, n_v: int = 96,
+                        k: int = 4, depths=(0, 2), mesh: int = 2
+                        ) -> List[str]:
+    """Dynamic determinism of the device-resident fault event tapes: a
+    3-lane fleet (2 seeded fault schedules + 1 clean lane) must (a)
+    compile tapes whose dates are bitwise the generate() schedule an
+    engine-side Profile would replay, (b) be bit-identical per lane —
+    completion events, fired fault events AND Kahan clocks — to the
+    same scenarios run solo, with at least one tape event actually
+    firing mid-drain and at least one completion landing after it, (c)
+    reproduce the pre-tape mean-availability folding exactly in
+    ``fault_mode="static"``, and (d) stay bit-identical under pipeline
+    depth 2 and a `mesh`-device replica-axis sharding.  Returns a list
+    of problem descriptions (empty = OK)."""
+    import numpy as np
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import jax
+    from bench import build_arrays
+    from simgrid_tpu.parallel.campaign import (Campaign, ScenarioSpec,
+                                               MIN_LINK_FACTOR)
+
+    rng = np.random.default_rng(seed)
+    arrays = build_arrays(rng, n_c, n_v, 3, np.float64)
+    E = arrays.n_elem
+    sizes = rng.choice(np.linspace(1e5, 2e6, 16), n_v)
+    specs = [ScenarioSpec(seed=s, bw_scale=1.0 + 0.1 * s,
+                          fault_mtbf=200.0 if s < 2 else None,
+                          fault_mttr=60.0, fault_horizon=800.0,
+                          fault_dist="weibull" if s == 1
+                          else "exponential",
+                          fault_shape=1.5)
+             for s in range(3)]
+
+    def make(**kw):
+        return Campaign(arrays.e_var[:E], arrays.e_cnst[:E],
+                        arrays.e_w[:E], arrays.c_bound[:n_c], sizes,
+                        specs, eps=1e-9, dtype=np.float64,
+                        superstep=k, **kw)
+
+    problems: List[str] = []
+    camp = make(fault_mode="on")
+
+    # (a) the tape is the Profile schedule, bitwise: same dates in the
+    # same order, states mapped to the clamp floor / full restore
+    for s in range(2):
+        fc, _ = camp._fault_campaign(specs[s])
+        sched = sorted((date, name, value)
+                       for (kind, name), pts in fc.generate().items()
+                       for date, value in pts)
+        ref = camp._fault_campaign(specs[s])[0]
+        tape = ref.compile_tape(floor=MIN_LINK_FACTOR)
+        if [(d, n, 1.0 if v > 0 else MIN_LINK_FACTOR)
+                for d, n, v in sched] \
+                != [(d, n, f) for d, _, n, f in tape]:
+            problems.append(f"fault: replica {s}: compile_tape "
+                            f"diverged from the generate() schedule")
+
+    # (b) batched vs solo, bit-identical incl. the fired fault events
+    fleet = camp.run_batched(batch=3)
+    fired = 0
+    for j in range(3):
+        solo = camp.run_solo(j)
+        got = fleet[j]
+        if solo.error or got.error:
+            problems.append(f"fault: replica {j} errored "
+                            f"({got.error or solo.error})")
+            continue
+        if solo.events != got.events or solo.t != got.t:
+            problems.append(
+                f"fault: replica {j}: batched run diverged from solo "
+                f"({len(got.events)} vs {len(solo.events)} events, "
+                f"clocks {got.t!r} vs {solo.t!r})")
+        if solo.fault_events != got.fault_events:
+            problems.append(f"fault: replica {j}: fired fault events "
+                            f"differ from solo ({len(got.fault_events)}"
+                            f" vs {len(solo.fault_events)})")
+        if j == 2 and got.fault_events:
+            problems.append("fault: the clean lane fired tape events")
+        fired += len(got.fault_events)
+    if not fired:
+        problems.append("fault: no tape event ever fired mid-drain "
+                        "(nothing was actually tested)")
+    for j in range(2):
+        if fleet[j].fault_events and fleet[j].events:
+            first_fire = fleet[j].fault_events[0][0]
+            if not any(t >= first_fire for t, _ in fleet[j].events):
+                problems.append(
+                    f"fault: replica {j}: no completion after the "
+                    f"first fire (the post-fault re-solve never ran)")
+
+    # (c) static mode is the pre-tape behavior: identical to folding
+    # the mean availabilities into explicit link_scale by hand
+    camp_s = make(fault_mode="static")
+    folded = []
+    for spec in specs:
+        ls = dict(spec.link_scale)
+        if spec.fault_mtbf is not None:
+            fc, names = camp_s._fault_campaign(spec)
+            for (kind, name), av in fc.mean_availability().items():
+                if av < 1.0:
+                    slot = names[name]
+                    ls[slot] = ls.get(slot, 1.0) \
+                        * max(av, MIN_LINK_FACTOR)
+        folded.append(ScenarioSpec(seed=spec.seed,
+                                   bw_scale=spec.bw_scale,
+                                   link_scale=ls))
+    camp_f = Campaign(arrays.e_var[:E], arrays.e_cnst[:E],
+                      arrays.e_w[:E], arrays.c_bound[:n_c], sizes,
+                      folded, eps=1e-9, dtype=np.float64,
+                      superstep=k, fault_mode="off")
+    for j, (a, b) in enumerate(zip(camp_s.run_batched(batch=3),
+                                   camp_f.run_batched(batch=3))):
+        if a.events != b.events or a.t != b.t or a.fault_events:
+            problems.append(f"fault: replica {j}: static mode "
+                            f"diverged from the hand-folded "
+                            f"mean-availability scenario")
+
+    # (d) pipeline + mesh compose: every variant bit-identical
+    variants = [("d2", dict(pipeline=2))]
+    if jax.device_count() >= mesh:
+        variants += [(f"m{mesh}", dict(mesh=mesh)),
+                     (f"m{mesh}:d{max(depths)}",
+                      dict(mesh=mesh, pipeline=max(depths)))]
+    else:
+        problems.append(
+            f"fault: only {jax.device_count()} device(s) visible; the "
+            f"mesh leg needs >= {mesh} — on CPU run under "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={mesh}")
+    for label, kw in variants:
+        got = camp.run_batched(batch=3, **kw)
+        for j in range(3):
+            if got[j].events != fleet[j].events \
+                    or got[j].t != fleet[j].t \
+                    or got[j].fault_events != fleet[j].fault_events:
+                problems.append(f"fault:{label}: replica {j} diverged "
+                                f"from the plain batched fleet")
+                break
+    return problems
+
+
 _FAT_TREE_64 = """<?xml version='1.0'?>
 <platform version="4.1">
   <zone id="world" routing="Full">
@@ -688,12 +840,13 @@ def quick_checks() -> List[str]:
                                     shards=(2,), depths=(0, 2))
     problems += check_phase_runtime(ranks=24, rounds=2, min_flows=8,
                                     superstep=8, depths=(0, 2))
+    problems += check_fault_runtime(n_c=24, n_v=64, k=4, mesh=2)
     return problems
 
 
 def main(argv: List[str]) -> int:
-    if ("--runtime-shard" in argv or "--quick" in argv) \
-            and "jax" not in sys.modules:
+    if ("--runtime-shard" in argv or "--runtime-fault" in argv
+            or "--quick" in argv) and "jax" not in sys.modules:
         # the mesh checks need >= 2 devices; the forced host-platform
         # count must land before JAX initializes and only affects the
         # CPU backend (harmless elsewhere)
@@ -716,6 +869,20 @@ def main(argv: List[str]) -> int:
               "fleet and to solo runs: event order, timestamps and "
               "clocks)")
         argv = [a for a in argv if a != "--runtime-shard"]
+    if "--runtime-fault" in argv:
+        problems = check_fault_runtime()
+        if problems:
+            print("check_determinism: fault runtime check FAILED:")
+            for p in problems:
+                print(f"  {p}")
+            return 1
+        print("check_determinism: fault runtime OK (device fault "
+              "tapes — 2 faulted + 1 clean lane, tape dates bitwise "
+              "the generate() schedule, >= 1 event fired mid-drain, "
+              "static mode = hand-folded availabilities, pipeline "
+              "depth 2 and 2-device mesh compose — bit-identical to "
+              "solo runs: events, fired faults and Kahan clocks)")
+        argv = [a for a in argv if a != "--runtime-fault"]
     if "--quick" in argv:
         problems = quick_checks()
         if problems:
@@ -724,7 +891,7 @@ def main(argv: List[str]) -> int:
                 print(f"  {p}")
             return 1
         print("check_determinism: quick OK (lint + small-N drain + "
-              "batch + pipeline + shard + phase runtime)")
+              "batch + pipeline + shard + phase + fault runtime)")
         return 0
     if "--runtime-phase" in argv:
         problems = check_phase_runtime()

@@ -312,7 +312,11 @@ def main() -> None:
                 "drain_transitions", "drain_transition_slots",
                 "drain_cause_transition", "drain_cause_partial_advance",
                 "drain_cause_profile_event", "drain_cause_stall",
-                "drain_cause_unrecognized")
+                "drain_cause_unrecognized",
+                # fault-tape activity (ops.lmm_drain tape=): compiled
+                # entries, mid-drain fires, speculative replays
+                "fault_tape_slots", "fault_tape_events",
+                "fault_replays", "warm_bound_restarts")
         phases = {}
         for name, before, after in (
                 ("build+latency", phase_marks[0], phase_marks[1]),
