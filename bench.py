@@ -952,6 +952,141 @@ def stage_phase(seed: int = 7, ranks: int = 64, rounds: int = 4,
     return out
 
 
+def _serve_specs(scenarios: int, faults: float = 0.25):
+    """The replayed serving sweep: deterministic bw/size scaling
+    families with a seeded fault stripe — structured enough that the
+    surrogate trained on the cold pass's device results can answer
+    the warm replay from its conformal predictor."""
+    from simgrid_tpu.parallel.campaign import ScenarioSpec
+    n_fault = int(round(scenarios * faults))
+    return [ScenarioSpec(seed=s, bw_scale=1.0 + 0.1 * (s % 5),
+                         size_scale=1.0 + 0.05 * (s % 3),
+                         fault_mtbf=400.0 if s < n_fault else None,
+                         fault_mttr=50.0, fault_horizon=600.0,
+                         label=f"serve{s}")
+            for s in range(scenarios)]
+
+
+def stage_serve_phase(n_c: int, n_v: int, deg: int, seed: int,
+                      scenarios: int, batch: int, superstep: int,
+                      phase: str, cache_dir: str) -> dict:
+    """One serving-process lifetime (cold start or warm restart)
+    against a shared on-disk AOT plan cache + surrogate corpus: build
+    the plan, stand up a CampaignService, submit ``scenarios`` what-if
+    queries and drain.  The warm phase seeds its surrogate from the
+    cold phase's corpus log and resubmits every 8th query with
+    ``exact=True`` so the device path (and therefore the disk plan
+    cache) is exercised even when the surrogate answers the rest."""
+    _force_cpu()
+    from simgrid_tpu.parallel.campaign import ScenarioPlan
+    from simgrid_tpu.serving import (CampaignService, PlanCache,
+                                     RuntimeSurrogate)
+
+    rng = np.random.default_rng(seed)
+    arrays = build_arrays(rng, n_c, n_v, deg, np.float64)
+    E = arrays.n_elem
+    sizes = rng.choice(np.linspace(1e5, 2e6, 16), n_v)
+    plan = ScenarioPlan(arrays.e_var[:E], arrays.e_cnst[:E],
+                        arrays.e_w[:E], arrays.c_bound[:n_c], sizes,
+                        eps=1e-9, superstep=superstep, fault_mode="on")
+    cache = PlanCache(cache_dir)
+    corpus_log = os.path.join(cache_dir, "serve_corpus.jsonl")
+    surrogate = RuntimeSurrogate()
+    corpus_rows = (surrogate.load_corpus(corpus_log)
+                   if phase == "warm" else 0)
+    svc = CampaignService(plan, batch=batch, plan_cache=cache,
+                          surrogate=surrogate, corpus_log=corpus_log)
+    specs = _serve_specs(scenarios)
+    exact_every = 8 if phase == "warm" else 0
+    t0 = time.perf_counter()
+    tickets = [svc.submit(spec, exact=bool(exact_every
+                                           and i % exact_every == 0))
+               for i, spec in enumerate(specs)]
+    svc.drain()
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    lat = sorted(t.latency_ms for t in tickets
+                 if t.latency_ms is not None)
+
+    def pct(q):
+        return round(lat[min(len(lat) - 1,
+                             int(round(q * (len(lat) - 1))))], 3)
+
+    first = min((t.done_at for t in tickets if t.done_at is not None),
+                default=None)
+    counters = svc.counters()
+    payload = {"bench": "lmm_serve", "phase": phase, "n_c": n_c,
+               "n_v": n_v, "scenarios": scenarios,
+               "superstep": superstep, "corpus_rows": corpus_rows,
+               "wall_ms": round(wall_ms, 1),
+               "submit_to_first_result_ms": (
+                   None if first is None
+                   else round((first - t0) * 1e3, 3)),
+               "latency_p50_ms": pct(0.50),
+               "latency_p99_ms": pct(0.99),
+               "surrogate_hit_rate": round(
+                   counters["surrogate_answers"] / max(scenarios, 1),
+                   4),
+               "result_errors": sum(
+                   1 for t in tickets
+                   if t.result is not None and t.result.error)}
+    payload.update({k: (round(v, 1) if isinstance(v, float)
+                        else int(v))
+                    for k, v in counters.items()})
+    return payload
+
+
+def stage_serve(args) -> dict:
+    """Cold start vs warm restart of the always-on campaign service
+    (simgrid_tpu/serving): the cold phase traces + AOT-compiles every
+    fleet program and serves all 256 queries on device (seeding the
+    surrogate corpus); the warm phase runs in a FRESH subprocess
+    sharing only the on-disk plan cache + corpus — an honest process
+    restart — and must show plan_compile_ms == 0, plan_cache_hits > 0
+    and a majority-surrogate hit rate.  Rows land in
+    bench_results/lmm_serve.jsonl."""
+    import tempfile
+    cache_dir = args.serve_cache or tempfile.mkdtemp(
+        prefix="lmm_serve_")
+    if args.serve_phase:
+        return stage_serve_phase(args.n_c, args.n_v, args.deg,
+                                 args.seed, args.scenarios,
+                                 args.serve_batch, args.superstep,
+                                 args.serve_phase, cache_dir)
+    out = {}
+    for phase in ("cold", "warm"):
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--stage", "serve", "--serve-phase", phase,
+               "--serve-cache", cache_dir,
+               "--n_c", str(args.n_c), "--n_v", str(args.n_v),
+               "--deg", str(args.deg), "--seed", str(args.seed),
+               "--scenarios", str(args.scenarios),
+               "--serve-batch", str(args.serve_batch),
+               "--superstep", str(args.superstep)]
+        log(f"[stage serve] {phase}: {' '.join(cmd[2:])}")
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=1800,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        sys.stderr.write(proc.stderr)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"serve {phase} phase failed rc={proc.returncode}")
+        out[phase] = json.loads(proc.stdout.strip().splitlines()[-1])
+    cold, warm = out["cold"], out["warm"]
+    speed = {}
+    for key, name in (("submit_to_first_result_ms",
+                       "warm_speedup_first_result"),
+                      ("latency_p50_ms", "warm_speedup_p50")):
+        if cold.get(key) and warm.get(key) is not None:
+            speed[name] = round(cold[key] / max(warm[key], 1e-9), 1)
+    warm.update(speed)
+    rows = [schema_row("serve", out[phase], mode=phase,
+                       batch=args.serve_batch, platform="cpu")
+            for phase in ("cold", "warm")]
+    path = append_rows("lmm_serve.jsonl", rows)
+    log(f"[stage serve] rows appended to {path}")
+    return {"cold": cold, "warm": warm, **speed}
+
+
 STAGES = {
     "probe": lambda args: stage_probe(),
     "dev": lambda args: stage_device(args.n_c, args.n_v, args.deg,
@@ -979,6 +1114,7 @@ STAGES = {
     "fault": lambda args: stage_fault(args.n_c, args.n_v, args.deg,
                                       args.seed, args.replicas,
                                       args.superstep),
+    "serve": lambda args: stage_serve(args),
 }
 
 
@@ -1218,6 +1354,18 @@ def main() -> None:
     if fault:
         detail["lmm_fault"] = fault
 
+    # --- always-on campaign service (simgrid_tpu/serving) --------------
+    # cold start vs warm restart over a shared disk plan cache +
+    # surrogate corpus; rows land in bench_results/lmm_serve.jsonl
+    serve = run_stage("serve", timeout=3600, errors=errors,
+                      n_c=96, n_v=400, deg=3, seed=42,
+                      scenarios=256, superstep=8)
+    if serve:
+        detail["lmm_serve"] = serve
+        if serve.get("warm_speedup_first_result") is not None:
+            detail["serve_warm_speedup"] = \
+                serve["warm_speedup_first_result"]
+
     # mergeable per-class solve rows for the record (same schema as the
     # churn/sweep files: bench_results/*.jsonl concatenate across PRs)
     solve_rows = []
@@ -1324,6 +1472,21 @@ if __name__ == "__main__":
                         help="pipeline stage: emulated per-advance "
                         "host bookkeeping (µs) the speculative "
                         "dispatch overlaps; recorded on every row")
+    parser.add_argument("--scenarios", type=int, default=256,
+                        help="serve stage: queries submitted to the "
+                        "campaign service")
+    parser.add_argument("--serve-batch", type=int, default=16,
+                        dest="serve_batch",
+                        help="serve stage: resident fleet width")
+    parser.add_argument("--serve-phase", choices=["cold", "warm"],
+                        default=None, dest="serve_phase",
+                        help="serve stage internal: run ONE service "
+                        "process lifetime against --serve-cache "
+                        "(the orchestrating invocation spawns both)")
+    parser.add_argument("--serve-cache", default=None,
+                        dest="serve_cache",
+                        help="serve stage: shared AOT plan-cache + "
+                        "corpus directory (default: fresh tempdir)")
     parser.add_argument("--clusters", type=int, default=960)
     parser.add_argument("--chain", type=int, default=96)
     parser.add_argument("--churn", type=float, default=0.01)

@@ -220,6 +220,16 @@ class FaultCampaign:
         tape.sort(key=lambda e: (e[0], e[1], e[2]))
         return tape
 
+    def tape_len(self, floor: float = 0.05) -> int:
+        """Number of entries :meth:`compile_tape` would emit — the
+        per-admission tape-slot count a serving fleet must reserve for
+        this campaign.  Same draws as the tape (the schedule cache is
+        shared), so the probe is exact and repeatable."""
+        floor = float(floor)
+        if not 0.0 < floor <= 1.0:
+            raise ValueError("floor must be in (0, 1]")
+        return sum(len(points) for points in self.generate().values())
+
     # -- compilation onto an engine ---------------------------------------
     def schedule(self, engine=None) -> Dict[Tuple[str, str],
                                             List[Tuple[float, float]]]:

@@ -87,6 +87,22 @@ from .lmm_drain import (_FLAG_BUDGET, _FLAG_OK, _FLAG_STALLED, _ZERO_BITS,
 BATCH_AXIS = "batch"
 
 
+class AdmissionError(RuntimeError):
+    """A lane admission the fleet cannot honor within the capacity
+    fixed at fleet birth: the lane is alive or out of range, the
+    overrides carry ``elem_w`` entries but the fleet shares one weight
+    table, or the fault tape is wider than the fleet's reserved tape
+    slots.  The serving layer catches this and either defers the query
+    or retires the fleet."""
+
+
+def _pow2_bucket(n: int) -> int:
+    """Smallest power of two >= n (>= 1): payload/tape widths are
+    bucketed so admissions and warm restarts hit a handful of stable
+    compiled shapes instead of one per width."""
+    return 1 if n <= 1 else 1 << (int(n) - 1).bit_length()
+
+
 def _as_mesh(mesh) -> Optional[Mesh]:
     """Normalize the ``mesh`` argument: None stays None (single-device
     vmap), an int M builds a 1-D ("batch",) mesh over the first M
@@ -264,6 +280,59 @@ def _materialize(base_cb, base_sizes, base_rem, base_pen,
         pen = base_pen.at[di_l].set(0.0, mode="drop")
         return cb, sz, rem, pen
     return jax.vmap(lane)(bw, fs, li, lf, fi, ff, di)
+
+
+@functools.partial(jax.jit, static_argnames=("done_rel",))
+def _admit_lane_state(base_cb, base_sizes, base_rem, base_pen,
+                      bw, fs, li, lf, fi, ff, di,
+                      cb, pen, rem, thresh, b, done_eps,
+                      done_rel: bool):
+    """DEVICE admission of ONE lane into a live fleet: the per-lane
+    `_materialize` math (f64 base*global-scale + sparse scatters), the
+    threshold derivation and the f64→dtype casts, scattered into row
+    ``b`` of the committed fleet state.  Must stay op-for-op identical
+    to the constructor materialization so an admitted lane is
+    bit-identical to the same scenario in a fresh fleet (and therefore
+    to its solo run).  Upload cost is O(overrides) — the payload is
+    the same compact record a fleet-birth lane ships."""
+    cb64 = base_cb * bw
+    cb64 = cb64.at[li].multiply(lf, mode="drop")
+    sz64 = base_sizes * fs
+    rem64 = base_rem * fs
+    sz64 = sz64.at[fi].multiply(ff, mode="drop")
+    rem64 = rem64.at[fi].multiply(ff, mode="drop")
+    pen64 = base_pen.at[di].set(0.0, mode="drop")
+    if done_rel:
+        th64 = done_eps * sz64
+    else:
+        th64 = jnp.full_like(sz64, done_eps)
+    dt = cb.dtype
+    return (cb.at[b].set(cb64.astype(dt)),
+            pen.at[b].set(pen64.astype(dt)),
+            rem.at[b].set(rem64.astype(dt)),
+            thresh.at[b].set(th64.astype(dt)))
+
+
+@jax.jit
+def _admit_lane_tape(tape_t, tape_slot, tape_val, tpos,
+                     row_t, row_s, row_v, b):
+    """Scatter one admitted lane's fault tape row (inf-padded to the
+    fleet's tape width) and reset its cursor to 0 — the admitted lane
+    starts at its own k=0 with a fresh tape slot."""
+    return (tape_t.at[b].set(row_t),
+            tape_slot.at[b].set(row_s),
+            tape_val.at[b].set(row_v),
+            tpos.at[b].set(jnp.int32(0)))
+
+
+@jax.jit
+def _admit_lane_ew(base_ew2, ew_fleet, ei, ewv, b):
+    """Re-materialize one lane's element-weight row from the shared
+    base table + the admitted spec's indexed payload (scatter-SET, pad
+    slots drop) — clears whatever the lane's previous occupant had."""
+    lane = base_ew2.reshape(-1).at[ei].set(
+        ewv, mode="drop").reshape(base_ew2.shape)
+    return ew_fleet.at[b].set(lane)
 
 
 # ---------------------------------------------------------------------------
@@ -592,12 +661,17 @@ class BatchDrainSim:
                  dtype=np.float64, done_mode: str = "rel",
                  superstep: int = 8, superstep_rounds: int = 0,
                  device=None, v_bound=None, penalty=None, remains=None,
-                 pipeline: int = 0, mesh=None, tapes=None):
+                 pipeline: int = 0, mesh=None, tapes=None,
+                 plan=None, tape_slots: int = 0, start_dead=(),
+                 batch_w: Optional[bool] = None):
         if not overrides:
             raise ValueError("BatchDrainSim needs at least one replica")
         if done_mode not in ("rel", "abs"):
             raise ValueError(f"Unknown done_mode {done_mode!r} "
                              "(expected rel or abs)")
+        #: serving.plancache.CompiledPlan routing the fleet's jitted
+        #: programs through AOT-compiled executables (None = plain jit)
+        self._plan = plan
         self.eps = float(eps)
         self.done_eps = float(done_eps)
         self.done_mode = done_mode
@@ -649,8 +723,11 @@ class BatchDrainSim:
         ew2 = _to2d(np.asarray(e_w, self.dtype))
         # per-replica element weights ride an INDEXED payload and are
         # materialized on device below — the shared 2D table is still
-        # uploaded exactly once whatever B is
-        self.batch_w = any(ov.elem_w for ov in overrides)
+        # uploaded exactly once whatever B is.  ``batch_w=True`` forces
+        # the per-replica tables even when no INITIAL lane overrides
+        # weights, so mid-flight admissions may bring elem_w specs.
+        self.batch_w = (any(ov.elem_w for ov in overrides)
+                        if batch_w is None else bool(batch_w))
         ew_payload = (_pack_elem_w(overrides, ew2.size, self.dtype)
                       if self.batch_w else None)
         if v_bound is not None:
@@ -661,12 +738,18 @@ class BatchDrainSim:
             self.has_bounds = False
 
         ew_dev = self._put_shared(ew2)
+        # base (pre-materialize) weight table + pad index, kept for
+        # per-lane re-materialization on admission
+        self._base_ew_dev = ew_dev
+        self._ew_pad_idx = int(ew2.size)
         if self.batch_w:
             ei_dev, ewv_dev = [self._put_batched(a)
                                for a in ew_payload]
             opstats.bump("uploaded_bytes_delta",
                          sum(a.nbytes for a in ew_payload))
-            ew_dev = _materialize_ew(ew_dev, ei_dev, ewv_dev)
+            ew_dev = self._call_plan(
+                "materialize_ew", _materialize_ew,
+                (self._base_ew_dev, ei_dev, ewv_dev), {})
             opstats.bump("dispatches")
             opstats.bump("batch_dispatches")
             ew_dev = self._pin(ew_dev)
@@ -693,7 +776,10 @@ class BatchDrainSim:
         # one materialization dispatch derives the whole fleet's f64
         # state on device; the dtype cast below mirrors DrainSim's
         # host-side casts exactly (f64 math first, cast second)
-        cb64, sz64, rem64, pen64 = _materialize(*base_dev, *payload_dev)
+        self._base_dev = base_dev
+        cb64, sz64, rem64, pen64 = self._call_plan(
+            "materialize", _materialize,
+            (*base_dev, *payload_dev), {})
         opstats.bump("dispatches")
         opstats.bump("batch_dispatches")
         if done_mode == "rel":
@@ -712,13 +798,31 @@ class BatchDrainSim:
         # shard-local like every other per-replica payload.
         self.has_tape = False
         self._last_fired = False
+        self._tape_width = 0
         if tapes is not None and any(
                 t is not None and len(t[0]) for t in tapes):
+            need = max(len(t[0]) for t in tapes if t is not None)
+        else:
+            need = 0
+            tapes = None
+        # `tape_slots` reserves ring capacity for tapes that arrive
+        # later via admit_lane; only then is the width bucketed to a
+        # power of two, so admissions and warm restarts hit stable
+        # compiled shapes (inf-padded entries never fire —
+        # bit-identity is unaffected).  A fleet whose tapes are all
+        # known at build keeps the exact width: no padding overhead on
+        # the plain batched path.
+        reserving = int(tape_slots) > 0
+        need = max(need, int(tape_slots))
+        if need:
+            if tapes is None:
+                tapes = [None] * self.B
             if len(tapes) != self.B:
                 raise ValueError(f"tapes must have one entry per "
                                  f"replica ({len(tapes)} != {self.B})")
             tapes = list(tapes) + [None] * (self.B_padded - self.B)
-            T = max(len(t[0]) for t in tapes if t is not None)
+            T = _pow2_bucket(need) if reserving else need
+            self._tape_width = T
             tt = np.full((self.B_padded, T), np.inf, np.float64)
             ts = np.full((self.B_padded, T), self.n_c, np.int32)
             tv = np.zeros((self.B_padded, T), np.float64)
@@ -767,6 +871,13 @@ class BatchDrainSim:
         self.replicas = [ReplicaState(b) for b in range(self.B)]
         self._alive = np.zeros(self.B_padded, bool)
         self._alive[:self.B] = True
+        # serving fleets are built wider than their initial spec list:
+        # `start_dead` lanes are dead at birth (k=0, state frozen) and
+        # wait for admit_lane to revive them mid-flight
+        for b in start_dead:
+            self._alive[int(b)] = False
+            self.replicas[int(b)].alive = False
+        self.admitted = 0
         self.pad_events = 0
         self.rescues = 0
         self.supersteps = 0
@@ -811,6 +922,14 @@ class BatchDrainSim:
         if self._mesh is not None:
             return jax.device_put(m, self._bspec)
         return jnp.asarray(m)
+
+    def _call_plan(self, kind: str, fn, args, statics):
+        """Dispatch one fleet program: through the AOT plan cache when
+        the fleet carries a CompiledPlan (warm restarts reuse
+        serialized executables, zero traces), else the plain jit."""
+        if self._plan is not None:
+            return self._plan.call(kind, fn, args, statics)
+        return fn(*args, **statics)
 
     # -- fleet stepping ----------------------------------------------------
 
@@ -864,15 +983,16 @@ class BatchDrainSim:
             t0_in = self._put_batched(t0_in)
         else:
             t0_in = t0
-        pen_out, rem_out, cb_out, tpos_out, packed = _batch_superstep(
-            *self._dev, cb_in, self._vb, pen_in, rem_in,
-            self._thresh, self._ids_dev,
-            self._put_mask(alive), np.int32(k),
-            np.int32(budget), _ZERO_BITS,
-            *self._tape, tpos_in, t0_in,
-            eps=self.eps, n_c=self.n_c, n_v=self.n_v, k_max=k_max,
-            group=group, has_bounds=self.has_bounds,
-            batch_w=self.batch_w, has_tape=self.has_tape)
+        pen_out, rem_out, cb_out, tpos_out, packed = self._call_plan(
+            "superstep", _batch_superstep,
+            (*self._dev, cb_in, self._vb, pen_in, rem_in,
+             self._thresh, self._ids_dev,
+             self._put_mask(alive), np.int32(k),
+             np.int32(budget), _ZERO_BITS,
+             *self._tape, tpos_in, t0_in),
+            dict(eps=self.eps, n_c=self.n_c, n_v=self.n_v, k_max=k_max,
+                 group=group, has_bounds=self.has_bounds,
+                 batch_w=self.batch_w, has_tape=self.has_tape))
         t0_out = None
         if self.has_tape:
             # derive the post-dispatch base clocks DEVICE-side with the
@@ -1011,6 +1131,144 @@ class BatchDrainSim:
             self._superstep_issue_all(k))
         return n_alive
 
+    # -- mid-flight lane admission (serving) -------------------------------
+
+    def admit_lane(self, b: int, overrides: ReplicaOverrides,
+                   tape=None) -> None:
+        """Revive dead lane ``b`` with a NEW scenario, between
+        supersteps: the lane's state row is re-materialized ON DEVICE
+        from the admitted spec's compact payload (O(overrides) upload,
+        the same lane math as fleet birth, so the admitted lane is
+        bit-identical to a solo run of the same spec), its tape slot is
+        replaced and its cursor reset, and its host replica record
+        starts fresh at k=0, t=0.  Raises :class:`AdmissionError` when
+        the fleet's birth-time capacity cannot absorb the scenario
+        (lane alive/out of range, elem_w into a shared-weight fleet,
+        tape wider than the reserved slots).
+
+        The caller must treat a fired admission as a fleet MUTATION:
+        in-flight speculative supersteps assumed the old alive mask and
+        state, so they must be discarded (``run(between=...)`` does
+        this automatically when the hook returns truthy)."""
+        b = int(b)
+        if not 0 <= b < self.B:
+            raise AdmissionError(
+                f"lane {b} out of range (fleet width {self.B})")
+        if self._alive[b]:
+            raise AdmissionError(f"lane {b} is still alive")
+        ov = overrides
+        if ov.elem_w and not self.batch_w:
+            raise AdmissionError(
+                "fleet shares one element-weight table (batch_w "
+                "False); a spec with elem_w overrides needs a fleet "
+                "built with batch_w=True")
+        if tape is not None and not len(tape[0]):
+            tape = None
+        if tape is not None:
+            if not self.has_tape:
+                raise AdmissionError(
+                    "fleet has no tape capacity (built without tapes "
+                    "or tape_slots); a faulted spec cannot be "
+                    "admitted")
+            if len(tape[0]) > self._tape_width:
+                raise AdmissionError(
+                    f"tape with {len(tape[0])} entries exceeds the "
+                    f"fleet's reserved tape width {self._tape_width}")
+        # compact single-lane payload, widths bucketed to powers of two
+        # so repeat admissions reuse a handful of compiled shapes
+        sl = _pow2_bucket(len(ov.link_scale))
+        sf = _pow2_bucket(len(ov.flow_scale))
+        sd = _pow2_bucket(len(ov.dead_flows))
+        li = np.full(sl, self.n_c, np.int32)
+        lf = np.ones(sl, np.float64)
+        fi = np.full(sf, self.n_v, np.int32)
+        ff = np.ones(sf, np.float64)
+        di = np.full(sd, self.n_v, np.int32)
+        for j, slot in enumerate(sorted(ov.link_scale)):
+            li[j] = slot
+            lf[j] = ov.link_scale[slot]
+        for j, slot in enumerate(sorted(ov.flow_scale)):
+            fi[j] = slot
+            ff[j] = ov.flow_scale[slot]
+        for j, slot in enumerate(ov.dead_flows):
+            di[j] = slot
+        opstats.bump("uploaded_bytes_delta",
+                     li.nbytes + lf.nbytes + fi.nbytes + ff.nbytes
+                     + di.nbytes)
+        cb, pen, rem, thresh = self._call_plan(
+            "admit_state", _admit_lane_state,
+            (*self._base_dev, np.float64(ov.bw_scale),
+             np.float64(ov.size_scale), li, lf, fi, ff, di,
+             self._cb, self._pen, self._rem, self._thresh,
+             np.int32(b), np.float64(self.done_eps)),
+            dict(done_rel=self.done_mode == "rel"))
+        self._cb = self._pin(cb)
+        self._pen = self._pin(pen)
+        self._rem = self._pin(rem)
+        self._thresh = self._pin(thresh)
+        opstats.bump("dispatches")
+        opstats.bump("batch_dispatches")
+        if self.has_tape:
+            # always rewrite the lane's tape row — the previous
+            # occupant may have left unfired entries behind
+            T = self._tape_width
+            row_t = np.full(T, np.inf, np.float64)
+            row_s = np.full(T, self.n_c, np.int32)
+            row_v = np.zeros(T, np.float64)
+            if tape is not None:
+                dates = np.asarray(tape[0], np.float64)
+                slots = np.asarray(tape[1], np.int32)
+                vals = np.asarray(tape[2], np.float64)
+                if not (len(dates) == len(slots) == len(vals)):
+                    raise AdmissionError(
+                        "tape arrays must have equal length")
+                if np.any(np.diff(dates) < 0):
+                    raise AdmissionError(
+                        "tape dates must be time-sorted")
+                if np.any((slots < 0) | (slots >= self.n_c)):
+                    raise AdmissionError("tape slot out of range")
+                n = len(dates)
+                row_t[:n] = dates
+                row_s[:n] = slots
+                row_v[:n] = vals
+                opstats.bump("fault_tape_slots", n)
+            # same f64 -> dtype cast order as fleet birth
+            row_vd = row_v.astype(self.dtype)
+            tt, ts, tv, tpos = self._call_plan(
+                "admit_tape", _admit_lane_tape,
+                (*self._tape, self._tpos, row_t, row_s, row_vd,
+                 np.int32(b)), {})
+            self._tape = (self._pin(tt), self._pin(ts), self._pin(tv))
+            self._tpos = self._pin(tpos)
+            opstats.bump("uploaded_bytes_delta",
+                         row_t.nbytes + row_s.nbytes + row_vd.nbytes)
+            opstats.bump("dispatches")
+            opstats.bump("batch_dispatches")
+        if self.batch_w:
+            # re-materialize the lane's weight row from the shared base
+            # + this spec's indexed payload (clears the previous lane)
+            se = _pow2_bucket(len(ov.elem_w))
+            ei = np.full(se, self._ew_pad_idx, np.int32)
+            ewv = np.zeros(se, self.dtype)
+            for j, slot in enumerate(sorted(ov.elem_w)):
+                ei[j] = slot
+                ewv[j] = ov.elem_w[slot]
+            new_ew = self._call_plan(
+                "admit_ew", _admit_lane_ew,
+                (self._base_ew_dev, self._dev[2], ei, ewv,
+                 np.int32(b)), {})
+            self._dev[2] = self._pin(new_ew)
+            opstats.bump("uploaded_bytes_delta",
+                         ei.nbytes + ewv.nbytes)
+            opstats.bump("dispatches")
+            opstats.bump("batch_dispatches")
+        self.overrides[b] = ov
+        self.replicas[b] = ReplicaState(b)
+        self._alive[b] = True
+        self.admitted += 1
+        opstats.bump("lanes_admitted")
+        opstats.bump("batch_replicas")
+
     def _rescue_fused(self, stuck: List[int]) -> None:
         self.rescues += 1
         active = np.zeros(self.B_padded, bool)
@@ -1092,7 +1350,8 @@ class BatchDrainSim:
                                         round_budget=_MAX_ROUNDS)
         self._superstep_collect_all(tok, rescue=True)
 
-    def _run_pipelined(self, max_supersteps: int) -> None:
+    def _run_pipelined(self, max_supersteps: int,
+                       between=None) -> None:
         """The speculative fleet driver: up to ``self.pipeline``
         supersteps in flight beyond the one being collected, FIFO
         collects, discard-on-mutation — the fleet mirror of
@@ -1124,7 +1383,13 @@ class BatchDrainSim:
                 tok = inflight.popleft()
                 _n_alive, clean = self._superstep_collect_all(tok)
                 left -= 1
-                if not clean:
+                # the between-supersteps hook (serving admission): a
+                # truthy return means the hook MUTATED the fleet
+                # (admitted a lane), which forces clean=False — the
+                # in-flight speculation assumed the old alive mask and
+                # state, so it is discarded and replayed
+                mutated = bool(between(self)) if between else False
+                if not clean or mutated:
                     # a lane death/rescue invalidated the in-flight
                     # alive masks, or a tape fire ended the clean
                     # window — discard and replay from committed state
@@ -1136,13 +1401,23 @@ class BatchDrainSim:
             while inflight:
                 self._discard_token(inflight.popleft())
 
-    def run(self, max_supersteps: int = 10_000_000) -> None:
-        """Drain every replica to completion (or error)."""
+    def run(self, max_supersteps: int = 10_000_000,
+            between=None) -> None:
+        """Drain every replica to completion (or error).  ``between``
+        is called after every committed superstep with the sim as its
+        argument (the serving layer's admission window: emit completed
+        lanes, admit queued scenarios via :meth:`admit_lane`); a truthy
+        return marks the fleet mutated, discarding any in-flight
+        speculative supersteps.  The drain continues while the hook
+        revives lanes and returns once every lane is dead and the hook
+        admits nothing more."""
         if self.pipeline:
-            self._run_pipelined(max_supersteps)
+            self._run_pipelined(max_supersteps, between=between)
             return
         while self._alive.any() and max_supersteps > 0:
             self.superstep_all()
+            if between is not None:
+                between(self)
             max_supersteps -= 1
 
     # -- results -----------------------------------------------------------

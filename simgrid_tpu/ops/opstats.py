@@ -89,6 +89,30 @@ context object through the solver entry points:
                               fault-injection signature: capacities
                               changed, topology didn't); subset of
                               ``warm_solves``
+* ``plan_cache_hits`` / ``plan_cache_misses`` — serving AOT plan-cache
+                              lookups (serving.plancache): a hit
+                              reuses a resident or disk-serialized
+                              compiled executable (zero traces), a
+                              miss pays one ``lower().compile()``
+* ``plan_cache_disk_hits``  — the subset of hits deserialized from the
+                              on-disk artifact store (warm restarts)
+* ``plan_compile_ms``       — monotonic milliseconds spent AOT
+                              lowering+compiling on plan-cache misses
+                              (0 on a fully warm restart)
+* ``plan_cache_fallbacks``  — plan-cache dispatches that fell back to
+                              the plain traced jit (unserializable
+                              backend / stale artifact); correctness
+                              never depends on the cache
+* ``lanes_admitted``        — dead fleet lanes revived mid-flight with
+                              a NEW scenario by the serving admission
+                              path (BatchDrainSim.admit_lane)
+* ``serve_device_results``  — queries the campaign service answered
+                              with exact device simulation
+* ``surrogate_answers`` / ``surrogate_escalations`` — queries the
+                              serving surrogate answered from its
+                              conformal-interval prediction vs routed
+                              to the device because the interval was
+                              too wide (exact=True bypasses both)
 
 Counters only ever increase; consumers snapshot before a phase and
 diff after (``snapshot``/``diff``), or wrap the phase in ``scoped``.

@@ -1,38 +1,49 @@
 """Batched scenario campaigns: fleets of what-if simulations drained in
 lockstep device programs.
 
-A :class:`Campaign` turns ONE platform flattening (a pure-drain LMM
-system, captured from a live engine via
-``NetworkCm02Model.capture_drain_scenario()`` or built from arrays)
-plus a list of :class:`ScenarioSpec` records into a replica fleet:
+The campaign layer is STAGED (the serving refactor, ISSUE 11):
 
-* each spec contributes *sweep overrides* (global bandwidth / flow-size
-  multipliers, sparse per-link and per-flow factors, dead flows) and an
-  optional *fault dimension* — a seeded
-  :class:`~simgrid_tpu.faults.FaultCampaign` per replica, so a Monte
-  Carlo fault sweep is just N seeds.  How the schedule is realized is
-  the ``faults/tape`` flag (or the ``fault_mode`` constructor
-  argument): ``on`` (default) compiles it into a device-resident EVENT
-  TAPE — links fail and recover mid-drain at the exact schedule dates,
-  the superstep loop clamping dt so no advance steps over an event —
-  while ``static`` demotes it to the pre-tape time-averaged capacity
-  multipliers (``FaultCampaign.mean_availability``) and ``off``
-  ignores it;
-* the fleet is stepped through :class:`~simgrid_tpu.ops.lmm_batch.
-  BatchDrainSim` in chunks of ``batch`` replicas: one shared platform
-  upload, compact per-replica payloads, lockstep supersteps with an
-  alive mask, and per-replica completion rings demultiplexed back into
-  per-replica event streams;
-* every replica's event order and clocks are bit-identical to the same
-  scenario drained solo (:meth:`Campaign.run_solo` is the oracle the
-  determinism tooling compares against), so batching is purely a
-  throughput choice;
-* ``mesh=M`` shards each fleet's replica axis across M devices
-  (``NamedSharding(mesh, PartitionSpec("batch"))`` on every [B, ·]
-  array, shared flattening replicated — see ops.lmm_batch): campaign
-  throughput then scales with devices, not with Python, and results
-  stay bit-identical to the single-device fleet and to solo runs
-  (``tools/check_determinism.py --runtime-shard``).
+* :class:`ScenarioSpec` — one replica's scenario record, with stable
+  content hashing (:meth:`ScenarioSpec.key`) and JSON round-tripping so
+  specs can travel between processes and index caches;
+* :class:`ScenarioPlan` — the spec-independent middle stage: ONE
+  platform flattening (a pure-drain LMM system, captured from a live
+  engine via ``NetworkCm02Model.capture_drain_scenario()`` or built
+  from arrays) plus solver configuration.  A plan derives per-spec
+  overrides/tapes, owns the content-addressed :meth:`ScenarioPlan.
+  plan_key` ``(topology-hash, layout, dtype, B, superstep, pipeline,
+  mesh, fault_mode)`` that the serving AOT plan cache
+  (``serving/plancache.py``) keys compiled executables by, and builds
+  executors (:meth:`ScenarioPlan.executor`) and solo oracles
+  (:meth:`ScenarioPlan.solo`);
+* :class:`Campaign` — the batch front-end over (plan, specs): the
+  historical API is unchanged (``run_batched``/``run_solo``/
+  ``run_scoped``), base-scenario attributes delegate to the plan.
+
+Each spec contributes *sweep overrides* (global bandwidth / flow-size
+multipliers, sparse per-link and per-flow factors, dead flows) and an
+optional *fault dimension* — a seeded
+:class:`~simgrid_tpu.faults.FaultCampaign` per replica, so a Monte
+Carlo fault sweep is just N seeds.  How the schedule is realized is
+the ``faults/tape`` flag (or the ``fault_mode`` constructor argument):
+``on`` (default) compiles it into a device-resident EVENT TAPE —
+links fail and recover mid-drain at the exact schedule dates, the
+superstep loop clamping dt so no advance steps over an event — while
+``static`` demotes it to the pre-tape time-averaged capacity
+multipliers (``FaultCampaign.mean_availability``) and ``off`` ignores
+it.
+
+The fleet is stepped through :class:`~simgrid_tpu.ops.lmm_batch.
+BatchDrainSim` in chunks of ``batch`` replicas: one shared platform
+upload, compact per-replica payloads, lockstep supersteps with an
+alive mask, and per-replica completion rings demultiplexed back into
+per-replica event streams.  Every replica's event order and clocks are
+bit-identical to the same scenario drained solo
+(:meth:`ScenarioPlan.solo` is the oracle the determinism tooling
+compares against), so batching is purely a throughput choice.
+``mesh=M`` shards each fleet's replica axis across M devices
+(``NamedSharding(mesh, PartitionSpec("batch"))`` on every [B, ·]
+array, shared flattening replicated — see ops.lmm_batch).
 
 The s4u Engine is a process singleton, so replicas are kernel-level
 scenario instances sharing one flattening — the drain phase is where
@@ -41,6 +52,8 @@ fleet scale pays (the maestro loop outside it is per-process).
 
 from __future__ import annotations
 
+import hashlib
+import json
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -56,6 +69,19 @@ from ..ops.lmm_batch import (BatchDrainSim, ReplicaOverrides,
 MIN_LINK_FACTOR = 0.05
 
 
+def _canon_pairs(d: Dict[int, float]) -> List[List[float]]:
+    """Canonical JSON form of a sparse {slot: factor} map: sorted
+    [slot, factor] pairs (dict insertion order must never leak into a
+    content hash)."""
+    return [[int(k), float(d[k])] for k in sorted(d)]
+
+
+def _pairs_to_map(pairs) -> Dict[int, float]:
+    if isinstance(pairs, dict):
+        return {int(k): float(v) for k, v in pairs.items()}
+    return {int(k): float(v) for k, v in (pairs or [])}
+
+
 class ScenarioSpec:
     """One replica's scenario: seed + sweep overrides + fault model.
 
@@ -67,6 +93,12 @@ class ScenarioSpec:
     time-averaged capacity multiplier (``static``, same clamp), or
     nothing (``off``).  Identical seeds give identical scenarios,
     bit-for-bit.
+
+    Specs are content-addressable: :meth:`key` is a stable sha256 over
+    the canonical JSON form (sorted keys, sorted sparse maps, ``label``
+    excluded — it is presentation only), so the same scenario hashes
+    identically across processes and field orderings.  :meth:`to_json`
+    / :meth:`from_json` round-trip the full record including the label.
     """
 
     __slots__ = ("seed", "bw_scale", "size_scale", "link_scale",
@@ -100,6 +132,63 @@ class ScenarioSpec:
         self.fault_horizon = float(fault_horizon)
         self.label = label if label is not None else f"seed{seed}"
 
+    # -- stable serialization / content addressing -------------------------
+
+    def to_dict(self, with_label: bool = True) -> Dict:
+        """Canonical dict form: sparse maps as sorted [slot, factor]
+        pairs, dead flows sorted — a pure function of the scenario
+        CONTENT, independent of construction order."""
+        d = {"seed": self.seed,
+             "bw_scale": self.bw_scale,
+             "size_scale": self.size_scale,
+             "link_scale": _canon_pairs(self.link_scale),
+             "flow_scale": _canon_pairs(self.flow_scale),
+             "dead_flows": sorted(int(s) for s in self.dead_flows),
+             "elem_w": _canon_pairs(self.elem_w),
+             "fault_mtbf": (None if self.fault_mtbf is None
+                            else float(self.fault_mtbf)),
+             "fault_mttr": self.fault_mttr,
+             "fault_dist": str(self.fault_dist),
+             "fault_shape": self.fault_shape,
+             "fault_horizon": self.fault_horizon}
+        if with_label:
+            d["label"] = self.label
+        return d
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True,
+                          separators=(",", ":"))
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "ScenarioSpec":
+        return cls(seed=d.get("seed", 0),
+                   bw_scale=d.get("bw_scale", 1.0),
+                   size_scale=d.get("size_scale", 1.0),
+                   link_scale=_pairs_to_map(d.get("link_scale")),
+                   flow_scale=_pairs_to_map(d.get("flow_scale")),
+                   dead_flows=tuple(int(s)
+                                    for s in d.get("dead_flows", ())),
+                   elem_w=_pairs_to_map(d.get("elem_w")),
+                   fault_mtbf=d.get("fault_mtbf"),
+                   fault_mttr=d.get("fault_mttr", 60.0),
+                   fault_dist=d.get("fault_dist", "exponential"),
+                   fault_shape=d.get("fault_shape", 1.0),
+                   fault_horizon=d.get("fault_horizon", 1000.0),
+                   label=d.get("label"))
+
+    @classmethod
+    def from_json(cls, text: str) -> "ScenarioSpec":
+        return cls.from_dict(json.loads(text))
+
+    def key(self) -> str:
+        """Stable content hash (sha256 hex) of the scenario identity —
+        the ``label`` is excluded, so renaming a query never misses a
+        cache.  Pinned by a regression test: the hash must not move
+        under field reordering or dict-insertion-order changes."""
+        canon = json.dumps(self.to_dict(with_label=False),
+                           sort_keys=True, separators=(",", ":"))
+        return hashlib.sha256(canon.encode("utf-8")).hexdigest()
+
 
 class ReplicaResult:
     """Per-replica campaign outcome (the demultiplexed 'engine')."""
@@ -120,11 +209,35 @@ class ReplicaResult:
         self.fault_events = list(fault_events or [])
 
 
-class Campaign:
-    """A scenario fleet over one shared pure-drain flattening."""
+def _mesh_size(mesh) -> int:
+    """Normalize a mesh argument to its device count for cache keys
+    (0 = unsharded)."""
+    if mesh is None:
+        return 0
+    if isinstance(mesh, int):
+        return int(mesh)
+    try:
+        return int(np.prod(list(mesh.shape.values())))
+    except Exception:
+        return 0
+
+
+class ScenarioPlan:
+    """The spec-independent stage of a campaign: one shared pure-drain
+    flattening + solver configuration.
+
+    A plan (a) derives per-spec scenarios (``overrides_for`` /
+    ``tape_for``), (b) is content-addressed — :meth:`topology_hash`
+    covers the flattening arrays and solver config, :meth:`plan_key`
+    adds the execution shape ``(layout, dtype, B, superstep, pipeline,
+    mesh, fault_mode)`` — so AOT-compiled fleet programs can be cached
+    and reloaded across processes (serving/plancache.py), and (c)
+    builds executors: :meth:`executor` returns a ready
+    :class:`~simgrid_tpu.ops.lmm_batch.BatchDrainSim` fleet,
+    :meth:`solo` runs the bit-identity oracle for one spec.
+    """
 
     def __init__(self, e_var, e_cnst, e_w, c_bound, sizes,
-                 specs: Sequence[ScenarioSpec],
                  remains=None, penalty=None, v_bound=None,
                  link_names: Optional[List[Optional[str]]] = None,
                  eps: float = 1e-9, done_eps: float = 1e-4,
@@ -143,7 +256,6 @@ class Campaign:
         self.v_bound = (np.asarray(v_bound, np.float64)
                         if v_bound is not None else None)
         self.link_names = link_names
-        self.specs = list(specs)
         self.eps = float(eps)
         self.done_eps = float(done_eps)
         self.dtype = np.dtype(dtype)
@@ -167,29 +279,55 @@ class Campaign:
         used = np.zeros(len(self.c_bound), bool)
         used[self.e_cnst[self.e_w > 0]] = True
         self._used_links = np.flatnonzero(used)
+        self._topology_hash: Optional[str] = None
 
-    # -- construction from a live engine ----------------------------------
+    # -- content addressing ------------------------------------------------
 
-    @classmethod
-    def from_engine(cls, model, specs: Sequence[ScenarioSpec], **kw
-                    ) -> "Campaign":
-        """Capture the CURRENT pure-drain phase of a network model (the
-        drain fast path's own preconditions, see
-        ``NetworkCm02Model.capture_drain_scenario``) as the fleet's
-        shared base scenario.  Raises when the phase is not a pure
-        drain — a campaign must start from a well-defined snapshot, not
-        silently diverge from the engine."""
-        snap = model.capture_drain_scenario()
-        if snap is None:
-            raise RuntimeError(
-                "capture_drain_scenario: the current phase is not a "
-                "pure drain (flows still in latency phase, suspended, "
-                "deadlined, or a non-flow variable is live)")
-        return cls(snap["e_var"], snap["e_cnst"], snap["e_w"],
-                   snap["c_bound"], snap["sizes"],
-                   remains=snap["remains"], penalty=snap["penalty"],
-                   v_bound=snap["v_bound"],
-                   link_names=snap["link_names"], specs=specs, **kw)
+    def topology_hash(self) -> str:
+        """Stable sha256 over the shared flattening + solver config:
+        two plans with the same hash trace to byte-identical fleet
+        programs (given the same execution shape — see plan_key)."""
+        if self._topology_hash is None:
+            h = hashlib.sha256()
+            for name, arr in (("e_var", self.e_var),
+                              ("e_cnst", self.e_cnst),
+                              ("e_w", self.e_w),
+                              ("c_bound", self.c_bound),
+                              ("sizes", self.sizes),
+                              ("remains", self.remains),
+                              ("penalty", self.penalty),
+                              ("v_bound", self.v_bound)):
+                h.update(name.encode())
+                if arr is None:
+                    h.update(b"<none>")
+                else:
+                    h.update(str(arr.shape).encode())
+                    h.update(arr.tobytes())
+            names = (list(self.link_names)
+                     if self.link_names is not None else None)
+            h.update(json.dumps(names).encode())
+            h.update(json.dumps([self.eps, self.done_eps,
+                                 self.done_mode]).encode())
+            self._topology_hash = h.hexdigest()
+        return self._topology_hash
+
+    def plan_key(self, batch: int, pipeline: Optional[int] = None,
+                 mesh=None) -> str:
+        """The content-addressed cache key for compiled fleet programs:
+        ``(topology-hash, layout, dtype, B, superstep, pipeline, mesh,
+        fault_mode)`` hashed to one hex digest.  Anything that changes
+        the traced program or the shapes it was specialized for changes
+        the key; anything that doesn't (spec values, labels) doesn't."""
+        from ..utils.config import config
+        depth = self.pipeline if pipeline is None else int(pipeline)
+        use_mesh = self.mesh if mesh is None else mesh
+        canon = json.dumps([self.topology_hash(),
+                            str(config["lmm/layout"]),
+                            self.dtype.name, int(batch),
+                            self.superstep, depth,
+                            _mesh_size(use_mesh), self.fault_mode],
+                           separators=(",", ":"))
+        return hashlib.sha256(canon.encode("utf-8")).hexdigest()
 
     # -- per-spec scenario derivation --------------------------------------
 
@@ -215,6 +353,16 @@ class Campaign:
                         mttr=spec.fault_mttr, dist=spec.fault_dist,
                         shape=spec.fault_shape)
         return fc, names
+
+    def tape_len(self, spec: ScenarioSpec) -> int:
+        """Number of event-tape entries this spec's seeded schedule
+        would compile to (0 when the fault dimension is off for this
+        plan/spec).  Cheap capacity probe for admission sizing — no
+        replica arrays are derived."""
+        if self.fault_mode != "on" or spec.fault_mtbf is None:
+            return 0
+        fc, _ = self._fault_campaign(spec)
+        return fc.tape_len(floor=MIN_LINK_FACTOR)
 
     def overrides_for(self, spec: ScenarioSpec) -> ReplicaOverrides:
         """Fold one spec's sweep overrides — and, in ``static`` fault
@@ -271,54 +419,59 @@ class Campaign:
             v[i] = cb[slot] * factor
         return t, s, v
 
-    # -- execution ---------------------------------------------------------
+    # -- executors ---------------------------------------------------------
 
-    def run_batched(self, batch: int = 64, superstep_rounds: int = 0,
-                    pipeline: Optional[int] = None, mesh=None
-                    ) -> List[ReplicaResult]:
-        """Drain the whole fleet in chunks of ``batch`` replicas, each
-        chunk one BatchDrainSim (one shared upload, lockstep
-        supersteps).  Results come back in spec order; chunking is
-        invisible to results — lanes are independent.  ``pipeline``
-        overrides the campaign's speculative-superstep depth and
-        ``mesh`` its replica-axis device sharding for this run
-        (bit-identical results either way)."""
+    def executor(self, specs: Sequence[ScenarioSpec],
+                 width: Optional[int] = None,
+                 superstep_rounds: int = 0,
+                 pipeline: Optional[int] = None, mesh=None,
+                 plan_cache=None, tape_slots: int = 0,
+                 batch_w: Optional[bool] = None) -> BatchDrainSim:
+        """Build one ready fleet executor for ``specs``.  ``width``
+        sizes the fleet wider than the initial spec list — the extra
+        lanes are dead from birth and available for mid-flight
+        admission (serving).  ``plan_cache`` (a serving.plancache.
+        PlanCache) routes the fleet's jitted programs through
+        AOT-compiled executables keyed by :meth:`plan_key`."""
+        specs = list(specs)
+        width = len(specs) if width is None else int(width)
+        if width < len(specs):
+            raise ValueError("executor width smaller than spec count")
+        overrides = [self.overrides_for(s) for s in specs]
+        overrides += [ReplicaOverrides()
+                      for _ in range(width - len(specs))]
+        tapes = [self.tape_for(s) for s in specs]
+        tapes += [None] * (width - len(specs))
+        if not any(t is not None for t in tapes) and not tape_slots:
+            tapes = None
         depth = self.pipeline if pipeline is None else int(pipeline)
         use_mesh = self.mesh if mesh is None else mesh
-        results: List[ReplicaResult] = []
-        for start in range(0, len(self.specs), max(1, int(batch))):
-            chunk_specs = self.specs[start:start + max(1, int(batch))]
-            overrides = [self.overrides_for(s) for s in chunk_specs]
-            tapes = [self.tape_for(s) for s in chunk_specs]
-            if not any(t is not None for t in tapes):
-                tapes = None
-            sim = BatchDrainSim(
-                self.e_var, self.e_cnst, self.e_w, self.c_bound,
-                self.sizes, overrides, eps=self.eps,
-                done_eps=self.done_eps, dtype=self.dtype,
-                done_mode=self.done_mode, superstep=self.superstep,
-                superstep_rounds=superstep_rounds,
-                v_bound=self.v_bound, penalty=self.penalty,
-                remains=self.remains, pipeline=depth,
-                mesh=use_mesh, tapes=tapes)
-            sim.run()
-            for b, spec in enumerate(chunk_specs):
-                rep = sim.replicas[b]
-                results.append(ReplicaResult(
-                    spec, rep.events, rep.t, rep.advances, rep.error,
-                    fault_events=rep.fault_events))
-        return results
+        compiled = None
+        if plan_cache is not None:
+            compiled = plan_cache.plan(
+                self.plan_key(width, pipeline=depth, mesh=use_mesh))
+        return BatchDrainSim(
+            self.e_var, self.e_cnst, self.e_w, self.c_bound,
+            self.sizes, overrides, eps=self.eps,
+            done_eps=self.done_eps, dtype=self.dtype,
+            done_mode=self.done_mode, superstep=self.superstep,
+            superstep_rounds=superstep_rounds,
+            v_bound=self.v_bound, penalty=self.penalty,
+            remains=self.remains, pipeline=depth, mesh=use_mesh,
+            tapes=tapes, plan=compiled, tape_slots=tape_slots,
+            start_dead=tuple(range(len(specs), width)),
+            batch_w=batch_w)
 
-    def run_solo(self, index: int,
-                 superstep_rounds: int = 0) -> ReplicaResult:
-        """Drain ONE replica with the solo executor
+    def solo(self, spec: ScenarioSpec,
+             superstep_rounds: int = 0) -> ReplicaResult:
+        """Drain ONE spec with the solo executor
         (ops.lmm_drain.DrainSim) over host-derived scenario arrays —
-        the bit-identity oracle for the batched path.  Repacks are
-        disabled to match the fleet's lockstep (fixed-shape) program;
-        event order and clocks are repack-invariant anyway, but the
-        oracle keeps the dispatch structure aligned too."""
+        the bit-identity oracle for the batched AND served paths.
+        Repacks are disabled to match the fleet's lockstep
+        (fixed-shape) program; event order and clocks are
+        repack-invariant anyway, but the oracle keeps the dispatch
+        structure aligned too."""
         from ..ops.lmm_drain import DrainSim
-        spec = self.specs[index]
         ov = self.overrides_for(spec)
         base_rem = (self.remains if self.remains is not None
                     else self.sizes)
@@ -345,6 +498,94 @@ class Campaign:
         return ReplicaResult(spec, sim.events, sim.t, sim.advances,
                              error, fault_events=sim.fault_events)
 
+
+class Campaign:
+    """A scenario fleet over one shared pure-drain flattening: the
+    batch front-end over ``(ScenarioPlan, specs)``.  Base-scenario
+    attributes and derivations (``e_var`` ... ``fault_mode``,
+    ``overrides_for``, ``tape_for``) delegate to :attr:`plan`."""
+
+    def __init__(self, e_var, e_cnst, e_w, c_bound, sizes,
+                 specs: Sequence[ScenarioSpec],
+                 remains=None, penalty=None, v_bound=None,
+                 link_names: Optional[List[Optional[str]]] = None,
+                 eps: float = 1e-9, done_eps: float = 1e-4,
+                 dtype=np.float64, done_mode: str = "rel",
+                 superstep: int = 8, pipeline: int = 0, mesh=None,
+                 fault_mode: Optional[str] = None, plan_cache=None):
+        self.plan = ScenarioPlan(
+            e_var, e_cnst, e_w, c_bound, sizes, remains=remains,
+            penalty=penalty, v_bound=v_bound, link_names=link_names,
+            eps=eps, done_eps=done_eps, dtype=dtype,
+            done_mode=done_mode, superstep=superstep,
+            pipeline=pipeline, mesh=mesh, fault_mode=fault_mode)
+        self.specs = list(specs)
+        #: optional serving.plancache.PlanCache: when set, fleet
+        #: programs run through AOT-compiled executables keyed by the
+        #: plan key (warm restarts skip tracing entirely)
+        self.plan_cache = plan_cache
+
+    def __getattr__(self, name: str):
+        # base-scenario attributes live on the plan stage since the
+        # serving split; the pre-refactor Campaign carried them
+        # directly, so delegate to keep the historical surface
+        plan = self.__dict__.get("plan")
+        if plan is None or name.startswith("__"):
+            raise AttributeError(name)
+        return getattr(plan, name)
+
+    # -- construction from a live engine ----------------------------------
+
+    @classmethod
+    def from_engine(cls, model, specs: Sequence[ScenarioSpec], **kw
+                    ) -> "Campaign":
+        """Capture the CURRENT pure-drain phase of a network model (the
+        drain fast path's own preconditions, see
+        ``NetworkCm02Model.capture_drain_scenario``) as the fleet's
+        shared base scenario.  Raises when the phase is not a pure
+        drain — a campaign must start from a well-defined snapshot, not
+        silently diverge from the engine."""
+        snap = capture_plan_snapshot(model)
+        return cls(snap["e_var"], snap["e_cnst"], snap["e_w"],
+                   snap["c_bound"], snap["sizes"],
+                   remains=snap["remains"], penalty=snap["penalty"],
+                   v_bound=snap["v_bound"],
+                   link_names=snap["link_names"], specs=specs, **kw)
+
+    # -- execution ---------------------------------------------------------
+
+    def run_batched(self, batch: int = 64, superstep_rounds: int = 0,
+                    pipeline: Optional[int] = None, mesh=None
+                    ) -> List[ReplicaResult]:
+        """Drain the whole fleet in chunks of ``batch`` replicas, each
+        chunk one BatchDrainSim (one shared upload, lockstep
+        supersteps).  Results come back in spec order; chunking is
+        invisible to results — lanes are independent.  ``pipeline``
+        overrides the campaign's speculative-superstep depth and
+        ``mesh`` its replica-axis device sharding for this run
+        (bit-identical results either way)."""
+        results: List[ReplicaResult] = []
+        for start in range(0, len(self.specs), max(1, int(batch))):
+            chunk_specs = self.specs[start:start + max(1, int(batch))]
+            sim = self.plan.executor(
+                chunk_specs, superstep_rounds=superstep_rounds,
+                pipeline=pipeline, mesh=mesh,
+                plan_cache=self.plan_cache)
+            sim.run()
+            for b, spec in enumerate(chunk_specs):
+                rep = sim.replicas[b]
+                results.append(ReplicaResult(
+                    spec, rep.events, rep.t, rep.advances, rep.error,
+                    fault_events=rep.fault_events))
+        return results
+
+    def run_solo(self, index: int,
+                 superstep_rounds: int = 0) -> ReplicaResult:
+        """The bit-identity oracle for spec ``index`` — see
+        :meth:`ScenarioPlan.solo`."""
+        return self.plan.solo(self.specs[index],
+                              superstep_rounds=superstep_rounds)
+
     def run_scoped(self, batch: int, stage: str,
                    pipeline: Optional[int] = None, mesh=None
                    ) -> Tuple[List[ReplicaResult], Dict[str, float]]:
@@ -356,3 +597,16 @@ class Campaign:
             results = self.run_batched(batch=batch, pipeline=pipeline,
                                        mesh=mesh)
         return results, stats
+
+
+def capture_plan_snapshot(model) -> Dict:
+    """Capture the current pure-drain phase of a live network model as
+    the array dict ScenarioPlan/Campaign construct from.  Raises when
+    the phase is not a pure drain."""
+    snap = model.capture_drain_scenario()
+    if snap is None:
+        raise RuntimeError(
+            "capture_drain_scenario: the current phase is not a "
+            "pure drain (flows still in latency phase, suspended, "
+            "deadlined, or a non-flow variable is live)")
+    return snap

@@ -1,0 +1,35 @@
+"""Always-on campaign serving: AOT plan cache, streaming scenario
+queue with mid-flight admission, and surrogate triage.
+
+The batch CLI (tools/campaign_run.py) re-pays platform flattening and
+XLA tracing on every invocation — a non-starter for serving millions
+of what-if queries.  This package turns the staged campaign layer
+(``parallel.campaign``: spec → :class:`~simgrid_tpu.parallel.campaign.
+ScenarioPlan` → executor) into a persistent service:
+
+* :mod:`.plancache` — content-addressed AOT compilation cache:
+  ``jit(...).lower().compile()`` once per plan key, executables kept
+  resident and serialized to disk so warm restarts skip tracing
+  entirely;
+* :mod:`.service` — :class:`~simgrid_tpu.serving.service.
+  CampaignService`: ``submit(spec) -> ticket``, live fleets with
+  admission batching (arriving queries revive dead lanes between
+  supersteps, bit-identical to solo runs), streaming per-replica
+  results;
+* :mod:`.surrogate` — SMART-style triage: a ridge predictor with
+  conformal intervals answers low-stakes queries from completed-row
+  history; wide-interval or ``exact=True`` queries go to the device.
+
+Standing invariant: every device-served result — including scenarios
+admitted mid-flight into a partially-drained fleet — is bit-identical
+(events, fault streams, Kahan clocks) to ``ScenarioPlan.solo`` on the
+same spec (``tools/check_determinism.py --runtime-serve``).
+"""
+
+from .plancache import CompiledPlan, PlanCache
+from .service import CampaignService, ServiceResult, Ticket
+from .surrogate import RuntimeSurrogate, SurrogateAnswer
+
+__all__ = ["PlanCache", "CompiledPlan", "CampaignService",
+           "ServiceResult", "Ticket", "RuntimeSurrogate",
+           "SurrogateAnswer"]

@@ -1,0 +1,304 @@
+"""The always-on campaign service: a persistent scenario queue over
+live fleets with mid-flight admission and surrogate triage.
+
+``submit(spec) -> Ticket`` enqueues one what-if query.  Low-stakes
+queries (``exact=False``) are first offered to the surrogate
+(:mod:`.surrogate`): a tight-interval prediction answers immediately
+with ``source="surrogate"`` + conformal bounds; wide-interval queries
+escalate to the device path.  ``exact=True`` always bypasses the
+surrogate.
+
+Device-path queries run on a resident :class:`~simgrid_tpu.ops.
+lmm_batch.BatchDrainSim` fleet whose programs route through the AOT
+plan cache (:mod:`.plancache`) — a warm restart performs zero XLA
+traces.  ADMISSION BATCHING packs arriving queries into
+partially-filled fleets: the service drives the fleet with
+``run(between=...)`` and, between supersteps, (a) emits finished lanes
+as streaming per-replica results and (b) revives dead lanes with
+queued scenarios via ``admit_lane`` — an O(overrides) device scatter;
+the admitted lane starts at its own k=0 with a fresh tape slot.  A
+fired admission marks the fleet mutated, so in-flight pipeline
+speculation discards and replays — preserving the standing invariant:
+an admitted scenario's events, fault streams and Kahan clocks are
+bit-identical to ``ScenarioPlan.solo`` on the same spec
+(``tools/check_determinism.py --runtime-serve``).
+
+Scenarios the live fleet cannot absorb (fault tape wider than the
+fleet's reserved slots, elem_w into a shared-weight fleet) are
+DEFERRED, not failed: they stay queued and the next fleet is sized for
+them at birth.
+
+The service is single-threaded and deterministic — ordering comes from
+the submit order and the fleet's lockstep supersteps, never from
+wall-clock races.  Wall-clock enters only as latency METADATA on
+tickets (``time.perf_counter``).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ..ops import opstats
+from ..ops.lmm_batch import AdmissionError
+from ..parallel.campaign import ScenarioPlan, ScenarioSpec
+from .plancache import PlanCache
+from .surrogate import RuntimeSurrogate
+
+
+class ServiceResult:
+    """One answered query.  ``source`` is the audit field: ``"device"``
+    results carry the exact event stream / clocks; ``"surrogate"``
+    results carry the conformal interval they were stated at."""
+
+    __slots__ = ("source", "t", "lo", "hi", "confidence", "events",
+                 "fault_events", "advances", "error")
+
+    def __init__(self, source: str, t: float, lo: float = None,
+                 hi: float = None, confidence: float = None,
+                 events=None, fault_events=None, advances: int = 0,
+                 error: Optional[str] = None):
+        self.source = source
+        self.t = t
+        self.lo = lo
+        self.hi = hi
+        self.confidence = confidence
+        self.events = events
+        self.fault_events = fault_events
+        self.advances = advances
+        self.error = error
+
+
+class Ticket:
+    """One submitted query's handle: spec, routing, and (once
+    answered) the result plus submit→done latency metadata."""
+
+    __slots__ = ("id", "spec", "exact", "status", "result", "lane",
+                 "submitted_at", "done_at", "defer_reason")
+
+    def __init__(self, tid: int, spec: ScenarioSpec, exact: bool):
+        self.id = tid
+        self.spec = spec
+        self.exact = exact
+        self.status = "queued"
+        self.result: Optional[ServiceResult] = None
+        self.lane: Optional[int] = None
+        self.submitted_at = time.perf_counter()
+        self.done_at: Optional[float] = None
+        self.defer_reason: Optional[str] = None
+
+    @property
+    def latency_ms(self) -> Optional[float]:
+        if self.done_at is None:
+            return None
+        return (self.done_at - self.submitted_at) * 1e3
+
+
+class CampaignService:
+    """A persistent scenario service over one :class:`ScenarioPlan`.
+
+    ``batch`` is the resident fleet width (default: the
+    ``serve/batch`` flag).  ``plan_cache`` routes fleet programs
+    through AOT executables; ``surrogate`` (pass a
+    :class:`RuntimeSurrogate`, or None for device-only) enables
+    triage; ``corpus_log`` appends every device-served row as jsonl so
+    future processes can seed their surrogate from it."""
+
+    def __init__(self, plan: ScenarioPlan, batch: Optional[int] = None,
+                 plan_cache: Optional[PlanCache] = None,
+                 surrogate: Optional[RuntimeSurrogate] = None,
+                 corpus_log: Optional[str] = None,
+                 pipeline: Optional[int] = None, mesh=None):
+        from ..utils.config import config
+        self.plan = plan
+        self.batch = int(config["serve/batch"] if batch is None
+                         else batch)
+        if self.batch <= 0:
+            raise ValueError("service batch must be >= 1")
+        if plan_cache is None and str(config["serve/plan-cache"]):
+            plan_cache = PlanCache(str(config["serve/plan-cache"]))
+        self.plan_cache = plan_cache
+        self.surrogate = surrogate
+        self.corpus_log = corpus_log
+        self.pipeline = pipeline
+        self.mesh = mesh
+        self.tickets: List[Ticket] = []
+        self.completed: List[Ticket] = []
+        self._queue: List[Ticket] = []
+        self._fleet = None
+        self._lane_tickets: List[Optional[Ticket]] = []
+        # service-lifetime counters (fleet counters are aggregated in
+        # on retire; see counters())
+        self.fleets = 0
+        self.lanes_admitted = 0
+        self.surrogate_answers = 0
+        self.surrogate_escalations = 0
+        self.deferrals = 0
+        self.spec_issued = 0
+        self.spec_committed = 0
+        self.spec_rolled_back = 0
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, spec: ScenarioSpec,
+               exact: bool = False) -> Ticket:
+        """Enqueue one query.  Surrogate triage happens HERE — a
+        tight-interval prediction answers without touching the queue;
+        ``exact=True`` always bypasses it."""
+        t = Ticket(len(self.tickets), spec, bool(exact))
+        self.tickets.append(t)
+        if not exact and self.surrogate is not None:
+            ans = self.surrogate.triage(spec)
+            if ans is not None:
+                t.result = ServiceResult(
+                    "surrogate", ans.t, lo=ans.lo, hi=ans.hi,
+                    confidence=ans.confidence)
+                t.status = "done"
+                t.done_at = time.perf_counter()
+                self.surrogate_answers += 1
+                opstats.bump("surrogate_answers")
+                self.completed.append(t)
+                return t
+            self.surrogate_escalations += 1
+            opstats.bump("surrogate_escalations")
+        self._queue.append(t)
+        return t
+
+    def submit_many(self, specs: Sequence[ScenarioSpec],
+                    exact: bool = False) -> List[Ticket]:
+        return [self.submit(s, exact=exact) for s in specs]
+
+    def pending(self) -> int:
+        return len(self._queue) + sum(
+            1 for t in self._lane_tickets if t is not None)
+
+    # -- the drive loop ----------------------------------------------------
+
+    def _start_fleet(self) -> None:
+        """Build a resident fleet from the queue head: up to ``batch``
+        initial lanes, the rest of the width dead-at-birth and open
+        for admission.  Capacity for LATER admissions is reserved at
+        birth — tape slots sized by probing every queued faulted
+        spec's schedule length, per-replica weight tables forced when
+        any queued spec overrides element weights."""
+        take = self._queue[:self.batch]
+        del self._queue[:len(take)]
+        tape_slots = 0
+        need_batch_w = False
+        for t in take + self._queue:
+            if t.spec.fault_mtbf is not None:
+                tape_slots = max(tape_slots,
+                                 self.plan.tape_len(t.spec))
+            if t.spec.elem_w:
+                need_batch_w = True
+        self._fleet = self.plan.executor(
+            [t.spec for t in take], width=self.batch,
+            plan_cache=self.plan_cache, tape_slots=tape_slots,
+            batch_w=True if need_batch_w else None,
+            pipeline=self.pipeline, mesh=self.mesh)
+        self._lane_tickets = (list(take)
+                              + [None] * (self.batch - len(take)))
+        for b, t in enumerate(take):
+            t.lane = b
+        self.fleets += 1
+
+    def _emit_completions(self, sim) -> None:
+        """Stream finished lanes out as device results: feed the
+        surrogate corpus, free the lane for admission."""
+        for b in range(sim.B):
+            t = self._lane_tickets[b]
+            if t is None or sim._alive[b]:
+                continue
+            rep = sim.replicas[b]
+            t.result = ServiceResult(
+                "device", rep.t, events=list(rep.events),
+                fault_events=list(rep.fault_events),
+                advances=rep.advances, error=rep.error)
+            t.status = "done"
+            t.done_at = time.perf_counter()
+            self.completed.append(t)
+            self._lane_tickets[b] = None
+            opstats.bump("serve_device_results")
+            if rep.error is None:
+                if self.surrogate is not None:
+                    self.surrogate.observe(t.spec, rep.t)
+                if self.corpus_log:
+                    with open(self.corpus_log, "a") as f:
+                        f.write(json.dumps(
+                            {"spec": t.spec.to_dict(), "t": rep.t,
+                             "source": "device"}) + "\n")
+
+    def _admit(self, sim) -> bool:
+        """Pack queued queries into the fleet's free (dead, emitted)
+        lanes.  Scenarios the fleet cannot absorb are deferred — they
+        stay queued for the next fleet, sized for them at birth."""
+        admitted = False
+        free = [b for b in range(sim.B)
+                if self._lane_tickets[b] is None and not sim._alive[b]]
+        if not free or not self._queue:
+            return False
+        remaining: List[Ticket] = []
+        for t in self._queue:
+            if not free:
+                remaining.append(t)
+                continue
+            b = free[0]
+            try:
+                sim.admit_lane(b, self.plan.overrides_for(t.spec),
+                               tape=self.plan.tape_for(t.spec))
+            except AdmissionError as exc:
+                t.defer_reason = str(exc)
+                self.deferrals += 1
+                remaining.append(t)
+                continue
+            free.pop(0)
+            t.lane = b
+            self._lane_tickets[b] = t
+            self.lanes_admitted += 1
+            admitted = True
+        self._queue = remaining
+        return admitted
+
+    def _on_superstep(self, sim) -> bool:
+        self._emit_completions(sim)
+        return self._admit(sim)
+
+    def _retire_fleet(self) -> None:
+        sim = self._fleet
+        self.spec_issued += sim.spec_issued
+        self.spec_committed += sim.spec_committed
+        self.spec_rolled_back += sim.spec_rolled_back
+        self._fleet = None
+        self._lane_tickets = []
+
+    def drain(self) -> List[Ticket]:
+        """Serve every queued query to completion and return ALL
+        completed tickets so far, in completion order.  Fleets are
+        recycled: one stays resident while admissions keep it fed;
+        deferred (capacity-misfit) scenarios get a fresh fleet sized
+        for them once the current one drains dry."""
+        while self._queue or self._fleet is not None:
+            if self._fleet is None:
+                self._start_fleet()
+            self._fleet.run(between=self._on_superstep)
+            # fleet ran dry: everything alive finished and nothing
+            # more could be admitted — final sweep, then retire
+            self._emit_completions(self._fleet)
+            self._retire_fleet()
+        return list(self.completed)
+
+    # -- introspection -----------------------------------------------------
+
+    def counters(self) -> Dict[str, float]:
+        c = {"fleets": self.fleets,
+             "lanes_admitted": self.lanes_admitted,
+             "surrogate_answers": self.surrogate_answers,
+             "surrogate_escalations": self.surrogate_escalations,
+             "deferrals": self.deferrals,
+             "spec_issued": self.spec_issued,
+             "spec_committed": self.spec_committed,
+             "spec_rolled_back": self.spec_rolled_back}
+        if self.plan_cache is not None:
+            c.update(self.plan_cache.stats())
+        return c

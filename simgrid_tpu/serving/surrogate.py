@@ -1,0 +1,252 @@
+"""SMART-style surrogate triage: a cheap deterministic predictor over
+completed campaign rows answers low-stakes runtime queries; exact
+device simulation is reserved for the uncertain tail.
+
+The model is closed-form ridge regression (numpy ``lstsq`` on the
+regularized normal equations — no iterative fitting, no RNG) over
+features derived from the :class:`~simgrid_tpu.parallel.campaign.
+ScenarioSpec` alone, predicting the scenario's final drain clock
+``t``.  Uncertainty is a SPLIT-CONFORMAL interval: a deterministic
+index-striped calibration subset is held out of the fit and the
+``confidence`` quantile of its absolute residuals becomes the
+half-width — distribution-free coverage, no Gaussian assumption.
+Both the fit and the calibration are MONDRIAN (group-conditional) on
+the fault indicator: a faulted scenario's realized schedule depends
+on its seed, which the features cannot see, so faulted clocks are
+irreducible noise to the model — in a joint fit that noise drags the
+shared weights and inflates CLEAN residuals by orders of magnitude
+(one global quantile then vetoes every answer).  Fitting each group
+its own weights + quantile keeps the clean family sharp: in-family
+clean queries answer, faulted ones honestly escalate to the device.
+
+Triage policy (:meth:`RuntimeSurrogate.triage`): answer only when the
+model is fitted AND the conformal interval is tight relative to the
+prediction (``width <= max(abs_tol, rel_tol * |t|)``); otherwise
+return None and the service escalates to the device path.  Every
+answer carries ``source="surrogate"`` plus its bounds downstream
+(:class:`~simgrid_tpu.serving.service.ServiceResult`), so callers can
+audit exactly which results were predicted rather than simulated.
+
+The corpus seeds from ``bench_results/*.jsonl`` (rows carrying a spec
+dict + final clock) and grows with every device-served result the
+:class:`~simgrid_tpu.serving.service.CampaignService` completes.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..parallel.campaign import ScenarioSpec
+
+#: feature-vector layout version (corpus rows don't store features —
+#: they are re-derived from specs — but refits must stay comparable)
+N_FEATURES = 11
+
+#: index of the fault-indicator feature — the Mondrian calibration
+#: group (seed-realized fault schedules are invisible to the features,
+#: so faulted rows get their own conformal quantile)
+FAULT_FEATURE = 8
+
+
+def spec_features(spec: ScenarioSpec) -> np.ndarray:
+    """Deterministic f64 feature vector of one scenario.  The dominant
+    physics of a drain is work/capacity, so the leading features are
+    the size/bandwidth ratio and its components; sparse maps enter
+    through order-independent summaries (sorted before reduction — a
+    float sum must not depend on dict insertion order)."""
+    bw = max(spec.bw_scale, 1e-12)
+    ls = sorted(spec.link_scale.values()) or [1.0]
+    fs = sorted(spec.flow_scale.values()) or [1.0]
+    mtbf = spec.fault_mtbf
+    return np.array([
+        1.0,
+        spec.size_scale / bw,
+        spec.size_scale,
+        1.0 / bw,
+        float(ls[0]),
+        float(np.mean(ls)),
+        float(np.mean(fs)),
+        float(len(spec.dead_flows)),
+        0.0 if mtbf is None else 1.0,
+        0.0 if mtbf is None else spec.fault_horizon / max(mtbf, 1e-12),
+        0.0 if mtbf is None else spec.fault_mttr / max(mtbf, 1e-12),
+    ], np.float64)
+
+
+class SurrogateAnswer:
+    """One surrogate prediction with its conformal interval."""
+
+    __slots__ = ("t", "lo", "hi", "confidence", "n_train")
+
+    def __init__(self, t: float, lo: float, hi: float,
+                 confidence: float, n_train: int):
+        self.t = float(t)
+        self.lo = float(lo)
+        self.hi = float(hi)
+        self.confidence = float(confidence)
+        self.n_train = int(n_train)
+
+
+class RuntimeSurrogate:
+    """Ridge + split-conformal predictor of scenario drain clocks.
+
+    ``min_corpus`` gates the first fit; after that the model refits
+    every ``refit_every`` new observations (cheap: one 11×11 solve).
+    ``rel_tol``/``abs_tol`` bound the interval width the triage will
+    answer at; ``confidence`` is the conformal coverage level.
+    Everything is deterministic — same corpus, same answers."""
+
+    def __init__(self, alpha: float = 1e-3, min_corpus: int = 24,
+                 rel_tol: float = 0.1, abs_tol: float = 0.0,
+                 confidence: float = 0.9, refit_every: int = 8):
+        self.alpha = float(alpha)
+        self.min_corpus = int(min_corpus)
+        self.rel_tol = float(rel_tol)
+        self.abs_tol = float(abs_tol)
+        self.confidence = float(confidence)
+        self.refit_every = max(1, int(refit_every))
+        self._X: List[np.ndarray] = []
+        self._y: List[float] = []
+        #: Mondrian per-group (ridge weights, conformal half-width),
+        #: keyed by the query's fault-indicator group (None = that
+        #: group never fit usably and escalates)
+        self._models: Optional[
+            Dict[bool, Optional[Tuple[np.ndarray, float]]]] = None
+        self._fit_n = 0
+
+    # -- corpus ------------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._y)
+
+    def observe(self, spec: ScenarioSpec, t: float) -> None:
+        """Append one completed (spec, final clock) row and refit when
+        enough new rows accumulated."""
+        if not math.isfinite(t):
+            return
+        self._X.append(spec_features(spec))
+        self._y.append(float(t))
+        n = len(self._y)
+        if n >= self.min_corpus and n - self._fit_n >= self.refit_every:
+            self.fit()
+
+    def load_corpus(self, paths) -> int:
+        """Seed the corpus from jsonl files (``bench_results/*.jsonl``
+        or a service's own corpus log): any row — at top level or
+        under ``payload`` — carrying a spec dict and a finite ``t`` is
+        adopted.  Returns the number of rows loaded."""
+        if isinstance(paths, (str, os.PathLike)):
+            paths = [paths]
+        loaded = 0
+        for path in paths:
+            if not os.path.exists(path):
+                continue
+            with open(path, "r") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        row = json.loads(line)
+                    except ValueError:
+                        continue
+                    for rec in (row, row.get("payload")
+                                if isinstance(row, dict) else None):
+                        if not isinstance(rec, dict):
+                            continue
+                        spec_d = rec.get("spec")
+                        t = rec.get("t")
+                        if (isinstance(spec_d, dict)
+                                and isinstance(t, (int, float))
+                                and math.isfinite(float(t))):
+                            self._X.append(spec_features(
+                                ScenarioSpec.from_dict(spec_d)))
+                            self._y.append(float(t))
+                            loaded += 1
+                            break
+        if len(self._y) >= self.min_corpus:
+            self.fit()
+        return loaded
+
+    # -- fitting -----------------------------------------------------------
+
+    def fit(self) -> bool:
+        """Refit one (ridge weights, conformal half-width) model PER
+        fault-indicator group.  Within a group, every 4th row is the
+        calibration stripe (deterministic, no RNG); the finite-sample
+        conformal rank ``ceil((n_g + 1) * conf)`` of its absolute
+        residuals is the half-width.  A group with too few train rows
+        (< n_features) or no valid rank stays None and escalates.
+        Returns True when at least one group is usable."""
+        n = len(self._y)
+        if n < self.min_corpus:
+            return False
+        X = np.stack(self._X)
+        y = np.asarray(self._y, np.float64)
+        faulted = X[:, FAULT_FEATURE] > 0.5
+        models: Dict[bool, Optional[Tuple[np.ndarray, float]]] = {}
+        for group in (False, True):
+            Xg, yg = X[faulted == group], y[faulted == group]
+            calib = np.arange(len(yg)) % 4 == 3
+            Xt, yt = Xg[~calib], yg[~calib]
+            Xc, yc = Xg[calib], yg[calib]
+            if len(yt) < X.shape[1] or not len(yc):
+                models[group] = None
+                continue
+            # ridge normal equations; lstsq for rank-deficient stripes
+            A = Xt.T @ Xt + self.alpha * np.eye(X.shape[1])
+            b = Xt.T @ yt
+            try:
+                w = np.linalg.solve(A, b)
+            except np.linalg.LinAlgError:
+                w = np.linalg.lstsq(A, b, rcond=None)[0]
+            resid = np.sort(np.abs(Xc @ w - yc))
+            rank = int(math.ceil((len(resid) + 1) * self.confidence))
+            models[group] = ((w, float(resid[rank - 1]))
+                             if 0 < rank <= len(resid) else None)
+        if all(m is None for m in models.values()):
+            return False
+        self._models = models
+        self._fit_n = n
+        return True
+
+    @property
+    def fitted(self) -> bool:
+        return (self._models is not None
+                and any(m is not None for m in self._models.values()))
+
+    # -- answering ---------------------------------------------------------
+
+    def predict(self, spec: ScenarioSpec
+                ) -> Optional[SurrogateAnswer]:
+        """Point prediction + conformal interval from the query's
+        GROUP model, or None before the first successful fit / when
+        the query's group never accumulated enough rows."""
+        if not self.fitted:
+            return None
+        model = self._models[spec.fault_mtbf is not None]
+        if model is None:
+            return None
+        w, q = model
+        t = float(spec_features(spec) @ w)
+        return SurrogateAnswer(t, t - q, t + q,
+                               self.confidence, self._fit_n)
+
+    def triage(self, spec: ScenarioSpec
+               ) -> Optional[SurrogateAnswer]:
+        """The serving decision: the answer when the interval is tight
+        enough to state with confidence, else None (escalate to the
+        device path)."""
+        ans = self.predict(spec)
+        if ans is None:
+            return None
+        width = ans.hi - ans.lo
+        tol = max(self.abs_tol, self.rel_tol * abs(ans.t))
+        if ans.t <= 0 or width > tol:
+            return None
+        return ans

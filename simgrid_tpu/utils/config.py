@@ -361,6 +361,35 @@ declare_flag("lmm/unroll",
              "some backends lower gathers inside while_loop to serialized "
              "dynamic-slice loops; unrolled code keeps them vectorized)",
              "auto")
+declare_flag("serve/batch",
+             "Resident fleet width of the always-on campaign service "
+             "(serving.service.CampaignService): queued scenarios "
+             "fill up to this many lanes; lanes freed by completed "
+             "replicas are revived mid-flight by admission batching",
+             16)
+declare_flag("serve/plan-cache",
+             "Directory for the serving AOT plan cache "
+             "(serving.plancache): compiled fleet executables are "
+             "serialized here so warm restarts skip XLA tracing "
+             "entirely; empty = in-memory caching only", "")
+declare_flag("serve/surrogate",
+             "Surrogate triage for the campaign service: on answers "
+             "tight-interval queries from the ridge+conformal "
+             "predictor (exact=True always bypasses), off sends every "
+             "query to the device path", "on")
+declare_flag("serve/surrogate-min-corpus",
+             "Completed rows required before the serving surrogate "
+             "makes its first fit (split-conformal calibration needs "
+             "a held-out stripe)", 24)
+declare_flag("serve/surrogate-rel-tol",
+             "Maximum conformal-interval width, relative to the "
+             "predicted clock, the surrogate will answer at; wider "
+             "intervals escalate the query to exact device "
+             "simulation", 0.1)
+declare_flag("serve/surrogate-confidence",
+             "Conformal coverage level of surrogate answers (the "
+             "interval quantile over held-out absolute residuals)",
+             0.9)
 declare_flag("smpi/rma-fast-atomics",
              "Linearize RMA atomic reads (get/fetch_op/get_accumulate/"
              "cas) immediately at the origin when all its outstanding "

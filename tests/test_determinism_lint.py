@@ -109,6 +109,23 @@ def test_fault_runtime_determinism():
     assert problems == []
 
 
+def test_serve_runtime_determinism():
+    """Dynamic coverage of the always-on campaign service (ISSUE 11
+    tooling, the `--quick` small-N instance): more exact queries than
+    the resident fleet has lanes, so admission batching revives dead
+    lanes mid-flight, and every device-served ticket — admitted lanes
+    and fault tapes included — is bit-identical (events, fired faults
+    and Kahan clocks) to ScenarioPlan.solo, with pipeline depth 2
+    asserting the admissions rolled speculation back and every fleet
+    program routing through the AOT plan cache.  The full-size check
+    runs via `check_determinism.py --runtime-serve`."""
+    checker = _load_checker()
+    problems = checker.check_serve_runtime(n_c=24, n_v=64, batch=3,
+                                           scenarios=7, k=4,
+                                           depths=(0, 2))
+    assert problems == []
+
+
 def test_checker_flags_violations(tmp_path):
     """The lint itself works: a planted file with each banned pattern is
     reported (guards against the lint silently matching nothing)."""

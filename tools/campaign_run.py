@@ -30,6 +30,20 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+def force_host_device_count(n: int) -> None:
+    """Pin the CPU backend's forced device count BEFORE jax initializes
+    its backends: once a backend exists the flag is silently ignored,
+    so every stage must route through here first — not just the
+    ``--mesh`` path.  Pinning it unconditionally (n=1 included) also
+    keeps the plan-cache artifact digest (which includes the device
+    count) identical between cold and warm invocations."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count="
+            f"{max(int(n), 1)}").strip()
+
+
 def build_synthetic(args):
     import numpy as np
     from bench import build_arrays
@@ -145,25 +159,30 @@ def main() -> int:
     ap.add_argument("--check", type=int, default=-1,
                     help="replica index to spot-check against a solo "
                     "run (-1: skip)")
+    ap.add_argument("--plan-cache", default=None, metavar="DIR",
+                    help="route fleet programs through an AOT plan "
+                         "cache rooted at DIR (serving.plancache): "
+                         "repeat invocations deserialize compiled "
+                         "executables instead of re-tracing")
     ap.add_argument("--out", default=None,
                     help="append the summary row to this jsonl file")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU JAX backend")
     args = ap.parse_args()
 
-    if args.mesh > 1:
-        # must land before jax initializes its backends: the forced
-        # host-platform device count only affects the CPU platform, so
-        # it is harmless on accelerator runs
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count="
-                f"{args.mesh}").strip()
+    # must land before jax initializes its backends for EVERY stage
+    # (the forced host-platform device count only affects the CPU
+    # platform, so it is harmless on accelerator runs)
+    force_host_device_count(args.mesh)
     if args.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
     from simgrid_tpu.parallel.campaign import Campaign, ScenarioSpec
+
+    plan_cache = None
+    if args.plan_cache:
+        from simgrid_tpu.serving.plancache import PlanCache
+        plan_cache = PlanCache(args.plan_cache)
 
     base, meta = (build_fat_tree(args) if args.platform == "fat-tree"
                   else build_synthetic(args))
@@ -178,7 +197,8 @@ def main() -> int:
     campaign = Campaign(specs=specs, superstep=args.superstep,
                         pipeline=args.pipeline,
                         mesh=args.mesh or None,
-                        fault_mode=args.fault_mode, **base)
+                        fault_mode=args.fault_mode,
+                        plan_cache=plan_cache, **base)
 
     t0 = time.perf_counter()
     results, stats = campaign.run_scoped(batch=args.batch,
@@ -206,7 +226,11 @@ def main() -> int:
                fault_tape_slots=int(stats.get("fault_tape_slots", 0)),
                fault_tape_events=int(
                    stats.get("fault_tape_events", 0)),
-               fault_replays=int(stats.get("fault_replays", 0)))
+               fault_replays=int(stats.get("fault_replays", 0)),
+               lanes_admitted=int(stats.get("lanes_admitted", 0)))
+    if plan_cache is not None:
+        row.update({k: (round(v, 1) if isinstance(v, float) else v)
+                    for k, v in plan_cache.stats().items()})
     if 0 <= args.check < args.replicas:
         solo = campaign.run_solo(args.check)
         row["solo_check"] = dict(

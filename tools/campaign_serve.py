@@ -1,0 +1,214 @@
+#!/usr/bin/env python3
+"""Drive the always-on campaign service from the command line.
+
+Builds ONE scenario plan (synthetic maxmin-bench system or the seeded
+64-host fat-tree drain — the same builders as tools/campaign_run.py),
+stands up a :class:`~simgrid_tpu.serving.service.CampaignService` over
+it (AOT plan cache + surrogate triage), submits a sweep of what-if
+queries, drains the queue, and prints one JSON summary row:
+submit→result latency percentiles, surrogate hit rate, plan-cache
+hit/miss/compile-ms and admission counters.
+
+The point of the service over the batch CLI: with ``--plan-cache DIR``
+a warm restart deserializes every fleet program from disk (zero XLA
+traces — ``plan_compile_ms`` 0), and with a seeded ``--corpus`` the
+surrogate answers the easy bulk of the sweep from its conformal
+predictor without touching the device.
+
+Examples::
+
+    tools/campaign_serve.py --scenarios 64 --batch 16
+    tools/campaign_serve.py --scenarios 256 --plan-cache /tmp/plans \\
+        --corpus bench_results/lmm_serve_corpus.jsonl
+    tools/campaign_serve.py --platform fat-tree --flows 300 --exact
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from campaign_run import (build_fat_tree, build_synthetic,  # noqa: E402
+                          force_host_device_count)
+
+
+def build_specs(args):
+    """A deterministic mixed sweep: bandwidth/size scaling families
+    (surrogate-learnable structure) with a seeded fault stripe."""
+    from simgrid_tpu.parallel.campaign import ScenarioSpec
+    n_fault = int(round(args.scenarios * args.faults))
+    specs = []
+    for s in range(args.scenarios):
+        specs.append(ScenarioSpec(
+            seed=s,
+            bw_scale=1.0 + 0.1 * (s % 5),
+            size_scale=1.0 + 0.05 * (s % 3),
+            fault_mtbf=args.mtbf if s < n_fault else None,
+            fault_mttr=args.mttr,
+            fault_horizon=args.horizon,
+            label=f"serve{s}"))
+    return specs
+
+
+def percentile(xs, q):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    k = min(len(xs) - 1, max(0, int(round(q / 100.0 * (len(xs) - 1)))))
+    return xs[k]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--platform", choices=["synthetic", "fat-tree"],
+                    default="synthetic")
+    ap.add_argument("--n_c", type=int, default=96)
+    ap.add_argument("--n_v", type=int, default=400)
+    ap.add_argument("--deg", type=int, default=3)
+    ap.add_argument("--flows", type=int, default=300,
+                    help="fat-tree platform: number of drain flows")
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--scenarios", type=int, default=64,
+                    help="queries submitted to the service")
+    ap.add_argument("--batch", type=int, default=None,
+                    help="resident fleet width (default: the "
+                         "serve/batch config flag)")
+    ap.add_argument("--superstep", type=int, default=8)
+    ap.add_argument("--pipeline", type=int, default=0)
+    ap.add_argument("--mesh", type=int, default=0)
+    ap.add_argument("--faults", type=float, default=0.25,
+                    help="fraction of scenarios with a fault dimension")
+    ap.add_argument("--fault-mode", choices=["on", "static", "off"],
+                    default=None)
+    ap.add_argument("--mtbf", type=float, default=400.0)
+    ap.add_argument("--mttr", type=float, default=50.0)
+    ap.add_argument("--horizon", type=float, default=600.0)
+    ap.add_argument("--plan-cache", default=None, metavar="DIR",
+                    help="AOT plan-cache directory (warm restarts "
+                         "skip XLA tracing entirely)")
+    ap.add_argument("--corpus", action="append", default=[],
+                    metavar="JSONL",
+                    help="seed the surrogate corpus from these jsonl "
+                         "files (spec dict + final clock rows; "
+                         "repeatable)")
+    ap.add_argument("--corpus-log", default=None, metavar="JSONL",
+                    help="append every device-served row here")
+    ap.add_argument("--no-surrogate", action="store_true",
+                    help="device path for every query")
+    ap.add_argument("--exact", action="store_true",
+                    help="submit every query with exact=True "
+                         "(bypass surrogate triage)")
+    ap.add_argument("--check", type=int, default=-1,
+                    help="ticket index to spot-check against the solo "
+                         "oracle (-1: skip; surrogate-answered "
+                         "tickets report interval coverage instead)")
+    ap.add_argument("--out", default=None,
+                    help="append the summary row to this jsonl file")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU JAX backend")
+    args = ap.parse_args()
+
+    # before jax initializes its backends, for every stage
+    force_host_device_count(args.mesh)
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from simgrid_tpu.ops import opstats
+    from simgrid_tpu.parallel.campaign import ScenarioPlan
+    from simgrid_tpu.serving import (CampaignService, PlanCache,
+                                     RuntimeSurrogate)
+    from simgrid_tpu.utils.config import config
+
+    base, meta = (build_fat_tree(args) if args.platform == "fat-tree"
+                  else build_synthetic(args))
+    plan = ScenarioPlan(superstep=args.superstep,
+                        pipeline=args.pipeline,
+                        mesh=args.mesh or None,
+                        fault_mode=args.fault_mode, **base)
+
+    plan_cache = PlanCache(args.plan_cache) if args.plan_cache else None
+    surrogate = None
+    if not args.no_surrogate and str(config["serve/surrogate"]) == "on":
+        surrogate = RuntimeSurrogate(
+            min_corpus=int(config["serve/surrogate-min-corpus"]),
+            rel_tol=float(config["serve/surrogate-rel-tol"]),
+            confidence=float(config["serve/surrogate-confidence"]))
+        if args.corpus:
+            surrogate.load_corpus(args.corpus)
+
+    service = CampaignService(plan, batch=args.batch,
+                              plan_cache=plan_cache,
+                              surrogate=surrogate,
+                              corpus_log=args.corpus_log,
+                              pipeline=args.pipeline,
+                              mesh=args.mesh or None)
+    specs = build_specs(args)
+
+    t0 = time.perf_counter()
+    with opstats.scoped("campaign_serve") as stats:
+        tickets = service.submit_many(specs, exact=args.exact)
+        service.drain()
+    wall = time.perf_counter() - t0
+
+    lat = [t.latency_ms for t in tickets if t.latency_ms is not None]
+    dev_lat = [t.latency_ms for t in tickets
+               if t.result is not None and t.result.source == "device"]
+    first_dev = min(
+        (t.done_at for t in tickets
+         if t.result is not None and t.result.source == "device"
+         and t.done_at is not None), default=None)
+    counters = service.counters()
+    row = dict(meta, tool="campaign_serve",
+               scenarios=args.scenarios, batch=service.batch,
+               superstep=args.superstep, pipeline=args.pipeline,
+               mesh=args.mesh,
+               fault_scenarios=int(round(args.scenarios * args.faults)),
+               wall_ms=round(wall * 1e3, 1),
+               submit_to_first_device_ms=(
+                   None if first_dev is None
+                   else round((first_dev - t0) * 1e3, 1)),
+               latency_p50_ms=round(percentile(lat, 50), 3),
+               latency_p99_ms=round(percentile(lat, 99), 3),
+               device_latency_p50_ms=(
+                   round(percentile(dev_lat, 50), 3) if dev_lat
+                   else None),
+               surrogate_hit_rate=round(
+                   counters["surrogate_answers"]
+                   / max(1, args.scenarios), 4),
+               dispatches=int(stats.get("dispatches", 0)),
+               errors=[t.spec.label for t in tickets
+                       if t.result is not None and t.result.error])
+    row.update({k: (round(v, 1) if isinstance(v, float) else int(v))
+                for k, v in counters.items()})
+    if 0 <= args.check < len(tickets):
+        t = tickets[args.check]
+        solo = plan.solo(t.spec)
+        if t.result is not None and t.result.source == "device":
+            row["solo_check"] = dict(
+                ticket=args.check, source="device",
+                events_bit_identical=solo.events == t.result.events,
+                clock_bit_identical=solo.t == t.result.t,
+                fault_events_bit_identical=(
+                    solo.fault_events == t.result.fault_events))
+        elif t.result is not None:
+            row["solo_check"] = dict(
+                ticket=args.check, source=t.result.source,
+                interval_covers_truth=(
+                    t.result.lo <= solo.t <= t.result.hi))
+    print(json.dumps(row))
+    if args.out:
+        with open(args.out, "a") as fh:
+            fh.write(json.dumps(row) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,12 +80,25 @@ kept completing after it; ``fault_mode="static"`` still reproduces
 the pre-tape mean-availability folding exactly; and the tape composes
 with pipeline depth 2 and a 2-device mesh unchanged.
 
+``--runtime-serve`` drives the always-on campaign service
+(simgrid_tpu/serving) with more exact queries than the resident fleet
+has lanes, so ADMISSION BATCHING must revive dead lanes mid-flight,
+and asserts the serving determinism contract: every device-served
+ticket — including every lane admitted into a partially-drained fleet
+— is bit-identical (completion events, fired fault events AND Kahan
+clocks) to ``ScenarioPlan.solo`` on the same spec; at least one lane
+really was admitted and at least one fault tape event fired
+(otherwise nothing was tested); under pipeline depth 2 the admissions
+must additionally have rolled speculation back; and the whole thing
+routes through the AOT plan cache, so the executable path is the
+audited path.
+
 ``--quick`` is the CI mode: the static lint plus small-N instances of
 every runtime check (drain, warm-start, batch, pipeline, shard,
-phase, fault), sized to finish in seconds so the tier-1 suite can run
-it on every test pass (tests/test_determinism_lint.py, whose conftest
-forces an 8-virtual-device CPU so the mesh path is exercised on
-every run).
+phase, fault, serve), sized to finish in seconds so the tier-1 suite
+can run it on every test pass (tests/test_determinism_lint.py, whose
+conftest forces an 8-virtual-device CPU so the mesh path is exercised
+on every run).
 """
 
 from __future__ import annotations
@@ -99,6 +112,7 @@ AUDITED_DIRS = (
     os.path.join("simgrid_tpu", "kernel"),
     os.path.join("simgrid_tpu", "ops"),
     os.path.join("simgrid_tpu", "faults"),
+    os.path.join("simgrid_tpu", "serving"),
 )
 
 BANNED = [
@@ -657,6 +671,89 @@ def check_fault_runtime(seed: int = 41, n_c: int = 32, n_v: int = 96,
     return problems
 
 
+def check_serve_runtime(seed: int = 43, n_c: int = 32, n_v: int = 96,
+                        batch: int = 3, scenarios: int = 9, k: int = 4,
+                        depths=(0, 2)) -> List[str]:
+    """Dynamic determinism of the always-on campaign service: more
+    exact queries than the resident fleet has lanes (``scenarios >
+    batch``), so most queries are ADMITTED into dead lanes of a
+    partially-drained fleet mid-flight.  Every device-served ticket —
+    initial and admitted alike, fault tapes included — must be
+    bit-identical (events, fired fault events, Kahan clocks) to
+    ``ScenarioPlan.solo`` on the same spec; admission and at least one
+    tape fire must actually have happened (otherwise nothing was
+    tested); at pipeline depth >= 1 the mid-flight admissions must
+    have rolled in-flight speculation back; and every fleet program
+    routes through the AOT plan cache so the executable path IS the
+    audited path.  Returns a list of problems (empty = OK)."""
+    import numpy as np
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import build_arrays
+    from simgrid_tpu.parallel.campaign import ScenarioPlan, ScenarioSpec
+    from simgrid_tpu.serving import CampaignService, PlanCache
+
+    rng = np.random.default_rng(seed)
+    arrays = build_arrays(rng, n_c, n_v, 3, np.float64)
+    E = arrays.n_elem
+    sizes = rng.choice(np.linspace(1e5, 2e6, 16), n_v)
+    specs = [ScenarioSpec(seed=s, bw_scale=1.0 + 0.1 * (s % 5),
+                          size_scale=1.0 + 0.05 * (s % 3),
+                          fault_mtbf=150.0 if s % 3 == 0 else None,
+                          fault_mttr=50.0, fault_horizon=900.0,
+                          label=f"q{s}")
+             for s in range(scenarios)]
+    plan = ScenarioPlan(arrays.e_var[:E], arrays.e_cnst[:E],
+                        arrays.e_w[:E], arrays.c_bound[:n_c], sizes,
+                        eps=1e-9, superstep=k, fault_mode="on")
+    solos = {spec.label: plan.solo(spec) for spec in specs}
+
+    problems: List[str] = []
+    cache = PlanCache()  # memory-resident; same executables every depth
+    for depth in depths:
+        tag = f"serve:d{depth}"
+        svc = CampaignService(plan, batch=batch, plan_cache=cache,
+                              pipeline=depth)
+        tickets = svc.submit_many(specs, exact=True)
+        svc.drain()
+        fired = 0
+        for t in tickets:
+            if t.result is None or t.result.source != "device":
+                problems.append(f"{tag}: {t.spec.label} never got a "
+                                f"device result")
+                continue
+            if t.result.error:
+                problems.append(f"{tag}: {t.spec.label} errored "
+                                f"({t.result.error})")
+                continue
+            solo = solos[t.spec.label]
+            if solo.events != t.result.events \
+                    or solo.t != t.result.t:
+                problems.append(
+                    f"{tag}: {t.spec.label}: served run diverged from "
+                    f"solo ({len(t.result.events)} vs "
+                    f"{len(solo.events)} events, clocks "
+                    f"{t.result.t!r} vs {solo.t!r})")
+            if solo.fault_events != t.result.fault_events:
+                problems.append(f"{tag}: {t.spec.label}: fired fault "
+                                f"events differ from solo")
+            fired += len(t.result.fault_events)
+        if svc.lanes_admitted == 0:
+            problems.append(f"{tag}: no lane was ever admitted "
+                            f"mid-flight (nothing was actually tested)")
+        if not fired:
+            problems.append(f"{tag}: no fault tape event ever fired")
+        if depth >= 1 and svc.spec_rolled_back == 0:
+            problems.append(f"{tag}: admissions never rolled "
+                            f"speculation back (the clean=False "
+                            f"contract was not exercised)")
+    if cache.hits == 0 or cache.fallbacks:
+        problems.append(f"serve: plan cache never took the AOT path "
+                        f"(hits={cache.hits}, "
+                        f"fallbacks={cache.fallbacks})")
+    return problems
+
+
 _FAT_TREE_64 = """<?xml version='1.0'?>
 <platform version="4.1">
   <zone id="world" routing="Full">
@@ -841,11 +938,14 @@ def quick_checks() -> List[str]:
     problems += check_phase_runtime(ranks=24, rounds=2, min_flows=8,
                                     superstep=8, depths=(0, 2))
     problems += check_fault_runtime(n_c=24, n_v=64, k=4, mesh=2)
+    problems += check_serve_runtime(n_c=24, n_v=64, batch=3,
+                                    scenarios=7, k=4, depths=(0, 2))
     return problems
 
 
 def main(argv: List[str]) -> int:
     if ("--runtime-shard" in argv or "--runtime-fault" in argv
+            or "--runtime-serve" in argv
             or "--quick" in argv) and "jax" not in sys.modules:
         # the mesh checks need >= 2 devices; the forced host-platform
         # count must land before JAX initializes and only affects the
@@ -883,6 +983,20 @@ def main(argv: List[str]) -> int:
               "depth 2 and 2-device mesh compose — bit-identical to "
               "solo runs: events, fired faults and Kahan clocks)")
         argv = [a for a in argv if a != "--runtime-fault"]
+    if "--runtime-serve" in argv:
+        problems = check_serve_runtime()
+        if problems:
+            print("check_determinism: serve runtime check FAILED:")
+            for p in problems:
+                print(f"  {p}")
+            return 1
+        print("check_determinism: serve runtime OK (campaign service "
+              "— queries admitted mid-flight into partially-drained "
+              "fleets through the AOT plan cache, incl. fault tapes "
+              "and pipeline depth 2 with forced-rollback assertion — "
+              "bit-identical to ScenarioPlan.solo: events, fired "
+              "faults and Kahan clocks)")
+        argv = [a for a in argv if a != "--runtime-serve"]
     if "--quick" in argv:
         problems = quick_checks()
         if problems:
@@ -891,7 +1005,8 @@ def main(argv: List[str]) -> int:
                 print(f"  {p}")
             return 1
         print("check_determinism: quick OK (lint + small-N drain + "
-              "batch + pipeline + shard + phase + fault runtime)")
+              "batch + pipeline + shard + phase + fault + serve "
+              "runtime)")
         return 0
     if "--runtime-phase" in argv:
         problems = check_phase_runtime()
