@@ -1087,6 +1087,117 @@ def stage_serve(args) -> dict:
     return {"cold": cold, "warm": warm, **speed}
 
 
+def stage_resume(args) -> dict:
+    """Preemption-safe campaign overhead (ISSUE 12): (a) checkpoint
+    cost — an uninterrupted drain vs the same drain writing a
+    FleetCheckpoint every 2 committed supersteps (wall delta,
+    per-checkpoint milliseconds, artifact bytes); (b) the preemption
+    gap — a drain KILLED at the halfway collect boundary, the service
+    discarded, and a fresh one rebuilt with CampaignService.resume
+    over a fresh PlanCache sharing only the on-disk artifact store (a
+    restarted process in spirit), timed from token load to last
+    ticket.  Every leg must stay bit-identical to the uninterrupted
+    run.  Rows land in bench_results/lmm_resume.jsonl."""
+    _force_cpu()
+    import tempfile
+    from simgrid_tpu.ops import opstats
+    from simgrid_tpu.parallel.campaign import ScenarioPlan
+    from simgrid_tpu.serving import CampaignService, PlanCache
+
+    rng = np.random.default_rng(args.seed)
+    arrays = build_arrays(rng, args.n_c, args.n_v, args.deg,
+                          np.float64)
+    E = arrays.n_elem
+    sizes = rng.choice(np.linspace(1e5, 2e6, 16), args.n_v)
+    plan = ScenarioPlan(arrays.e_var[:E], arrays.e_cnst[:E],
+                        arrays.e_w[:E], arrays.c_bound[:args.n_c],
+                        sizes, eps=1e-9, superstep=args.superstep,
+                        fault_mode="on")
+    specs = _serve_specs(args.scenarios)
+    workdir = tempfile.mkdtemp(prefix="lmm_resume_")
+    plan_dir = os.path.join(workdir, "plans")
+
+    def run(cache, **drain_kw):
+        svc = CampaignService(plan, batch=args.serve_batch,
+                              plan_cache=cache)
+        svc.submit_many(specs, exact=True)
+        t0 = time.perf_counter()
+        svc.drain(**drain_kw)
+        return svc, (time.perf_counter() - t0) * 1e3
+
+    def digest(svc):
+        return {t.spec.label: (tuple(map(tuple, t.result.events or ())),
+                               tuple(map(tuple,
+                                         t.result.fault_events or ())),
+                               t.result.t)
+                for t in svc.completed if t.result is not None}
+
+    # leg 0: warmup — populate the disk plan cache so every timed leg
+    # below runs warm and the cadence comparison is compile-free
+    run(PlanCache(plan_dir))
+
+    # leg 1: uninterrupted baseline
+    base_svc, base_ms = run(PlanCache(plan_dir))
+    ref = digest(base_svc)
+    base_steps = base_svc.supersteps
+
+    # leg 2: checkpoint cadence overhead
+    ck = os.path.join(workdir, "cadence")
+    before = opstats.snapshot()
+    ck_svc, ck_ms = run(PlanCache(plan_dir), checkpoint_every=2,
+                        checkpoint_path=ck)
+    d = opstats.diff(before)
+    n_ckpt = int(d.get("fleet_checkpoints", 0))
+    ckpt_bytes = (os.path.getsize(ck)
+                  + os.path.getsize(ck + ".fleet.npz"))
+    cadence_identical = digest(ck_svc) == ref
+
+    # leg 3: kill at the halfway boundary, resume in a fresh service
+    kill_at = max(1, base_steps // 2)
+    ck2 = os.path.join(workdir, "kill")
+    kill_svc, _ = run(PlanCache(plan_dir), stop_after=kill_at,
+                      checkpoint_path=ck2)
+    killed_with_fleet = kill_svc._fleet is not None
+    del kill_svc
+    warm = PlanCache(plan_dir)
+    t0 = time.perf_counter()
+    back = CampaignService.resume(ck2, plan_cache=warm)
+    resume_ms = (time.perf_counter() - t0) * 1e3
+    n_done = len(back.completed)
+    back.drain()
+    finish_ms = (time.perf_counter() - t0) * 1e3
+    resume_identical = digest(back) == ref
+
+    payload = {"bench": "lmm_resume", "n_c": args.n_c,
+               "n_v": args.n_v, "scenarios": args.scenarios,
+               "superstep": args.superstep,
+               "supersteps": base_steps, "kill_at": kill_at,
+               "killed_with_fleet": killed_with_fleet,
+               "base_wall_ms": round(base_ms, 1),
+               "cadence_wall_ms": round(ck_ms, 1),
+               "checkpoints": n_ckpt,
+               "checkpoint_ms_total": round(
+                   d.get("checkpoint_ms", 0.0), 2),
+               "checkpoint_ms_each": round(
+                   d.get("checkpoint_ms", 0.0) / max(n_ckpt, 1), 2),
+               "checkpoint_bytes": int(ckpt_bytes),
+               "checkpoint_overhead_pct": round(
+                   100.0 * (ck_ms - base_ms) / max(base_ms, 1e-9), 1),
+               "resume_rebuild_ms": round(resume_ms, 2),
+               "resume_finish_ms": round(finish_ms, 1),
+               "restored_tickets": n_done,
+               "plan_cache_misses_on_resume": warm.misses,
+               "cadence_bit_identical": cadence_identical,
+               "resume_bit_identical": resume_identical}
+    rows = [schema_row("resume", payload, batch=args.serve_batch,
+                       platform="cpu")]
+    path = append_rows("lmm_resume.jsonl", rows)
+    log(f"[stage resume] rows appended to {path} "
+        f"(cadence_bit_identical={cadence_identical}, "
+        f"resume_bit_identical={resume_identical})")
+    return payload
+
+
 STAGES = {
     "probe": lambda args: stage_probe(),
     "dev": lambda args: stage_device(args.n_c, args.n_v, args.deg,
@@ -1115,6 +1226,7 @@ STAGES = {
                                       args.seed, args.replicas,
                                       args.superstep),
     "serve": lambda args: stage_serve(args),
+    "resume": lambda args: stage_resume(args),
 }
 
 

@@ -37,7 +37,66 @@ from __future__ import annotations
 
 import importlib
 import json
-from typing import Any, List, Optional, Tuple
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint artifact that cannot be honored: a missing or
+    truncated ``.npz`` sidecar, arrays whose shapes/dtypes disagree
+    with the token, inconsistent ragged offsets, or an unknown format
+    version.  Raised at LOAD time with the offending key named, so a
+    corrupt artifact fails fast instead of surfacing as a deep numpy
+    broadcast error mid-resume."""
+
+
+def _load_npz(path: str):
+    """Open one checkpoint ``.npz`` sidecar, normalizing every failure
+    mode (absent file, truncated zip, foreign bytes) to
+    :class:`CheckpointError`."""
+    if not os.path.exists(path):
+        raise CheckpointError(
+            f"checkpoint sidecar {path!r} is missing (the token "
+            f"promises arrays; save() writes them next to the token)")
+    try:
+        return np.load(path)
+    except Exception as exc:
+        raise CheckpointError(
+            f"checkpoint sidecar {path!r} is unreadable (truncated or "
+            f"corrupt): {exc}")
+
+
+def _npz_get(z, key: str, dtype=None, ndim: Optional[int] = None,
+             shape: Optional[Tuple[int, ...]] = None,
+             cols: Optional[int] = None) -> "np.ndarray":
+    """Fetch one array from a loaded npz, validating it against what
+    the token promised.  Shared by :meth:`Checkpoint.load` and
+    :meth:`FleetCheckpoint.load` — the single place a stale or
+    truncated artifact turns into a clear :class:`CheckpointError`."""
+    if key not in z.files:
+        raise CheckpointError(
+            f"checkpoint npz is missing array {key!r} (truncated or "
+            f"corrupt artifact, or a token/sidecar mismatch)")
+    arr = z[key]
+    if dtype is not None and arr.dtype != np.dtype(dtype):
+        raise CheckpointError(
+            f"checkpoint array {key!r} has dtype {arr.dtype}, token "
+            f"expects {np.dtype(dtype).name}")
+    if ndim is not None and arr.ndim != ndim:
+        raise CheckpointError(
+            f"checkpoint array {key!r} has {arr.ndim} dimension(s), "
+            f"token expects {ndim}")
+    if shape is not None and tuple(arr.shape) != tuple(shape):
+        raise CheckpointError(
+            f"checkpoint array {key!r} has shape {tuple(arr.shape)}, "
+            f"token expects {tuple(shape)}")
+    if cols is not None and (arr.ndim != 2 or arr.shape[1] != cols):
+        raise CheckpointError(
+            f"checkpoint array {key!r} has shape {tuple(arr.shape)}, "
+            f"token expects (*, {cols})")
+    return arr
 
 
 class _SolveStream:
@@ -289,8 +348,17 @@ class Checkpoint:
 
     @classmethod
     def load(cls, path: str) -> "Checkpoint":
-        with open(path) as f:
-            d = json.load(f)
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, ValueError) as exc:
+            raise CheckpointError(
+                f"checkpoint token {path!r} is unreadable: {exc}")
+        for field in ("module", "qualname", "args", "at"):
+            if field not in d:
+                raise CheckpointError(
+                    f"checkpoint token {path!r} is missing the "
+                    f"{field!r} field (truncated or foreign file)")
         token = cls.__new__(cls)
         token._module = str(d["module"])
         token._qualname = str(d["qualname"])
@@ -298,29 +366,122 @@ class Checkpoint:
         token.at = float(d["at"])
         token.solves = None
         if d.get("has_solves"):
-            import os
-
-            import numpy as np
-            npz_path = path + ".solves.npz"
-            if os.path.exists(npz_path):
-                with np.load(npz_path) as z:
-                    stream = _SolveStream()
-                    for i, n in enumerate(z["shape"]):
-                        recs = []
-                        for k in range(int(n)):
-                            p = f"s{i}r{k}"
-                            cn = z[p + "c"]
-                            flat = z[p + "a"].tolist()
-                            offs = z[p + "o"].tolist()
-                            cnst = []
-                            for j, (r, u, ne) in enumerate(cn):
-                                cnst.append((float(r), float(u), int(ne),
-                                             flat[offs[j]:offs[j + 1]]))
-                            recs.append({
-                                "values": z[p + "v"].tolist(),
-                                "cnst": cnst,
-                                "flags": z[p + "f"].tolist(),
-                            })
-                        stream.per_system.append(recs)
-                    token.solves = stream
+            # every array is validated against the token BEFORE any of
+            # it is consumed: a truncated artifact fails here with the
+            # offending key named, not as a numpy broadcast error deep
+            # inside a resume
+            with _load_npz(path + ".solves.npz") as z:
+                stream = _SolveStream()
+                shape = _npz_get(z, "shape", dtype=np.int64, ndim=1)
+                for i, n in enumerate(shape):
+                    recs = []
+                    for k in range(int(n)):
+                        p = f"s{i}r{k}"
+                        cn = _npz_get(z, p + "c", dtype=np.float64,
+                                      cols=3)
+                        flat = _npz_get(z, p + "a", dtype=np.int64,
+                                        ndim=1).tolist()
+                        offs = _npz_get(z, p + "o", dtype=np.int64,
+                                        ndim=1).tolist()
+                        if (len(offs) != len(cn) + 1 or offs[0] != 0
+                                or offs[-1] != len(flat)
+                                or any(a > b for a, b in
+                                       zip(offs, offs[1:]))):
+                            raise CheckpointError(
+                                f"checkpoint record {p!r} has "
+                                f"inconsistent active-position offsets "
+                                f"(corrupt artifact)")
+                        cnst = []
+                        for j, (r, u, ne) in enumerate(cn):
+                            cnst.append((float(r), float(u), int(ne),
+                                         flat[offs[j]:offs[j + 1]]))
+                        recs.append({
+                            "values": _npz_get(z, p + "v",
+                                               dtype=np.float64,
+                                               ndim=1).tolist(),
+                            "cnst": cnst,
+                            "flags": _npz_get(z, p + "f",
+                                              dtype=np.int64,
+                                              ndim=1).tolist(),
+                        })
+                    stream.per_system.append(recs)
+                token.solves = stream
         return token
+
+
+class FleetCheckpoint:
+    """A superstep-boundary snapshot of a campaign fleet/service: one
+    JSON token (plain data — loading executes nothing) plus a
+    ``path + ".fleet.npz"`` sidecar of numeric arrays.
+
+    The token embeds a MANIFEST of every sidecar array's shape and
+    dtype; :meth:`load` validates the npz against it through the same
+    :func:`_npz_get` gate :class:`Checkpoint` uses, so a truncated or
+    mismatched artifact raises :class:`CheckpointError` with the
+    offending key named instead of corrupting a resume.
+
+    This class is format only — WHAT goes into the token/arrays is
+    owned by the producer (``serving.service.CampaignService.
+    checkpoint`` snapshots the BatchDrainSim committed state + ticket
+    journal; ``CampaignService.resume`` consumes it).  Captured at
+    collect boundaries exclusively: in-flight pipeline speculation is
+    never represented, so resuming replays from committed state
+    exactly like a speculation mispredict."""
+
+    #: bumped when the fleet token layout changes incompatibly
+    FORMAT = 1
+
+    def __init__(self, token: Dict, arrays: Dict[str, "np.ndarray"]):
+        self.token = dict(token)
+        self.arrays = {k: np.asarray(v) for k, v in arrays.items()}
+
+    def save(self, path: str) -> None:
+        """JSON token + compressed npz sidecar (both data, not code).
+        The token carries the array manifest the loader validates
+        against."""
+        manifest = {name: [list(a.shape), a.dtype.name]
+                    for name, a in self.arrays.items()}
+        try:
+            blob = json.dumps({"kind": "fleet", "format": self.FORMAT,
+                               "token": self.token,
+                               "arrays": manifest})
+        except TypeError as exc:
+            raise TypeError(
+                "fleet checkpoint token must be JSON-serializable "
+                f"plain data: {exc}")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(blob)
+        np.savez_compressed(path + ".fleet.npz", **self.arrays)
+        # token last, atomically: a crash mid-save leaves no token
+        # pointing at a half-written sidecar
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str) -> "FleetCheckpoint":
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, ValueError) as exc:
+            raise CheckpointError(
+                f"fleet checkpoint token {path!r} is unreadable: {exc}")
+        if d.get("kind") != "fleet":
+            raise CheckpointError(
+                f"{path!r} is not a fleet checkpoint token "
+                f"(kind={d.get('kind')!r})")
+        if d.get("format") != cls.FORMAT:
+            raise CheckpointError(
+                f"fleet checkpoint format {d.get('format')!r} is not "
+                f"supported (this build reads format {cls.FORMAT})")
+        manifest = d.get("arrays")
+        if not isinstance(manifest, dict) or "token" not in d:
+            raise CheckpointError(
+                f"fleet checkpoint token {path!r} is missing its "
+                f"array manifest or payload (truncated file)")
+        arrays = {}
+        with _load_npz(path + ".fleet.npz") as z:
+            for name, spec in manifest.items():
+                shape, dtype = tuple(spec[0]), spec[1]
+                arrays[name] = _npz_get(z, name, dtype=dtype,
+                                        shape=shape)
+        return cls(d["token"], arrays)

@@ -67,6 +67,7 @@ and a runtime guard asserts they produce zero completion events.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
@@ -94,6 +95,129 @@ class AdmissionError(RuntimeError):
     table, or the fault tape is wider than the fleet's reserved tape
     slots.  The serving layer catches this and either defers the query
     or retires the fleet."""
+
+
+class LaneFault:
+    """Why one lane was QUARANTINED — killed with a recorded cause
+    while the rest of the fleet kept draining.  Attached to the lane's
+    :class:`ReplicaState` (and, through the serving layer, to the
+    query's Ticket) so a poisoned scenario is diagnosable instead of
+    silently missing.  Causes:
+
+    * ``nan_solve``        — the superstep returned a NaN clock
+                             advance (degenerate capacities/overrides);
+                             the lane's ring events for that dispatch
+                             are garbage and are dropped
+    * ``stall``            — no flow holds bandwidth (dt not finite)
+    * ``non_convergence``  — the budget rescue still could not finish
+                             one advance
+    * ``ring_overflow``    — the completion ring reported more events
+                             than it has slots (defensive; would
+                             corrupt the demux)
+    * ``admission_storm``  — the serving layer gave up admitting the
+                             scenario after repeated fleet generations
+    * ``watchdog``         — device dispatches exhausted the retry
+                             policy; the query fell back to the solo
+                             host path
+
+    Each quarantine bumps the matching ``lane_quarantined_<cause>``
+    opstats counter."""
+
+    __slots__ = ("cause", "detail", "lane", "superstep", "t")
+
+    def __init__(self, cause: str, detail: str, lane: int,
+                 superstep: int = 0, t: float = 0.0):
+        self.cause = str(cause)
+        self.detail = str(detail)
+        self.lane = int(lane)
+        self.superstep = int(superstep)
+        self.t = float(t)
+
+    def to_dict(self) -> Dict:
+        return {"cause": self.cause, "detail": self.detail,
+                "lane": self.lane, "superstep": self.superstep,
+                "t": self.t}
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "LaneFault":
+        return cls(d["cause"], d["detail"], d["lane"],
+                   superstep=d.get("superstep", 0), t=d.get("t", 0.0))
+
+    def __repr__(self) -> str:
+        return (f"LaneFault(cause={self.cause!r}, lane={self.lane}, "
+                f"t={self.t!r}, detail={self.detail!r})")
+
+
+class DispatchExhausted(RuntimeError):
+    """A device dispatch kept failing after every watchdog retry; the
+    caller (serving layer) should fall back to the solo host path for
+    the affected lanes instead of poisoning the whole campaign."""
+
+
+class DispatchWatchdog:
+    """Wall-clock guard around fleet device dispatches: bounded
+    retries with seeded exponential backoff (riding the existing
+    :class:`~simgrid_tpu.s4u.activity.RetryPolicy` shape) around every
+    dispatch/fetch, plus a post-hoc slow-dispatch threshold.
+
+    Retrying a fleet dispatch is SAFE: issues and fetches are pure
+    functions of the committed device state (nothing commits until the
+    host collect), so a re-run after a transient runtime failure is
+    bit-identical.  A dispatch that still fails after
+    ``policy.max_attempts`` raises :class:`DispatchExhausted`.  A
+    dispatch that *succeeds* but took longer than ``timeout_s`` cannot
+    be aborted mid-flight (jax calls are synchronous) — it is counted
+    in ``watchdog_slow_dispatches`` so operators see the device
+    degrading before it dies.
+
+    Backoff delays use the monotonic-safe ``time.sleep`` only; the
+    jitter is the RetryPolicy's SEEDED stream, so retry timing never
+    introduces wall-clock entropy into the audited packages."""
+
+    def __init__(self, policy=None, timeout_s: float = float("inf")):
+        if policy is None:
+            from ..s4u.activity import RetryPolicy
+            policy = RetryPolicy(max_attempts=3, base_delay=0.05,
+                                 multiplier=4.0, max_delay=2.0)
+        self.policy = policy
+        self.timeout_s = float(timeout_s)
+        self.retries = 0
+        self.slow_dispatches = 0
+        self.exhausted = 0
+
+    def guard(self, fn, what: str = "dispatch"):
+        attempt = 1
+        while True:
+            t0 = time.perf_counter()
+            try:
+                out = fn()
+            except Exception as exc:
+                if attempt >= int(self.policy.max_attempts):
+                    self.exhausted += 1
+                    opstats.bump("watchdog_exhausted")
+                    raise DispatchExhausted(
+                        f"fleet {what} failed {attempt} time(s), "
+                        f"retry policy exhausted: {exc}") from exc
+                self.retries += 1
+                opstats.bump("watchdog_retries")
+                time.sleep(float(self.policy.backoff(attempt)))
+                attempt += 1
+                continue
+            if time.perf_counter() - t0 > self.timeout_s:
+                self.slow_dispatches += 1
+                opstats.bump("watchdog_slow_dispatches")
+            return out
+
+    def timed(self, fn, what: str = "fetch"):
+        """Wall-clock accounting WITHOUT retries — for the ring fetch,
+        whose source buffer is consumed on failure (the superstep must
+        be replayed from committed state, not the fetch re-run)."""
+        t0 = time.perf_counter()
+        out = fn()
+        if time.perf_counter() - t0 > self.timeout_s:
+            self.slow_dispatches += 1
+            opstats.bump("watchdog_slow_dispatches")
+        return out
 
 
 def _pow2_bucket(n: int) -> int:
@@ -602,7 +726,7 @@ class ReplicaState:
     """Host-side record of one replica in a fleet."""
 
     __slots__ = ("index", "events", "fault_events", "t", "advances",
-                 "alive", "error")
+                 "alive", "error", "fault")
 
     def __init__(self, index: int):
         self.index = index
@@ -613,6 +737,8 @@ class ReplicaState:
         self.advances = 0
         self.alive = True
         self.error: Optional[str] = None
+        #: why the lane was quarantined (None for clean completion)
+        self.fault: Optional[LaneFault] = None
 
 
 class BatchDrainSim:
@@ -663,7 +789,7 @@ class BatchDrainSim:
                  device=None, v_bound=None, penalty=None, remains=None,
                  pipeline: int = 0, mesh=None, tapes=None,
                  plan=None, tape_slots: int = 0, start_dead=(),
-                 batch_w: Optional[bool] = None):
+                 batch_w: Optional[bool] = None, watchdog=None):
         if not overrides:
             raise ValueError("BatchDrainSim needs at least one replica")
         if done_mode not in ("rel", "abs"):
@@ -672,6 +798,9 @@ class BatchDrainSim:
         #: serving.plancache.CompiledPlan routing the fleet's jitted
         #: programs through AOT-compiled executables (None = plain jit)
         self._plan = plan
+        #: DispatchWatchdog wrapping every device dispatch/fetch in
+        #: wall-clock accounting + seeded-backoff retries (None = raw)
+        self._watchdog = watchdog
         self.eps = float(eps)
         self.done_eps = float(done_eps)
         self.done_mode = done_mode
@@ -926,15 +1055,31 @@ class BatchDrainSim:
     def _call_plan(self, kind: str, fn, args, statics):
         """Dispatch one fleet program: through the AOT plan cache when
         the fleet carries a CompiledPlan (warm restarts reuse
-        serialized executables, zero traces), else the plain jit."""
+        serialized executables, zero traces), else the plain jit.
+        With a watchdog every dispatch runs under its wall-clock guard
+        (seeded backoff + bounded retries); dispatches are pure
+        functions of committed device state, so a retry is safe."""
         if self._plan is not None:
-            return self._plan.call(kind, fn, args, statics)
-        return fn(*args, **statics)
+            issue = lambda: self._plan.call(kind, fn, args, statics)
+        else:
+            issue = lambda: fn(*args, **statics)
+        if self._watchdog is not None:
+            return self._watchdog.guard(issue, what=f"dispatch:{kind}")
+        return issue()
 
     # -- fleet stepping ----------------------------------------------------
 
     def _fetch(self, packed) -> np.ndarray:
         self.syncs += 1
+        if self._watchdog is not None:
+            # the ring fetch is the sync point where a wedged device
+            # program actually surfaces — time it, but do NOT retry on
+            # failure (the buffer is gone; the superstep must replay)
+            return self._watchdog.timed(
+                lambda: self._fetch_raw(packed), what="fetch")
+        return self._fetch_raw(packed)
+
+    def _fetch_raw(self, packed) -> np.ndarray:
         if self._mesh is None:
             return opstats.timed_fetch(packed)
         # per-shard ring demux: each device's [B/M, ·] block comes back
@@ -1018,6 +1163,39 @@ class BatchDrainSim:
         self.spec_rolled_back += 1
         opstats.bump("speculations_rolled_back")
 
+    def _stall_cause(self, b: int, n_live: int) -> Tuple[str, str]:
+        """Attribute a fatal stall honestly: the superstep kernel's
+        masked arithmetic surfaces a NaN-poisoned scenario (NaN
+        capacity/size/penalty) as "no flow holds bandwidth" rather
+        than a NaN clock, so on this already-fatal path we pay one
+        extra fetch of the lane's committed arrays and classify NaN
+        state as ``nan_solve`` instead of ``stall``."""
+        for name, arr in (("remaining work", self._rem),
+                          ("penalties", self._pen),
+                          ("capacities", self._cb)):
+            if np.isnan(np.asarray(arr[b])).any():
+                return ("nan_solve",
+                        f"drain solve consumed non-finite lane state "
+                        f"(NaN in {name})")
+        return ("stall",
+                f"drain stalled: no flow holds bandwidth "
+                f"({n_live} live)")
+
+    def _quarantine(self, b: int, cause: str, detail: str) -> None:
+        """Kill exactly lane ``b`` with a structured cause: the lane
+        goes dark via the alive mask (like any death — every other
+        lane's vmapped math is untouched, so their streams stay
+        bit-identical to solo) and the replica record carries a
+        :class:`LaneFault` for the serving layer to surface on the
+        ticket."""
+        rep = self.replicas[b]
+        rep.error = detail
+        rep.fault = LaneFault(cause, detail, b,
+                              superstep=self.supersteps, t=rep.t)
+        rep.alive = False
+        self._alive[b] = False
+        opstats.bump("lane_quarantined_" + cause)
+
     def _superstep_collect_all(self, tok: "FleetToken",
                                rescue: bool = False
                                ) -> Tuple[int, bool]:
@@ -1056,6 +1234,28 @@ class BatchDrainSim:
                           o + 2 * k_max + 2 * ring_n].astype(np.int64)
             self.rounds += rounds
             opstats.bump("fixpoint_rounds", rounds)
+            if np.isnan(t_sum):
+                # a poisoned scenario (e.g. NaN link capacity) turns
+                # the lane's whole advance into NaN — quarantine it
+                # BEFORE the ring demux so its garbage events never
+                # reach the committed stream; the vmapped lane math is
+                # per-lane, so no other lane saw the NaN
+                self._quarantine(
+                    b, "nan_solve",
+                    "drain solve produced a non-finite clock advance "
+                    "(NaN)")
+                deaths += 1
+                continue
+            if n_ev > ring_n:
+                # defensive: a ring claiming more events than it has
+                # slots would walk the demux off the row and corrupt
+                # neighbouring lanes' streams
+                self._quarantine(
+                    b, "ring_overflow",
+                    f"completion ring overflow: {n_ev} events for "
+                    f"{ring_n} slots")
+                deaths += 1
+                continue
             rep.advances += adv
             t_base = rep.t
             if self.has_tape:
@@ -1075,10 +1275,7 @@ class BatchDrainSim:
                                        int(ring_id[j])))
             rep.t = t_base + t_sum
             if flag == _FLAG_STALLED:
-                rep.error = (f"drain stalled: no flow holds bandwidth "
-                             f"({n_live} live)")
-                rep.alive = False
-                self._alive[b] = False
+                self._quarantine(b, *self._stall_cause(b, n_live))
                 deaths += 1
             elif n_live == 0:
                 rep.alive = False
@@ -1086,9 +1283,8 @@ class BatchDrainSim:
                 deaths += 1
             elif flag == _FLAG_BUDGET and adv == 0:
                 if rescue:
-                    rep.error = "drain solve did not converge"
-                    rep.alive = False
-                    self._alive[b] = False
+                    self._quarantine(b, "non_convergence",
+                                     "drain solve did not converge")
                     deaths += 1
                 else:
                     stuck.append(b)
@@ -1303,10 +1499,8 @@ class BatchDrainSim:
                 rounds, n_light = int(st[b, 0]), int(st[b, 1])
                 if n_light:
                     if rounds >= _MAX_ROUNDS:
-                        rep = self.replicas[b]
-                        rep.error = "drain solve did not converge"
-                        rep.alive = False
-                        self._alive[b] = False
+                        self._quarantine(b, "non_convergence",
+                                         "drain solve did not converge")
                         active[b] = False
                         self.rounds += rounds
                         opstats.bump("fixpoint_rounds", rounds)
@@ -1316,11 +1510,15 @@ class BatchDrainSim:
                 rep = self.replicas[b]
                 dt, n_live = float(st[b, 2]), int(st[b, 3])
                 done = st[b, 4:] > 0
+                if np.isnan(dt):
+                    self._quarantine(
+                        b, "nan_solve",
+                        "drain solve produced a non-finite clock "
+                        "advance (NaN)")
+                    active[b] = False
+                    continue
                 if not np.isfinite(dt):
-                    rep.error = (f"drain stalled: no flow holds "
-                                 f"bandwidth ({n_live} live)")
-                    rep.alive = False
-                    self._alive[b] = False
+                    self._quarantine(b, *self._stall_cause(b, n_live))
                     active[b] = False
                     continue
                 rep.t += dt
@@ -1419,6 +1617,166 @@ class BatchDrainSim:
             if between is not None:
                 between(self)
             max_supersteps -= 1
+
+    # -- superstep-boundary checkpoint/resume ------------------------------
+
+    def committed_state(self) -> Dict:
+        """Snapshot the fleet's COMMITTED state at a collect boundary:
+        the materialized per-lane device arrays (bounds, penalties,
+        remaining, thresholds, tape rows + cursors, per-replica weight
+        tables), the alive mask, the f64 host clocks and advance
+        counts, the committed event/fault-event prefixes (ragged-
+        flattened, f64/i64 exact) and the per-lane error/LaneFault
+        records.  In-flight pipeline speculation is NEVER part of the
+        snapshot — speculative tokens carry their state on their own
+        buffers and commit nothing until collected — so a checkpoint
+        between supersteps is exactly the state resume replays from
+        (the same replay semantics as a mispredict discard)."""
+        reps = self.replicas
+        arrays = {
+            "cb": np.asarray(self._cb),
+            "pen": np.asarray(self._pen),
+            "rem": np.asarray(self._rem),
+            "thresh": np.asarray(self._thresh),
+            "alive": self._alive.copy(),
+            "tpos": np.asarray(self._tpos),
+            "clocks": np.array([r.t for r in reps], np.float64),
+            "advances": np.array([r.advances for r in reps],
+                                 np.int64),
+            "ev_counts": np.array([len(r.events) for r in reps],
+                                  np.int64),
+            "ev_t": np.array([t for r in reps
+                              for t, _ in r.events], np.float64),
+            "ev_id": np.array([i for r in reps
+                               for _, i in r.events], np.int64),
+            "fev_counts": np.array(
+                [len(r.fault_events) for r in reps], np.int64),
+            "fev_t": np.array([t for r in reps
+                               for t, _ in r.fault_events],
+                              np.float64),
+            "fev_slot": np.array([s for r in reps
+                                  for _, s in r.fault_events],
+                                 np.int64),
+        }
+        if self.has_tape:
+            tt, ts, tv = self._tape
+            arrays["tape_t"] = np.asarray(tt)
+            arrays["tape_s"] = np.asarray(ts)
+            arrays["tape_v"] = np.asarray(tv)
+        if self.batch_w:
+            arrays["ew"] = np.asarray(self._dev[2])
+        return {
+            "arrays": arrays,
+            "errors": [r.error for r in reps],
+            "faults": [r.fault.to_dict() if r.fault is not None
+                       else None for r in reps],
+            "counters": {
+                "admitted": self.admitted,
+                "supersteps": self.supersteps,
+                "syncs": self.syncs,
+                "rounds": self.rounds,
+                "rescues": self.rescues,
+                "pad_events": self.pad_events,
+                "spec_issued": self.spec_issued,
+                "spec_committed": self.spec_committed,
+                "spec_rolled_back": self.spec_rolled_back,
+            },
+        }
+
+    def restore_state(self, st: Dict) -> None:
+        """Adopt a :meth:`committed_state` snapshot into THIS fleet
+        (built from the same plan/geometry): uploads the saved device
+        arrays, rebuilds every host replica record — committed events,
+        fault streams, clocks, errors, LaneFaults — and restores the
+        alive mask and counters.  Raises ``ValueError`` on any
+        geometry mismatch (a snapshot from a different plan)."""
+        arrays = st["arrays"]
+        B, Bp = self.B, self.B_padded
+
+        def _chk(name, dtype, shape):
+            if name not in arrays:
+                raise ValueError(
+                    f"fleet snapshot is missing array {name!r}")
+            a = np.asarray(arrays[name])
+            if tuple(a.shape) != tuple(shape):
+                raise ValueError(
+                    f"fleet snapshot array {name!r} has shape "
+                    f"{a.shape}, this fleet expects {tuple(shape)} — "
+                    f"the snapshot is from a different plan")
+            return np.ascontiguousarray(a, dtype)
+
+        cb = _chk("cb", self.dtype, (Bp, self.n_c))
+        pen = _chk("pen", self.dtype, (Bp, self.n_v))
+        rem = _chk("rem", self.dtype, (Bp, self.n_v))
+        thresh = _chk("thresh", self.dtype, (Bp, self.n_v))
+        alive = _chk("alive", bool, (Bp,))
+        tpos = _chk("tpos", np.int32, (Bp,))
+        clocks = _chk("clocks", np.float64, (B,))
+        advances = _chk("advances", np.int64, (B,))
+        ev_counts = _chk("ev_counts", np.int64, (B,))
+        fev_counts = _chk("fev_counts", np.int64, (B,))
+        ev_t = _chk("ev_t", np.float64, (int(ev_counts.sum()),))
+        ev_id = _chk("ev_id", np.int64, (int(ev_counts.sum()),))
+        fev_t = _chk("fev_t", np.float64, (int(fev_counts.sum()),))
+        fev_slot = _chk("fev_slot", np.int64,
+                        (int(fev_counts.sum()),))
+        if "tape_t" in arrays:
+            if not self.has_tape:
+                raise ValueError(
+                    "fleet snapshot carries fault tapes but this "
+                    "fleet was built without tape capacity (pass "
+                    "tape_slots at build)")
+            T = self._tape_width
+            tt = _chk("tape_t", np.float64, (Bp, T))
+            ts = _chk("tape_s", np.int32, (Bp, T))
+            tv = _chk("tape_v", self.dtype, (Bp, T))
+            self._tape = (self._put_batched(tt),
+                          self._put_batched(ts),
+                          self._put_batched(tv))
+        if "ew" in arrays:
+            if not self.batch_w:
+                raise ValueError(
+                    "fleet snapshot carries per-replica weight "
+                    "tables but this fleet was built with a shared "
+                    "table (pass batch_w=True at build)")
+            ew = _chk("ew", self.dtype, tuple(self._dev[2].shape))
+            self._dev[2] = self._put_batched(ew)
+        self._cb = self._put_batched(cb)
+        self._pen = self._put_batched(pen)
+        self._rem = self._put_batched(rem)
+        self._thresh = self._put_batched(thresh)
+        self._tpos = self._put_batched(tpos)
+        errors = st.get("errors") or [None] * B
+        faults = st.get("faults") or [None] * B
+        eo = fo = 0
+        for b in range(B):
+            rep = ReplicaState(b)
+            n_e, n_f = int(ev_counts[b]), int(fev_counts[b])
+            rep.events = [(float(ev_t[eo + j]), int(ev_id[eo + j]))
+                          for j in range(n_e)]
+            rep.fault_events = [(float(fev_t[fo + j]),
+                                 int(fev_slot[fo + j]))
+                                for j in range(n_f)]
+            eo += n_e
+            fo += n_f
+            rep.t = float(clocks[b])
+            rep.advances = int(advances[b])
+            rep.alive = bool(alive[b])
+            rep.error = errors[b]
+            rep.fault = (LaneFault.from_dict(faults[b])
+                         if faults[b] else None)
+            self.replicas[b] = rep
+        self._alive = alive.copy()
+        c = st.get("counters") or {}
+        self.admitted = int(c.get("admitted", 0))
+        self.supersteps = int(c.get("supersteps", 0))
+        self.syncs = int(c.get("syncs", 0))
+        self.rounds = int(c.get("rounds", 0))
+        self.rescues = int(c.get("rescues", 0))
+        self.pad_events = int(c.get("pad_events", 0))
+        self.spec_issued = int(c.get("spec_issued", 0))
+        self.spec_committed = int(c.get("spec_committed", 0))
+        self.spec_rolled_back = int(c.get("spec_rolled_back", 0))
 
     # -- results -----------------------------------------------------------
 

@@ -1117,6 +1117,14 @@ class DrainSim:
         self.syncs += 1
         rounds, adv, n_ev = int(p[0]), int(p[1]), int(p[2])
         t_sum = float(p[3])
+        if np.isnan(t_sum):
+            # a poisoned scenario (e.g. NaN link capacity) makes the
+            # whole advance NaN — fail with a cause instead of
+            # committing a garbage clock/ring (the solo mirror of the
+            # fleet's nan_solve lane quarantine)
+            raise RuntimeError(
+                "drain solve produced a non-finite clock advance "
+                "(NaN)")
         n_live, flag = int(p[4]), int(p[5])
         live_elems = int(p[6])
         o = 7

@@ -1656,6 +1656,10 @@ def solve_jax(system: System) -> None:
             system.warm_solver.invalidate()
         _fallback_count += 1
         system.fallback_count = getattr(system, "fallback_count", 0) + 1
+        # per-stage visibility (the global int cannot be attributed):
+        # quarantine decisions and bench rows read this scoped counter
+        from . import opstats
+        opstats.bump("solver_fallbacks")
         if not _fallback_warned:
             _fallback_warned = True
             from ..utils import log as _log

@@ -113,6 +113,37 @@ context object through the solver entry points:
                               conformal-interval prediction vs routed
                               to the device because the interval was
                               too wide (exact=True bypasses both)
+* ``solver_fallbacks``      — device solves redone by the exact host
+                              solver after a non-convergent/non-finite
+                              device fixpoint (the per-stage view of
+                              ``lmm_jax.get_fallback_count``'s
+                              process-global int)
+* ``lane_quarantined_<cause>`` — fleet lanes killed WITH a recorded
+                              cause (ops.lmm_batch.LaneFault) instead
+                              of poisoning the fleet: ``nan_solve``,
+                              ``stall``, ``non_convergence``,
+                              ``ring_overflow``, ``admission_storm``,
+                              ``watchdog``
+* ``fleet_checkpoints``     — superstep-boundary FleetCheckpoints
+                              written by the campaign service
+* ``checkpoint_ms``         — monotonic milliseconds spent building +
+                              writing those checkpoints
+* ``fleet_resumes``         — services rebuilt from a FleetCheckpoint
+                              token (CampaignService.resume)
+* ``watchdog_retries`` / ``watchdog_exhausted`` /
+  ``watchdog_slow_dispatches`` — dispatch-watchdog activity: seeded-
+                              backoff retries of failed device
+                              dispatches, dispatches that kept failing
+                              past the retry policy, and dispatches
+                              that succeeded but exceeded the
+                              wall-clock threshold
+* ``watchdog_solo_fallbacks`` — campaign-service fallbacks onto the
+                              solo host path after watchdog
+                              exhaustion (affected in-flight queries
+                              are re-served solo, bit-identically)
+* ``serve_solo_results``    — queries the campaign service answered
+                              on the solo host path (watchdog
+                              fallback)
 
 Counters only ever increase; consumers snapshot before a phase and
 diff after (``snapshot``/``diff``), or wrap the phase in ``scoped``.

@@ -426,13 +426,17 @@ class ScenarioPlan:
                  superstep_rounds: int = 0,
                  pipeline: Optional[int] = None, mesh=None,
                  plan_cache=None, tape_slots: int = 0,
-                 batch_w: Optional[bool] = None) -> BatchDrainSim:
+                 batch_w: Optional[bool] = None,
+                 watchdog=None) -> BatchDrainSim:
         """Build one ready fleet executor for ``specs``.  ``width``
         sizes the fleet wider than the initial spec list — the extra
         lanes are dead from birth and available for mid-flight
         admission (serving).  ``plan_cache`` (a serving.plancache.
         PlanCache) routes the fleet's jitted programs through
-        AOT-compiled executables keyed by :meth:`plan_key`."""
+        AOT-compiled executables keyed by :meth:`plan_key`.
+        ``watchdog`` (an ops.lmm_batch.DispatchWatchdog) wraps every
+        fleet dispatch in wall-clock accounting + bounded seeded-
+        backoff retries."""
         specs = list(specs)
         width = len(specs) if width is None else int(width)
         if width < len(specs):
@@ -460,7 +464,7 @@ class ScenarioPlan:
             remains=self.remains, pipeline=depth, mesh=use_mesh,
             tapes=tapes, plan=compiled, tape_slots=tape_slots,
             start_dead=tuple(range(len(specs), width)),
-            batch_w=batch_w)
+            batch_w=batch_w, watchdog=watchdog)
 
     def solo(self, spec: ScenarioSpec,
              superstep_rounds: int = 0) -> ReplicaResult:

@@ -24,12 +24,26 @@ Standing invariant: every device-served result — including scenarios
 admitted mid-flight into a partially-drained fleet — is bit-identical
 (events, fault streams, Kahan clocks) to ``ScenarioPlan.solo`` on the
 same spec (``tools/check_determinism.py --runtime-serve``).
+
+Durability (preemption-safe campaigns): ``CampaignService.
+checkpoint``/``resume`` persist the fleet's superstep-boundary
+committed state + ticket journal as a
+:class:`~simgrid_tpu.checkpoint.FleetCheckpoint`, lanes with poisoned
+scenarios are QUARANTINED with a :class:`~simgrid_tpu.ops.lmm_batch.
+LaneFault` cause instead of killing the fleet, and device dispatches
+run under a :class:`~simgrid_tpu.ops.lmm_batch.DispatchWatchdog`
+(seeded-backoff retries, solo-path fallback on exhaustion) —
+``tools/check_determinism.py --runtime-resume``.
 """
 
+from ..checkpoint import CheckpointError, FleetCheckpoint
+from ..ops.lmm_batch import (DispatchExhausted, DispatchWatchdog,
+                             LaneFault)
 from .plancache import CompiledPlan, PlanCache
 from .service import CampaignService, ServiceResult, Ticket
 from .surrogate import RuntimeSurrogate, SurrogateAnswer
 
 __all__ = ["PlanCache", "CompiledPlan", "CampaignService",
            "ServiceResult", "Ticket", "RuntimeSurrogate",
-           "SurrogateAnswer"]
+           "SurrogateAnswer", "FleetCheckpoint", "CheckpointError",
+           "LaneFault", "DispatchWatchdog", "DispatchExhausted"]

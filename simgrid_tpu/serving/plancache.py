@@ -176,6 +176,15 @@ class PlanCache:
             except Exception:
                 self._broken[digest] = True
                 self._mem.pop(digest, None)
+                # evict the on-disk artifact too: a restarted process
+                # would deserialize the same broken executable and
+                # re-fail — deleting it makes the restart RECOMPILE
+                # instead (best-effort; serving continues either way)
+                if self.cache_dir:
+                    try:
+                        os.remove(self._path(digest))
+                    except OSError:
+                        pass
                 self.fallbacks += 1
                 opstats.bump("plan_cache_fallbacks")
         return jitted_fn(*args, **statics)

@@ -126,6 +126,26 @@ def test_serve_runtime_determinism():
     assert problems == []
 
 
+def test_resume_runtime_determinism():
+    """Dynamic coverage of the preemption-safe campaign layer (ISSUE
+    12 tooling, the `--quick` small-N instance): a service killed at a
+    collect boundary and rebuilt from its FleetCheckpoint token —
+    warm through the AOT plan cache, fault tapes active, pipeline
+    depth 2 with speculation in flight at the kill — continues
+    bit-identical (events, fired faults and Kahan clocks) to the
+    uninterrupted run and to ScenarioPlan.solo; resuming the same
+    token twice is idempotent; and a NaN-poisoned lane quarantines
+    with a nan_solve LaneFault while every other lane stays
+    bit-identical to solo.  The full-size check runs via
+    `check_determinism.py --runtime-resume`."""
+    checker = _load_checker()
+    problems = checker.check_resume_runtime(n_c=24, n_v=64, batch=3,
+                                            scenarios=6, k=4,
+                                            depths=(0, 2),
+                                            stop_after=2)
+    assert problems == []
+
+
 def test_checker_flags_violations(tmp_path):
     """The lint itself works: a planted file with each banned pattern is
     reported (guards against the lint silently matching nothing)."""

@@ -1,0 +1,520 @@
+"""Preemption-safe campaign fleets (ISSUE 12): superstep-boundary
+checkpoint/resume, lane quarantine, and dispatch watchdogs.
+
+The acceptance contract: corrupt or mismatched checkpoint artifacts
+fail at LOAD with a clear CheckpointError (never a deep numpy error
+mid-resume); FleetCheckpoint round-trips token + arrays exactly;
+BatchDrainSim committed state restores bit-identically into a fresh
+executor and refuses snapshots from a different plan; a service killed
+at a collect boundary — mid-admission, with pipeline speculation and
+fired-but-uncollected fault tape entries in flight — resumes
+bit-identically to the uninterrupted run and to ScenarioPlan.solo, and
+resuming the same token twice is idempotent; a NaN-poisoned scenario
+quarantines exactly its own lane with a nan_solve LaneFault; the
+dispatch watchdog retries with seeded backoff, raises
+DispatchExhausted when the policy runs out, and the service then
+re-serves the affected queries on the solo host path; a query deferred
+across too many fleet generations fails with an admission_storm
+LaneFault instead of spinning forever."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from bench import build_arrays
+from simgrid_tpu.checkpoint import (Checkpoint, CheckpointError,
+                                    FleetCheckpoint)
+from simgrid_tpu.ops import opstats
+from simgrid_tpu.ops.lmm_batch import (DispatchExhausted,
+                                       DispatchWatchdog, LaneFault)
+from simgrid_tpu.parallel.campaign import ScenarioPlan, ScenarioSpec
+from simgrid_tpu.s4u.activity import RetryPolicy
+from simgrid_tpu.serving import CampaignService, PlanCache
+
+
+@pytest.fixture(scope="module")
+def plan():
+    rng = np.random.default_rng(43)
+    n_c, n_v = 24, 64
+    arrays = build_arrays(rng, n_c, n_v, 3, np.float64)
+    E = arrays.n_elem
+    sizes = rng.choice(np.linspace(1e5, 2e6, 16), n_v)
+    return ScenarioPlan(arrays.e_var[:E], arrays.e_cnst[:E],
+                        arrays.e_w[:E], arrays.c_bound[:n_c], sizes,
+                        eps=1e-9, superstep=4, fault_mode="on")
+
+
+def faulted_spec(seed, label=None):
+    return ScenarioSpec(seed=seed, bw_scale=1.0 + 0.1 * (seed % 5),
+                        fault_mtbf=150.0, fault_mttr=50.0,
+                        fault_horizon=900.0, label=label)
+
+
+def stream_of(t):
+    """The comparable outcome of one ticket: everything except wall
+    -clock latency metadata."""
+    r = t.result
+    return (r.source, [tuple(e) for e in (r.events or [])],
+            [tuple(e) for e in (r.fault_events or [])], r.t, r.error)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint.load hardening (the shared validation gate)
+# ---------------------------------------------------------------------------
+
+class TestCheckpointLoadValidation:
+    def test_missing_token_field(self, tmp_path):
+        p = str(tmp_path / "tok")
+        with open(p, "w") as f:
+            json.dump({"module": "m", "args": [], "at": 0.0}, f)
+        with pytest.raises(CheckpointError, match="qualname"):
+            Checkpoint.load(p)
+
+    def test_unreadable_token(self, tmp_path):
+        p = str(tmp_path / "tok")
+        with open(p, "w") as f:
+            f.write("{not json")
+        with pytest.raises(CheckpointError, match="unreadable"):
+            Checkpoint.load(p)
+
+    def test_missing_sidecar(self, tmp_path):
+        p = str(tmp_path / "tok")
+        with open(p, "w") as f:
+            json.dump({"module": "m", "qualname": "q", "args": [],
+                       "at": 0.0, "has_solves": True}, f)
+        with pytest.raises(CheckpointError, match="missing"):
+            Checkpoint.load(p)
+
+    def test_truncated_sidecar(self, tmp_path):
+        p = str(tmp_path / "tok")
+        with open(p, "w") as f:
+            json.dump({"module": "m", "qualname": "q", "args": [],
+                       "at": 0.0, "has_solves": True}, f)
+        with open(p + ".solves.npz", "wb") as f:
+            f.write(b"PK\x03\x04 definitely not a whole zip")
+        with pytest.raises(CheckpointError, match="unreadable"):
+            Checkpoint.load(p)
+
+    def test_wrong_dtype_and_missing_key(self, tmp_path):
+        p = str(tmp_path / "tok")
+        with open(p, "w") as f:
+            json.dump({"module": "m", "qualname": "q", "args": [],
+                       "at": 0.0, "has_solves": True}, f)
+        # shape promises one record; its value array has a bad dtype
+        np.savez_compressed(
+            p + ".solves.npz", shape=np.asarray([1], np.int64),
+            s0r0v=np.zeros(3, np.float32),
+            s0r0c=np.zeros((0, 3), np.float64),
+            s0r0a=np.zeros(0, np.int64), s0r0o=np.zeros(1, np.int64),
+            s0r0f=np.zeros(0, np.int64))
+        with pytest.raises(CheckpointError, match="dtype"):
+            Checkpoint.load(p)
+        np.savez_compressed(
+            p + ".solves.npz", shape=np.asarray([1], np.int64))
+        with pytest.raises(CheckpointError, match="missing array"):
+            Checkpoint.load(p)
+
+    def test_inconsistent_ragged_offsets(self, tmp_path):
+        p = str(tmp_path / "tok")
+        with open(p, "w") as f:
+            json.dump({"module": "m", "qualname": "q", "args": [],
+                       "at": 0.0, "has_solves": True}, f)
+        np.savez_compressed(
+            p + ".solves.npz", shape=np.asarray([1], np.int64),
+            s0r0v=np.zeros(3, np.float64),
+            s0r0c=np.zeros((2, 3), np.float64),
+            s0r0a=np.zeros(4, np.int64),
+            s0r0o=np.asarray([0, 9, 4], np.int64),  # 9 > len(flat)
+            s0r0f=np.zeros(0, np.int64))
+        with pytest.raises(CheckpointError, match="offsets"):
+            Checkpoint.load(p)
+
+
+# ---------------------------------------------------------------------------
+# FleetCheckpoint format
+# ---------------------------------------------------------------------------
+
+class TestFleetCheckpointFormat:
+    def test_roundtrip(self, tmp_path):
+        p = str(tmp_path / "fleet")
+        token = {"plan": {"eps": 1e-9}, "service": {"batch": 3}}
+        arrays = {"a": np.arange(6, dtype=np.float64).reshape(2, 3),
+                  "b": np.asarray([True, False]),
+                  "c": np.arange(4, dtype=np.int64)}
+        FleetCheckpoint(token, arrays).save(p)
+        back = FleetCheckpoint.load(p)
+        assert back.token == token
+        assert set(back.arrays) == set(arrays)
+        for k, a in arrays.items():
+            assert back.arrays[k].dtype == a.dtype
+            np.testing.assert_array_equal(back.arrays[k], a)
+
+    def test_rejects_foreign_kind_and_format(self, tmp_path):
+        p = str(tmp_path / "fleet")
+        FleetCheckpoint({"x": 1}, {"a": np.zeros(2)}).save(p)
+        with open(p) as f:
+            d = json.load(f)
+        d["kind"] = "other"
+        with open(p, "w") as f:
+            json.dump(d, f)
+        with pytest.raises(CheckpointError, match="not a fleet"):
+            FleetCheckpoint.load(p)
+        d["kind"] = "fleet"
+        d["format"] = 99
+        with open(p, "w") as f:
+            json.dump(d, f)
+        with pytest.raises(CheckpointError, match="format"):
+            FleetCheckpoint.load(p)
+
+    def test_rejects_sidecar_manifest_mismatch(self, tmp_path):
+        p = str(tmp_path / "fleet")
+        FleetCheckpoint({"x": 1},
+                        {"a": np.zeros((2, 3), np.float64)}).save(p)
+        # sidecar swapped for one whose array disagrees with the
+        # token's manifest (a stale or foreign .fleet.npz)
+        np.savez_compressed(p + ".fleet.npz",
+                            a=np.zeros((2, 2), np.float64))
+        with pytest.raises(CheckpointError, match="shape"):
+            FleetCheckpoint.load(p)
+        os.remove(p + ".fleet.npz")
+        with pytest.raises(CheckpointError, match="missing"):
+            FleetCheckpoint.load(p)
+
+
+# ---------------------------------------------------------------------------
+# BatchDrainSim committed state
+# ---------------------------------------------------------------------------
+
+class _Stop(Exception):
+    pass
+
+
+def _run_supersteps(sim, n):
+    """Drive a fleet for exactly n committed supersteps, then stop at
+    the collect boundary (the pipelined driver discards in-flight
+    speculation on the way out, like any halt)."""
+    seen = [0]
+
+    def between(s):
+        seen[0] += 1
+        if seen[0] >= n:
+            raise _Stop()
+        return False
+
+    try:
+        sim.run(between=between)
+    except _Stop:
+        pass
+
+
+class TestCommittedStateRoundtrip:
+    def test_restore_bit_identical(self, plan):
+        specs = [faulted_spec(0, "a"), ScenarioSpec(seed=1, label="b"),
+                 ScenarioSpec(seed=2, bw_scale=1.2, label="c")]
+        sim = plan.executor(specs, tape_slots=plan.tape_len(specs[0]))
+        _run_supersteps(sim, 2)
+        st = sim.committed_state()
+        fresh = plan.executor([], width=sim.B,
+                              tape_slots=sim._tape_width)
+        fresh.restore_state(st)
+        # the restored fleet IS the original at this boundary
+        a, b = sim.committed_state(), fresh.committed_state()
+        assert a["counters"] == b["counters"]
+        assert a["errors"] == b["errors"]
+        for k in a["arrays"]:
+            np.testing.assert_array_equal(a["arrays"][k],
+                                          b["arrays"][k])
+        # and both drains finish identically from here
+        sim.run()
+        fresh.run()
+        for r0, r1 in zip(sim.replicas, fresh.replicas):
+            assert r0.events == r1.events
+            assert r0.fault_events == r1.fault_events
+            assert r0.t == r1.t
+
+    def test_rejects_snapshot_from_different_plan(self, plan):
+        sim = plan.executor([ScenarioSpec(seed=1)], width=2)
+        _run_supersteps(sim, 1)
+        st = sim.committed_state()
+        other = plan.executor([ScenarioSpec(seed=1)], width=4)
+        with pytest.raises(ValueError, match="different plan"):
+            other.restore_state(st)
+        # tape arrays require a tape-capable fleet
+        tape_sim = plan.executor([faulted_spec(0)], width=2,
+                                 tape_slots=plan.tape_len(
+                                     faulted_spec(0)))
+        _run_supersteps(tape_sim, 1)
+        tape_st = tape_sim.committed_state()
+        no_tape = plan.executor([ScenarioSpec(seed=1)], width=2)
+        with pytest.raises(ValueError):
+            no_tape.restore_state(tape_st)
+
+
+# ---------------------------------------------------------------------------
+# Service crash windows
+# ---------------------------------------------------------------------------
+
+class TestServiceCrashWindows:
+    def test_resume_mid_admission_and_double_resume(self, plan,
+                                                    tmp_path):
+        """Kill while the queue still holds unadmitted queries (the
+        mid-admission window: some tickets done, some on lanes, some
+        queued), resume, and finish bit-identically — twice."""
+        cache = PlanCache()
+        specs = [faulted_spec(s, f"m{s}") if s % 3 == 0
+                 else ScenarioSpec(seed=s, bw_scale=1.0 + 0.07 * s,
+                                   label=f"m{s}")
+                 for s in range(7)]
+        ref_svc = CampaignService(plan, batch=2, plan_cache=cache)
+        ref_svc.submit_many(specs, exact=True)
+        ref = {t.spec.label: stream_of(t) for t in ref_svc.drain()}
+
+        p = str(tmp_path / "mid")
+        svc = CampaignService(plan, batch=2, plan_cache=cache)
+        svc.submit_many(specs, exact=True)
+        svc.drain(stop_after=2, checkpoint_path=p)
+        assert svc._fleet is not None
+        assert svc.pending() > 0  # the kill really landed mid-service
+        del svc
+
+        outs = []
+        for _ in range(2):
+            back = CampaignService.resume(p, plan_cache=cache)
+            outs.append({t.spec.label: stream_of(t)
+                         for t in back.drain()})
+        assert outs[0] == ref
+        assert outs[1] == ref  # double resume is idempotent
+        for label, spec in ((s.label, s) for s in specs):
+            solo = plan.solo(spec)
+            src, ev, fev, t, err = outs[0][label]
+            assert err is None
+            assert ev == [tuple(e) for e in solo.events]
+            assert fev == [tuple(e) for e in solo.fault_events]
+            assert t == solo.t
+
+    def test_checkpoint_with_inflight_fired_tape(self, plan,
+                                                 tmp_path):
+        """Pipeline depth 2 with active fault tapes: the kill lands
+        with speculative supersteps in flight, including ones whose
+        tape entries already FIRED on device but were never collected.
+        Those fires are speculation — not committed state — so the
+        checkpoint must not contain them and the resume must replay
+        them exactly once (no loss, no duplication)."""
+        cache = PlanCache()
+        specs = [faulted_spec(s, f"f{s}") for s in range(4)]
+        before = opstats.snapshot()
+        p = str(tmp_path / "fired")
+        svc = CampaignService(plan, batch=2, plan_cache=cache,
+                              pipeline=2)
+        svc.submit_many(specs, exact=True)
+        svc.drain(stop_after=2, checkpoint_path=p)
+        assert svc._fleet is not None
+        committed = {t.spec.label: stream_of(t) for t in svc.completed}
+        del svc
+        assert opstats.diff(before).get("speculations_issued", 0) > 0
+
+        back = CampaignService.resume(p, plan_cache=cache)
+        # the checkpoint carries only committed streams
+        restored = {t.spec.label: stream_of(t) for t in back.completed}
+        assert restored == committed
+        done = {t.spec.label: stream_of(t) for t in back.drain()}
+        fired_total = 0
+        for spec in specs:
+            solo = plan.solo(spec)
+            src, ev, fev, t, err = done[spec.label]
+            assert err is None
+            assert ev == [tuple(e) for e in solo.events]
+            assert fev == [tuple(e) for e in solo.fault_events]
+            assert t == solo.t
+            fired_total += len(fev)
+        assert fired_total > 0  # the tapes really fired
+
+    def test_resume_rejects_mismatched_plan(self, plan, tmp_path):
+        p = str(tmp_path / "tok")
+        svc = CampaignService(plan, batch=2)
+        svc.submit_many([ScenarioSpec(seed=s) for s in range(3)],
+                        exact=True)
+        svc.drain(stop_after=1, checkpoint_path=p)
+        rng = np.random.default_rng(7)
+        arrays = build_arrays(rng, 16, 32, 3, np.float64)
+        other = ScenarioPlan(arrays.e_var[:arrays.n_elem],
+                             arrays.e_cnst[:arrays.n_elem],
+                             arrays.e_w[:arrays.n_elem],
+                             arrays.c_bound[:16],
+                             rng.choice(np.linspace(1e5, 2e6, 16), 32),
+                             eps=1e-9, superstep=4)
+        with pytest.raises(CheckpointError, match="topology"):
+            CampaignService.resume(p, plan=other)
+
+
+# ---------------------------------------------------------------------------
+# Lane quarantine
+# ---------------------------------------------------------------------------
+
+class TestLaneQuarantine:
+    def test_nan_poisoned_lane_quarantines_alone(self, plan):
+        """A NaN-poisoned scenario (NaN sizes) kills exactly its own
+        lane with a nan_solve LaneFault; its neighbours stay
+        bit-identical to solo."""
+        poison = ScenarioSpec(seed=9, size_scale=float("nan"),
+                              label="poison")
+        clean = [ScenarioSpec(seed=s, bw_scale=1.0 + 0.1 * s,
+                              label=f"ok{s}") for s in range(2)]
+        before = opstats.snapshot()
+        svc = CampaignService(plan, batch=3)
+        tickets = svc.submit_many([poison] + clean, exact=True)
+        svc.drain()
+        assert opstats.diff(before).get(
+            "lane_quarantined_nan_solve", 0) >= 1
+        for t in tickets:
+            if t.spec.label == "poison":
+                assert t.fault is not None
+                assert t.fault.cause == "nan_solve"
+                assert t.result.error is not None
+                continue
+            solo = plan.solo(t.spec)
+            assert t.fault is None
+            assert t.result.error is None
+            assert t.result.events == solo.events
+            assert t.result.t == solo.t
+
+    def test_lane_fault_roundtrip(self):
+        f = LaneFault("ring_overflow", "72 events for 64 slots", 3,
+                      superstep=11, t=123.5)
+        back = LaneFault.from_dict(f.to_dict())
+        assert (back.cause, back.detail, back.lane, back.superstep,
+                back.t) == (f.cause, f.detail, f.lane, f.superstep,
+                            f.t)
+
+
+# ---------------------------------------------------------------------------
+# Dispatch watchdog
+# ---------------------------------------------------------------------------
+
+def _policy(attempts):
+    return RetryPolicy(max_attempts=attempts, base_delay=1e-4,
+                       multiplier=2.0, max_delay=1e-3)
+
+
+class TestDispatchWatchdog:
+    def test_retries_then_succeeds(self):
+        wd = DispatchWatchdog(policy=_policy(3))
+        calls = [0]
+
+        def flaky():
+            calls[0] += 1
+            if calls[0] < 3:
+                raise RuntimeError("transient device loss")
+            return "ok"
+
+        assert wd.guard(flaky) == "ok"
+        assert calls[0] == 3
+        assert wd.retries == 2
+        assert wd.exhausted == 0
+
+    def test_exhaustion_raises(self):
+        wd = DispatchWatchdog(policy=_policy(2))
+
+        def dead():
+            raise RuntimeError("device gone")
+
+        with pytest.raises(DispatchExhausted, match="device gone"):
+            wd.guard(dead)
+        assert wd.retries == 1
+        assert wd.exhausted == 1
+
+    def test_slow_dispatch_counted(self):
+        wd = DispatchWatchdog(policy=_policy(2), timeout_s=0.0)
+        assert wd.guard(lambda: 7) == 7
+        assert wd.slow_dispatches == 1
+
+    def test_service_falls_back_solo_on_midfleet_exhaustion(self,
+                                                            plan):
+        """Watchdog exhaustion mid-fleet (construction succeeded, a
+        superstep dispatch died): in-flight queries re-serve on the
+        solo host path (bit-identical, watchdog LaneFault on the
+        ticket) and later queries route solo too."""
+        class _DiesMidFleet(DispatchWatchdog):
+            def guard(self, fn, what="dispatch"):
+                if "superstep" in what:
+                    raise DispatchExhausted(
+                        f"fleet {what}: device gone")
+                return super().guard(fn, what=what)
+
+        specs = [ScenarioSpec(seed=s, bw_scale=1.0 + 0.1 * s,
+                              label=f"w{s}") for s in range(3)]
+        svc = CampaignService(plan, batch=2,
+                              watchdog=_DiesMidFleet())
+        tickets = svc.submit_many(specs, exact=True)
+        svc.drain()
+        assert svc._device_broken
+        assert svc.watchdog_solo_fallbacks == 1
+        lane_faulted = 0
+        for t in tickets:
+            assert t.status == "done"
+            assert t.result.source == "solo"
+            solo = plan.solo(t.spec)
+            assert t.result.events == solo.events
+            assert t.result.t == solo.t
+            if t.fault is not None:
+                assert t.fault.cause == "watchdog"
+                lane_faulted += 1
+        # exactly the queries in flight at the failure carry the cause
+        assert lane_faulted == 2
+
+    def test_service_falls_back_solo_on_construction_death(self,
+                                                           plan):
+        """The device can die before the fleet even exists (the first
+        materialize dispatch exhausts the watchdog): the queue head is
+        restored and everything routes solo — no query is ever lost
+        to a half-built fleet."""
+        class _DeadWatchdog(DispatchWatchdog):
+            def guard(self, fn, what="dispatch"):
+                raise DispatchExhausted(f"fleet {what}: device gone")
+
+        specs = [ScenarioSpec(seed=s, bw_scale=1.0 + 0.1 * s,
+                              label=f"d{s}") for s in range(3)]
+        svc = CampaignService(plan, batch=2,
+                              watchdog=_DeadWatchdog())
+        tickets = svc.submit_many(specs, exact=True)
+        svc.drain()
+        assert svc._device_broken
+        assert len(svc.completed) == 3
+        for t in tickets:
+            assert t.status == "done"
+            assert t.result.source == "solo"
+            assert t.fault is None  # nothing was in flight
+            solo = plan.solo(t.spec)
+            assert t.result.events == solo.events
+            assert t.result.t == solo.t
+
+
+# ---------------------------------------------------------------------------
+# Admission storms
+# ---------------------------------------------------------------------------
+
+class TestAdmissionStorm:
+    def test_storm_fails_with_cause(self, plan):
+        """A query the resident fleet can never absorb (its tape is
+        wider than the fleet's reserved slots) is failed with an
+        admission_storm LaneFault after max_admission_retries fleet
+        generations instead of spinning forever."""
+        svc = CampaignService(plan, batch=2, max_admission_retries=1)
+        svc.submit_many([ScenarioSpec(seed=s, label=f"c{s}")
+                         for s in range(2)], exact=True)
+        # keep the (tape-less) fleet resident, then wedge a faulted
+        # query into its queue — admission must defer it
+        svc.drain(stop_after=1)
+        assert svc._fleet is not None
+        storm = svc.submit(faulted_spec(0, "storm"), exact=True)
+        before = opstats.snapshot()
+        svc.drain()
+        assert storm.status == "failed"
+        assert storm.fault is not None
+        assert storm.fault.cause == "admission_storm"
+        assert storm.result.error is not None
+        assert svc.storm_failures == 1
+        assert opstats.diff(before).get(
+            "lane_quarantined_admission_storm", 0) == 1

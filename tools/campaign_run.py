@@ -227,7 +227,9 @@ def main() -> int:
                fault_tape_events=int(
                    stats.get("fault_tape_events", 0)),
                fault_replays=int(stats.get("fault_replays", 0)),
-               lanes_admitted=int(stats.get("lanes_admitted", 0)))
+               lanes_admitted=int(stats.get("lanes_admitted", 0)),
+               solver_fallbacks=int(
+                   stats.get("solver_fallbacks", 0)))
     if plan_cache is not None:
         row.update({k: (round(v, 1) if isinstance(v, float) else v)
                     for k, v in plan_cache.stats().items()})

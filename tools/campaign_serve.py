@@ -184,6 +184,8 @@ def main() -> int:
                    counters["surrogate_answers"]
                    / max(1, args.scenarios), 4),
                dispatches=int(stats.get("dispatches", 0)),
+               solver_fallbacks=int(
+                   stats.get("solver_fallbacks", 0)),
                errors=[t.spec.label for t in tickets
                        if t.result is not None and t.result.error])
     row.update({k: (round(v, 1) if isinstance(v, float) else int(v))

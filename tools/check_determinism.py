@@ -93,9 +93,22 @@ must additionally have rolled speculation back; and the whole thing
 routes through the AOT plan cache, so the executable path is the
 audited path.
 
+``--runtime-resume`` audits the preemption-safe campaign contract: a
+service is KILLED at an arbitrary collect boundary (checkpoint +
+halt), the object discarded, and a fresh service rebuilt with
+``CampaignService.resume`` — every ticket must come out bit-identical
+(events, fired fault events AND Kahan clocks) to the uninterrupted
+run and to ``ScenarioPlan.solo``, including pipeline depth 2 (the
+kill lands with speculation in flight, which is never persisted) and
+active fault tapes; the resumed fleet must rebuild WARM through the
+AOT plan cache (zero new compiles); resuming the same token twice is
+idempotent; and a single NaN-poisoned lane quarantines with a
+``nan_solve`` LaneFault on its own ticket while every other lane
+stays bit-identical to solo.
+
 ``--quick`` is the CI mode: the static lint plus small-N instances of
 every runtime check (drain, warm-start, batch, pipeline, shard,
-phase, fault, serve), sized to finish in seconds so the tier-1 suite
+phase, fault, serve, resume), sized to finish in seconds so the tier-1 suite
 can run it on every test pass (tests/test_determinism_lint.py, whose
 conftest forces an 8-virtual-device CPU so the mesh path is exercised
 on every run).
@@ -754,6 +767,169 @@ def check_serve_runtime(seed: int = 43, n_c: int = 32, n_v: int = 96,
     return problems
 
 
+def check_resume_runtime(seed: int = 47, n_c: int = 32, n_v: int = 96,
+                         batch: int = 3, scenarios: int = 8, k: int = 4,
+                         depths=(0, 2), stop_after: int = 3
+                         ) -> List[str]:
+    """Dynamic determinism of preemption-safe campaigns (ISSUE 12):
+
+    * kill/resume — a campaign service is KILLED at an arbitrary
+      collect boundary (``drain(stop_after=...)`` checkpoints and
+      halts; the service object is then discarded, simulating the
+      preemption) and a fresh service is rebuilt with
+      ``CampaignService.resume``: every ticket's completion events,
+      fired-fault stream and Kahan clock must be bit-identical to the
+      uninterrupted run AND to ``ScenarioPlan.solo`` — including
+      pipeline depth 2 (in-flight speculation at the kill point is
+      never persisted) and active fault tapes;
+    * warm resume — the resumed fleet must rebuild through the AOT
+      plan cache without ONE new compile (same plan key);
+    * double resume — resuming the same token twice must re-run
+      bit-identically (the token is never mutated);
+    * lane quarantine — a single poisoned lane (NaN link capacity)
+      must die with a ``nan_solve`` LaneFault on ITS ticket while
+      every other lane stays bit-identical to solo.
+
+    Returns a list of problems (empty = OK)."""
+    import tempfile
+    import numpy as np
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import build_arrays
+    from simgrid_tpu.ops import opstats
+    from simgrid_tpu.parallel.campaign import ScenarioPlan, ScenarioSpec
+    from simgrid_tpu.serving import CampaignService, PlanCache
+
+    rng = np.random.default_rng(seed)
+    arrays = build_arrays(rng, n_c, n_v, 3, np.float64)
+    E = arrays.n_elem
+    sizes = rng.choice(np.linspace(1e5, 2e6, 16), n_v)
+    specs = [ScenarioSpec(seed=s, bw_scale=1.0 + 0.1 * (s % 5),
+                          size_scale=1.0 + 0.05 * (s % 3),
+                          fault_mtbf=150.0 if s % 3 == 0 else None,
+                          fault_mttr=50.0, fault_horizon=900.0,
+                          label=f"q{s}")
+             for s in range(scenarios)]
+    plan = ScenarioPlan(arrays.e_var[:E], arrays.e_cnst[:E],
+                        arrays.e_w[:E], arrays.c_bound[:n_c], sizes,
+                        eps=1e-9, superstep=k, fault_mode="on")
+    solos = {spec.label: plan.solo(spec) for spec in specs}
+
+    def digest(tickets):
+        """The comparable outcome of one service run: per-label
+        (events, fault events, clock, error) — latency metadata and
+        ticket ordering are excluded on purpose."""
+        out = {}
+        for t in tickets:
+            r = t.result
+            out[t.spec.label] = (
+                None if r is None
+                else (r.source, [tuple(e) for e in (r.events or [])],
+                      [tuple(e) for e in (r.fault_events or [])],
+                      r.t, r.error))
+        return out
+
+    problems: List[str] = []
+    cache = PlanCache()  # memory-resident; shared across every leg
+    tmpdir = tempfile.mkdtemp(prefix="simgrid_resume_")
+    for depth in depths:
+        tag = f"resume:d{depth}"
+        # leg 1: the uninterrupted oracle run
+        svc_a = CampaignService(plan, batch=batch, plan_cache=cache,
+                                pipeline=depth)
+        svc_a.submit_many(specs, exact=True)
+        ref = digest(svc_a.drain())
+        # leg 2: kill at a collect boundary, then resume cold
+        path = os.path.join(tmpdir, f"ck_d{depth}")
+        svc_b = CampaignService(plan, batch=batch, plan_cache=cache,
+                                pipeline=depth)
+        svc_b.submit_many(specs, exact=True)
+        svc_b.drain(stop_after=stop_after, checkpoint_path=path)
+        if svc_b._fleet is None:
+            problems.append(f"{tag}: drain finished before "
+                            f"stop_after={stop_after} — the kill "
+                            f"window was never exercised")
+            continue
+        del svc_b  # the preemption: nothing survives but the token
+        misses_before = cache.misses
+        svc_c = CampaignService.resume(path, plan_cache=cache)
+        if svc_c._fleet is None:
+            problems.append(f"{tag}: resume rebuilt no resident fleet")
+            continue
+        if cache.misses != misses_before:
+            problems.append(
+                f"{tag}: resume compiled "
+                f"{cache.misses - misses_before} new executable(s) — "
+                f"the AOT plan cache was not hit warm")
+        got = digest(svc_c.drain())
+        if got != ref:
+            bad = [lbl for lbl in ref
+                   if got.get(lbl) != ref[lbl]]
+            problems.append(
+                f"{tag}: resumed run diverged from the uninterrupted "
+                f"run on {len(bad)} quer{'y' if len(bad) == 1 else 'ies'} "
+                f"({', '.join(bad[:4])})")
+        for spec in specs:
+            r = got.get(spec.label)
+            solo = solos[spec.label]
+            if r is None or r[4] is not None:
+                problems.append(f"{tag}: {spec.label} has no clean "
+                                f"resumed result")
+                continue
+            if (r[1] != [tuple(e) for e in solo.events]
+                    or r[2] != [tuple(e) for e in solo.fault_events]
+                    or r[3] != solo.t):
+                problems.append(f"{tag}: {spec.label}: resumed run "
+                                f"diverged from solo")
+        if not any(r and r[2] for r in got.values()):
+            problems.append(f"{tag}: no fault tape event ever fired "
+                            f"(tapes were not actually exercised)")
+        # leg 3: double resume from the SAME token is idempotent
+        svc_d = CampaignService.resume(path, plan_cache=cache)
+        got2 = digest(svc_d.drain())
+        if got2 != got:
+            problems.append(f"{tag}: second resume from the same "
+                            f"token diverged from the first")
+    if cache.hits == 0 or cache.fallbacks:
+        problems.append(f"resume: plan cache never took the AOT path "
+                        f"(hits={cache.hits}, "
+                        f"fallbacks={cache.fallbacks})")
+
+    # leg 4: single-lane NaN quarantine — a poisoned scenario (NaN
+    # sizes: every remaining-work entry of that lane is NaN) kills
+    # exactly its own lane, with a structured cause on the ticket
+    poison = ScenarioSpec(seed=99, size_scale=float("nan"),
+                          label="poison")
+    clean = [ScenarioSpec(seed=s, bw_scale=1.0 + 0.1 * s,
+                          label=f"clean{s}") for s in range(batch)]
+    clean_solos = {s.label: plan.solo(s) for s in clean}
+    before = opstats.snapshot()
+    svc_q = CampaignService(plan, batch=batch, plan_cache=cache)
+    tickets = svc_q.submit_many([poison] + clean, exact=True)
+    svc_q.drain()
+    d = opstats.diff(before)
+    for t in tickets:
+        if t.spec.label == "poison":
+            if t.fault is None or t.fault.cause != "nan_solve":
+                problems.append(
+                    f"resume:quarantine: poisoned lane was not "
+                    f"quarantined with cause nan_solve (fault="
+                    f"{t.fault!r}, error={t.result and t.result.error!r})")
+            continue
+        r = t.result
+        solo = clean_solos[t.spec.label]
+        if r is None or r.error is not None \
+                or r.events != solo.events or r.t != solo.t:
+            problems.append(f"resume:quarantine: clean lane "
+                            f"{t.spec.label} diverged from solo after "
+                            f"a neighbour's NaN quarantine")
+    if not d.get("lane_quarantined_nan_solve"):
+        problems.append("resume:quarantine: the nan_solve quarantine "
+                        "counter never moved (nothing was actually "
+                        "tested)")
+    return problems
+
+
 _FAT_TREE_64 = """<?xml version='1.0'?>
 <platform version="4.1">
   <zone id="world" routing="Full">
@@ -940,12 +1116,15 @@ def quick_checks() -> List[str]:
     problems += check_fault_runtime(n_c=24, n_v=64, k=4, mesh=2)
     problems += check_serve_runtime(n_c=24, n_v=64, batch=3,
                                     scenarios=7, k=4, depths=(0, 2))
+    problems += check_resume_runtime(n_c=24, n_v=64, batch=3,
+                                     scenarios=6, k=4, depths=(0, 2),
+                                     stop_after=2)
     return problems
 
 
 def main(argv: List[str]) -> int:
     if ("--runtime-shard" in argv or "--runtime-fault" in argv
-            or "--runtime-serve" in argv
+            or "--runtime-serve" in argv or "--runtime-resume" in argv
             or "--quick" in argv) and "jax" not in sys.modules:
         # the mesh checks need >= 2 devices; the forced host-platform
         # count must land before JAX initializes and only affects the
@@ -997,6 +1176,23 @@ def main(argv: List[str]) -> int:
               "bit-identical to ScenarioPlan.solo: events, fired "
               "faults and Kahan clocks)")
         argv = [a for a in argv if a != "--runtime-serve"]
+    if "--runtime-resume" in argv:
+        problems = check_resume_runtime()
+        if problems:
+            print("check_determinism: resume runtime check FAILED:")
+            for p in problems:
+                print(f"  {p}")
+            return 1
+        print("check_determinism: resume runtime OK (preemption-safe "
+              "campaigns — service killed at a collect boundary and "
+              "rebuilt from its FleetCheckpoint token, warm through "
+              "the AOT plan cache, incl. fault tapes and pipeline "
+              "depth 2; double resume idempotent; a NaN-poisoned "
+              "lane quarantines with a nan_solve LaneFault while "
+              "every other lane stays bit-identical to "
+              "ScenarioPlan.solo: events, fired faults and Kahan "
+              "clocks)")
+        argv = [a for a in argv if a != "--runtime-resume"]
     if "--quick" in argv:
         problems = quick_checks()
         if problems:
@@ -1005,8 +1201,8 @@ def main(argv: List[str]) -> int:
                 print(f"  {p}")
             return 1
         print("check_determinism: quick OK (lint + small-N drain + "
-              "batch + pipeline + shard + phase + fault + serve "
-              "runtime)")
+              "batch + pipeline + shard + phase + fault + serve + "
+              "resume runtime)")
         return 0
     if "--runtime-phase" in argv:
         problems = check_phase_runtime()
