@@ -511,6 +511,99 @@ def stage_fault(n_c: int, n_v: int, deg: int, seed: int,
     return out
 
 
+def stage_collective(seed: int, superstep: int = 16) -> dict:
+    """Collective schedule tapes (the ISSUE-13 trajectory metric):
+    host-maestro vs tape-driven allreduce at 64 / 256 / 1024 ranks.
+    The maestro drives the SAME compiled comm DAG the SMPI way — every
+    advance is >= 2 dispatches and >= 3 fetches, every activation an
+    extra scatter upload — while the tape path walks the DAG inside
+    the superstep while_loop, one dispatch per K advances and no host
+    involvement until the phase barrier.
+
+    Algorithm per rank count: ring (lr) at 64 ranks (2(R-1)·R comm
+    records — the quadratic schedule the tape must absorb), recursive
+    doubling at 256 and 1024 (R·log2 R records; lr at 1k would be
+    ~2.1M flow slots, beyond a sensible maestro run).  Every row
+    checks the two event streams, activation streams and Kahan clocks
+    are bit-identical — a fast row with different events measured
+    nothing — and reports dispatches per collective step plus uploaded
+    bytes for both drivers.
+
+    CPU-measured by design: the contract is the dispatch/upload
+    structure, which is platform-independent; tools own the
+    on-hardware wall-clock story (ROADMAP sweep list carries the TPU
+    row)."""
+    _force_cpu()
+    import jax  # noqa: F401  (select backend before importing ops)
+    from simgrid_tpu.collectives import CollectiveSpec, HostMaestro
+    from simgrid_tpu.ops import opstats
+
+    cases = [CollectiveSpec("allreduce", "lr", 64, "nic",
+                            1 << 17, bw=1e9),
+             CollectiveSpec("allreduce", "rdb", 256, "nic",
+                            1 << 20, bw=1e9),
+             CollectiveSpec("allreduce", "rdb", 1024, "nic",
+                            1 << 20, bw=1e9)]
+    rows = []
+    for cs in cases:
+        dc = cs.build()
+        legs = {}
+        for label in ("tape", "maestro"):
+            before = opstats.snapshot()
+            t0 = time.perf_counter()
+            if label == "tape":
+                drv = dc.make_sim(superstep=superstep)
+                drv.run()
+                dispatches = drv.supersteps
+                events = (drv.events, drv.collective_events)
+                clk = tuple(float(x) for x in np.asarray(drv._coll_clk))
+            else:
+                drv = HostMaestro(dc)
+                drv.run()
+                dispatches = drv.dispatches
+                events = (drv.events, drv.collective_events)
+                clk = drv.clock
+            wall = time.perf_counter() - t0
+            st = opstats.diff(before)
+            legs[label] = {
+                "dispatches": int(st.get("dispatches", dispatches)),
+                "upload_bytes": int(st.get("uploaded_bytes_full", 0)
+                                    + st.get("uploaded_bytes_delta", 0)),
+                "wall_ms": round(wall * 1e3, 1),
+                "events": events, "clock": clk}
+        ok = (legs["tape"]["events"] == legs["maestro"]["events"]
+              and legs["tape"]["clock"] == legs["maestro"]["clock"])
+        row = {"bench": "lmm_collective", "op": cs.op, "algo": cs.algo,
+               "ranks": cs.ranks, "topo": cs.topo,
+               "payload": cs.payload, "superstep": superstep,
+               "n_v": dc.n_v, "n_c": dc.n_c, "n_edges": dc.n_edges,
+               "events_bit_identical": ok,
+               "activations": len(legs["tape"]["events"][1])}
+        for label in ("tape", "maestro"):
+            for k in ("dispatches", "upload_bytes", "wall_ms"):
+                row[f"{label}_{k}"] = legs[label][k]
+            # one collective == one step: per-step == per-row totals
+            row[f"{label}_dispatches_per_step"] = legs[label][
+                "dispatches"]
+        row["dispatch_ratio"] = round(
+            row["maestro_dispatches"]
+            / max(row["tape_dispatches"], 1), 1)
+        rows.append(schema_row("collective", row,
+                               mode=f"{cs.algo}-r{cs.ranks}",
+                               platform="cpu"))
+        log(f"[stage collective] {cs.algo} r{cs.ranks}: "
+            f"{dc.n_v} comms, tape {row['tape_dispatches']} vs "
+            f"maestro {row['maestro_dispatches']} dispatches "
+            f"({row['dispatch_ratio']}x), bit_identical={ok}")
+    path = append_rows("lmm_collective.jsonl", rows)
+    log(f"[stage collective] rows appended to {path}")
+    return {"rows": rows,
+            "events_bit_identical": all(r["events_bit_identical"]
+                                        for r in rows),
+            "min_dispatch_ratio": min(r["dispatch_ratio"]
+                                      for r in rows)}
+
+
 def stage_shard(n_c: int, n_v: int, deg: int, seed: int,
                 per_shard: int = 16, superstep: int = 8,
                 max_mesh: int = 4) -> dict:
@@ -1222,6 +1315,8 @@ STAGES = {
     "shard": lambda args: stage_shard(args.n_c, args.n_v, args.deg,
                                       args.seed, args.per_shard,
                                       args.superstep, args.mesh),
+    "collective": lambda args: stage_collective(args.seed,
+                                                args.superstep),
     "fault": lambda args: stage_fault(args.n_c, args.n_v, args.deg,
                                       args.seed, args.replicas,
                                       args.superstep),
@@ -1465,6 +1560,17 @@ def main() -> None:
                       superstep=8)
     if fault:
         detail["lmm_fault"] = fault
+
+    # --- collective schedule tapes (simgrid_tpu/collectives) -----------
+    # host-maestro vs tape-driven allreduce at 64/256/1k ranks:
+    # dispatches per collective step, upload bytes, event streams
+    # bit-identical; rows land in bench_results/lmm_collective.jsonl
+    collective = run_stage("collective", timeout=3600, errors=errors,
+                           seed=42, superstep=16)
+    if collective:
+        detail["lmm_collective"] = collective
+        detail["collective_dispatch_ratio"] = \
+            collective.get("min_dispatch_ratio")
 
     # --- always-on campaign service (simgrid_tpu/serving) --------------
     # cold start vs warm restart over a shared disk plan cache +

@@ -164,6 +164,29 @@ def _plan_inputs(model, dtype, allow_latency: bool = False):
     return slot_action, view, snap, sizes, rem, pen, lat_slots
 
 
+def classify_phase(sim) -> str:
+    """Classify what kind of phase a drain executor is walking, from
+    its armed device tapes: ``collective-tape`` (a comm-DAG schedule
+    tape drives activations on device — optionally composed with a
+    fault tape as ``collective-tape+faults``), ``fault-tape`` (link
+    events only) or ``pure-drain``.  Bumps the matching
+    ``phase_<kind>`` opstats counter so the phase mix shows up in
+    ``tools/e2e_drain.py --phase-stats`` and on campaign rows.
+    Accepts any executor with the DrainSim flag surface (DrainSim,
+    BatchDrainSim, a fast-path plan)."""
+    has_coll = bool(getattr(sim, "has_coll", False))
+    has_tape = bool(getattr(sim, "has_tape", False))
+    if has_coll:
+        kind = ("collective-tape+faults" if has_tape
+                else "collective-tape")
+    elif has_tape:
+        kind = "fault-tape"
+    else:
+        kind = "pure-drain"
+    opstats.bump("phase_" + kind.replace("-", "_").replace("+", "_"))
+    return kind
+
+
 def capture_scenario(model):
     """Snapshot the model's CURRENT pure-drain phase as the shared base
     scenario of a batched campaign (parallel.campaign.Campaign): the
@@ -196,6 +219,7 @@ class DrainFastPath:
     def __init__(self, model):
         self.model = model
         self.sim = None                     # active DrainSim, or None
+        self.phase_kind = "none"            # classify_phase at build
         self.slot_action: Dict[int, object] = {}
         self.lat_actions: Dict[int, object] = {}   # latency-phase lanes
         self.live_slots: set = set()        # slots with device pen > 0
@@ -305,6 +329,7 @@ class DrainFastPath:
             # view's own host-side compaction covers shrinkage
             repack_min=1 << 62)
         self.sim = sim
+        self.phase_kind = classify_phase(sim)
         self.slot_action = slot_action
         self.lat_actions = {s: slot_action[s] for s in lat_slots}
         self.live_slots = {int(s) for s in np.flatnonzero(pen > 0)}

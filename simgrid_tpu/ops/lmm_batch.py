@@ -450,6 +450,17 @@ def _admit_lane_tape(tape_t, tape_slot, tape_val, tpos,
 
 
 @jax.jit
+def _admit_lane_coll(pred, ready, clk, row_p, row_r, b):
+    """Reset one admitted lane's collective-DAG walk state to the
+    schedule's birth state (fresh predecessor counts and activation
+    dates, Kahan clock pair back to zero) — the lane replays the whole
+    shared schedule from its own t=0."""
+    return (pred.at[b].set(row_p),
+            ready.at[b].set(row_r),
+            clk.at[b].set(jnp.zeros(2, jnp.float64)))
+
+
+@jax.jit
 def _admit_lane_ew(base_ew2, ew_fleet, ei, ewv, b):
     """Re-materialize one lane's element-weight row from the shared
     base table + the admitted spec's indexed payload (scatter-SET, pad
@@ -466,13 +477,16 @@ def _admit_lane_ew(base_ew2, ew_fleet, ei, ewv, b):
 @functools.partial(jax.jit,
                    static_argnames=("eps", "n_c", "n_v", "k_max",
                                     "group", "has_bounds", "batch_w",
-                                    "has_tape"))
+                                    "has_tape", "has_coll"))
 def _batch_superstep(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
                      thresh, ids, alive, k, round_budget, zero_bits,
-                     tape_t, tape_slot, tape_val, tape_pos, t0,
+                     tape_t, tape_slot, tape_val, tape_pos,
+                     coll_pred, coll_ready, coll_clk,
+                     edge_src, edge_dst, exec_cost, t0,
                      eps: float, n_c: int, n_v: int, k_max: int,
                      group: int, has_bounds: bool = False,
-                     batch_w: bool = False, has_tape: bool = False):
+                     batch_w: bool = False, has_tape: bool = False,
+                     has_coll: bool = False):
     """One fleet superstep: the solo superstep program vmapped over the
     replica axis.  A dead lane (alive=False) gets k=0, so its outer
     while_loop cond is false on entry and the vmap batching rule
@@ -482,22 +496,32 @@ def _batch_superstep(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
     With ``has_tape`` each lane additionally carries its own fault
     event tape ([B, T] dates/slots/values, inf-padded), tape cursor and
     f64 base clock — sharded shard-local like every other [B, ·]
-    payload, so a lane's fires never cross device boundaries."""
+    payload, so a lane's fires never cross device boundaries.
+
+    With ``has_coll`` each lane carries its own collective-DAG state
+    (predecessor counts [B, n_v], pending-activation dates [B, n_v],
+    the Kahan clock pair [B, 2]) while the schedule STRUCTURE
+    (edge_src / edge_dst / exec_cost) is shared across the fleet like
+    the platform — rank-count/algorithm sweeps batch scenarios that
+    differ only in per-lane overrides."""
     k = jnp.asarray(k, jnp.int32)
 
     def lane(cb, pen_l, rem_l, th_l, alive_l, tt_l, ts_l, tv_l, tp_l,
-             t0_l, ew_l):
+             cp_l, cr_l, ck_l, t0_l, ew_l):
         k_l = jnp.where(alive_l, k, jnp.int32(0))
         return _superstep_program(
             e_var, e_cnst, ew_l, cb, v_bound, pen_l, rem_l, th_l, ids,
             k_l, jnp.asarray(round_budget, jnp.int32), jnp.int32(0),
-            zero_bits, tt_l, ts_l, tv_l, tp_l, t0_l,
+            zero_bits, tt_l, ts_l, tv_l, tp_l,
+            cp_l, cr_l, ck_l, edge_src, edge_dst, exec_cost, t0_l,
             eps=eps, n_c=n_c, n_v=n_v, k_max=k_max,
-            group=group, has_bounds=has_bounds, has_tape=has_tape)
+            group=group, has_bounds=has_bounds, has_tape=has_tape,
+            has_coll=has_coll)
 
-    return jax.vmap(lane, in_axes=(0,) * 10 + (0 if batch_w else None,))(
+    return jax.vmap(lane,
+                    in_axes=(0,) * 13 + (0 if batch_w else None,))(
         c_bound, pen, rem, thresh, alive, tape_t, tape_slot, tape_val,
-        tape_pos, t0, e_w)
+        tape_pos, coll_pred, coll_ready, coll_clk, t0, e_w)
 
 
 def _batch_fused_lane(e_var, e_cnst, ew_l, cb, v_bound, pen_l, rem_l,
@@ -698,12 +722,14 @@ class FleetToken:
 
     __slots__ = ("pen_in", "rem_in", "pen_out", "rem_out", "packed",
                  "k", "alive", "speculative",
-                 "cb_in", "cb_out", "tpos_out", "t0_in", "t0_out")
+                 "cb_in", "cb_out", "tpos_out", "t0_in", "t0_out",
+                 "pred_out", "ready_out", "clk_out")
 
     def __init__(self, pen_in, rem_in, pen_out, rem_out, packed,
                  k: int, alive, speculative: bool,
                  cb_in=None, cb_out=None, tpos_out=None,
-                 t0_in=None, t0_out=None):
+                 t0_in=None, t0_out=None,
+                 pred_out=None, ready_out=None, clk_out=None):
         self.pen_in = pen_in
         self.rem_in = rem_in
         self.pen_out = pen_out
@@ -720,12 +746,17 @@ class FleetToken:
         self.tpos_out = tpos_out
         self.t0_in = t0_in
         self.t0_out = t0_out
+        # collective-tape double buffers (see SuperstepToken)
+        self.pred_out = pred_out
+        self.ready_out = ready_out
+        self.clk_out = clk_out
 
 
 class ReplicaState:
     """Host-side record of one replica in a fleet."""
 
-    __slots__ = ("index", "events", "fault_events", "t", "advances",
+    __slots__ = ("index", "events", "fault_events",
+                 "collective_events", "t", "advances",
                  "alive", "error", "fault")
 
     def __init__(self, index: int):
@@ -733,6 +764,8 @@ class ReplicaState:
         self.events: List[Tuple[float, int]] = []
         #: (time, constraint slot) per fired tape entry, fire order
         self.fault_events: List[Tuple[float, int]] = []
+        #: (time, flow id) per fired collective activation, fire order
+        self.collective_events: List[Tuple[float, int]] = []
         self.t = 0.0              # f64 master clock (host-accumulated)
         self.advances = 0
         self.alive = True
@@ -789,7 +822,8 @@ class BatchDrainSim:
                  device=None, v_bound=None, penalty=None, remains=None,
                  pipeline: int = 0, mesh=None, tapes=None,
                  plan=None, tape_slots: int = 0, start_dead=(),
-                 batch_w: Optional[bool] = None, watchdog=None):
+                 batch_w: Optional[bool] = None, watchdog=None,
+                 collective=None):
         if not overrides:
             raise ValueError("BatchDrainSim needs at least one replica")
         if done_mode not in ("rel", "abs"):
@@ -997,6 +1031,61 @@ class BatchDrainSim:
         self._tpos = self._put_batched(
             np.zeros(self.B_padded, np.int32))
 
+        # collective schedule tape: ONE compiled comm DAG (pred, ready,
+        # edge_src, edge_dst, exec_cost — see DrainSim's collective=)
+        # shared across the fleet.  The schedule STRUCTURE (edges,
+        # exec costs) is platform-like and replicated; the walk STATE
+        # (predecessor counts, pending-activation dates, the carried
+        # Kahan clock pair) is per-lane, so lanes differing only in
+        # overrides sweep the same collective independently.
+        self.has_coll = False
+        if collective is not None:
+            cp, cr, ces, ced, cec = collective
+            cp = np.asarray(cp, np.int32)
+            cr = np.asarray(cr, np.float64)
+            ces = np.asarray(ces, np.int32)
+            ced = np.asarray(ced, np.int32)
+            cec = np.asarray(cec, np.float64)
+            if not (len(cp) == len(cr) == len(cec) == self.n_v):
+                raise ValueError("collective arrays must be per-flow "
+                                 f"(n_v={self.n_v})")
+            if len(ces) != len(ced):
+                raise ValueError("collective edge arrays must have "
+                                 "equal length")
+            if self.dtype != np.float64:
+                raise ValueError("collective= needs dtype=float64 "
+                                 "(see DrainSim)")
+            if any(ov.dead_flows for ov in self.overrides):
+                raise ValueError("collective fleets cannot kill DAG "
+                                 "flows via dead_flows overrides")
+            self.has_coll = True
+            self._coll_base = (cp, cr)
+            self._coll_edges = tuple(self._put_shared(a)
+                                     for a in (ces, ced, cec))
+            self._coll_pred = self._put_batched(
+                np.broadcast_to(cp, (self.B_padded, self.n_v)).copy())
+            self._coll_ready = self._put_batched(
+                np.broadcast_to(cr, (self.B_padded, self.n_v)).copy())
+            self._coll_clk = self._put_batched(
+                np.zeros((self.B_padded, 2), np.float64))
+            opstats.bump("collective_tape_slots", self.n_v * self.B)
+            opstats.bump("uploaded_bytes_delta",
+                         cp.nbytes * self.B_padded
+                         + cr.nbytes * self.B_padded
+                         + ces.nbytes + ced.nbytes + cec.nbytes
+                         + 16 * self.B_padded)
+        else:
+            self._coll_edges = (
+                self._put_shared(np.zeros(1, np.int32)),
+                self._put_shared(np.zeros(1, np.int32)),
+                self._put_shared(np.zeros(1, np.float64)))
+            self._coll_pred = self._put_batched(
+                np.zeros((self.B_padded, 1), np.int32))
+            self._coll_ready = self._put_batched(
+                np.full((self.B_padded, 1), np.inf))
+            self._coll_clk = self._put_batched(
+                np.zeros((self.B_padded, 2), np.float64))
+
         self.replicas = [ReplicaState(b) for b in range(self.B)]
         self._alive = np.zeros(self.B_padded, bool)
         self._alive[:self.B] = True
@@ -1100,7 +1189,9 @@ class BatchDrainSim:
     def _superstep_issue_all(self, k: Optional[int] = None, pen=None,
                              rem=None, speculative: bool = False,
                              alive=None, cb=None, tpos=None, t0=None,
-                             round_budget: int = 0) -> "FleetToken":
+                             round_budget: int = 0,
+                             pred=None, ready=None,
+                             clk=None) -> "FleetToken":
         """Dispatch ONE fleet superstep without touching the committed
         state: chains from `(pen, rem)` (default: committed) under the
         CURRENT alive mask (or an explicit `alive` restriction — the
@@ -1128,16 +1219,22 @@ class BatchDrainSim:
             t0_in = self._put_batched(t0_in)
         else:
             t0_in = t0
-        pen_out, rem_out, cb_out, tpos_out, packed = self._call_plan(
+        pred_in = self._coll_pred if pred is None else pred
+        ready_in = self._coll_ready if ready is None else ready
+        clk_in = self._coll_clk if clk is None else clk
+        (pen_out, rem_out, cb_out, tpos_out, pred_out, ready_out,
+         clk_out, packed) = self._call_plan(
             "superstep", _batch_superstep,
             (*self._dev, cb_in, self._vb, pen_in, rem_in,
              self._thresh, self._ids_dev,
              self._put_mask(alive), np.int32(k),
              np.int32(budget), _ZERO_BITS,
-             *self._tape, tpos_in, t0_in),
+             *self._tape, tpos_in,
+             pred_in, ready_in, clk_in, *self._coll_edges, t0_in),
             dict(eps=self.eps, n_c=self.n_c, n_v=self.n_v, k_max=k_max,
                  group=group, has_bounds=self.has_bounds,
-                 batch_w=self.batch_w, has_tape=self.has_tape))
+                 batch_w=self.batch_w, has_tape=self.has_tape,
+                 has_coll=self.has_coll))
         t0_out = None
         if self.has_tape:
             # derive the post-dispatch base clocks DEVICE-side with the
@@ -1154,7 +1251,9 @@ class BatchDrainSim:
         return FleetToken(pen_in, rem_in, pen_out, rem_out, packed,
                           k, alive, speculative,
                           cb_in=cb_in, cb_out=cb_out, tpos_out=tpos_out,
-                          t0_in=t0_in, t0_out=t0_out)
+                          t0_in=t0_in, t0_out=t0_out,
+                          pred_out=pred_out, ready_out=ready_out,
+                          clk_out=clk_out)
 
     def _discard_token(self, tok: "FleetToken") -> None:
         """Drop an un-collected speculative fleet superstep (the alive
@@ -1213,14 +1312,20 @@ class BatchDrainSim:
         if self.has_tape:
             self._cb = tok.cb_out
             self._tpos = tok.tpos_out
+        if self.has_coll:
+            self._coll_pred = tok.pred_out
+            self._coll_ready = tok.ready_out
+            self._coll_clk = tok.clk_out
         k_max = self.superstep_k
         p = self._fetch(tok.packed)
         n_v = self.n_v
-        ring_n = n_v + k_max if self.has_tape else n_v
+        ring_n = (n_v + (k_max if self.has_tape else 0)
+                  + (n_v if self.has_coll else 0))
         o = 7
         stuck: List[int] = []
         deaths = 0
         fired = 0
+        coll_fired = 0
         for b in range(self.B):
             if not tok.alive[b]:
                 continue
@@ -1257,16 +1362,25 @@ class BatchDrainSim:
                 deaths += 1
                 continue
             rep.advances += adv
-            t_base = rep.t
-            if self.has_tape:
-                # demux: negative ids are tape fires (slot -(1+id)) —
-                # fault stream, not completion stream (see DrainSim)
+            # collective lanes carry ABSOLUTE ring dates/clocks (the
+            # Kahan pair chains on device across dispatches)
+            t_base = 0.0 if self.has_coll else rep.t
+            if self.has_tape or self.has_coll:
+                # demux: negative ids are tagged — fault fires
+                # (idx < n_c, fault stream) or collective activations
+                # (idx >= n_c, activation stream) — see DrainSim
                 for j in range(n_ev):
                     fid = int(ring_id[j])
                     tj = t_base + float(ring_t[j])
                     if fid < 0:
-                        rep.fault_events.append((tj, -fid - 1))
-                        fired += 1
+                        idx = -fid - 1
+                        if idx >= self.n_c:
+                            rep.collective_events.append(
+                                (tj, idx - self.n_c))
+                            coll_fired += 1
+                        else:
+                            rep.fault_events.append((tj, idx))
+                            fired += 1
                     else:
                         rep.events.append((tj, fid))
             else:
@@ -1274,12 +1388,24 @@ class BatchDrainSim:
                     rep.events.append((t_base + float(ring_t[j]),
                                        int(ring_id[j])))
             rep.t = t_base + t_sum
+            coll_pending = (self.has_coll
+                            and len(rep.events) < self.n_v)
             if flag == _FLAG_STALLED:
                 self._quarantine(b, *self._stall_cause(b, n_live))
                 deaths += 1
-            elif n_live == 0:
+            elif n_live == 0 and not coll_pending:
                 rep.alive = False
                 self._alive[b] = False
+                deaths += 1
+            elif n_live == 0 and coll_pending and adv == 0:
+                # no live flow, no progress, schedule still owes
+                # completions: a cyclic/truncated DAG would spin the
+                # fleet forever — kill exactly this lane
+                self._quarantine(
+                    b, "collective_deadlock",
+                    f"collective schedule deadlocked: "
+                    f"{len(rep.events)}/{self.n_v} flows completed "
+                    f"and nothing is pending")
                 deaths += 1
             elif flag == _FLAG_BUDGET and adv == 0:
                 if rescue:
@@ -1291,6 +1417,8 @@ class BatchDrainSim:
         self._last_fired = fired > 0
         if fired:
             opstats.bump("fault_tape_events", fired)
+        if coll_fired:
+            opstats.bump("collective_tape_fires", coll_fired)
         if self.B_padded != self.B:
             # ragged-fleet guard: padded lanes are dead from birth
             # (k=0, state frozen), so any event they log would be a
@@ -1304,12 +1432,13 @@ class BatchDrainSim:
                     f"event(s) — the frozen-lane invariant is broken")
         if stuck:
             # the round budget expired inside a replica's FIRST solve:
-            # finish exactly one advance for those lanes.  Tape-armed
-            # fleets must stay on the superstep path (the fused rescue
-            # is tape-blind and would step over events); otherwise the
-            # chunked fused program (converges across dispatches), the
-            # batched mirror of the solo run() rescue.
-            if self.has_tape:
+            # finish exactly one advance for those lanes.  Tape- or
+            # collective-armed fleets must stay on the superstep path
+            # (the fused rescue is tape-blind and would step over
+            # events); otherwise the chunked fused program (converges
+            # across dispatches), the batched mirror of the solo run()
+            # rescue.
+            if self.has_tape or self.has_coll:
                 self._rescue_superstep(stuck)
             else:
                 self._rescue_fused(stuck)
@@ -1438,6 +1567,26 @@ class BatchDrainSim:
             self._tpos = self._pin(tpos)
             opstats.bump("uploaded_bytes_delta",
                          row_t.nbytes + row_s.nbytes + row_vd.nbytes)
+            opstats.bump("dispatches")
+            opstats.bump("batch_dispatches")
+        if self.has_coll:
+            # the admitted lane replays the fleet's shared schedule
+            # from its own t=0: fresh DAG walk state, zeroed clock
+            if ov.dead_flows:
+                raise AdmissionError(
+                    "collective fleets cannot kill DAG flows via "
+                    "dead_flows overrides")
+            cp, cr = self._coll_base
+            pred, ready, clk = self._call_plan(
+                "admit_coll", _admit_lane_coll,
+                (self._coll_pred, self._coll_ready, self._coll_clk,
+                 cp, cr, np.int32(b)), {})
+            self._coll_pred = self._pin(pred)
+            self._coll_ready = self._pin(ready)
+            self._coll_clk = self._pin(clk)
+            opstats.bump("collective_tape_slots", self.n_v)
+            opstats.bump("uploaded_bytes_delta",
+                         cp.nbytes + cr.nbytes + 16)
             opstats.bump("dispatches")
             opstats.bump("batch_dispatches")
         if self.batch_w:
@@ -1573,11 +1722,17 @@ class BatchDrainSim:
                         cb, tpos, t0 = (
                             (prev.cb_out, prev.tpos_out, prev.t0_out)
                             if self.has_tape else (None, None, None))
+                        pred, ready, clk = (
+                            (prev.pred_out, prev.ready_out,
+                             prev.clk_out)
+                            if self.has_coll else (None, None, None))
                     else:
                         pen = rem = cb = tpos = t0 = None
+                        pred = ready = clk = None
                     inflight.append(self._superstep_issue_all(
                         pen=pen, rem=rem, speculative=spec,
-                        cb=cb, tpos=tpos, t0=t0))
+                        cb=cb, tpos=tpos, t0=t0,
+                        pred=pred, ready=ready, clk=clk))
                 tok = inflight.popleft()
                 _n_alive, clean = self._superstep_collect_all(tok)
                 left -= 1
@@ -1593,6 +1748,9 @@ class BatchDrainSim:
                     # window — discard and replay from committed state
                     if self.has_tape and self._last_fired and inflight:
                         opstats.bump("fault_replays", len(inflight))
+                    if self.has_coll and inflight:
+                        opstats.bump("collective_replays",
+                                     len(inflight))
                     while inflight:
                         self._discard_token(inflight.popleft())
         finally:
@@ -1663,6 +1821,18 @@ class BatchDrainSim:
             arrays["tape_t"] = np.asarray(tt)
             arrays["tape_s"] = np.asarray(ts)
             arrays["tape_v"] = np.asarray(tv)
+        if self.has_coll:
+            arrays["coll_pred"] = np.asarray(self._coll_pred)
+            arrays["coll_ready"] = np.asarray(self._coll_ready)
+            arrays["coll_clk"] = np.asarray(self._coll_clk)
+            arrays["cev_counts"] = np.array(
+                [len(r.collective_events) for r in reps], np.int64)
+            arrays["cev_t"] = np.array(
+                [t for r in reps for t, _ in r.collective_events],
+                np.float64)
+            arrays["cev_id"] = np.array(
+                [i for r in reps for _, i in r.collective_events],
+                np.int64)
         if self.batch_w:
             arrays["ew"] = np.asarray(self._dev[2])
         return {
@@ -1733,6 +1903,28 @@ class BatchDrainSim:
             self._tape = (self._put_batched(tt),
                           self._put_batched(ts),
                           self._put_batched(tv))
+        cev_counts = None
+        if "coll_pred" in arrays:
+            if not self.has_coll:
+                raise ValueError(
+                    "fleet snapshot carries a collective schedule "
+                    "but this fleet was built without collective=")
+            cp = _chk("coll_pred", np.int32, (Bp, self.n_v))
+            crd = _chk("coll_ready", np.float64, (Bp, self.n_v))
+            ck = _chk("coll_clk", np.float64, (Bp, 2))
+            cev_counts = _chk("cev_counts", np.int64, (B,))
+            cev_t = _chk("cev_t", np.float64,
+                         (int(cev_counts.sum()),))
+            cev_id = _chk("cev_id", np.int64,
+                          (int(cev_counts.sum()),))
+            self._coll_pred = self._put_batched(cp)
+            self._coll_ready = self._put_batched(crd)
+            self._coll_clk = self._put_batched(ck)
+        elif self.has_coll:
+            raise ValueError(
+                "this fleet carries a collective schedule but the "
+                "snapshot has no collective arrays — it is from a "
+                "different plan")
         if "ew" in arrays:
             if not self.batch_w:
                 raise ValueError(
@@ -1748,7 +1940,7 @@ class BatchDrainSim:
         self._tpos = self._put_batched(tpos)
         errors = st.get("errors") or [None] * B
         faults = st.get("faults") or [None] * B
-        eo = fo = 0
+        eo = fo = co = 0
         for b in range(B):
             rep = ReplicaState(b)
             n_e, n_f = int(ev_counts[b]), int(fev_counts[b])
@@ -1759,6 +1951,12 @@ class BatchDrainSim:
                                 for j in range(n_f)]
             eo += n_e
             fo += n_f
+            if cev_counts is not None:
+                n_cv = int(cev_counts[b])
+                rep.collective_events = [
+                    (float(cev_t[co + j]), int(cev_id[co + j]))
+                    for j in range(n_cv)]
+                co += n_cv
             rep.t = float(clocks[b])
             rep.advances = int(advances[b])
             rep.alive = bool(alive[b])

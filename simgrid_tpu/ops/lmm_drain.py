@@ -246,10 +246,12 @@ _FLAG_BUDGET = 2      # solve hit the round budget mid-superstep
 
 def _superstep_program(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
                        thresh, ids, k, round_budget, stop_live, zero_bits,
-                       tape_t, tape_slot, tape_val, tape_pos, t0,
+                       tape_t, tape_slot, tape_val, tape_pos,
+                       coll_pred, coll_ready, coll_clk,
+                       edge_src, edge_dst, exec_cost, t0,
                        eps: float, n_c: int, n_v: int, k_max: int,
                        group: int, has_bounds: bool = False,
-                       has_tape: bool = False):
+                       has_tape: bool = False, has_coll: bool = False):
     """Up to `k` (<= k_max) full advances in ONE dispatch: an outer
     lax.while_loop of (fixpoint to convergence -> dt -> retire), with
     completions logged into a device ring buffer and the clock carried
@@ -284,6 +286,28 @@ def _superstep_program(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
     a stall), mirroring how a Profile event re-arms an idle engine.
     With ``has_tape=False`` the tape arguments are ignored and the
     loop state/HLO are exactly the legacy 12-tuple.
+
+    ``has_coll`` arms the COLLECTIVE SCHEDULE TAPE: the flow set is a
+    compiled communication DAG (collectives.tape) whose dormant flows
+    (penalty 0, full remains) activate when their predecessors
+    complete.  ``coll_pred`` carries the per-flow outstanding
+    predecessor counts, ``(edge_src, edge_dst)`` the static successor
+    edge list (padded rows scatter to the dropped slot ``n_v``),
+    ``exec_cost`` the per-flow delay between the last predecessor's
+    completion and the flow's activation (the compute leg of a
+    compute/comm phase), and ``coll_ready`` the f64 pending-activation
+    dates (+inf = not scheduled).  Each advance takes the earliest of
+    {planned completion, fault date, activation date}; an activation
+    scatters penalty 1.0 into the fired flows, consumes their ready
+    slots, and logs tagged ring entries ``id = -(1 + n_c + flow_id)``
+    (disjoint from fault fires, whose slots are < n_c) — no host
+    involvement until the schedule is exhausted.  Because collective
+    runs must be bit-identical at EVERY dispatch grouping (the
+    host-maestro oracle replays the same recurrence one advance per
+    dispatch), the Kahan clock pair is carried ACROSS dispatches via
+    ``coll_clk = (t, comp)`` and ring times are ABSOLUTE f64 dates;
+    the dtype must be float64.  The ring grows by another n_v
+    activation slots.
     """
     dtype = e_w.dtype
     fat = jnp.zeros(n_c, bool)
@@ -292,8 +316,10 @@ def _superstep_program(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
     round_budget = jnp.asarray(round_budget, jnp.int32)
     stop_live = jnp.asarray(stop_live, jnp.int32)
     # completions scatter to [0, n_ev); the out-of-range sentinel and
-    # the ring capacity grow by k_max when faults may interleave
-    ring_n = n_v + k_max if has_tape else n_v
+    # the ring capacity grow by k_max when faults may interleave and
+    # by n_v when collective activations may
+    ring_n = (n_v + (k_max if has_tape else 0)
+              + (n_v if has_coll else 0))
     if has_tape:
         T = tape_t.shape[0]
         t0 = jnp.asarray(t0, jnp.float64)
@@ -302,17 +328,25 @@ def _superstep_program(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
         pen_c = st[0]
         flag, adv, rounds = st[11], st[9], st[10]
         n_live = jnp.count_nonzero(pen_c > 0).astype(jnp.int32)
+        alive = n_live > stop_live
+        if has_coll:
+            # a dormant flow with a pending activation keeps the loop
+            # walking even when nothing currently holds bandwidth
+            alive = alive | jnp.any(jnp.isfinite(st[-1]))
         return ((flag == _FLAG_OK) & (adv < k) & (rounds < round_budget)
-                & (n_live > stop_live))
+                & alive)
 
     def body(st):
+        idx = 12
+        (pen_c, rem_c, t_sum, t_comp, ring_t, ring_id, adv_dt,
+         adv_nev, n_ev, adv, rounds, flag) = st[:12]
         if has_tape:
-            (pen_c, rem_c, t_sum, t_comp, ring_t, ring_id, adv_dt,
-             adv_nev, n_ev, adv, rounds, flag, cb_c, tpos) = st
+            cb_c, tpos = st[idx], st[idx + 1]
+            idx += 2
         else:
-            (pen_c, rem_c, t_sum, t_comp, ring_t, ring_id, adv_dt,
-             adv_nev, n_ev, adv, rounds, flag) = st
             cb_c = c_bound
+        if has_coll:
+            pred_c, ready_c = st[idx], st[idx + 1]
         out = fixpoint(e_var, e_cnst, e_w, cb_c, fat, pen_c, v_bound,
                        eps_c, n_c, n_v, parallel_rounds=True,
                        carry=None, max_rounds=round_budget - rounds,
@@ -321,25 +355,37 @@ def _superstep_program(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
         carry2 = out[4]
         r = out[3].astype(jnp.int32)
         converged = jnp.count_nonzero(carry2[4]) == 0
-        if has_tape:
-            # planned dt (the _advance_math front half), then the tape
-            # peek: fire iff the next event lands inside this advance
-            # (ties go to the event, and a pending event rescues an
-            # infinite dt).  Clock math in f64: t0 and the tape dates
-            # are f64, so event placement is exact even on f32 drains.
+        if has_tape or has_coll:
+            # planned dt (the _advance_math front half), then the event
+            # peek: fire iff the next fault/activation date lands inside
+            # this advance (ties go to the event, and a pending event
+            # rescues an infinite dt).  Clock math in f64: the event
+            # dates are f64, so placement is exact even on f32 drains.
             live = pen_c > 0
             rate = jnp.where(live, carry2[0], 0.0)
             flowing = live & (rate > 0)
             dt_plan = jnp.min(jnp.where(
                 flowing, rem_c / jnp.where(flowing, rate, 1.0), jnp.inf))
-            ti = jnp.minimum(tpos, T - 1)
-            next_t = jnp.where(tpos < T, tape_t[ti], jnp.inf)
-            now = t0 + t_sum.astype(jnp.float64)
+            if has_tape:
+                ti = jnp.minimum(tpos, T - 1)
+                next_ft = jnp.where(tpos < T, tape_t[ti], jnp.inf)
+            else:
+                next_ft = jnp.asarray(jnp.inf, jnp.float64)
+            if has_coll:
+                # collective clocks are absolute (carried across
+                # dispatches); t0 is already folded into t_sum
+                next_at = jnp.min(ready_c)
+                now = t_sum.astype(jnp.float64)
+            else:
+                next_at = jnp.asarray(jnp.inf, jnp.float64)
+                now = t0 + t_sum.astype(jnp.float64)
+            next_t = jnp.minimum(next_ft, next_at)
             fire = jnp.isfinite(next_t) & (
                 next_t <= now + dt_plan.astype(jnp.float64))
             dt = jnp.where(
                 fire, jnp.maximum(next_t - now, 0.0).astype(dtype),
                 dt_plan)
+            f_fire = fire & (next_ft <= next_at)
             prod = _rounded_product(rate, dt, zero_bits)
             rem2 = jnp.where(flowing, rem_c - prod, rem_c)
             done = flowing & (rem2 < thresh)
@@ -375,15 +421,42 @@ def _superstep_program(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
             # the event onward): tagged ring entry, bound scatter, and
             # cursor bump — all dropped when not firing
             slot = tape_slot[ti]
-            fpos = jnp.where(fire, n_ev + n_done, ring_n)
+            fpos = jnp.where(f_fire, n_ev + n_done, ring_n)
             ring_t2 = ring_t2.at[fpos].set(t_new, mode="drop")
             ring_id2 = ring_id2.at[fpos].set(-(1 + slot), mode="drop")
-            n_new = n_ev + n_done + fire.astype(jnp.int32)
-            cb2 = cb_c.at[jnp.where(fire, slot, n_c)].set(
+            n_new = n_ev + n_done + f_fire.astype(jnp.int32)
+            cb2 = cb_c.at[jnp.where(f_fire, slot, n_c)].set(
                 tape_val[ti], mode="drop")
-            tpos2 = tpos + (ok & fire).astype(jnp.int32)
+            tpos2 = tpos + (ok & f_fire).astype(jnp.int32)
         else:
             n_new = n_ev + n_done
+
+        if has_coll:
+            # activations fire AFTER completions and any fault entry:
+            # every pending flow whose ready date is <= the event date
+            # wakes up (penalty scatter), its ready slot is consumed,
+            # and a tagged entry id = -(1 + n_c + flow_id) logs the
+            # fired successor at the (absolute) advance clock
+            a_any = fire & (next_at <= next_ft)
+            act = a_any & (ready_c <= next_t)
+            acount = jnp.cumsum(act.astype(jnp.int32))
+            apos = jnp.where(act, n_new + acount - 1, ring_n)
+            ring_t2 = ring_t2.at[apos].set(
+                jnp.broadcast_to(t_new, apos.shape), mode="drop")
+            ring_id2 = ring_id2.at[apos].set(-(1 + n_c + ids),
+                                             mode="drop")
+            n_new = n_new + acount[-1]
+            pen2 = jnp.where(act, jnp.asarray(1.0, dtype), pen2)
+            ready2 = jnp.where(act, jnp.inf, ready_c)
+            # DAG walk: completions decrement their successors'
+            # outstanding-predecessor counts; flows reaching zero get
+            # a ready date = completion clock + exec cost (activation
+            # happens on a LATER advance, never the completing one)
+            pred2 = pred_c.at[edge_dst].add(
+                -jnp.take(done.astype(jnp.int32), edge_src), mode="drop")
+            newly = (pred2 <= 0) & (pred_c > 0)
+            ready2 = jnp.where(
+                newly, t_new.astype(jnp.float64) + exec_cost, ready2)
 
         adv_dt2 = adv_dt.at[adv].set(dt.astype(dtype))
         adv_nev2 = adv_nev.at[adv].set(n_new)
@@ -404,23 +477,43 @@ def _superstep_program(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
         if has_tape:
             out_st = out_st + (jnp.where(ok, cb2, cb_c),
                                jnp.where(ok, tpos2, tpos))
+        if has_coll:
+            out_st = out_st + (jnp.where(ok, pred2, pred_c),
+                               jnp.where(ok, ready2, ready_c))
         return out_st
 
     zero = jnp.asarray(0, jnp.int32)
-    st0 = (pen, rem, jnp.asarray(0.0, dtype), jnp.asarray(0.0, dtype),
+    if has_coll:
+        # the Kahan clock pair is carried across dispatches so the
+        # recurrence — and therefore every event date — is invariant
+        # to how advances are grouped into dispatches
+        clk0 = (coll_clk[0].astype(dtype), coll_clk[1].astype(dtype))
+    else:
+        clk0 = (jnp.asarray(0.0, dtype), jnp.asarray(0.0, dtype))
+    st0 = (pen, rem) + clk0 + (
            jnp.zeros(ring_n, dtype), jnp.zeros(ring_n, jnp.int32),
            jnp.zeros(k_max, dtype), jnp.zeros(k_max, jnp.int32),
            zero, zero, zero, zero)
     if has_tape:
         st0 = st0 + (c_bound, jnp.asarray(tape_pos, jnp.int32))
+    if has_coll:
+        st0 = st0 + (coll_pred, coll_ready)
     st = lax.while_loop(cond, body, st0)
-    (pen_o, rem_o, t_sum, _t_comp, ring_t, ring_id, adv_dt, adv_nev,
+    (pen_o, rem_o, t_sum, t_comp_o, ring_t, ring_id, adv_dt, adv_nev,
      n_ev, adv, rounds, flag) = st[:12]
+    idx = 12
     if has_tape:
-        cb_o, tpos_o = st[12], st[13]
+        cb_o, tpos_o = st[idx], st[idx + 1]
+        idx += 2
     else:
         cb_o = c_bound
         tpos_o = jnp.asarray(tape_pos, jnp.int32)
+    if has_coll:
+        pred_o, ready_o = st[idx], st[idx + 1]
+        clk_o = jnp.stack([t_sum.astype(jnp.float64),
+                           t_comp_o.astype(jnp.float64)])
+    else:
+        pred_o, ready_o, clk_o = coll_pred, coll_ready, coll_clk
     n_live = jnp.count_nonzero(pen_o > 0)
     live_elems = jnp.count_nonzero(
         (e_w > 0) & jnp.take(pen_o > 0, e_var, fill_value=False))
@@ -430,13 +523,13 @@ def _superstep_program(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
                        live_elems.astype(dtype)])
     packed = jnp.concatenate([stats, adv_dt, adv_nev.astype(dtype),
                               ring_t, ring_id.astype(dtype)])
-    return pen_o, rem_o, cb_o, tpos_o, packed
+    return pen_o, rem_o, cb_o, tpos_o, pred_o, ready_o, clk_o, packed
 
 
 _drain_superstep = functools.partial(
     jax.jit, static_argnames=("eps", "n_c", "n_v", "k_max", "group",
-                              "has_bounds",
-                              "has_tape"))(_superstep_program)
+                              "has_bounds", "has_tape",
+                              "has_coll"))(_superstep_program)
 
 
 #: transition-payload field order (index = the static target code in
@@ -554,11 +647,13 @@ class SuperstepToken:
 
     __slots__ = ("pen_in", "rem_in", "pen_out", "rem_out", "packed",
                  "k", "k_max", "want_stop", "speculative",
-                 "cb_in", "cb_out", "tpos_out", "t0")
+                 "cb_in", "cb_out", "tpos_out", "t0",
+                 "pred_out", "ready_out", "clk_out")
 
     def __init__(self, pen_in, rem_in, pen_out, rem_out, packed,
                  k: int, k_max: int, want_stop: int, speculative: bool,
-                 cb_in=None, cb_out=None, tpos_out=None, t0=None):
+                 cb_in=None, cb_out=None, tpos_out=None, t0=None,
+                 pred_out=None, ready_out=None, clk_out=None):
         self.pen_in = pen_in
         self.rem_in = rem_in
         self.pen_out = pen_out
@@ -575,6 +670,12 @@ class SuperstepToken:
         self.cb_out = cb_out
         self.tpos_out = tpos_out
         self.t0 = t0
+        # collective-tape double buffers: post-dispatch predecessor
+        # counts, pending-activation dates, and the carried Kahan
+        # clock pair speculative successors chain from
+        self.pred_out = pred_out
+        self.ready_out = ready_out
+        self.clk_out = clk_out
 
 
 class DrainSim:
@@ -615,7 +716,7 @@ class DrainSim:
                  fused: bool = False, superstep: int = 0,
                  superstep_rounds: int = 0, repack_min: int = 1024,
                  penalty=None, remains=None, pipeline: int = 0,
-                 tape=None):
+                 tape=None, collective=None):
         self.eps = float(eps)
         self.done_eps = float(done_eps)
         if done_mode not in ("rel", "abs"):
@@ -739,6 +840,61 @@ class DrainSim:
                 jax.device_put(np.full(1, self.n_c, np.int32), device),
                 jax.device_put(np.zeros(1, self.dtype), device))
             self._tpos = np.int32(0)
+
+        # collective schedule tape: `collective` is (pred, ready,
+        # edge_src, edge_dst, exec_cost) — the compiled comm DAG
+        # (collectives.tape.DeviceCollective.drain_args()).  Dormant
+        # flows (penalty 0) activate on device when their outstanding
+        # predecessor count hits zero; the superstep loop walks the
+        # whole schedule without host involvement (see
+        # _superstep_program's has_coll docs).
+        self.has_coll = False
+        self.collective_events: list = []   # (time, flow id) activations
+        if collective is not None:
+            cp, cr, ces, ced, cec = collective
+            cp = np.asarray(cp, np.int32)
+            cr = np.asarray(cr, np.float64)
+            ces = np.asarray(ces, np.int32)
+            ced = np.asarray(ced, np.int32)
+            cec = np.asarray(cec, np.float64)
+            if not (len(cp) == len(cr) == len(cec) == self.n_v):
+                raise ValueError("collective arrays must be per-flow "
+                                 f"(n_v={self.n_v})")
+            if len(ces) != len(ced):
+                raise ValueError("collective edge arrays must have "
+                                 "equal length")
+            if not superstep:
+                raise ValueError("collective= needs superstep=K (the "
+                                 "DAG walks inside the superstep loop)")
+            if self.dtype != np.float64:
+                raise ValueError("collective= needs dtype=float64 (the "
+                                 "carried Kahan clock must match the "
+                                 "host-maestro oracle bit-for-bit)")
+            self.has_coll = True
+            # a repack would scramble the DAG's static slot indexing
+            self.repack_min = 1 << 62
+            self._coll = tuple(jax.device_put(a, device)
+                               for a in (cp, cr))
+            self._coll_edges = tuple(jax.device_put(a, device)
+                                     for a in (ces, ced, cec))
+            self._coll_clk = jax.device_put(
+                np.zeros(2, np.float64), device)
+            self._coll_total = int(self.n_v)
+            opstats.bump("collective_tape_slots", self.n_v)
+            opstats.bump("uploaded_bytes_delta",
+                         cp.nbytes + cr.nbytes + ces.nbytes
+                         + ced.nbytes + cec.nbytes)
+        else:
+            self._coll = (
+                jax.device_put(np.zeros(1, np.int32), device),
+                jax.device_put(np.full(1, np.inf), device))
+            self._coll_edges = (
+                jax.device_put(np.zeros(1, np.int32), device),
+                jax.device_put(np.zeros(1, np.int32), device),
+                jax.device_put(np.zeros(1, np.float64), device))
+            self._coll_clk = jax.device_put(np.zeros(2, np.float64),
+                                            device)
+            self._coll_total = 0
 
         opstats.bump("uploaded_bytes_full",
                      pen0.nbytes + rem0.nbytes + thresh.nbytes
@@ -1037,7 +1193,8 @@ class DrainSim:
     def _superstep_issue(self, k: Optional[int] = None, pen=None,
                          rem=None, speculative: bool = False,
                          stop_live: int = 0, cb=None, tpos=None,
-                         t0=None, round_budget: int = 0
+                         t0=None, round_budget: int = 0,
+                         pred=None, ready=None, clk=None
                          ) -> SuperstepToken:
         """Dispatch ONE superstep of up to `k` advances WITHOUT
         touching the committed flow state: the dispatch chains from
@@ -1069,14 +1226,19 @@ class DrainSim:
         cb_in = self._cb if cb is None else cb
         tpos_in = self._tpos if tpos is None else tpos
         t0_in = np.float64(self.t) if t0 is None else t0
-        pen_out, rem_out, cb_out, tpos_out, packed = _drain_superstep(
+        pred_in = self._coll[0] if pred is None else pred
+        ready_in = self._coll[1] if ready is None else ready
+        clk_in = self._coll_clk if clk is None else clk
+        (pen_out, rem_out, cb_out, tpos_out, pred_out, ready_out,
+         clk_out, packed) = _drain_superstep(
             *self._dev, cb_in, self._vb, pen_in, rem_in,
             self._thresh, self._ids_dev,
             np.int32(k), np.int32(budget), np.int32(want_stop),
-            _ZERO_BITS, *self._tape, tpos_in, t0_in,
+            _ZERO_BITS, *self._tape, tpos_in,
+            pred_in, ready_in, clk_in, *self._coll_edges, t0_in,
             eps=self.eps, n_c=self.n_c, n_v=self.n_v,
             k_max=k_max, group=group, has_bounds=self.has_bounds,
-            has_tape=self.has_tape)
+            has_tape=self.has_tape, has_coll=self.has_coll)
         self.supersteps += 1
         opstats.bump("dispatches")
         if speculative:
@@ -1085,7 +1247,9 @@ class DrainSim:
         return SuperstepToken(pen_in, rem_in, pen_out, rem_out, packed,
                               k, k_max, want_stop, speculative,
                               cb_in=cb_in, cb_out=cb_out,
-                              tpos_out=tpos_out, t0=t0_in)
+                              tpos_out=tpos_out, t0=t0_in,
+                              pred_out=pred_out, ready_out=ready_out,
+                              clk_out=clk_out)
 
     def _discard_token(self, tok: SuperstepToken) -> None:
         """Drop an un-collected speculative superstep: processing the
@@ -1112,6 +1276,9 @@ class DrainSim:
         if self.has_tape:
             self._cb = tok.cb_out
             self._tpos = tok.tpos_out
+        if self.has_coll:
+            self._coll = (tok.pred_out, tok.ready_out)
+            self._coll_clk = tok.clk_out
         k_max = tok.k_max
         p = opstats.timed_fetch(tok.packed)
         self.syncs += 1
@@ -1131,7 +1298,8 @@ class DrainSim:
         adv_dt = p[o:o + k_max]
         adv_nev = p[o + k_max:o + 2 * k_max].astype(np.int64)
         o += 2 * k_max
-        ring_n = self.n_v + k_max if self.has_tape else self.n_v
+        ring_n = (self.n_v + (k_max if self.has_tape else 0)
+                  + (self.n_v if self.has_coll else 0))
         ring_t = p[o:o + ring_n]
         ring_id = p[o + ring_n:o + 2 * ring_n].astype(np.int64)
 
@@ -1140,12 +1308,16 @@ class DrainSim:
         self.advances += adv
         batches: List[Tuple[float, List[int]]] = []
         start = 0
-        t_base = self.t
+        # collective rings carry ABSOLUTE dates (the Kahan clock pair is
+        # carried across dispatches), so the base folds to zero
+        t_base = 0.0 if self.has_coll else self.t
         fired = 0
-        if self.has_tape:
-            # demux the ring: negative ids are tape fires (slot
-            # -(1+id)), logged into the fault stream instead of the
-            # completion stream/batches
+        coll_fired = 0
+        if self.has_tape or self.has_coll:
+            # demux the ring: negative ids are tagged entries — fault
+            # fires (idx < n_c, into the fault stream) or collective
+            # activations (idx >= n_c, flow idx - n_c fired into the
+            # activation stream) — neither joins the completion batches
             for i in range(adv):
                 end = int(adv_nev[i])
                 batch_ids: List[int] = []
@@ -1153,8 +1325,14 @@ class DrainSim:
                     fid = int(ring_id[j])
                     tj = t_base + float(ring_t[j])
                     if fid < 0:
-                        self.fault_events.append((tj, -fid - 1))
-                        fired += 1
+                        idx = -fid - 1
+                        if idx >= self.n_c:
+                            self.collective_events.append(
+                                (tj, idx - self.n_c))
+                            coll_fired += 1
+                        else:
+                            self.fault_events.append((tj, idx))
+                            fired += 1
                     else:
                         batch_ids.append(fid)
                         self.events.append((tj, fid))
@@ -1164,6 +1342,8 @@ class DrainSim:
             self._last_fired = fired > 0
             if fired:
                 opstats.bump("fault_tape_events", fired)
+            if coll_fired:
+                opstats.bump("collective_tape_fires", coll_fired)
         else:
             for i in range(adv):
                 end = int(adv_nev[i])
@@ -1174,7 +1354,8 @@ class DrainSim:
                                         int(ring_id[j])))
                 start = end
         # f64 master clock: one Kahan-compensated dtype total per
-        # superstep, accumulated on host in f64
+        # superstep, accumulated on host in f64 (collective runs carry
+        # the absolute clock on device; t_base is 0 there)
         self.t = t_base + t_sum
 
         if flag == _FLAG_STALLED:
@@ -1224,6 +1405,9 @@ class DrainSim:
             if self.has_tape:
                 self._cb = tok.cb_out
                 self._tpos = tok.tpos_out
+            if self.has_coll:
+                self._coll = (tok.pred_out, tok.ready_out)
+                self._coll_clk = tok.clk_out
             return None, None
         n_live, batches, _clean = self._superstep_collect(tok)
         return n_live, batches
@@ -1242,7 +1426,7 @@ class DrainSim:
         issued_k = 0            # advances the in-flight tokens may eat
         n = self.n_v
         try:
-            while n and budget > 0:
+            while (n or self._coll_open()) and budget > 0:
                 # fill the pipeline: the head issue mirrors the
                 # unpipelined k=min(K, remaining); speculative issues
                 # only when a FULL K is guaranteed to still be within
@@ -1269,11 +1453,22 @@ class DrainSim:
                                 jnp.float64)
                         else:
                             cb = tpos = t0 = None
+                        if self.has_coll:
+                            # the DAG carry (pred counts, ready dates,
+                            # Kahan clock pair) chains device-side, so
+                            # a committed speculative chain replays the
+                            # exact unpipelined recurrence
+                            pred, ready = prev.pred_out, prev.ready_out
+                            clk = prev.clk_out
+                        else:
+                            pred = ready = clk = None
                     else:
                         pen = rem = cb = tpos = t0 = None
+                        pred = ready = clk = None
                     inflight.append(self._superstep_issue(
                         k, pen=pen, rem=rem, speculative=spec,
-                        cb=cb, tpos=tpos, t0=t0))
+                        cb=cb, tpos=tpos, t0=t0,
+                        pred=pred, ready=ready, clk=clk))
                     issued_k += k
                 tok = inflight.popleft()
                 issued_k -= tok.k
@@ -1289,17 +1484,32 @@ class DrainSim:
                     # committed state
                     if self.has_tape and self._last_fired and inflight:
                         opstats.bump("fault_replays", len(inflight))
+                    if self.has_coll and inflight:
+                        # schedule exhaustion / stop boundary while a
+                        # collective tape is armed: the discarded tail
+                        # is replayed from the committed DAG carry
+                        opstats.bump("collective_replays",
+                                     len(inflight))
                     while inflight:
                         self._discard_token(inflight.popleft())
                     issued_k = 0
-                    if n and self.advances == before:
+                    if (n or self._coll_open()) \
+                            and self.advances == before:
                         # the round budget expired inside the first
                         # solve: finish ONE advance (full-budget
                         # superstep when a tape is armed — the fused
                         # rescue path cannot see tape events — else
                         # the chunked fused path)
+                        after = self.advances
                         n = self._rescue_one()
                         budget -= 1
+                        if self.advances == after \
+                                and self._coll_open():
+                            raise RuntimeError(
+                                "collective schedule deadlocked: "
+                                f"{len(self.events)}/"
+                                f"{self._coll_total} flows completed "
+                                "and nothing is pending")
         finally:
             while inflight:
                 self._discard_token(inflight.popleft())
@@ -1312,10 +1522,17 @@ class DrainSim:
         — its collect raises "did not converge" if even that fails.
         Without a tape, the chunked fused path (which converges across
         dispatches) is cheaper."""
-        if self.has_tape:
+        if self.has_tape or self.has_coll:
             n, _ = self.superstep_batch(k=1, round_budget=_MAX_ROUNDS)
             return n
         return self._advance_fused()
+
+    def _coll_open(self) -> bool:
+        """True while an armed collective schedule still owes
+        completions: a superstep may exit with zero LIVE flows while
+        dormant successors wait on pending activation dates, so the
+        drivers must keep dispatching until every DAG flow completed."""
+        return self.has_coll and len(self.events) < self._coll_total
 
     def run(self, max_advances: int = 10_000_000) -> None:
         n = self.n_v
@@ -1323,16 +1540,24 @@ class DrainSim:
             self._run_pipelined(max_advances)
             return
         if self.superstep_k:
-            while n and max_advances > 0:
+            while (n or self._coll_open()) and max_advances > 0:
                 before = self.advances
                 k = min(self.superstep_k, max_advances)
                 n, _ = self.superstep_batch(k=k)
                 max_advances -= self.advances - before
-                if n and self.advances == before:
+                if (n or self._coll_open()) and self.advances == before:
                     # the round budget expired inside the first solve:
                     # finish ONE advance, then resume
                     n = self._rescue_one()
                     max_advances -= 1
+                    if self.advances == before and self._coll_open():
+                        # no live flow, no pending activation, but the
+                        # schedule still owes completions: a cyclic or
+                        # truncated DAG would spin here forever
+                        raise RuntimeError(
+                            "collective schedule deadlocked: "
+                            f"{len(self.events)}/{self._coll_total} "
+                            "flows completed and nothing is pending")
             return
         while n and max_advances:
             n = self.advance()

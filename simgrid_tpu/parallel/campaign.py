@@ -58,6 +58,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..collectives.spec import CollectiveSpec
 from ..faults import FaultCampaign
 from ..ops import opstats
 from ..ops.lmm_batch import (BatchDrainSim, ReplicaOverrides,
@@ -104,7 +105,7 @@ class ScenarioSpec:
     __slots__ = ("seed", "bw_scale", "size_scale", "link_scale",
                  "flow_scale", "dead_flows", "elem_w", "fault_mtbf",
                  "fault_mttr", "fault_dist", "fault_shape",
-                 "fault_horizon", "label")
+                 "fault_horizon", "collective", "label")
 
     def __init__(self, seed: int = 0, bw_scale: float = 1.0,
                  size_scale: float = 1.0,
@@ -117,6 +118,7 @@ class ScenarioSpec:
                  fault_dist: str = "exponential",
                  fault_shape: float = 1.0,
                  fault_horizon: float = 1000.0,
+                 collective: Optional[CollectiveSpec] = None,
                  label: Optional[str] = None):
         self.seed = int(seed)
         self.bw_scale = float(bw_scale)
@@ -130,6 +132,12 @@ class ScenarioSpec:
         self.fault_dist = fault_dist
         self.fault_shape = float(fault_shape)
         self.fault_horizon = float(fault_horizon)
+        if isinstance(collective, dict):
+            collective = CollectiveSpec.from_dict(collective)
+        #: optional CollectiveSpec: the comm-DAG workload this spec is
+        #: meant for.  Specs carrying one only run on a plan compiled
+        #: for the SAME collective (campaign/serving validate by key)
+        self.collective = collective
         self.label = label if label is not None else f"seed{seed}"
 
     # -- stable serialization / content addressing -------------------------
@@ -151,6 +159,10 @@ class ScenarioSpec:
              "fault_dist": str(self.fault_dist),
              "fault_shape": self.fault_shape,
              "fault_horizon": self.fault_horizon}
+        if self.collective is not None:
+            # present ONLY when set: legacy (collective-free) specs
+            # keep their pinned hashes
+            d["collective"] = self.collective.to_dict()
         if with_label:
             d["label"] = self.label
         return d
@@ -174,6 +186,7 @@ class ScenarioSpec:
                    fault_dist=d.get("fault_dist", "exponential"),
                    fault_shape=d.get("fault_shape", 1.0),
                    fault_horizon=d.get("fault_horizon", 1000.0),
+                   collective=d.get("collective"),
                    label=d.get("label"))
 
     @classmethod
@@ -194,11 +207,11 @@ class ReplicaResult:
     """Per-replica campaign outcome (the demultiplexed 'engine')."""
 
     __slots__ = ("spec", "events", "t", "advances", "error",
-                 "fault_events")
+                 "fault_events", "collective_events")
 
     def __init__(self, spec: ScenarioSpec, events, t: float,
                  advances: int, error: Optional[str],
-                 fault_events=None):
+                 fault_events=None, collective_events=None):
         self.spec = spec
         self.events = events          # [(time, flow slot)] solo order
         self.t = t
@@ -207,6 +220,9 @@ class ReplicaResult:
         #: (time, constraint slot) per fired tape event, fire order
         #: (empty unless the campaign runs in faults/tape:on mode)
         self.fault_events = list(fault_events or [])
+        #: (time, flow slot) per schedule-tape activation, fire order
+        #: (empty unless the plan carries a collective)
+        self.collective_events = list(collective_events or [])
 
 
 def _mesh_size(mesh) -> int:
@@ -243,7 +259,9 @@ class ScenarioPlan:
                  eps: float = 1e-9, done_eps: float = 1e-4,
                  dtype=np.float64, done_mode: str = "rel",
                  superstep: int = 8, pipeline: int = 0, mesh=None,
-                 fault_mode: Optional[str] = None):
+                 fault_mode: Optional[str] = None,
+                 collective: Optional[CollectiveSpec] = None,
+                 _device_collective=None):
         self.e_var = np.asarray(e_var, np.int32)
         self.e_cnst = np.asarray(e_cnst, np.int32)
         self.e_w = np.asarray(e_w, np.float64)
@@ -273,6 +291,34 @@ class ScenarioPlan:
         #: tapes (mid-drain capacity flips), "static" = folded
         #: mean-availability multipliers, "off" = ignored
         self.fault_mode = fault_mode
+        if isinstance(collective, dict):
+            collective = CollectiveSpec.from_dict(collective)
+        #: optional CollectiveSpec: when set, the plan's flattening IS
+        #: the compiled comm DAG and every executor walks its schedule
+        #: tape on device (see collectives/)
+        self.collective = collective
+        self._dc = None
+        if collective is not None:
+            if self.dtype != np.float64:
+                raise ValueError(
+                    "collective schedule tapes require dtype float64 "
+                    "(the superstep clock is carried on device)")
+            dc = (_device_collective if _device_collective is not None
+                  else collective.build())
+            if len(self.sizes) != dc.n_v or len(self.c_bound) != dc.n_c:
+                raise ValueError(
+                    f"plan arrays ({len(self.sizes)} flows, "
+                    f"{len(self.c_bound)} links) do not match the "
+                    f"collective's compiled tape ({dc.n_v} flows, "
+                    f"{dc.n_c} links); build the plan with "
+                    f"ScenarioPlan.for_collective")
+            if self.penalty is None:
+                self.penalty = np.asarray(dc.penalty0, np.float64)
+            elif not np.array_equal(self.penalty, dc.penalty0):
+                raise ValueError(
+                    "plan penalty does not match the collective's "
+                    "root-activation mask (dc.penalty0)")
+            self._dc = dc
         #: constraint slots that actually carry elements — fault
         #: schedules are drawn for these only (padding slots have no
         #: flows and scaling them is pure noise in the RNG stream)
@@ -308,6 +354,11 @@ class ScenarioPlan:
             h.update(json.dumps(names).encode())
             h.update(json.dumps([self.eps, self.done_eps,
                                  self.done_mode]).encode())
+            if self.collective is not None:
+                # folded in only when present: legacy plans keep their
+                # cached hashes (and cached AOT executables)
+                h.update(b"collective")
+                h.update(self.collective.key().encode())
             self._topology_hash = h.hexdigest()
         return self._topology_hash
 
@@ -328,6 +379,40 @@ class ScenarioPlan:
                             _mesh_size(use_mesh), self.fault_mode],
                            separators=(",", ":"))
         return hashlib.sha256(canon.encode("utf-8")).hexdigest()
+
+    @classmethod
+    def for_collective(cls, cspec: CollectiveSpec, exec_cost=None,
+                       **kw) -> "ScenarioPlan":
+        """Build a plan whose flattening IS one collective's compiled
+        comm DAG: the tape arrays come from ``cspec.build()`` and the
+        plan carries the spec, so ``plan_key`` content-addresses the
+        (algorithm × ranks × topology) sweep point for the AOT plan
+        cache.  Solver/config kwargs pass through."""
+        dc = cspec.build(exec_cost=exec_cost)
+        return cls(dc.e_var, dc.e_cnst, dc.e_w, dc.c_bound, dc.sizes,
+                   penalty=dc.penalty0, collective=cspec,
+                   _device_collective=dc, **kw)
+
+    def _check_collective(self, spec: ScenarioSpec) -> None:
+        """A spec carrying a collective only runs on a plan compiled
+        for the same one — a silent mismatch would report a different
+        workload's clocks under the spec's label."""
+        if self.collective is not None and spec.dead_flows:
+            raise ValueError(
+                f"spec {spec.label!r} kills flows "
+                f"{spec.dead_flows} but the plan walks a schedule "
+                f"tape — a dead record would deadlock its successors")
+        if spec.collective is None:
+            return
+        if self.collective is None:
+            raise ValueError(
+                f"spec {spec.label!r} carries collective "
+                f"{spec.collective.label()} but the plan has none")
+        if spec.collective.key() != self.collective.key():
+            raise ValueError(
+                f"spec {spec.label!r} carries collective "
+                f"{spec.collective.label()} but the plan was compiled "
+                f"for {self.collective.label()}")
 
     # -- per-spec scenario derivation --------------------------------------
 
@@ -441,6 +526,8 @@ class ScenarioPlan:
         width = len(specs) if width is None else int(width)
         if width < len(specs):
             raise ValueError("executor width smaller than spec count")
+        for s in specs:
+            self._check_collective(s)
         overrides = [self.overrides_for(s) for s in specs]
         overrides += [ReplicaOverrides()
                       for _ in range(width - len(specs))]
@@ -464,7 +551,9 @@ class ScenarioPlan:
             remains=self.remains, pipeline=depth, mesh=use_mesh,
             tapes=tapes, plan=compiled, tape_slots=tape_slots,
             start_dead=tuple(range(len(specs), width)),
-            batch_w=batch_w, watchdog=watchdog)
+            batch_w=batch_w, watchdog=watchdog,
+            collective=(self._dc.drain_args()
+                        if self._dc is not None else None))
 
     def solo(self, spec: ScenarioSpec,
              superstep_rounds: int = 0) -> ReplicaResult:
@@ -476,6 +565,7 @@ class ScenarioPlan:
         repack-invariant anyway, but the oracle keeps the dispatch
         structure aligned too."""
         from ..ops.lmm_drain import DrainSim
+        self._check_collective(spec)
         ov = self.overrides_for(spec)
         base_rem = (self.remains if self.remains is not None
                     else self.sizes)
@@ -493,14 +583,17 @@ class ScenarioPlan:
                        v_bound=(self.v_bound.astype(self.dtype)
                                 if self.v_bound is not None else None),
                        penalty=pen, remains=rem, repack_min=1 << 62,
-                       tape=self.tape_for(spec))
+                       tape=self.tape_for(spec),
+                       collective=(self._dc.drain_args()
+                                   if self._dc is not None else None))
         error = None
         try:
             sim.run()
         except RuntimeError as exc:
             error = str(exc)
         return ReplicaResult(spec, sim.events, sim.t, sim.advances,
-                             error, fault_events=sim.fault_events)
+                             error, fault_events=sim.fault_events,
+                             collective_events=sim.collective_events)
 
 
 class Campaign:
@@ -516,13 +609,15 @@ class Campaign:
                  eps: float = 1e-9, done_eps: float = 1e-4,
                  dtype=np.float64, done_mode: str = "rel",
                  superstep: int = 8, pipeline: int = 0, mesh=None,
-                 fault_mode: Optional[str] = None, plan_cache=None):
+                 fault_mode: Optional[str] = None, plan_cache=None,
+                 collective: Optional[CollectiveSpec] = None):
         self.plan = ScenarioPlan(
             e_var, e_cnst, e_w, c_bound, sizes, remains=remains,
             penalty=penalty, v_bound=v_bound, link_names=link_names,
             eps=eps, done_eps=done_eps, dtype=dtype,
             done_mode=done_mode, superstep=superstep,
-            pipeline=pipeline, mesh=mesh, fault_mode=fault_mode)
+            pipeline=pipeline, mesh=mesh, fault_mode=fault_mode,
+            collective=collective)
         self.specs = list(specs)
         #: optional serving.plancache.PlanCache: when set, fleet
         #: programs run through AOT-compiled executables keyed by the
@@ -556,6 +651,16 @@ class Campaign:
                    v_bound=snap["v_bound"],
                    link_names=snap["link_names"], specs=specs, **kw)
 
+    @classmethod
+    def for_collective(cls, cspec: CollectiveSpec,
+                       specs: Sequence[ScenarioSpec], **kw
+                       ) -> "Campaign":
+        """A campaign over one collective's compiled comm DAG — see
+        :meth:`ScenarioPlan.for_collective`."""
+        dc = cspec.build()
+        return cls(dc.e_var, dc.e_cnst, dc.e_w, dc.c_bound, dc.sizes,
+                   specs, penalty=dc.penalty0, collective=cspec, **kw)
+
     # -- execution ---------------------------------------------------------
 
     def run_batched(self, batch: int = 64, superstep_rounds: int = 0,
@@ -580,7 +685,8 @@ class Campaign:
                 rep = sim.replicas[b]
                 results.append(ReplicaResult(
                     spec, rep.events, rep.t, rep.advances, rep.error,
-                    fault_events=rep.fault_events))
+                    fault_events=rep.fault_events,
+                    collective_events=rep.collective_events))
         return results
 
     def run_solo(self, index: int,

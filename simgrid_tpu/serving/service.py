@@ -216,6 +216,9 @@ class CampaignService:
         """Enqueue one query.  Surrogate triage happens HERE — a
         tight-interval prediction answers without touching the queue;
         ``exact=True`` always bypasses it."""
+        # reject collective mismatches at the door: an admitted lane
+        # would otherwise report a different workload's clocks
+        self.plan._check_collective(spec)
         t = Ticket(len(self.tickets), spec, bool(exact))
         self.tickets.append(t)
         if not exact and self.surrogate is not None:
@@ -553,6 +556,9 @@ class CampaignService:
                 "link_names": (list(plan.link_names)
                                if plan.link_names is not None
                                else None),
+                "collective": (plan.collective.to_dict()
+                               if plan.collective is not None
+                               else None),
             },
             "service": {
                 "batch": self.batch,
@@ -636,6 +642,8 @@ class CampaignService:
             for name in ("remains", "penalty", "v_bound"):
                 if "plan_" + name in ck.arrays:
                     kw[name] = ck.arrays["plan_" + name]
+            if pt.get("collective"):
+                kw["collective"] = pt["collective"]
             plan = ScenarioPlan(
                 ck.arrays["plan_e_var"], ck.arrays["plan_e_cnst"],
                 ck.arrays["plan_e_w"], ck.arrays["plan_c_bound"],

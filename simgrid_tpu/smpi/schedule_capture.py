@@ -1,0 +1,427 @@
+"""Schedule capture: record a collective's posted comms into a tape.
+
+Two entry points, both producing ``collectives.schedule`` per-rank
+programs from the REAL algorithm implementations in ``coll.py`` (not
+the mirrored generators — that is the point: the generators are
+proved against this module by tests/test_collectives.py):
+
+* ``record_algorithm(op, algo, ranks, payload)`` runs one named
+  algorithm on ``ranks`` threads over :class:`RecordingComm` shims —
+  every ``isend``/``irecv``/``wait`` the algorithm posts is recorded
+  as a :class:`~..collectives.schedule.Prog` op, while the payloads
+  rendezvous through in-memory queues so the algorithm's own data flow
+  (reduction combines, chunk rotation) runs for real.
+
+* ``CaptureScope`` patches ``coll.dispatch`` so a live SMPI program —
+  e.g. a C binary driven through ``smpi/c_api`` — records every
+  top-level collective it issues; ``scope.schedule()`` then replays
+  the recorded call sequence through the same thread harness,
+  CONCATENATING per-rank programs so multi-phase dependency chains
+  (NAS-style allreduce; alltoall; allreduce ...) fall out of the
+  frontier walk with no explicit barrier records.
+
+The shim decomposes exactly like ``smpi.Comm``: blocking ``send`` is
+post + wait, ``sendrecv`` is irecv, isend, wait(recv), wait(send), and
+matching is per-(src, dst, tag) FIFO — the non-overtaking rule the
+runtime's mailboxes apply.  Wildcard receives cannot be compiled into
+a static tape and raise :class:`CaptureError` (so ``barrier``, whose
+linear algorithm receives from MPI_ANY_SOURCE, is not capturable).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..collectives.schedule import CollectiveSchedule, Prog, build_schedule
+from .datatype import payload_size
+from .op import MPI_SUM, Op
+from .request import MPI_ANY_SOURCE, MPI_ANY_TAG
+
+#: rendezvous timeout — a capture that blocks this long has deadlocked
+#: (mismatched posts), which build_schedule would also reject
+_TIMEOUT = 30.0
+
+#: collectives the thread harness knows how to re-invoke (op name ->
+#: argument shape); everything else raises at capture time
+_CAPTURABLE = ("bcast", "reduce", "allreduce", "alltoall")
+
+
+class CaptureError(RuntimeError):
+    pass
+
+
+class _Rendezvous:
+    """Per-(src, dst, tag) FIFO queues carrying the real payloads
+    between recording threads (the in-memory stand-in for the
+    runtime's mailboxes)."""
+
+    def __init__(self):
+        self._q: Dict[tuple, queue.Queue] = {}
+        self._lock = threading.Lock()
+
+    def chan(self, src: int, dst: int, tag: int) -> queue.Queue:
+        k = (src, dst, tag)
+        with self._lock:
+            q = self._q.get(k)
+            if q is None:
+                q = self._q[k] = queue.Queue()
+            return q
+
+
+class RecordedRequest:
+    """The shim's Request: wait() records the wait op and, for recvs,
+    blocks on the rendezvous channel for the real payload."""
+
+    __slots__ = ("comm", "kind", "peer", "tag", "h", "_done", "_data")
+
+    def __init__(self, comm: "RecordingComm", kind: str, peer: int,
+                 tag: int, h: int):
+        self.comm = comm
+        self.kind = kind
+        self.peer = peer
+        self.tag = tag
+        self.h = h
+        self._done = False
+        self._data = None
+
+    def wait(self, status=None):
+        if self._done:
+            return self._data
+        self.comm.prog.wait(self.h)
+        if self.kind == "recv":
+            chan = self.comm._rdv.chan(self.peer, self.comm._rank,
+                                       self.tag)
+            try:
+                self._data = chan.get(timeout=_TIMEOUT)
+            except queue.Empty:
+                raise CaptureError(
+                    f"capture deadlocked: rank {self.comm._rank} recv "
+                    f"from {self.peer} tag {self.tag} never matched")
+        self._done = True
+        return self._data
+
+
+class RecordingComm:
+    """Comm-shaped shim: the p2p surface coll.py algorithms touch
+    (rank/size/send/recv/isend/irecv/sendrecv), recording each post
+    into a Prog while shipping payloads eagerly through queues."""
+
+    def __init__(self, rank: int, size: int, rdv: _Rendezvous,
+                 prog: Prog):
+        self._rank = rank
+        self._size = size
+        self._rdv = rdv
+        self.prog = prog
+
+    def rank(self) -> int:
+        return self._rank
+
+    def size(self) -> int:
+        return self._size
+
+    # -- p2p, decomposed exactly like smpi.Comm ---------------------------
+
+    def isend(self, buf, dest: int, tag: int = 0, count=None,
+              datatype=None, ssend: bool = False) -> RecordedRequest:
+        h = self.prog.isend(dest, tag, payload_size(buf, datatype))
+        # eager: the channel buffers, so sends never block — same
+        # completion semantics the schedule compiler assumes
+        self._rdv.chan(self._rank, dest, tag).put(buf)
+        return RecordedRequest(self, "send", dest, tag, h)
+
+    def send(self, buf, dest: int, tag: int = 0, count=None,
+             datatype=None) -> None:
+        self.isend(buf, dest, tag).wait()
+
+    def irecv(self, source: int = MPI_ANY_SOURCE,
+              tag: int = MPI_ANY_TAG, buf=None, count=None,
+              datatype=None) -> RecordedRequest:
+        if source == MPI_ANY_SOURCE or tag == MPI_ANY_TAG:
+            raise CaptureError(
+                "wildcard receive cannot be compiled into a static "
+                "schedule tape (rank %d, source=%r tag=%r)"
+                % (self._rank, source, tag))
+        h = self.prog.irecv(source, tag)
+        return RecordedRequest(self, "recv", source, tag, h)
+
+    def recv(self, source: int = MPI_ANY_SOURCE,
+             tag: int = MPI_ANY_TAG, buf=None, count=None,
+             datatype=None, status=None):
+        return self.irecv(source, tag).wait(status)
+
+    def sendrecv(self, sendbuf, dest: int, recvsource: int,
+                 sendtag: int = 0, recvtag: int = MPI_ANY_TAG,
+                 status=None):
+        rreq = self.irecv(recvsource, recvtag)
+        sreq = self.isend(sendbuf, dest, sendtag)
+        data = rreq.wait(status)
+        sreq.wait()
+        return data
+
+
+def _invoke(fn: Callable, op: str, comm: RecordingComm, payload,
+            mpi_op: Op, root: int):
+    if op == "bcast":
+        return fn(comm, payload, root)
+    if op == "reduce":
+        return fn(comm, payload, mpi_op, root)
+    if op == "allreduce":
+        return fn(comm, payload, mpi_op)
+    if op == "alltoall":
+        return fn(comm, payload)
+    raise CaptureError(f"cannot capture collective {op!r}; "
+                       f"capturable: {_CAPTURABLE}")
+
+
+def _run_threads(ranks: int, progs: List[Prog],
+                 thunk: Callable[[RecordingComm, int], None]) -> None:
+    """Run one thread per rank over fresh RecordingComms appending to
+    ``progs``; re-raise the first rank failure."""
+    rdv = _Rendezvous()
+    errs: List[Tuple[int, BaseException]] = []
+
+    def body(r: int) -> None:
+        try:
+            thunk(RecordingComm(r, ranks, rdv, progs[r]), r)
+        except BaseException as e:      # noqa: BLE001 — surfaced below
+            errs.append((r, e))
+
+    threads = [threading.Thread(target=body, args=(r,), daemon=True)
+               for r in range(ranks)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=_TIMEOUT + 5.0)
+        if t.is_alive():
+            raise CaptureError("capture threads wedged (deadlocked "
+                               "collective?)")
+    if errs:
+        r, e = errs[0]
+        raise CaptureError(f"rank {r} failed during capture: "
+                           f"{e!r}") from e
+
+
+def default_payload(op: str, ranks: int, payload: float):
+    """Per-rank payload factory matching the size conventions of
+    collectives.schedule.GENERATORS: bcast/reduce/allreduce get one
+    ``payload``-byte buffer (elements × 8 for lr — pass elems × 8
+    bytes and the ndarray length carries the element count), alltoall
+    a list of per-destination ``payload``-byte buffers."""
+    def one(nbytes: float):
+        n = int(nbytes)
+        if n % 8 == 0 and n > 0:
+            return np.zeros(n // 8, np.float64)
+        return np.zeros(max(n, 1), np.uint8)
+
+    if op == "alltoall":
+        return lambda r: [one(payload) for _ in range(ranks)]
+    return lambda r: one(payload)
+
+
+def record_algorithm(op: str, algo: str, ranks: int, payload,
+                     mpi_op: Optional[Op] = None, root: int = 0,
+                     progs: Optional[List[Prog]] = None) -> List[Prog]:
+    """Run the REAL ``coll.py`` algorithm ``op``/``algo`` on ``ranks``
+    recording threads.  ``payload`` is a per-rank factory (rank ->
+    object) or a plain object shared by all ranks.  Appends into
+    ``progs`` when given (multi-phase chaining) and returns the
+    program list."""
+    from . import coll
+    from ..utils.config import config
+    fn = coll.dispatch_name(op, algo)
+    mpi_op = MPI_SUM if mpi_op is None else mpi_op
+    if progs is None:
+        progs = [Prog() for _ in range(ranks)]
+    elif len(progs) != ranks:
+        raise CaptureError(f"progs has {len(progs)} ranks, need {ranks}")
+
+    def thunk(comm: RecordingComm, r: int) -> None:
+        pay = payload(r) if callable(payload) else payload
+        _invoke(fn, op, comm, pay, mpi_op, root)
+
+    # Pin the selector to the algorithm under test so nested
+    # self-dispatches (allreduce_lr's remainder chunk) resolve the way
+    # the named algorithm would resolve them in a run configured for
+    # it, not the way the ambient config happens to point.
+    flag = f"smpi/{op}"
+    prev = config[flag]
+    config[flag] = algo
+    try:
+        _run_threads(ranks, progs, thunk)
+    finally:
+        config[flag] = prev
+    return progs
+
+
+def capture_schedule(op: str, algo: str, ranks: int, payload,
+                     mpi_op: Optional[Op] = None,
+                     root: int = 0) -> CollectiveSchedule:
+    """record_algorithm + build_schedule in one step."""
+    return build_schedule(record_algorithm(op, algo, ranks, payload,
+                                           mpi_op=mpi_op, root=root))
+
+
+class CaptureScope:
+    """Record every top-level collective a live SMPI program issues.
+
+    Patches ``coll.dispatch`` so each per-rank invocation notes
+    (algorithm fn, payload shape descriptor) in the rank's call list
+    while still running the real algorithm (the program's data flow is
+    undisturbed).  Nested dispatches (redbcast's inner reduce + bcast)
+    are not recorded — replaying the outer call re-derives them.
+
+    ``schedule()`` replays the j-th call of every rank together
+    through the thread harness, asserting the program is SPMD (same op
+    sequence on every rank), and compiles one CollectiveSchedule whose
+    per-rank frontier chains the phases.
+    """
+
+    def __init__(self):
+        self._calls: Dict[int, List[tuple]] = {}
+        self._depth: Dict[int, int] = {}
+        self._ranks: Optional[int] = None
+        self._orig = None
+
+    # -- context management ----------------------------------------------
+
+    def __enter__(self) -> "CaptureScope":
+        from . import coll
+        if self._orig is not None:
+            raise CaptureError("CaptureScope is not reentrant")
+        self._orig = coll.dispatch
+        coll.dispatch = self._dispatch
+        return self
+
+    def __exit__(self, *exc) -> None:
+        from . import coll
+        coll.dispatch = self._orig
+        self._orig = None
+
+    # -- the patched selector --------------------------------------------
+
+    def _dispatch(self, opname: str) -> Callable:
+        real = self._orig(opname)
+
+        def wrapped(comm, *args, **kw):
+            r = comm.rank()
+            d = self._depth.get(r, 0)
+            if d == 0:
+                self._note(opname, real, comm, r, args, kw)
+            self._depth[r] = d + 1
+            try:
+                return real(comm, *args, **kw)
+            finally:
+                self._depth[r] = d
+
+        return wrapped
+
+    def _note(self, opname: str, fn: Callable, comm, rank: int,
+              args: tuple, kw: dict) -> None:
+        if opname not in _CAPTURABLE:
+            raise CaptureError(
+                f"collective {opname!r} cannot be captured into a "
+                f"schedule tape (capturable: {_CAPTURABLE})")
+        size = comm.size()
+        if self._ranks is None:
+            self._ranks = size
+        elif size != self._ranks:
+            raise CaptureError(
+                f"capture spans communicators of different sizes "
+                f"({self._ranks} vs {size}); one communicator only")
+        self._calls.setdefault(rank, []).append(
+            (opname, fn, _describe(opname, args, kw)))
+
+    # -- replay ------------------------------------------------------------
+
+    @property
+    def n_phases(self) -> int:
+        if not self._calls:
+            return 0
+        return max(len(c) for c in self._calls.values())
+
+    def schedule(self) -> CollectiveSchedule:
+        if self._orig is not None:
+            # replaying inside the scope would record the replay's own
+            # nested dispatches into _calls mid-iteration
+            raise CaptureError("call schedule() after the scope exits")
+        ranks = self._ranks
+        if ranks is None:
+            raise CaptureError("no collectives captured")
+        per_rank = []
+        for r in range(ranks):
+            if r not in self._calls:
+                raise CaptureError(f"rank {r} issued no collectives "
+                                   f"(non-SPMD program?)")
+            per_rank.append(self._calls[r])
+        n = len(per_rank[0])
+        for r, calls in enumerate(per_rank):
+            if len(calls) != n:
+                raise CaptureError(
+                    f"rank {r} issued {len(calls)} collectives, rank 0 "
+                    f"issued {n}; capture needs an SPMD sequence")
+        progs = [Prog() for _ in range(ranks)]
+        for j in range(n):
+            phase = [per_rank[r][j] for r in range(ranks)]
+            opname, fn = phase[0][0], phase[0][1]
+            for r, (o, f, _) in enumerate(phase):
+                if o != opname or f is not fn:
+                    raise CaptureError(
+                        f"phase {j}: rank {r} ran {o} but rank 0 ran "
+                        f"{opname}; capture needs an SPMD sequence")
+
+            def thunk(comm: RecordingComm, r: int,
+                      _phase=phase, _op=opname, _fn=fn) -> None:
+                payload, mpi_op, root = _rebuild(_op, _phase[r][2])
+                _invoke(_fn, _op, comm, payload, mpi_op, root)
+
+            _run_threads(ranks, progs, thunk)
+        return build_schedule(progs)
+
+
+def _describe(opname: str, args: tuple, kw: dict):
+    """Shape descriptor of one rank's call: enough to replay with a
+    value-free payload (coll.py control flow depends on rank, size and
+    payload type/length only — never on element values)."""
+    if opname == "bcast":
+        obj = args[0] if args else kw.get("obj")
+        root = args[1] if len(args) > 1 else kw.get("root", 0)
+        return (_desc(obj), None, int(root))
+    if opname == "reduce":
+        obj = args[0] if args else kw.get("sendobj")
+        op = args[1] if len(args) > 1 else kw.get("op", MPI_SUM)
+        root = args[2] if len(args) > 2 else kw.get("root", 0)
+        return (_desc(obj), op, int(root))
+    if opname == "allreduce":
+        obj = args[0] if args else kw.get("sendobj")
+        op = args[1] if len(args) > 1 else kw.get("op", MPI_SUM)
+        return (_desc(obj), op, 0)
+    # alltoall
+    objs = args[0] if args else kw.get("sendobjs")
+    return ([_desc(o) for o in objs], None, 0)
+
+
+def _rebuild(opname: str, desc: tuple):
+    d, op, root = desc
+    if opname == "alltoall":
+        return [_synth(x) for x in d], op, root
+    return _synth(d), op, root
+
+
+def _desc(obj):
+    if isinstance(obj, np.ndarray):
+        return ("nd", obj.shape, obj.dtype.str)
+    if isinstance(obj, (bytes, bytearray)):
+        return ("bytes", len(obj))
+    return ("obj",)
+
+
+def _synth(d):
+    if d[0] == "nd":
+        return np.zeros(d[1], np.dtype(d[2]))
+    if d[0] == "bytes":
+        return b"\0" * d[1]
+    return 0.0

@@ -146,6 +146,25 @@ def test_resume_runtime_determinism():
     assert problems == []
 
 
+def test_collective_runtime_determinism():
+    """Dynamic coverage of the collective schedule tapes (ISSUE 13
+    tooling, the `--quick` small-N instance): the comm sequences the
+    real smpi/coll.py algorithms post on recording threads equal the
+    mirrored generators at non-power-of-two rank counts, and the
+    tape-driven superstep DAG walk — solo, k=1 grouping, pipelined,
+    3-lane Campaign.for_collective fleets and a fault-tape-composed
+    run — is bit-identical (completion events, fired activations and
+    Kahan clocks) to the dispatch-per-advance HostMaestro at a >= 3x
+    dispatch advantage.  The full-size check, including the
+    live-captured NAS IS kernel through smpi/c_api, runs via
+    `check_determinism.py --runtime-collective`."""
+    checker = _load_checker()
+    problems = checker.check_collective_runtime(ranks=5, k=4,
+                                                depths=(0, 2),
+                                                nas=False)
+    assert problems == []
+
+
 def test_checker_flags_violations(tmp_path):
     """The lint itself works: a planted file with each banned pattern is
     reported (guards against the lint silently matching nothing)."""

@@ -227,6 +227,12 @@ def main() -> int:
                fault_tape_events=int(
                    stats.get("fault_tape_events", 0)),
                fault_replays=int(stats.get("fault_replays", 0)),
+               collective_tape_slots=int(
+                   stats.get("collective_tape_slots", 0)),
+               collective_tape_fires=int(
+                   stats.get("collective_tape_fires", 0)),
+               collective_replays=int(
+                   stats.get("collective_replays", 0)),
                lanes_admitted=int(stats.get("lanes_admitted", 0)),
                solver_fallbacks=int(
                    stats.get("solver_fallbacks", 0)))

@@ -106,9 +106,20 @@ idempotent; and a single NaN-poisoned lane quarantines with a
 ``nan_solve`` LaneFault on its own ticket while every other lane
 stays bit-identical to solo.
 
+``--runtime-collective`` proves the collective schedule tapes: the
+captured comm sequences of the real ``smpi/coll.py`` algorithms equal
+the mirrored generators at non-power-of-two rank counts, and the
+tape-driven superstep runs — solo, k=1, pipelined, batched fleets and
+fault-tape-composed — are bit-identical (completion events, fired
+activations AND Kahan clocks) to the dispatch-per-advance
+``HostMaestro`` baseline, at a fraction of its dispatch count; with a
+C compiler present, a real NAS-style IS kernel (allreduce + alltoall
+iterations through ``smpi/c_api``) is captured live and replayed on
+the tape path end to end.
+
 ``--quick`` is the CI mode: the static lint plus small-N instances of
 every runtime check (drain, warm-start, batch, pipeline, shard,
-phase, fault, serve, resume), sized to finish in seconds so the tier-1 suite
+phase, fault, serve, resume, collective), sized to finish in seconds so the tier-1 suite
 can run it on every test pass (tests/test_determinism_lint.py, whose
 conftest forces an 8-virtual-device CPU so the mesh path is exercised
 on every run).
@@ -126,6 +137,7 @@ AUDITED_DIRS = (
     os.path.join("simgrid_tpu", "ops"),
     os.path.join("simgrid_tpu", "faults"),
     os.path.join("simgrid_tpu", "serving"),
+    os.path.join("simgrid_tpu", "collectives"),
 )
 
 BANNED = [
@@ -1097,6 +1109,288 @@ def check_phase_runtime(seed: int = 37, ranks: int = 48, rounds: int = 3,
     return problems
 
 
+#: IS-style NAS comm skeleton: each iteration is the integer sort's
+#: bucket-count allreduce followed by the key alltoall, with data
+#: checks so a wrong reduction fails the exit code (ITERS via -D).
+_NAS_IS_KERNEL = r"""
+#include <mpi.h>
+#include <stdlib.h>
+
+#ifndef ITERS
+#define ITERS 3
+#endif
+
+int main(int argc, char **argv) {
+    int rank, size, i, it;
+    MPI_Init(&argc, &argv);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    int n = 32 * size;                 /* bucket counts */
+    int per = 16;                      /* keys per destination */
+    double *cnt = malloc(n * sizeof(double));
+    double *tot = malloc(n * sizeof(double));
+    double *keys = malloc(per * size * sizeof(double));
+    double *sorted = malloc(per * size * sizeof(double));
+    for (it = 0; it < ITERS; it++) {
+        for (i = 0; i < n; i++) cnt[i] = rank + i + it;
+        MPI_Allreduce(cnt, tot, n, MPI_DOUBLE, MPI_SUM, MPI_COMM_WORLD);
+        for (i = 0; i < n; i++)
+            if (tot[i] != size * (double)(i + it)
+                          + size * (size - 1) / 2.0) {
+                MPI_Finalize();
+                return 20 + it;
+            }
+        for (i = 0; i < per * size; i++) keys[i] = rank * 1000.0 + i;
+        MPI_Alltoall(keys, per, MPI_DOUBLE, sorted, per, MPI_DOUBLE,
+                     MPI_COMM_WORLD);
+        for (i = 0; i < size; i++)
+            if (sorted[i * per] != i * 1000.0 + rank * per) {
+                MPI_Finalize();
+                return 40 + it;
+            }
+    }
+    MPI_Finalize();
+    return 0;
+}
+"""
+
+
+def check_collective_runtime(seed: int = 53, ranks: int = 6, k: int = 8,
+                             depths=(0, 2), nas: bool = True,
+                             nas_ranks: int = 8, nas_iters: int = 3,
+                             ratio: float = 10.0) -> List[str]:
+    """Dynamic determinism of the collective schedule tapes:
+
+    * capture parity — the comm sequence (src, dst, tag, size,
+      dependency order) the REAL ``smpi/coll.py`` algorithms post on
+      recording threads must equal the mirrored ``collectives.schedule``
+      generators, at `ranks` and the non-power-of-two `ranks`+1;
+    * tape vs maestro — the superstep-resident DAG walk (solo, k=1
+      grouping and pipeline depth ``max(depths)``) must be
+      bit-identical — completion events, fired activations AND the
+      Kahan clock pair — to the dispatch-per-advance ``HostMaestro``
+      over the same compiled arrays, while issuing at least 3x fewer
+      dispatches;
+    * fleets — a 3-lane ``Campaign.for_collective`` sweep (plain,
+      bw-scaled, size+link-scaled), plain and pipelined, must be
+      bit-identical per lane to solo runs including the activation
+      stream;
+    * fault composition — a seeded link-flip tape firing mid-collective
+      must keep tape, maestro and the pipelined variant bit-identical
+      while actually moving the event stream;
+    * NAS leg (``nas=True``, needs a C compiler) — a real IS-style MPI
+      C kernel (bucket-count allreduce + key alltoall per iteration)
+      is compiled with ``smpi/c_api``, its live collectives captured
+      via ``CaptureScope``, and the replayed schedule must complete on
+      the tape path bit-identically to the maestro with >= `ratio`x
+      fewer dispatches per collective step.
+
+    Returns a list of problem descriptions (empty = OK)."""
+    import numpy as np
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from simgrid_tpu.collectives import (CollectiveSpec, DeviceCollective,
+                                         HostMaestro, Topology, generate)
+    from simgrid_tpu.smpi.schedule_capture import (CaptureScope,
+                                                   capture_schedule,
+                                                   default_payload)
+
+    problems: List[str] = []
+
+    # (a) capture parity: real algorithm vs mirrored generator.  The
+    # generator payload is bytes except lr (elements); capture always
+    # takes bytes.
+    cases = [("allreduce", "lr", 23, 23 * 8),
+             ("allreduce", "rdb", 4096, 4096),
+             ("alltoall", "pairwise", 2e5, 2e5),
+             ("alltoall", "bruck", 64, 64),
+             ("bcast", "binomial_tree", 4096, 4096)]
+    for R in (ranks, ranks + 1):
+        for op, algo, gen_pay, nbytes in cases:
+            gen = generate(op, algo, R, gen_pay)
+            cap = capture_schedule(op, algo, R,
+                                   default_payload(op, R, nbytes))
+            if cap.sequence() != gen.sequence():
+                problems.append(
+                    f"collective: {op}/{algo} R={R}: captured comm "
+                    f"sequence diverged from the generator "
+                    f"({cap.n_comms} vs {gen.n_comms} comms)")
+
+    # (b) tape vs maestro, bit-identical at every grouping
+    combos = [CollectiveSpec("allreduce", "lr", ranks - 1, "ring",
+                             64, bw=1e8),
+              CollectiveSpec("allreduce", "rdb", ranks, "nic",
+                             4096, bw=1e8),
+              CollectiveSpec("alltoall", "pairwise", ranks, "star",
+                             2e5, bw=1e8),
+              CollectiveSpec("bcast", "binomial_tree", ranks + 3,
+                             "ring", 5e5, bw=1e8)]
+    fired_acts = 0
+    for cs in combos:
+        tag = f"collective: {cs.label()}"
+        dc = cs.build()
+        sim = dc.make_sim(superstep=k)
+        sim.run()
+        if len(sim.events) != dc.n_v:
+            problems.append(f"{tag}: tape run retired "
+                            f"{len(sim.events)}/{dc.n_v} flows")
+            continue
+        ma = HostMaestro(dc)
+        ma.run()
+        clk = np.asarray(sim._coll_clk)
+        if ma.events != sim.events \
+                or ma.collective_events != sim.collective_events:
+            problems.append(f"{tag}: tape events diverged from the "
+                            f"host maestro")
+        if ma.clock != (float(clk[0]), float(clk[1])):
+            problems.append(f"{tag}: tape Kahan clock "
+                            f"{tuple(map(float, clk))!r} != maestro "
+                            f"{ma.clock!r}")
+        if ma.dispatches < 3 * max(sim.supersteps, 1):
+            problems.append(
+                f"{tag}: tape path won no dispatch advantage "
+                f"({sim.supersteps} supersteps vs {ma.dispatches} "
+                f"maestro dispatches)")
+        fired_acts += len(sim.collective_events)
+        for label, kw in [("k1", dict(superstep=1)),
+                          ("d%d" % max(depths),
+                           dict(superstep=max(2, k // 2),
+                                pipeline=max(depths)))]:
+            alt = dc.make_sim(**kw)
+            alt.run()
+            if alt.events != sim.events \
+                    or alt.collective_events != sim.collective_events:
+                problems.append(f"{tag}:{label}: regrouped tape run "
+                                f"diverged from superstep k={k}")
+    if not fired_acts:
+        problems.append("collective: no activation ever fired (the "
+                        "DAG walk was not actually tested)")
+
+    # (c) fleet sweep: batched + pipelined lanes == solo, incl. the
+    # activation stream
+    from simgrid_tpu.parallel.campaign import Campaign, ScenarioSpec
+    cs = combos[0]
+    specs = [ScenarioSpec(seed=seed, collective=cs, label="plain"),
+             ScenarioSpec(seed=seed + 1, bw_scale=0.5, collective=cs,
+                          label="bw"),
+             ScenarioSpec(seed=seed + 2, size_scale=2.0,
+                          link_scale={0: 0.25}, label="scaled")]
+    camp = Campaign.for_collective(cs, specs, fault_mode="off",
+                                   superstep=k, dtype=np.float64)
+    fleet = camp.run_batched(batch=3)
+    for j in range(3):
+        solo = camp.run_solo(j)
+        got = fleet[j]
+        if got.error or solo.error:
+            problems.append(f"collective: lane {j} errored "
+                            f"({got.error or solo.error})")
+            continue
+        if got.events != solo.events or got.t != solo.t \
+                or got.collective_events != solo.collective_events:
+            problems.append(f"collective: lane {j}: batched run "
+                            f"diverged from solo")
+    for depth in depths:
+        if not depth:
+            continue
+        piped = camp.run_batched(batch=3, pipeline=depth)
+        for j in range(3):
+            if piped[j].events != fleet[j].events \
+                    or piped[j].collective_events \
+                    != fleet[j].collective_events:
+                problems.append(f"collective: lane {j}: pipelined "
+                                f"d{depth} fleet diverged")
+                break
+
+    # (d) fault-tape composition: a link flip mid-collective
+    dc = combos[2].build()
+    base = dc.make_sim(superstep=k)
+    base.run()
+    mid = base.events[len(base.events) // 2][0]
+    # drop rank 0's uplink far below its fair share of the star core
+    # (merely shaving it would stay core-bottlenecked and move nothing)
+    bw = combos[2].bw
+    ft = (np.asarray([mid * 0.7, mid * 1.3]),
+          np.asarray([0, 0], np.int32), np.asarray([bw * 0.02, bw]))
+    simf = dc.make_sim(superstep=k, tape=ft)
+    simf.run()
+    maf = HostMaestro(dc, tape=ft)
+    maf.run()
+    clk = np.asarray(simf._coll_clk)
+    if maf.events != simf.events \
+            or maf.collective_events != simf.collective_events \
+            or maf.fault_events != simf.fault_events \
+            or maf.clock != (float(clk[0]), float(clk[1])):
+        problems.append("collective:fault: composed tape run diverged "
+                        "from the host maestro")
+    if not simf.fault_events:
+        problems.append("collective:fault: no fault event fired "
+                        "mid-collective (nothing was actually tested)")
+    if simf.events == base.events:
+        problems.append("collective:fault: the link flip never moved "
+                        "the event stream (nothing was actually tested)")
+    piped = dc.make_sim(superstep=max(2, k // 2),
+                        pipeline=max(depths) or 2, tape=ft)
+    piped.run()
+    if piped.events != simf.events \
+            or piped.fault_events != simf.fault_events:
+        problems.append("collective:fault: pipelined composed run "
+                        "diverged")
+
+    # (e) the NAS leg: a real MPI C kernel captured live end to end
+    if nas:
+        import shutil
+        import tempfile
+        if shutil.which("gcc") is None \
+                and os.environ.get("SMPI_CC") is None:
+            problems.append("collective:nas: no C compiler — the NAS "
+                            "leg cannot run (install gcc or set "
+                            "SMPI_CC)")
+            return problems
+        from simgrid_tpu.smpi.c_api import compile_program, run_c_program
+        tmp = tempfile.mkdtemp(prefix="simgrid_nas_")
+        src = os.path.join(tmp, "nas_is.c")
+        with open(src, "w") as f:
+            f.write(_NAS_IS_KERNEL)
+        so = os.path.join(tmp, "nas_is.so")
+        compile_program([src], so,
+                        extra_flags=(f"-DITERS={nas_iters}",))
+        with CaptureScope() as scope:
+            _engine, codes = run_c_program(
+                so, np_ranks=nas_ranks,
+                configs=("smpi/simulate-computation:false",))
+        if any(codes.get(r) != 0 for r in range(nas_ranks)):
+            problems.append(f"collective:nas: kernel exit codes "
+                            f"{codes} (data corrupted under capture)")
+            return problems
+        if scope.n_phases != 2 * nas_iters:
+            problems.append(f"collective:nas: captured "
+                            f"{scope.n_phases} collective phases, "
+                            f"expected {2 * nas_iters}")
+        sched = scope.schedule()
+        dc = DeviceCollective(sched, Topology(nas_ranks, "nic", bw=1e8))
+        sim = dc.make_sim(superstep=4 * k)
+        sim.run()
+        if len(sim.events) != dc.n_v:
+            problems.append(f"collective:nas: tape run retired "
+                            f"{len(sim.events)}/{dc.n_v} flows")
+            return problems
+        ma = HostMaestro(dc)
+        ma.run()
+        clk = np.asarray(sim._coll_clk)
+        if ma.events != sim.events \
+                or ma.collective_events != sim.collective_events \
+                or ma.clock != (float(clk[0]), float(clk[1])):
+            problems.append("collective:nas: tape run diverged from "
+                            "the host maestro")
+        if ma.dispatches < ratio * max(sim.supersteps, 1):
+            problems.append(
+                f"collective:nas: dispatch advantage below {ratio}x "
+                f"({sim.supersteps} supersteps vs {ma.dispatches} "
+                f"maestro dispatches over {scope.n_phases} collective "
+                f"steps)")
+    return problems
+
+
 def quick_checks() -> List[str]:
     """The CI bundle: static lint + small-N instances of every runtime
     check, sized for seconds, so determinism regressions fail pytest
@@ -1119,6 +1413,8 @@ def quick_checks() -> List[str]:
     problems += check_resume_runtime(n_c=24, n_v=64, batch=3,
                                      scenarios=6, k=4, depths=(0, 2),
                                      stop_after=2)
+    problems += check_collective_runtime(ranks=5, k=4, depths=(0, 2),
+                                         nas=False)
     return problems
 
 
@@ -1193,6 +1489,23 @@ def main(argv: List[str]) -> int:
               "ScenarioPlan.solo: events, fired faults and Kahan "
               "clocks)")
         argv = [a for a in argv if a != "--runtime-resume"]
+    if "--runtime-collective" in argv:
+        problems = check_collective_runtime()
+        if problems:
+            print("check_determinism: collective runtime check FAILED:")
+            for p in problems:
+                print(f"  {p}")
+            return 1
+        print("check_determinism: collective runtime OK (schedule "
+              "tapes — captured smpi/coll.py comm sequences equal the "
+              "mirrored generators at non-power-of-two ranks; tape "
+              "runs solo/k=1/pipelined/batched/fault-composed "
+              "bit-identical to the host maestro: events, activations "
+              "and Kahan clocks, at a >= 3x dispatch advantage; and a "
+              "live-captured NAS IS kernel replayed end to end on the "
+              "tape path at >= 10x fewer dispatches per collective "
+              "step)")
+        argv = [a for a in argv if a != "--runtime-collective"]
     if "--quick" in argv:
         problems = quick_checks()
         if problems:
@@ -1202,7 +1515,7 @@ def main(argv: List[str]) -> int:
             return 1
         print("check_determinism: quick OK (lint + small-N drain + "
               "batch + pipeline + shard + phase + fault + serve + "
-              "resume runtime)")
+              "resume + collective runtime)")
         return 0
     if "--runtime-phase" in argv:
         problems = check_phase_runtime()

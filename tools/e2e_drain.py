@@ -316,7 +316,12 @@ def main() -> None:
                 # fault-tape activity (ops.lmm_drain tape=): compiled
                 # entries, mid-drain fires, speculative replays
                 "fault_tape_slots", "fault_tape_events",
-                "fault_replays", "warm_bound_restarts")
+                "fault_replays", "warm_bound_restarts",
+                # collective-tape activity (ops.lmm_drain
+                # collective=): compiled DAG slots, fired
+                # activations, speculative replays
+                "collective_tape_slots", "collective_tape_fires",
+                "collective_replays")
         phases = {}
         for name, before, after in (
                 ("build+latency", phase_marks[0], phase_marks[1]),
