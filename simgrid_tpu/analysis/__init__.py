@@ -1,0 +1,24 @@
+"""simlint — AST-based invariant checker for the simulation core.
+
+The codebase lives by invariants no test can exhaustively cover:
+bit-identical event order across every executor axis, FMA-pinned f64
+arithmetic, supersteps with <= 1 blocking fetch per dispatch, and a
+single seeded RNG discipline.  This package checks them structurally:
+
+* :mod:`.engine` — the shared analysis engine: file walker, import /
+  alias resolution (so ``import random as rnd`` cannot dodge a rule),
+  per-line suppressions (``# simlint: ignore[rule-id] -- reason``), a
+  checked-in baseline for grandfathered findings, and text/JSON
+  reporters.
+* :mod:`.rules` — the rule modules, one invariant each.
+
+Entry points: ``python tools/simlint.py`` (CLI) and
+``tools/check_determinism.py --quick`` (tier-1, via
+tests/test_determinism_lint.py).
+"""
+
+from .engine import (ALL_RULE_IDS, Finding, apply_baseline,  # noqa: F401
+                     dump_baseline, findings_to_json, format_findings,
+                     lint_paths, lint_sources, load_baseline,
+                     make_baseline)
+from .rules import ALL_RULES  # noqa: F401

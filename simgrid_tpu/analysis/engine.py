@@ -1,0 +1,543 @@
+"""The simlint engine: everything the rule modules share.
+
+A rule is an object with
+
+* ``id`` — kebab-case rule id (what suppressions and the baseline cite),
+* ``applies(relpath)`` — path gate (repo-relative, "/" separators),
+* ``check(ctx)`` — per-file pass over a parsed :class:`FileContext`,
+* optionally ``check_project(ctxs)`` — one pass over ALL parsed files,
+  for cross-file invariants (e.g. opstats counter declarations).
+
+The engine owns the pieces every rule needs and none should reimplement:
+
+Import resolution
+    :class:`ImportMap` maps local names to canonical dotted paths, so
+    ``import random as rnd`` / ``from time import time as _t`` /
+    ``from numpy import random as npr`` all resolve to the module they
+    really are.  Rules match on resolved paths, never on surface text.
+
+Traced-scope detection
+    :func:`traced_scopes` finds the jit-compiled kernel *programs*: a
+    function is a program root when its name ends in ``_program``, it
+    is decorated with ``jax.jit`` (directly or through
+    ``functools.partial(jax.jit, ...)``), or the module jits it by
+    assignment (``_f = jax.jit(f)`` / ``partial(jax.jit, ...)``(f)).
+    Nested defs (while_loop cond/body) inherit the traced scope.  The
+    jit call's ``static_argnames`` — plus int/float/bool/str-annotated
+    params, which this codebase uses for statics — are reported so
+    rules can tell traced values from trace-time constants.
+
+Suppressions
+    ``# simlint: ignore[rule-id] -- reason`` on (or immediately above)
+    a line silences that rule there.  Several ids separate with commas.
+    A suppression without a reason is itself reported
+    (``bad-suppression``): the reason string is part of the audit
+    trail, not decoration.
+
+Baseline
+    A JSON file of grandfathered findings keyed by (rule, path, code
+    snippet) with an occurrence count.  Findings covered by the
+    baseline don't fail the run; baseline entries that no longer match
+    anything are STALE and do fail it — fixed findings must leave the
+    baseline in the same commit, so it only ever shrinks.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Finding", "FileContext", "ImportMap", "Suppressions",
+    "TracedScope", "traced_scopes", "parse_source", "lint_sources",
+    "lint_paths", "iter_py_files", "format_findings",
+    "findings_to_json", "load_baseline", "dump_baseline",
+    "make_baseline", "apply_baseline", "ALL_RULE_IDS",
+]
+
+#: rule id reserved for malformed suppression comments
+BAD_SUPPRESSION = "bad-suppression"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+    rule: str
+    path: str          # repo-relative, "/" separators
+    line: int          # 1-based
+    col: int           # 0-based
+    message: str
+    snippet: str       # the stripped source line (baseline key part)
+
+    def key(self) -> Tuple[str, str, str]:
+        """The baseline identity: stable across unrelated line-number
+        shifts (rule, path, code text) — NOT the line number."""
+        return (self.rule, self.path, self.snippet)
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "snippet": self.snippet}
+
+
+# -- import / alias resolution -------------------------------------------
+
+class ImportMap:
+    """Local name -> canonical dotted module path.
+
+    Relative imports keep their leading dots (``from . import opstats``
+    binds ``opstats`` to ``.opstats``); :meth:`matches` strips them and
+    suffix-matches, so ``..ops.opstats`` still matches the canonical
+    ``simgrid_tpu.ops.opstats``.
+    """
+
+    def __init__(self, tree: ast.AST):
+        self.aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        self.aliases[alias.asname] = alias.name
+                    else:
+                        top = alias.name.split(".")[0]
+                        self.aliases[top] = top
+            elif isinstance(node, ast.ImportFrom):
+                mod = ("." * node.level) + (node.module or "")
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    self.aliases[local] = (mod + "." + alias.name
+                                           if mod else alias.name)
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted path of an expression, or None when it isn't a
+        resolvable name/attribute chain."""
+        if isinstance(node, ast.Name):
+            return self.aliases.get(node.id, node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.resolve(node.value)
+            if base is None:
+                return None
+            return base + "." + node.attr
+        return None
+
+    @staticmethod
+    def matches(dotted: Optional[str], *targets: str) -> bool:
+        """True when `dotted` names one of `targets` (exact), lives
+        inside one (prefix), or — for relative imports — is a suffix of
+        one (``..ops.opstats`` vs ``simgrid_tpu.ops.opstats``)."""
+        if not dotted:
+            return False
+        rel = dotted.lstrip(".")
+        for t in targets:
+            if dotted == t or dotted.startswith(t + "."):
+                return True
+            if rel != dotted and (t == rel or t.endswith("." + rel)
+                                  or rel.startswith(t + ".")):
+                return True
+        return False
+
+
+# -- suppressions --------------------------------------------------------
+
+_SUPPRESS = re.compile(
+    r"#\s*simlint:\s*ignore\[([A-Za-z0-9_\-, ]+)\]"
+    r"(?:\s*--\s*(\S.*))?")
+
+
+class Suppressions:
+    """Per-line ``# simlint: ignore[...] -- reason`` directives.
+
+    A directive applies to its own physical line; a directive on a
+    comment-only line also applies to the next line (so long fixes can
+    carry the suppression above them)."""
+
+    def __init__(self, source: str):
+        self.by_line: Dict[int, Tuple[set, Optional[str]]] = {}
+        self._standalone: set = set()
+        self.problems: List[Tuple[int, str]] = []
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(source).readline))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            tokens = []
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS.search(tok.string)
+            if m is None:
+                if "simlint:" in tok.string:
+                    self.problems.append(
+                        (tok.start[0],
+                         "unparseable simlint directive (expected "
+                         "'# simlint: ignore[rule-id] -- reason')"))
+                continue
+            ids = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            reason = m.group(2)
+            line = tok.start[0]
+            if reason is None or not reason.strip():
+                self.problems.append(
+                    (line, "suppression without a reason — append "
+                           "'-- <why this is safe>'"))
+            self.by_line[line] = (ids, reason)
+            if tok.line.lstrip().startswith("#"):
+                self._standalone.add(line)
+
+    def covers(self, rule: str, line: int) -> bool:
+        for cand in (line, line - 1):
+            entry = self.by_line.get(cand)
+            if entry is None:
+                continue
+            if cand == line - 1 and cand not in self._standalone:
+                continue
+            if rule in entry[0]:
+                return True
+        return False
+
+
+# -- traced (jit-compiled) scope detection -------------------------------
+
+_JIT_TARGETS = ("jax.jit", "jax.pjit", "jax.experimental.pjit.pjit",
+                "jit", "pjit")
+_STATIC_ANNOTATIONS = {"int", "float", "bool", "str"}
+
+
+@dataclass
+class TracedScope:
+    """One function whose body is traced by jax.jit (a kernel
+    *program*), plus which of its params are trace-time statics."""
+    node: ast.AST                   # FunctionDef | Lambda
+    static_params: set = field(default_factory=set)
+    root: bool = True               # False for nested defs
+
+
+def _is_partial_of_jit(node: ast.AST, imap: ImportMap) -> bool:
+    """``functools.partial(jax.jit, ...)`` (the jit-by-assignment
+    idiom the kernel programs use)."""
+    return (isinstance(node, ast.Call)
+            and ImportMap.matches(imap.resolve(node.func),
+                                  "functools.partial", "partial")
+            and len(node.args) >= 1
+            and ImportMap.matches(imap.resolve(node.args[0]),
+                                  *_JIT_TARGETS))
+
+
+def _static_argnames(call: ast.Call) -> set:
+    out: set = set()
+    for kw in call.keywords:
+        if kw.arg in ("static_argnames", "static_argnums"):
+            if isinstance(kw.value, (ast.Tuple, ast.List)):
+                for elt in kw.value.elts:
+                    if isinstance(elt, ast.Constant) \
+                            and isinstance(elt.value, str):
+                        out.add(elt.value)
+            elif isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                out.add(kw.value.value)
+    return out
+
+
+def _annotated_statics(fn: ast.AST) -> set:
+    out = set()
+    args = getattr(fn, "args", None)
+    if args is None:
+        return out
+    for a in (args.posonlyargs + args.args + args.kwonlyargs):
+        ann = a.annotation
+        if isinstance(ann, ast.Name) and ann.id in _STATIC_ANNOTATIONS:
+            out.add(a.arg)
+    return out
+
+
+def traced_scopes(tree: ast.AST,
+                  imap: ImportMap) -> Dict[ast.AST, TracedScope]:
+    """Every function whose body jax traces, mapped to its scope info.
+
+    Roots: ``*_program`` functions, jit-decorated functions, and
+    functions handed to ``jax.jit(...)`` / ``partial(jax.jit, ...)``
+    anywhere in the module.  Every def nested inside a root (while_loop
+    cond/body closures) is traced too, marked ``root=False``."""
+    jitted_names: Dict[str, set] = {}        # fn name -> static names
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        statics: Optional[set] = None
+        if ImportMap.matches(imap.resolve(node.func), *_JIT_TARGETS):
+            statics = _static_argnames(node)
+        elif _is_partial_of_jit(node.func, imap):
+            statics = _static_argnames(node.func)
+        if statics is None:
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Name):
+                jitted_names.setdefault(arg.id, set()).update(statics)
+
+    scopes: Dict[ast.AST, TracedScope] = {}
+
+    def visit(node: ast.AST, inside: bool) -> None:
+        is_fn = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        here = inside
+        if is_fn:
+            statics = set()
+            root = False
+            if node.name.endswith("_program"):
+                root = True
+            if node.name in jitted_names:
+                root = True
+                statics |= jitted_names[node.name]
+            for dec in node.decorator_list:
+                if ImportMap.matches(imap.resolve(dec), *_JIT_TARGETS):
+                    root = True
+                elif isinstance(dec, ast.Call) and (
+                        ImportMap.matches(imap.resolve(dec.func),
+                                          *_JIT_TARGETS)
+                        or _is_partial_of_jit(dec, imap)):
+                    root = True
+                    statics |= _static_argnames(dec)
+                elif _is_partial_of_jit(dec, imap):
+                    root = True
+                    statics |= _static_argnames(dec)
+            if root or inside:
+                statics |= _annotated_statics(node)
+                scopes[node] = TracedScope(node, statics,
+                                           root=root and not inside)
+                here = True
+        for child in ast.iter_child_nodes(node):
+            visit(child, here)
+
+    visit(tree, False)
+    return scopes
+
+
+# -- per-file context ----------------------------------------------------
+
+class FileContext:
+    """One parsed source file plus the engine services rules consume."""
+
+    def __init__(self, relpath: str, source: str):
+        self.path = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        self.imports = ImportMap(self.tree)
+        self.suppressions = Suppressions(source)
+        self._traced: Optional[Dict[ast.AST, TracedScope]] = None
+
+    @property
+    def traced(self) -> Dict[ast.AST, TracedScope]:
+        if self._traced is None:
+            self._traced = traced_scopes(self.tree, self.imports)
+        return self._traced
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule, self.path, line, col, message,
+                       self.snippet(line))
+
+
+def parse_source(relpath: str, source: str) -> Optional[FileContext]:
+    try:
+        return FileContext(relpath, source)
+    except SyntaxError:
+        return None
+
+
+# -- running -------------------------------------------------------------
+
+def iter_py_files(root: str,
+                  paths: Sequence[str]) -> Iterable[Tuple[str, str]]:
+    """(relpath, abspath) for every .py under root-relative `paths`
+    (files or directories), sorted for stable reports."""
+    out = []
+    for p in paths:
+        top = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(top) and top.endswith(".py"):
+            out.append(os.path.relpath(top, root))
+            continue
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames.sort()
+            if "__pycache__" in dirnames:
+                dirnames.remove("__pycache__")
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.relpath(
+                        os.path.join(dirpath, fn), root))
+    for rel in sorted(set(out)):
+        yield rel.replace(os.sep, "/"), os.path.join(root, rel)
+
+
+def _run_rules(ctxs: List[FileContext], rules) -> List[Finding]:
+    findings: List[Finding] = []
+    for ctx in ctxs:
+        for line, msg in ctx.suppressions.problems:
+            findings.append(Finding(BAD_SUPPRESSION, ctx.path, line, 0,
+                                    msg, ctx.snippet(line)))
+        for rule in rules:
+            if not rule.applies(ctx.path):
+                continue
+            for f in rule.check(ctx):
+                if not ctx.suppressions.covers(f.rule, f.line):
+                    findings.append(f)
+    by_path = {c.path: c for c in ctxs}
+    for rule in rules:
+        check_project = getattr(rule, "check_project", None)
+        if check_project is None:
+            continue
+        for f in check_project(ctxs):
+            ctx = by_path.get(f.path)
+            if ctx is not None \
+                    and ctx.suppressions.covers(f.rule, f.line):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_sources(sources: Dict[str, str], rules=None) -> List[Finding]:
+    """Lint in-memory {relpath: source} — the fixture-test entry point."""
+    if rules is None:
+        from .rules import ALL_RULES as rules
+    ctxs = []
+    for rel, src in sorted(sources.items()):
+        ctx = parse_source(rel, src)
+        if ctx is not None:
+            ctxs.append(ctx)
+    return _run_rules(ctxs, rules)
+
+
+def lint_paths(root: str, paths: Sequence[str],
+               rules=None) -> List[Finding]:
+    """Lint .py files under root-relative `paths` with `rules`
+    (default: every registered rule)."""
+    if rules is None:
+        from .rules import ALL_RULES as rules
+    ctxs = []
+    for rel, abspath in iter_py_files(root, paths):
+        try:
+            with open(abspath, encoding="utf-8") as f:
+                src = f.read()
+        except OSError:
+            continue
+        ctx = parse_source(rel, src)
+        if ctx is not None:
+            ctxs.append(ctx)
+    return _run_rules(ctxs, rules)
+
+
+# -- baseline ------------------------------------------------------------
+
+BASELINE_VERSION = 1
+
+
+def make_baseline(findings: Sequence[Finding]) -> dict:
+    counts: Dict[Tuple[str, str, str], int] = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    return {
+        "version": BASELINE_VERSION,
+        "entries": [{"rule": r, "path": p, "snippet": s, "count": n}
+                    for (r, p, s), n in sorted(counts.items())],
+    }
+
+
+def dump_baseline(baseline: dict, path: str) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(baseline, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def load_baseline(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(f"unsupported baseline version "
+                         f"{data.get('version')!r} in {path}")
+    return data
+
+
+def apply_baseline(findings: Sequence[Finding], baseline: Optional[dict]
+                   ) -> Tuple[List[Finding], List[dict]]:
+    """(new findings, stale baseline entries).
+
+    The first `count` findings matching a baseline entry are
+    grandfathered; extras are new.  Entries matching nothing are stale
+    — a fixed finding must be removed from the baseline too."""
+    if not baseline:
+        return list(findings), []
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for e in baseline.get("entries", []):
+        budget[(e["rule"], e["path"], e["snippet"])] = e.get("count", 1)
+    seen: Dict[Tuple[str, str, str], int] = {}
+    new: List[Finding] = []
+    for f in findings:
+        k = f.key()
+        seen[k] = seen.get(k, 0) + 1
+        if seen.get(k, 0) > budget.get(k, 0):
+            new.append(f)
+    stale = [{"rule": r, "path": p, "snippet": s, "count": n,
+              "matched": seen.get((r, p, s), 0)}
+             for (r, p, s), n in sorted(budget.items())
+             if seen.get((r, p, s), 0) < n]
+    return new, stale
+
+
+# -- reporters -----------------------------------------------------------
+
+def format_findings(findings: Sequence[Finding],
+                    stale: Sequence[dict] = ()) -> str:
+    out = []
+    for f in findings:
+        out.append(f"{f.path}:{f.line}:{f.col + 1}: [{f.rule}] "
+                   f"{f.message}")
+        if f.snippet:
+            out.append(f"    {f.snippet}")
+    for e in stale:
+        out.append(f"{e['path']}: [stale-baseline] {e['rule']} entry "
+                   f"matched {e['matched']}/{e['count']} finding(s) — "
+                   f"remove it from the baseline: {e['snippet']!r}")
+    return "\n".join(out)
+
+
+def findings_to_json(findings: Sequence[Finding],
+                     stale: Sequence[dict] = (),
+                     baselined: int = 0) -> str:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return json.dumps({
+        "findings": [f.to_dict() for f in findings],
+        "stale_baseline": list(stale),
+        "baselined": baselined,
+        "counts": counts,
+        "ok": not findings and not stale,
+    }, indent=1, sort_keys=True)
+
+
+def _rule_ids():
+    from .rules import ALL_RULES
+    return [r.id for r in ALL_RULES] + [BAD_SUPPRESSION]
+
+
+class _RuleIds:
+    def __iter__(self):
+        return iter(_rule_ids())
+
+    def __contains__(self, item):
+        return item in _rule_ids()
+
+
+#: lazily-evaluated registry view (avoids an import cycle with .rules)
+ALL_RULE_IDS = _RuleIds()

@@ -1,0 +1,72 @@
+"""The simlint rule registry.
+
+Each module ships one rule instance; ``ALL_RULES`` is what the CLI and
+``check_determinism.py --quick`` run.  Path scopes live here so the
+rule modules and the docs agree on exactly which files each invariant
+governs.
+"""
+
+from __future__ import annotations
+
+#: packages whose randomness/clock discipline is absolute: every RNG
+#: through utils/rngstream, no wall clock (monotonic perf_counter is
+#: allowed — it feeds opstats timing and never orders events)
+CORE_RNG_DIRS = (
+    "simgrid_tpu/kernel/", "simgrid_tpu/ops/", "simgrid_tpu/faults/",
+    "simgrid_tpu/serving/", "simgrid_tpu/collectives/",
+    "simgrid_tpu/parallel/",
+)
+
+#: benchmark/campaign drivers: seeded np.random generators are allowed
+#: (scenario construction), the global RNGs and the wall clock are not
+DRIVER_RNG_FILES = (
+    "tools/campaign_run.py", "tools/campaign_serve.py",
+    "tools/e2e_drain.py",
+)
+
+#: the jit-compiled kernel program files: FMA pinning and dtype
+#: discipline are per-expression properties here
+KERNEL_FILES = (
+    "simgrid_tpu/ops/lmm_drain.py", "simgrid_tpu/ops/lmm_batch.py",
+    "simgrid_tpu/ops/lmm_jax.py", "simgrid_tpu/ops/lmm_warm.py",
+    "simgrid_tpu/collectives/tape.py",
+)
+
+#: the issue/collect seam: host code between dispatches where a bare
+#: np.asarray / .item() on a device array is a silent blocking fetch
+SEAM_FILES = KERNEL_FILES + (
+    "simgrid_tpu/collectives/maestro.py",
+    "simgrid_tpu/serving/service.py",
+    "simgrid_tpu/parallel/campaign.py",
+)
+
+#: files that feed flattening slot assignment, ring demux or event
+#: commitment: iteration order here IS simulation event order
+ORDER_FILES = (
+    "simgrid_tpu/ops/lmm_view.py", "simgrid_tpu/ops/drain_path.py",
+    "simgrid_tpu/ops/lmm_batch.py", "simgrid_tpu/ops/lmm_warm.py",
+    "simgrid_tpu/parallel/campaign.py",
+    "simgrid_tpu/collectives/schedule.py",
+    "simgrid_tpu/collectives/tape.py",
+    "simgrid_tpu/faults/campaign.py",
+    "simgrid_tpu/serving/service.py",
+)
+
+from .wallclock_rng import WallclockRngRule          # noqa: E402
+from .fma_hazard import FmaHazardRule                # noqa: E402
+from .host_sync import HiddenHostSyncRule            # noqa: E402
+from .dtype_discipline import DtypeDisciplineRule    # noqa: E402
+from .unordered_iter import UnorderedIterationRule   # noqa: E402
+from .opstats_discipline import OpstatsDisciplineRule  # noqa: E402
+
+ALL_RULES = (
+    WallclockRngRule(),
+    FmaHazardRule(),
+    HiddenHostSyncRule(),
+    DtypeDisciplineRule(),
+    UnorderedIterationRule(),
+    OpstatsDisciplineRule(),
+)
+
+__all__ = ["ALL_RULES", "CORE_RNG_DIRS", "DRIVER_RNG_FILES",
+           "KERNEL_FILES", "SEAM_FILES", "ORDER_FILES"]

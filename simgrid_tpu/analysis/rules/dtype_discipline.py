@@ -1,0 +1,116 @@
+"""Rule ``dtype-discipline`` — no implicit dtypes on the f64 event path.
+
+Event ordering in the kernels is decided by f64 comparisons
+(``remains / rate`` vs the drain horizon); a single f32 intermediate
+reorders events and breaks the bit-identity oracles.  JAX makes this
+easy to do by accident:
+
+* ``jnp.zeros(n)`` / ``jnp.arange(k)`` *et al.* without ``dtype=``
+  pick the default dtype, which depends on the ``jax_enable_x64``
+  flag — trace-environment state, not code.
+* ``jnp.asarray(False)`` / ``jnp.asarray(0.5)`` on scalar or literal
+  arguments produce *weak-typed* values whose final dtype is decided
+  by whatever they later touch (silent promotion).  Array arguments
+  are fine — passthrough preserves the operand dtype.
+* explicit ``float32`` constructions inside the kernel files put an
+  f32 value one arithmetic op away from the f64 state.
+
+The rule flags all three, file-wide, in the KERNEL_FILES only.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..engine import FileContext, Finding, ImportMap
+from . import KERNEL_FILES
+
+#: jnp constructors whose dtype must be spelled out, mapped to the
+#: positional index where dtype may also legally appear
+#: (``jnp.zeros(n, bool)`` is explicit — arg 1 IS the dtype)
+_CREATORS = {"zeros": 1, "ones": 1, "empty": 1, "full": 2,
+             "arange": None, "linspace": None, "eye": None}
+
+#: constructors where only literal/scalar args are a hazard; dtype may
+#: be the second positional (``jnp.asarray(0, jnp.int32)``)
+_CASTERS = {"asarray": 1, "array": 1}
+
+
+def _is_literal(node: ast.AST) -> bool:
+    """Scalar or container literal — the weak-typing hazard cases."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.UnaryOp):
+        return _is_literal(node.operand)
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return all(_is_literal(e) for e in node.elts)
+    return False
+
+
+class DtypeDisciplineRule:
+    id = "dtype-discipline"
+    doc = "explicit dtypes on the f64 event-ordering path"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath in KERNEL_FILES
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        imap = ctx.imports
+        out: List[Finding] = []
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = imap.resolve(node.func)
+            if dotted is None:
+                continue
+            kwargs = {kw.arg for kw in node.keywords}
+
+            if ImportMap.matches(dotted, "jax.numpy"):
+                leaf = dotted.split(".")[-1]
+
+                def has_dtype(pos) -> bool:
+                    return ("dtype" in kwargs
+                            or (pos is not None
+                                and len(node.args) > pos))
+
+                if leaf in _CREATORS \
+                        and not has_dtype(_CREATORS[leaf]):
+                    out.append(ctx.finding(
+                        self.id, node,
+                        f"jnp.{leaf} without dtype= takes the ambient "
+                        f"default (jax_enable_x64 state) — spell the "
+                        f"dtype out on the f64 event path"))
+                elif leaf in _CASTERS \
+                        and not has_dtype(_CASTERS[leaf]) \
+                        and node.args and _is_literal(node.args[0]):
+                    out.append(ctx.finding(
+                        self.id, node,
+                        f"jnp.{leaf} on a literal without dtype= is "
+                        f"weak-typed — its final dtype is decided by "
+                        f"later promotion, not here; spell it out"))
+                elif leaf in ("float32", "bfloat16", "float16"):
+                    out.append(ctx.finding(
+                        self.id, node,
+                        f"{leaf} construction in a kernel file: one "
+                        f"arithmetic op away from contaminating the "
+                        f"f64 event-ordering state"))
+
+            # dtype=<float32> keywords, whatever the constructor
+            for kw in node.keywords:
+                if kw.arg != "dtype":
+                    continue
+                v = kw.value
+                vname = (imap.resolve(v) or "") if isinstance(
+                    v, (ast.Name, ast.Attribute)) else (
+                    v.value if isinstance(v, ast.Constant)
+                    and isinstance(v.value, str) else "")
+                if vname and vname.split(".")[-1] in (
+                        "float32", "bfloat16", "float16"):
+                    out.append(ctx.finding(
+                        self.id, kw.value,
+                        f"dtype={vname.split('.')[-1]} in a kernel "
+                        f"file: sub-f64 precision on or near the "
+                        f"event-ordering path"))
+        return out

@@ -1,0 +1,137 @@
+"""Rule ``fma-hazard`` — unpinned multiply-add chains in kernel programs.
+
+XLA:CPU's LLVM backend contracts ``a - b*c`` / ``a + b*c`` into FMAs
+no matter how the HLO is structured, so the product is never rounded
+to f64 before the add consumes it — and the chained remains walk
+drifts a ulp per advance from the host engine (the PR 7 bug class).
+The codebase pins such products with ``_rounded_product(b, c,
+zero_bits)``, which routes the product's bits through a traced integer
+add the compiler cannot fold.
+
+This rule flags ``x ± y*z`` (either operand order) inside traced
+kernel-program scopes of the KERNEL_FILES, unless the multiply is
+already wrapped (a call — e.g. ``_rounded_product`` — is not a bare
+``*``) or the arithmetic is integer-looking (any integer constant
+leaf, or every name leaf matching the index-naming convention
+``n_*/i/j/k/*_idx/*_pos/...`` — slot math never carries f64 state).
+
+Near-misses that stay clean: ``a - b`` (no product), ``rem -
+_rounded_product(rate, dt, zb)`` (pinned), ``pos*group + j`` on index
+names (integer math).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from ..engine import FileContext, Finding
+from . import KERNEL_FILES
+
+#: names that denote integer slot/index/count math, where FMA
+#: contraction cannot exist (integer ops have no fused form)
+_INTY = re.compile(
+    r"^(n|i|j|k|m|idx|pos|slot|cnt|count|num|size|len|adv|rounds?|"
+    r"group|chunk|cap|half|level|step|stride|off|offset|shape|dim|"
+    r"ring_n|t)$|(_idx|_pos|_slot|_count|_n|_id|_ids|_bits)$|"
+    r"^(n|k|idx|pos|slot|num)_")
+
+
+def _int_looking(node: ast.AST) -> Optional[bool]:
+    """True: certainly integer math.  False: certainly float math.
+    None: can't tell (treated as float — the rule errs toward
+    reporting inside kernel programs; suppressions carry the rest)."""
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, bool):
+            return True
+        if isinstance(node.value, int):
+            return True
+        if isinstance(node.value, float):
+            return False
+        return None
+    if isinstance(node, ast.Name):
+        return True if _INTY.search(node.id) else None
+    if isinstance(node, ast.UnaryOp):
+        return _int_looking(node.operand)
+    if isinstance(node, ast.Attribute):
+        return True if _INTY.search(node.attr) else None
+    if isinstance(node, ast.Call):
+        fn = node.func
+        leaf = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else "")
+        if leaf in ("len", "count_nonzero", "astype", "sum", "cumsum",
+                    "searchsorted", "argmin", "argmax", "int32",
+                    "int64", "int_", "arange", "flatnonzero"):
+            # .astype(...) of what? integer when the dtype arg is
+            if leaf == "astype" and node.args:
+                a = node.args[0]
+                name = (a.attr if isinstance(a, ast.Attribute)
+                        else a.id if isinstance(a, ast.Name) else "")
+                return "int" in name or "bool" in name or None
+            return True
+        return None
+    return None
+
+
+def _binop_is_int(node: ast.BinOp) -> bool:
+    """A ± b*c is integer slot math when any leaf is certainly int and
+    no leaf is certainly float."""
+    leaves: List[ast.AST] = []
+
+    def collect(n):
+        if isinstance(n, ast.BinOp):
+            collect(n.left)
+            collect(n.right)
+        else:
+            leaves.append(n)
+
+    collect(node)
+    verdicts = [_int_looking(n) for n in leaves]
+    return any(v is True for v in verdicts) \
+        and not any(v is False for v in verdicts)
+
+
+class FmaHazardRule:
+    id = "fma-hazard"
+    doc = "a ± b*c on f64 state must go through _rounded_product"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath in KERNEL_FILES
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        out: List[Finding] = []
+        traced = ctx.traced
+        if not traced:
+            return out
+        spans = [(t.node.lineno, max(getattr(t.node, "end_lineno", 0)
+                                     or t.node.lineno, t.node.lineno))
+                 for t in traced.values()]
+
+        def in_traced(node: ast.AST) -> bool:
+            ln = getattr(node, "lineno", None)
+            return ln is not None and any(a <= ln <= b
+                                          for a, b in spans)
+
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, (ast.Add, ast.Sub))):
+                continue
+            if not in_traced(node):
+                continue
+            mults = [s for s in (node.left, node.right)
+                     if isinstance(s, ast.BinOp)
+                     and isinstance(s.op, ast.Mult)]
+            if not mults:
+                continue
+            if _binop_is_int(node):
+                continue
+            op = "-" if isinstance(node.op, ast.Sub) else "+"
+            out.append(ctx.finding(
+                self.id, node,
+                f"bare multiply feeding '{op}' in a jitted kernel "
+                f"program: XLA may contract it into an FMA and skip "
+                f"the f64 rounding of the product — route it through "
+                f"_rounded_product(a, b, zero_bits) (or suppress if "
+                f"provably not on the f64 event-ordering path)"))
+        return out

@@ -1,0 +1,168 @@
+"""Rule ``hidden-host-sync`` — device→host round trips that dodge the
+audited fetch path.
+
+The superstep contract is <= 1 blocking fetch per dispatch, and every
+one of them goes through :func:`opstats.timed_fetch` so the blocking /
+overlap accounting stays truthful.  Two ways code silently breaks that:
+
+* **inside a traced program** — a host coercion (``float(x)`` /
+  ``int(x)`` / ``bool(x)`` / ``len(x)`` / ``x.item()``), a numpy call
+  on a traced value, or a Python ``if``/``while`` on a traced
+  parameter.  Under jit these either force a trace-time concretization
+  or silently bake a constant into the compiled program.
+* **at the issue/collect seam** — a bare single-argument
+  ``np.asarray(device_arr)`` / ``np.array(device_arr)``, ``.item()``
+  or ``jax.device_get`` on host code in the seam files.  Each is a
+  synchronous transfer that bypasses the ``fetches`` /
+  ``blocking_fetches`` / ``host_block_ms`` counters.
+
+Host-side array *normalization* (``np.asarray(x, dtype=...)`` with an
+explicit dtype, or literal arguments) is not flagged — a dtype keyword
+marks intent and the common device-array case is the bare spelling.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..engine import FileContext, Finding, ImportMap, TracedScope
+from . import SEAM_FILES
+
+#: numpy attributes that are trace-time constants / dtype handles, fine
+#: to touch inside a jitted program
+_NP_CONST_OK = frozenset({
+    "float64", "float32", "float16", "int64", "int32", "int16", "int8",
+    "uint8", "uint16", "uint32", "uint64", "bool_", "intp",
+    "finfo", "iinfo", "dtype", "inf", "nan", "pi", "e", "newaxis",
+})
+
+_COERCERS = ("float", "int", "bool", "len")
+
+
+def _scope_spans(ctx: FileContext
+                 ) -> List[Tuple[int, int, TracedScope]]:
+    spans = []
+    for scope in ctx.traced.values():
+        node = scope.node
+        end = getattr(node, "end_lineno", None) or node.lineno
+        spans.append((node.lineno, max(end, node.lineno), scope))
+    return spans
+
+
+def _covering(spans, line: int) -> List[TracedScope]:
+    return [s for a, b, s in spans if a <= line <= b]
+
+
+class HiddenHostSyncRule:
+    id = "hidden-host-sync"
+    doc = "device->host syncs must go through opstats.timed_fetch"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath in SEAM_FILES
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        imap = ctx.imports
+        spans = _scope_spans(ctx)
+        out: Dict[Tuple[int, int, str], Finding] = {}
+
+        def hit(node, msg):
+            f = ctx.finding(self.id, node, msg)
+            out.setdefault((f.line, f.col, msg), f)
+
+        def statics_at(line: int) -> set:
+            names: set = set()
+            for s in _covering(spans, line):
+                names |= s.static_params
+            return names
+
+        def traced_params_at(line: int) -> set:
+            """Non-static parameter names of the scopes covering
+            `line` — the values jax traces."""
+            names: set = set()
+            for s in _covering(spans, line):
+                args = getattr(s.node, "args", None)
+                if args is None:
+                    continue
+                for a in (args.posonlyargs + args.args
+                          + args.kwonlyargs):
+                    names.add(a.arg)
+            return names - statics_at(line)
+
+        for node in ast.walk(ctx.tree):
+            line = getattr(node, "lineno", None)
+            if line is None:
+                continue
+            traced = bool(_covering(spans, line))
+
+            if isinstance(node, ast.Call):
+                fn = node.func
+                dotted = imap.resolve(fn)
+
+                # .item() — a scalar transfer wherever it appears
+                if isinstance(fn, ast.Attribute) and fn.attr == "item" \
+                        and not node.args:
+                    hit(node, "'.item()' is a synchronous device->host "
+                              "scalar transfer — inside a program it "
+                              "concretizes the trace; at the seam, "
+                              "fetch through opstats.timed_fetch and "
+                              "index on host")
+                    continue
+
+                if traced:
+                    if ImportMap.matches(dotted, "numpy"):
+                        leaf = dotted.split(".")[-1]
+                        if leaf not in _NP_CONST_OK:
+                            hit(node,
+                                f"numpy call {dotted!r} inside a "
+                                f"jitted program runs on host at "
+                                f"trace time — use jnp (traced) or "
+                                f"hoist it out as a static")
+                    elif dotted in _COERCERS and node.args \
+                            and not isinstance(node.args[0],
+                                               ast.Constant) \
+                            and not (isinstance(node.args[0], ast.Name)
+                                     and node.args[0].id
+                                     in statics_at(line)):
+                        hit(node,
+                            f"'{dotted}()' on a traced value forces a "
+                            f"host concretization inside the program "
+                            f"— keep it as a jnp array or mark the "
+                            f"argument static")
+                    continue
+
+                # host seam checks
+                if ImportMap.matches(dotted, "numpy.asarray",
+                                     "numpy.array"):
+                    if len(node.args) == 1 and not node.keywords \
+                            and not isinstance(node.args[0],
+                                               (ast.Constant, ast.List,
+                                                ast.Tuple)):
+                        hit(node,
+                            "bare single-argument np.asarray/np.array "
+                            "at the issue/collect seam is a silent "
+                            "blocking device->host fetch — route it "
+                            "through opstats.timed_fetch (or pass an "
+                            "explicit dtype for host normalization)")
+                elif ImportMap.matches(dotted, "jax.device_get"):
+                    hit(node,
+                        "jax.device_get bypasses the fetch "
+                        "accounting — route it through "
+                        "opstats.timed_fetch")
+
+            elif isinstance(node, (ast.If, ast.While)) and traced:
+                hot = traced_params_at(line)
+                test_names = {n.id for n in ast.walk(node.test)
+                              if isinstance(n, ast.Name)}
+                used = sorted(test_names & hot)
+                if used:
+                    kw = "while" if isinstance(node, ast.While) \
+                        else "if"
+                    hit(node.test,
+                        f"Python '{kw}' on traced parameter(s) "
+                        f"{', '.join(used)} inside a jitted program "
+                        f"— this concretizes (or silently "
+                        f"constant-folds) the trace; use lax.cond / "
+                        f"jnp.where or mark the parameter static")
+
+        return list(out.values())

@@ -1,0 +1,119 @@
+"""Rule ``unordered-iteration`` — iteration order that becomes event
+order must be pinned.
+
+The ORDER_FILES feed three order-sensitive machines: the flattening's
+slot assignment, the completion-ring demux, and event commitment.  A
+``for`` over a set there picks an arbitrary (hash-seeded) order; a
+``for`` over a dict is insertion-ordered — deterministic, but only as
+long as every *insertion* site stays deterministic, which is an
+argument someone has to actually make.
+
+So: iterating a set (literal, comprehension, ``set()``/``frozenset()``
+call, or a local assigned from one) is flagged outright; iterating a
+dict or dict view (``.keys()`` / ``.values()`` / ``.items()``, or a
+local assigned from a dict display) is flagged unless wrapped in
+``sorted(...)`` — and the correct resolution for insertion-ordered
+dicts is usually a suppression *with the insertion-order argument
+written down*, NOT a ``sorted()`` that would change the committed
+event order.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from ..engine import FileContext, Finding, ImportMap
+from . import ORDER_FILES
+
+_DICT_VIEWS = ("keys", "values", "items")
+
+
+def _local_kinds(tree: ast.AST, imap: ImportMap) -> Dict[str, str]:
+    """name -> 'set' | 'dict' for locals assigned an unordered (or
+    insertion-ordered) container display/constructor."""
+    kinds: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        kind: Optional[str] = None
+        if isinstance(value, (ast.Set, ast.SetComp)):
+            kind = "set"
+        elif isinstance(value, (ast.Dict, ast.DictComp)):
+            kind = "dict"
+        elif isinstance(value, ast.Call):
+            fn = imap.resolve(value.func)
+            if ImportMap.matches(fn, "set", "frozenset"):
+                kind = "set"
+            elif ImportMap.matches(fn, "dict",
+                                   "collections.defaultdict"):
+                kind = "dict"
+        if kind is None:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                kinds[t.id] = kind
+    return kinds
+
+
+class UnorderedIterationRule:
+    id = "unordered-iteration"
+    doc = "set/dict iteration feeding event order must be pinned"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath in ORDER_FILES
+
+    def _classify(self, node: ast.AST, imap: ImportMap,
+                  kinds: Dict[str, str]) -> Optional[str]:
+        """What unordered thing `node` iterates, or None."""
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return "a set literal"
+        if isinstance(node, ast.Call):
+            fn = node.func
+            dotted = imap.resolve(fn)
+            if ImportMap.matches(dotted, "sorted"):
+                return None                      # pinned
+            if ImportMap.matches(dotted, "set", "frozenset"):
+                return "a set"
+            if isinstance(fn, ast.Attribute) \
+                    and fn.attr in _DICT_VIEWS and not node.args:
+                return f"a dict .{fn.attr}() view"
+        if isinstance(node, ast.Name):
+            kind = kinds.get(node.id)
+            if kind == "set":
+                return f"the set {node.id!r}"
+            if kind == "dict":
+                return f"the dict {node.id!r}"
+        return None
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        kinds = _local_kinds(ctx.tree, ctx.imports)
+        out: List[Finding] = []
+
+        def hit(iter_node, what):
+            sety = "set" in what
+            fix = ("wrap in sorted(...)" if sety else
+                   "suppress with the written argument that every "
+                   "insertion site is deterministic (sorted() here "
+                   "would CHANGE committed event order), or sort if "
+                   "this is new code")
+            out.append(ctx.finding(
+                self.id, iter_node,
+                f"iterating {what} where iteration order feeds slot "
+                f"assignment / ring demux / event commitment — {fix}"))
+
+        for node in ast.walk(ctx.tree):
+            iters: List[ast.AST] = []
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                iters.append(node.iter)
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.GeneratorExp, ast.DictComp)):
+                iters.extend(gen.iter for gen in node.generators)
+            for it in iters:
+                what = self._classify(it, ctx.imports, kinds)
+                if what is not None:
+                    hit(it, what)
+        return out

@@ -1,0 +1,179 @@
+"""Rule ``wallclock-rng`` — no wall clock, no global RNG.
+
+The successor of the old regex lint in tools/check_determinism.py,
+which ``from time import time`` or ``import random as rnd`` walked
+straight past.  This rule works on resolved import paths, so aliases
+can't dodge it, and it additionally catches the getattr/import_module
+escapes.
+
+Two strictness tiers:
+
+* **core** (CORE_RNG_DIRS): any reference into the ``random``,
+  ``numpy.random`` or ``jax.random`` modules is a finding — ALL
+  randomness in the simulation core goes through the seeded
+  ``utils/rngstream``.  Wall-clock reads (``time.time``,
+  ``datetime.now`` and friends) are findings; the monotonic
+  ``time.perf_counter``/``monotonic`` are allowed (they feed opstats
+  timing and can never order simulation events).
+* **driver** (DRIVER_RNG_FILES): benchmark/campaign drivers may build
+  scenarios with explicitly seeded generators
+  (``np.random.default_rng(seed)`` / ``Generator`` / ``SeedSequence``
+  with arguments), but the stdlib ``random`` module, the legacy numpy
+  global RNG (``np.random.seed/rand/...``), UNSEEDED constructors and
+  the wall clock are findings.  Intentional wall-clock timing must
+  carry an inline suppression with a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from ..engine import FileContext, Finding, ImportMap
+from . import CORE_RNG_DIRS, DRIVER_RNG_FILES
+
+#: module roots that hold global/ambient randomness
+RNG_MODULES = ("random", "numpy.random", "jax.random")
+
+#: wall-clock reads (module-qualified); monotonic clocks are absent on
+#: purpose — perf_counter/monotonic are the blessed timing sources
+WALLCLOCK = (
+    "time.time", "time.time_ns", "time.ctime", "time.localtime",
+    "time.gmtime", "time.strftime", "time.asctime",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+    # the module-attribute spellings the old regex lint matched on
+    "datetime.now", "datetime.utcnow", "datetime.today",
+)
+
+#: other ambient-entropy sources nothing in the repo should touch
+ENTROPY = ("os.urandom", "uuid.uuid1", "uuid.uuid4", "secrets")
+
+#: constructors that are fine in driver scope WHEN seeded (args given)
+SEEDED_OK = ("numpy.random.default_rng", "numpy.random.Generator",
+             "numpy.random.SeedSequence", "numpy.random.PCG64",
+             "numpy.random.Philox")
+
+
+class WallclockRngRule:
+    id = "wallclock-rng"
+    doc = "no wall clock, no global RNG (seeded rngstream only)"
+
+    def applies(self, relpath: str) -> bool:
+        return (relpath.startswith(CORE_RNG_DIRS)
+                or relpath in DRIVER_RNG_FILES)
+
+    # -- helpers ---------------------------------------------------------
+
+    @staticmethod
+    def _banned_import(node: ast.AST) -> Iterator[str]:
+        """Module paths a plain import statement drags in that are
+        banned outright (the alias-proof half: the binding itself is
+        the finding, whatever name it hides behind)."""
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if ImportMap.matches(alias.name, *RNG_MODULES) \
+                        or ImportMap.matches(alias.name, "secrets"):
+                    yield alias.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            mod = node.module
+            if ImportMap.matches(mod, *RNG_MODULES) \
+                    or ImportMap.matches(mod, "secrets"):
+                yield mod
+            else:
+                for alias in node.names:
+                    full = mod + "." + alias.name
+                    if full in WALLCLOCK or full in ENTROPY \
+                            or ImportMap.matches(full, *RNG_MODULES):
+                        yield full
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        strict = ctx.path.startswith(CORE_RNG_DIRS)
+        imap = ctx.imports
+        out: List[Finding] = []
+
+        def hit(node, what, why):
+            out.append(ctx.finding(self.id, node, f"{what}: {why}"))
+
+        seeded_calls = set()
+        if not strict:
+            # pre-mark seeded constructor calls so the attribute walk
+            # below can skip them (driver tier only)
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Call) \
+                        and ImportMap.matches(imap.resolve(node.func),
+                                              *SEEDED_OK) \
+                        and (node.args or node.keywords):
+                    for sub in ast.walk(node.func):
+                        seeded_calls.add(id(sub))
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                for mod in self._banned_import(node):
+                    if strict or ImportMap.matches(mod, "random",
+                                                   "secrets") \
+                            or mod in WALLCLOCK or mod in ENTROPY:
+                        hit(node, f"import of {mod!r}",
+                            "all randomness goes through "
+                            "utils/rngstream; wall time is banned in "
+                            "deterministic code")
+                continue
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                dotted = imap.resolve(node)
+                if dotted is None:
+                    continue
+                # only report the OUTERMOST attribute of a chain once:
+                # handled by skipping nodes that are the .value of a
+                # parent we'll also see — cheap approximation: report
+                # Names only when they resolve to a banned FUNCTION
+                # (from-imports), attributes always
+                if isinstance(node, ast.Name) \
+                        and dotted == node.id:
+                    continue        # unaliased local name, not a ref
+                if dotted in WALLCLOCK or dotted in ENTROPY \
+                        or ImportMap.matches(dotted, "secrets"):
+                    hit(node, f"wall-clock / entropy read {dotted!r}",
+                        "use the simulated clock, or "
+                        "time.perf_counter for host-side timing")
+                elif ImportMap.matches(dotted, *RNG_MODULES):
+                    if not strict and id(node) in seeded_calls:
+                        continue
+                    hit(node, f"global RNG reference {dotted!r}",
+                        "seed a stream via utils/rngstream (core) or "
+                        "an explicitly seeded np.random.default_rng "
+                        "(drivers)")
+            elif isinstance(node, ast.Call):
+                fn = imap.resolve(node.func)
+                if ImportMap.matches(fn, "getattr") and node.args:
+                    base = imap.resolve(node.args[0])
+                    name = (node.args[1].value
+                            if len(node.args) > 1
+                            and isinstance(node.args[1], ast.Constant)
+                            else None)
+                    target = (f"{base}.{name}" if base and name
+                              else base)
+                    if ImportMap.matches(base, *RNG_MODULES) \
+                            or (target and (target in WALLCLOCK
+                                            or target in ENTROPY)):
+                        hit(node, f"getattr access to {target!r}",
+                            "dynamic attribute access does not exempt "
+                            "banned modules")
+                elif ImportMap.matches(fn, "importlib.import_module",
+                                       "__import__") and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    mod = node.args[0].value
+                    if ImportMap.matches(mod, *RNG_MODULES) \
+                            or ImportMap.matches(mod, "secrets"):
+                        hit(node, f"dynamic import of {mod!r}",
+                            "dynamic imports do not exempt banned "
+                            "modules")
+        # de-duplicate chained attribute reports (np.random.default_rng
+        # resolves at both the .random and .default_rng nodes): keep
+        # the innermost (first by col) per line span
+        dedup = {}
+        for f in out:
+            k = (f.line, f.rule)
+            if k not in dedup or f.col < dedup[k].col:
+                dedup[k] = f
+        return list(dedup.values())

@@ -115,9 +115,9 @@ class HostMaestro:
         opstats.bump("dispatches")
         if int(n_light):
             raise RuntimeError("maestro solve did not converge")
-        rates = np.asarray(rates_dev)
-        pen = np.asarray(s._pen)
-        rem = np.asarray(s._rem)
+        rates = opstats.timed_fetch(rates_dev)
+        pen = opstats.timed_fetch(s._pen)
+        rem = opstats.timed_fetch(s._rem)
         self.fetches += 3
 
         live = pen > 0
@@ -145,7 +145,7 @@ class HostMaestro:
             jnp.asarray(dt, np.float64), _ZERO_BITS)
         self.dispatches += 1
         opstats.bump("dispatches")
-        out = np.asarray(out)
+        out = opstats.timed_fetch(out)
         self.fetches += 1
         done = out[1:] > 0
         self.advances += 1

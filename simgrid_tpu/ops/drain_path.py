@@ -331,7 +331,8 @@ class DrainFastPath:
         self.sim = sim
         self.phase_kind = classify_phase(sim)
         self.slot_action = slot_action
-        self.lat_actions = {s: slot_action[s] for s in lat_slots}
+        self.lat_actions = {s: slot_action[s]
+                            for s in sorted(lat_slots)}
         self.live_slots = {int(s) for s in np.flatnonzero(pen > 0)}
         self.version = view.version
         self.epoch = view.layout_epoch

@@ -210,6 +210,7 @@ def _fused_step_program(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
     solve needs more rounds the flow state is returned unchanged and
     the caller re-dispatches with the carry (rare: local-rounds drains
     converge in O(10) rounds)."""
+    opstats.bump("retraces")      # trace-time only; see _superstep
     dtype = e_w.dtype
     out = fixpoint(e_var, e_cnst, e_w, c_bound,
                    jnp.zeros(n_c, bool), pen, v_bound,
@@ -309,6 +310,10 @@ def _superstep_program(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
     the dtype must be float64.  The ring grows by another n_v
     activation slots.
     """
+    # trace-time only: a steady-state superstep loop re-enters the jit
+    # cache, so this stays flat; a nonzero delta on a repeat run means
+    # something is busting the cache (shape/static churn)
+    opstats.bump("retraces")
     dtype = e_w.dtype
     fat = jnp.zeros(n_c, bool)
     eps_c = jnp.asarray(eps, dtype)
@@ -933,7 +938,8 @@ class DrainSim:
         """The slot -> original-flow-id mirror, refetched after an
         on-device repack made it stale (one transfer, counted)."""
         if self._ids_stale:
-            self._ids = np.asarray(self._ids_dev).astype(np.int64)
+            self._ids = opstats.timed_fetch(
+                self._ids_dev).astype(np.int64)
             self.syncs += 1
             self._ids_stale = False
         return self._ids
@@ -944,9 +950,9 @@ class DrainSim:
         order over survivors — and therefore event ordering — is
         unchanged.  Unfused/fused paths only; the superstep path
         repacks on device."""
-        pen = np.asarray(self._pen)
-        rem = np.asarray(self._rem)
-        thresh = np.asarray(self._thresh)
+        pen = opstats.timed_fetch(self._pen)
+        rem = opstats.timed_fetch(self._rem)
+        thresh = opstats.timed_fetch(self._thresh)
         self.syncs += 1
         live = pen > 0
         keep = np.flatnonzero(live)
@@ -965,7 +971,8 @@ class DrainSim:
         self._thresh = jax.device_put(thresh[keep], self.device)
         self._ids_dev = jax.device_put(
             self._ids.astype(np.int32), self.device)
-        self._vb = jax.device_put(np.asarray(self._vb)[keep], self.device)
+        self._vb = jax.device_put(
+            opstats.timed_fetch(self._vb)[keep], self.device)
         self._dev = [jax.device_put(_to2d(self._host[k]), self.device)
                      for k in ("e_var", "e_cnst", "e_w")]
         self._live0 = self.n_v
@@ -1018,7 +1025,7 @@ class DrainSim:
                 *self._dev, self._cb, self._pen, self._vb, carry,
                 eps=self.eps, n_c=self.n_c, n_v=self.n_v,
                 chunk=self.solve_chunk, has_bounds=self.has_bounds)
-            st = np.asarray(stats)
+            st = opstats.timed_fetch(stats)
             self.syncs += 1
             rounds, n_light = int(st[0]), int(st[1])
             if n_light == 0:
@@ -1031,7 +1038,7 @@ class DrainSim:
 
         self._pen, self._rem, out = _drain_advance(
             self._pen, self._rem, self._thresh, carry[0], _ZERO_BITS)
-        out = np.asarray(out)
+        out = opstats.timed_fetch(out)
         self.syncs += 1
         dt, n_live = float(out[0]), int(out[1])
         done = out[2:] > 0
@@ -1045,7 +1052,7 @@ class DrainSim:
                 self._thresh, carry, _ZERO_BITS, eps=self.eps,
                 n_c=self.n_c, n_v=self.n_v, chunk=self.solve_chunk,
                 has_bounds=self.has_bounds)
-            st = np.asarray(stats)
+            st = opstats.timed_fetch(stats)
             self.syncs += 1
             rounds, n_light = int(st[0]), int(st[1])
             if n_light == 0:

@@ -891,7 +891,7 @@ def _solve_ell_chain(ell: LmmEllArrays, eps: float, device,
     orig_idx = init[5]
     v_final = jnp.zeros(V0, dtype)
 
-    overflow = jnp.asarray(False)
+    overflow = jnp.asarray(False, jnp.bool_)
     tables = (vc_cnst, vc_w, vc_valid, v_pen, orig_idx)
     Vs = V0
     while Vs // 2 >= _CHAIN_MIN_V:

@@ -144,6 +144,40 @@ context object through the solver entry points:
 * ``serve_solo_results``    — queries the campaign service answered
                               on the solo host path (watchdog
                               fallback)
+* ``native_advances``       — engine advances served by the generic
+                              host sweep (models.cpu/models.network)
+                              instead of a device drain plan
+* ``fastpath_advances``     — engine advances fully served by the
+                              device drain plan (ops.drain_path
+                              serve/apply at the planned dt)
+* ``drain_transitions``     — drain-plan transition absorptions: dirty
+                              deltas scattered into the live device
+                              state instead of invalidating the plan
+* ``drain_transition_slots`` — slots touched by those scatters
+* ``drain_cause_<cause>``   — drain-plan invalidation/absorption
+                              causes (``partial_advance``,
+                              ``transition``, ``stall``,
+                              ``profile_event``, ...): one bump per
+                              event, keyed by cause
+* ``phase_<kind>``          — drain-plan builds keyed by the
+                              classified phase kind of the system
+                              snapshot (ops.drain_path.classify_phase)
+* ``collective_tape_slots`` — collective-tape entries compiled into
+                              device schedule tapes at sim
+                              construction (collectives.tape)
+* ``collective_tape_fires`` — collective tape events that FIRED
+                              mid-drain (ring entries the host demuxed
+                              into ``collective_events``)
+* ``collective_replays``    — speculative in-flight supersteps
+                              discarded because the superstep they
+                              chained from fired a collective tape
+                              event (mirror of ``fault_replays``)
+* ``retraces``              — jit trace executions of the kernel
+                              program functions (bumped at TRACE time
+                              only, from inside the program body): a
+                              steady-state superstep loop must keep
+                              this flat — a nonzero delta on a repeat
+                              run is a cache-busting retrace
 
 Counters only ever increase; consumers snapshot before a phase and
 diff after (``snapshot``/``diff``), or wrap the phase in ``scoped``.

@@ -247,6 +247,7 @@ def assert_sharded_matches_at_scale(n_devices: int,
     from bench import build_arrays
     from ..ops import lmm_jax
 
+    # simlint: ignore[wallclock-rng] -- fixed-seed scenario generator for the self-check harness; never feeds simulation state
     big = build_arrays(_np.random.default_rng(42), n_c, n_v, deg,
                        _np.float64)
     v1, r1, u1, rounds1 = lmm_jax.solve_arrays(big, 1e-9,

@@ -167,15 +167,51 @@ def test_collective_runtime_determinism():
 
 def test_checker_flags_violations(tmp_path):
     """The lint itself works: a planted file with each banned pattern is
-    reported (guards against the lint silently matching nothing)."""
+    reported (guards against the lint silently matching nothing).
+    Covers both the old regex lint's surface spellings and the alias
+    escapes that walked straight past it."""
     checker = _load_checker()
     bad_dir = tmp_path / "simgrid_tpu" / "kernel"
     bad_dir.mkdir(parents=True)
+    # the spellings the old regex lint matched: still all caught
     (bad_dir / "bad.py").write_text(
         "import random, time, datetime\n"
         "x = random.random()\n"
         "t = time.time()\n"
         "d = datetime.now()\n"
         "# a comment saying random. is fine\n")
-    violations = checker.collect_violations(str(tmp_path))
-    assert [v[1] for v in violations] == [2, 3, 4]
+    violations = [v for v in checker.collect_violations(str(tmp_path))
+                  if v[0].endswith("bad.py")]
+    # line 1 is new coverage: the banned import itself is the finding
+    assert [v[1] for v in violations] == [1, 2, 3, 4]
+
+    # the alias escapes the regex lint could NOT see
+    (bad_dir / "sneaky.py").write_text(
+        "from time import time as _clock\n"
+        "import random as rnd\n"
+        "t = _clock()\n"
+        "x = rnd.random()\n"
+        "import datetime\n"       # module import alone is legal
+        "d = datetime.datetime.now()\n")
+    violations = [v for v in checker.collect_violations(str(tmp_path))
+                  if v[0].endswith("sneaky.py")]
+    assert [v[1] for v in violations] == [1, 2, 3, 4, 6]
+
+
+def test_simlint_cli_clean_tree():
+    """`python tools/simlint.py` (the full rule set, default paths,
+    checked-in baseline) exits 0 on the merged tree and reports
+    machine-readable JSON."""
+    import json
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                      "simlint.py"), "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["ok"] is True
+    assert report["findings"] == []
+    assert report["stale_baseline"] == []

@@ -293,6 +293,36 @@ class TestSuperstepSaturation:
             assert results[b].t == ref.t
 
 
+class TestRetraceSentinel:
+    def test_steady_state_superstep_does_not_retrace(self):
+        """The ``opstats.retraces`` sentinel (simlint PR): the superstep
+        program bodies bump it at TRACE time only, so a repeat drain of
+        an identically-shaped system must re-enter the jit cache and
+        leave the counter flat.  A nonzero delta here means shape or
+        static churn is busting the cache on the steady-state path."""
+        from simgrid_tpu.ops import opstats
+
+        ev, ec, ew, cb, sizes, n_v = \
+            TestSuperstepSaturation._chain_system()
+
+        def drain():
+            sim = DrainSim(ev, ec, ew, cb, sizes, eps=1e-9,
+                           dtype=np.float64, superstep=K,
+                           repack_min=1 << 62)
+            sim.run()
+            return sim
+
+        first = drain()
+        assert len(first.events) == n_v
+        # the programs really carry the sentinel: the cumulative counter
+        # is nonzero once any superstep program has ever been traced
+        assert opstats.snapshot().get("retraces", 0) > 0
+        before = opstats.snapshot()
+        second = drain()
+        assert second.events == first.events
+        assert opstats.diff(before).get("retraces", 0) == 0
+
+
 class TestClockAccumulation:
     def test_host_clock_is_f64(self, drained):
         """The master clock accumulates per-advance dts in f64 on the

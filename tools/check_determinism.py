@@ -4,15 +4,14 @@
 The kernel, the solver and the fault-injection subsystem must be
 bit-reproducible: all randomness goes through the seeded RngStream
 (simgrid_tpu/utils/rngstream.py) and all time through the simulated
-clock.  This lint fails if any file under the audited packages reaches
-for the wall clock or Python's global RNG:
-
-    random.<anything>      (incl. np.random / jax.random)
-    time.time(
-    datetime.now(
-
-Comments are stripped before matching so prose mentioning the banned
-names stays legal; code and docstrings are audited as written.
+clock.  The static half is simlint (simgrid_tpu/analysis +
+tools/simlint.py): run bare, this tool runs the ``wallclock-rng``
+rule — an AST lint with import/alias resolution, so ``from time
+import time`` or ``import random as rnd`` can't dodge it — over the
+audited packages; ``--quick`` runs the FULL simlint rule set (FMA
+pinning, hidden host syncs, dtype discipline, iteration order,
+opstats registry) against the checked-in
+tools/simlint_baseline.json.
 Run directly (exit 1 on violations) or through tests/test_determinism_lint.py.
 
 ``--runtime-drain`` additionally executes the drain executor's three
@@ -128,50 +127,59 @@ on every run).
 from __future__ import annotations
 
 import os
-import re
 import sys
 from typing import List, Tuple
 
-AUDITED_DIRS = (
-    os.path.join("simgrid_tpu", "kernel"),
-    os.path.join("simgrid_tpu", "ops"),
-    os.path.join("simgrid_tpu", "faults"),
-    os.path.join("simgrid_tpu", "serving"),
-    os.path.join("simgrid_tpu", "collectives"),
-)
+#: what the static half audits (simlint path scopes govern per-rule
+#: coverage inside these)
+AUDITED_PATHS = ("simgrid_tpu", "tools")
 
-BANNED = [
-    (re.compile(r"\brandom\s*\."), "random."),
-    (re.compile(r"\btime\.time\s*\("), "time.time("),
-    (re.compile(r"\bdatetime\.now\s*\("), "datetime.now("),
-]
+_OWN_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-_COMMENT = re.compile(r"#.*$")
+
+def _simlint():
+    """The simlint package (from THIS repo, wherever the checker was
+    loaded from — tests exec this file via importlib)."""
+    if _OWN_ROOT not in sys.path:
+        sys.path.insert(0, _OWN_ROOT)
+    from simgrid_tpu import analysis
+    return analysis
 
 
 def collect_violations(repo_root: str) -> List[Tuple[str, int, str]]:
-    """(relative path, line number, stripped line) for every banned
-    pattern occurrence under the audited directories."""
-    violations: List[Tuple[str, int, str]] = []
-    for rel_dir in AUDITED_DIRS:
-        top = os.path.join(repo_root, rel_dir)
-        if not os.path.isdir(top):
-            continue
-        for dirpath, _dirnames, filenames in os.walk(top):
-            for filename in sorted(filenames):
-                if not filename.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, filename)
-                with open(path, encoding="utf-8") as f:
-                    for lineno, line in enumerate(f, 1):
-                        code = _COMMENT.sub("", line)
-                        for pattern, label in BANNED:
-                            if pattern.search(code):
-                                violations.append(
-                                    (os.path.relpath(path, repo_root),
-                                     lineno, line.strip()))
-                                break
-    return violations
+    """(relative path, line number, stripped line) for every
+    wall-clock / global-RNG use under the audited packages.
+
+    Backed by the simlint ``wallclock-rng`` AST rule (import/alias
+    resolution, so ``from time import time`` or ``import random as
+    rnd`` can't dodge it) — the successor of the old regex scan, same
+    return shape."""
+    analysis = _simlint()
+    rules = [r for r in analysis.ALL_RULES if r.id == "wallclock-rng"]
+    findings = analysis.lint_paths(repo_root, AUDITED_PATHS, rules)
+    return [(f.path.replace("/", os.sep), f.line, f.snippet)
+            for f in findings]
+
+
+def collect_simlint_problems(repo_root: str) -> List[str]:
+    """The full simlint rule set against the checked-in baseline:
+    formatted problem strings for every NEW finding and every stale
+    baseline entry (empty = clean)."""
+    analysis = _simlint()
+    findings = analysis.lint_paths(repo_root, AUDITED_PATHS)
+    baseline_path = os.path.join(repo_root, "tools",
+                                 "simlint_baseline.json")
+    baseline = None
+    if os.path.exists(baseline_path):
+        baseline = analysis.load_baseline(baseline_path)
+    new, stale = analysis.apply_baseline(findings, baseline)
+    problems = [f"{f.path}:{f.line}: [{f.rule}] {f.message}"
+                for f in new]
+    problems += [f"{e['path']}: stale simlint baseline entry "
+                 f"[{e['rule']}] {e['snippet']!r} — fixed findings "
+                 f"must leave tools/simlint_baseline.json too"
+                 for e in stale]
+    return problems
 
 
 def check_drain_runtime(seed: int = 13, n_c: int = 128, n_v: int = 800,
@@ -1396,8 +1404,7 @@ def quick_checks() -> List[str]:
     check, sized for seconds, so determinism regressions fail pytest
     instead of waiting for a manual tool run."""
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    problems = [f"{p}:{n}: {t}"
-                for p, n, t in collect_violations(repo_root)]
+    problems = collect_simlint_problems(repo_root)
     problems += check_drain_runtime(n_c=32, n_v=128, k=4)
     problems += check_batch_runtime(n_c=32, n_v=96, batch=6,
                                     solo_check=(0, 3, 5))
@@ -1580,7 +1587,8 @@ def main(argv: List[str]) -> int:
         os.path.dirname(os.path.abspath(__file__)))
     violations = collect_violations(repo_root)
     if not violations:
-        print("check_determinism: OK (%s clean)" % ", ".join(AUDITED_DIRS))
+        print("check_determinism: OK (%s clean — simlint wallclock-rng)"
+              % ", ".join(AUDITED_PATHS))
         return 0
     print("check_determinism: nondeterminism sources found "
           "(use utils/rngstream.py and the simulated clock):")

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""simlint — run the AST invariant rules over the tree.
+
+Usage::
+
+    python tools/simlint.py [paths...] [options]
+
+Paths default to ``simgrid_tpu tools`` (repo-relative).  Exit status 0
+means no NEW findings and no stale baseline entries; 1 means there is
+something to fix; 2 is an operational error (bad arguments, unreadable
+baseline).
+
+Options:
+    --json              machine-readable report on stdout
+    --baseline PATH     baseline file (default tools/simlint_baseline.json;
+                        pass --baseline '' to run baseline-less)
+    --write-baseline    rewrite the baseline to grandfather every
+                        current finding, then exit 0
+    --rule ID           run only this rule (repeatable)
+    --list-rules        print rule ids + one-line docs and exit
+
+The baseline only ever shrinks: fix a grandfathered finding and the
+now-stale entry fails the run until it is deleted (rerun with
+``--write-baseline`` or edit the JSON).  New code never gets new
+baseline entries — fix it or suppress it inline with
+``# simlint: ignore[rule-id] -- reason``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from simgrid_tpu.analysis import (ALL_RULES, apply_baseline,  # noqa: E402
+                                  dump_baseline, findings_to_json,
+                                  format_findings, lint_paths,
+                                  load_baseline, make_baseline)
+
+DEFAULT_PATHS = ("simgrid_tpu", "tools")
+DEFAULT_BASELINE = os.path.join("tools", "simlint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="simlint", description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="repo-relative files/dirs "
+                         f"(default: {' '.join(DEFAULT_PATHS)})")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--rule", action="append", default=None,
+                    metavar="ID")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--root", default=REPO_ROOT,
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f"{r.id:22s} {r.doc}")
+        return 0
+
+    rules = list(ALL_RULES)
+    if args.rule:
+        by_id = {r.id: r for r in ALL_RULES}
+        unknown = [i for i in args.rule if i not in by_id]
+        if unknown:
+            print(f"simlint: unknown rule id(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+        rules = [by_id[i] for i in args.rule]
+
+    paths = args.paths or list(DEFAULT_PATHS)
+    findings = lint_paths(args.root, paths, rules)
+
+    baseline_path = (os.path.join(args.root, args.baseline)
+                     if args.baseline
+                     and not os.path.isabs(args.baseline)
+                     else args.baseline)
+
+    if args.write_baseline:
+        if not baseline_path:
+            print("simlint: --write-baseline needs --baseline",
+                  file=sys.stderr)
+            return 2
+        dump_baseline(make_baseline(findings), baseline_path)
+        print(f"simlint: baselined {len(findings)} finding(s) -> "
+              f"{os.path.relpath(baseline_path, args.root)}")
+        return 0
+
+    baseline = None
+    if baseline_path and os.path.exists(baseline_path):
+        try:
+            baseline = load_baseline(baseline_path)
+        except (ValueError, OSError) as e:
+            print(f"simlint: cannot load baseline: {e}",
+                  file=sys.stderr)
+            return 2
+    new, stale = apply_baseline(findings, baseline)
+    baselined = len(findings) - len(new)
+
+    if args.json:
+        print(findings_to_json(new, stale, baselined))
+    else:
+        report = format_findings(new, stale)
+        if report:
+            print(report)
+        print(f"simlint: {len(new)} new finding(s), {baselined} "
+              f"baselined, {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'}")
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
