@@ -1,0 +1,79 @@
+"""ProgramContract: the declared IR-level invariants of one program.
+
+A contract states what the *lowered* form of a jitted kernel program
+is allowed to look like.  The registry pairs each program with one,
+and the rules in :mod:`.rules` verify the pairing — so a change that
+silently rewrites the compiled program (a weak-typed scalar promoting
+the solve to f64, a host callback sneaking in as a custom_call, XLA
+re-contracting the advance arithmetic into an FMA, a dropped
+``donate_argnames``) surfaces as a lint finding instead of a ulp
+drift three layers up.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Mapping, Tuple
+
+
+@dataclass(frozen=True)
+class ProgramContract:
+    """The IR invariants one jitted kernel program must satisfy.
+
+    Fields
+    ------
+    solve_dtype:
+        The program's value dtype ("float32" or "float64") — the
+        dtype the flow-state math runs in.
+    allowed_dtypes:
+        Every dtype that may appear as an equation output anywhere in
+        the jaxpr (sub-jaxprs included).  This IS the explicit
+        allowlist: an f32-solve program that legitimately carries f64
+        (the Kahan clock pair, tape dates, the event-ordering oracle)
+        lists ``float64`` here with a reason in :attr:`dtype_why`;
+        anything outside the set is a ``dtype-flow`` finding.
+    dtype_why:
+        Documentation for every non-solve dtype in the allowlist —
+        rendered into findings so a reviewer sees *why* f64 is legal
+        in an f32 program instead of guessing.
+    expected_outputs:
+        The program's flat output-surface size (number of output
+        arrays).  The superstep programs return exactly one packed
+        ring plus the double-buffered carries; growing this surface
+        means a second fetch per superstep somewhere downstream.
+        ``None`` skips the check.
+    donated:
+        Names of arguments the lowered program must mark donated
+        (``tf.aliasing_output`` / ``jax.buffer_donor`` input
+        aliasing).  Empty for programs whose inputs must stay alive
+        (speculation chains from them).
+    fma_pinned:
+        The program advances remains via ``_rounded_product`` and the
+        int-bitcast detour must survive lowering: bitcast ops present,
+        and no float ``sub`` consuming a raw ``mul`` product in the
+        advance dtype (the contractible pattern XLA:CPU's LLVM
+        backend would fuse).
+    forbidden_ops:
+        Extra StableHLO op substrings forbidden in the lowered text,
+        on top of the always-forbidden hidden-transfer set
+        (custom_call / infeed / outfeed / send / recv).
+    retrace_stable:
+        Lowering the program at two example shapes must produce the
+        same closed-over constant surface (count and per-const
+        shape/dtype).  A constant that tracks the example shape is a
+        shape-specialized closure: every new system geometry would
+        retrace and recompile it (the runtime ``retraces`` sentinel
+        would catch it only after the cache miss already happened).
+    """
+
+    solve_dtype: str = "float64"
+    allowed_dtypes: Tuple[str, ...] = ()
+    dtype_why: Mapping[str, str] = field(default_factory=dict)
+    expected_outputs: "int | None" = None
+    donated: Tuple[str, ...] = ()
+    fma_pinned: bool = False
+    forbidden_ops: Tuple[str, ...] = ()
+    retrace_stable: bool = True
+
+    def allows(self, dtype_name: str) -> bool:
+        return dtype_name in self.allowed_dtypes

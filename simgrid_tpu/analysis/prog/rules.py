@@ -1,0 +1,482 @@
+"""The proglint IR rules.
+
+Each rule inspects one staged program — its jaxpr (sub-jaxprs
+included) and its lowered StableHLO text — against the program's
+:class:`~.contract.ProgramContract`, and reports violations as the
+same :class:`~simgrid_tpu.analysis.engine.Finding` records simlint
+emits, with ``path = "program:<registry name>"`` and the finding's
+stable identity in the snippet, so the shared shrink-only baseline
+machinery applies unchanged.
+
+Rules
+-----
+``dtype-flow``
+    Every equation-output dtype must be in the contract's allowlist,
+    and no non-scalar solve-dtype state may be upcast to a wider
+    float (tracing rewrites every implicit mixed-width op into an
+    explicit ``convert_element_type``, so an f32→f64 array upcast IS
+    the weak-scalar leak that rewrites the solve's rounding).
+``hidden-transfer``
+    The lowered text must not contain custom_call / host-callback /
+    infeed / outfeed / send / recv ops, and the program's flat output
+    surface must match the contract — the superstep contract is ONE
+    packed ring plus the double-buffered carries, so a grown surface
+    means a second fetch per superstep somewhere downstream.
+``fma-pinning``
+    The int-bitcast detour of ``_rounded_product`` must survive
+    lowering (bitcast_convert_type present), and no float ``sub`` may
+    consume a raw ``mul`` product in the solve dtype — the
+    contractible multiply-subtract XLA:CPU's LLVM backend would fuse
+    into an FMA, drifting remains a ulp per advance off the host
+    oracle.
+``donation``
+    Every argument the contract lists in ``donated`` must carry an
+    input-output aliasing attribute (``tf.aliasing_output`` /
+    ``jax.buffer_donor``) in the lowered module — the steady-state
+    carry really is reused in place, not copied.
+``retrace-surface``
+    Lowering at two example geometries must close over the same
+    constant surface (count, and per-constant shape/dtype): a
+    constant that tracks the example shape is a shape-specialized
+    closure, which retraces and recompiles on every new geometry.
+``shape-discipline``
+    No dynamic shapes anywhere (static dims in every aval, no
+    stablehlo dynamic-shape ops), and every while_loop carry is
+    shape-invariant.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..engine import Finding
+from .contract import ProgramContract
+from .registry import ProgramSpec
+
+RULE_DTYPE = "dtype-flow"
+RULE_TRANSFER = "hidden-transfer"
+RULE_FMA = "fma-pinning"
+RULE_DONATION = "donation"
+RULE_RETRACE = "retrace-surface"
+RULE_SHAPE = "shape-discipline"
+
+ALL_PROG_RULE_IDS = (RULE_DTYPE, RULE_TRANSFER, RULE_FMA,
+                     RULE_DONATION, RULE_RETRACE, RULE_SHAPE)
+
+#: StableHLO ops that move data across the device boundary (or into
+#: opaque host code) — never legal inside a drain/solve program
+_TRANSFER_OPS = ("stablehlo.custom_call", "mhlo.custom_call",
+                 "stablehlo.infeed", "stablehlo.outfeed",
+                 "stablehlo.send", "stablehlo.recv")
+
+#: StableHLO ops whose RESULT shape is data-dependent — their
+#: presence means a shape left the static discipline.  NOTE
+#: ``stablehlo.dynamic_slice`` / ``dynamic_update_slice`` are NOT
+#: here: their sizes are static attributes (only the start indices
+#: are data), so they are shape-disciplined.
+_DYNAMIC_OPS = ("stablehlo.dynamic_reshape",
+                "stablehlo.dynamic_broadcast_in_dim",
+                "stablehlo.dynamic_iota",
+                "stablehlo.dynamic_pad",
+                "stablehlo.dynamic_gather",
+                "stablehlo.real_dynamic_slice",
+                "stablehlo.compute_reshape_shape")
+
+# ---------------------------------------------------------------------------
+# Staging: trace + lower one registered program
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ProgramIR:
+    """One program's staged artifacts at the two example scales."""
+    spec: ProgramSpec
+    jaxpr1: Any            # ClosedJaxpr at scale 1
+    jaxpr2: Any            # ClosedJaxpr at scale 2
+    lowered_text: str      # StableHLO of scale 1
+    donated_flags: Tuple[bool, ...]  # per positional arg, scale 1
+
+
+def stage(spec: ProgramSpec) -> ProgramIR:
+    """Trace the program at both example scales and lower scale 1 —
+    the exact ``jit().trace().lower()`` staging the serving plan
+    cache compiles through, so proglint sees the program the AOT
+    artifacts will actually contain."""
+    import jax
+
+    args1, statics1 = spec.make(1)
+    args2, statics2 = spec.make(2)
+    tr1 = spec.jitted.trace(*args1, **statics1)
+    tr2 = spec.jitted.trace(*args2, **statics2)
+    lowered = tr1.lower()
+    text = lowered.as_text()
+    # Lowered.args_info mirrors the call's positional arg structure
+    # (None placeholders included), each leaf flagged donated or not
+    # — authoritative even after jit prunes unused args from @main.
+    flags = tuple(bool(getattr(info, "donated", False)) for info in
+                  jax.tree_util.tree_leaves(lowered.args_info))
+    return ProgramIR(spec, tr1.jaxpr, tr2.jaxpr, text, flags)
+
+
+def _prog_path(spec: ProgramSpec) -> str:
+    return f"program:{spec.name}"
+
+
+def _finding(spec: ProgramSpec, rule: str, message: str,
+             snippet: str) -> Finding:
+    # line/col carry no meaning for a lowered program; the stable
+    # identity (rule, path, snippet) drives baselines and dedup
+    return Finding(rule=rule, path=_prog_path(spec), line=1, col=0,
+                   message=message, snippet=snippet)
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking helpers (duck-typed: no jax import needed here)
+# ---------------------------------------------------------------------------
+
+def _subjaxprs(value) -> Iterable[Any]:
+    """Open jaxprs reachable from one eqn param value."""
+    if hasattr(value, "eqns"):                      # open Jaxpr
+        yield value
+    elif hasattr(value, "jaxpr") and hasattr(
+            getattr(value, "jaxpr"), "eqns"):       # ClosedJaxpr
+        yield value.jaxpr
+    elif isinstance(value, (tuple, list)):
+        for item in value:
+            yield from _subjaxprs(item)
+
+
+def iter_eqns(closed_jaxpr) -> Iterable[Any]:
+    """Every equation in a ClosedJaxpr, sub-jaxprs included."""
+    stack = [closed_jaxpr.jaxpr]
+    while stack:
+        jaxpr = stack.pop()
+        for eqn in jaxpr.eqns:
+            yield eqn
+            for value in eqn.params.values():
+                stack.extend(_subjaxprs(value))
+
+
+def _aval(var):
+    return getattr(var, "aval", None)
+
+
+def _dtype_name(var) -> Optional[str]:
+    aval = _aval(var)
+    dt = getattr(aval, "dtype", None)
+    return None if dt is None else str(dt)
+
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+
+def check_dtype_flow(ir: ProgramIR) -> List[Finding]:
+    spec, contract = ir.spec, ir.spec.contract
+    out: List[Finding] = []
+    seen_bad: set = set()
+    for eqn in iter_eqns(ir.jaxpr1):
+        prim = eqn.primitive.name
+        for var in eqn.outvars:
+            name = _dtype_name(var)
+            if name is None or name in contract.allowed_dtypes:
+                continue
+            if name not in seen_bad:
+                seen_bad.add(name)
+                why = ", ".join(f"{k}: {v}" for k, v in
+                                sorted(contract.dtype_why.items()))
+                out.append(_finding(
+                    spec, RULE_DTYPE,
+                    f"dtype {name} (first produced by `{prim}`) is "
+                    f"outside the contract allowlist "
+                    f"{sorted(contract.allowed_dtypes)}"
+                    + (f" (allowlisted: {why})" if why else ""),
+                    f"dtype:{name}"))
+        if prim != "convert_element_type":
+            continue
+        # tracing already rewrites every implicit mixed-width op into
+        # an explicit convert, so THE leak signature in a traced
+        # program is this: a non-scalar upcast of solve-dtype state
+        # to a wider float.  (Scalars stay exempt — weak literals —
+        # and downcasts toward the solve dtype are the disciplined
+        # direction.)
+        src = _dtype_name(eqn.invars[0])
+        dst = _dtype_name(eqn.outvars[0])
+        shape = tuple(getattr(_aval(eqn.invars[0]), "shape", ()))
+        if (src == contract.solve_dtype and dst
+                and dst.startswith("float") and dst > src
+                and shape != ()):
+            key = f"promote:{src}->{dst}"
+            if key not in seen_bad:
+                seen_bad.add(key)
+                out.append(_finding(
+                    spec, RULE_DTYPE,
+                    f"{src} solve state of shape {shape} is upcast "
+                    f"to {dst} — an implicit promotion leaked into "
+                    f"the program (a weak scalar or a wider-dtype "
+                    f"operand pulled the solve math up)",
+                    key))
+    return out
+
+
+def check_hidden_transfer(ir: ProgramIR) -> List[Finding]:
+    spec, contract = ir.spec, ir.spec.contract
+    out: List[Finding] = []
+    forbidden = _TRANSFER_OPS + tuple(contract.forbidden_ops)
+    for op in forbidden:
+        if op in ir.lowered_text:
+            line = next((ln.strip() for ln in
+                         ir.lowered_text.splitlines() if op in ln),
+                        op)
+            out.append(_finding(
+                spec, RULE_TRANSFER,
+                f"lowered program contains `{op}` — a hidden "
+                f"host/device boundary crossing ({line[:100]})",
+                f"op:{op}"))
+    if contract.expected_outputs is not None:
+        n_out = len(ir.jaxpr1.jaxpr.outvars)
+        if n_out != contract.expected_outputs:
+            out.append(_finding(
+                spec, RULE_TRANSFER,
+                f"program returns {n_out} arrays, contract pins "
+                f"{contract.expected_outputs} — the fetch surface "
+                f"grew (the superstep contract is ONE packed ring "
+                f"per dispatch)",
+                f"outputs:{n_out}"))
+    return out
+
+
+def check_fma_pinning(ir: ProgramIR) -> List[Finding]:
+    spec, contract = ir.spec, ir.spec.contract
+    if not contract.fma_pinned:
+        return []
+    out: List[Finding] = []
+    bitcasts = 0
+    producer: Dict[Any, str] = {}
+    for eqn in iter_eqns(ir.jaxpr1):
+        prim = eqn.primitive.name
+        if prim == "bitcast_convert_type":
+            bitcasts += 1
+        for var in eqn.outvars:
+            producer[var] = prim
+    if bitcasts < 2:
+        out.append(_finding(
+            spec, RULE_FMA,
+            "the int-bitcast rounding detour (_rounded_product) did "
+            "not survive lowering: "
+            f"{bitcasts} bitcast_convert_type op(s) found, >=2 "
+            "expected — XLA can now contract the advance's "
+            "multiply-subtract into an FMA",
+            "bitcast-detour-missing"))
+    solve = contract.solve_dtype
+    flagged = False
+    for eqn in iter_eqns(ir.jaxpr1):
+        if eqn.primitive.name != "sub" or flagged:
+            continue
+        if _dtype_name(eqn.outvars[0]) != solve:
+            continue
+        # the contractible pattern: sub consuming a RAW mul product
+        # (the pinned path routes the product through two bitcasts
+        # first, so its sub operand is produced by bitcast, not mul)
+        if any(producer.get(v) == "mul" for v in eqn.invars):
+            flagged = True
+            out.append(_finding(
+                spec, RULE_FMA,
+                f"a {solve} `sub` consumes a raw `mul` product — a "
+                "contractible multiply-subtract XLA:CPU's LLVM "
+                "backend may fuse into an FMA; round the product "
+                "first (_rounded_product)",
+                "contractible-mul-sub"))
+    return out
+
+
+_DONATION_ATTRS = ("tf.aliasing_output", "jax.buffer_donor")
+
+
+def check_donation(ir: ProgramIR) -> List[Finding]:
+    spec, contract = ir.spec, ir.spec.contract
+    if not contract.donated:
+        return []
+    out: List[Finding] = []
+    # Lowered.args_info is keyed by CALL position, which lines up with
+    # the program's Python signature even when jit prunes unused/None
+    # args out of the lowered @main (so `pen` at Python position 5 can
+    # land at %arg4 — signature-index parsing of the MLIR text would
+    # misattribute it).
+    params = list(inspect.signature(spec.program).parameters)
+    for name in contract.donated:
+        if name not in params:
+            out.append(_finding(
+                spec, RULE_DONATION,
+                f"contract donates `{name}` but the program has no "
+                f"such parameter",
+                f"missing-param:{name}"))
+            continue
+        idx = params.index(name)
+        donated = (idx < len(ir.donated_flags)
+                   and ir.donated_flags[idx])
+        if not donated:
+            out.append(_finding(
+                spec, RULE_DONATION,
+                f"carried state buffer `{name}` (arg {idx}) is not "
+                f"donated in the lowered module — the steady-state "
+                f"dispatch copies it instead of reusing it in place "
+                f"(pass donate_argnames)",
+                f"not-donated:{name}"))
+    # corroborate in the IR text: every donated arg must surface as
+    # an input-output aliasing attr on the lowered @main signature
+    n_attrs = sum(ir.lowered_text.count(a) for a in _DONATION_ATTRS)
+    n_expected = sum(1 for name in contract.donated
+                     if name in params)
+    if not out and n_attrs < n_expected:
+        out.append(_finding(
+            spec, RULE_DONATION,
+            f"args_info reports {n_expected} donated arg(s) but the "
+            f"lowered module text carries only {n_attrs} aliasing "
+            f"attribute(s) ({'/'.join(_DONATION_ATTRS)}) — donation "
+            f"did not survive lowering",
+            "aliasing-attr-missing"))
+    return out
+
+
+def check_retrace_surface(ir: ProgramIR) -> List[Finding]:
+    spec, contract = ir.spec, ir.spec.contract
+    if not contract.retrace_stable:
+        return []
+    out: List[Finding] = []
+    c1, c2 = list(ir.jaxpr1.consts), list(ir.jaxpr2.consts)
+    if len(c1) != len(c2):
+        out.append(_finding(
+            spec, RULE_RETRACE,
+            f"closed-over constant count differs across example "
+            f"geometries ({len(c1)} vs {len(c2)}) — the program "
+            f"closes over shape-dependent state and will retrace "
+            f"per geometry",
+            "const-count"))
+        return out
+    for i, (a, b) in enumerate(zip(c1, c2)):
+        sa = tuple(getattr(a, "shape", ()))
+        sb = tuple(getattr(b, "shape", ()))
+        if sa != sb:
+            out.append(_finding(
+                spec, RULE_RETRACE,
+                f"closed-over constant {i} tracks the example shape "
+                f"({sa} vs {sb}) — a shape-specialized closure: "
+                f"every new system geometry retraces and recompiles "
+                f"(pass it as an argument instead)",
+                f"const-shape:{i}"))
+        elif str(getattr(a, "dtype", "")) != str(getattr(b, "dtype",
+                                                         "")):
+            out.append(_finding(
+                spec, RULE_RETRACE,
+                f"closed-over constant {i} changes dtype across "
+                f"example geometries",
+                f"const-dtype:{i}"))
+    return out
+
+
+def check_shape_discipline(ir: ProgramIR) -> List[Finding]:
+    spec = ir.spec
+    out: List[Finding] = []
+    for op in _DYNAMIC_OPS:
+        if op in ir.lowered_text:
+            out.append(_finding(
+                spec, RULE_SHAPE,
+                f"lowered program contains dynamic-shape op `{op}`",
+                f"dynamic:{op}"))
+    flagged_dim = False
+    for eqn in iter_eqns(ir.jaxpr1):
+        prim = eqn.primitive.name
+        if not flagged_dim:
+            for var in eqn.outvars:
+                aval = _aval(var)
+                shape = getattr(aval, "shape", ())
+                if any(not isinstance(d, int) for d in shape):
+                    flagged_dim = True
+                    out.append(_finding(
+                        spec, RULE_SHAPE,
+                        f"`{prim}` produces a non-static dimension "
+                        f"({shape})",
+                        f"nonstatic-dim:{prim}"))
+                    break
+        if prim == "while":
+            body = eqn.params.get("body_jaxpr")
+            jaxpr = getattr(body, "jaxpr", body)
+            if jaxpr is None:
+                continue
+            n_carry = len(jaxpr.outvars)
+            ins = [
+                (tuple(getattr(_aval(v), "shape", ())),
+                 str(getattr(_aval(v), "dtype", "")))
+                for v in jaxpr.invars[-n_carry:]]
+            outs = [
+                (tuple(getattr(_aval(v), "shape", ())),
+                 str(getattr(_aval(v), "dtype", "")))
+                for v in jaxpr.outvars]
+            if ins != outs:
+                out.append(_finding(
+                    spec, RULE_SHAPE,
+                    "while_loop carry is not shape-invariant "
+                    f"(in {ins} vs out {outs})",
+                    "while-carry"))
+    return out
+
+
+_ALL_CHECKS = (check_dtype_flow, check_hidden_transfer,
+               check_fma_pinning, check_donation,
+               check_retrace_surface, check_shape_discipline)
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+def lint_program(spec: ProgramSpec,
+                 rules: Optional[Sequence[str]] = None
+                 ) -> List[Finding]:
+    """Stage one program and run the (selected) rules over it."""
+    ir = stage(spec)
+    out: List[Finding] = []
+    for check in _ALL_CHECKS:
+        if rules is not None:
+            rid = _CHECK_IDS[check]
+            if rid not in rules:
+                continue
+        out.extend(check(ir))
+    return out
+
+
+_CHECK_IDS = {check_dtype_flow: RULE_DTYPE,
+              check_hidden_transfer: RULE_TRANSFER,
+              check_fma_pinning: RULE_FMA,
+              check_donation: RULE_DONATION,
+              check_retrace_surface: RULE_RETRACE,
+              check_shape_discipline: RULE_SHAPE}
+
+
+def lint_programs(specs: Optional[Sequence[ProgramSpec]] = None,
+                  rules: Optional[Sequence[str]] = None
+                  ) -> List[Finding]:
+    """Stage and check every registered program.  A program whose
+    staging itself fails (an example factory out of sync with a
+    driver signature) is reported as a finding rather than a crash —
+    a registry rot is exactly the kind of silent decay this tool
+    exists to surface."""
+    from .registry import iter_programs
+
+    if specs is None:
+        specs = iter_programs()
+    out: List[Finding] = []
+    for spec in specs:
+        try:
+            out.extend(lint_program(spec, rules=rules))
+        except Exception as exc:  # noqa: BLE001 — reported, not raised
+            out.append(_finding(
+                spec, RULE_SHAPE if rules and RULE_SHAPE in rules
+                else (rules[0] if rules else RULE_SHAPE),
+                f"program failed to stage: {type(exc).__name__}: "
+                f"{exc}",
+                "stage-failure"))
+    return out

@@ -184,7 +184,7 @@ def build_schedule(progs) -> CollectiveSchedule:
                         f"rank {r}: recv(src={src}, tag={tag}) has no "
                         "matching send (wildcards are not compilable)")
                 recv_rec[r][h] = q.popleft()
-    leftover = sum(len(q) for q in chan.values())
+    leftover = sum(len(chan[k]) for k in sorted(chan))
     if leftover:
         raise ValueError(f"{leftover} sends were never received")
 

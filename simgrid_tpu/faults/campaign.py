@@ -126,7 +126,7 @@ class FaultCampaign:
         independent draws)."""
         out = FaultCampaign(seed=self.seed + int(seed_offset),
                             horizon=self.horizon)
-        for (kind, name), spec in self._specs.items():
+        for (kind, name), spec in sorted(self._specs.items()):
             out._add(kind, name, spec.mtbf, spec.mttr, spec.dist,
                      spec.shape)
         return out
@@ -174,7 +174,7 @@ class FaultCampaign:
         if h <= 0:
             raise ValueError("horizon must be > 0")
         out: Dict[Tuple[str, str], float] = {}
-        for key, points in self.generate().items():
+        for key, points in sorted(self.generate().items()):
             down = 0.0
             fail_at: Optional[float] = None
             for date, value in points:
@@ -213,7 +213,7 @@ class FaultCampaign:
         if not 0.0 < floor <= 1.0:
             raise ValueError("floor must be in (0, 1]")
         tape: List[Tuple[float, str, str, float]] = []
-        for (kind, name), points in self.generate().items():
+        for (kind, name), points in sorted(self.generate().items()):
             for date, value in points:
                 tape.append((date, kind, name,
                              1.0 if value > 0 else floor))
@@ -228,7 +228,8 @@ class FaultCampaign:
         floor = float(floor)
         if not 0.0 < floor <= 1.0:
             raise ValueError("floor must be in (0, 1]")
-        return sum(len(points) for points in self.generate().values())
+        sched = self.generate()
+        return sum(len(sched[k]) for k in sorted(sched))
 
     # -- compilation onto an engine ---------------------------------------
     def schedule(self, engine=None) -> Dict[Tuple[str, str],

@@ -155,7 +155,7 @@ def _plan_inputs(model, dtype, allow_latency: bool = False):
     sizes = np.ones(n_v)
     rem = np.zeros(n_v)
     pen = np.zeros(n_v, dtype)
-    for slot, action in slot_action.items():
+    for slot, action in sorted(slot_action.items()):
         sizes[slot] = max(action.cost, 1.0)
         rem[slot] = action.get_remains_no_update()
         pen[slot] = pen_all[slot]
@@ -454,7 +454,8 @@ class DrainFastPath:
                 return None
             dt = self.batches[0][0]
         if self.lat_actions:
-            dt_lat = min(a.latency for a in self.lat_actions.values())
+            dt_lat = min(self.lat_actions[s].latency
+                         for s in sorted(self.lat_actions))
             if dt is None or dt_lat < dt:
                 dt = dt_lat
         if dt is None:
@@ -530,7 +531,7 @@ class DrainFastPath:
         eps = config["surf/precision"]
         model = self.model
         woken = []
-        for slot, action in self.lat_actions.items():
+        for slot, action in sorted(self.lat_actions.items()):
             if action.latency > delta:
                 action.latency = double_update(action.latency, delta,
                                                eps)
@@ -595,7 +596,7 @@ class DrainFastPath:
         dirty = view.consume("drain")
         if dirty is None:
             return False
-        if any(idxs is True for idxs in dirty.values()):
+        if any(dirty[f] is True for f in sorted(dirty)):
             return False       # index identity lost for a whole field
         if dirty["c_fatpipe"]:
             return False       # sharing-policy change: no drain program
@@ -768,7 +769,7 @@ class DrainFastPath:
         # any advances this plan served mean the host System's cached
         # rates are stale: force the next generic call to re-solve
         self.model.system.modified = True
-        for slot, action in self.slot_action.items():
+        for slot, action in sorted(self.slot_action.items()):
             if pen[slot] <= 0:
                 continue
             if action.state_set is not self.model.started_action_set:

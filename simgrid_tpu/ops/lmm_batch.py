@@ -474,19 +474,18 @@ def _admit_lane_ew(base_ew2, ew_fleet, ei, ewv, b):
 # Batched kernel programs (vmapped solo programs + alive-mask gating)
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit,
-                   static_argnames=("eps", "n_c", "n_v", "k_max",
-                                    "group", "has_bounds", "batch_w",
-                                    "has_tape", "has_coll"))
-def _batch_superstep(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
-                     thresh, ids, alive, k, round_budget, zero_bits,
-                     tape_t, tape_slot, tape_val, tape_pos,
-                     coll_pred, coll_ready, coll_clk,
-                     edge_src, edge_dst, exec_cost, t0,
-                     eps: float, n_c: int, n_v: int, k_max: int,
-                     group: int, has_bounds: bool = False,
-                     batch_w: bool = False, has_tape: bool = False,
-                     has_coll: bool = False):
+def _batch_superstep_program(e_var, e_cnst, e_w, c_bound, v_bound,
+                             pen, rem, thresh, ids, alive, k,
+                             round_budget, zero_bits,
+                             tape_t, tape_slot, tape_val, tape_pos,
+                             coll_pred, coll_ready, coll_clk,
+                             edge_src, edge_dst, exec_cost, t0,
+                             eps: float, n_c: int, n_v: int,
+                             k_max: int, group: int,
+                             has_bounds: bool = False,
+                             batch_w: bool = False,
+                             has_tape: bool = False,
+                             has_coll: bool = False):
     """One fleet superstep: the solo superstep program vmapped over the
     replica axis.  A dead lane (alive=False) gets k=0, so its outer
     while_loop cond is false on entry and the vmap batching rule
@@ -522,6 +521,25 @@ def _batch_superstep(e_var, e_cnst, e_w, c_bound, v_bound, pen, rem,
                     in_axes=(0,) * 13 + (0 if batch_w else None,))(
         c_bound, pen, rem, thresh, alive, tape_t, tape_slot, tape_val,
         tape_pos, coll_pred, coll_ready, coll_clk, t0, e_w)
+
+
+_BATCH_SUPERSTEP_STATICS = ("eps", "n_c", "n_v", "k_max", "group",
+                            "has_bounds", "batch_w", "has_tape",
+                            "has_coll")
+
+_batch_superstep = functools.partial(
+    jax.jit,
+    static_argnames=_BATCH_SUPERSTEP_STATICS)(_batch_superstep_program)
+
+#: the donating twin (see ops.lmm_drain._drain_superstep_donate):
+#: committed-state fleet dispatches reuse the [B, n_v] (pen, rem)
+#: buffers in place.  Dispatched under its own plan-cache kind
+#: ("superstep_donate") so AOT artifacts never alias the non-donating
+#: executable, and NEVER under a watchdog — a retried dispatch would
+#: replay over inputs the first attempt already consumed.
+_batch_superstep_donate = functools.partial(
+    jax.jit, static_argnames=_BATCH_SUPERSTEP_STATICS,
+    donate_argnames=("pen", "rem"))(_batch_superstep_program)
 
 
 def _batch_fused_lane(e_var, e_cnst, ew_l, cb, v_bound, pen_l, rem_l,
@@ -1191,7 +1209,8 @@ class BatchDrainSim:
                              alive=None, cb=None, tpos=None, t0=None,
                              round_budget: int = 0,
                              pred=None, ready=None,
-                             clk=None) -> "FleetToken":
+                             clk=None,
+                             donate: bool = False) -> "FleetToken":
         """Dispatch ONE fleet superstep without touching the committed
         state: chains from `(pen, rem)` (default: committed) under the
         CURRENT alive mask (or an explicit `alive` restriction — the
@@ -1222,9 +1241,18 @@ class BatchDrainSim:
         pred_in = self._coll_pred if pred is None else pred
         ready_in = self._coll_ready if ready is None else ready
         clk_in = self._coll_clk if clk is None else clk
+        # donation gate: only non-speculative dispatches chained from
+        # the COMMITTED state may consume their inputs, and never
+        # under a watchdog (its retry would replay over buffers the
+        # first attempt already consumed — dispatches stop being pure)
+        donate = (donate and not speculative
+                  and pen is None and rem is None
+                  and self._watchdog is None)
+        kind, fn = (("superstep_donate", _batch_superstep_donate)
+                    if donate else ("superstep", _batch_superstep))
         (pen_out, rem_out, cb_out, tpos_out, pred_out, ready_out,
          clk_out, packed) = self._call_plan(
-            "superstep", _batch_superstep,
+            kind, fn,
             (*self._dev, cb_in, self._vb, pen_in, rem_in,
              self._thresh, self._ids_dev,
              self._put_mask(alive), np.int32(k),
@@ -1235,6 +1263,13 @@ class BatchDrainSim:
                  group=group, has_bounds=self.has_bounds,
                  batch_w=self.batch_w, has_tape=self.has_tape,
                  has_coll=self.has_coll))
+        if donate:
+            # the committed buffers are gone: adopt the outputs NOW
+            # (collect re-adopts them, a no-op) and strip the dead
+            # inputs from the token so misuse fails loudly
+            self._pen, self._rem = pen_out, rem_out
+            pen_in = rem_in = None
+            opstats.bump("donated_buffers", 2)
         t0_out = None
         if self.has_tape:
             # derive the post-dispatch base clocks DEVICE-side with the
@@ -1453,7 +1488,7 @@ class BatchDrainSim:
         ONE [B, ·] fetch; commits per-replica events and clocks.
         Returns the number of still-live replicas."""
         n_alive, _clean = self._superstep_collect_all(
-            self._superstep_issue_all(k))
+            self._superstep_issue_all(k, donate=True))
         return n_alive
 
     # -- mid-flight lane admission (serving) -------------------------------
@@ -1694,7 +1729,8 @@ class BatchDrainSim:
         restricted = np.zeros(self.B_padded, bool)
         restricted[stuck] = True
         tok = self._superstep_issue_all(k=1, alive=restricted,
-                                        round_budget=_MAX_ROUNDS)
+                                        round_budget=_MAX_ROUNDS,
+                                        donate=True)
         self._superstep_collect_all(tok, rescue=True)
 
     def _run_pipelined(self, max_supersteps: int,
@@ -1732,7 +1768,8 @@ class BatchDrainSim:
                     inflight.append(self._superstep_issue_all(
                         pen=pen, rem=rem, speculative=spec,
                         cb=cb, tpos=tpos, t0=t0,
-                        pred=pred, ready=ready, clk=clk))
+                        pred=pred, ready=ready, clk=clk,
+                        donate=not spec))
                 tok = inflight.popleft()
                 _n_alive, clean = self._superstep_collect_all(tok)
                 left -= 1

@@ -536,6 +536,18 @@ _drain_superstep = functools.partial(
                               "has_bounds", "has_tape",
                               "has_coll"))(_superstep_program)
 
+#: the donating twin: steady-state dispatches that chain from the
+#: COMMITTED flow state hand their (pen, rem) buffers to XLA for
+#: in-place reuse — the inputs are dead the moment the outputs are
+#: adopted, so the only cost is that the dispatch may never be
+#: retried or replayed from those inputs (see _superstep_issue's
+#: donate gate).  Donation is an aliasing hint, not a numeric change:
+#: the program text is identical, so events/clocks are bit-identical.
+_drain_superstep_donate = functools.partial(
+    jax.jit, static_argnames=("eps", "n_c", "n_v", "k_max", "group",
+                              "has_bounds", "has_tape", "has_coll"),
+    donate_argnames=("pen", "rem"))(_superstep_program)
+
 
 #: transition-payload field order (index = the static target code in
 #: the payload layout); the first three scatter into the 2D element
@@ -1201,7 +1213,8 @@ class DrainSim:
                          rem=None, speculative: bool = False,
                          stop_live: int = 0, cb=None, tpos=None,
                          t0=None, round_budget: int = 0,
-                         pred=None, ready=None, clk=None
+                         pred=None, ready=None, clk=None,
+                         donate: bool = False
                          ) -> SuperstepToken:
         """Dispatch ONE superstep of up to `k` advances WITHOUT
         touching the committed flow state: the dispatch chains from
@@ -1213,7 +1226,17 @@ class DrainSim:
         constraint bounds and tape cursor (`cb`, `tpos`) and needs the
         f64 base clock `t0` the dispatch starts from (default: the
         committed ``self.t``); speculative issues derive all three
-        from their predecessor's token."""
+        from their predecessor's token.
+
+        ``donate=True`` hands the committed (pen, rem) buffers to XLA
+        for in-place reuse and adopts the outputs as the committed
+        state IMMEDIATELY (the inputs are deleted by the dispatch, so
+        leaving ``self._pen`` pointing at them would be a landmine).
+        Only honored on non-speculative issues chained from the
+        committed state: speculative issues must leave their inputs
+        alive for the mispredict replay, and explicit (pen, rem)
+        chains belong to callers (fastpath/replay) that snapshot
+        them."""
         if not self.superstep_k and k is None:
             raise ValueError("superstep_batch needs superstep=K "
                              "(constructor) or an explicit k")
@@ -1236,8 +1259,11 @@ class DrainSim:
         pred_in = self._coll[0] if pred is None else pred
         ready_in = self._coll[1] if ready is None else ready
         clk_in = self._coll_clk if clk is None else clk
+        donate = (donate and not speculative
+                  and pen is None and rem is None)
+        step = _drain_superstep_donate if donate else _drain_superstep
         (pen_out, rem_out, cb_out, tpos_out, pred_out, ready_out,
-         clk_out, packed) = _drain_superstep(
+         clk_out, packed) = step(
             *self._dev, cb_in, self._vb, pen_in, rem_in,
             self._thresh, self._ids_dev,
             np.int32(k), np.int32(budget), np.int32(want_stop),
@@ -1246,6 +1272,14 @@ class DrainSim:
             eps=self.eps, n_c=self.n_c, n_v=self.n_v,
             k_max=k_max, group=group, has_bounds=self.has_bounds,
             has_tape=self.has_tape, has_coll=self.has_coll)
+        if donate:
+            # the dispatch consumed the committed buffers: adopt the
+            # outputs NOW so no reachable reference is left deleted
+            # (collect re-adopts them, a no-op), and strip the dead
+            # inputs from the token so misuse fails loudly
+            self._pen, self._rem = pen_out, rem_out
+            pen_in = rem_in = None
+            opstats.bump("donated_buffers", 2)
         self.supersteps += 1
         opstats.bump("dispatches")
         if speculative:
@@ -1397,16 +1431,21 @@ class DrainSim:
 
     def superstep_batch(self, k: Optional[int] = None,
                         fetch: bool = True, stop_live: int = 0,
-                        round_budget: int = 0):
+                        round_budget: int = 0,
+                        donate: bool = False):
         """Dispatch ONE superstep of up to `k` advances and (optionally)
         fetch its packed result — a single transfer.
 
         Returns (n_live, batches) where batches is a list of
         (dt, [original flow ids]) per executed advance; with
         fetch=False nothing is transferred (replay) and (None, None) is
-        returned.  Events/clock/counters are committed on fetch."""
+        returned.  Events/clock/counters are committed on fetch.
+        ``donate=True`` (steady-state drivers only — never replay
+        paths that keep a batch-start snapshot) lets the dispatch
+        reuse the committed (pen, rem) buffers in place."""
         tok = self._superstep_issue(k, stop_live=stop_live,
-                                    round_budget=round_budget)
+                                    round_budget=round_budget,
+                                    donate=donate)
         if not fetch:
             self._pen, self._rem = tok.pen_out, tok.rem_out
             if self.has_tape:
@@ -1475,7 +1514,8 @@ class DrainSim:
                     inflight.append(self._superstep_issue(
                         k, pen=pen, rem=rem, speculative=spec,
                         cb=cb, tpos=tpos, t0=t0,
-                        pred=pred, ready=ready, clk=clk))
+                        pred=pred, ready=ready, clk=clk,
+                        donate=not spec))
                     issued_k += k
                 tok = inflight.popleft()
                 issued_k -= tok.k
@@ -1530,7 +1570,8 @@ class DrainSim:
         Without a tape, the chunked fused path (which converges across
         dispatches) is cheaper."""
         if self.has_tape or self.has_coll:
-            n, _ = self.superstep_batch(k=1, round_budget=_MAX_ROUNDS)
+            n, _ = self.superstep_batch(k=1, round_budget=_MAX_ROUNDS,
+                                        donate=True)
             return n
         return self._advance_fused()
 
@@ -1550,7 +1591,7 @@ class DrainSim:
             while (n or self._coll_open()) and max_advances > 0:
                 before = self.advances
                 k = min(self.superstep_k, max_advances)
-                n, _ = self.superstep_batch(k=k)
+                n, _ = self.superstep_batch(k=k, donate=True)
                 max_advances -= self.advances - before
                 if (n or self._coll_open()) and self.advances == before:
                     # the round budget expired inside the first solve:

@@ -148,9 +148,10 @@ class ArrayView:
     def _mark(self, field: str, idx=None) -> None:
         """Record `field` (slot `idx`, or the whole field when None) as
         dirty for every handout dtype and every index consumer."""
-        for dirty in self._dirty.values():
-            dirty.add(field)
-        for cons in self._consumers.values():
+        for dt in sorted(self._dirty, key=str):
+            self._dirty[dt].add(field)
+        for name in sorted(self._consumers):
+            cons = self._consumers[name]
             cur = cons[field]
             if cur is True:
                 continue

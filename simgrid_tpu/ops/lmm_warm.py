@@ -292,7 +292,8 @@ class WarmSolver:
         """Drop every carried fixpoint state (masters stay resident).
         Called when a solve happened outside this solver (host-exact
         fallback) so stale values can never seed a warm restart."""
-        for st in self._states.values():
+        for dt in sorted(self._states, key=str):
+            st = self._states[dt]
             if st.carry is not None:
                 self.carry_invalidations += 1
             st.carry = None
@@ -647,8 +648,8 @@ class WarmSolver:
             # changed, topology didn't) — counted separately so fault
             # sweeps can see their re-solves ride the warm path
             if dirty is not None and all(
-                    f == "c_bound" or not slots
-                    for f, slots in dirty.items()) \
+                    f == "c_bound" or not dirty[f]
+                    for f in sorted(dirty)) \
                     and dirty.get("c_bound"):
                 opstats.bump("warm_bound_restarts")
         else:

@@ -79,7 +79,7 @@ def _canon_pairs(d: Dict[int, float]) -> List[List[float]]:
 
 def _pairs_to_map(pairs) -> Dict[int, float]:
     if isinstance(pairs, dict):
-        return {int(k): float(v) for k, v in pairs.items()}
+        return {int(k): float(pairs[k]) for k in sorted(pairs, key=int)}
     return {int(k): float(v) for k, v in (pairs or [])}
 
 
@@ -458,7 +458,8 @@ class ScenarioPlan:
         link_scale = dict(spec.link_scale)
         if spec.fault_mtbf is not None and self.fault_mode == "static":
             fc, names = self._fault_campaign(spec)
-            for (kind, name), avail in fc.mean_availability().items():
+            for (kind, name), avail in sorted(
+                    fc.mean_availability().items()):
                 if avail >= 1.0:
                     continue
                 slot = names[name]

@@ -593,7 +593,7 @@ class CampaignService:
         sim = self._fleet
         if sim is not None:
             st = sim.committed_state()
-            for name, a in st["arrays"].items():
+            for name, a in sorted(st["arrays"].items()):
                 arrays["fleet_" + name] = a
             token["fleet"] = {
                 "width": sim.B,
@@ -690,7 +690,7 @@ class CampaignService:
                 pipeline=svc.pipeline, mesh=svc.mesh,
                 watchdog=watchdog)
             fleet_arrays = {name[len("fleet_"):]: a
-                            for name, a in ck.arrays.items()
+                            for name, a in sorted(ck.arrays.items())
                             if name.startswith("fleet_")}
             try:
                 sim.restore_state({"arrays": fleet_arrays,

@@ -370,3 +370,65 @@ def test_json_reporter_shape():
         == {"dtype-discipline"}
     assert all({"rule", "path", "line", "col", "message",
                 "snippet"} <= set(f) for f in report["findings"])
+
+
+# -- CLI: --rule baseline scoping ----------------------------------------
+
+class TestRuleScopedBaseline:
+    """`simlint --rule X` must not report OTHER rules' grandfathered
+    baseline entries as stale: a single-rule run only produces that
+    rule's findings, so the baseline has to be scoped the same way
+    before diffing (regression: a clean `--rule unordered-iteration`
+    run used to exit 1 over every hidden-host-sync entry)."""
+
+    def _make_tree(self, tmp_path):
+        # two files, two different rules' findings
+        core = tmp_path / CORE
+        order = tmp_path / ORDER
+        core.parent.mkdir(parents=True, exist_ok=True)
+        order.parent.mkdir(parents=True, exist_ok=True)
+        core.write_text("import random\nx = random.random()\n")
+        order.write_text("s = {1, 2}\nfor v in s:\n    print(v)\n")
+        return [CORE, ORDER]
+
+    def _main(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "simlint_cli",
+            os.path.join(REPO_ROOT, "tools", "simlint.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.main
+
+    def test_single_rule_run_ignores_other_rules_entries(self,
+                                                         tmp_path):
+        main = self._main()
+        paths = self._make_tree(tmp_path)
+        baseline = str(tmp_path / "baseline.json")
+        base_args = ["--root", str(tmp_path), "--baseline", baseline]
+        assert main(paths + base_args + ["--write-baseline"]) == 0
+
+        # full run: everything grandfathered
+        assert main(paths + base_args) == 0
+        # scoped runs: each rule sees only its own baseline slice
+        assert main(paths + base_args
+                    + ["--rule", "unordered-iteration"]) == 0
+        assert main(paths + base_args
+                    + ["--rule", "wallclock-rng"]) == 0
+
+    def test_scoped_run_still_fails_on_own_stale_entry(self,
+                                                       tmp_path):
+        main = self._main()
+        paths = self._make_tree(tmp_path)
+        baseline = str(tmp_path / "baseline.json")
+        base_args = ["--root", str(tmp_path), "--baseline", baseline]
+        assert main(paths + base_args + ["--write-baseline"]) == 0
+
+        # fix the unordered-iteration finding: ITS scoped run goes
+        # stale, the other rule's scoped run stays clean
+        (tmp_path / ORDER).write_text(
+            "s = {1, 2}\nfor v in sorted(s):\n    print(v)\n")
+        assert main(paths + base_args
+                    + ["--rule", "unordered-iteration"]) == 1
+        assert main(paths + base_args
+                    + ["--rule", "wallclock-rng"]) == 0

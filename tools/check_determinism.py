@@ -1404,7 +1404,14 @@ def quick_checks() -> List[str]:
     check, sized for seconds, so determinism regressions fail pytest
     instead of waiting for a manual tool run."""
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    problems = collect_simlint_problems(repo_root)
+    # the full static gate: simlint + proglint (compiled-program
+    # contracts staged over the registered kernel programs) + the
+    # opstats counter registry — same bundle as tools/lint_all.py
+    tools_dir = os.path.join(repo_root, "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    from lint_all import collect_problems as collect_lint_problems
+    problems = collect_lint_problems(repo_root)
     problems += check_drain_runtime(n_c=32, n_v=128, k=4)
     problems += check_batch_runtime(n_c=32, n_v=96, batch=6,
                                     solo_check=(0, 3, 5))

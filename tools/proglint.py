@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""proglint — check every registered compiled program's contract.
+
+Usage::
+
+    python tools/proglint.py [options]
+
+Stages every program in ``simgrid_tpu.analysis.prog.registry``
+(through the same ``jit().trace()`` / ``.lower()`` path the serving
+plan cache compiles) and runs the IR contract rules over the jaxpr
+and StableHLO.  Exit status 0 means no NEW findings and no stale
+baseline entries; 1 means there is something to fix; 2 is an
+operational error.
+
+Options:
+    --json              machine-readable report on stdout
+    --baseline PATH     baseline file (default tools/proglint_baseline.json
+                        when it exists; pass --baseline '' to run
+                        baseline-less)
+    --write-baseline    rewrite the baseline to grandfather every
+                        current finding, then exit 0
+    --rule ID           run only this rule (repeatable)
+    --program NAME      check only this registry entry (substring
+                        match, repeatable)
+    --list-rules        print rule ids and exit
+    --list-programs     print registered program names and exit
+
+The baseline is shrink-only, exactly like simlint's: fix a
+grandfathered finding and the now-stale entry fails the run until it
+is removed.  The expected steady state of THIS baseline is empty —
+every registered program satisfies its contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from simgrid_tpu.analysis import (apply_baseline,  # noqa: E402
+                                  dump_baseline, findings_to_json,
+                                  format_findings, load_baseline,
+                                  make_baseline)
+
+DEFAULT_BASELINE = os.path.join("tools", "proglint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="proglint", description=__doc__.splitlines()[0])
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--rule", action="append", default=None,
+                    metavar="ID")
+    ap.add_argument("--program", action="append", default=None,
+                    metavar="NAME")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--list-programs", action="store_true")
+    ap.add_argument("--root", default=REPO_ROOT,
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    # staging imports jax + the ops modules; keep that off the
+    # --list-* fast paths' error surface but load lazily either way
+    from simgrid_tpu.analysis.prog import (ALL_PROG_RULE_IDS,
+                                           iter_programs,
+                                           lint_programs)
+
+    if args.list_rules:
+        for rid in ALL_PROG_RULE_IDS:
+            print(rid)
+        return 0
+    specs = iter_programs()
+    if args.list_programs:
+        for spec in specs:
+            print(spec.name)
+        return 0
+
+    if args.rule:
+        unknown = [i for i in args.rule
+                   if i not in ALL_PROG_RULE_IDS]
+        if unknown:
+            print("proglint: unknown rule id(s): "
+                  + ", ".join(unknown), file=sys.stderr)
+            return 2
+    if args.program:
+        specs = [s for s in specs
+                 if any(pat in s.name for pat in args.program)]
+        if not specs:
+            print("proglint: no registered program matches "
+                  + ", ".join(args.program), file=sys.stderr)
+            return 2
+
+    findings = lint_programs(specs, rules=args.rule)
+
+    baseline_path = (os.path.join(args.root, args.baseline)
+                     if args.baseline
+                     and not os.path.isabs(args.baseline)
+                     else args.baseline)
+
+    if args.write_baseline:
+        if not baseline_path:
+            print("proglint: --write-baseline needs --baseline",
+                  file=sys.stderr)
+            return 2
+        dump_baseline(make_baseline(findings), baseline_path)
+        print(f"proglint: baselined {len(findings)} finding(s) -> "
+              f"{os.path.relpath(baseline_path, args.root)}")
+        return 0
+
+    baseline = None
+    if baseline_path and os.path.exists(baseline_path):
+        try:
+            baseline = load_baseline(baseline_path)
+        except (ValueError, OSError) as e:
+            print(f"proglint: cannot load baseline: {e}",
+                  file=sys.stderr)
+            return 2
+    if baseline is not None and (args.rule or args.program):
+        # a scoped run only produced the selected rules'/programs'
+        # findings — scope the baseline the same way so everything
+        # else doesn't read as stale (mirrors simlint --rule)
+        checked = {f"program:{s.name}" for s in specs}
+        entries = [e for e in baseline.get("entries", [])
+                   if (not args.rule or e.get("rule") in args.rule)
+                   and e.get("path") in checked]
+        baseline = dict(baseline, entries=entries)
+    new, stale = apply_baseline(findings, baseline)
+    baselined = len(findings) - len(new)
+
+    if args.json:
+        print(findings_to_json(new, stale, baselined))
+    else:
+        report = format_findings(new, stale)
+        if report:
+            print(report)
+        print(f"proglint: {len(specs)} program(s) checked, "
+              f"{len(new)} new finding(s), {baselined} baselined, "
+              f"{len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'}")
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -101,6 +101,15 @@ def main(argv=None) -> int:
             print(f"simlint: cannot load baseline: {e}",
                   file=sys.stderr)
             return 2
+    if baseline is not None and args.rule:
+        # a --rule run only produced that rule's findings, so other
+        # rules' grandfathered entries would all read as "stale" —
+        # scope the baseline to the selected rules before diffing
+        selected = {r.id for r in rules}
+        baseline = dict(
+            baseline,
+            entries=[e for e in baseline.get("entries", [])
+                     if e.get("rule") in selected])
     new, stale = apply_baseline(findings, baseline)
     baselined = len(findings) - len(new)
 
